@@ -1,0 +1,56 @@
+"""Headline benchmark for the driver: bf16 matmul TFLOP/s per chip.
+
+Prints exactly ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference publishes no numbers, so the target is
+BASELINE.json's north star — >=50% MFU on v5e => 98.5 bf16 TFLOP/s per chip.
+``vs_baseline`` is achieved/98.5 (so 1.0 == the 50%-MFU target; 2.0 == peak).
+
+On a multi-device backend this runs the pjit-sharded matmul over the full mesh
+(per-chip TFLOP/s reported); on one device it runs the single-chip kernel. On
+a CPU-only backend it still emits a (small, honest) measurement so the pipeline
+never breaks.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
+
+
+def main() -> int:
+    import jax
+
+    from k3stpu.ops.matmul import measure_matmul, measure_pjit_matmul
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    dim = 8192 if on_accel else 512
+    iters = 50 if on_accel else 5
+
+    if len(devices) > 1:
+        from k3stpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh(len(devices), model_parallelism=1,
+                         axis_names=("data", "model"))
+        res = measure_pjit_matmul(mesh, m=dim, n=dim, k=dim, iters=iters)
+    else:
+        res = measure_matmul(m=dim, n=dim, k=dim, iters=iters)
+
+    print(json.dumps({
+        "metric": "pjit_matmul_bf16_tflops_per_chip",
+        "value": round(res.tflops, 2),
+        "unit": "TFLOP/s/chip",
+        "vs_baseline": round(res.tflops / BASELINE_TFLOPS, 4),
+        "detail": res.to_dict(),
+        "device_kind": getattr(devices[0], "device_kind", "unknown"),
+        "n_devices": len(devices),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

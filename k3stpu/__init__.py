@@ -1,0 +1,29 @@
+"""K3S-TPU: a TPU-native K3S accelerator-enablement stack.
+
+Built from scratch with the capabilities of the UntouchedWagons/K3S-NVidia
+reference guide (see /root/reference and SURVEY.md): where the reference wires
+NVIDIA GPUs into K3S (nvidia-container-toolkit RuntimeClass, Node Feature
+Discovery, NVIDIA device plugin with 4-way time-slicing, nvidia-smi probe,
+Jellyfin workload), this package plus the `native/` C++ components provide the
+same capability surface for Cloud TPUs:
+
+- ``native/tpu-container-runtime``  — OCI runtime shim (RuntimeClass ``tpu``),
+  parity with nvidia-container-toolkit (reference README.md:57-69).
+- ``native/tpu-device-plugin``      — kubelet device plugin advertising
+  ``google.com/tpu`` with N-way per-chip sharing, parity with the nvdp chart
+  and its time-slicing values.yaml (reference values.yaml:12-18).
+- ``k3stpu.discovery``              — node labeling, parity with NFD + GFD
+  (reference README.md:97-103, values.yaml:1-2).
+- ``k3stpu.probe``                  — ``jax.devices()`` probe, parity with
+  nvidia-smi.yaml.
+- ``k3stpu.serve`` / ``k3stpu.models`` — JAX inference workload, parity with
+  jellyfin.yaml.
+- ``k3stpu.parallel``               — mesh/pjit/shard_map utilities for the
+  multi-node north-star job (BASELINE.json config 5).
+"""
+
+__version__ = "0.1.0"
+
+RESOURCE_NAME = "google.com/tpu"
+
+from k3stpu.utils.chips import GOOGLE_PCI_VENDOR_ID  # noqa: E402,F401

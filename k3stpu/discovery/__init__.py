@@ -1,0 +1,10 @@
+"""TPU node discovery & labeling — parity with NFD + GPU Feature Discovery.
+
+The reference installs Node Feature Discovery to label GPU nodes (vendor-id
+10de -> `nvidia.com/gpu.present`, reference README.md:97-103, consumed at
+nvidia-smi.yaml:6-7) plus GFD for per-GPU labels (values.yaml:1-2). This
+package is the TPU-native equivalent: scan PCI sysfs for Google's vendor id
+1ae0 and publish `google.com/tpu.*` labels through the Kubernetes API.
+"""
+
+from k3stpu.discovery.labeler import labels_for_inventory  # noqa: F401

@@ -1,0 +1,128 @@
+"""tpu-feature-discovery: label K3S nodes with their TPU inventory.
+
+Runs as a DaemonSet (deploy/charts templates it). Parity mapping:
+- NFD's `feature.node.kubernetes.io/pci-10de.present` for NVIDIA (reference
+  README.md:99) -> `feature.node.kubernetes.io/pci-1ae0.present` here;
+- GFD's `nvidia.com/gpu.product/count/...` (reference values.yaml:1-2,
+  README.md:126) -> `google.com/tpu.generation/count/topology`;
+- the nodeSelector gate `nvidia.com/gpu.present: "true"` (reference
+  nvidia-smi.yaml:6-7) -> `google.com/tpu.present: "true"`.
+
+Stdlib-only: the in-cluster Kubernetes API is plain HTTPS with the service
+account bearer token, so no client library is needed. `--dry-run` prints the
+patch instead of sending it (used by tests and for debugging).
+
+Run: python -m k3stpu.discovery.labeler [--once] [--dry-run] [--interval 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import ssl
+import sys
+import time
+import urllib.request
+
+from k3stpu.utils.chips import TpuInventory, enumerate_chips
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def labels_for_inventory(inv: TpuInventory) -> dict[str, "str | None"]:
+    """Pure label computation (unit-testable, no cluster).
+
+    The zero-chip case sets the per-chip keys to None: a strategic-merge
+    PATCH deletes null-valued labels, so a node whose TPUs vanish does not
+    keep advertising a stale count/topology.
+    """
+    if inv.count == 0:
+        return {
+            "google.com/tpu.present": "false",
+            "google.com/tpu.count": None,
+            "google.com/tpu.generation": None,
+            "google.com/tpu.topology": None,
+            "feature.node.kubernetes.io/pci-1ae0.present": "false",
+        }
+    return {
+        "google.com/tpu.present": "true",
+        "google.com/tpu.count": str(inv.count),
+        "google.com/tpu.generation": inv.generation,
+        "google.com/tpu.topology": inv.topology(),
+        "feature.node.kubernetes.io/pci-1ae0.present": "true",
+    }
+
+
+class NodePatcher:
+    """PATCHes node labels via the in-cluster API using the SA token."""
+
+    def __init__(self, node_name: str | None = None,
+                 api_server: str | None = None, sa_dir: str = SA_DIR):
+        self.node_name = node_name or os.environ.get("NODE_NAME", "")
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.api_server = api_server or f"https://{host}:{port}"
+        self.sa_dir = sa_dir
+
+    def patch_labels(self, labels: dict[str, str]) -> int:
+        if not self.node_name:
+            raise RuntimeError("NODE_NAME env var is required (downward API)")
+        with open(os.path.join(self.sa_dir, "token")) as f:
+            token = f.read().strip()
+        ctx = ssl.create_default_context(
+            cafile=os.path.join(self.sa_dir, "ca.crt"))
+        body = json.dumps({"metadata": {"labels": labels}}).encode()
+        req = urllib.request.Request(
+            f"{self.api_server}/api/v1/nodes/{self.node_name}",
+            data=body,
+            method="PATCH",
+            headers={
+                "Authorization": f"Bearer {token}",
+                "Content-Type": "application/strategic-merge-patch+json",
+            },
+        )
+        with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+            return resp.status
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="K3S-TPU node labeler (NFD/GFD parity)")
+    ap.add_argument("--once", action="store_true", help="label once and exit")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="print the labels instead of patching the node")
+    ap.add_argument("--interval", type=int, default=30,
+                    help="rescan/patch interval seconds")
+    ap.add_argument("--host-root", default=None,
+                    help="host filesystem root (default / or K3STPU_HOST_ROOT)")
+    args = ap.parse_args(argv)
+
+    patcher = None if args.dry_run else NodePatcher()
+    last: dict | None = None
+    while True:
+        inv = enumerate_chips(root=args.host_root)
+        labels = labels_for_inventory(inv)
+        if labels != last:
+            if args.dry_run:
+                print("LABELS_JSON " + json.dumps(labels))
+                last = labels
+            else:
+                # Transient apiserver errors must not crash the DaemonSet
+                # (NFD likewise retries in-process); `last` stays unset so
+                # the patch is reattempted next interval.
+                try:
+                    status = patcher.patch_labels(labels)
+                    print(f"patched node {patcher.node_name}: {status} "
+                          + json.dumps(labels), flush=True)
+                    last = labels
+                except Exception as e:  # noqa: BLE001 — keep the daemon up
+                    print(f"node patch failed (will retry): {e}",
+                          file=sys.stderr, flush=True)
+        if args.once:
+            return 0
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,11 @@
+"""Model zoo for the workload images: ResNet-50 (BASELINE.json config 4,
+"JAX ResNet-50 inference Deployment") and a decoder-only transformer LM (the
+matmul-only flagship for compile checks and LM serving)."""
+
+from k3stpu.models.resnet import ResNet, resnet18, resnet50  # noqa: F401
+from k3stpu.models.transformer import (  # noqa: F401
+    TransformerConfig,
+    TransformerLM,
+    transformer_lm_small,
+    transformer_lm_tiny,
+)

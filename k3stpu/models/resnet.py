@@ -1,0 +1,135 @@
+"""ResNet (v1.5) in Flax, written TPU-first.
+
+This is the workload model replacing the reference's Jellyfin demo
+(reference jellyfin.yaml:1-43 — a long-running 1-GPU media server); our
+equivalent is a JAX ResNet-50 inference Deployment (BASELINE.json config 4:
+1 chip, batch=32).
+
+TPU-first choices:
+- compute in bfloat16 (MXU native), batch-norm statistics in float32;
+- NHWC layout throughout — XLA:TPU's preferred conv layout;
+- the stride-2 downsample sits on the 3x3 conv (v1.5), which both helps
+  accuracy and keeps the 1x1 convs dense matmuls on the MXU;
+- no Python-level control flow in the forward pass, so the whole network
+  traces to a single XLA computation.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Sequence
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck with projection shortcut when shapes change."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1), name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      name="conv2")(y)
+        y = self.norm(name="bn2")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1), name="conv3")(y)
+        # Zero-init the last BN scale so each block starts as identity.
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn3")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class BasicBlock(nn.Module):
+    """3x3 -> 3x3 block for the small variants (ResNet-18/34)."""
+
+    filters: int
+    strides: int
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), strides=(self.strides, self.strides),
+                      name="conv1")(x)
+        y = self.norm(name="bn1")(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), name="conv2")(y)
+        y = self.norm(scale_init=nn.initializers.zeros, name="bn2")(y)
+
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1),
+                                 strides=(self.strides, self.strides),
+                                 name="proj_conv")(residual)
+            residual = self.norm(name="proj_bn")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block: ModuleDef = BottleneckBlock
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=jnp.float32)
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), strides=(2, 2), name="conv_stem")(x)
+        x = norm(name="bn_stem")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for blk in range(n_blocks):
+                strides = 2 if stage > 0 and blk == 0 else 1
+                x = self.block(
+                    filters=self.num_filters * 2 ** stage,
+                    strides=strides, conv=conv, norm=norm,
+                    name=f"stage{stage + 1}_block{blk + 1}",
+                )(x)
+
+        x = jnp.mean(x, axis=(1, 2))
+        # Classifier head in fp32 for numerically stable logits/softmax.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x
+
+
+def resnet18(**kw) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block=BasicBlock, **kw)
+
+
+def resnet34(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BasicBlock, **kw)
+
+
+def resnet50(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block=BottleneckBlock, **kw)
+
+
+def resnet101(**kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 23, 3), block=BottleneckBlock, **kw)

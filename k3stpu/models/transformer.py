@@ -1,0 +1,138 @@
+"""Decoder-only transformer LM, written TPU-first.
+
+Second model family beside ResNet (the reference stack is model-agnostic — it
+schedules devices, not models; SURVEY.md §2c). This is the flagship for the
+driver's compile checks and the LM-serving workload: unlike ResNet it is
+matmul-only, so every FLOP lands on the MXU with no conv lowering in the path.
+
+TPU-first choices:
+- single fused QKV projection (one big matmul beats three small ones);
+- attention via einsum with fp32 softmax accumulation, bf16 everywhere else;
+- RoPE instead of learned positions — no extra params to shard, and the
+  rotation fuses into the surrounding elementwise ops;
+- weight-tied LM head (embedding transpose) keeps the big vocab matmul
+  shardable over the 'model' axis;
+- static shapes + no Python control flow, so the whole step is one XLA
+  computation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32768
+    d_model: int = 768
+    n_heads: int = 12
+    n_layers: int = 12
+    d_ff: int = 3072
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16
+
+
+def rope_frequencies(head_dim: int, max_seq_len: int) -> np.ndarray:
+    """Precomputed RoPE angles, shape (max_seq_len, head_dim // 2)."""
+    inv_freq = 1.0 / (10000 ** (np.arange(0, head_dim, 2) / head_dim))
+    t = np.arange(max_seq_len)
+    return np.outer(t, inv_freq)
+
+
+def apply_rope(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
+    """x: (B, S, H, D). Rotates pairs of channels by position-dependent angles."""
+    seq = x.shape[1]
+    cos = jnp.cos(angles[:seq])[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles[:seq])[None, :, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        b, s, _ = x.shape
+        head_dim = cfg.d_model // cfg.n_heads
+
+        qkv = nn.Dense(3 * cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                       param_dtype=jnp.float32, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, cfg.n_heads, head_dim)
+        k = k.reshape(b, s, cfg.n_heads, head_dim)
+        v = v.reshape(b, s, cfg.n_heads, head_dim)
+
+        angles = jnp.asarray(rope_frequencies(head_dim, cfg.max_seq_len))
+        q = apply_rope(q, angles)
+        k = apply_rope(k, angles)
+
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32) * scale
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(b, s, cfg.d_model)
+        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=jnp.float32, name="proj")(out)
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_attn")(x)
+        x = x + Attention(cfg, name="attn")(h)
+        h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_mlp")(x)
+        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_in")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="mlp_out")(h)
+        return x + h
+
+
+class TransformerLM(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        del train  # no dropout: inference-first; training uses weight decay
+        cfg = self.config
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model,
+                         param_dtype=jnp.float32, dtype=cfg.dtype,
+                         name="embed")
+        x = embed(tokens)
+        for i in range(cfg.n_layers):
+            x = Block(cfg, name=f"block{i}")(x)
+        x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        # Weight-tied head; logits cast to fp32 for a stable softmax/loss.
+        return embed.attend(x).astype(jnp.float32)
+
+
+def transformer_lm_small(**overrides) -> TransformerLM:
+    """~124M params (GPT-2-small scale), the default serving model."""
+    return TransformerLM(TransformerConfig(**overrides))
+
+
+def transformer_lm_tiny(**overrides) -> TransformerLM:
+    """Test/dry-run scale: compiles in seconds on CPU."""
+    defaults = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                    d_ff=128, max_seq_len=128)
+    defaults.update(overrides)
+    return TransformerLM(TransformerConfig(**defaults))

@@ -1,0 +1,2 @@
+"""Compute ops: the pjit matmul benchmark that defines this repo's headline
+metric (BASELINE.json north star: >=50% MFU on v5e), plus Pallas kernels."""

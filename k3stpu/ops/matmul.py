@@ -1,0 +1,189 @@
+"""Matmul throughput / MFU measurement.
+
+This is the TPU-native replacement for the reference's verification oracle: the
+reference proves the stack works by reading an ``nvidia-smi`` table from inside
+a pod (reference README.md:128-156); we prove it by running a jitted bf16
+matmul inside the probe pod and logging achieved TFLOP/s per chip against the
+chip's peak (BASELINE.json: ">=50% MFU on v5e" => >= ~98.5 bf16 TFLOP/s).
+
+Design notes (TPU-first):
+- bf16 inputs with fp32 accumulation (``preferred_element_type``) is the MXU's
+  native contraction; sizes are multiples of 256 so XLA tiles cleanly.
+- each iteration feeds the previous output back in (a data dependency), and
+  the timed region ends with a jitted scalar reduction pulled to the host —
+  a device->host transfer cannot complete before the chain has executed, so
+  the measurement is immune to optimistic ``block_until_ready`` behavior on
+  relayed/async PJRT backends.
+- the chained product is rescaled by 1/sqrt(k) each step so bf16 stays finite.
+- compile (first call) is excluded; the median of several trials is reported.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+# Peak dense bf16 TFLOP/s per chip, per generation (public figures).
+PEAK_BF16_TFLOPS = {
+    "v2": 46.0,
+    "v3": 123.0,
+    "v4": 275.0,
+    "v5 lite": 197.0,   # device_kind for v5e is "TPU v5 lite"
+    "v5e": 197.0,
+    "v5p": 459.0,
+    "v6 lite": 918.0,
+    "v6e": 918.0,
+}
+
+
+def peak_tflops_for(device: "jax.Device | None" = None) -> float | None:
+    """Peak bf16 TFLOP/s for a device, or None if unknown (e.g. CPU)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = getattr(device, "device_kind", "").lower()
+    for key, peak in PEAK_BF16_TFLOPS.items():
+        if key in kind:
+            return peak
+    return None
+
+
+@dataclass
+class MatmulResult:
+    m: int
+    n: int
+    k: int
+    dtype: str
+    iters: int
+    seconds: float
+    tflops: float            # achieved TFLOP/s (per participating chip)
+    peak_tflops: float | None
+    mfu: float | None        # achieved / peak, None when peak unknown
+
+    def to_dict(self) -> dict:
+        return {
+            "m": self.m, "n": self.n, "k": self.k, "dtype": self.dtype,
+            "iters": self.iters, "seconds": round(self.seconds, 4),
+            "tflops": round(self.tflops, 2),
+            "peak_tflops": self.peak_tflops,
+            "mfu": round(self.mfu, 4) if self.mfu is not None else None,
+        }
+
+
+@jax.jit
+def _abs_sum(x: jax.Array) -> jax.Array:
+    return jnp.sum(jnp.abs(x.astype(jnp.float32)))
+
+
+def measure_matmul(
+    m: int = 8192,
+    n: int = 8192,
+    k: int = 8192,
+    dtype=jnp.bfloat16,
+    iters: int = 50,
+    trials: int = 3,
+    device: "jax.Device | None" = None,
+) -> MatmulResult:
+    """Time ``iters`` dependency-chained ``m x k @ k x n`` matmuls."""
+    if device is None:
+        device = jax.devices()[0]
+    square = m == n == k
+    scale = 1.0 / (k ** 0.5)
+
+    @jax.jit
+    def step(a, x):
+        y = jnp.dot(a, x, preferred_element_type=jnp.float32)
+        return (y * scale).astype(a.dtype)
+
+    key_a, key_b = jax.random.split(jax.random.key(0))
+    a = jax.device_put(jax.random.normal(key_a, (m, k), dtype=dtype), device)
+    b = jax.device_put(jax.random.normal(key_b, (k, n), dtype=dtype), device)
+
+    # Warm up both programs end-to-end (compile + relay pipeline).
+    float(_abs_sum(step(a, b)))
+
+    best: float | None = None
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = b
+        for _ in range(iters):
+            out = step(a, out if square else b)
+        host_sum = float(_abs_sum(out))  # device->host sync ends the clock
+        times.append(time.perf_counter() - t0)
+        assert host_sum == host_sum, "matmul produced NaN"
+    times.sort()
+    elapsed = times[len(times) // 2]  # median trial
+
+    tflops = (2.0 * m * n * k * iters) / elapsed / 1e12
+    peak = peak_tflops_for(device)
+    return MatmulResult(
+        m=m, n=n, k=k, dtype=jnp.dtype(dtype).name, iters=iters,
+        seconds=elapsed, tflops=tflops, peak_tflops=peak,
+        mfu=(tflops / peak) if peak else None,
+    )
+
+
+def measure_pjit_matmul(
+    mesh: "jax.sharding.Mesh",
+    m: int = 8192,
+    n: int = 8192,
+    k: int = 8192,
+    dtype=jnp.bfloat16,
+    iters: int = 50,
+    trials: int = 3,
+) -> MatmulResult:
+    """The north-star measurement (BASELINE.json config 5): a matmul sharded
+    over a device mesh. A is row-sharded over the leading mesh axis and the
+    chained product keeps that sharding, so each chip runs its full MXU tile
+    with no collective in the hot loop. Reported TFLOP/s is per chip."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    row_sh = NamedSharding(mesh, P(axis, None))
+    repl_sh = NamedSharding(mesh, P())
+    scale = 1.0 / (k ** 0.5)
+    square = m == n == k
+
+    step = jax.jit(
+        lambda a, x: (jnp.dot(a, x, preferred_element_type=jnp.float32)
+                      * scale).astype(a.dtype),
+        in_shardings=(row_sh, repl_sh),
+        out_shardings=row_sh,
+    )
+    # Chaining feeds the row-sharded product back as the replicated operand,
+    # which inserts an all-gather; at 8 chips x 8192^2 bf16 that is <4% of the
+    # matmul time and rides ICI. Square-only; otherwise iterate independently.
+    gather = jax.jit(lambda x: x, in_shardings=(row_sh,), out_shardings=repl_sh)
+    pull = jax.jit(_abs_sum.__wrapped__, in_shardings=(row_sh,),
+                   out_shardings=repl_sh)
+
+    key_a, key_b = jax.random.split(jax.random.key(0))
+    a = jax.device_put(jax.random.normal(key_a, (m, k), dtype=dtype), row_sh)
+    b = jax.device_put(jax.random.normal(key_b, (k, n), dtype=dtype), repl_sh)
+
+    float(pull(step(a, b)))  # warm-up: compile + relay pipeline
+
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = b
+        for _ in range(iters):
+            out = gather(step(a, out)) if square else step(a, b)
+        host_sum = float(pull(out) if not square else _abs_sum(out))
+        times.append(time.perf_counter() - t0)
+        assert host_sum == host_sum, "matmul produced NaN"
+    times.sort()
+    elapsed = times[len(times) // 2]
+
+    n_dev = len(mesh.devices.reshape(-1))
+    tflops = (2.0 * m * n * k * iters) / elapsed / 1e12 / n_dev
+    peak = peak_tflops_for(mesh.devices.reshape(-1)[0])
+    return MatmulResult(
+        m=m, n=n, k=k, dtype=jnp.dtype(dtype).name, iters=iters,
+        seconds=elapsed, tflops=tflops, peak_tflops=peak,
+        mfu=(tflops / peak) if peak else None,
+    )

@@ -1,0 +1,13 @@
+"""Distributed execution: device meshes, sharding rules, the sharded train
+step, and multi-pod rendezvous.
+
+The reference stack has no distributed compute at all (SURVEY.md §2d) — its
+north-star TPU translation is XLA collectives over ICI/DCN reached through
+``jax.distributed.initialize`` + ``pjit`` (BASELINE.json config 5). This
+package is that layer: no custom transport, the compiler inserts the
+collectives; the cluster layer (device plugin + headless Service) only has to
+deliver chips and a coordinator address.
+"""
+
+from k3stpu.parallel.mesh import make_mesh  # noqa: F401
+from k3stpu.parallel.sharding import infer_param_sharding, shard_params  # noqa: F401

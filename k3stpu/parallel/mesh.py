@@ -1,0 +1,57 @@
+"""Device-mesh construction for K3S-scheduled TPU pods.
+
+A mesh is the TPU-idiomatic unit of parallelism: axes map onto ICI links
+within a slice and DCN across slices. We default to a 2-D ``(data, model)``
+mesh — data-parallel gradients ride a ``psum`` per step, tensor-parallel
+activations ride ``all_gather``/``reduce_scatter``, and XLA lays both onto ICI
+as long as the 'model' axis is innermost (fastest-varying device order).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    n_devices: int | None = None,
+    model_parallelism: int | None = None,
+    axis_names: tuple[str, str] = ("data", "model"),
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``(data, model)`` mesh over the first ``n_devices`` devices.
+
+    ``model_parallelism`` defaults to min(2, n) so every multi-device mesh
+    exercises both a batch axis and a tensor axis. The 'model' axis is the
+    minor (contiguous) axis so tensor-parallel collectives stay on adjacent
+    ICI neighbors.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices but only {len(devices)} visible"
+        )
+    devices = devices[:n_devices]
+
+    if model_parallelism is None:
+        model_parallelism = 2 if n_devices % 2 == 0 and n_devices >= 2 else 1
+    if n_devices % model_parallelism:
+        raise ValueError(
+            f"n_devices={n_devices} not divisible by model_parallelism={model_parallelism}"
+        )
+    grid = np.array(devices).reshape(n_devices // model_parallelism, model_parallelism)
+    return Mesh(grid, axis_names)
+
+
+def mesh_shape_for(n: int) -> tuple[int, int]:
+    """Near-square (data, model) factorization, used for topology labels."""
+    m = int(math.sqrt(n))
+    while n % m:
+        m -= 1
+    return (n // m, m)

@@ -1,0 +1,53 @@
+"""Parameter sharding rules for the (data, model) mesh.
+
+Tensor parallelism for conv nets, the TPU way: shard every kernel's output-
+feature axis over 'model' (conv HWIO -> 'O'; dense in,out -> 'out'), replicate
+biases/scales logically but let them follow the feature axis where they have
+one. XLA then partitions each conv/matmul across the 'model' axis and inserts
+the all-gathers/reduce-scatters itself — no hand-written collectives.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def infer_param_sharding(path: tuple, value, mesh: Mesh) -> NamedSharding:
+    """Sharding for one parameter leaf, by name and rank.
+
+    - conv kernels (rank 4, HWIO): P(None, None, None, 'model')
+    - dense kernels (rank 2): P(None, 'model')
+    - per-feature vectors (rank 1) under a norm/bias that feeds a sharded
+      feature axis: P('model') when divisible, else replicated
+    - everything else: replicated
+    """
+    names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
+    is_model_axis_ok = lambda dim: dim % mesh.shape["model"] == 0
+
+    if value.ndim == 4 and is_model_axis_ok(value.shape[3]):
+        return NamedSharding(mesh, P(None, None, None, "model"))
+    if value.ndim == 2 and is_model_axis_ok(value.shape[1]):
+        return NamedSharding(mesh, P(None, "model"))
+    if value.ndim == 1 and is_model_axis_ok(value.shape[0]) and any(
+        n in ("bias", "scale", "mean", "var") for n in names
+    ):
+        return NamedSharding(mesh, P("model"))
+    return NamedSharding(mesh, P())
+
+
+def shard_params(params, mesh: Mesh):
+    """Apply :func:`infer_param_sharding` across a pytree and device_put it."""
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda path, v: infer_param_sharding(path, v, mesh), params
+    )
+    return jax.device_put(params, shardings), shardings
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Inputs are sharded over 'data' on the leading (batch) axis."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

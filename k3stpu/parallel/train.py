@@ -1,0 +1,147 @@
+"""Sharded training step (dp x tp) over a K3S-delivered TPU mesh.
+
+The reference has no training path at all (SURVEY.md §2c) — this is the
+north-star extension: one generic jitted train step whose gradients ``psum``
+over the 'data' axis and whose matmuls partition over 'model', with XLA
+emitting the ICI collectives. Works for any flax model whose ``__call__``
+accepts ``(inputs, *, train: bool)`` — both model families (ResNet-50 and the
+transformer LM) ride the same bundle. Used by the multi-node Job workload and
+by ``__graft_entry__.dryrun_multichip`` (the driver's multi-chip compile
+check).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh
+
+from k3stpu.parallel.sharding import batch_sharding, replicated, shard_params
+
+
+@dataclass
+class TrainBundle:
+    """Everything needed to run sharded steps: jitted fn + sharded state.
+
+    ``step_fn(params, batch_stats, opt_state, inputs, labels)`` returns
+    ``(params, batch_stats, opt_state, loss)``; ``batch_stats`` is an empty
+    dict for models without BatchNorm (the LM) and flows through untouched.
+    """
+
+    step_fn: Any
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+    mesh: Mesh
+
+    def run(self, inputs: jax.Array, labels: jax.Array) -> float:
+        """One step on an already-formed batch; returns the loss."""
+        if inputs.shape[0] % self.mesh.shape["data"]:
+            raise ValueError(
+                f"batch {inputs.shape[0]} not divisible by data axis "
+                f"{self.mesh.shape['data']}"
+            )
+        data_sh = batch_sharding(self.mesh)
+        inputs = jax.device_put(inputs, data_sh)
+        labels = jax.device_put(labels, data_sh)
+        self.params, self.batch_stats, self.opt_state, loss = self.step_fn(
+            self.params, self.batch_stats, self.opt_state, inputs, labels
+        )
+        return float(loss)
+
+
+def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean token/example NLL; works for (B, C) and (B, S, C) logits."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    picked = jnp.take_along_axis(logp, labels[..., None], axis=-1)
+    return -jnp.mean(picked)
+
+
+def make_train_bundle(
+    model,
+    mesh: Mesh,
+    example_input: jax.Array,
+    optimizer: "optax.GradientTransformation | None" = None,
+    loss_fn: Callable[[jax.Array, jax.Array], jax.Array] = cross_entropy,
+) -> TrainBundle:
+    """Initialize params on host, shard them over the mesh (conv/dense feature
+    axes over 'model'), and jit the train step with explicit shardings.
+
+    ``example_input`` is a single-example-shaped array used only for init
+    (e.g. ``zeros((1, H, W, 3))`` or ``zeros((1, seq), int32)``); the step
+    itself specializes to whatever batch is passed at run time.
+    """
+    if optimizer is None:
+        optimizer = optax.sgd(0.1, momentum=0.9, nesterov=True)
+    tx = optimizer
+
+    variables = model.init(jax.random.key(0), example_input, train=True)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    has_stats = bool(batch_stats)
+
+    params, param_sh = shard_params(params, mesh)
+    batch_stats, stats_sh = shard_params(batch_stats, mesh)
+    # tx.init runs on the already-sharded params, so optimizer buffers
+    # inherit the parameter shardings; the step leaves opt_state free.
+    opt_state = tx.init(params)
+
+    data_sh = batch_sharding(mesh)
+    repl = replicated(mesh)
+
+    def apply_loss(p, stats, inputs, labels):
+        variables = {"params": p}
+        if has_stats:
+            variables["batch_stats"] = stats
+            logits, mut = model.apply(variables, inputs, train=True,
+                                      mutable=["batch_stats"])
+            return loss_fn(logits, labels), mut["batch_stats"]
+        logits = model.apply(variables, inputs, train=True)
+        return loss_fn(logits, labels), stats
+
+    def step(p, stats, opt_state, inputs, labels):
+        (loss, new_stats), grads = jax.value_and_grad(
+            apply_loss, has_aux=True)(p, stats, inputs, labels)
+        updates, opt_state = tx.update(grads, opt_state, p)
+        p = optax.apply_updates(p, updates)
+        return p, new_stats, opt_state, loss
+
+    step_fn = jax.jit(
+        step,
+        in_shardings=(param_sh, stats_sh, None, data_sh, data_sh),
+        out_shardings=(param_sh, stats_sh, None, repl),
+        donate_argnums=(0, 1, 2),
+    )
+    return TrainBundle(step_fn=step_fn, params=params, batch_stats=batch_stats,
+                       opt_state=opt_state, mesh=mesh)
+
+
+# ----------------------------------------------------- synthetic batches
+
+def synth_image_batch(rng: jax.Array, batch: int, image_shape, num_classes):
+    k1, k2 = jax.random.split(rng)
+    images = jax.random.normal(k1, (batch, *image_shape), jnp.float32)
+    labels = jax.random.randint(k2, (batch,), 0, num_classes)
+    return images, labels
+
+
+def synth_token_batch(rng: jax.Array, batch: int, seq_len: int, vocab: int):
+    toks = jax.random.randint(rng, (batch, seq_len + 1), 0, vocab)
+    return toks[:, :-1], toks[:, 1:]
+
+
+def run_synthetic_steps(bundle: TrainBundle, make_batch, n_steps: int = 1,
+                        seed: int = 2) -> float:
+    """Drive steps with ``make_batch(rng) -> (inputs, labels)``; returns the
+    final loss (host float)."""
+    rng = jax.random.key(seed)
+    loss = None
+    for _ in range(n_steps):
+        rng, k = jax.random.split(rng)
+        inputs, labels = make_batch(k)
+        loss = bundle.run(inputs, labels)
+    return loss

@@ -1,0 +1,75 @@
+"""Diagnostic probe: the TPU analogue of the reference's nvidia-smi pod.
+
+The reference verifies its whole stack by running ``nvidia-smi`` in a pod with
+``nvidia.com/gpu: 1`` and reading the device table from the logs (reference
+nvidia-smi.yaml:1-16, README.md:128-156). This module is the command that runs
+inside our probe pod (deploy/manifests/tpu-probe.yaml): it prints a device
+table from ``jax.devices()`` — the oracle is a ``TpuDevice``/TPU entry — and
+then, unlike nvidia-smi, proves the chip actually computes by logging matmul
+TFLOP/s and MFU (the BASELINE.json metric).
+
+Run:  python -m k3stpu.probe [--m 8192 --iters 30] [--skip-bench]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def device_table() -> list[dict]:
+    import jax
+
+    rows = []
+    for d in jax.devices():
+        rows.append(
+            {
+                "id": d.id,
+                "kind": getattr(d, "device_kind", "unknown"),
+                "platform": d.platform,
+                "process": getattr(d, "process_index", 0),
+                "coords": list(getattr(d, "coords", []) or []),
+            }
+        )
+    return rows
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="K3S-TPU probe (nvidia-smi parity)")
+    ap.add_argument("--m", type=int, default=8192, help="matmul dimension")
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--skip-bench", action="store_true")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    rows = device_table()
+    # Human-readable table first (the reference's oracle is a readable table in
+    # pod logs), then machine-readable JSON lines.
+    print(f"K3S-TPU probe | jax {jax.__version__} | {len(rows)} device(s)")
+    print(f"{'ID':>3} {'KIND':<16} {'PLATFORM':<9} {'PROC':>4} COORDS")
+    for r in rows:
+        print(f"{r['id']:>3} {r['kind']:<16} {r['platform']:<9} {r['process']:>4} {r['coords']}")
+    print("DEVICES_JSON " + json.dumps(rows))
+
+    ok = any(r["platform"] not in ("cpu",) for r in rows)
+    if not ok:
+        print("WARNING: no accelerator devices visible (cpu-only backend)")
+
+    if not args.skip_bench:
+        from k3stpu.ops.matmul import measure_matmul
+
+        m = args.m if ok else min(args.m, 512)
+        res = measure_matmul(m=m, n=m, k=m, iters=args.iters)
+        print(
+            f"matmul {res.m}x{res.k}x{res.n} {res.dtype}: "
+            f"{res.tflops:.1f} TFLOP/s"
+            + (f" ({res.mfu * 100:.1f}% MFU)" if res.mfu is not None else "")
+        )
+        print("BENCH_JSON " + json.dumps(res.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

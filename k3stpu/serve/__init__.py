@@ -1,0 +1,6 @@
+"""JAX inference serving — the workload replacing the reference's Jellyfin
+demo (reference jellyfin.yaml:1-43: a long-running Deployment holding one
+GPU behind a ClusterIP Service). Here: a batched JAX model server holding one
+TPU chip behind a Service (BASELINE.json config 4)."""
+
+from k3stpu.serve.server import InferenceServer, make_app  # noqa: F401

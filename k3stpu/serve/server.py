@@ -1,0 +1,203 @@
+"""Batched JAX inference server (ResNet-50 or transformer LM) on one chip.
+
+Parity with the reference's real workload (reference jellyfin.yaml:1-43):
+long-running Deployment, one accelerator, ClusterIP Service in front. TPU-
+first serving choices:
+
+- requests are padded to a fixed set of batch sizes (1, 8, 32) so every
+  request hits a pre-compiled XLA program — no recompiles in steady state
+  (batch=32 is BASELINE.json config 4's shape);
+- the model runs in bf16 with fp32 logits; weights initialize once at boot
+  (the reference's Jellyfin similarly carries its state in-image — no volume,
+  jellyfin.yaml:24-29);
+- stdlib http.server (threaded) keeps the image dependency-free; the JAX
+  dispatch itself is serialized by a lock, matching one-chip ownership.
+
+Endpoints:
+  GET  /healthz         -> {"ok": true, "devices": [...]}   (readiness)
+  GET  /v1/models       -> model card
+  POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
+
+Run: python -m k3stpu.serve.server --model resnet50 --port 8096
+(8096 mirrors the reference Service port, jellyfin.yaml:40-42.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+BATCH_SIZES = (1, 8, 32)
+
+
+class InferenceServer:
+    """Owns the model, its weights, and the jitted per-batch-size programs."""
+
+    def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
+                 image_size: int = 224, seq_len: int = 128):
+        import jax
+
+        self.model_name = model_name
+        self.image_size = image_size
+        self.seq_len = seq_len
+        self._lock = threading.Lock()
+        self._stats = {"requests": 0, "examples": 0, "seconds": 0.0}
+
+        if model_name == "resnet50":
+            from k3stpu.models.resnet import resnet50
+
+            self.model = resnet50(num_classes=num_classes)
+            example = np.zeros((1, image_size, image_size, 3), np.float32)
+        elif model_name == "transformer":
+            from k3stpu.models.transformer import transformer_lm_small
+
+            self.model = transformer_lm_small(max_seq_len=seq_len)
+            example = np.zeros((1, seq_len), np.int32)
+        elif model_name == "transformer-tiny":  # tests / CPU smoke
+            from k3stpu.models.transformer import transformer_lm_tiny
+
+            self.model = transformer_lm_tiny(max_seq_len=seq_len)
+            example = np.zeros((1, seq_len), np.int32)
+        elif model_name == "resnet18-tiny":  # tests / CPU smoke
+            from k3stpu.models.resnet import resnet18
+
+            self.model = resnet18(num_classes=num_classes)
+            example = np.zeros((1, image_size, image_size, 3), np.float32)
+        else:
+            raise ValueError(f"unknown model {model_name!r}")
+
+        self._variables = self.model.init(jax.random.key(0), example[:1],
+                                          train=False)
+        self._forward = jax.jit(
+            lambda x: self.model.apply(self._variables, x, train=False))
+
+    def warmup(self, batch_sizes=BATCH_SIZES):
+        """Pre-compile every served batch size so first requests are fast."""
+        for b in batch_sizes:
+            self.predict(np.zeros((b, *self.input_shape()), self.input_dtype()))
+
+    def input_shape(self):
+        if self.model_name.startswith("resnet"):
+            return (self.image_size, self.image_size, 3)
+        return (self.seq_len,)
+
+    def input_dtype(self):
+        return np.float32 if self.model_name.startswith("resnet") else np.int32
+
+    def predict(self, inputs: np.ndarray) -> np.ndarray:
+        """Pads to the next served batch size, runs the jitted program, and
+        slices the padding back off."""
+        import jax
+
+        n = inputs.shape[0]
+        padded = next((b for b in BATCH_SIZES if b >= n), None)
+        if padded is None:
+            raise ValueError(
+                f"batch {n} exceeds max served batch {BATCH_SIZES[-1]}")
+        if padded != n:
+            pad = np.zeros((padded - n, *inputs.shape[1:]), inputs.dtype)
+            inputs = np.concatenate([inputs, pad], axis=0)
+
+        t0 = time.perf_counter()
+        with self._lock:  # one chip, one queue
+            out = np.asarray(jax.block_until_ready(self._forward(inputs)))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["examples"] += n
+            self._stats["seconds"] += dt
+        return out[:n]
+
+    def model_card(self) -> dict:
+        import jax
+
+        return {
+            "model": self.model_name,
+            "input_shape": list(self.input_shape()),
+            "input_dtype": np.dtype(self.input_dtype()).name,
+            "batch_sizes": list(BATCH_SIZES),
+            "devices": [str(d) for d in jax.devices()],
+            "stats": dict(self._stats),
+        }
+
+
+def make_app(server: InferenceServer):
+    """Returns the BaseHTTPRequestHandler class bound to `server`."""
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, code: int, payload: dict):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet; stats live in /v1/models
+            pass
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                import jax
+
+                self._send(200, {"ok": True,
+                                 "devices": [str(d) for d in jax.devices()]})
+            elif self.path == "/v1/models":
+                self._send(200, server.model_card())
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def do_POST(self):
+            if self.path != "/v1/predict":
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length))
+                inputs = np.asarray(req["inputs"], dtype=server.input_dtype())
+                if inputs.shape[1:] != server.input_shape():
+                    raise ValueError(
+                        f"expected input shape {server.input_shape()}, "
+                        f"got {inputs.shape[1:]}")
+                logits = server.predict(inputs)
+                top = np.argsort(-logits[..., -1, :] if logits.ndim == 3
+                                 else -logits, axis=-1)[:, :5]
+                self._send(200, {
+                    "top5": top.tolist(),
+                    "logits_shape": list(logits.shape),
+                })
+            except (KeyError, ValueError, json.JSONDecodeError) as e:
+                self._send(400, {"error": str(e)})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="K3S-TPU inference server")
+    ap.add_argument("--model", default="resnet50",
+                    choices=["resnet50", "resnet18-tiny", "transformer",
+                             "transformer-tiny"])
+    ap.add_argument("--port", type=int, default=8096)  # jellyfin.yaml:40-42
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--no-warmup", action="store_true")
+    args = ap.parse_args(argv)
+
+    server = InferenceServer(model_name=args.model,
+                             image_size=args.image_size, seq_len=args.seq_len)
+    if not args.no_warmup:
+        print("warming up (pre-compiling batch sizes)...", flush=True)
+        server.warmup()
+    httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_app(server))
+    print(f"serving {args.model} on :{args.port}", flush=True)
+    httpd.serve_forever()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,4 @@
+"""Utilities: TPU chip enumeration from sysfs/devfs, tpu-info CLI, peak-FLOPs
+tables. The sysfs scan here is the Python mirror of the enumeration logic in
+``native/tpu-device-plugin`` (both honor ``K3STPU_HOST_ROOT`` so tests can point
+them at a fake sysfs tree — SURVEY.md §4 "fake sysfs/PCI tree")."""

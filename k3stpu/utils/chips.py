@@ -1,0 +1,167 @@
+"""TPU chip enumeration from the host's sysfs/devfs.
+
+TPU-native analogue of what NVML-based enumeration does for the reference's
+device plugin (reference values.yaml:6-18 drives a plugin that enumerates GPUs
+via NVML; see SURVEY.md §2b #9). On a Cloud TPU VM there is no NVML: chips
+appear as
+
+- PCI functions with Google's vendor id 0x1ae0 under ``/sys/bus/pci/devices``,
+- accelerator device nodes ``/dev/accel{N}`` (newer gen: ``/dev/vfio/{N}``
+  with the PCI device bound to vfio-pci).
+
+Everything takes an optional ``root`` so tests (and the C++ plugin's tests) can
+run against a fabricated tree — SURVEY.md §4's "fake sysfs/PCI tree" strategy.
+The fake-root env var is ``K3STPU_HOST_ROOT``.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from dataclasses import dataclass, field
+
+GOOGLE_PCI_VENDOR_ID = "0x1ae0"
+HOST_ROOT_ENV = "K3STPU_HOST_ROOT"
+
+# Google TPU PCI device ids -> (generation name, chips per PCI function).
+# Unknown ids still enumerate; they just report generation "tpu-unknown".
+PCI_DEVICE_IDS = {
+    "0x0027": "tpu-v2/v3",
+    "0x005e": "tpu-v4",
+    "0x0062": "tpu-v5e",
+    "0x0063": "tpu-v5p",
+    "0x006f": "tpu-v6e",
+}
+
+
+@dataclass(frozen=True)
+class TpuChip:
+    """One physical TPU chip as seen from the host OS."""
+
+    index: int                     # stable enumeration index (sorted PCI BDF)
+    pci_address: str               # e.g. "0000:00:05.0"
+    vendor_id: str                 # "0x1ae0"
+    device_id: str                 # e.g. "0x0062"
+    generation: str                # e.g. "tpu-v5e"
+    numa_node: int                 # -1 if unknown
+    dev_paths: tuple[str, ...]     # device nodes to inject, e.g. ("/dev/accel0",)
+
+
+@dataclass
+class TpuInventory:
+    chips: list[TpuChip] = field(default_factory=list)
+
+    @property
+    def count(self) -> int:
+        return len(self.chips)
+
+    @property
+    def generation(self) -> str:
+        return self.chips[0].generation if self.chips else "none"
+
+    def topology(self) -> str:
+        """Best-effort ICI topology string for the local slice, following the
+        v5e host layouts (1 chip -> 1x1, 4 -> 2x2, 8 -> 2x4)."""
+        n = self.count
+        return {0: "0", 1: "1x1", 2: "1x2", 4: "2x2", 8: "2x4", 16: "4x4"}.get(
+            n, f"1x{n}"
+        )
+
+
+def host_root(root: str | None = None) -> str:
+    return root if root is not None else os.environ.get(HOST_ROOT_ENV, "/")
+
+
+def _read(path: str) -> str | None:
+    try:
+        with open(path, "r", encoding="utf-8", errors="replace") as f:
+            return f.read().strip()
+    except OSError:
+        return None
+
+
+def enumerate_chips(root: str | None = None) -> TpuInventory:
+    """Scan ``{root}/sys/bus/pci/devices`` for Google TPU functions and match
+    them to ``/dev/accel*`` / ``/dev/vfio/*`` nodes."""
+    root = host_root(root)
+    pci_dir = os.path.join(root, "sys", "bus", "pci", "devices")
+    inv = TpuInventory()
+    try:
+        bdfs = sorted(os.listdir(pci_dir))
+    except OSError:
+        return inv
+
+    tpu_bdfs = []
+    for bdf in bdfs:
+        vendor = _read(os.path.join(pci_dir, bdf, "vendor"))
+        if vendor and vendor.lower() == GOOGLE_PCI_VENDOR_ID:
+            tpu_bdfs.append(bdf)
+
+    accel_nodes = _accel_nodes(root)
+    vfio_nodes = _vfio_nodes(root)
+
+    for idx, bdf in enumerate(tpu_bdfs):
+        dev_dir = os.path.join(pci_dir, bdf)
+        device_id = (_read(os.path.join(dev_dir, "device")) or "").lower()
+        numa = _read(os.path.join(dev_dir, "numa_node"))
+        # Chips consume accel nodes first (in index order); any remaining
+        # chips map onto the vfio groups starting from vfio[0].
+        devs: tuple[str, ...]
+        if idx < len(accel_nodes):
+            devs = (accel_nodes[idx],)
+        elif idx - len(accel_nodes) < len(vfio_nodes):
+            devs = (vfio_nodes[idx - len(accel_nodes)], "/dev/vfio/vfio")
+        else:
+            devs = ()
+        inv.chips.append(
+            TpuChip(
+                index=idx,
+                pci_address=bdf,
+                vendor_id=GOOGLE_PCI_VENDOR_ID,
+                device_id=device_id,
+                generation=PCI_DEVICE_IDS.get(device_id, "tpu-unknown"),
+                numa_node=int(numa) if numa and numa.lstrip("-").isdigit() else -1,
+                dev_paths=devs,
+            )
+        )
+    return inv
+
+
+def _accel_nodes(root: str) -> list[str]:
+    """Container-side paths of /dev/accel* nodes present under root."""
+    dev_dir = os.path.join(root, "dev")
+    try:
+        names = os.listdir(dev_dir)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if re.fullmatch(r"accel\d+", name):
+            out.append("/dev/" + name)
+    return sorted(out, key=lambda p: int(p.rsplit("accel", 1)[1]))
+
+
+def _vfio_nodes(root: str) -> list[str]:
+    vfio_dir = os.path.join(root, "dev", "vfio")
+    try:
+        names = os.listdir(vfio_dir)
+    except OSError:
+        return []
+    out = [f"/dev/vfio/{n}" for n in names if n.isdigit()]
+    return sorted(out, key=lambda p: int(p.rsplit("/", 1)[1]))
+
+
+def libtpu_path(root: str | None = None) -> str | None:
+    """Locate libtpu.so on the host, as the runtime shim does natively."""
+    root = host_root(root)
+    candidates = [
+        "usr/lib/libtpu.so",
+        "usr/local/lib/libtpu.so",
+        "lib/libtpu.so",
+        "usr/lib/x86_64-linux-gnu/libtpu.so",
+    ]
+    for rel in candidates:
+        p = os.path.join(root, rel)
+        if os.path.exists(p):
+            return "/" + rel
+    return None

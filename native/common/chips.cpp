@@ -1,0 +1,143 @@
+#include "chips.hpp"
+
+#include <algorithm>
+#include <cstdlib>
+#include <fstream>
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+namespace k3stpu {
+
+namespace {
+
+std::string read_trimmed(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) return "";
+  std::string s;
+  std::getline(f, s);
+  while (!s.empty() && (s.back() == '\n' || s.back() == '\r' || s.back() == ' '))
+    s.pop_back();
+  return s;
+}
+
+std::vector<std::string> list_dir(const std::string& path) {
+  std::vector<std::string> names;
+  DIR* d = opendir(path.c_str());
+  if (!d) return names;
+  while (dirent* e = readdir(d)) {
+    std::string n = e->d_name;
+    if (n != "." && n != "..") names.push_back(n);
+  }
+  closedir(d);
+  std::sort(names.begin(), names.end());
+  return names;
+}
+
+bool exists(const std::string& path) {
+  struct stat st{};
+  return ::stat(path.c_str(), &st) == 0;
+}
+
+std::string lower(std::string s) {
+  std::transform(s.begin(), s.end(), s.begin(),
+                 [](unsigned char c) { return std::tolower(c); });
+  return s;
+}
+
+std::string generation_for(const std::string& device_id) {
+  if (device_id == "0x0027") return "tpu-v2/v3";
+  if (device_id == "0x005e") return "tpu-v4";
+  if (device_id == "0x0062") return "tpu-v5e";
+  if (device_id == "0x0063") return "tpu-v5p";
+  if (device_id == "0x006f") return "tpu-v6e";
+  return "tpu-unknown";
+}
+
+}  // namespace
+
+std::string host_root(const std::string& override_root) {
+  if (!override_root.empty()) return override_root;
+  const char* env = std::getenv(kHostRootEnv);
+  return env && *env ? env : "/";
+}
+
+std::vector<TpuChip> enumerate_chips(const std::string& root_in) {
+  std::string root = host_root(root_in);
+  if (root.back() == '/') root.pop_back();
+  std::vector<TpuChip> chips;
+
+  // accel nodes sorted numerically: accel0, accel1, ... accel10.
+  std::vector<std::string> accel;
+  for (const auto& name : list_dir(root + "/dev")) {
+    if (name.rfind("accel", 0) == 0 &&
+        name.find_first_not_of("0123456789", 5) == std::string::npos &&
+        name.size() > 5)
+      accel.push_back(name);
+  }
+  std::sort(accel.begin(), accel.end(), [](const auto& a, const auto& b) {
+    return std::stoi(a.substr(5)) < std::stoi(b.substr(5));
+  });
+
+  std::vector<std::string> vfio;
+  for (const auto& name : list_dir(root + "/dev/vfio")) {
+    if (!name.empty() &&
+        name.find_first_not_of("0123456789") == std::string::npos)
+      vfio.push_back(name);
+  }
+  std::sort(vfio.begin(), vfio.end(), [](const auto& a, const auto& b) {
+    return std::stoi(a) < std::stoi(b);
+  });
+
+  int idx = 0;
+  const std::string pci_dir = root + "/sys/bus/pci/devices";
+  for (const auto& bdf : list_dir(pci_dir)) {
+    const std::string dev_dir = pci_dir + "/" + bdf;
+    if (lower(read_trimmed(dev_dir + "/vendor")) != kGoogleVendorId) continue;
+
+    TpuChip chip;
+    chip.index = idx;
+    chip.pci_address = bdf;
+    chip.device_id = lower(read_trimmed(dev_dir + "/device"));
+    chip.generation = generation_for(chip.device_id);
+    const std::string numa = read_trimmed(dev_dir + "/numa_node");
+    chip.numa_node = numa.empty() ? -1 : std::atoi(numa.c_str());
+
+    // Chips consume accel nodes first (in index order); any remaining chips
+    // map onto the vfio groups starting from vfio[0].
+    if (static_cast<size_t>(idx) < accel.size()) {
+      chip.dev_paths = {"/dev/" + accel[idx]};
+    } else if (static_cast<size_t>(idx) - accel.size() < vfio.size()) {
+      chip.dev_paths = {"/dev/vfio/" + vfio[idx - accel.size()],
+                        "/dev/vfio/vfio"};
+    }
+    chips.push_back(std::move(chip));
+    ++idx;
+  }
+  return chips;
+}
+
+std::string find_libtpu(const std::string& root_in) {
+  std::string root = host_root(root_in);
+  if (root.back() == '/') root.pop_back();
+  for (const char* rel :
+       {"/usr/lib/libtpu.so", "/usr/local/lib/libtpu.so", "/lib/libtpu.so",
+        "/usr/lib/x86_64-linux-gnu/libtpu.so"}) {
+    if (exists(root + rel)) return rel;
+  }
+  return "";
+}
+
+std::string topology_for(size_t n) {
+  switch (n) {
+    case 0: return "0";
+    case 1: return "1x1";
+    case 2: return "1x2";
+    case 4: return "2x2";
+    case 8: return "2x4";
+    case 16: return "4x4";
+    default: return "1x" + std::to_string(n);
+  }
+}
+
+}  // namespace k3stpu

@@ -1,0 +1,42 @@
+// TPU chip discovery from sysfs/devfs — C++ twin of k3stpu/utils/chips.py.
+//
+// The reference's device plugin enumerates GPUs through NVML (SURVEY.md §2b
+// #9); on a TPU host the equivalent ground truth is PCI functions with
+// Google's vendor id 0x1ae0 plus /dev/accel* (or vfio) device nodes. Both the
+// OCI runtime shim and the device plugin link this. All lookups honor a root
+// override (K3STPU_HOST_ROOT) so tests run against a fake tree (SURVEY.md §4).
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+namespace k3stpu {
+
+struct TpuChip {
+  int index = 0;                       // stable order: sorted PCI BDF
+  std::string pci_address;             // "0000:00:05.0"
+  std::string device_id;               // "0x0062"
+  std::string generation;              // "tpu-v5e" | "tpu-unknown" | ...
+  int numa_node = -1;
+  std::vector<std::string> dev_paths;  // e.g. {"/dev/accel0"}
+};
+
+inline constexpr const char* kGoogleVendorId = "0x1ae0";
+inline constexpr const char* kHostRootEnv = "K3STPU_HOST_ROOT";
+
+// Root directory of the host filesystem ("/" unless K3STPU_HOST_ROOT is set
+// or an explicit override is given).
+std::string host_root(const std::string& override_root = "");
+
+// Scans {root}/sys/bus/pci/devices for Google TPU functions and matches them
+// to device nodes. Returns chips ordered by PCI address.
+std::vector<TpuChip> enumerate_chips(const std::string& root = "");
+
+// Host path of libtpu.so under root, or "" when absent.
+std::string find_libtpu(const std::string& root = "");
+
+// "1x1", "2x2", "2x4" ... best-effort local ICI topology for n chips.
+std::string topology_for(size_t n_chips);
+
+}  // namespace k3stpu

@@ -1,0 +1,683 @@
+#include "grpc_transport.hpp"
+
+#include <cstring>
+#include <iostream>
+
+#include <poll.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+namespace k3stpu::h2 {
+
+namespace {
+
+constexpr char kPreface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kPrefaceLen = 24;
+
+enum FrameType : uint8_t {
+  kData = 0x0,
+  kHeaders = 0x1,
+  kPriority = 0x2,
+  kRstStream = 0x3,
+  kSettings = 0x4,
+  kPing = 0x6,
+  kGoaway = 0x7,
+  kWindowUpdate = 0x8,
+  kContinuation = 0x9,
+};
+
+enum Flags : uint8_t {
+  kFlagEndStream = 0x1,
+  kFlagAck = 0x1,
+  kFlagEndHeaders = 0x4,
+  kFlagPadded = 0x8,
+  kFlagPriority = 0x20,
+};
+
+constexpr int64_t kDefaultWindow = 65535;
+
+struct Frame {
+  uint8_t type = 0;
+  uint8_t flags = 0;
+  uint32_t stream_id = 0;
+  std::string payload;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_all(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_frame(int fd, Frame& f, size_t max_len = 1 << 24) {
+  uint8_t hdr[9];
+  if (!read_exact(fd, hdr, 9)) return false;
+  size_t len = (static_cast<size_t>(hdr[0]) << 16) |
+               (static_cast<size_t>(hdr[1]) << 8) | hdr[2];
+  if (len > max_len) return false;
+  f.type = hdr[3];
+  f.flags = hdr[4];
+  f.stream_id = ((static_cast<uint32_t>(hdr[5]) & 0x7F) << 24) |
+                (static_cast<uint32_t>(hdr[6]) << 16) |
+                (static_cast<uint32_t>(hdr[7]) << 8) | hdr[8];
+  f.payload.resize(len);
+  return len == 0 || read_exact(fd, f.payload.data(), len);
+}
+
+std::string frame_bytes(uint8_t type, uint8_t flags, uint32_t stream_id,
+                        const std::string& payload) {
+  std::string out;
+  out.reserve(9 + payload.size());
+  size_t len = payload.size();
+  out.push_back(static_cast<char>((len >> 16) & 0xFF));
+  out.push_back(static_cast<char>((len >> 8) & 0xFF));
+  out.push_back(static_cast<char>(len & 0xFF));
+  out.push_back(static_cast<char>(type));
+  out.push_back(static_cast<char>(flags));
+  out.push_back(static_cast<char>((stream_id >> 24) & 0x7F));
+  out.push_back(static_cast<char>((stream_id >> 16) & 0xFF));
+  out.push_back(static_cast<char>((stream_id >> 8) & 0xFF));
+  out.push_back(static_cast<char>(stream_id & 0xFF));
+  out += payload;
+  return out;
+}
+
+// Strips padding/priority from a HEADERS payload to the header block.
+bool header_block_of(const Frame& f, std::string& block) {
+  size_t off = 0;
+  size_t pad = 0;
+  if (f.flags & kFlagPadded) {
+    if (f.payload.empty()) return false;
+    pad = static_cast<uint8_t>(f.payload[0]);
+    off += 1;
+  }
+  if (f.flags & kFlagPriority) off += 5;
+  if (off + pad > f.payload.size()) return false;
+  block.assign(f.payload, off, f.payload.size() - off - pad);
+  return true;
+}
+
+std::string be32(uint32_t v) {
+  std::string s(4, '\0');
+  s[0] = static_cast<char>((v >> 24) & 0xFF);
+  s[1] = static_cast<char>((v >> 16) & 0xFF);
+  s[2] = static_cast<char>((v >> 8) & 0xFF);
+  s[3] = static_cast<char>(v & 0xFF);
+  return s;
+}
+
+// gRPC message framing: flag byte + 4-byte big-endian length.
+std::string grpc_frame(const std::string& msg) {
+  std::string out;
+  out.push_back('\0');
+  out += be32(static_cast<uint32_t>(msg.size()));
+  out += msg;
+  return out;
+}
+
+// Incrementally extracts complete gRPC messages from a stream buffer.
+bool pop_grpc_message(std::string& buf, std::string& msg) {
+  if (buf.size() < 5) return false;
+  uint32_t len = (static_cast<uint32_t>(static_cast<uint8_t>(buf[1])) << 24) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(buf[2])) << 16) |
+                 (static_cast<uint32_t>(static_cast<uint8_t>(buf[3])) << 8) |
+                 static_cast<uint32_t>(static_cast<uint8_t>(buf[4]));
+  if (buf.size() < 5 + len) return false;
+  msg = buf.substr(5, len);
+  buf.erase(0, 5 + len);
+  return true;
+}
+
+int connect_unix(const std::string& path) {
+  int fd = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  std::strncpy(addr.sun_path, path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+// Shared per-connection state for flow-controlled, mutex-serialized writes.
+struct ConnWriter {
+  explicit ConnWriter(int fd) : fd(fd) {}
+  int fd;
+  std::mutex mu;
+  std::condition_variable cv;
+  int64_t conn_window = kDefaultWindow;
+  std::map<uint32_t, int64_t> stream_window;
+  int32_t initial_window = kDefaultWindow;
+  bool dead = false;
+
+  bool raw_write(const std::string& bytes) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (dead) return false;
+    if (!write_all(fd, bytes.data(), bytes.size())) {
+      dead = true;
+      return false;
+    }
+    return true;
+  }
+
+  // DATA write with flow control; splits to the window when needed.
+  bool write_data(uint32_t stream_id, const std::string& payload,
+                  bool end_stream) {
+    size_t off = 0;
+    while (off < payload.size()) {
+      std::unique_lock<std::mutex> lock(mu);
+      cv.wait(lock, [&] {
+        auto it = stream_window.find(stream_id);
+        return dead || it == stream_window.end() ||
+               (conn_window > 0 && it->second > 0);
+      });
+      auto it = stream_window.find(stream_id);
+      if (dead || it == stream_window.end()) return false;  // peer gone
+      size_t quota = static_cast<size_t>(std::min(conn_window, it->second));
+      size_t n = std::min(payload.size() - off, quota);
+      conn_window -= static_cast<int64_t>(n);
+      it->second -= static_cast<int64_t>(n);
+      bool last = (off + n) == payload.size();
+      std::string fr =
+          frame_bytes(kData, last && end_stream ? kFlagEndStream : 0,
+                      stream_id, payload.substr(off, n));
+      if (!write_all(fd, fr.data(), fr.size())) {
+        dead = true;
+        return false;
+      }
+      off += n;
+    }
+    return true;
+  }
+
+  void on_window_update(uint32_t stream_id, uint32_t increment) {
+    std::lock_guard<std::mutex> lock(mu);
+    if (stream_id == 0)
+      conn_window += increment;
+    else if (stream_window.count(stream_id))
+      stream_window[stream_id] += increment;
+    cv.notify_all();
+  }
+
+  void open_stream(uint32_t stream_id) {
+    std::lock_guard<std::mutex> lock(mu);
+    stream_window[stream_id] = initial_window;
+  }
+
+  void close_stream(uint32_t stream_id) {
+    std::lock_guard<std::mutex> lock(mu);
+    stream_window.erase(stream_id);
+    cv.notify_all();
+  }
+
+  void apply_initial_window(int32_t new_size) {
+    std::lock_guard<std::mutex> lock(mu);
+    int64_t delta = static_cast<int64_t>(new_size) - initial_window;
+    initial_window = new_size;
+    for (auto& [_, w] : stream_window) w += delta;
+    cv.notify_all();
+  }
+
+  void kill() {
+    std::lock_guard<std::mutex> lock(mu);
+    dead = true;
+    cv.notify_all();
+  }
+
+  bool stream_alive(uint32_t stream_id) {
+    std::lock_guard<std::mutex> lock(mu);
+    return !dead && stream_window.count(stream_id) > 0;
+  }
+};
+
+std::string settings_payload_empty() { return std::string(); }
+
+void parse_settings(const Frame& f, ConnWriter& writer) {
+  for (size_t off = 0; off + 6 <= f.payload.size(); off += 6) {
+    uint16_t id = (static_cast<uint16_t>(static_cast<uint8_t>(f.payload[off]))
+                   << 8) |
+                  static_cast<uint8_t>(f.payload[off + 1]);
+    uint32_t value =
+        (static_cast<uint32_t>(static_cast<uint8_t>(f.payload[off + 2])) << 24) |
+        (static_cast<uint32_t>(static_cast<uint8_t>(f.payload[off + 3])) << 16) |
+        (static_cast<uint32_t>(static_cast<uint8_t>(f.payload[off + 4])) << 8) |
+        static_cast<uint8_t>(f.payload[off + 5]);
+    if (id == 0x4)  // SETTINGS_INITIAL_WINDOW_SIZE
+      writer.apply_initial_window(static_cast<int32_t>(value));
+  }
+}
+
+struct StreamState {
+  Headers headers;
+  std::string header_block;
+  bool headers_done = false;
+  std::string body;       // raw DATA bytes (gRPC-framed)
+  bool end_stream = false;
+  bool responded = false;
+};
+
+std::string path_of(const Headers& headers) {
+  for (const auto& [n, v] : headers)
+    if (n == ":path") return v;
+  return "";
+}
+
+Headers response_headers() {
+  return {{":status", "200"}, {"content-type", "application/grpc"}};
+}
+
+Headers trailers(int status, const std::string& message) {
+  Headers t = {{"grpc-status", std::to_string(status)}};
+  if (!message.empty()) t.emplace_back("grpc-message", message);
+  return t;
+}
+
+}  // namespace
+
+GrpcServer::~GrpcServer() { stop(); }
+
+void GrpcServer::add_unary(const std::string& path, UnaryHandler handler) {
+  unary_[path] = std::move(handler);
+}
+
+void GrpcServer::add_server_stream(const std::string& path,
+                                   StreamHandler handler) {
+  streams_[path] = std::move(handler);
+}
+
+bool GrpcServer::start(const std::string& socket_path) {
+  socket_path_ = socket_path;
+  // Bind under a temp name and rename only after listen(): the socket file
+  // is how clients discover readiness, and a connect() in the bind->listen
+  // window would get ECONNREFUSED.
+  const std::string tmp_path = socket_path + ".tmp";
+  ::unlink(socket_path.c_str());
+  ::unlink(tmp_path.c_str());
+  listen_fd_ = ::socket(AF_UNIX, SOCK_STREAM, 0);
+  if (listen_fd_ < 0) return false;
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  if (tmp_path.size() >= sizeof(addr.sun_path)) {
+    ::close(listen_fd_);
+    listen_fd_ = -1;
+    return false;
+  }
+  std::strncpy(addr.sun_path, tmp_path.c_str(), sizeof(addr.sun_path) - 1);
+  if (::bind(listen_fd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(listen_fd_, 8) != 0 ||
+      std::rename(tmp_path.c_str(), socket_path.c_str()) != 0) {
+    ::close(listen_fd_);
+    ::unlink(tmp_path.c_str());
+    listen_fd_ = -1;
+    return false;
+  }
+  accept_thread_ = std::thread([this] { accept_loop(); });
+  return true;
+}
+
+void GrpcServer::stop() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (stopping_) return;
+    stopping_ = true;
+    // Unblock every connection reader so its thread can wind down; the
+    // long-lived kubelet ListAndWatch connection would otherwise pin
+    // stop() forever.
+    for (int fd : conn_fds_) ::shutdown(fd, SHUT_RDWR);
+  }
+  if (listen_fd_ >= 0) {
+    ::shutdown(listen_fd_, SHUT_RDWR);
+    ::close(listen_fd_);
+  }
+  if (accept_thread_.joinable()) accept_thread_.join();
+  {
+    // Connection threads are detached (a long-lived node would otherwise
+    // accumulate unjoined thread stacks per kubelet reconnect); wait for
+    // the counter they decrement on exit.
+    std::unique_lock<std::mutex> lock(mu_);
+    conn_cv_.wait(lock, [this] { return active_conns_ == 0; });
+  }
+  listen_fd_ = -1;
+  if (!socket_path_.empty()) ::unlink(socket_path_.c_str());
+}
+
+void GrpcServer::accept_loop() {
+  for (;;) {
+    int fd = ::accept(listen_fd_, nullptr, nullptr);
+    if (fd < 0) return;  // listener closed -> shutdown
+    std::lock_guard<std::mutex> lock(mu_);
+    if (stopping_) {
+      ::close(fd);
+      return;
+    }
+    conn_fds_.insert(fd);
+    ++active_conns_;
+    std::thread([this, fd] {
+      handle_connection(fd);
+      std::lock_guard<std::mutex> lock(mu_);
+      --active_conns_;
+      conn_cv_.notify_all();
+    }).detach();
+  }
+}
+
+void GrpcServer::handle_connection(int fd) {
+  char preface[kPrefaceLen];
+  if (!read_exact(fd, preface, kPrefaceLen) ||
+      std::memcmp(preface, kPreface, kPrefaceLen) != 0) {
+    ::close(fd);
+    return;
+  }
+  auto writer = std::make_shared<ConnWriter>(fd);
+  writer->raw_write(frame_bytes(kSettings, 0, 0, settings_payload_empty()));
+
+  HpackDecoder decoder;
+  std::map<uint32_t, StreamState> streams;
+  // RPC handlers run detached (kubelet issues one Allocate per pod admission
+  // on a connection that lives for weeks — unjoined thread stacks would
+  // accumulate); this counter lets teardown wait for in-flight handlers.
+  struct HandlerTracker {
+    std::mutex mu;
+    std::condition_variable cv;
+    int active = 0;
+  };
+  auto tracker = std::make_shared<HandlerTracker>();
+  auto spawn_handler = [tracker](std::function<void()> fn) {
+    {
+      std::lock_guard<std::mutex> lock(tracker->mu);
+      ++tracker->active;
+    }
+    std::thread([tracker, fn = std::move(fn)] {
+      fn();
+      std::lock_guard<std::mutex> lock(tracker->mu);
+      --tracker->active;
+      tracker->cv.notify_all();
+    }).detach();
+  };
+
+  Frame f;
+  while (read_frame(fd, f)) {
+    switch (f.type) {
+      case kSettings:
+        if (!(f.flags & kFlagAck)) {
+          parse_settings(f, *writer);
+          writer->raw_write(frame_bytes(kSettings, kFlagAck, 0, ""));
+        }
+        break;
+      case kPing:
+        if (!(f.flags & kFlagAck))
+          writer->raw_write(frame_bytes(kPing, kFlagAck, 0, f.payload));
+        break;
+      case kWindowUpdate:
+        if (f.payload.size() == 4) {
+          uint32_t inc =
+              ((static_cast<uint32_t>(static_cast<uint8_t>(f.payload[0])) & 0x7F)
+               << 24) |
+              (static_cast<uint32_t>(static_cast<uint8_t>(f.payload[1])) << 16) |
+              (static_cast<uint32_t>(static_cast<uint8_t>(f.payload[2])) << 8) |
+              static_cast<uint8_t>(f.payload[3]);
+          writer->on_window_update(f.stream_id, inc);
+        }
+        break;
+      case kHeaders:
+      case kContinuation: {
+        auto& st = streams[f.stream_id];
+        if (f.type == kHeaders) {
+          writer->open_stream(f.stream_id);
+          std::string block;
+          if (!header_block_of(f, block)) goto done;
+          st.header_block += block;
+          if (f.flags & kFlagEndStream) st.end_stream = true;
+        } else {
+          st.header_block += f.payload;
+        }
+        if (f.flags & kFlagEndHeaders) {
+          if (!decoder.decode(
+                  reinterpret_cast<const uint8_t*>(st.header_block.data()),
+                  st.header_block.size(), st.headers))
+            goto done;
+          st.header_block.clear();
+          st.headers_done = true;
+        }
+        break;
+      }
+      case kData: {
+        auto& st = streams[f.stream_id];
+        size_t off = 0, pad = 0;
+        if (f.flags & kFlagPadded) {
+          if (f.payload.empty()) goto done;
+          pad = static_cast<uint8_t>(f.payload[0]);
+          off = 1;
+        }
+        if (off + pad <= f.payload.size())
+          st.body.append(f.payload, off, f.payload.size() - off - pad);
+        if (f.flags & kFlagEndStream) st.end_stream = true;
+        // Replenish receive windows so long-lived connections never stall.
+        if (!f.payload.empty()) {
+          writer->raw_write(frame_bytes(
+              kWindowUpdate, 0, 0,
+              be32(static_cast<uint32_t>(f.payload.size()))));
+          writer->raw_write(frame_bytes(
+              kWindowUpdate, 0, f.stream_id,
+              be32(static_cast<uint32_t>(f.payload.size()))));
+        }
+        break;
+      }
+      case kRstStream:
+        writer->close_stream(f.stream_id);
+        streams.erase(f.stream_id);
+        break;
+      case kGoaway:
+        goto done;
+      default:
+        break;  // PRIORITY etc.: ignore
+    }
+
+    // Dispatch streams whose request is complete. State moves out of the map
+    // (long-lived connections would otherwise accumulate one StreamState per
+    // RPC forever), and all handlers run on their own thread so the reader
+    // loop keeps servicing WINDOW_UPDATE/PING — a unary response larger than
+    // the flow-control window must not deadlock against its own reader.
+    for (auto it = streams.begin(); it != streams.end();) {
+      if (!it->second.headers_done || !it->second.end_stream) {
+        ++it;
+        continue;
+      }
+      const uint32_t stream_id = it->first;
+      StreamState st = std::move(it->second);
+      it = streams.erase(it);
+
+      std::string msg;
+      pop_grpc_message(st.body, msg);
+      const std::string rpc = path_of(st.headers);
+
+      auto send_response_headers = [writer, stream_id] {
+        writer->raw_write(frame_bytes(kHeaders, kFlagEndHeaders, stream_id,
+                                      encode_headers(response_headers())));
+      };
+      auto send_trailers = [writer, stream_id](int status,
+                                               const std::string& message) {
+        writer->raw_write(frame_bytes(kHeaders,
+                                      kFlagEndHeaders | kFlagEndStream,
+                                      stream_id,
+                                      encode_headers(trailers(status, message))));
+        writer->close_stream(stream_id);
+      };
+
+      if (auto uit = unary_.find(rpc); uit != unary_.end()) {
+        UnaryHandler handler = uit->second;
+        spawn_handler([handler, msg, writer, stream_id,
+                       send_response_headers, send_trailers] {
+          try {
+            std::string resp = handler(msg);
+            send_response_headers();
+            writer->write_data(stream_id, grpc_frame(resp), false);
+            send_trailers(kOk, "");
+          } catch (const GrpcError& e) {
+            send_response_headers();
+            send_trailers(e.status, e.message);
+          } catch (const std::exception& e) {
+            send_response_headers();
+            send_trailers(kUnknown, e.what());
+          }
+        });
+      } else if (auto sit = streams_.find(rpc); sit != streams_.end()) {
+        StreamHandler handler = sit->second;
+        spawn_handler([handler, msg, writer, stream_id,
+                       send_response_headers, send_trailers] {
+          send_response_headers();
+          StreamCtx ctx;
+          ctx.write = [writer, stream_id](const std::string& m) {
+            return writer->write_data(stream_id, grpc_frame(m), false);
+          };
+          ctx.alive = [writer, stream_id] {
+            return writer->stream_alive(stream_id);
+          };
+          try {
+            handler(msg, ctx);
+            send_trailers(kOk, "");
+          } catch (const GrpcError& e) {
+            send_trailers(e.status, e.message);
+          } catch (const std::exception& e) {
+            send_trailers(kUnknown, e.what());
+          }
+        });
+      } else {
+        send_response_headers();
+        send_trailers(kUnimplemented, "unknown method " + rpc);
+      }
+    }
+  }
+done:
+  writer->kill();
+  ::shutdown(fd, SHUT_RDWR);
+  {
+    std::unique_lock<std::mutex> lock(tracker->mu);
+    tracker->cv.wait(lock, [&] { return tracker->active == 0; });
+  }
+  {
+    // Drop from the live set before close: fd numbers are reused, and a
+    // later stop() must not shutdown() whoever inherited this number.
+    std::lock_guard<std::mutex> lock(mu_);
+    conn_fds_.erase(fd);
+  }
+  ::close(fd);
+}
+
+UnaryResult grpc_unary_call(const std::string& socket_path,
+                            const std::string& rpc_path,
+                            const std::string& request, int timeout_ms) {
+  UnaryResult result;
+  int fd = connect_unix(socket_path);
+  if (fd < 0) return result;
+
+  timeval tv{};
+  tv.tv_sec = timeout_ms / 1000;
+  tv.tv_usec = (timeout_ms % 1000) * 1000;
+  ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  ::setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+
+  std::string out(kPreface, kPrefaceLen);
+  out += frame_bytes(kSettings, 0, 0, "");
+  Headers req_headers = {
+      {":method", "POST"},       {":scheme", "http"},
+      {":path", rpc_path},       {":authority", "localhost"},
+      {"content-type", "application/grpc"},
+      {"te", "trailers"},
+  };
+  out += frame_bytes(kHeaders, kFlagEndHeaders, 1, encode_headers(req_headers));
+  out += frame_bytes(kData, kFlagEndStream, 1, grpc_frame(request));
+  if (!write_all(fd, out.data(), out.size())) {
+    ::close(fd);
+    return result;
+  }
+
+  HpackDecoder decoder;
+  std::string body;
+  std::string header_block;
+  bool in_headers = false;
+  bool end_stream_seen = false;  // END_STREAM rides HEADERS, not CONTINUATION
+  Frame f;
+  while (read_frame(fd, f)) {
+    if (f.type == kSettings && !(f.flags & kFlagAck)) {
+      write_all(fd, frame_bytes(kSettings, kFlagAck, 0, "").data(), 9);
+    } else if (f.type == kPing && !(f.flags & kFlagAck)) {
+      auto pong = frame_bytes(kPing, kFlagAck, 0, f.payload);
+      write_all(fd, pong.data(), pong.size());
+    } else if (f.stream_id == 1 &&
+               (f.type == kHeaders || f.type == kContinuation)) {
+      if (f.type == kHeaders) {
+        std::string block;
+        if (!header_block_of(f, block)) break;
+        header_block += block;
+        if (f.flags & kFlagEndStream) end_stream_seen = true;
+      } else {
+        header_block += f.payload;
+      }
+      in_headers = true;
+      if (f.flags & kFlagEndHeaders) {
+        Headers hs;
+        if (!decoder.decode(
+                reinterpret_cast<const uint8_t*>(header_block.data()),
+                header_block.size(), hs))
+          break;
+        header_block.clear();
+        in_headers = false;
+        for (const auto& [n, v] : hs) {
+          if (n == "grpc-status") {
+            result.grpc_status = std::atoi(v.c_str());
+            result.transport_ok = true;
+          } else if (n == "grpc-message") {
+            result.message = v;
+          }
+        }
+        if (end_stream_seen) break;  // trailers received
+      }
+    } else if (f.stream_id == 1 && f.type == kData) {
+      size_t off = 0, pad = 0;
+      if (f.flags & kFlagPadded) {
+        pad = static_cast<uint8_t>(f.payload[0]);
+        off = 1;
+      }
+      if (off + pad <= f.payload.size())
+        body.append(f.payload, off, f.payload.size() - off - pad);
+      // Replenish flow-control windows or responses beyond 64KiB stall the
+      // sender (and this call) until the socket timeout.
+      if (!f.payload.empty()) {
+        auto inc = be32(static_cast<uint32_t>(f.payload.size()));
+        auto w0 = frame_bytes(kWindowUpdate, 0, 0, inc);
+        auto w1 = frame_bytes(kWindowUpdate, 0, 1, inc);
+        write_all(fd, w0.data(), w0.size());
+        write_all(fd, w1.data(), w1.size());
+      }
+    } else if (f.type == kGoaway || f.type == kRstStream) {
+      break;
+    }
+  }
+  ::close(fd);
+  (void)in_headers;
+  std::string msg;
+  if (pop_grpc_message(body, msg)) result.response = msg;
+  return result;
+}
+
+}  // namespace k3stpu::h2

@@ -1,0 +1,100 @@
+// Minimal gRPC-over-HTTP/2 transport on unix sockets.
+//
+// Purpose-built for the kubelet device-plugin protocol (SURVEY.md §3.2): a
+// server side for DevicePlugin (unary + server-streaming ListAndWatch — the
+// long-lived "hot loop" of the reference stack) and a client side for the
+// one-shot Registration call. No TLS (kubelet device-plugin sockets are
+// plaintext unix sockets), no compression, HPACK via the system libnghttp2.
+//
+// Threading: one reader thread per accepted connection; server-stream
+// handlers run on their own thread and write through a mutex, so Allocate
+// stays responsive while ListAndWatch blocks awaiting device-state changes.
+
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <functional>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "hpack.hpp"
+
+namespace k3stpu::h2 {
+
+// gRPC status codes we use.
+inline constexpr int kOk = 0;
+inline constexpr int kUnknown = 2;
+inline constexpr int kUnimplemented = 12;
+
+struct GrpcError {
+  int status;
+  std::string message;
+};
+
+// Handle a server-stream gives to its handler thread.
+struct StreamCtx {
+  // Writes one message; returns false once the peer is gone.
+  std::function<bool(const std::string& msg)> write;
+  // Cheap liveness probe so handlers blocked on their own conditions can
+  // poll for peer disconnect without emitting anything.
+  std::function<bool()> alive;
+};
+
+// Unary: request bytes in, response bytes out; throw GrpcError to fail.
+using UnaryHandler = std::function<std::string(const std::string& request)>;
+
+// Server-streaming: write() as many times as needed, return to close with OK.
+using StreamHandler =
+    std::function<void(const std::string& request, const StreamCtx& ctx)>;
+
+class GrpcServer {
+ public:
+  GrpcServer() = default;
+  ~GrpcServer();
+  GrpcServer(const GrpcServer&) = delete;
+  GrpcServer& operator=(const GrpcServer&) = delete;
+
+  void add_unary(const std::string& path, UnaryHandler handler);
+  void add_server_stream(const std::string& path, StreamHandler handler);
+
+  // Binds the unix socket (unlinking any stale file) and starts the accept
+  // loop on a background thread. Returns false when bind/listen fails.
+  bool start(const std::string& socket_path);
+  void stop();
+  bool running() const { return listen_fd_ >= 0; }
+
+ private:
+  void accept_loop();
+  void handle_connection(int fd);
+
+  std::string socket_path_;
+  int listen_fd_ = -1;
+  std::thread accept_thread_;
+  std::mutex mu_;
+  std::condition_variable conn_cv_;
+  int active_conns_ = 0;  // detached connection threads still running
+  std::map<std::string, UnaryHandler> unary_;
+  std::map<std::string, StreamHandler> streams_;
+  std::set<int> conn_fds_;  // live connections, shut down on stop()
+  bool stopping_ = false;
+};
+
+struct UnaryResult {
+  int grpc_status = kUnknown;
+  std::string message;   // grpc-message on failure
+  std::string response;  // decoded message bytes on success
+  bool transport_ok = false;
+};
+
+// One connection per call; ample for the single Register round-trip.
+UnaryResult grpc_unary_call(const std::string& socket_path,
+                            const std::string& rpc_path,
+                            const std::string& request, int timeout_ms = 5000);
+
+}  // namespace k3stpu::h2

@@ -1,0 +1,56 @@
+#include "hpack.hpp"
+
+namespace k3stpu::h2 {
+
+bool HpackDecoder::decode(const uint8_t* data, size_t len, Headers& out) {
+  size_t pos = 0;
+  for (;;) {
+    nghttp2_nv nv;
+    int flags = 0;
+    ssize_t consumed = nghttp2_hd_inflate_hd2(inflater_, &nv, &flags,
+                                              data + pos, len - pos,
+                                              /*in_final=*/1);
+    if (consumed < 0) return false;
+    pos += static_cast<size_t>(consumed);
+    if (flags & kInflateEmit) {
+      out.emplace_back(
+          std::string(reinterpret_cast<char*>(nv.name), nv.namelen),
+          std::string(reinterpret_cast<char*>(nv.value), nv.valuelen));
+    }
+    if (flags & kInflateFinal) {
+      nghttp2_hd_inflate_end_headers(inflater_);
+      return true;
+    }
+    if (consumed == 0 && !(flags & kInflateEmit)) return false;  // stuck
+  }
+}
+
+namespace {
+
+// HPACK integer with a 7-bit prefix (string length encoding, H bit clear).
+void put_len(std::string& out, size_t n) {
+  if (n < 0x7F) {
+    out.push_back(static_cast<char>(n));
+    return;
+  }
+  out.push_back(0x7F);
+  n -= 0x7F;
+  while (n >= 0x80) {
+    out.push_back(static_cast<char>((n & 0x7F) | 0x80));
+    n >>= 7;
+  }
+  out.push_back(static_cast<char>(n));
+}
+
+}  // namespace
+
+void encode_header(std::string& out, const std::string& name,
+                   const std::string& value) {
+  out.push_back(0x00);  // literal without indexing, new name
+  put_len(out, name.size());
+  out += name;
+  put_len(out, value.size());
+  out += value;
+}
+
+}  // namespace k3stpu::h2

@@ -1,0 +1,68 @@
+// HPACK (RFC 7541) decode via the system libnghttp2.so.14, encode by hand.
+//
+// Only the runtime .so is baked into this image (no dev headers), so the few
+// stable entry points we need are declared here directly. All nghttp2 types
+// involved are opaque pointers except nghttp2_nv, whose layout has been fixed
+// since nghttp2 1.0. Encoding always uses "literal header field without
+// indexing / new name" representations — spec-valid, stateless, and every
+// HTTP/2 peer must accept it, so no deflater state is needed.
+
+#pragma once
+
+#include <cstddef>
+#include <cstdint>
+#include <string>
+#include <utility>
+#include <vector>
+
+extern "C" {
+typedef struct nghttp2_hd_inflater nghttp2_hd_inflater;
+
+typedef struct {
+  uint8_t* name;
+  uint8_t* value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+} nghttp2_nv;
+
+int nghttp2_hd_inflate_new(nghttp2_hd_inflater** inflater_ptr);
+void nghttp2_hd_inflate_del(nghttp2_hd_inflater* inflater);
+ssize_t nghttp2_hd_inflate_hd2(nghttp2_hd_inflater* inflater,
+                               nghttp2_nv* nv_out, int* inflate_flags,
+                               const uint8_t* in, size_t inlen, int in_final);
+int nghttp2_hd_inflate_end_headers(nghttp2_hd_inflater* inflater);
+}
+
+namespace k3stpu::h2 {
+
+inline constexpr int kInflateFinal = 0x01;  // NGHTTP2_HD_INFLATE_FINAL
+inline constexpr int kInflateEmit = 0x02;   // NGHTTP2_HD_INFLATE_EMIT
+
+using Headers = std::vector<std::pair<std::string, std::string>>;
+
+class HpackDecoder {
+ public:
+  HpackDecoder() { nghttp2_hd_inflate_new(&inflater_); }
+  ~HpackDecoder() { nghttp2_hd_inflate_del(inflater_); }
+  HpackDecoder(const HpackDecoder&) = delete;
+  HpackDecoder& operator=(const HpackDecoder&) = delete;
+
+  // Decodes one complete header block; returns false on malformed input.
+  bool decode(const uint8_t* data, size_t len, Headers& out);
+
+ private:
+  nghttp2_hd_inflater* inflater_ = nullptr;
+};
+
+// Appends one header as a literal-without-indexing representation.
+void encode_header(std::string& out, const std::string& name,
+                   const std::string& value);
+
+inline std::string encode_headers(const Headers& headers) {
+  std::string out;
+  for (const auto& [n, v] : headers) encode_header(out, n, v);
+  return out;
+}
+
+}  // namespace k3stpu::h2

@@ -1,0 +1,395 @@
+#include "json.hpp"
+
+#include <cctype>
+#include <cmath>
+#include <cstdio>
+#include <cstring>
+
+namespace k3stpu::json {
+
+ValuePtr Value::make_null() { return std::make_shared<Value>(); }
+
+ValuePtr Value::make_bool(bool b) {
+  auto v = std::make_shared<Value>();
+  v->type = Type::Bool;
+  v->bool_v = b;
+  return v;
+}
+
+ValuePtr Value::make_int(int64_t i) {
+  auto v = std::make_shared<Value>();
+  v->type = Type::Int;
+  v->int_v = i;
+  return v;
+}
+
+ValuePtr Value::make_string(const std::string& s) {
+  auto v = std::make_shared<Value>();
+  v->type = Type::String;
+  v->str_v = s;
+  return v;
+}
+
+ValuePtr Value::make_array() {
+  auto v = std::make_shared<Value>();
+  v->type = Type::Array;
+  return v;
+}
+
+ValuePtr Value::make_object() {
+  auto v = std::make_shared<Value>();
+  v->type = Type::Object;
+  return v;
+}
+
+ValuePtr Value::get(const std::string& key) const {
+  if (type != Type::Object) return nullptr;
+  for (const auto& [k, v] : obj_v)
+    if (k == key) return v;
+  return nullptr;
+}
+
+ValuePtr Value::set(const std::string& key, ValuePtr v) {
+  for (auto& [k, existing] : obj_v) {
+    if (k == key) {
+      existing = v;
+      return v;
+    }
+  }
+  obj_v.emplace_back(key, v);
+  return v;
+}
+
+ValuePtr Value::ensure_object(const std::string& key) {
+  auto existing = get(key);
+  if (existing && existing->is_object()) return existing;
+  return set(key, make_object());
+}
+
+ValuePtr Value::ensure_array(const std::string& key) {
+  auto existing = get(key);
+  if (existing && existing->is_array()) return existing;
+  return set(key, make_array());
+}
+
+namespace {
+
+class Parser {
+ public:
+  explicit Parser(const std::string& text) : s_(text) {}
+
+  ValuePtr parse_document() {
+    auto v = parse_value();
+    skip_ws();
+    if (pos_ != s_.size()) fail("trailing characters after JSON document");
+    return v;
+  }
+
+ private:
+  const std::string& s_;
+  size_t pos_ = 0;
+
+  [[noreturn]] void fail(const std::string& msg) {
+    throw ParseError(msg + " at byte " + std::to_string(pos_));
+  }
+
+  void skip_ws() {
+    while (pos_ < s_.size() &&
+           (s_[pos_] == ' ' || s_[pos_] == '\t' || s_[pos_] == '\n' ||
+            s_[pos_] == '\r'))
+      ++pos_;
+  }
+
+  char peek() {
+    if (pos_ >= s_.size()) fail("unexpected end of input");
+    return s_[pos_];
+  }
+
+  void expect(char c) {
+    if (pos_ >= s_.size() || s_[pos_] != c)
+      fail(std::string("expected '") + c + "'");
+    ++pos_;
+  }
+
+  bool consume_lit(const char* lit) {
+    size_t n = std::strlen(lit);
+    if (s_.compare(pos_, n, lit) == 0) {
+      pos_ += n;
+      return true;
+    }
+    return false;
+  }
+
+  ValuePtr parse_value() {
+    skip_ws();
+    char c = peek();
+    switch (c) {
+      case '{':
+        return parse_object();
+      case '[':
+        return parse_array();
+      case '"':
+        return Value::make_string(parse_string());
+      case 't':
+        if (consume_lit("true")) return Value::make_bool(true);
+        fail("bad literal");
+      case 'f':
+        if (consume_lit("false")) return Value::make_bool(false);
+        fail("bad literal");
+      case 'n':
+        if (consume_lit("null")) return Value::make_null();
+        fail("bad literal");
+      default:
+        return parse_number();
+    }
+  }
+
+  ValuePtr parse_object() {
+    expect('{');
+    auto obj = Value::make_object();
+    skip_ws();
+    if (peek() == '}') {
+      ++pos_;
+      return obj;
+    }
+    while (true) {
+      skip_ws();
+      std::string key = parse_string();
+      skip_ws();
+      expect(':');
+      obj->obj_v.emplace_back(key, parse_value());
+      skip_ws();
+      if (peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      expect('}');
+      return obj;
+    }
+  }
+
+  ValuePtr parse_array() {
+    expect('[');
+    auto arr = Value::make_array();
+    skip_ws();
+    if (peek() == ']') {
+      ++pos_;
+      return arr;
+    }
+    while (true) {
+      arr->arr_v.push_back(parse_value());
+      skip_ws();
+      if (peek() == ',') {
+        ++pos_;
+        continue;
+      }
+      expect(']');
+      return arr;
+    }
+  }
+
+  std::string parse_string() {
+    expect('"');
+    std::string out;
+    while (true) {
+      if (pos_ >= s_.size()) fail("unterminated string");
+      char c = s_[pos_++];
+      if (c == '"') return out;
+      if (c != '\\') {
+        out.push_back(c);
+        continue;
+      }
+      if (pos_ >= s_.size()) fail("unterminated escape");
+      char e = s_[pos_++];
+      switch (e) {
+        case '"': out.push_back('"'); break;
+        case '\\': out.push_back('\\'); break;
+        case '/': out.push_back('/'); break;
+        case 'b': out.push_back('\b'); break;
+        case 'f': out.push_back('\f'); break;
+        case 'n': out.push_back('\n'); break;
+        case 'r': out.push_back('\r'); break;
+        case 't': out.push_back('\t'); break;
+        case 'u': {
+          if (pos_ + 4 > s_.size()) fail("short \\u escape");
+          unsigned cp = 0;
+          for (int i = 0; i < 4; ++i) {
+            char h = s_[pos_++];
+            cp <<= 4;
+            if (h >= '0' && h <= '9') cp |= h - '0';
+            else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+            else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+            else fail("bad hex digit in \\u escape");
+          }
+          // Surrogate pair -> one code point.
+          if (cp >= 0xD800 && cp <= 0xDBFF && pos_ + 6 <= s_.size() &&
+              s_[pos_] == '\\' && s_[pos_ + 1] == 'u') {
+            unsigned lo = 0;
+            bool ok = true;
+            for (int i = 0; i < 4; ++i) {
+              char h = s_[pos_ + 2 + i];
+              lo <<= 4;
+              if (h >= '0' && h <= '9') lo |= h - '0';
+              else if (h >= 'a' && h <= 'f') lo |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') lo |= h - 'A' + 10;
+              else { ok = false; break; }
+            }
+            if (ok && lo >= 0xDC00 && lo <= 0xDFFF) {
+              pos_ += 6;
+              cp = 0x10000 + ((cp - 0xD800) << 10) + (lo - 0xDC00);
+            }
+          }
+          // UTF-8 encode.
+          if (cp < 0x80) {
+            out.push_back(static_cast<char>(cp));
+          } else if (cp < 0x800) {
+            out.push_back(static_cast<char>(0xC0 | (cp >> 6)));
+            out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          } else if (cp < 0x10000) {
+            out.push_back(static_cast<char>(0xE0 | (cp >> 12)));
+            out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          } else {
+            out.push_back(static_cast<char>(0xF0 | (cp >> 18)));
+            out.push_back(static_cast<char>(0x80 | ((cp >> 12) & 0x3F)));
+            out.push_back(static_cast<char>(0x80 | ((cp >> 6) & 0x3F)));
+            out.push_back(static_cast<char>(0x80 | (cp & 0x3F)));
+          }
+          break;
+        }
+        default:
+          fail("bad escape character");
+      }
+    }
+  }
+
+  ValuePtr parse_number() {
+    size_t start = pos_;
+    if (peek() == '-') ++pos_;
+    while (pos_ < s_.size() && std::isdigit(static_cast<unsigned char>(s_[pos_])))
+      ++pos_;
+    bool is_double = false;
+    if (pos_ < s_.size() && s_[pos_] == '.') {
+      is_double = true;
+      ++pos_;
+      while (pos_ < s_.size() &&
+             std::isdigit(static_cast<unsigned char>(s_[pos_])))
+        ++pos_;
+    }
+    if (pos_ < s_.size() && (s_[pos_] == 'e' || s_[pos_] == 'E')) {
+      is_double = true;
+      ++pos_;
+      if (pos_ < s_.size() && (s_[pos_] == '+' || s_[pos_] == '-')) ++pos_;
+      while (pos_ < s_.size() &&
+             std::isdigit(static_cast<unsigned char>(s_[pos_])))
+        ++pos_;
+    }
+    if (pos_ == start || (pos_ == start + 1 && s_[start] == '-'))
+      fail("malformed number");
+    std::string tok = s_.substr(start, pos_ - start);
+    auto v = std::make_shared<Value>();
+    if (is_double) {
+      v->type = Type::Double;
+      v->dbl_v = std::stod(tok);
+    } else {
+      v->type = Type::Int;
+      try {
+        v->int_v = std::stoll(tok);
+      } catch (const std::out_of_range&) {
+        v->type = Type::Double;
+        v->dbl_v = std::stod(tok);
+      }
+    }
+    return v;
+  }
+};
+
+void escape_into(const std::string& s, std::string& out) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\b': out += "\\b"; break;
+      case '\f': out += "\\f"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof(buf), "\\u%04x", c);
+          out += buf;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+void dump_into(const ValuePtr& v, std::string& out, int indent, int depth) {
+  const std::string pad(static_cast<size_t>(indent) * depth, ' ');
+  const std::string pad_in(static_cast<size_t>(indent) * (depth + 1), ' ');
+  if (!v) {
+    out += "null";
+    return;
+  }
+  switch (v->type) {
+    case Type::Null: out += "null"; break;
+    case Type::Bool: out += v->bool_v ? "true" : "false"; break;
+    case Type::Int: out += std::to_string(v->int_v); break;
+    case Type::Double: {
+      char buf[32];
+      std::snprintf(buf, sizeof(buf), "%.17g", v->dbl_v);
+      out += buf;
+      break;
+    }
+    case Type::String: escape_into(v->str_v, out); break;
+    case Type::Array: {
+      if (v->arr_v.empty()) {
+        out += "[]";
+        break;
+      }
+      out += "[\n";
+      for (size_t i = 0; i < v->arr_v.size(); ++i) {
+        out += pad_in;
+        dump_into(v->arr_v[i], out, indent, depth + 1);
+        if (i + 1 < v->arr_v.size()) out += ",";
+        out += "\n";
+      }
+      out += pad + "]";
+      break;
+    }
+    case Type::Object: {
+      if (v->obj_v.empty()) {
+        out += "{}";
+        break;
+      }
+      out += "{\n";
+      for (size_t i = 0; i < v->obj_v.size(); ++i) {
+        out += pad_in;
+        escape_into(v->obj_v[i].first, out);
+        out += ": ";
+        dump_into(v->obj_v[i].second, out, indent, depth + 1);
+        if (i + 1 < v->obj_v.size()) out += ",";
+        out += "\n";
+      }
+      out += pad + "}";
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+ValuePtr parse(const std::string& text) { return Parser(text).parse_document(); }
+
+std::string dump(const ValuePtr& v, int indent) {
+  std::string out;
+  dump_into(v, out, indent, 0);
+  out += "\n";
+  return out;
+}
+
+}  // namespace k3stpu::json

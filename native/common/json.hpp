@@ -1,0 +1,71 @@
+// Minimal ordered JSON parser/serializer for the K3S-TPU native components.
+//
+// Why hand-rolled: the OCI runtime shim must rewrite a container's
+// config.json byte-faithfully enough that runc accepts it, and this image has
+// no C++ JSON library baked in. Insertion order is preserved (objects are
+// vectors of pairs) so patched specs diff cleanly against their inputs.
+// Parity note: the reference's nvidia-container-runtime does the same job
+// with Go's encoding/json (reference README.md:164 describes the behavior;
+// see SURVEY.md §2b #7).
+
+#pragma once
+
+#include <cstdint>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <utility>
+#include <vector>
+
+namespace k3stpu::json {
+
+class Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+enum class Type { Null, Bool, Int, Double, String, Array, Object };
+
+class Value {
+ public:
+  Type type = Type::Null;
+  bool bool_v = false;
+  int64_t int_v = 0;
+  double dbl_v = 0.0;
+  std::string str_v;
+  std::vector<ValuePtr> arr_v;
+  std::vector<std::pair<std::string, ValuePtr>> obj_v;
+
+  static ValuePtr make_null();
+  static ValuePtr make_bool(bool b);
+  static ValuePtr make_int(int64_t i);
+  static ValuePtr make_string(const std::string& s);
+  static ValuePtr make_array();
+  static ValuePtr make_object();
+
+  bool is_object() const { return type == Type::Object; }
+  bool is_array() const { return type == Type::Array; }
+  bool is_string() const { return type == Type::String; }
+
+  // Object helpers. get() returns nullptr when missing or not an object.
+  ValuePtr get(const std::string& key) const;
+  // Sets (replacing any existing entry) and returns the stored value.
+  ValuePtr set(const std::string& key, ValuePtr v);
+  // Returns the child object/array at key, creating it if absent.
+  ValuePtr ensure_object(const std::string& key);
+  ValuePtr ensure_array(const std::string& key);
+
+  std::string as_string(const std::string& fallback = "") const {
+    return type == Type::String ? str_v : fallback;
+  }
+};
+
+struct ParseError : std::runtime_error {
+  explicit ParseError(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+// Parses a complete JSON document; throws ParseError on malformed input.
+ValuePtr parse(const std::string& text);
+
+// Serializes with 2-space indentation (stable output for spec-diff tests).
+std::string dump(const ValuePtr& v, int indent = 2);
+
+}  // namespace k3stpu::json

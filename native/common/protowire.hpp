@@ -1,0 +1,153 @@
+// Minimal protobuf wire-format encoder/decoder.
+//
+// The kubelet device-plugin API (v1beta1) is protobuf-over-gRPC; this image
+// has libprotoc but hand-rolling the dozen fixed messages we exchange keeps
+// the plugin dependency-free and the wire layer auditable. Field numbers are
+// documented in native/tpu-device-plugin/deviceplugin.proto and mirrored by
+// tests/dp_proto.py (the fake kubelet). Parity context: the reference's
+// device plugin speaks the same gRPC API from Go (SURVEY.md §3.2 hot loop).
+
+#pragma once
+
+#include <cstdint>
+#include <map>
+#include <string>
+#include <vector>
+
+namespace k3stpu::pw {
+
+enum WireType : uint32_t {
+  kVarint = 0,
+  kFixed64 = 1,
+  kLenDelim = 2,
+  kFixed32 = 5,
+};
+
+// ---------------------------------------------------------------- encoding
+
+inline void put_varint(std::string& out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<char>((v & 0x7F) | 0x80));
+    v >>= 7;
+  }
+  out.push_back(static_cast<char>(v));
+}
+
+inline void put_tag(std::string& out, uint32_t field, WireType wt) {
+  put_varint(out, (static_cast<uint64_t>(field) << 3) | wt);
+}
+
+inline void put_string(std::string& out, uint32_t field, const std::string& s) {
+  put_tag(out, field, kLenDelim);
+  put_varint(out, s.size());
+  out += s;
+}
+
+inline void put_message(std::string& out, uint32_t field,
+                        const std::string& msg) {
+  put_string(out, field, msg);
+}
+
+inline void put_uint(std::string& out, uint32_t field, uint64_t v) {
+  put_tag(out, field, kVarint);
+  put_varint(out, v);
+}
+
+inline void put_bool(std::string& out, uint32_t field, bool v) {
+  if (v) put_uint(out, field, 1);
+}
+
+inline std::string map_entry(const std::string& key, const std::string& value) {
+  std::string e;
+  put_string(e, 1, key);
+  put_string(e, 2, value);
+  return e;
+}
+
+// ---------------------------------------------------------------- decoding
+
+// Streaming field reader over a serialized message. Unknown fields skip
+// cleanly, so the plugin tolerates newer kubelets.
+class Reader {
+ public:
+  Reader(const char* data, size_t size) : p_(data), end_(data + size) {}
+  explicit Reader(const std::string& s) : Reader(s.data(), s.size()) {}
+
+  bool next(uint32_t& field, WireType& wt) {
+    if (p_ >= end_) return false;
+    uint64_t tag;
+    if (!varint(tag)) return false;
+    field = static_cast<uint32_t>(tag >> 3);
+    wt = static_cast<WireType>(tag & 0x7);
+    return true;
+  }
+
+  bool varint(uint64_t& v) {
+    v = 0;
+    int shift = 0;
+    while (p_ < end_ && shift < 64) {
+      uint8_t b = static_cast<uint8_t>(*p_++);
+      v |= static_cast<uint64_t>(b & 0x7F) << shift;
+      if (!(b & 0x80)) return true;
+      shift += 7;
+    }
+    return false;
+  }
+
+  bool bytes(std::string& out) {
+    uint64_t len;
+    if (!varint(len)) return false;
+    // Compare against remaining bytes, not p_ + len: a crafted huge length
+    // must not overflow the pointer arithmetic past end_.
+    if (len > static_cast<uint64_t>(end_ - p_)) return false;
+    out.assign(p_, static_cast<size_t>(len));
+    p_ += len;
+    return true;
+  }
+
+  bool skip(WireType wt) {
+    switch (wt) {
+      case kVarint: {
+        uint64_t v;
+        return varint(v);
+      }
+      case kFixed64:
+        if (end_ - p_ < 8) return false;
+        p_ += 8;
+        return true;
+      case kLenDelim: {
+        std::string s;
+        return bytes(s);
+      }
+      case kFixed32:
+        if (end_ - p_ < 4) return false;
+        p_ += 4;
+        return true;
+      default:
+        return false;
+    }
+  }
+
+ private:
+  const char* p_;
+  const char* end_;
+};
+
+inline bool parse_map_entry(const std::string& entry, std::string& key,
+                            std::string& value) {
+  Reader r(entry);
+  uint32_t f;
+  WireType wt;
+  while (r.next(f, wt)) {
+    if (f == 1 && wt == kLenDelim) {
+      if (!r.bytes(key)) return false;
+    } else if (f == 2 && wt == kLenDelim) {
+      if (!r.bytes(value)) return false;
+    } else if (!r.skip(wt)) {
+      return false;
+    }
+  }
+  return true;
+}
+
+}  // namespace k3stpu::pw

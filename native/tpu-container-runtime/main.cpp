@@ -1,0 +1,202 @@
+// tpu-container-runtime: OCI runtime shim registered as RuntimeClass "tpu".
+//
+// TPU-native replacement for the reference's nvidia-container-runtime
+// (installed at reference README.md:57-69, consumed via
+// `runtimeClassName: nvidia` at values.yaml:4 / nvidia-smi.yaml:8 /
+// jellyfin.yaml:23). Like that runtime it is a thin wrapper over runc: on
+// `create`/`run` it rewrites the bundle's config.json — bind-mounting
+// libtpu.so, adding /dev/accel* (or vfio) device nodes and TPU_* env — then
+// execs the real runc. All other commands pass straight through, so
+// containerd can use it as a drop-in runtime binary.
+//
+// Extra subcommand `patch` exposes the rewrite as a standalone operation for
+// spec-diff tests and debugging (SURVEY.md §7 step 1).
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <fstream>
+#include <iostream>
+#include <sstream>
+#include <string>
+#include <vector>
+
+#include <unistd.h>
+
+#include "../common/json.hpp"
+#include "spec_patch.hpp"
+
+namespace {
+
+constexpr const char* kVersion = "0.1.0";
+constexpr const char* kRuncEnv = "TPU_CONTAINER_RUNTIME_RUNC";
+constexpr const char* kConfigPath = "/etc/tpu-container-runtime/config.json";
+
+struct RuntimeConfig {
+  std::string runc_path;
+  bool always = false;
+};
+
+RuntimeConfig load_config() {
+  RuntimeConfig cfg;
+  if (const char* env = std::getenv(kRuncEnv); env && *env)
+    cfg.runc_path = env;
+  std::ifstream f(kConfigPath);
+  if (f) {
+    std::stringstream ss;
+    ss << f.rdbuf();
+    try {
+      auto root = k3stpu::json::parse(ss.str());
+      if (cfg.runc_path.empty())
+        if (auto p = root->get("runc_path")) cfg.runc_path = p->as_string();
+      if (auto a = root->get("always")) cfg.always = a->bool_v;
+    } catch (const k3stpu::json::ParseError& e) {
+      std::cerr << "tpu-container-runtime: bad " << kConfigPath << ": "
+                << e.what() << "\n";
+    }
+  }
+  if (cfg.runc_path.empty()) cfg.runc_path = "runc";
+  return cfg;
+}
+
+std::string read_file(const std::string& path) {
+  std::ifstream f(path);
+  if (!f) throw std::runtime_error("cannot read " + path);
+  std::stringstream ss;
+  ss << f.rdbuf();
+  return ss.str();
+}
+
+void write_file(const std::string& path, const std::string& content) {
+  // Write-then-rename so runc never sees a half-written spec.
+  const std::string tmp = path + ".tpu-tmp";
+  {
+    std::ofstream f(tmp, std::ios::trunc);
+    if (!f) throw std::runtime_error("cannot write " + tmp);
+    f << content;
+  }
+  if (std::rename(tmp.c_str(), path.c_str()) != 0)
+    throw std::runtime_error("cannot rename " + tmp + " -> " + path);
+}
+
+// Finds the OCI bundle directory from runc-style argv: `--bundle X`,
+// `--bundle=X`, or `-b X`, after the create/run command. Default: cwd.
+std::string find_bundle(const std::vector<std::string>& args, size_t cmd_at) {
+  for (size_t i = cmd_at; i < args.size(); ++i) {
+    const std::string& a = args[i];
+    if ((a == "--bundle" || a == "-b") && i + 1 < args.size())
+      return args[i + 1];
+    if (a.rfind("--bundle=", 0) == 0) return a.substr(9);
+  }
+  return ".";
+}
+
+// Locates the runc command verb, skipping global options and their values.
+// Returns args.size() when none found.
+size_t find_command(const std::vector<std::string>& args) {
+  static const char* opts_with_value[] = {"--log", "--log-format", "--root",
+                                          "--criu", "--rootless"};
+  for (size_t i = 1; i < args.size(); ++i) {
+    const std::string& a = args[i];
+    if (a.rfind("-", 0) != 0) return i;
+    if (a.find('=') == std::string::npos) {
+      for (const char* o : opts_with_value) {
+        if (a == o) {
+          ++i;
+          break;
+        }
+      }
+    }
+  }
+  return args.size();
+}
+
+[[noreturn]] void exec_runc(const RuntimeConfig& cfg,
+                            const std::vector<std::string>& args) {
+  std::vector<char*> argv;
+  std::string argv0 = cfg.runc_path;
+  argv.push_back(argv0.data());
+  for (size_t i = 1; i < args.size(); ++i)
+    argv.push_back(const_cast<char*>(args[i].c_str()));
+  argv.push_back(nullptr);
+  execvp(cfg.runc_path.c_str(), argv.data());
+  std::perror(("tpu-container-runtime: exec " + cfg.runc_path).c_str());
+  std::exit(127);
+}
+
+int patch_bundle(const std::string& bundle, const k3stpu::runtime::PatchOptions& opts,
+                 bool dry_run, bool quiet) {
+  const std::string spec_path = bundle + "/config.json";
+  auto spec = k3stpu::json::parse(read_file(spec_path));
+  auto result = k3stpu::runtime::patch_spec(spec, opts);
+  const std::string out = k3stpu::json::dump(spec);
+  if (dry_run) {
+    std::cout << out;
+  } else if (result.injected) {
+    write_file(spec_path, out);
+  }
+  if (!quiet) {
+    std::cerr << "tpu-container-runtime: injected=" << result.injected
+              << " devices=" << result.n_devices
+              << " mounts=" << result.n_mounts << " env=[";
+    for (size_t i = 0; i < result.env_added.size(); ++i)
+      std::cerr << (i ? "," : "") << result.env_added[i];
+    std::cerr << "]\n";
+  }
+  return 0;
+}
+
+int cmd_patch(const std::vector<std::string>& args) {
+  k3stpu::runtime::PatchOptions opts;
+  std::string bundle = ".";
+  bool dry_run = false;
+  for (size_t i = 2; i < args.size(); ++i) {
+    const std::string& a = args[i];
+    if (a == "--bundle" && i + 1 < args.size()) bundle = args[++i];
+    else if (a == "--host-root" && i + 1 < args.size()) opts.host_root = args[++i];
+    else if (a == "--visible-chips" && i + 1 < args.size())
+      opts.visible_chips = args[++i];
+    else if (a == "--always") opts.always = true;
+    else if (a == "--dry-run") dry_run = true;
+    else {
+      std::cerr << "tpu-container-runtime patch: unknown option " << a << "\n";
+      return 2;
+    }
+  }
+  try {
+    return patch_bundle(bundle, opts, dry_run, /*quiet=*/false);
+  } catch (const std::exception& e) {
+    std::cerr << "tpu-container-runtime patch: " << e.what() << "\n";
+    return 1;
+  }
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::vector<std::string> args(argv, argv + argc);
+
+  if (args.size() >= 2 && (args[1] == "--version" || args[1] == "-v")) {
+    std::cout << "tpu-container-runtime version " << kVersion << "\n";
+    return 0;
+  }
+  if (args.size() >= 2 && args[1] == "patch") return cmd_patch(args);
+
+  RuntimeConfig cfg = load_config();
+  size_t cmd_at = find_command(args);
+  if (cmd_at < args.size() &&
+      (args[cmd_at] == "create" || args[cmd_at] == "run")) {
+    const std::string bundle = find_bundle(args, cmd_at);
+    try {
+      k3stpu::runtime::PatchOptions opts;
+      opts.always = cfg.always;
+      patch_bundle(bundle, opts, /*dry_run=*/false, /*quiet=*/true);
+    } catch (const std::exception& e) {
+      // Injection failure must not wedge non-TPU pods; log and continue so
+      // the container still starts (matching the reference runtime's
+      // pass-through behavior for non-GPU workloads).
+      std::cerr << "tpu-container-runtime: patch skipped: " << e.what() << "\n";
+    }
+  }
+  exec_runc(cfg, args);
+}

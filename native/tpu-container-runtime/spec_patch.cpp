@@ -1,0 +1,212 @@
+#include "spec_patch.hpp"
+
+#include <algorithm>
+#include <sstream>
+
+#include <sys/stat.h>
+#include <sys/sysmacros.h>
+
+namespace k3stpu::runtime {
+
+namespace {
+
+using json::Value;
+using json::ValuePtr;
+
+std::string env_lookup(const ValuePtr& spec, const std::string& name) {
+  auto process = spec->get("process");
+  if (!process) return "";
+  auto env = process->get("env");
+  if (!env || !env->is_array()) return "";
+  const std::string prefix = name + "=";
+  for (const auto& e : env->arr_v) {
+    if (e->is_string() && e->str_v.rfind(prefix, 0) == 0)
+      return e->str_v.substr(prefix.size());
+  }
+  return "";
+}
+
+bool env_present(const ValuePtr& spec, const std::string& name) {
+  auto process = spec->get("process");
+  if (!process) return false;
+  auto env = process->get("env");
+  if (!env || !env->is_array()) return false;
+  const std::string prefix = name + "=";
+  for (const auto& e : env->arr_v)
+    if (e->is_string() && e->str_v.rfind(prefix, 0) == 0) return true;
+  return false;
+}
+
+void add_env(const ValuePtr& spec, const std::string& name,
+             const std::string& value, PatchResult& result) {
+  if (env_present(spec, name)) return;
+  auto process = spec->ensure_object("process");
+  auto env = process->ensure_array("env");
+  env->arr_v.push_back(Value::make_string(name + "=" + value));
+  result.env_added.push_back(name);
+}
+
+bool has_mount(const ValuePtr& spec, const std::string& dest) {
+  auto mounts = spec->get("mounts");
+  if (!mounts || !mounts->is_array()) return false;
+  for (const auto& m : mounts->arr_v) {
+    auto d = m->get("destination");
+    if (d && d->as_string() == dest) return true;
+  }
+  return false;
+}
+
+void add_bind_mount(const ValuePtr& spec, const std::string& src,
+                    const std::string& dest, bool read_only,
+                    PatchResult& result) {
+  if (has_mount(spec, dest)) return;
+  auto mounts = spec->ensure_array("mounts");
+  auto m = Value::make_object();
+  m->set("destination", Value::make_string(dest));
+  m->set("type", Value::make_string("bind"));
+  m->set("source", Value::make_string(src));
+  auto opts = Value::make_array();
+  opts->arr_v.push_back(Value::make_string("rbind"));
+  opts->arr_v.push_back(Value::make_string(read_only ? "ro" : "rw"));
+  opts->arr_v.push_back(Value::make_string("nosuid"));
+  opts->arr_v.push_back(Value::make_string("nodev"));
+  m->set("options", opts);
+  mounts->arr_v.push_back(m);
+  ++result.n_mounts;
+}
+
+bool has_device(const ValuePtr& linux_obj, const std::string& path) {
+  auto devices = linux_obj->get("devices");
+  if (!devices || !devices->is_array()) return false;
+  for (const auto& d : devices->arr_v) {
+    auto p = d->get("path");
+    if (p && p->as_string() == path) return true;
+  }
+  return false;
+}
+
+// Adds the device node plus its cgroup allow-list entry.
+void add_device(const ValuePtr& spec, const std::string& container_path,
+                const std::string& host_path, PatchResult& result) {
+  auto linux_obj = spec->ensure_object("linux");
+  if (has_device(linux_obj, container_path)) return;
+
+  struct stat st{};
+  int64_t major = 0, minor = 0;
+  std::string dev_type = "c";
+  if (::stat(host_path.c_str(), &st) == 0 &&
+      (S_ISCHR(st.st_mode) || S_ISBLK(st.st_mode))) {
+    dev_type = S_ISBLK(st.st_mode) ? "b" : "c";
+    major = static_cast<int64_t>(::major(st.st_rdev));
+    minor = static_cast<int64_t>(::minor(st.st_rdev));
+  }
+
+  auto devices = linux_obj->ensure_array("devices");
+  auto d = Value::make_object();
+  d->set("path", Value::make_string(container_path));
+  d->set("type", Value::make_string(dev_type));
+  d->set("major", Value::make_int(major));
+  d->set("minor", Value::make_int(minor));
+  d->set("fileMode", Value::make_int(0666));
+  d->set("uid", Value::make_int(0));
+  d->set("gid", Value::make_int(0));
+  devices->arr_v.push_back(d);
+
+  auto resources = linux_obj->ensure_object("resources");
+  auto allow = resources->ensure_array("devices");
+  auto rule = Value::make_object();
+  rule->set("allow", Value::make_bool(true));
+  rule->set("type", Value::make_string(dev_type));
+  rule->set("major", Value::make_int(major));
+  rule->set("minor", Value::make_int(minor));
+  rule->set("access", Value::make_string("rwm"));
+  allow->arr_v.push_back(rule);
+  ++result.n_devices;
+}
+
+std::vector<int> parse_visible(const std::string& csv, size_t n_chips) {
+  std::vector<int> out;
+  if (csv.empty() || csv == "all") {
+    for (size_t i = 0; i < n_chips; ++i) out.push_back(static_cast<int>(i));
+    return out;
+  }
+  std::stringstream ss(csv);
+  std::string tok;
+  while (std::getline(ss, tok, ',')) {
+    try {
+      int v = std::stoi(tok);
+      if (v >= 0 && static_cast<size_t>(v) < n_chips) out.push_back(v);
+    } catch (...) {
+      // Ignore malformed entries; an empty result injects nothing, which
+      // surfaces quickly in the probe pod rather than corrupting the spec.
+    }
+  }
+  std::sort(out.begin(), out.end());
+  out.erase(std::unique(out.begin(), out.end()), out.end());
+  return out;
+}
+
+}  // namespace
+
+bool wants_injection(const json::ValuePtr& spec) {
+  if (env_present(spec, "TPU_VISIBLE_CHIPS")) return true;
+  auto annotations = spec->get("annotations");
+  if (annotations && annotations->is_object()) {
+    auto a = annotations->get("tpu.google.com/inject");
+    if (a && a->as_string() == "true") return true;
+  }
+  return false;
+}
+
+PatchResult patch_spec(json::ValuePtr spec, const PatchOptions& opts) {
+  PatchResult result;
+  if (!opts.always && !wants_injection(spec)) return result;
+  result.injected = true;
+
+  const std::string root = host_root(opts.host_root);
+  auto chips = enumerate_chips(root);
+
+  std::string visible = opts.visible_chips;
+  if (visible.empty()) visible = env_lookup(spec, "TPU_VISIBLE_CHIPS");
+  auto selected = parse_visible(visible, chips.size());
+
+  const std::string host_prefix = (root == "/") ? "" : root;
+  bool vfio_ctl = false;
+  for (int idx : selected) {
+    for (const auto& dev : chips[idx].dev_paths) {
+      if (dev == "/dev/vfio/vfio") {
+        vfio_ctl = true;
+        continue;
+      }
+      add_device(spec, dev, host_prefix + dev, result);
+    }
+  }
+  if (vfio_ctl) add_device(spec, "/dev/vfio/vfio",
+                           host_prefix + "/dev/vfio/vfio", result);
+
+  const std::string libtpu = find_libtpu(root);
+  if (!libtpu.empty())
+    add_bind_mount(spec, host_prefix + libtpu, "/lib/libtpu.so",
+                   /*read_only=*/true, result);
+
+  // Env contract consumed by libtpu/JAX inside the pod. TPU_VISIBLE_CHIPS is
+  // normally already present (device plugin Allocate); fill the rest.
+  if (!selected.empty()) {
+    std::string csv;
+    for (size_t i = 0; i < selected.size(); ++i)
+      csv += (i ? "," : "") + std::to_string(selected[i]);
+    add_env(spec, "TPU_VISIBLE_CHIPS", csv, result);
+    add_env(spec, "TPU_CHIPS_PER_PROCESS_BOUNDS",
+            "1,1," + std::to_string(selected.size()), result);
+    add_env(spec, "TPU_PROCESS_BOUNDS", "1,1,1", result);
+    if (!libtpu.empty())
+      add_env(spec, "TPU_LIBRARY_PATH", "/lib/libtpu.so", result);
+    if (!chips.empty())
+      add_env(spec, "TPU_ACCELERATOR_TYPE",
+              chips[0].generation + "-" + std::to_string(selected.size()),
+              result);
+  }
+  return result;
+}
+
+}  // namespace k3stpu::runtime

@@ -1,0 +1,47 @@
+// OCI spec rewriting: inject libtpu + TPU device nodes into a container spec.
+//
+// TPU-native equivalent of what the reference's nvidia-container-runtime +
+// libnvidia-container prestart hook do for GPU pods ("The nvidia runtime will
+// automatically copy everything needed for your pod to use the GPU" —
+// reference README.md:164; install at README.md:57-69). Instead of a prestart
+// hook binary we rewrite config.json directly before delegating to runc:
+// fewer moving parts and unit-testable as a pure JSON->JSON function
+// (SURVEY.md §7 step 1: "Unit-testable by spec-diffing").
+
+#pragma once
+
+#include <string>
+#include <vector>
+
+#include "../common/chips.hpp"
+#include "../common/json.hpp"
+
+namespace k3stpu::runtime {
+
+struct PatchOptions {
+  // Inject even when the spec carries no TPU request marker.
+  bool always = false;
+  // Host root override for discovery (tests use a fake tree).
+  std::string host_root;
+  // When non-empty, overrides discovered chips (device-plugin pre-selected
+  // visible chips, comma-separated indices from TPU_VISIBLE_CHIPS).
+  std::string visible_chips;
+};
+
+struct PatchResult {
+  bool injected = false;       // false: spec had no TPU request and !always
+  int n_devices = 0;           // device nodes added
+  int n_mounts = 0;            // bind mounts added
+  std::vector<std::string> env_added;
+};
+
+// Returns true when the spec asks for TPU injection: an env var
+// TPU_VISIBLE_CHIPS=... (set by the device plugin's Allocate response) or the
+// pod annotation "tpu.google.com/inject" == "true". Mirrors how the NVIDIA
+// runtime keys off NVIDIA_VISIBLE_DEVICES.
+bool wants_injection(const json::ValuePtr& spec);
+
+// Mutates the spec in place. Idempotent: running twice adds nothing new.
+PatchResult patch_spec(json::ValuePtr spec, const PatchOptions& opts);
+
+}  // namespace k3stpu::runtime

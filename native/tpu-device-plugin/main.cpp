@@ -1,0 +1,138 @@
+// tpu-device-plugin daemon: the DaemonSet binary.
+//
+// Lifecycle parity with the reference's plugin rollout (SURVEY.md §3.2):
+// enumerate chips -> bind plugin socket -> Register with kubelet ->
+// ListAndWatch streams chips x replicas device IDs -> Allocate returns
+// devices/mounts/envs. `--replicas` is the time-slicing knob (reference
+// values.yaml:18); `--dump` prints the enumerated inventory and exits
+// (nvidia-smi-style check, reference README.md:71-93).
+
+#include <atomic>
+#include <csignal>
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include <unistd.h>
+
+#include "../common/json.hpp"
+#include "plugin.hpp"
+
+namespace {
+
+std::atomic<bool> g_stop{false};
+
+void on_signal(int) { g_stop = true; }
+
+int dump_inventory(const k3stpu::plugin::PluginConfig& config) {
+  using k3stpu::json::Value;
+  auto chips = k3stpu::enumerate_chips(config.host_root);
+  auto root = Value::make_object();
+  root->set("resource", Value::make_string(config.resource_name));
+  root->set("replicas", Value::make_int(config.replicas));
+  root->set("chip_count", Value::make_int(static_cast<int64_t>(chips.size())));
+  root->set("schedulable",
+            Value::make_int(static_cast<int64_t>(chips.size()) *
+                            config.replicas));
+  root->set("topology", Value::make_string(k3stpu::topology_for(chips.size())));
+  auto arr = root->ensure_array("chips");
+  for (const auto& c : chips) {
+    auto o = Value::make_object();
+    o->set("index", Value::make_int(c.index));
+    o->set("pci", Value::make_string(c.pci_address));
+    o->set("generation", Value::make_string(c.generation));
+    o->set("numa", Value::make_int(c.numa_node));
+    auto devs = o->ensure_array("dev_paths");
+    for (const auto& d : c.dev_paths)
+      devs->arr_v.push_back(Value::make_string(d));
+    arr->arr_v.push_back(o);
+  }
+  std::cout << k3stpu::json::dump(root);
+  return 0;
+}
+
+void usage() {
+  std::cerr <<
+      "tpu-device-plugin [options]\n"
+      "  --resource NAME       extended resource name (google.com/tpu)\n"
+      "  --replicas N          shares per chip, parity with time-slicing\n"
+      "  --fail-multi          reject >1 device per container\n"
+      "  --plugin-dir DIR      kubelet device-plugin dir\n"
+      "  --socket NAME         plugin socket filename (k3stpu.sock)\n"
+      "  --host-root DIR       fake host root (tests)\n"
+      "  --scan-seconds N      health rescan interval\n"
+      "  --no-register         serve without registering (tests)\n"
+      "  --dump                print chip inventory JSON and exit\n";
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  k3stpu::plugin::PluginConfig config;
+  bool dump = false, no_register = false;
+
+  for (int i = 1; i < argc; ++i) {
+    std::string a = argv[i];
+    auto next = [&](const char* flag) -> std::string {
+      if (i + 1 >= argc) {
+        std::cerr << flag << " requires a value\n";
+        std::exit(2);
+      }
+      return argv[++i];
+    };
+    if (a == "--resource") config.resource_name = next("--resource");
+    else if (a == "--replicas") config.replicas = std::stoi(next("--replicas"));
+    else if (a == "--fail-multi") config.fail_requests_greater_than_one = true;
+    else if (a == "--plugin-dir") config.device_plugin_dir = next("--plugin-dir");
+    else if (a == "--socket") config.socket_name = next("--socket");
+    else if (a == "--host-root") config.host_root = next("--host-root");
+    else if (a == "--scan-seconds")
+      config.health_scan_seconds = std::stoi(next("--scan-seconds"));
+    else if (a == "--no-register") no_register = true;
+    else if (a == "--dump") dump = true;
+    else if (a == "--help" || a == "-h") { usage(); return 0; }
+    else { std::cerr << "unknown option " << a << "\n"; usage(); return 2; }
+  }
+  if (config.replicas < 1) {
+    std::cerr << "--replicas must be >= 1\n";
+    return 2;
+  }
+  if (dump) return dump_inventory(config);
+
+  const std::string kubelet_socket =
+      config.device_plugin_dir + "/kubelet.sock";
+
+  std::signal(SIGINT, on_signal);
+  std::signal(SIGTERM, on_signal);
+
+  // Outer loop = kubelet-restart recovery: when kubelet restarts it wipes
+  // /var/lib/kubelet/device-plugins/ (taking our socket with it) and expects
+  // plugins to re-register — otherwise google.com/tpu silently drops to 0
+  // until the DaemonSet pod restarts. Rebind + re-register whenever our
+  // socket vanishes; retry with backoff when kubelet is not up yet.
+  bool first = true;
+  while (!g_stop) {
+    k3stpu::plugin::TpuDevicePlugin plugin(config);
+    if (first) {
+      auto chips = plugin.chips_snapshot();
+      std::cerr << "tpu-device-plugin: " << chips.size() << " chip(s), "
+                << config.replicas << " replica(s) -> "
+                << chips.size() * config.replicas << " schedulable "
+                << config.resource_name << " on " << plugin.socket_path()
+                << "\n";
+      first = false;
+    }
+    if (!plugin.serve(kubelet_socket, no_register)) {
+      for (int i = 0; i < 10 && !g_stop; ++i) ::usleep(200 * 1000);
+      continue;
+    }
+    while (!g_stop &&
+           ::access(plugin.socket_path().c_str(), F_OK) == 0)
+      ::usleep(200 * 1000);
+    plugin.stop();
+    if (!g_stop)
+      std::cerr << "tpu-device-plugin: socket removed (kubelet restart?); "
+                   "re-registering\n";
+  }
+  return 0;
+}

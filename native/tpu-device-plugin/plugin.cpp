@@ -1,0 +1,426 @@
+#include "plugin.hpp"
+
+#include <algorithm>
+#include <chrono>
+#include <iostream>
+#include <map>
+
+#include "../common/protowire.hpp"
+
+namespace k3stpu::plugin {
+
+namespace {
+
+using pw::Reader;
+
+constexpr const char* kHealthy = "Healthy";
+
+// v1beta1.Device message.
+std::string encode_device(const std::string& id, const std::string& health,
+                          int numa_node) {
+  std::string dev;
+  pw::put_string(dev, 1, id);
+  pw::put_string(dev, 2, health);
+  if (numa_node >= 0) {
+    std::string numa;
+    pw::put_uint(numa, 1, static_cast<uint64_t>(numa_node));
+    std::string topo;
+    pw::put_message(topo, 1, numa);
+    pw::put_message(dev, 3, topo);
+  }
+  return dev;
+}
+
+std::vector<std::string> parse_string_list(const std::string& msg,
+                                           uint32_t field) {
+  std::vector<std::string> out;
+  Reader r(msg);
+  uint32_t f;
+  pw::WireType wt;
+  while (r.next(f, wt)) {
+    if (f == field && wt == pw::kLenDelim) {
+      std::string s;
+      if (!r.bytes(s)) break;
+      out.push_back(std::move(s));
+    } else if (!r.skip(wt)) {
+      break;
+    }
+  }
+  return out;
+}
+
+std::string csv(const std::vector<int>& xs) {
+  std::string out;
+  for (size_t i = 0; i < xs.size(); ++i)
+    out += (i ? "," : "") + std::to_string(xs[i]);
+  return out;
+}
+
+}  // namespace
+
+bool parse_device_id(const std::string& id, DeviceId& out) {
+  if (id.rfind("tpu-", 0) != 0) return false;
+  size_t dash = id.find('-', 4);
+  if (dash == std::string::npos) return false;
+  try {
+    out.chip = std::stoi(id.substr(4, dash - 4));
+    out.replica = std::stoi(id.substr(dash + 1));
+  } catch (...) {
+    return false;
+  }
+  return out.chip >= 0 && out.replica >= 0;
+}
+
+std::string format_device_id(int chip, int replica) {
+  return "tpu-" + std::to_string(chip) + "-" + std::to_string(replica);
+}
+
+TpuDevicePlugin::TpuDevicePlugin(PluginConfig config)
+    : config_(std::move(config)) {
+  chips_ = enumerate_chips(config_.host_root);
+}
+
+std::vector<TpuChip> TpuDevicePlugin::chips_snapshot() {
+  std::lock_guard<std::mutex> lock(mu_);
+  return chips_;
+}
+
+std::string TpuDevicePlugin::handle_options(const std::string&) const {
+  std::string out;
+  pw::put_bool(out, 1, false);  // pre_start_required
+  pw::put_bool(out, 2, true);   // get_preferred_allocation_available
+  return out;
+}
+
+std::string TpuDevicePlugin::list_and_watch_payload() {
+  std::lock_guard<std::mutex> lock(mu_);
+  std::string out;
+  for (const auto& chip : chips_) {
+    const std::string health =
+        chip.dev_paths.empty() ? "Unhealthy" : kHealthy;
+    for (int r = 0; r < config_.replicas; ++r)
+      pw::put_message(
+          out, 1, encode_device(format_device_id(chip.index, r), health,
+                                chip.numa_node));
+  }
+  return out;
+}
+
+std::string TpuDevicePlugin::allocate_one_container(
+    const std::vector<std::string>& ids) {
+  if (config_.fail_requests_greater_than_one && ids.size() > 1)
+    throw h2::GrpcError{3 /*INVALID_ARGUMENT*/,
+                        "requests for more than one " + config_.resource_name +
+                            " are disabled (failRequestsGreaterThanOne)"};
+
+  std::set<int> chip_set;
+  for (const auto& id : ids) {
+    DeviceId d;
+    if (!parse_device_id(id, d))
+      throw h2::GrpcError{3, "malformed device id: " + id};
+    chip_set.insert(d.chip);
+  }
+
+  std::lock_guard<std::mutex> lock(mu_);
+  std::map<int, const TpuChip*> by_index;
+  for (const auto& c : chips_) by_index[c.index] = &c;
+
+  std::vector<int> chip_list(chip_set.begin(), chip_set.end());
+  std::string resp;
+
+  // envs (map<string,string> = repeated entry messages, field 1)
+  auto put_env = [&resp](const std::string& k, const std::string& v) {
+    pw::put_message(resp, 1, pw::map_entry(k, v));
+  };
+  put_env("TPU_VISIBLE_CHIPS", csv(chip_list));
+  put_env("TPU_CHIPS_PER_PROCESS_BOUNDS",
+          "1,1," + std::to_string(chip_list.size()));
+  put_env("TPU_PROCESS_BOUNDS", "1,1,1");
+  if (!chips_.empty())
+    put_env("TPU_ACCELERATOR_TYPE",
+            chips_.front().generation + "-" + std::to_string(chip_list.size()));
+  if (config_.replicas > 1) {
+    // Shared chips: multiple JAX processes coexist on one chip, so cap each
+    // pod's premapped HBM slice instead of letting libtpu assume exclusive
+    // ownership (SURVEY.md §7 "Hard parts": Allocate semantics for shared
+    // chips).
+    put_env("TPU_MEM_FRACTION",
+            std::to_string(1.0 / config_.replicas).substr(0, 6));
+    put_env("TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES", "1");
+  }
+
+  // device nodes + libtpu mount
+  bool vfio_ctl = false;
+  for (int chip : chip_list) {
+    auto it = by_index.find(chip);
+    if (it == by_index.end())
+      throw h2::GrpcError{5 /*NOT_FOUND*/,
+                          "unknown chip " + std::to_string(chip)};
+    for (const auto& dev : it->second->dev_paths) {
+      if (dev == "/dev/vfio/vfio") {
+        vfio_ctl = true;
+        continue;
+      }
+      std::string spec;
+      pw::put_string(spec, 1, dev);  // container_path
+      pw::put_string(spec, 2, dev);  // host_path
+      pw::put_string(spec, 3, "rwm");
+      pw::put_message(resp, 3, spec);
+    }
+  }
+  if (vfio_ctl) {
+    std::string spec;
+    pw::put_string(spec, 1, "/dev/vfio/vfio");
+    pw::put_string(spec, 2, "/dev/vfio/vfio");
+    pw::put_string(spec, 3, "rwm");
+    pw::put_message(resp, 3, spec);
+  }
+
+  const std::string libtpu = find_libtpu(config_.host_root);
+  if (!libtpu.empty()) {
+    std::string mount;
+    pw::put_string(mount, 1, "/lib/libtpu.so");
+    pw::put_string(mount, 2, libtpu);
+    pw::put_bool(mount, 3, true);
+    pw::put_message(resp, 2, mount);
+  }
+
+  pw::put_message(resp, 4,
+                  pw::map_entry("tpu.google.com/chips", csv(chip_list)));
+  return resp;
+}
+
+std::string TpuDevicePlugin::handle_allocate(const std::string& request) {
+  // AllocateRequest{ repeated ContainerAllocateRequest{ devicesIDs=1 } = 1 }
+  std::string out;
+  Reader r(request);
+  uint32_t f;
+  pw::WireType wt;
+  while (r.next(f, wt)) {
+    if (f == 1 && wt == pw::kLenDelim) {
+      std::string creq;
+      if (!r.bytes(creq)) break;
+      pw::put_message(out, 1,
+                      allocate_one_container(parse_string_list(creq, 1)));
+    } else if (!r.skip(wt)) {
+      break;
+    }
+  }
+  return out;
+}
+
+std::string TpuDevicePlugin::handle_preferred(const std::string& request) {
+  std::string out;
+  Reader r(request);
+  uint32_t f;
+  pw::WireType wt;
+  while (r.next(f, wt)) {
+    if (!(f == 1 && wt == pw::kLenDelim)) {
+      if (!r.skip(wt)) break;
+      continue;
+    }
+    std::string creq;
+    if (!r.bytes(creq)) break;
+
+    std::vector<std::string> available = parse_string_list(creq, 1);
+    std::vector<std::string> must = parse_string_list(creq, 2);
+    int64_t size = 0;
+    {
+      Reader cr(creq);
+      uint32_t cf;
+      pw::WireType cwt;
+      while (cr.next(cf, cwt)) {
+        if (cf == 3 && cwt == pw::kVarint) {
+          uint64_t v;
+          if (cr.varint(v)) size = static_cast<int64_t>(v);
+        } else if (!cr.skip(cwt)) {
+          break;
+        }
+      }
+    }
+
+    // Topology-aware choice (SURVEY.md §7 "Hard parts"): prefer replicas on
+    // the fewest chips, and chips in the tightest contiguous index window —
+    // contiguous indices are ICI neighbors on a v5e host tray, so multi-chip
+    // pods land on a connected sub-mesh.
+    std::map<int, std::vector<std::string>> by_chip;
+    for (auto& id : available) {
+      DeviceId d;
+      if (parse_device_id(id, d)) by_chip[d.chip].push_back(id);
+    }
+    for (auto& [_, ids] : by_chip)
+      std::sort(ids.begin(), ids.end());
+
+    std::vector<std::string> chosen(must.begin(), must.end());
+    std::set<std::string> chosen_set(must.begin(), must.end());
+    std::vector<int> chip_order;
+    for (const auto& [chip, _] : by_chip) chip_order.push_back(chip);
+
+    // Find the shortest contiguous chip window whose capacity covers `size`.
+    size_t best_lo = 0, best_len = chip_order.size() + 1;
+    for (size_t lo = 0; lo < chip_order.size(); ++lo) {
+      size_t have = 0;
+      for (size_t hi = lo; hi < chip_order.size(); ++hi) {
+        if (hi > lo && chip_order[hi] != chip_order[hi - 1] + 1) break;
+        have += by_chip[chip_order[hi]].size();
+        if (have >= static_cast<size_t>(size)) {
+          if (hi - lo + 1 < best_len) {
+            best_len = hi - lo + 1;
+            best_lo = lo;
+          }
+          break;
+        }
+      }
+    }
+    if (best_len <= chip_order.size()) {
+      for (size_t i = best_lo;
+           i < best_lo + best_len &&
+           chosen.size() < static_cast<size_t>(size);
+           ++i) {
+        for (const auto& id : by_chip[chip_order[i]]) {
+          if (chosen.size() >= static_cast<size_t>(size)) break;
+          if (chosen_set.insert(id).second) chosen.push_back(id);
+        }
+      }
+    }
+    // Fall back to any available ids if the window search came up short.
+    for (const auto& id : available) {
+      if (chosen.size() >= static_cast<size_t>(size)) break;
+      if (chosen_set.insert(id).second) chosen.push_back(id);
+    }
+
+    std::string cresp;
+    for (const auto& id : chosen) pw::put_string(cresp, 1, id);
+    pw::put_message(out, 1, cresp);
+  }
+  return out;
+}
+
+std::string TpuDevicePlugin::handle_prestart(const std::string&) const {
+  return "";  // PreStartContainerResponse{}
+}
+
+void TpuDevicePlugin::rescan() {
+  auto fresh = enumerate_chips(config_.host_root);
+  std::lock_guard<std::mutex> lock(mu_);
+  bool changed = fresh.size() != chips_.size();
+  if (!changed) {
+    for (size_t i = 0; i < fresh.size(); ++i) {
+      if (fresh[i].pci_address != chips_[i].pci_address ||
+          fresh[i].dev_paths != chips_[i].dev_paths) {
+        changed = true;
+        break;
+      }
+    }
+  }
+  if (changed) {
+    chips_ = std::move(fresh);
+    ++state_version_;
+    cv_.notify_all();
+  }
+}
+
+std::string TpuDevicePlugin::register_request() const {
+  std::string opts;
+  pw::put_bool(opts, 1, false);
+  pw::put_bool(opts, 2, true);
+  std::string req;
+  pw::put_string(req, 1, "v1beta1");
+  pw::put_string(req, 2, config_.socket_name);
+  pw::put_string(req, 3, config_.resource_name);
+  pw::put_message(req, 4, opts);
+  return req;
+}
+
+bool TpuDevicePlugin::serve(const std::string& kubelet_socket,
+                            bool skip_register) {
+  server_.add_unary("/v1beta1.DevicePlugin/GetDevicePluginOptions",
+                    [this](const std::string& req) {
+                      return handle_options(req);
+                    });
+  server_.add_unary("/v1beta1.DevicePlugin/Allocate",
+                    [this](const std::string& req) {
+                      return handle_allocate(req);
+                    });
+  server_.add_unary("/v1beta1.DevicePlugin/GetPreferredAllocation",
+                    [this](const std::string& req) {
+                      return handle_preferred(req);
+                    });
+  server_.add_unary("/v1beta1.DevicePlugin/PreStartContainer",
+                    [this](const std::string& req) {
+                      return handle_prestart(req);
+                    });
+  server_.add_server_stream(
+      "/v1beta1.DevicePlugin/ListAndWatch",
+      [this](const std::string&, const h2::StreamCtx& ctx) {
+        // The reference stack's hot loop (SURVEY.md §3.2): stream the device
+        // list, then again on every inventory change, until the client goes
+        // away or the plugin stops. The wait polls ctx.alive() so a kubelet
+        // reconnect doesn't strand this thread until the next (possibly
+        // never) inventory change.
+        uint64_t seen;
+        {
+          std::lock_guard<std::mutex> lock(mu_);
+          seen = state_version_;
+        }
+        if (!ctx.write(list_and_watch_payload())) return;
+        for (;;) {
+          bool changed;
+          {
+            std::unique_lock<std::mutex> lock(mu_);
+            cv_.wait_for(lock, std::chrono::milliseconds(500), [&] {
+              return stopping_ || state_version_ != seen;
+            });
+            if (stopping_) return;
+            changed = state_version_ != seen;
+            seen = state_version_;
+          }
+          if (!ctx.alive()) return;
+          if (changed && !ctx.write(list_and_watch_payload())) return;
+        }
+      });
+
+  if (!server_.start(socket_path())) {
+    std::cerr << "tpu-device-plugin: cannot bind " << socket_path() << "\n";
+    return false;
+  }
+
+  if (!skip_register) {
+    auto result = h2::grpc_unary_call(
+        kubelet_socket, "/v1beta1.Registration/Register", register_request());
+    if (!result.transport_ok || result.grpc_status != h2::kOk) {
+      std::cerr << "tpu-device-plugin: Register failed (transport="
+                << result.transport_ok << " status=" << result.grpc_status
+                << " message=\"" << result.message << "\")\n";
+      server_.stop();
+      return false;
+    }
+  }
+
+  scan_thread_ = std::thread([this] {
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> lock(mu_);
+        if (cv_.wait_for(lock,
+                         std::chrono::seconds(config_.health_scan_seconds),
+                         [this] { return stopping_; }))
+          return;
+      }
+      rescan();
+    }
+  });
+  return true;
+}
+
+void TpuDevicePlugin::stop() {
+  {
+    std::lock_guard<std::mutex> lock(mu_);
+    if (stopping_) return;
+    stopping_ = true;
+    cv_.notify_all();
+  }
+  if (scan_thread_.joinable()) scan_thread_.join();
+  server_.stop();
+}
+
+}  // namespace k3stpu::plugin

@@ -1,0 +1,92 @@
+// TPU device plugin: advertises google.com/tpu to kubelet with N-way
+// per-chip sharing and topology-aware allocation.
+//
+// TPU-native rebuild of the reference's NVIDIA device plugin + its
+// time-slicing policy (SURVEY.md §2b #9): `replicas` here mirrors
+// `timeSlicing.resources[].replicas: 4` (reference values.yaml:12-18,
+// README.md:112 — "treat that one GPU as if it were actually four");
+// `fail_requests_greater_than_one` mirrors values.yaml:15. Device IDs are
+// "tpu-<chip>-<replica>" so kubelet counts chips x replicas schedulable
+// units while Allocate collapses them back to physical chips.
+//
+// The message-level handlers are pure bytes-in/bytes-out functions over the
+// hand-rolled wire layer, so tests drive them without sockets, and the gRPC
+// server (grpc_transport) binds them to the kubelet protocol.
+
+#pragma once
+
+#include <condition_variable>
+#include <cstdint>
+#include <mutex>
+#include <set>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include "../common/chips.hpp"
+#include "../common/grpc_transport.hpp"
+
+namespace k3stpu::plugin {
+
+struct PluginConfig {
+  std::string resource_name = "google.com/tpu";
+  int replicas = 1;  // shares per physical chip (1 = exclusive)
+  bool fail_requests_greater_than_one = false;
+  std::string device_plugin_dir = "/var/lib/kubelet/device-plugins";
+  std::string socket_name = "k3stpu.sock";
+  std::string host_root;  // "" = real /
+  int health_scan_seconds = 5;
+};
+
+struct DeviceId {
+  int chip = 0;
+  int replica = 0;
+};
+
+// "tpu-<chip>-<replica>"; returns false on malformed input.
+bool parse_device_id(const std::string& id, DeviceId& out);
+std::string format_device_id(int chip, int replica);
+
+class TpuDevicePlugin {
+ public:
+  explicit TpuDevicePlugin(PluginConfig config);
+
+  // -- protobuf message handlers (testable without any socket) --
+  std::string handle_options(const std::string& request) const;
+  std::string list_and_watch_payload();  // current ListAndWatchResponse
+  std::string handle_allocate(const std::string& request);
+  std::string handle_preferred(const std::string& request);
+  std::string handle_prestart(const std::string& request) const;
+
+  // Re-enumerates chips; wakes ListAndWatch streams when inventory changed.
+  void rescan();
+
+  // Serving: binds the plugin socket, registers with kubelet, runs the
+  // health-rescan loop until stop(). Returns false if bind/register fails.
+  bool serve(const std::string& kubelet_socket, bool skip_register = false);
+  void stop();
+
+  std::string socket_path() const {
+    return config_.device_plugin_dir + "/" + config_.socket_name;
+  }
+  const PluginConfig& config() const { return config_; }
+  std::vector<TpuChip> chips_snapshot();
+
+  // Builds the RegisterRequest this plugin sends to kubelet.
+  std::string register_request() const;
+
+ private:
+  std::string allocate_one_container(const std::vector<std::string>& ids);
+
+  PluginConfig config_;
+  h2::GrpcServer server_;
+  std::thread scan_thread_;
+
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<TpuChip> chips_;
+  uint64_t state_version_ = 0;
+  bool stopping_ = false;
+};
+
+}  // namespace k3stpu::plugin

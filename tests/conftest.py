@@ -1,0 +1,54 @@
+"""Test env: force the CPU backend with 8 virtual devices BEFORE jax imports,
+so every sharding/mesh test runs the real pjit path without TPU hardware
+(SURVEY.md §4 — CPU-JAX stand-in, fake backends)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon sitecustomize (TPU tunnel) force-registers its platform ahead of
+# env vars, so pin the CPU backend via jax.config before any backend init.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pathlib
+import sys
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+if str(REPO_ROOT) not in sys.path:
+    sys.path.insert(0, str(REPO_ROOT))
+
+import pytest
+
+
+@pytest.fixture()
+def fake_host_root(tmp_path):
+    """A fabricated host filesystem with 4 TPU v5e chips: sysfs PCI entries
+    (vendor 0x1ae0) + /dev/accel* nodes (files stand in for device nodes)."""
+    for i in range(4):
+        bdf = tmp_path / "sys" / "bus" / "pci" / "devices" / f"0000:00:0{4 + i}.0"
+        bdf.mkdir(parents=True)
+        (bdf / "vendor").write_text("0x1ae0\n")
+        (bdf / "device").write_text("0x0062\n")
+        (bdf / "numa_node").write_text(f"{i // 2}\n")
+    # A non-TPU PCI device that must be ignored.
+    other = tmp_path / "sys" / "bus" / "pci" / "devices" / "0000:00:01.0"
+    other.mkdir(parents=True)
+    (other / "vendor").write_text("0x8086\n")
+    (other / "device").write_text("0x1237\n")
+
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(4):
+        (dev / f"accel{i}").write_text("")
+    libdir = tmp_path / "usr" / "lib"
+    libdir.mkdir(parents=True)
+    (libdir / "libtpu.so").write_text("")
+    return tmp_path

@@ -1,0 +1,177 @@
+"""Hand-rolled protobuf wire helpers for the kubelet device-plugin v1beta1
+messages (field numbers documented in native/tpu-device-plugin/
+deviceplugin.proto). Used by the fake kubelet tests to talk to the C++ plugin
+through grpcio with identity serializers — no protoc plugin needed."""
+
+from __future__ import annotations
+
+
+def put_varint(buf: bytearray, v: int) -> None:
+    while v >= 0x80:
+        buf.append((v & 0x7F) | 0x80)
+        v >>= 7
+    buf.append(v)
+
+
+def put_tag(buf: bytearray, field: int, wire_type: int) -> None:
+    put_varint(buf, (field << 3) | wire_type)
+
+
+def put_bytes(buf: bytearray, field: int, data: bytes) -> None:
+    put_tag(buf, field, 2)
+    put_varint(buf, len(data))
+    buf.extend(data)
+
+
+def put_str(buf: bytearray, field: int, s: str) -> None:
+    put_bytes(buf, field, s.encode())
+
+
+def put_uint(buf: bytearray, field: int, v: int) -> None:
+    put_tag(buf, field, 0)
+    put_varint(buf, v)
+
+
+def read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    v = shift = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        v |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return v, pos
+        shift += 7
+
+
+def iter_fields(data: bytes):
+    """Yields (field_number, wire_type, value); value is bytes for
+    length-delimited fields and int for varints."""
+    pos = 0
+    while pos < len(data):
+        tag, pos = read_varint(data, pos)
+        field, wt = tag >> 3, tag & 0x7
+        if wt == 0:
+            v, pos = read_varint(data, pos)
+            yield field, wt, v
+        elif wt == 2:
+            length, pos = read_varint(data, pos)
+            yield field, wt, data[pos:pos + length]
+            pos += length
+        elif wt == 1:
+            yield field, wt, data[pos:pos + 8]
+            pos += 8
+        elif wt == 5:
+            yield field, wt, data[pos:pos + 4]
+            pos += 4
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+
+
+def fields(data: bytes, field: int) -> list:
+    return [v for f, _, v in iter_fields(data) if f == field]
+
+
+def first(data: bytes, field: int, default=None):
+    got = fields(data, field)
+    return got[0] if got else default
+
+
+def parse_map(entries: list[bytes]) -> dict[str, str]:
+    out = {}
+    for e in entries:
+        key = first(e, 1, b"").decode()
+        value = first(e, 2, b"").decode()
+        out[key] = value
+    return out
+
+
+# ------------------------------------------------------- message builders
+
+def empty() -> bytes:
+    return b""
+
+
+def allocate_request(*container_device_ids: list[str]) -> bytes:
+    buf = bytearray()
+    for ids in container_device_ids:
+        creq = bytearray()
+        for d in ids:
+            put_str(creq, 1, d)
+        put_bytes(buf, 1, bytes(creq))
+    return bytes(buf)
+
+
+def preferred_request(available: list[str], size: int,
+                      must: list[str] = ()) -> bytes:
+    creq = bytearray()
+    for d in available:
+        put_str(creq, 1, d)
+    for d in must:
+        put_str(creq, 2, d)
+    put_uint(creq, 3, size)
+    buf = bytearray()
+    put_bytes(buf, 1, bytes(creq))
+    return bytes(buf)
+
+
+# ------------------------------------------------------- message parsers
+
+def parse_devices(law_response: bytes) -> list[dict]:
+    """ListAndWatchResponse -> [{id, health, numa}]"""
+    out = []
+    for dev in fields(law_response, 1):
+        numa = None
+        topo = first(dev, 3)
+        if topo is not None:
+            node = first(topo, 1)
+            if node is not None:
+                numa = first(node, 1, 0)
+        out.append({
+            "id": first(dev, 1, b"").decode(),
+            "health": first(dev, 2, b"").decode(),
+            "numa": numa,
+        })
+    return out
+
+
+def parse_allocate_response(resp: bytes) -> list[dict]:
+    """AllocateResponse -> [{envs, mounts, devices, annotations}]"""
+    out = []
+    for cresp in fields(resp, 1):
+        mounts = [
+            {
+                "container_path": first(m, 1, b"").decode(),
+                "host_path": first(m, 2, b"").decode(),
+                "read_only": bool(first(m, 3, 0)),
+            }
+            for m in fields(cresp, 2)
+        ]
+        devices = [
+            {
+                "container_path": first(d, 1, b"").decode(),
+                "host_path": first(d, 2, b"").decode(),
+                "permissions": first(d, 3, b"").decode(),
+            }
+            for d in fields(cresp, 3)
+        ]
+        out.append({
+            "envs": parse_map(fields(cresp, 1)),
+            "mounts": mounts,
+            "devices": devices,
+            "annotations": parse_map(fields(cresp, 4)),
+        })
+    return out
+
+
+def parse_preferred_response(resp: bytes) -> list[list[str]]:
+    return [[d.decode() for d in fields(c, 1)] for c in fields(resp, 1)]
+
+
+def parse_register_request(req: bytes) -> dict:
+    opts = first(req, 4)
+    return {
+        "version": first(req, 1, b"").decode(),
+        "endpoint": first(req, 2, b"").decode(),
+        "resource_name": first(req, 3, b"").decode(),
+        "preferred_alloc": bool(first(opts, 2, 0)) if opts else False,
+    }

@@ -1,0 +1,39 @@
+"""bench.py must print exactly one JSON line with the driver's schema."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bench_json_line():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""  # drop the axon sitecustomize (forces TPU tunnel)
+    env.pop("XLA_FLAGS", None)  # single CPU device -> single-chip path
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"bench.py must print exactly one line, got: {lines}"
+    rec = json.loads(lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in rec
+    assert rec["value"] > 0
+
+
+def test_probe_cpu():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.probe", "--m", "256", "--iters", "2"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr
+    assert "DEVICES_JSON" in out.stdout
+    assert "BENCH_JSON" in out.stdout

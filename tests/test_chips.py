@@ -1,0 +1,57 @@
+"""Chip enumeration against the fake sysfs tree (SURVEY.md §4)."""
+
+from k3stpu.utils import chips
+
+
+def test_enumerate_fake_v5e(fake_host_root):
+    inv = chips.enumerate_chips(root=str(fake_host_root))
+    assert inv.count == 4
+    assert inv.generation == "tpu-v5e"
+    assert inv.topology() == "2x2"
+    assert [c.index for c in inv.chips] == [0, 1, 2, 3]
+    assert inv.chips[0].dev_paths == ("/dev/accel0",)
+    assert inv.chips[3].dev_paths == ("/dev/accel3",)
+    assert inv.chips[0].numa_node == 0
+    assert inv.chips[3].numa_node == 1
+    # The Intel device must not appear.
+    assert all(c.vendor_id == "0x1ae0" for c in inv.chips)
+
+
+def test_mixed_accel_vfio(tmp_path):
+    """Chips beyond the accel nodes map onto vfio groups starting at 0."""
+    for i in range(4):
+        bdf = tmp_path / "sys" / "bus" / "pci" / "devices" / f"0000:00:0{4 + i}.0"
+        bdf.mkdir(parents=True)
+        (bdf / "vendor").write_text("0x1ae0\n")
+        (bdf / "device").write_text("0x0062\n")
+    dev = tmp_path / "dev"
+    (dev / "vfio").mkdir(parents=True)
+    for i in range(2):
+        (dev / f"accel{i}").write_text("")
+    for i in range(2):
+        (dev / "vfio" / str(i)).write_text("")
+    (dev / "vfio" / "vfio").write_text("")
+
+    inv = chips.enumerate_chips(root=str(tmp_path))
+    assert [c.dev_paths for c in inv.chips] == [
+        ("/dev/accel0",),
+        ("/dev/accel1",),
+        ("/dev/vfio/0", "/dev/vfio/vfio"),
+        ("/dev/vfio/1", "/dev/vfio/vfio"),
+    ]
+
+
+def test_enumerate_empty(tmp_path):
+    inv = chips.enumerate_chips(root=str(tmp_path))
+    assert inv.count == 0
+    assert inv.generation == "none"
+    assert inv.topology() == "0"
+
+
+def test_libtpu_path(fake_host_root, tmp_path):
+    assert chips.libtpu_path(root=str(fake_host_root)) == "/usr/lib/libtpu.so"
+
+
+def test_host_root_env(fake_host_root, monkeypatch):
+    monkeypatch.setenv(chips.HOST_ROOT_ENV, str(fake_host_root))
+    assert chips.enumerate_chips().count == 4

@@ -1,0 +1,183 @@
+"""tpu-container-runtime: OCI spec rewriting + runc passthrough.
+
+Spec-diff unit tests (SURVEY.md §7 step 1) against the fake host tree — no
+TPU, no containerd. The binary is built on demand from native/.
+"""
+
+import json
+import os
+import stat
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = os.path.join(REPO, "native", "build")
+BIN = os.path.join(BUILD_DIR, "tpu-container-runtime")
+
+
+@pytest.fixture(scope="session")
+def runtime_bin():
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD_DIR],
+        check=True, capture_output=True,
+    )
+    subprocess.run(
+        ["cmake", "--build", BUILD_DIR], check=True, capture_output=True
+    )
+    return BIN
+
+
+def base_spec(env=()):
+    return {
+        "ociVersion": "1.0.2",
+        "process": {
+            "args": ["python", "-m", "k3stpu.probe"],
+            "env": ["PATH=/usr/bin"] + list(env),
+        },
+        "root": {"path": "rootfs"},
+        "mounts": [
+            {"destination": "/proc", "type": "proc", "source": "proc"},
+        ],
+        "linux": {"namespaces": [{"type": "pid"}]},
+    }
+
+
+def run_patch(runtime_bin, bundle, *extra):
+    out = subprocess.run(
+        [runtime_bin, "patch", "--bundle", str(bundle), "--dry-run", *extra],
+        capture_output=True, text=True,
+    )
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout), out.stderr
+
+
+def write_bundle(tmp_path, spec, name="bundle"):
+    bundle = tmp_path / name
+    bundle.mkdir(exist_ok=True)
+    (bundle / "config.json").write_text(json.dumps(spec))
+    return bundle
+
+
+def test_injects_devices_mounts_env(runtime_bin, fake_host_root, tmp_path):
+    bundle = write_bundle(tmp_path, base_spec(env=["TPU_VISIBLE_CHIPS=all"]))
+    patched, log = run_patch(
+        runtime_bin, bundle, "--host-root", str(fake_host_root)
+    )
+    env = patched["process"]["env"]
+    assert "TPU_VISIBLE_CHIPS=all" in env
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=1,1,4" in env
+    assert "TPU_LIBRARY_PATH=/lib/libtpu.so" in env
+    assert any(e.startswith("TPU_ACCELERATOR_TYPE=tpu-v5e-4") for e in env)
+
+    dev_paths = [d["path"] for d in patched["linux"]["devices"]]
+    assert dev_paths == [f"/dev/accel{i}" for i in range(4)]
+    allows = patched["linux"]["resources"]["devices"]
+    assert all(rule["allow"] and rule["access"] == "rwm" for rule in allows)
+
+    libtpu_mounts = [
+        m for m in patched["mounts"] if m["destination"] == "/lib/libtpu.so"
+    ]
+    assert len(libtpu_mounts) == 1
+    assert libtpu_mounts[0]["source"].endswith("/usr/lib/libtpu.so")
+    assert "ro" in libtpu_mounts[0]["options"]
+    assert "injected=1" in log
+
+
+def test_visible_chips_subset(runtime_bin, fake_host_root, tmp_path):
+    bundle = write_bundle(tmp_path, base_spec(env=["TPU_VISIBLE_CHIPS=1,3"]))
+    patched, _ = run_patch(
+        runtime_bin, bundle, "--host-root", str(fake_host_root)
+    )
+    dev_paths = [d["path"] for d in patched["linux"]["devices"]]
+    assert dev_paths == ["/dev/accel1", "/dev/accel3"]
+    assert "TPU_CHIPS_PER_PROCESS_BOUNDS=1,1,2" in patched["process"]["env"]
+
+
+def test_no_request_no_injection(runtime_bin, fake_host_root, tmp_path):
+    bundle = write_bundle(tmp_path, base_spec())
+    patched, log = run_patch(
+        runtime_bin, bundle, "--host-root", str(fake_host_root)
+    )
+    assert "devices" not in patched.get("linux", {})
+    assert patched["process"]["env"] == ["PATH=/usr/bin"]
+    assert "injected=0" in log
+
+
+def test_annotation_triggers_injection(runtime_bin, fake_host_root, tmp_path):
+    spec = base_spec()
+    spec["annotations"] = {"tpu.google.com/inject": "true"}
+    bundle = write_bundle(tmp_path, spec)
+    patched, _ = run_patch(
+        runtime_bin, bundle, "--host-root", str(fake_host_root)
+    )
+    assert len(patched["linux"]["devices"]) == 4
+
+
+def test_idempotent(runtime_bin, fake_host_root, tmp_path):
+    bundle = write_bundle(tmp_path, base_spec(env=["TPU_VISIBLE_CHIPS=all"]))
+    first, _ = run_patch(runtime_bin, bundle, "--host-root", str(fake_host_root))
+    (bundle / "config.json").write_text(json.dumps(first))
+    second, _ = run_patch(
+        runtime_bin, bundle, "--host-root", str(fake_host_root)
+    )
+    assert first == second
+
+
+def test_create_patches_and_execs_runc(runtime_bin, fake_host_root, tmp_path):
+    """End-to-end shape of the containerd call: `create --bundle X id` must
+    rewrite config.json in place and exec the real runtime with argv intact."""
+    bundle = write_bundle(tmp_path, base_spec(env=["TPU_VISIBLE_CHIPS=0"]))
+    argv_log = tmp_path / "runc-argv"
+    fake_runc = tmp_path / "fake-runc"
+    fake_runc.write_text(f'#!/bin/sh\necho "$@" > {argv_log}\nexit 0\n')
+    fake_runc.chmod(fake_runc.stat().st_mode | stat.S_IEXEC)
+
+    env = dict(os.environ)
+    env["TPU_CONTAINER_RUNTIME_RUNC"] = str(fake_runc)
+    env["K3STPU_HOST_ROOT"] = str(fake_host_root)
+    out = subprocess.run(
+        [runtime_bin, "--log", "/dev/null", "create", "--bundle", str(bundle),
+         "probe-pod-1"],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0, out.stderr
+    assert argv_log.read_text().split() == [
+        "--log", "/dev/null", "create", "--bundle", str(bundle), "probe-pod-1",
+    ]
+    patched = json.loads((bundle / "config.json").read_text())
+    assert [d["path"] for d in patched["linux"]["devices"]] == ["/dev/accel0"]
+
+
+def test_non_create_passthrough(runtime_bin, tmp_path):
+    """`state`/`delete`/... must not touch any spec, just exec runc."""
+    argv_log = tmp_path / "runc-argv"
+    fake_runc = tmp_path / "fake-runc"
+    fake_runc.write_text(f'#!/bin/sh\necho "$@" > {argv_log}\nexit 3\n')
+    fake_runc.chmod(fake_runc.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["TPU_CONTAINER_RUNTIME_RUNC"] = str(fake_runc)
+    out = subprocess.run(
+        [runtime_bin, "state", "some-container"],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 3  # fake runc's exit code propagates via exec
+    assert argv_log.read_text().split() == ["state", "some-container"]
+
+
+def test_malformed_spec_does_not_block_container(runtime_bin, tmp_path):
+    """A broken config.json must not wedge non-TPU pods: log + exec runc."""
+    bundle = tmp_path / "bundle"
+    bundle.mkdir()
+    (bundle / "config.json").write_text("{not json")
+    fake_runc = tmp_path / "fake-runc"
+    fake_runc.write_text("#!/bin/sh\nexit 0\n")
+    fake_runc.chmod(fake_runc.stat().st_mode | stat.S_IEXEC)
+    env = dict(os.environ)
+    env["TPU_CONTAINER_RUNTIME_RUNC"] = str(fake_runc)
+    out = subprocess.run(
+        [runtime_bin, "create", "--bundle", str(bundle), "c1"],
+        capture_output=True, text=True, env=env,
+    )
+    assert out.returncode == 0
+    assert "patch skipped" in out.stderr

@@ -1,0 +1,45 @@
+"""Node labeling: pure label math + the dry-run CLI surface."""
+
+import json
+import os
+import subprocess
+import sys
+
+from k3stpu.discovery.labeler import labels_for_inventory
+from k3stpu.utils.chips import enumerate_chips
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_labels_for_v5e_pod(fake_host_root):
+    inv = enumerate_chips(root=str(fake_host_root))
+    labels = labels_for_inventory(inv)
+    assert labels == {
+        "google.com/tpu.present": "true",
+        "google.com/tpu.count": "4",
+        "google.com/tpu.generation": "tpu-v5e",
+        "google.com/tpu.topology": "2x2",
+        "feature.node.kubernetes.io/pci-1ae0.present": "true",
+    }
+
+
+def test_labels_no_tpu(tmp_path):
+    labels = labels_for_inventory(enumerate_chips(root=str(tmp_path)))
+    assert labels["google.com/tpu.present"] == "false"
+    assert labels["feature.node.kubernetes.io/pci-1ae0.present"] == "false"
+    # Null values delete stale labels via strategic-merge-patch.
+    assert labels["google.com/tpu.count"] is None
+    assert labels["google.com/tpu.topology"] is None
+
+
+def test_labeler_cli_dry_run(fake_host_root):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.discovery.labeler", "--once",
+         "--dry-run", "--host-root", str(fake_host_root)],
+        capture_output=True, text=True, cwd=REPO, timeout=60, env=env)
+    assert out.returncode == 0, out.stderr
+    line = [l for l in out.stdout.splitlines() if l.startswith("LABELS_JSON")]
+    labels = json.loads(line[0].split(" ", 1)[1])
+    assert labels["google.com/tpu.topology"] == "2x2"

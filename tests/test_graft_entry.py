@@ -1,0 +1,15 @@
+"""Driver contract: entry() traces; dryrun_multichip executes on 8 devices."""
+
+import jax
+
+import __graft_entry__ as ge
+
+
+def test_entry_traces():
+    fn, args = ge.entry()
+    out = jax.eval_shape(fn, *args)
+    assert out.shape == (8, 512, 32768)  # (batch, seq, vocab)
+
+
+def test_dryrun_multichip_8():
+    ge.dryrun_multichip(8)

@@ -1,0 +1,62 @@
+"""Sharded training over the 8-virtual-device CPU mesh: the real pjit path
+(dp gradients + tp kernels), no TPU needed."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.resnet import ResNet, BasicBlock
+from k3stpu.parallel.mesh import make_mesh, mesh_shape_for
+from k3stpu.parallel.train import (
+    make_train_bundle,
+    run_synthetic_steps,
+    synth_image_batch,
+)
+
+
+def test_make_mesh_shape():
+    mesh = make_mesh(8, model_parallelism=2)
+    assert dict(mesh.shape) == {"data": 4, "model": 2}
+    assert mesh_shape_for(16) == (4, 4)
+    assert mesh_shape_for(8) == (4, 2)
+
+
+def test_make_mesh_too_many():
+    with pytest.raises(ValueError):
+        make_mesh(1024)
+
+
+def test_sharded_train_step_runs_and_shards():
+    assert len(jax.devices()) == 8, "conftest must provide 8 CPU devices"
+    mesh = make_mesh(8, model_parallelism=2)
+    model = ResNet(stage_sizes=(1, 1), block=BasicBlock, num_classes=16,
+                   num_filters=16)
+    image_shape = (16, 16, 3)
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, *image_shape), jnp.float32))
+
+    # Parameters with a feature axis must actually be sharded over 'model'.
+    head_kernel = bundle.params["head"]["kernel"]
+    assert len(head_kernel.sharding.device_set) == 8
+    shard_shapes = {s.data.shape for s in head_kernel.addressable_shards}
+    assert shard_shapes == {(head_kernel.shape[0], head_kernel.shape[1] // 2)}
+
+    losses = [
+        run_synthetic_steps(
+            bundle, lambda k: synth_image_batch(k, 8, image_shape, 16))
+        for _ in range(3)
+    ]
+    assert all(np.isfinite(l) for l in losses)
+    # SGD on repeated synthetic batches should not diverge to inf/nan.
+    assert losses[-1] == losses[-1]
+
+
+def test_batch_divisibility_validated():
+    mesh = make_mesh(8, model_parallelism=2)
+    model = ResNet(stage_sizes=(1,), block=BasicBlock, num_classes=4,
+                   num_filters=8)
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, 8, 8, 3), jnp.float32))
+    with pytest.raises(ValueError, match="not divisible"):
+        bundle.run(jnp.zeros((6, 8, 8, 3)), jnp.zeros((6,), jnp.int32))

@@ -1,0 +1,92 @@
+"""Inference server: HTTP surface, batching/padding, error paths."""
+
+import json
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from k3stpu.serve.server import InferenceServer, make_app
+
+
+@pytest.fixture(scope="module")
+def http_server():
+    server = InferenceServer(model_name="resnet18-tiny", num_classes=10,
+                             image_size=32)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def get(url):
+    with urllib.request.urlopen(url, timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def post(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_healthz(http_server):
+    status, body = get(http_server + "/healthz")
+    assert status == 200 and body["ok"]
+    assert body["devices"]
+
+
+def test_model_card(http_server):
+    status, body = get(http_server + "/v1/models")
+    assert status == 200
+    assert body["model"] == "resnet18-tiny"
+    assert body["input_shape"] == [32, 32, 3]
+    assert body["batch_sizes"] == [1, 8, 32]
+
+
+def test_predict_batches_and_pads(http_server):
+    # Batch of 3 -> padded to 8 internally, 3 results back.
+    images = np.random.rand(3, 32, 32, 3).astype(np.float32)
+    status, body = post(http_server + "/v1/predict",
+                        {"inputs": images.tolist()})
+    assert status == 200, body
+    assert len(body["top5"]) == 3
+    assert len(body["top5"][0]) == 5
+    assert body["logits_shape"] == [3, 10]
+
+
+def test_predict_wrong_shape_400(http_server):
+    status, body = post(http_server + "/v1/predict",
+                        {"inputs": [[1.0, 2.0]]})
+    assert status == 400
+    assert "expected input shape" in body["error"]
+
+
+def test_predict_missing_key_400(http_server):
+    status, body = post(http_server + "/v1/predict", {"nope": 1})
+    assert status == 400
+
+
+def test_predict_oversized_batch_400(http_server):
+    images = np.zeros((33, 32, 32, 3), np.float32)
+    status, body = post(http_server + "/v1/predict",
+                        {"inputs": images.tolist()})
+    assert status == 400
+    assert "exceeds max" in body["error"]
+
+
+def test_lm_server_predict():
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16)
+    tokens = np.zeros((2, 16), np.int32)
+    logits = server.predict(tokens)
+    assert logits.shape == (2, 16, 512)
+    card = server.model_card()
+    assert card["stats"]["examples"] == 2

@@ -1,0 +1,74 @@
+"""Transformer LM: shapes, causality, param count, sharded LM training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.transformer import (
+    transformer_lm_small,
+    transformer_lm_tiny,
+)
+from k3stpu.parallel.mesh import make_mesh
+from k3stpu.parallel.train import (
+    make_train_bundle,
+    run_synthetic_steps,
+    synth_token_batch,
+)
+
+
+def test_forward_shape():
+    model = transformer_lm_tiny()
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    logits = model.apply(variables, tokens)
+    assert logits.shape == (2, 16, model.config.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_causality():
+    """Changing a future token must not change past logits."""
+    model = transformer_lm_tiny()
+    rng = jax.random.key(0)
+    tokens = jax.random.randint(rng, (1, 12), 0, model.config.vocab_size)
+    variables = model.init(jax.random.key(1), tokens)
+    base = model.apply(variables, tokens)
+    mutated = tokens.at[0, 8].set((tokens[0, 8] + 1) % model.config.vocab_size)
+    out = model.apply(variables, mutated)
+    np.testing.assert_allclose(base[0, :8], out[0, :8], rtol=2e-3, atol=2e-3)
+    assert not np.allclose(base[0, 8:], out[0, 8:], rtol=1e-3, atol=1e-3)
+
+
+def test_small_param_count():
+    """GPT-2-small scale: 12 layers x 12 heads x 768 with tied embeddings."""
+    model = transformer_lm_small()
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    variables = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), tokens))
+    count = sum(np.prod(x.shape) for x in
+                jax.tree_util.tree_leaves(variables["params"]))
+    # 12 * 12 * d^2 ~ 85M transformer + 25M embed (32768 x 768).
+    assert 100e6 < count < 120e6, count
+
+
+def test_sharded_lm_train_step():
+    import optax
+
+    mesh = make_mesh(8, model_parallelism=2)
+    model = transformer_lm_tiny()
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, 32), jnp.int32),
+        optimizer=optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1))
+
+    qkv = bundle.params["block0"]["attn"]["qkv"]["kernel"]
+    shard_shapes = {s.data.shape for s in qkv.addressable_shards}
+    assert shard_shapes == {(qkv.shape[0], qkv.shape[1] // 2)}
+
+    losses = [
+        run_synthetic_steps(
+            bundle,
+            lambda k: synth_token_batch(k, 8, 32, model.config.vocab_size))
+        for _ in range(3)
+    ]
+    assert all(np.isfinite(l) for l in losses)
+    # Adam on random tokens: loss should move toward uniform ~log(V).
+    assert losses[-1] <= losses[0] + 1.0
