@@ -1,0 +1,45 @@
+#!/bin/sh
+# Per-node install of the tpu-container-runtime OCI shim — the TPU analogue
+# of the reference's nvidia-container-toolkit node step (reference
+# README.md:57-69: add repo, apt-get install, reboot). Here there is no
+# kernel driver to install (Cloud TPU VMs ship VFIO + libtpu — SURVEY.md §1
+# L1), so the whole step is: place the binary, register the containerd
+# handler, restart k3s.
+#
+# Usage: sudo ./install-runtime.sh [path/to/tpu-container-runtime]
+set -eu
+
+BIN="${1:-$(dirname "$0")/../native/build/tpu-container-runtime}"
+K3S_AGENT_DIR=/var/lib/rancher/k3s/agent/etc/containerd
+DEST=/usr/local/bin/tpu-container-runtime
+TMPL_V3="$(dirname "$0")/containerd/config-v3.toml.tmpl"
+TMPL_V2="$(dirname "$0")/containerd/config.toml.tmpl"
+
+[ -x "$BIN" ] || { echo "runtime binary not found: $BIN (build native/ first)" >&2; exit 1; }
+
+install -m 0755 "$BIN" "$DEST"
+echo "installed $DEST"
+
+mkdir -p "$K3S_AGENT_DIR"
+# K3S >= 1.29 reads config-v3.toml.tmpl (containerd v3 config syntax);
+# older K3S reads config.toml.tmpl (containerd 1.x `io.containerd.grpc.v1.cri`
+# syntax). Each name gets the file written in the syntax that K3S
+# generation's containerd understands; K3S only consumes the one it knows.
+install -m 0644 "$TMPL_V3" "$K3S_AGENT_DIR/config-v3.toml.tmpl"
+install -m 0644 "$TMPL_V2" "$K3S_AGENT_DIR/config.toml.tmpl"
+echo "installed containerd template into $K3S_AGENT_DIR"
+
+# Restart whichever K3S unit this node runs (server or agent).
+if command -v systemctl >/dev/null 2>&1; then
+    if systemctl is-active --quiet k3s-agent 2>/dev/null; then
+        systemctl restart k3s-agent
+        echo "restarted k3s-agent"
+    elif systemctl is-active --quiet k3s 2>/dev/null; then
+        systemctl restart k3s
+        echo "restarted k3s"
+    else
+        echo "k3s service not detected — restart it manually to pick up the runtime" >&2
+    fi
+fi
+
+echo "done. verify with: kubectl apply -f deploy/manifests/runtimeclass-tpu.yaml"

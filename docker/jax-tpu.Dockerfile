@@ -1,0 +1,31 @@
+# Workload base image: python + jax[tpu] + the k3stpu package.
+#
+# The TPU analogue of the reference's CUDA base image
+# (nvcr.io/nvidia/cuda:12.5.0-base-ubuntu22.04, reference nvidia-smi.yaml:12)
+# AND of its demo workload image (jellyfin/jellyfin, jellyfin.yaml:26): one
+# image serves the probe pod (`python -m k3stpu.probe`), the inference
+# Deployment (`python -m k3stpu.serve.server`), and the multi-node Job
+# (`python -m k3stpu.parallel.launch`) — the command in the pod spec picks
+# the role.
+#
+# libtpu.so itself is bind-mounted at run time by tpu-container-runtime
+# (RuntimeClass `tpu`), exactly as the reference's runtime injects the CUDA
+# driver libs ("will automatically copy everything needed", reference
+# README.md:164) — so this image stays hardware-agnostic and also runs on
+# CPU (JAX_PLATFORMS=cpu) for CI.
+#
+# Build: docker build -f docker/jax-tpu.Dockerfile -t ghcr.io/k3s-tpu/jax-tpu:latest .
+
+FROM python:3.11-slim
+
+RUN pip install --no-cache-dir \
+    "jax[tpu]" -f https://storage.googleapis.com/jax-releases/libtpu_releases.html \
+    flax optax numpy pyyaml
+
+WORKDIR /app
+COPY k3stpu /app/k3stpu
+ENV PYTHONPATH=/app \
+    PYTHONUNBUFFERED=1
+
+# Default role: the diagnostic probe (override `command:` in the pod spec).
+CMD ["python", "-m", "k3stpu.probe"]

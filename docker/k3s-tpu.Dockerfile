@@ -1,0 +1,32 @@
+# Control-plane image: native C++ binaries + the k3stpu python package.
+#
+# Runs the device-plugin DaemonSet (python launcher exec'ing the C++ gRPC
+# plugin) and the feature-discovery DaemonSet — the TPU equivalents of the
+# nvdp plugin and NFD/GFD images the reference's Helm installs pull
+# (reference README.md:97-126).
+#
+# Build: docker build -f docker/k3s-tpu.Dockerfile -t ghcr.io/k3s-tpu/k3s-tpu:latest .
+
+FROM debian:bookworm-slim AS build
+RUN apt-get update && apt-get install -y --no-install-recommends \
+    g++ cmake ninja-build && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY native /src/native
+RUN cmake -S native -B native/build -G Ninja -DCMAKE_BUILD_TYPE=Release \
+ && cmake --build native/build
+
+FROM python:3.11-slim
+RUN pip install --no-cache-dir pyyaml
+COPY --from=build /src/native/build/tpu-device-plugin \
+                  /src/native/build/tpu-container-runtime \
+                  /usr/local/bin/
+WORKDIR /app
+COPY k3stpu /app/k3stpu
+ENV PYTHONPATH=/app \
+    PYTHONUNBUFFERED=1
+
+# Default role: the device plugin behind its config launcher (the chart's
+# DaemonSet passes the full command; see deploy/charts/k3s-tpu/templates).
+CMD ["python", "-m", "k3stpu.plugin_config", \
+     "--config", "/etc/k3s-tpu/config.yaml", \
+     "--exec", "/usr/local/bin/tpu-device-plugin"]

@@ -1,0 +1,108 @@
+"""Collective micro-benchmarks: psum allreduce bandwidth over the mesh.
+
+BASELINE.json config 5 is "multi-node v5e-16 pjit allreduce over ICI" — this
+is its measurement kernel, and the TPU-native stand-in for the NCCL
+`all_reduce_perf` style tests the reference's GPU stack would use (the
+reference itself never exercises NCCL — SURVEY.md §2d).
+
+TPU-first notes:
+- the allreduce is expressed as ``psum`` inside ``shard_map`` over the mesh,
+  so XLA lowers it straight onto ICI (ring/tree chosen by the compiler);
+- algorithmic bus bandwidth uses the standard ring lower bound
+  ``2·(n-1)/n · bytes / time``, comparable with NCCL's reported busbw;
+- iterations are dependency-chained (each allreduce consumes the previous
+  result) and the clock stops on a device->host scalar pull, same discipline
+  as ops/matmul.py.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclass
+class AllreduceResult:
+    bytes_per_rank: int
+    n_devices: int
+    iters: int
+    seconds: float
+    algo_gbps: float    # bytes / time (per-rank data volume)
+    bus_gbps: float     # ring busbw: 2(n-1)/n * algo
+
+    def to_dict(self) -> dict:
+        return {
+            "bytes_per_rank": self.bytes_per_rank,
+            "n_devices": self.n_devices,
+            "iters": self.iters,
+            "seconds": round(self.seconds, 4),
+            "algo_gbps": round(self.algo_gbps, 2),
+            "bus_gbps": round(self.bus_gbps, 2),
+        }
+
+
+def measure_psum_allreduce(
+    mesh: Mesh,
+    mbytes: float = 64.0,
+    dtype=jnp.bfloat16,
+    iters: int = 20,
+    trials: int = 3,
+) -> AllreduceResult:
+    """Time ``iters`` chained psum allreduces of ~``mbytes`` MiB per rank."""
+    from jax import shard_map
+
+    axes = mesh.axis_names
+    n_dev = int(mesh.devices.size)
+    itemsize = jnp.dtype(dtype).itemsize
+    # Per-rank buffer, padded to a (8, 128)-friendly 2-D shape.
+    elems = max(1024, int(mbytes * 2**20 / itemsize))
+    cols = 4096
+    rows = max(8, elems // cols)
+    nbytes = rows * cols * itemsize
+    scale = 1.0 / n_dev  # keep the chained values finite in bf16
+
+    @jax.jit
+    @partial(shard_map, mesh=mesh, in_specs=P(axes[0]), out_specs=P(axes[0]))
+    def allreduce(x):
+        y = x
+        for ax in axes:
+            y = jax.lax.psum(y, ax)
+        return (y * scale).astype(x.dtype)
+
+    # Shard the leading axis over the first mesh axis so each rank holds
+    # `rows` rows (the per-rank buffer being reduced).
+    sharded = NamedSharding(mesh, P(axes[0]))
+    x = jax.device_put(
+        jax.random.normal(jax.random.key(0), (rows * mesh.shape[axes[0]], cols),
+                          dtype=dtype),
+        sharded,
+    )
+
+    pull = jax.jit(lambda v: jnp.sum(jnp.abs(v.astype(jnp.float32))),
+                   out_shardings=NamedSharding(mesh, P()))
+
+    float(pull(allreduce(x)))  # warm-up (compile)
+
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = x
+        for _ in range(iters):
+            out = allreduce(out)
+        s = float(pull(out))
+        times.append(time.perf_counter() - t0)
+        assert s == s, "allreduce produced NaN"
+    times.sort()
+    elapsed = times[len(times) // 2]
+
+    algo = nbytes * iters / elapsed / 1e9
+    bus = algo * 2 * (n_dev - 1) / n_dev if n_dev > 1 else algo
+    return AllreduceResult(
+        bytes_per_rank=nbytes, n_devices=n_dev, iters=iters,
+        seconds=elapsed, algo_gbps=algo, bus_gbps=bus,
+    )

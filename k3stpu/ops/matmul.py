@@ -105,7 +105,6 @@ def measure_matmul(
     # Warm up both programs end-to-end (compile + relay pipeline).
     float(_abs_sum(step(a, b)))
 
-    best: float | None = None
     times = []
     for _ in range(trials):
         t0 = time.perf_counter()

@@ -1,0 +1,110 @@
+"""Multi-host rendezvous for K3S-scheduled JAX processes.
+
+The reference stack has no distributed backend at all (SURVEY.md §2d — its
+NCCL sits unused inside the CUDA image); the TPU-native design replaces it
+with XLA's built-in ICI/DCN collectives, which only need every process to
+join one coordinator. This module derives that rendezvous from the Kubernetes
+environment an Indexed Job provides (deploy/manifests/tpu-pjit-job.yaml):
+
+- process id     <- JOB_COMPLETION_INDEX (set by kubelet for Indexed Jobs),
+- world size     <- K3STPU_NUM_PROCESSES (templated from Job completions),
+- coordinator    <- `<job>-0.<headless-service>:<port>`, resolvable because
+                    the Job pods share a `subdomain` backed by a headless
+                    Service — the stable-DNS analogue of the reference's only
+                    inter-pod channel, its ClusterIP Service
+                    (jellyfin.yaml:36-42).
+
+Everything is overridable via explicit env (K3STPU_COORDINATOR,
+K3STPU_PROCESS_ID) so the same code runs under bare `srun`-style launchers or
+tests with no cluster.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+DEFAULT_PORT = 8476
+
+
+@dataclass(frozen=True)
+class Rendezvous:
+    """Everything jax.distributed.initialize needs."""
+
+    coordinator_address: str   # host:port of process 0
+    num_processes: int
+    process_id: int
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.num_processes > 1
+
+
+def _job_name_from_hostname(hostname: str) -> tuple[str, int] | None:
+    """Indexed Job pods are named `<job>-<index>`; split that back apart."""
+    base, _, idx = hostname.rpartition("-")
+    if base and idx.isdigit():
+        return base, int(idx)
+    return None
+
+
+def rendezvous_from_env(env: "dict[str, str] | None" = None,
+                        hostname: str | None = None) -> Rendezvous:
+    """Build the rendezvous from the pod environment.
+
+    Precedence: explicit K3STPU_* overrides > Indexed-Job derivation >
+    single-process fallback (num_processes=1, never calls out).
+    """
+    env = dict(os.environ) if env is None else env
+    if hostname is None:
+        hostname = env.get("HOSTNAME", os.uname().nodename)
+
+    num = int(env.get("K3STPU_NUM_PROCESSES", "1"))
+
+    pid_s = env.get("K3STPU_PROCESS_ID", env.get("JOB_COMPLETION_INDEX"))
+    parsed = _job_name_from_hostname(hostname)
+    if pid_s is not None:
+        pid = int(pid_s)
+    elif parsed is not None:
+        pid = parsed[1]
+    else:
+        pid = 0
+
+    coord = env.get("K3STPU_COORDINATOR")
+    if coord is None:
+        port = env.get("K3STPU_COORDINATOR_PORT", str(DEFAULT_PORT))
+        service = env.get("K3STPU_COORDINATOR_SERVICE")
+        if parsed is not None:
+            job = parsed[0]
+            host0 = f"{job}-0.{service}" if service else f"{job}-0"
+            coord = f"{host0}:{port}"
+        else:
+            coord = f"{hostname}:{port}"
+
+    if num <= 1:
+        # Single process: coordinator is self and nothing will dial it.
+        return Rendezvous(coordinator_address=coord, num_processes=1,
+                          process_id=0)
+    return Rendezvous(coordinator_address=coord, num_processes=num,
+                      process_id=pid)
+
+
+def initialize(rdv: Rendezvous | None = None) -> Rendezvous:
+    """Join the JAX process group (no-op for a single process).
+
+    After this returns, jax.devices() is the GLOBAL device list across all
+    Job pods and any jit/pjit over a mesh of those devices emits ICI/DCN
+    collectives — the TPU-native replacement for the NCCL/MPI layer the
+    reference never had (SURVEY.md §2d).
+    """
+    if rdv is None:
+        rdv = rendezvous_from_env()
+    if rdv.is_distributed:
+        import jax
+
+        jax.distributed.initialize(
+            coordinator_address=rdv.coordinator_address,
+            num_processes=rdv.num_processes,
+            process_id=rdv.process_id,
+        )
+    return rdv

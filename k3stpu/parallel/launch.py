@@ -1,0 +1,78 @@
+"""Entry point for the multi-node pjit Job (deploy/manifests/tpu-pjit-job.yaml).
+
+The reference has no multi-node call stack — SURVEY.md §3.5 defines this as
+the one genuinely new entry point: every Indexed-Job pod runs this module,
+joins the JAX process group (k3stpu/parallel/distributed.py), and then runs
+the BASELINE.json config-5 measurements over the GLOBAL mesh:
+
+1. pjit bf16 matmul, TFLOP/s per chip vs the >=50%-MFU north star, and
+2. psum allreduce bus bandwidth over ICI (intra-slice) / DCN (cross-slice).
+
+Each measurement is one JSON log line (pod logs are the observability
+interface, exactly like the reference's `kubectl logs` oracle,
+reference README.md:134-156).
+
+Run: python -m k3stpu.parallel.launch [--m 8192] [--iters 30] [--mbytes 64]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="K3S-TPU multi-node pjit job")
+    ap.add_argument("--m", type=int, default=None,
+                    help="matmul dim (default 8192 on TPU, 512 on CPU)")
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--mbytes", type=float, default=None,
+                    help="allreduce MiB per rank (default 64 TPU, 1 CPU)")
+    ap.add_argument("--skip-matmul", action="store_true")
+    ap.add_argument("--skip-allreduce", action="store_true")
+    args = ap.parse_args(argv)
+
+    from k3stpu.parallel.distributed import initialize
+
+    rdv = initialize()
+
+    import jax
+
+    from k3stpu.ops.collectives import measure_psum_allreduce
+    from k3stpu.ops.matmul import measure_pjit_matmul
+    from k3stpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    dim = args.m or (8192 if on_accel else 512)
+    iters = args.iters or (30 if on_accel else 3)
+    mbytes = args.mbytes or (64.0 if on_accel else 1.0)
+
+    print(json.dumps({
+        "event": "rendezvous",
+        "process_id": rdv.process_id,
+        "num_processes": rdv.num_processes,
+        "coordinator": rdv.coordinator_address,
+        "local_devices": len(jax.local_devices()),
+        "global_devices": len(devices),
+    }), flush=True)
+
+    mesh = make_mesh(len(devices), model_parallelism=1,
+                     axis_names=("data", "model"))
+
+    if not args.skip_matmul:
+        res = measure_pjit_matmul(mesh, m=dim, n=dim, k=dim, iters=iters)
+        print(json.dumps({"event": "pjit_matmul", **res.to_dict(),
+                          "n_devices": len(devices)}), flush=True)
+
+    if not args.skip_allreduce:
+        res = measure_psum_allreduce(mesh, mbytes=mbytes)
+        print(json.dumps({"event": "psum_allreduce", **res.to_dict()}),
+              flush=True)
+
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

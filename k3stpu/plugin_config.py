@@ -1,0 +1,105 @@
+"""Device-plugin config loader: v1 config schema -> tpu-device-plugin argv.
+
+The reference's device plugin consumes an embedded config file with a
+`version: v1` schema (reference values.yaml:6-18: flags.migStrategy +
+sharing.timeSlicing.resources[].replicas). Our chart mounts the same-shaped
+config (deploy/charts/k3s-tpu/values.yaml `config:`) as a ConfigMap, and this
+module translates it into flags for the native binary
+(native/tpu-device-plugin) — keeping the C++ daemon free of YAML parsing.
+
+Run (DaemonSet command):
+  python -m k3stpu.plugin_config --config /etc/k3s-tpu/config.yaml \
+      --exec /usr/local/bin/tpu-device-plugin [-- extra flags...]
+
+With --dry-run it prints the argv instead of exec'ing (tests).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+RESOURCE_DEFAULT = "google.com/tpu"
+
+
+def parse_config(text: str) -> dict:
+    """Parse the v1 config into normalized plugin settings.
+
+    Unknown versions and malformed sharing sections fail loudly — a typo'd
+    sharing policy silently defaulting to exclusive chips would be the worst
+    failure mode (pods pending forever on a \"full\" node).
+    """
+    import yaml
+
+    doc = yaml.safe_load(text) or {}
+    version = str(doc.get("version", "v1"))
+    if version != "v1":
+        raise ValueError(f"unsupported config version: {version}")
+
+    flags = doc.get("flags") or {}
+    granularity = flags.get("granularity", "chip")
+    if granularity not in ("chip",):
+        raise ValueError(f"unsupported granularity: {granularity}")
+
+    out = {
+        "resource": RESOURCE_DEFAULT,
+        "replicas": 1,
+        "fail_multi": False,
+        "granularity": granularity,
+    }
+
+    sharing = doc.get("sharing") or {}
+    ts = sharing.get("timeSlicing") or {}
+    resources = ts.get("resources") or []
+    if len(resources) > 1:
+        raise ValueError("at most one timeSlicing resource is supported")
+    if resources:
+        r = resources[0]
+        out["resource"] = r.get("name", RESOURCE_DEFAULT)
+        replicas = int(r.get("replicas", 1))
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        out["replicas"] = replicas
+    if ts.get("renameByDefault"):
+        # Parity knob (reference values.yaml:14) — shared replicas keep the
+        # original resource name; renaming would break every workload
+        # manifest, so reject rather than half-support.
+        raise ValueError("renameByDefault: true is not supported")
+    if ts.get("failRequestsGreaterThanOne"):
+        out["fail_multi"] = True
+    return out
+
+
+def argv_for(settings: dict, binary: str, extra: "list[str] | None" = None) -> list[str]:
+    argv = [
+        binary,
+        "--resource", settings["resource"],
+        "--replicas", str(settings["replicas"]),
+    ]
+    if settings["fail_multi"]:
+        argv.append("--fail-multi")
+    argv.extend(extra or [])
+    return argv
+
+
+def main(args: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="k3s-tpu plugin config launcher")
+    ap.add_argument("--config", required=True)
+    ap.add_argument("--exec", dest="binary", required=True)
+    ap.add_argument("--dry-run", action="store_true")
+    ap.add_argument("extra", nargs="*",
+                    help="extra flags passed through to the binary")
+    ns = ap.parse_args(args)
+
+    with open(ns.config) as f:
+        settings = parse_config(f.read())
+    argv = argv_for(settings, ns.binary, ns.extra)
+    if ns.dry_run:
+        print(" ".join(argv))
+        return 0
+    os.execv(ns.binary, argv)  # never returns
+
+
+if __name__ == "__main__":
+    sys.exit(main())

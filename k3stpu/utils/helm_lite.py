@@ -1,0 +1,159 @@
+"""helm-lite: render the k3s-tpu chart without Helm.
+
+The reference assumes Helm is present ("Helm is like apt-get but for
+Kubernetes", reference README.md:107) and installs everything through it
+(README.md:101-116). K3S hosts often have no helm binary, so this module
+renders the chart's Go-template *subset* to plain manifests that `kubectl
+apply -f -` accepts — and doubles as the test harness for the chart (no helm
+in CI either).
+
+Supported template constructs (all the chart uses, nothing more):
+- ``{{ .Values.a.b }}``, ``{{ .Release.Namespace }}``, ``{{ .Release.Name }}``,
+  ``{{ .Chart.Name }}``
+- pipelines ``| toYaml``, ``| indent N``, ``| quote``
+- ``{{- if <ref> }} ... {{- end }}`` (nested; truthy = present and not
+  false/empty)
+- whitespace chomping ``{{-`` / ``-}}``
+
+Run: python -m k3stpu.utils.helm_lite CHART_DIR [--set a.b=c ...] \
+         [--namespace NS] | kubectl apply -f -
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from pathlib import Path
+
+import yaml
+
+_TAG = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+
+def _lookup(ctx: dict, dotted: str):
+    """Resolve `.Values.a.b` against the context; None if missing."""
+    cur: object = ctx
+    for part in dotted.lstrip(".").split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur
+
+
+def _apply_pipeline(value, pipes: "list[str]"):
+    for pipe in pipes:
+        parts = pipe.split()
+        name, args = parts[0], parts[1:]
+        if name == "toYaml":
+            value = yaml.safe_dump(value, default_flow_style=False,
+                                   sort_keys=False).rstrip("\n")
+        elif name == "indent":
+            pad = " " * int(args[0])
+            value = "\n".join(pad + line for line in str(value).splitlines())
+        elif name == "quote":
+            value = '"' + str(value).replace('"', '\\"') + '"'
+        else:
+            raise ValueError(f"unsupported pipeline function: {name}")
+    return value
+
+
+def _truthy(v) -> bool:
+    return bool(v) and v is not None
+
+
+def render_template(text: str, ctx: dict) -> str:
+    """Render one template file to text."""
+    # Normalise chomping: `{{- ` eats preceding whitespace/newline, ` -}}`
+    # eats following. We implement the common case: a line containing only a
+    # chomped control tag disappears entirely.
+    out: list[str] = []
+    stack: list[bool] = []  # emission state per nested if
+
+    def emitting() -> bool:
+        return all(stack)
+
+    for line in text.splitlines():
+        stripped = line.strip()
+        m = _TAG.fullmatch(stripped) if stripped.startswith("{{") else None
+        if m:
+            expr = m.group(1)
+            if expr.startswith("if "):
+                ref = expr[3:].strip()
+                stack.append(_truthy(_lookup(ctx, ref)))
+                continue
+            if expr == "end":
+                if not stack:
+                    raise ValueError("unbalanced {{ end }}")
+                stack.pop()
+                continue
+            # A full-line value tag (e.g. the toYaml block) — falls through.
+        if not emitting():
+            continue
+
+        def sub(match: "re.Match[str]") -> str:
+            expr = match.group(1)
+            pipes = [p.strip() for p in expr.split("|")]
+            ref, pipeline = pipes[0], pipes[1:]
+            if not ref.startswith("."):
+                raise ValueError(f"unsupported template expr: {expr}")
+            value = _lookup(ctx, ref)
+            if value is None:
+                raise ValueError(f"undefined reference: {ref}")
+            value = _apply_pipeline(value, pipeline)
+            if isinstance(value, bool):
+                return "true" if value else "false"
+            return str(value)
+
+        out.append(_TAG.sub(sub, line))
+    if stack:
+        raise ValueError("unclosed {{ if }}")
+    return "\n".join(out) + "\n"
+
+
+def _deep_set(d: dict, dotted: str, value: str) -> None:
+    keys = dotted.split(".")
+    for k in keys[:-1]:
+        d = d.setdefault(k, {})
+    # YAML-parse scalars so --set x=4 / x=true give int/bool like helm.
+    d[keys[-1]] = yaml.safe_load(value)
+
+
+def render_chart(chart_dir: "str | Path", namespace: str = "tpu-system",
+                 release: str = "k3s-tpu",
+                 overrides: "dict[str, str] | None" = None) -> str:
+    """Render every template in the chart; returns one multi-doc YAML."""
+    chart_dir = Path(chart_dir)
+    chart = yaml.safe_load((chart_dir / "Chart.yaml").read_text())
+    values = yaml.safe_load((chart_dir / "values.yaml").read_text())
+    for dotted, v in (overrides or {}).items():
+        _deep_set(values, dotted, v)
+    ctx = {
+        "Values": values,
+        "Release": {"Namespace": namespace, "Name": release},
+        "Chart": {"Name": chart["name"]},
+    }
+    rendered = []
+    for path in sorted((chart_dir / "templates").glob("*.yaml")):
+        text = render_template(path.read_text(), ctx)
+        if any(yaml.safe_load_all(text)):  # skip fully-disabled templates
+            rendered.append(f"---\n# Source: {path.name}\n{text}")
+    return "".join(rendered)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="render the k3s-tpu chart (no helm)")
+    ap.add_argument("chart_dir")
+    ap.add_argument("--namespace", default="tpu-system")
+    ap.add_argument("--release", default="k3s-tpu")
+    ap.add_argument("--set", dest="sets", action="append", default=[],
+                    metavar="a.b=v")
+    args = ap.parse_args(argv)
+    overrides = dict(s.split("=", 1) for s in args.sets)
+    sys.stdout.write(render_chart(args.chart_dir, args.namespace,
+                                  args.release, overrides))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

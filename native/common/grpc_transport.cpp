@@ -58,7 +58,10 @@ bool read_exact(int fd, void* buf, size_t n) {
 bool write_all(int fd, const void* buf, size_t n) {
   const auto* p = static_cast<const char*>(buf);
   while (n > 0) {
-    ssize_t r = ::write(fd, p, n);
+    // MSG_NOSIGNAL: a peer that closed (kubelet restart) must surface as an
+    // error return, not a process-killing SIGPIPE — the re-register loop in
+    // the plugin depends on surviving exactly this.
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
     if (r <= 0) return false;
     p += r;
     n -= static_cast<size_t>(r);

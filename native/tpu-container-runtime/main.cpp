@@ -50,7 +50,10 @@ RuntimeConfig load_config() {
       if (cfg.runc_path.empty())
         if (auto p = root->get("runc_path")) cfg.runc_path = p->as_string();
       if (auto a = root->get("always")) cfg.always = a->bool_v;
-    } catch (const k3stpu::json::ParseError& e) {
+    } catch (const std::exception& e) {
+      // std::exception, not just ParseError: number conversion can throw
+      // std::out_of_range (e.g. 1e999), and a bad config file must never
+      // wedge every tpu-class container on the node.
       std::cerr << "tpu-container-runtime: bad " << kConfigPath << ": "
                 << e.what() << "\n";
     }

@@ -104,6 +104,9 @@ int main(int argc, char** argv) {
 
   std::signal(SIGINT, on_signal);
   std::signal(SIGTERM, on_signal);
+  // Kubelet restarts close our sockets mid-write; that must surface as a
+  // send() error (re-register path), never a fatal SIGPIPE.
+  std::signal(SIGPIPE, SIG_IGN);
 
   // Outer loop = kubelet-restart recovery: when kubelet restarts it wipes
   // /var/lib/kubelet/device-plugins/ (taking our socket with it) and expects

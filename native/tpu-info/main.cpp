@@ -1,0 +1,92 @@
+// tpu-info: the nvidia-smi of this stack (SURVEY.md §2b #6).
+//
+// The reference's first verification step is running `nvidia-smi` on the
+// host and reading a device table (reference README.md:71-93); tpu-info is
+// that table for TPU hosts — chip inventory from sysfs/devfs, no libtpu or
+// python needed, so it also works inside minimal containers and initramfs.
+// `--json` emits machine-readable output (what the probe pod parses);
+// default is the human table.
+//
+// Exit code: 0 when at least one chip is visible, 1 when none (script-able
+// the way `nvidia-smi` exit codes are), 2 on usage error.
+
+#include <cstring>
+#include <iostream>
+#include <string>
+
+#include "../common/chips.hpp"
+#include "../common/json.hpp"
+
+namespace {
+
+void usage() {
+  std::cerr << "tpu-info [--json] [--host-root DIR]\n"
+               "  TPU chip inventory from the host PCI/dev tree.\n";
+}
+
+int run(const std::string& root, bool as_json) {
+  auto chips = k3stpu::enumerate_chips(root);
+  auto libtpu = k3stpu::find_libtpu(root);
+
+  if (as_json) {
+    using k3stpu::json::Value;
+    auto doc = Value::make_object();
+    doc->set("chip_count", Value::make_int(static_cast<int64_t>(chips.size())));
+    doc->set("topology", Value::make_string(k3stpu::topology_for(chips.size())));
+    doc->set("libtpu", Value::make_string(libtpu));
+    auto arr = doc->ensure_array("chips");
+    for (const auto& c : chips) {
+      auto o = Value::make_object();
+      o->set("index", Value::make_int(c.index));
+      o->set("pci", Value::make_string(c.pci_address));
+      o->set("device_id", Value::make_string(c.device_id));
+      o->set("generation", Value::make_string(c.generation));
+      o->set("numa", Value::make_int(c.numa_node));
+      auto devs = o->ensure_array("dev_paths");
+      for (const auto& d : c.dev_paths)
+        devs->arr_v.push_back(Value::make_string(d));
+      arr->arr_v.push_back(o);
+    }
+    std::cout << k3stpu::json::dump(doc) << "\n";
+  } else {
+    std::cout << "+-----------------------------------------------------------+\n";
+    std::cout << "| tpu-info            chips: " << chips.size()
+              << "   topology: " << k3stpu::topology_for(chips.size()) << "\n";
+    std::cout << "| libtpu: " << (libtpu.empty() ? "(not found)" : libtpu) << "\n";
+    std::cout << "+-----+---------------+------------+------+-----------------+\n";
+    std::cout << "| IDX | PCI           | GENERATION | NUMA | DEV             |\n";
+    std::cout << "+-----+---------------+------------+------+-----------------+\n";
+    for (const auto& c : chips) {
+      std::string devs;
+      for (const auto& d : c.dev_paths) devs += (devs.empty() ? "" : ",") + d;
+      char line[160];
+      std::snprintf(line, sizeof(line), "| %3d | %-13s | %-10s | %4d | %-15s |",
+                    c.index, c.pci_address.c_str(), c.generation.c_str(),
+                    c.numa_node, devs.c_str());
+      std::cout << line << "\n";
+    }
+    std::cout << "+-----+---------------+------------+------+-----------------+\n";
+  }
+  return chips.empty() ? 1 : 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  std::string root;
+  bool as_json = false;
+  for (int i = 1; i < argc; ++i) {
+    if (!std::strcmp(argv[i], "--json")) {
+      as_json = true;
+    } else if (!std::strcmp(argv[i], "--host-root") && i + 1 < argc) {
+      root = argv[++i];
+    } else if (!std::strcmp(argv[i], "--help") || !std::strcmp(argv[i], "-h")) {
+      usage();
+      return 0;
+    } else {
+      usage();
+      return 2;
+    }
+  }
+  return run(root, as_json);
+}

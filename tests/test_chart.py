@@ -1,0 +1,113 @@
+"""Helm chart: render with helm_lite and verify the control-plane objects.
+
+Parity targets: the nvdp chart + NFD install the reference drives at
+README.md:97-126, with the values schema of reference values.yaml:1-18.
+"""
+
+import os
+
+import pytest
+import yaml
+
+from k3stpu.plugin_config import argv_for, parse_config
+from k3stpu.utils.helm_lite import render_chart
+
+CHART = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deploy", "charts", "k3s-tpu",
+)
+
+
+def render(overrides=None, namespace="tpu-system"):
+    text = render_chart(CHART, namespace=namespace, overrides=overrides)
+    docs = [d for d in yaml.safe_load_all(text) if d]
+    return {(d["kind"], d["metadata"]["name"]): d for d in docs}
+
+
+def test_default_render_objects():
+    objs = render()
+    kinds = {k for k, _ in objs}
+    assert kinds == {"RuntimeClass", "ConfigMap", "DaemonSet",
+                     "ServiceAccount", "ClusterRole", "ClusterRoleBinding"}
+    assert ("DaemonSet", "k3s-tpu-device-plugin") in objs
+    assert ("DaemonSet", "k3s-tpu-feature-discovery") in objs
+
+
+def test_runtimeclass_and_namespace():
+    objs = render(namespace="custom-ns")
+    rc = objs[("RuntimeClass", "tpu")]
+    assert rc["handler"] == "tpu"
+    cm = objs[("ConfigMap", "k3s-tpu-config")]
+    assert cm["metadata"]["namespace"] == "custom-ns"
+
+
+def test_config_roundtrip_to_plugin_flags():
+    # The ConfigMap payload must parse back through plugin_config into the
+    # flags the C++ binary takes — 4-way sharing by default (reference
+    # values.yaml:18).
+    objs = render()
+    cm = objs[("ConfigMap", "k3s-tpu-config")]
+    settings = parse_config(cm["data"]["config.yaml"])
+    assert settings["resource"] == "google.com/tpu"
+    assert settings["replicas"] == 4
+    assert settings["fail_multi"] is False
+    argv = argv_for(settings, "/usr/local/bin/tpu-device-plugin")
+    assert argv == ["/usr/local/bin/tpu-device-plugin",
+                    "--resource", "google.com/tpu", "--replicas", "4"]
+
+
+def test_device_plugin_daemonset_wiring():
+    objs = render()
+    ds = objs[("DaemonSet", "k3s-tpu-device-plugin")]
+    pod = ds["spec"]["template"]["spec"]
+    # Label-gated like the reference's NFD-dependent plugin (README.md:99).
+    assert pod["nodeSelector"] == {"google.com/tpu.present": "true"}
+    (ctr,) = pod["containers"]
+    cmd = ctr["command"]
+    assert "k3stpu.plugin_config" in cmd
+    assert "/usr/local/bin/tpu-device-plugin" in cmd
+    mounts = {m["name"]: m for m in ctr["volumeMounts"]}
+    assert mounts["device-plugins"]["mountPath"] == "/var/lib/kubelet/device-plugins"
+    assert mounts["host-sys"]["readOnly"] and mounts["host-dev"]["readOnly"]
+    vols = {v["name"]: v for v in ds["spec"]["template"]["spec"]["volumes"]}
+    assert vols["config"]["configMap"]["name"] == "k3s-tpu-config"
+
+
+def test_tfd_disable_and_rbac():
+    # tfd.enabled mirrors gfd.enabled (reference values.yaml:1-2).
+    objs = render(overrides={"tfd.enabled": "false"})
+    assert ("DaemonSet", "k3s-tpu-feature-discovery") not in objs
+    assert all(k != "ClusterRole" for k, _ in objs)
+
+    objs = render()
+    tfd = objs[("DaemonSet", "k3s-tpu-feature-discovery")]
+    pod = tfd["spec"]["template"]["spec"]
+    assert pod["serviceAccountName"] == "k3s-tpu-feature-discovery"
+    role = objs[("ClusterRole", "k3s-tpu-feature-discovery")]
+    (rule,) = role["rules"]
+    assert set(rule["verbs"]) == {"get", "patch"}
+    assert rule["resources"] == ["nodes"]
+    (ctr,) = pod["containers"]
+    env = {e["name"] for e in ctr["env"]}
+    assert "NODE_NAME" in env
+
+
+def test_replicas_override():
+    objs = render(overrides={
+        "config.sharing.timeSlicing.resources": '[{"name": "google.com/tpu", "replicas": 2}]',
+    })
+    cm = objs[("ConfigMap", "k3s-tpu-config")]
+    assert parse_config(cm["data"]["config.yaml"])["replicas"] == 2
+
+
+def test_bad_configs_fail_loudly():
+    with pytest.raises(ValueError, match="version"):
+        parse_config("version: v2\n")
+    with pytest.raises(ValueError, match="replicas"):
+        parse_config(
+            "version: v1\nsharing:\n  timeSlicing:\n    resources:\n"
+            "      - name: google.com/tpu\n        replicas: 0\n")
+    with pytest.raises(ValueError, match="renameByDefault"):
+        parse_config(
+            "version: v1\nsharing:\n  timeSlicing:\n    renameByDefault: true\n"
+            "    resources:\n      - name: google.com/tpu\n        replicas: 2\n")

@@ -1,0 +1,79 @@
+"""Rendezvous derivation + collective measurement on the virtual CPU mesh."""
+
+import json
+
+import jax
+import pytest
+
+from k3stpu.parallel.distributed import Rendezvous, rendezvous_from_env
+from k3stpu.parallel.mesh import make_mesh
+
+
+def test_indexed_job_derivation():
+    # Exactly the env an Indexed Job pod sees (tpu-pjit-job.yaml).
+    rdv = rendezvous_from_env(
+        env={
+            "K3STPU_NUM_PROCESSES": "2",
+            "K3STPU_COORDINATOR_SERVICE": "tpu-pjit",
+            "K3STPU_COORDINATOR_PORT": "8476",
+            "JOB_COMPLETION_INDEX": "1",
+        },
+        hostname="tpu-pjit-1",
+    )
+    assert rdv == Rendezvous("tpu-pjit-0.tpu-pjit:8476", 2, 1)
+    assert rdv.is_distributed
+
+
+def test_hostname_fallback_without_index_env():
+    rdv = rendezvous_from_env(
+        env={"K3STPU_NUM_PROCESSES": "4",
+             "K3STPU_COORDINATOR_SERVICE": "tpu-pjit"},
+        hostname="tpu-pjit-3",
+    )
+    assert rdv.process_id == 3
+    assert rdv.coordinator_address == "tpu-pjit-0.tpu-pjit:8476"
+
+
+def test_explicit_overrides_win():
+    rdv = rendezvous_from_env(
+        env={
+            "K3STPU_NUM_PROCESSES": "8",
+            "K3STPU_PROCESS_ID": "5",
+            "K3STPU_COORDINATOR": "coord.example:9999",
+            "JOB_COMPLETION_INDEX": "1",
+        },
+        hostname="whatever-1",
+    )
+    assert rdv == Rendezvous("coord.example:9999", 8, 5)
+
+
+def test_single_process_fallback():
+    rdv = rendezvous_from_env(env={}, hostname="laptop")
+    assert rdv.num_processes == 1
+    assert rdv.process_id == 0
+    assert not rdv.is_distributed
+
+
+def test_psum_allreduce_measurement():
+    from k3stpu.ops.collectives import measure_psum_allreduce
+
+    mesh = make_mesh(8, model_parallelism=2)
+    res = measure_psum_allreduce(mesh, mbytes=0.5, iters=2, trials=1)
+    assert res.n_devices == 8
+    assert res.algo_gbps > 0
+    assert res.bus_gbps == pytest.approx(res.algo_gbps * 2 * 7 / 8)
+
+
+def test_launch_main_single_process(capsys, monkeypatch):
+    # The Job entry point end-to-end on the virtual mesh (1 process).
+    monkeypatch.delenv("K3STPU_NUM_PROCESSES", raising=False)
+    from k3stpu.parallel import launch
+
+    rc = launch.main(["--m", "256", "--iters", "2", "--mbytes", "0.25"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    events = {l["event"]: l for l in lines}
+    assert events["rendezvous"]["num_processes"] == 1
+    assert events["rendezvous"]["global_devices"] == len(jax.devices())
+    assert events["pjit_matmul"]["seconds"] > 0
+    assert events["psum_allreduce"]["bus_gbps"] > 0

@@ -1,0 +1,104 @@
+"""Workload manifests: structural parity with the reference's YAML surface.
+
+The reference ships three manifests (nvidia-smi.yaml, jellyfin.yaml, plus the
+Helm values); ours must carry the same load-bearing fields with the TPU
+resource/runtime names (SURVEY.md §2a #2-#4, §3.3-§3.5).
+"""
+
+import glob
+import os
+
+import yaml
+
+MANIFEST_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "deploy", "manifests",
+)
+
+
+def load_all(name):
+    with open(os.path.join(MANIFEST_DIR, name)) as f:
+        return [d for d in yaml.safe_load_all(f) if d]
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d.get("kind") == kind]
+
+
+def test_all_manifests_parse():
+    files = glob.glob(os.path.join(MANIFEST_DIR, "*.yaml"))
+    assert files, "no manifests found"
+    for path in files:
+        with open(path) as f:
+            docs = [d for d in yaml.safe_load_all(f) if d]
+        assert docs, f"{path} contains no documents"
+        for doc in docs:
+            assert "kind" in doc and "apiVersion" in doc, path
+
+
+def test_runtimeclass():
+    (rc,) = load_all("runtimeclass-tpu.yaml")
+    assert rc["kind"] == "RuntimeClass"
+    assert rc["metadata"]["name"] == "tpu"
+    assert rc["handler"] == "tpu"
+
+
+def test_probe_pod_parity():
+    # Parity with reference nvidia-smi.yaml:1-16.
+    (pod,) = load_all("tpu-probe.yaml")
+    assert pod["kind"] == "Pod"
+    spec = pod["spec"]
+    assert spec["runtimeClassName"] == "tpu"           # nvidia-smi.yaml:8
+    assert spec["restartPolicy"] == "Never"            # nvidia-smi.yaml:9
+    (ctr,) = spec["containers"]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "1"  # :14-16
+    assert ctr["command"][0] == "python"
+    assert "k3stpu.probe" in ctr["command"]
+
+
+def test_inference_deployment_parity():
+    # Parity with reference jellyfin.yaml:1-43.
+    docs = load_all("tpu-inference.yaml")
+    (dep,) = by_kind(docs, "Deployment")
+    spec = dep["spec"]
+    assert spec["replicas"] == 1                        # jellyfin.yaml:10
+    assert spec["progressDeadlineSeconds"] == 600       # jellyfin.yaml:11
+    assert spec["revisionHistoryLimit"] == 0            # jellyfin.yaml:12
+    assert spec["strategy"]["type"] == "Recreate"       # jellyfin.yaml:13-14
+    pod = spec["template"]["spec"]
+    assert pod["runtimeClassName"] == "tpu"             # jellyfin.yaml:23
+    (ctr,) = pod["containers"]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "1"  # :27-29
+
+    (svc,) = by_kind(docs, "Service")
+    (port,) = svc["spec"]["ports"]
+    assert port["port"] == 8096                         # jellyfin.yaml:40-42
+    assert svc["spec"]["selector"] == {"app": "tpu-inference"}
+    assert spec["selector"]["matchLabels"] == {"app": "tpu-inference"}
+
+
+def test_pjit_job_rendezvous_wiring():
+    # SURVEY.md §3.5: indexed pods + headless Service rendezvous.
+    docs = load_all("tpu-pjit-job.yaml")
+    (svc,) = by_kind(docs, "Service")
+    assert svc["spec"]["clusterIP"] == "None"           # headless
+    svc_name = svc["metadata"]["name"]
+
+    (job,) = by_kind(docs, "Job")
+    spec = job["spec"]
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == spec["parallelism"]
+    pod = spec["template"]["spec"]
+    assert pod["subdomain"] == svc_name                 # stable per-pod DNS
+    assert pod["runtimeClassName"] == "tpu"
+    assert svc["spec"]["selector"] == spec["template"]["metadata"]["labels"]
+
+    (ctr,) = pod["containers"]
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["K3STPU_NUM_PROCESSES"] == str(spec["completions"])
+    assert env["K3STPU_COORDINATOR_SERVICE"] == svc_name
+    (svc_port,) = svc["spec"]["ports"]
+    assert env["K3STPU_COORDINATOR_PORT"] == str(svc_port["port"])
+    assert "k3stpu.parallel.launch" in ctr["command"]
+    # Multi-chip pod (values.yaml:15 analogue): whole host's chips.
+    assert int(ctr["resources"]["limits"]["google.com/tpu"]) >= 1

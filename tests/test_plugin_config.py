@@ -1,0 +1,65 @@
+"""plugin_config: v1 config schema -> native binary flags (chart launcher)."""
+
+import subprocess
+import sys
+
+import pytest
+
+from k3stpu.plugin_config import argv_for, parse_config
+
+V1 = """\
+version: v1
+flags:
+  granularity: chip
+sharing:
+  timeSlicing:
+    renameByDefault: false
+    failRequestsGreaterThanOne: false
+    resources:
+      - name: google.com/tpu
+        replicas: 4
+"""
+
+
+def test_parse_default_schema():
+    s = parse_config(V1)
+    assert s == {"resource": "google.com/tpu", "replicas": 4,
+                 "fail_multi": False, "granularity": "chip"}
+
+
+def test_empty_config_is_exclusive():
+    s = parse_config("version: v1\n")
+    assert s["replicas"] == 1
+    assert argv_for(s, "bin") == ["bin", "--resource", "google.com/tpu",
+                                  "--replicas", "1"]
+
+
+def test_fail_requests_greater_than_one():
+    s = parse_config(V1.replace("failRequestsGreaterThanOne: false",
+                                "failRequestsGreaterThanOne: true"))
+    assert s["fail_multi"] is True
+    assert "--fail-multi" in argv_for(s, "bin")
+
+
+def test_extra_flags_pass_through():
+    s = parse_config(V1)
+    argv = argv_for(s, "bin", ["--plugin-dir", "/tmp/dp"])
+    assert argv[-2:] == ["--plugin-dir", "/tmp/dp"]
+
+
+def test_cli_dry_run(tmp_path):
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(V1)
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.plugin_config", "--config", str(cfg),
+         "--exec", "/usr/local/bin/tpu-device-plugin", "--dry-run",
+         "--", "--scan-seconds", "30"],
+        capture_output=True, text=True, check=True)
+    assert out.stdout.split() == [
+        "/usr/local/bin/tpu-device-plugin", "--resource", "google.com/tpu",
+        "--replicas", "4", "--scan-seconds", "30"]
+
+
+def test_unknown_granularity_rejected():
+    with pytest.raises(ValueError, match="granularity"):
+        parse_config("version: v1\nflags:\n  granularity: tensorcore\n")

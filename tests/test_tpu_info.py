@@ -1,0 +1,54 @@
+"""tpu-info CLI: the nvidia-smi parity tool against the fake host tree."""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = os.path.join(REPO, "native", "build")
+BIN = os.path.join(BUILD_DIR, "tpu-info")
+
+
+@pytest.fixture(scope="session")
+def info_bin():
+    subprocess.run(["cmake", "-S", os.path.join(REPO, "native"), "-B",
+                    BUILD_DIR], check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", BUILD_DIR, "--target", "tpu-info"],
+                   check=True, capture_output=True)
+    return BIN
+
+
+def test_json_inventory(info_bin, fake_host_root):
+    out = subprocess.run(
+        [info_bin, "--json", "--host-root", str(fake_host_root)],
+        capture_output=True, text=True)
+    assert out.returncode == 0
+    doc = json.loads(out.stdout)
+    assert doc["chip_count"] == 4
+    assert doc["topology"] == "2x2"
+    assert doc["libtpu"] == "/usr/lib/libtpu.so"
+    gens = {c["generation"] for c in doc["chips"]}
+    assert gens == {"tpu-v5e"}
+    assert doc["chips"][0]["dev_paths"] == ["/dev/accel0"]
+
+
+def test_human_table(info_bin, fake_host_root):
+    out = subprocess.run([info_bin, "--host-root", str(fake_host_root)],
+                         capture_output=True, text=True)
+    assert out.returncode == 0
+    assert "chips: 4" in out.stdout
+    assert "tpu-v5e" in out.stdout
+    assert "/dev/accel0" in out.stdout
+
+
+def test_exit_code_no_chips(info_bin, tmp_path):
+    out = subprocess.run([info_bin, "--host-root", str(tmp_path)],
+                         capture_output=True, text=True)
+    assert out.returncode == 1  # nvidia-smi-style: nonzero when no devices
+
+
+def test_usage_error(info_bin):
+    out = subprocess.run([info_bin, "--bogus"], capture_output=True, text=True)
+    assert out.returncode == 2
