@@ -36,6 +36,26 @@ class TransformerConfig:
     d_ff: int = 3072
     max_seq_len: int = 2048
     dtype: Any = jnp.bfloat16
+    # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
+    # (ops/attention.py) only on a single-device TPU process: the Mosaic
+    # custom call has no GSPMD partitioning rule, so under a multi-device
+    # mesh the einsum path (which XLA partitions itself) is the safe and
+    # fast choice until attention is wired through shard_map/ring
+    # (parallel/context.py). "flash" forces the kernel anywhere — on
+    # non-TPU backends it runs in the Pallas interpreter (slow; tests).
+    attn_impl: str = "auto"
+
+
+_ATTN_IMPLS = ("auto", "einsum", "flash")
+
+
+def _resolve_attn_impl(impl: str) -> str:
+    if impl not in _ATTN_IMPLS:
+        raise ValueError(f"attn_impl={impl!r} not in {_ATTN_IMPLS}")
+    if impl != "auto":
+        return impl
+    on_tpu = jax.default_backend() == "tpu"
+    return "flash" if on_tpu and jax.device_count() == 1 else "einsum"
 
 
 def rope_frequencies(head_dim: int, max_seq_len: int) -> np.ndarray:
@@ -75,13 +95,27 @@ class Attention(nn.Module):
         k = apply_rope(k, angles)
 
         scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32) * scale
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        logits = jnp.where(mask[None, None], logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+        from k3stpu.ops.attention import DEFAULT_BLOCK, flash_attention
 
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        # Flash wants MXU-tileable shapes. "auto" is conservative — only
+        # multiple-of-block sequences (init passes s=8, which must take the
+        # einsum path). An explicit "flash" is honored for anything the
+        # kernel accepts: s <= block (clamped) or a multiple of it.
+        resolved = _resolve_attn_impl(cfg.attn_impl)
+        if cfg.attn_impl == "flash":
+            use_flash = s <= DEFAULT_BLOCK or s % DEFAULT_BLOCK == 0
+        else:
+            use_flash = resolved == "flash" and s % DEFAULT_BLOCK == 0
+        if use_flash:
+            out = flash_attention(q, k, v, causal=True, scale=scale,
+                                  interpret=jax.default_backend() != "tpu")
+        else:
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                preferred_element_type=jnp.float32) * scale
+            mask = jnp.tril(jnp.ones((s, s), bool))
+            logits = jnp.where(mask[None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(b, s, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="proj")(out)

@@ -1,0 +1,218 @@
+"""Blocked flash attention as a Pallas TPU kernel.
+
+The reference stack has no attention anywhere (SURVEY.md §2c — it schedules
+devices, not models); this is the TPU-native hot-op for the transformer LM
+workload the K3S-TPU stack serves. Design follows the classic online-softmax
+formulation mapped onto the TPU memory hierarchy:
+
+- grid ``(batch*heads, q_blocks, k_blocks)``; the k dimension is the
+  innermost ("arbitrary") axis so the fp32 accumulators for one q block live
+  in VMEM scratch across the whole k sweep — O(S) HBM traffic instead of the
+  O(S^2) logits matrix a naive softmax writes.
+- both matmuls (q@k^T and p@v) run on the MXU with fp32 accumulation
+  (``preferred_element_type``); everything streamed from HBM is bf16.
+- running max/denominator are kept in (block_q, 128) fp32 scratch — the
+  128-lane replication keeps the VPU happy (last dim must be 128).
+- causal masking is done per tile with ``broadcasted_iota``; k tiles fully
+  above the diagonal skip their compute entirely via ``pl.when`` (the DMA
+  still runs — block specs are static — but the MXU work is saved).
+
+The backward pass recomputes attention with a plain einsum (a standard
+rematerialization trade: the O(S^2) logits exist only inside the backward
+computation). Sequence lengths long enough for that to matter shard S over
+the mesh via ring attention (parallel/context.py), which makes the per-shard
+S small again.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+_LANES = 128  # TPU lane width: trailing dim of any VMEM tile
+
+# Default q/k tile edge; callers gating on shape divisibility (e.g. the
+# transformer's Attention) should test against this, not a literal.
+DEFAULT_BLOCK = 256
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # A k tile is live unless it sits entirely above the causal diagonal.
+    live = True
+    if causal:
+        live = ki * block_k <= qi * block_q + block_q - 1
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]                      # (block_q, d) bf16
+        k = k_ref[0]                      # (block_k, d) bf16
+        v = v_ref[0]                      # (block_k, d) bf16
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale                          # (block_q, block_k) fp32
+
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            cols = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]                             # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                   # (block_q, 1)
+        p = jnp.exp(s - m_new)                            # (block_q, block_k)
+
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        # Fully-masked q rows (can't happen causally, but guard anyway)
+        # would have l == 0; emit zeros instead of inf.
+        l = l_ref[:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
+                   vmem_limit_bytes=32 * 1024 * 1024):
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
+            f"({block_q}, {block_k})")
+
+    grid = (bh, s_q // block_q, s_kv // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k)
+
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
+            pltpu.VMEM((block_q, d), jnp.float32),        # output accum
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * s_q * s_kv * d,
+            bytes_accessed=2 * bh * (s_q + 2 * s_kv) * d,
+            transcendentals=bh * s_q * s_kv,
+        ),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _reference_attention(q, k, v, *, scale, causal):
+    """Einsum attention with fp32 softmax — the oracle and the bwd remat."""
+    s_q, s_kv = q.shape[1], k.shape[1]
+    logits = jnp.einsum("bqd,bkd->bqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((s_q, s_kv), bool), k=s_kv - s_q)
+        logits = jnp.where(mask[None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", probs, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+    return _flash_forward(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret)
+
+
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+    out = _flash(q, k, v, scale, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda q, k, v: _reference_attention(q, k, v, scale=scale,
+                                             causal=causal), q, k, v)
+    return vjp(g)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over ``(B, S, H, D)`` tensors (transformer layout).
+
+    Heads fold into the grid's batch dimension; each (batch, head) pair sweeps
+    its k/v tiles through VMEM against a resident q tile. Differentiable via
+    einsum rematerialization. ``interpret=True`` runs the kernel in the Pallas
+    interpreter (CPU CI — SURVEY.md §4's "CPU-JAX stand-in" test tier).
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out = _flash(fold(q), fold(k), fold(v), scale, causal,
+                 block_q, block_k, interpret)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+
+
+def reference_attention(q, k, v, *, causal: bool = True,
+                        scale: float | None = None) -> jax.Array:
+    """(B, S, H, D) einsum attention — the correctness oracle for tests."""
+    b, s_q, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out = _reference_attention(fold(q), fold(k), fold(v),
+                               scale=scale, causal=causal)
+    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)

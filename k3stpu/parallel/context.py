@@ -1,0 +1,149 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Long-context is first-class in the K3S-TPU stack: a sequence too long for one
+chip's HBM is sharded over a 'seq' mesh axis, and attention runs as a ring —
+each device keeps its Q shard resident while K/V shards rotate around the
+axis via ``jax.lax.ppermute`` (XLA lowers the rotation onto ICI neighbor
+links, overlapping it with the local attention compute). Softmax is combined
+across steps with the same online (max, denom, accumulator) recurrence flash
+attention uses within a chip, so the result is exact — not an approximation.
+
+The reference stack has no sequence dimension anywhere (SURVEY.md §5
+"long-context: absent"); this is the TPU-native extension that makes the
+north-star workloads scale past one chip's memory. No custom transport:
+the only communication primitive is ``ppermute`` (SURVEY.md §2d — XLA
+collectives replace NCCL).
+
+Layout convention matches ops/attention.py: ``(batch, seq, heads, head_dim)``,
+with the global sequence split contiguously over the axis — shard i holds
+positions ``[i * S_local, (i+1) * S_local)``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _local_attention_update(q, k, v, m, l, acc, *, scale, q_offset, kv_offset,
+                            causal):
+    """One online-softmax update of (m, l, acc) with a visiting K/V shard.
+
+    q: (B, Sq, H, D); k, v: (B, Skv, H, D); m, l: (B, Sq, H, 1) fp32;
+    acc: (B, Sq, H, D) fp32. Offsets are the shards' global positions, used
+    for causal masking.
+    """
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+
+    if causal:
+        rows = q_offset + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 2)
+        cols = kv_offset + jax.lax.broadcasted_iota(
+            jnp.int32, logits.shape, 3)
+        logits = jnp.where(rows >= cols, logits, _NEG_INF)
+
+    # (B, H, Sq, 1) -> (B, Sq, H, 1) to match the carry layout.
+    block_max = jnp.max(logits, axis=-1, keepdims=True).transpose(0, 2, 1, 3)
+    m_new = jnp.maximum(m, block_max)
+    # exp(_NEG_INF - m_new) underflows to 0, so fully-masked rows contribute
+    # nothing and fully-masked shards are a (cheap) no-op.
+    p = jnp.exp(logits - m_new.transpose(0, 2, 1, 3))        # (B, H, Sq, Skv)
+    alpha = jnp.exp(m - m_new)                               # (B, Sq, H, 1)
+
+    l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True).transpose(0, 2, 1, 3)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
+    return m_new, l_new, acc * alpha + pv
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+) -> jax.Array:
+    """Exact attention over sequence shards; call inside ``shard_map``.
+
+    Arguments are the *local* shards ``(B, S_local, H, D)``. Runs
+    ``axis_size`` steps: attend to the currently-held K/V shard, then pass it
+    to the next device on the ring. Returns the local output shard.
+    """
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # pvary: the accumulators start as compile-time constants (replicated in
+    # shard_map's replication-typing) but become device-varying inside the
+    # loop; the carry types must agree up front.
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    m = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
+    l = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
+    acc = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+
+    def step(t, carry):
+        k_t, v_t, m, l, acc = carry
+        # Shard held at step t originated on rank (my_idx - t) mod n.
+        src = jax.lax.rem(my_idx - t + n, n)
+        m, l, acc = _local_attention_update(
+            q, k_t, v_t, m, l, acc, scale=scale,
+            q_offset=my_idx * s_local, kv_offset=src * s_local, causal=causal)
+        # Rotate K/V to the next rank (a no-op result on the last step would
+        # be nice to skip, but a static loop keeps XLA's schedule simple and
+        # lets it overlap the permute with the next step's einsum).
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        return k_t, v_t, m, l, acc
+
+    _, _, m, l, acc = jax.lax.fori_loop(0, n, step, (k, v, m, l, acc))
+    denom = jnp.where(l == 0.0, 1.0, l)
+    return (acc / denom).astype(q.dtype)
+
+
+def make_context_mesh(n_devices: int | None = None,
+                      devices: list | None = None) -> Mesh:
+    """1-D ('seq',) mesh: every device is a sequence shard on the ring."""
+    import numpy as np
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    return Mesh(np.array(devices[:n_devices]), ("seq",))
+
+
+def context_parallel_attention(
+    mesh: Mesh,
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = "seq",
+    causal: bool = True,
+    scale: float | None = None,
+):
+    """Jit-ready global-array entry: shards (B, S, H, D) inputs over
+    ``axis_name`` and runs :func:`ring_attention` under ``shard_map``."""
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    sharded = jax.jit(
+        shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                  out_specs=spec))
+    q = jax.device_put(q, NamedSharding(mesh, spec))
+    k = jax.device_put(k, NamedSharding(mesh, spec))
+    v = jax.device_put(v, NamedSharding(mesh, spec))
+    return sharded(q, k, v)

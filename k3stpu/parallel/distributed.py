@@ -78,6 +78,14 @@ def rendezvous_from_env(env: "dict[str, str] | None" = None,
             job = parsed[0]
             host0 = f"{job}-0.{service}" if service else f"{job}-0"
             coord = f"{host0}:{port}"
+        elif num > 1 and pid != 0:
+            # A non-zero rank whose hostname isn't Indexed-Job-shaped has no
+            # way to find rank 0 — its own hostname would be wrong and
+            # jax.distributed.initialize would hang for minutes. Fail fast.
+            raise ValueError(
+                f"distributed run (K3STPU_NUM_PROCESSES={num}, process_id="
+                f"{pid}) but no coordinator is derivable from hostname "
+                f"{hostname!r}; set K3STPU_COORDINATOR=host:port")
         else:
             coord = f"{hostname}:{port}"
 

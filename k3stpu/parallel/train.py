@@ -88,7 +88,17 @@ def make_train_bundle(
     batch_stats, stats_sh = shard_params(batch_stats, mesh)
     # tx.init runs on the already-sharded params, so optimizer buffers
     # inherit the parameter shardings; the step leaves opt_state free.
-    opt_state = tx.init(params)
+    # Scalar leaves (e.g. adam's count) come out UNcommitted — pin them to a
+    # replicated mesh sharding so checkpoint restore (which always commits)
+    # round-trips to the same placement.
+    from jax.sharding import NamedSharding
+
+    repl_sh = replicated(mesh)
+    opt_state = jax.tree.map(
+        lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, repl_sh),
+        tx.init(params),
+    )
 
     data_sh = batch_sharding(mesh)
     repl = replicated(mesh)

@@ -1,0 +1,112 @@
+"""Resumable multi-node LM training Job (the checkpoint/resume workload).
+
+The reference's only long-running workload restarts from scratch when its pod
+dies (SURVEY.md §5: no checkpointing, no volume). This entry point is the
+TPU-native upgrade: an Indexed-Job pod that joins the process group
+(distributed.py), builds a (data, model) mesh over the global devices, trains
+the transformer LM with the sharded train step (train.py), checkpoints every
+``--ckpt-every`` steps (utils/checkpoint.py), and **resumes from the latest
+checkpoint on boot** — so K8s-native self-healing (Deployment/Job restart)
+becomes elastic recovery instead of a restart.
+
+Observability stays log-based like the reference (`kubectl logs` — reference
+README.md:134-156): one JSON line per step with loss and tokens/s.
+
+Run: python -m k3stpu.parallel.train_job --steps 100 --ckpt-dir /ckpt
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="K3S-TPU resumable train job")
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="checkpoint directory (volume mount); omit to disable")
+    ap.add_argument("--ckpt-every", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=None,
+                    help="global batch (default 8 per data-shard)")
+    ap.add_argument("--seq", type=int, default=None)
+    ap.add_argument("--model", choices=["tiny", "small"], default=None,
+                    help="default: small on TPU, tiny on CPU")
+    ap.add_argument("--model-parallelism", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from k3stpu.parallel.distributed import initialize
+
+    rdv = initialize()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from k3stpu.models.transformer import (
+        transformer_lm_small,
+        transformer_lm_tiny,
+    )
+    from k3stpu.parallel.mesh import make_mesh
+    from k3stpu.parallel.train import make_train_bundle, synth_token_batch
+    from k3stpu.utils import checkpoint as ckpt
+
+    devices = jax.devices()
+    on_accel = devices[0].platform != "cpu"
+    model_name = args.model or ("small" if on_accel else "tiny")
+    seq = args.seq or (512 if model_name == "small" else 64)
+    model = (transformer_lm_small(max_seq_len=max(seq, 512))
+             if model_name == "small" else transformer_lm_tiny())
+    mesh = make_mesh(len(devices), model_parallelism=args.model_parallelism)
+    batch = args.batch or 8 * mesh.shape["data"]
+    vocab = model.config.vocab_size
+
+    print(json.dumps({
+        "event": "train_start", "model": model_name, "seq": seq,
+        "batch": batch, "mesh": dict(mesh.shape),
+        "process_id": rdv.process_id, "num_processes": rdv.num_processes,
+    }), flush=True)
+
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, seq), jnp.int32),
+        optimizer=optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+    )
+
+    start_step = 0
+    if args.ckpt_dir:
+        last = ckpt.latest_step(args.ckpt_dir)
+        if last is not None:
+            ckpt.restore_bundle(args.ckpt_dir, last, bundle)
+            start_step = last
+            print(json.dumps({"event": "resume", "step": last}), flush=True)
+
+    rng = jax.random.key(1234 + start_step)
+    tokens_per_step = batch * seq
+    for step in range(start_step, args.steps):
+        rng, k = jax.random.split(rng)
+        inputs, labels = synth_token_batch(k, batch, seq, vocab)
+        t0 = time.perf_counter()
+        loss = bundle.run(inputs, labels)
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "event": "step", "step": step + 1, "loss": round(loss, 4),
+            "tokens_per_s": round(tokens_per_step / dt, 1),
+        }), flush=True)
+        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+            ckpt.save_bundle(args.ckpt_dir, step + 1, bundle)
+            print(json.dumps({"event": "checkpoint", "step": step + 1}),
+                  flush=True)
+
+    # Final save, unless the loop's periodic save already covered this step.
+    if (args.ckpt_dir and args.steps > start_step
+            and args.steps % args.ckpt_every != 0):
+        ckpt.save_bundle(args.ckpt_dir, args.steps, bundle)
+        print(json.dumps({"event": "checkpoint", "step": args.steps}),
+              flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

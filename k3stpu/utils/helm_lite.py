@@ -10,7 +10,8 @@ in CI either).
 Supported template constructs (all the chart uses, nothing more):
 - ``{{ .Values.a.b }}``, ``{{ .Release.Namespace }}``, ``{{ .Release.Name }}``,
   ``{{ .Chart.Name }}``
-- pipelines ``| toYaml``, ``| indent N``, ``| quote``
+- pipelines ``| toYaml``, ``| indent N``, ``| nindent N``, ``| quote``;
+  function-call form ``toYaml .Ref | nindent N``
 - ``{{- if <ref> }} ... {{- end }}`` (nested; truthy = present and not
   false/empty)
 - whitespace chomping ``{{-`` / ``-}}``
@@ -51,6 +52,10 @@ def _apply_pipeline(value, pipes: "list[str]"):
         elif name == "indent":
             pad = " " * int(args[0])
             value = "\n".join(pad + line for line in str(value).splitlines())
+        elif name == "nindent":
+            pad = " " * int(args[0])
+            value = "\n" + "\n".join(
+                pad + line for line in str(value).splitlines())
         elif name == "quote":
             value = '"' + str(value).replace('"', '\\"') + '"'
         else:
@@ -60,6 +65,26 @@ def _apply_pipeline(value, pipes: "list[str]"):
 
 def _truthy(v) -> bool:
     return bool(v) and v is not None
+
+
+def _eval_expr(expr: str, ctx: dict):
+    """Evaluate `.Ref | pipe ...` or the function-call form `func .Ref | ...`."""
+    pipes = [p.strip() for p in expr.split("|")]
+    head, pipeline = pipes[0], pipes[1:]
+    tokens = head.split()
+    if len(tokens) == 2 and tokens[0] in ("toYaml", "quote"):
+        ref = tokens[1]
+        pipeline = [tokens[0], *pipeline]
+    elif len(tokens) == 1:
+        ref = tokens[0]
+    else:
+        raise ValueError(f"unsupported template expr: {expr}")
+    if not ref.startswith("."):
+        raise ValueError(f"unsupported template expr: {expr}")
+    value = _lookup(ctx, ref)
+    if value is None:
+        raise ValueError(f"undefined reference: {ref}")
+    return _apply_pipeline(value, pipeline)
 
 
 def render_template(text: str, ctx: dict) -> str:
@@ -87,20 +112,19 @@ def render_template(text: str, ctx: dict) -> str:
                     raise ValueError("unbalanced {{ end }}")
                 stack.pop()
                 continue
-            # A full-line value tag (e.g. the toYaml block) — falls through.
+            if emitting():
+                # Full-line value tag (toYaml/nindent blocks): the rendered
+                # value replaces the whole line — `{{-` chomped the line's
+                # own indentation, nindent supplies the real one.
+                value = _eval_expr(expr, ctx)
+                s = str(value)
+                out.append(s[1:] if s.startswith("\n") else s)
+            continue
         if not emitting():
             continue
 
         def sub(match: "re.Match[str]") -> str:
-            expr = match.group(1)
-            pipes = [p.strip() for p in expr.split("|")]
-            ref, pipeline = pipes[0], pipes[1:]
-            if not ref.startswith("."):
-                raise ValueError(f"unsupported template expr: {expr}")
-            value = _lookup(ctx, ref)
-            if value is None:
-                raise ValueError(f"undefined reference: {ref}")
-            value = _apply_pipeline(value, pipeline)
+            value = _eval_expr(match.group(1), ctx)
             if isinstance(value, bool):
                 return "true" if value else "false"
             return str(value)

@@ -1,0 +1,74 @@
+"""Flash-attention kernel vs the einsum oracle (Pallas interpret mode on CPU
+— SURVEY.md §4's no-hardware test tier)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.ops.attention import flash_attention, reference_attention
+
+
+def _qkv(b=2, s=256, h=4, d=64, dtype=jnp.float32, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    shape = (b, s, h, d)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_matches_reference(causal):
+    q, k, v = _qkv()
+    out = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_multiple_k_blocks_per_q_block():
+    # block_q != block_k exercises the diagonal-crossing tiles.
+    q, k, v = _qkv(s=512)
+    out = flash_attention(q, k, v, block_q=256, block_k=64, interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_bf16_tolerance():
+    q, k, v = _qkv(dtype=jnp.bfloat16, seed=1)
+    out = flash_attention(q, k, v, interpret=True)
+    ref = reference_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        atol=3e-2, rtol=3e-2)
+
+
+def test_blocks_larger_than_seq_are_clamped():
+    q, k, v = _qkv(s=128)
+    out = flash_attention(q, k, v, block_q=512, block_k=512, interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_indivisible_seq_raises():
+    q, k, v = _qkv(s=192)
+    with pytest.raises(ValueError, match="divide"):
+        flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
+
+
+def test_gradients_match_reference():
+    q, k, v = _qkv(b=1, s=128, h=2, d=32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)

@@ -1,0 +1,63 @@
+"""Checkpoint/resume round trips (orbax, sharded state on the 8-device CPU
+mesh): save a trained bundle, restore into a fresh one, losses must agree."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.parallel.mesh import make_mesh
+from k3stpu.parallel.train import (
+    make_train_bundle,
+    run_synthetic_steps,
+    synth_token_batch,
+)
+from k3stpu.utils.checkpoint import (
+    latest_step,
+    restore_bundle,
+    restore_train_state,
+    save_bundle,
+    save_train_state,
+)
+
+
+def test_roundtrip_pytree(tmp_path):
+    state = {"w": jnp.arange(12.0).reshape(3, 4), "step": jnp.int32(7)}
+    save_train_state(tmp_path, 3, state)
+    out = restore_train_state(tmp_path, 3, state)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.asarray(state["w"]))
+    assert int(out["step"]) == 7
+
+
+def test_latest_step(tmp_path):
+    assert latest_step(tmp_path / "missing") is None
+    state = {"x": jnp.ones((2,))}
+    save_train_state(tmp_path, 1, state)
+    save_train_state(tmp_path, 10, state)
+    assert latest_step(tmp_path) == 10
+
+
+def test_bundle_resume_preserves_training(tmp_path):
+    mesh = make_mesh(8, model_parallelism=2)
+    model = transformer_lm_tiny()
+    seq, vocab = 32, model.config.vocab_size
+    mk = lambda k: synth_token_batch(k, 8, seq, vocab)
+
+    bundle = make_train_bundle(model, mesh,
+                               example_input=jnp.zeros((1, seq), jnp.int32))
+    run_synthetic_steps(bundle, mk, n_steps=2)
+    save_bundle(tmp_path, 2, bundle)
+
+    # Fresh bundle (different init path state), restore, then the next step
+    # must match a continuation of the original exactly.
+    resumed = make_train_bundle(model, mesh,
+                                example_input=jnp.zeros((1, seq), jnp.int32))
+    restore_bundle(tmp_path, 2, resumed)
+
+    loss_cont = run_synthetic_steps(bundle, mk, n_steps=1, seed=9)
+    loss_resumed = run_synthetic_steps(resumed, mk, n_steps=1, seed=9)
+    assert abs(loss_cont - loss_resumed) < 1e-6
+
+    # Restored arrays keep their mesh shardings (no silent host gather).
+    leaf = jax.tree.leaves(resumed.params)[0]
+    assert leaf.sharding.mesh.shape == mesh.shape

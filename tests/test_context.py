@@ -1,0 +1,87 @@
+"""Ring attention (context parallelism) vs full attention, on the 8-device
+virtual CPU mesh (conftest) — exactness check for the online-softmax ring."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.ops.attention import reference_attention
+from k3stpu.parallel.context import (
+    context_parallel_attention,
+    make_context_mesh,
+    ring_attention,
+)
+
+
+def _qkv(b=2, s=256, h=4, d=32, seed=0, dtype=jnp.float32):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    return tuple(jax.random.normal(k, (b, s, h, d), dtype) for k in ks)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_matches_full(causal):
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv()
+    out = context_parallel_attention(mesh, q, k, v, causal=causal)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_on_subset_of_devices():
+    mesh = make_context_mesh(4)
+    q, k, v = _qkv(s=128, seed=3)
+    out = context_parallel_attention(mesh, q, k, v)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_ring_bf16():
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv(seed=1, dtype=jnp.bfloat16)
+    out = context_parallel_attention(mesh, q, k, v)
+    ref = reference_attention(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_ring_output_stays_sharded():
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv()
+    out = context_parallel_attention(mesh, q, k, v)
+    # The output must remain sequence-sharded (no hidden all-gather).
+    ns = out.sharding
+    assert ns.spec == jax.sharding.PartitionSpec(None, "seq", None, None)
+
+
+def test_ring_attention_differentiable():
+    """Gradients flow through ppermute + fori_loop (training viability)."""
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = make_context_mesh(4)
+    q, k, v = _qkv(b=1, s=64, h=2, d=16, seed=5)
+    spec = P(None, "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    ring = shard_map(partial(ring_attention, axis_name="seq"),
+                     mesh=mesh, in_specs=(spec,) * 3, out_specs=spec)
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v) ** 2)
+
+    g_ring = jax.jit(jax.grad(loss_ring, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   atol=1e-4, rtol=1e-4)

@@ -1,0 +1,44 @@
+"""train_job entry point: runs steps, checkpoints, and resumes (CPU mesh)."""
+
+import json
+
+from k3stpu.parallel import train_job
+
+
+def _run(capsys, argv):
+    rc = train_job.main(argv)
+    assert rc == 0
+    return [json.loads(line) for line in
+            capsys.readouterr().out.strip().splitlines()]
+
+
+def test_train_then_resume(tmp_path, capsys):
+    ckpt = str(tmp_path / "ckpt")
+    base = ["--model", "tiny", "--steps", "4", "--ckpt-dir", ckpt,
+            "--ckpt-every", "2", "--batch", "8", "--seq", "32"]
+
+    events = _run(capsys, base)
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "train_start"
+    assert kinds.count("step") == 4
+    assert "checkpoint" in kinds
+    losses = [e["loss"] for e in events if e["event"] == "step"]
+    assert losses[-1] < losses[0]  # it actually optimizes
+
+    # Second invocation resumes at step 4 and only runs the remaining 2.
+    events = _run(capsys, ["--model", "tiny", "--steps", "6",
+                           "--ckpt-dir", ckpt, "--ckpt-every", "2",
+                           "--batch", "8", "--seq", "32"])
+    kinds = [e["event"] for e in events]
+    assert {"event": "resume", "step": 4} in events
+    assert kinds.count("step") == 2
+    steps = [e["step"] for e in events if e["event"] == "step"]
+    assert steps == [5, 6]
+
+
+def test_train_without_ckpt_dir(capsys):
+    events = _run(capsys, ["--model", "tiny", "--steps", "2",
+                           "--batch", "8", "--seq", "32"])
+    kinds = [e["event"] for e in events]
+    assert kinds.count("step") == 2
+    assert "checkpoint" not in kinds
