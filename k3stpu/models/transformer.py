@@ -66,7 +66,11 @@ def rope_frequencies(head_dim: int, max_seq_len: int) -> np.ndarray:
 
 
 def apply_rope(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
-    """x: (B, S, H, D). Rotates pairs of channels by position-dependent angles."""
+    """x: (B, S, H, D). Rotates pairs of channels by position-dependent angles.
+
+    ``angles`` must already be the (S, D//2) slice for these positions —
+    callers at a dynamic offset (decode) slice with ``lax.dynamic_slice``.
+    """
     seq = x.shape[1]
     cos = jnp.cos(angles[:seq])[None, :, None, :].astype(x.dtype)
     sin = jnp.sin(angles[:seq])[None, :, None, :].astype(x.dtype)
@@ -75,10 +79,23 @@ def apply_rope(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
 
 
 class Attention(nn.Module):
+    """Causal self-attention with an optional KV cache.
+
+    ``mode``:
+    - "full": training/eval forward, no cache (flash or einsum).
+    - "prefill": full causal attention over the prompt AND write K/V into
+      the cache (positions [0, s)), setting the cache index to s.
+    - "decode": one-token step (s == 1) at position ``index``; K/V append
+      to the cache and attention runs against the cached max_seq_len
+      window with a position mask. TPU-first: the cache is a static-shape
+      (B, max_seq_len, H, D) buffer updated with ``dynamic_update_slice``,
+      so the whole decode step is one fixed XLA program for lax.scan.
+    """
+
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, mode: str = "full"):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
@@ -91,31 +108,69 @@ class Attention(nn.Module):
         v = v.reshape(b, s, cfg.n_heads, head_dim)
 
         angles = jnp.asarray(rope_frequencies(head_dim, cfg.max_seq_len))
-        q = apply_rope(q, angles)
-        k = apply_rope(k, angles)
-
         scale = 1.0 / np.sqrt(head_dim)
-        from k3stpu.ops.attention import DEFAULT_BLOCK, flash_attention
 
-        # Flash wants MXU-tileable shapes. "auto" is conservative — only
-        # multiple-of-block sequences (init passes s=8, which must take the
-        # einsum path). An explicit "flash" is honored for anything the
-        # kernel accepts: s <= block (clamped) or a multiple of it.
-        resolved = _resolve_attn_impl(cfg.attn_impl)
-        if cfg.attn_impl == "flash":
-            use_flash = s <= DEFAULT_BLOCK or s % DEFAULT_BLOCK == 0
-        else:
-            use_flash = resolved == "flash" and s % DEFAULT_BLOCK == 0
-        if use_flash:
-            out = flash_attention(q, k, v, causal=True, scale=scale,
-                                  interpret=jax.default_backend() != "tpu")
-        else:
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+        if mode in ("prefill", "decode"):
+            cache_k = self.variable(
+                "cache", "key", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.n_heads, head_dim), cfg.dtype)
+            cache_v = self.variable(
+                "cache", "value", jnp.zeros,
+                (b, cfg.max_seq_len, cfg.n_heads, head_dim), cfg.dtype)
+            cache_idx = self.variable(
+                "cache", "index", lambda: jnp.zeros((), jnp.int32))
+
+        if mode == "decode":
+            if s != 1:
+                raise ValueError(f"decode mode is one token at a time, got s={s}")
+            idx = cache_idx.value
+            pos_angles = jax.lax.dynamic_slice_in_dim(angles, idx, 1, axis=0)
+            q = apply_rope(q, pos_angles)
+            k = apply_rope(k, pos_angles)
+            ck = jax.lax.dynamic_update_slice(
+                cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+            cv = jax.lax.dynamic_update_slice(
+                cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+            cache_k.value, cache_v.value = ck, cv
+            cache_idx.value = idx + 1
+
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                 preferred_element_type=jnp.float32) * scale
-            mask = jnp.tril(jnp.ones((s, s), bool))
-            logits = jnp.where(mask[None, None], logits, -1e30)
+            visible = jnp.arange(cfg.max_seq_len) <= idx
+            logits = jnp.where(visible[None, None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+        else:
+            q = apply_rope(q, angles)
+            k = apply_rope(k, angles)
+            if mode == "prefill":
+                cache_k.value = jax.lax.dynamic_update_slice(
+                    cache_k.value, k.astype(cfg.dtype), (0, 0, 0, 0))
+                cache_v.value = jax.lax.dynamic_update_slice(
+                    cache_v.value, v.astype(cfg.dtype), (0, 0, 0, 0))
+                cache_idx.value = jnp.int32(s)
+
+            from k3stpu.ops.attention import DEFAULT_BLOCK, flash_attention
+
+            # Flash wants MXU-tileable shapes. "auto" is conservative — only
+            # multiple-of-block sequences (init passes s=8, which must take
+            # the einsum path). An explicit "flash" is honored for anything
+            # the kernel accepts: s <= block (clamped) or a multiple of it.
+            resolved = _resolve_attn_impl(cfg.attn_impl)
+            if cfg.attn_impl == "flash":
+                use_flash = s <= DEFAULT_BLOCK or s % DEFAULT_BLOCK == 0
+            else:
+                use_flash = resolved == "flash" and s % DEFAULT_BLOCK == 0
+            if use_flash:
+                out = flash_attention(q, k, v, causal=True, scale=scale,
+                                      interpret=jax.default_backend() != "tpu")
+            else:
+                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                                    preferred_element_type=jnp.float32) * scale
+                mask = jnp.tril(jnp.ones((s, s), bool))
+                logits = jnp.where(mask[None, None], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(b, s, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="proj")(out)
@@ -125,11 +180,11 @@ class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, mode: str = "full"):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
-        x = x + Attention(cfg, name="attn")(h)
+        x = x + Attention(cfg, name="attn")(h, mode=mode)
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
@@ -144,7 +199,7 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False, mode: str = "full"):
         del train  # no dropout: inference-first; training uses weight decay
         cfg = self.config
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
@@ -152,7 +207,7 @@ class TransformerLM(nn.Module):
                          name="embed")
         x = embed(tokens)
         for i in range(cfg.n_layers):
-            x = Block(cfg, name=f"block{i}")(x)
+            x = Block(cfg, name=f"block{i}")(x, mode=mode)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # Weight-tied head; logits cast to fp32 for a stable softmax/loss.

@@ -123,6 +123,20 @@ def make_context_mesh(n_devices: int | None = None,
     return Mesh(np.array(devices[:n_devices]), ("seq",))
 
 
+@functools.lru_cache(maxsize=32)
+def _ring_program(mesh: Mesh, axis_name: str, causal: bool,
+                  scale: "float | None"):
+    """Jitted shard_map ring program, cached so repeated calls with the
+    same (mesh, axis, causal, scale) hit the XLA compile cache."""
+    from jax import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, scale=scale)
+    return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec))
+
+
 def context_parallel_attention(
     mesh: Mesh,
     q: jax.Array,
@@ -135,15 +149,9 @@ def context_parallel_attention(
 ):
     """Jit-ready global-array entry: shards (B, S, H, D) inputs over
     ``axis_name`` and runs :func:`ring_attention` under ``shard_map``."""
-    from jax import shard_map
-
-    spec = P(None, axis_name, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, scale=scale)
-    sharded = jax.jit(
-        shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
-                  out_specs=spec))
-    q = jax.device_put(q, NamedSharding(mesh, spec))
-    k = jax.device_put(k, NamedSharding(mesh, spec))
-    v = jax.device_put(v, NamedSharding(mesh, spec))
+    sharded = _ring_program(mesh, axis_name, causal, scale)
+    sh = NamedSharding(mesh, P(None, axis_name, None, None))
+    q = jax.device_put(q, sh)
+    k = jax.device_put(k, sh)
+    v = jax.device_put(v, sh)
     return sharded(q, k, v)

@@ -93,15 +93,13 @@ def make_train_bundle(
     # round-trips to the same placement.
     from jax.sharding import NamedSharding
 
-    repl_sh = replicated(mesh)
-    opt_state = jax.tree.map(
-        lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
-        else jax.device_put(x, repl_sh),
-        tx.init(params),
-    )
-
     data_sh = batch_sharding(mesh)
     repl = replicated(mesh)
+    opt_state = jax.tree.map(
+        lambda x: x if isinstance(getattr(x, "sharding", None), NamedSharding)
+        else jax.device_put(x, repl),
+        tx.init(params),
+    )
 
     def apply_loss(p, stats, inputs, labels):
         variables = {"params": p}

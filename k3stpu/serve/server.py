@@ -17,6 +17,10 @@ Endpoints:
   GET  /healthz         -> {"ok": true, "devices": [...]}   (readiness)
   GET  /v1/models       -> model card
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
+  POST /v1/generate     -> {"prompt_tokens": [[...]], "max_new_tokens": N,
+                            "temperature": t, "top_k": k, "eos_id": e}
+                        -> {"tokens": [[...]]}  (transformer models only;
+                           KV-cache prefill + lax.scan decode)
 
 Run: python -m k3stpu.serve.server --model resnet50 --port 8096
 (8096 mirrors the reference Service port, jellyfin.yaml:40-42.)
@@ -89,16 +93,22 @@ class InferenceServer:
     def input_dtype(self):
         return np.float32 if self.model_name.startswith("resnet") else np.int32
 
+    @staticmethod
+    def _served_batch(n: int) -> int:
+        """Smallest pre-compiled batch size >= n."""
+        padded = next((b for b in BATCH_SIZES if b >= n), None)
+        if padded is None:
+            raise ValueError(
+                f"batch {n} exceeds max served batch {BATCH_SIZES[-1]}")
+        return padded
+
     def predict(self, inputs: np.ndarray) -> np.ndarray:
         """Pads to the next served batch size, runs the jitted program, and
         slices the padding back off."""
         import jax
 
         n = inputs.shape[0]
-        padded = next((b for b in BATCH_SIZES if b >= n), None)
-        if padded is None:
-            raise ValueError(
-                f"batch {n} exceeds max served batch {BATCH_SIZES[-1]}")
+        padded = self._served_batch(n)
         if padded != n:
             pad = np.zeros((padded - n, *inputs.shape[1:]), inputs.dtype)
             inputs = np.concatenate([inputs, pad], axis=0)
@@ -112,6 +122,73 @@ class InferenceServer:
             self._stats["examples"] += n
             self._stats["seconds"] += dt
         return out[:n]
+
+    def generate_tokens(self, prompts: "list[list[int]]",
+                        max_new_tokens: int = 32, temperature: float = 0.0,
+                        top_k: "int | None" = None,
+                        eos_id: "int | None" = None) -> "list[list[int]]":
+        """KV-cache generation for a ragged batch of token prompts.
+
+        Prompts are right-padded with each row's last token to a shared
+        power-of-two width, and the batch to the next served batch size —
+        both keep the jitted prefill/decode programs to a small fixed set
+        (models/generate.py handles the ragged lengths exactly).
+        """
+        import jax.numpy as jnp
+
+        from k3stpu.models.generate import generate
+
+        if not self.model_name.startswith("transformer"):
+            raise ValueError(f"{self.model_name} is not a generative LM")
+        if not prompts or any(len(p) == 0 for p in prompts):
+            raise ValueError("prompts must be non-empty token lists")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+        # Everything that reaches generate() as a STATIC jit argument is
+        # bucketed/quantized here, so a hostile or chatty client can only
+        # ever populate a small fixed set of compiled programs (same
+        # reasoning as the BATCH_SIZES padding for predict()).
+        lens = [len(p) for p in prompts]
+        width = 1 << (max(lens) - 1).bit_length()  # next power of two
+        width = min(max(width, 8), self.seq_len)
+        if max(lens) > width:
+            raise ValueError(
+                f"prompt length {max(lens)} exceeds max seq {width}")
+        if width + max_new_tokens > self.seq_len:
+            raise ValueError(
+                f"prompt width {width} + max_new_tokens {max_new_tokens} "
+                f"exceeds the KV cache ({self.seq_len}); lower one of them")
+        gen_budget = 1 << (max_new_tokens - 1).bit_length()  # pow2 bucket
+        gen_budget = min(gen_budget, self.seq_len - width)
+        temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
+        if top_k is not None:  # pow2 bucket, capped at the vocab
+            top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(),
+                        self.model.config.vocab_size)
+        n = len(prompts)
+        batch = self._served_batch(n)
+
+        block = np.zeros((batch, width), np.int32)
+        for i, p in enumerate(prompts):
+            block[i, :len(p)] = p
+            block[i, len(p):] = p[-1]  # pad with the row's last real token
+        block[n:] = block[n - 1 if n else 0]  # batch padding rows
+        plens = np.array(lens + [lens[-1]] * (batch - n), np.int32)
+
+        t0 = time.perf_counter()
+        with self._lock:
+            out = np.asarray(generate(
+                self.model, self._variables["params"], jnp.asarray(block),
+                jnp.asarray(plens), gen_budget,
+                temperature=temperature, top_k=top_k,
+                eos_id=int(eos_id) if eos_id is not None else None))
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["examples"] += n
+            self._stats["seconds"] += dt
+        return out[:n, :max_new_tokens].tolist()
 
     def model_card(self) -> dict:
         import jax
@@ -153,6 +230,21 @@ def make_app(server: InferenceServer):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/v1/generate":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length))
+                    tokens = server.generate_tokens(
+                        req["prompt_tokens"],
+                        max_new_tokens=req.get("max_new_tokens", 32),
+                        temperature=req.get("temperature", 0.0),
+                        top_k=req.get("top_k"),
+                        eos_id=req.get("eos_id"))
+                    self._send(200, {"tokens": tokens})
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                return
             if self.path != "/v1/predict":
                 self._send(404, {"error": f"no route {self.path}"})
                 return

@@ -90,3 +90,56 @@ def test_lm_server_predict():
     assert logits.shape == (2, 16, 512)
     card = server.model_card()
     assert card["stats"]["examples"] == 2
+
+@pytest.fixture(scope="module")
+def lm_server():
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+    thread.start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+
+
+def test_generate_endpoint(lm_server):
+    status, body = post(lm_server + "/v1/generate",
+                        {"prompt_tokens": [[1, 2, 3], [4, 5, 6, 7, 8]],
+                         "max_new_tokens": 6})
+    assert status == 200, body
+    toks = body["tokens"]
+    assert len(toks) == 2 and all(len(t) == 6 for t in toks)
+    assert all(0 <= t < 512 for row in toks for t in row)
+
+
+def test_generate_greedy_deterministic(lm_server):
+    req = {"prompt_tokens": [[9, 8, 7, 6]], "max_new_tokens": 5}
+    _, a = post(lm_server + "/v1/generate", req)
+    _, b = post(lm_server + "/v1/generate", req)
+    assert a["tokens"] == b["tokens"]
+
+
+def test_generate_rejects_non_lm(http_server):
+    status, body = post(http_server + "/v1/generate",
+                        {"prompt_tokens": [[1, 2]]})
+    assert status == 400
+    assert "not a generative LM" in body["error"]
+
+
+def test_generate_rejects_empty_prompt(lm_server):
+    status, body = post(lm_server + "/v1/generate", {"prompt_tokens": [[]]})
+    assert status == 400
+
+
+def test_generate_rejects_too_long_prompt(lm_server):
+    status, body = post(lm_server + "/v1/generate",
+                        {"prompt_tokens": [list(range(65))]})
+    assert status == 400
+    assert "exceeds" in body["error"]
+
+
+def test_generate_rejects_cache_overflow(lm_server):
+    status, body = post(lm_server + "/v1/generate",
+                        {"prompt_tokens": [list(range(1, 40))],
+                         "max_new_tokens": 32})
+    assert status == 400
+    assert "KV cache" in body["error"]
