@@ -47,14 +47,16 @@ def _sample(logits: jax.Array, rng: jax.Array, *, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+# eos_id is deliberately NOT static: it traces as an int32 scalar, so any
+# tokenizer's eos (a client-controlled value in serving) reuses one
+# compiled program. Presence/absence (None) is still a static structure.
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
-                     "eos_id"))
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k"))
 def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
              max_new_tokens: int, *, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: "int | None" = None,
-             eos_id: "int | None" = None) -> jax.Array:
+             eos_id: "jax.Array | int | None" = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for a padded prompt block.
 
     ``prompt``: (B, P) int32, right-padded; ``prompt_lens``: (B,) true
@@ -69,6 +71,13 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
     that benign, or batch equal-length prompts for exactness.
     """
     b, p = prompt.shape
+    max_seq = getattr(model.config, "base", model.config).max_seq_len
+    if p + max_new_tokens > max_seq:
+        # dynamic_update_slice would silently clamp writes onto the last
+        # cache slot past this point — corrupt tokens, not an error.
+        raise ValueError(
+            f"prompt width {p} + max_new_tokens {max_new_tokens} exceeds "
+            f"max_seq_len {max_seq}")
     if rng is None:
         rng = jax.random.key(0)
 

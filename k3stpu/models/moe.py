@@ -1,0 +1,182 @@
+"""Mixture-of-Experts transformer LM with expert parallelism.
+
+Third model family (the reference schedules devices, not models — SURVEY.md
+§2c; the zoo is ResNet, dense LM, and this). TPU-first routing, the GShard/
+Mesh-TensorFlow way: everything is fixed-shape einsums against one-hot
+dispatch/combine tensors, so the whole MoE layer is three MXU matmuls plus
+elementwise — no gather/scatter, no dynamic shapes, nothing XLA can't
+partition. Expert parallelism falls out of sharding the expert-major
+parameters (E, d, f) over the mesh 'model' axis: GSPMD inserts the
+all-to-alls around the dispatch einsums itself.
+
+Capacity discipline: each expert processes at most C = ceil(T/E * factor)
+tokens; overflow tokens are dropped by the dispatch mask (their residual
+stream passes through unchanged) — the standard fixed-shape trade.
+
+The router's load-balancing aux loss is ``sow``n into the "losses"
+collection already scaled; the train bundle adds every sowed scalar to the
+objective (parallel/train.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.transformer import Attention, Block, TransformerConfig
+
+
+@dataclass(frozen=True)
+class MoeConfig:
+    base: TransformerConfig = field(default_factory=TransformerConfig)
+    num_experts: int = 8
+    router_top_k: int = 2           # tokens dispatched to their top-k experts
+    capacity_factor: float = 1.25   # C = ceil(T/E * factor * top_k)
+    aux_loss_coef: float = 0.01
+    every_n_blocks: int = 2         # MoE MLP in every n-th block, dense rest
+
+
+def route_top_k(probs: jax.Array, top_k: int, capacity: int):
+    """Fixed-shape top-k capacity routing.
+
+    ``probs``: (T, E) router probabilities. Returns ``(dispatch, combine)``,
+    both (T, E, capacity) one-hot-weighted: per round, each token takes its
+    best not-yet-used expert and claims that expert's next capacity slot
+    via a cumsum; tokens past capacity are dropped (dispatch row = 0).
+
+    Invariants (unit-tested): per-expert load <= capacity; each (e, c)
+    slot is claimed by at most one token; each token dispatches <= top_k
+    times; combine = dispatch * that token's gate probability.
+    """
+    t, e = probs.shape
+    remaining = probs
+    dispatch = jnp.zeros((t, e, capacity), jnp.float32)
+    combine = jnp.zeros((t, e, capacity), jnp.float32)
+    slots_used = jnp.zeros((e,), jnp.int32)
+    for _ in range(top_k):
+        choice = jnp.argmax(remaining, axis=-1)             # (T,)
+        gate = jnp.take_along_axis(
+            probs, choice[:, None], axis=-1)[:, 0]          # (T,)
+        onehot_e = jax.nn.one_hot(choice, e, dtype=jnp.float32)
+        # Position of each token within its chosen expert's queue,
+        # offset by slots already used in earlier rounds.
+        pos = (jnp.cumsum(onehot_e, axis=0) - 1.0)          # (T, E)
+        pos = pos + slots_used[None].astype(jnp.float32)
+        my_pos = jnp.sum(pos * onehot_e, axis=-1).astype(jnp.int32)
+        keep = my_pos < capacity
+        onehot_c = jax.nn.one_hot(my_pos, capacity, dtype=jnp.float32)
+        dd = onehot_e[:, :, None] * onehot_c[:, None, :]
+        dd = dd * keep[:, None, None]
+        dispatch = dispatch + dd
+        combine = combine + dd * gate[:, None, None]
+        slots_used = slots_used + jnp.sum(
+            onehot_e * keep[:, None], axis=0).astype(jnp.int32)
+        remaining = remaining * (1.0 - onehot_e)
+    return dispatch, combine
+
+
+class MoeMlp(nn.Module):
+    """Top-k routed expert MLP over flattened (B*S) tokens."""
+
+    config: MoeConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg, base = self.config, self.config.base
+        b, s, d = x.shape
+        t = b * s
+        e = cfg.num_experts
+        cap = int(np.ceil(t / e * cfg.capacity_factor * cfg.router_top_k))
+        cap = min(cap, t)
+        tokens = x.reshape(t, d)
+
+        # Router in fp32 — tiny matmul, and gate precision matters.
+        logits = nn.Dense(e, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32, name="router")(
+                              tokens.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # (T, E)
+        dispatch, combine = route_top_k(probs, cfg.router_top_k, cap)
+
+        # Load-balance aux loss (switch-style): E * <frac_tokens_e><gate_e>.
+        frac = jnp.mean(dispatch.sum(-1), axis=0)           # (E,)
+        mean_gate = jnp.mean(probs, axis=0)                 # (E,)
+        aux = e * jnp.sum(frac * mean_gate) * cfg.aux_loss_coef
+        self.sow("losses", "router_balance", aux)
+
+        # Expert-major params: leading E shards over 'model' (EP).
+        w_in = self.param(
+            "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, base.d_ff), jnp.float32)
+        w_out = self.param(
+            "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, base.d_ff, d), jnp.float32)
+
+        xs = tokens.astype(base.dtype)
+        expert_in = jnp.einsum("td,tec->ecd", xs,
+                               dispatch.astype(base.dtype))
+        h = nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in,
+                               w_in.astype(base.dtype)))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(base.dtype))
+        out = jnp.einsum("ecd,tec->td", expert_out,
+                         combine.astype(base.dtype))
+        return out.reshape(b, s, d)
+
+
+class MoeBlock(nn.Module):
+    """Attention + MoE MLP; dense blocks reuse transformer.Block directly."""
+
+    config: MoeConfig
+
+    @nn.compact
+    def __call__(self, x, *, mode: str = "full"):
+        base = self.config.base
+        h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
+                         name="ln_attn")(x)
+        x = x + Attention(base, name="attn")(h, mode=mode)
+        h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
+                         name="ln_mlp")(x)
+        return x + MoeMlp(self.config, name="moe")(h)
+
+
+class MoeTransformerLM(nn.Module):
+    """Decoder-only LM with MoE MLPs in every ``every_n_blocks``-th block."""
+
+    config: MoeConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False, mode: str = "full"):
+        del train
+        cfg, base = self.config, self.config.base
+        embed = nn.Embed(base.vocab_size, base.d_model,
+                         param_dtype=jnp.float32, dtype=base.dtype,
+                         name="embed")
+        x = embed(tokens)
+        for i in range(base.n_layers):
+            use_moe = (i % cfg.every_n_blocks) == cfg.every_n_blocks - 1
+            if use_moe:
+                x = MoeBlock(cfg, name=f"block{i}")(x, mode=mode)
+            else:  # identical param tree to the dense LM's blocks
+                x = Block(base, name=f"block{i}")(x, mode=mode)
+        x = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        return embed.attend(x).astype(jnp.float32)
+
+
+def moe_lm_small(num_experts: int = 8, **overrides) -> MoeTransformerLM:
+    """GPT-2-small backbone with 8-expert MoE MLPs in alternating blocks."""
+    return MoeTransformerLM(MoeConfig(base=TransformerConfig(**overrides),
+                                      num_experts=num_experts))
+
+
+def moe_lm_tiny(num_experts: int = 4, **overrides) -> MoeTransformerLM:
+    """Test/dry-run scale."""
+    defaults = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                    d_ff=128, max_seq_len=128)
+    defaults.update(overrides)
+    return MoeTransformerLM(MoeConfig(base=TransformerConfig(**defaults),
+                                      num_experts=num_experts))

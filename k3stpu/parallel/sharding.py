@@ -17,6 +17,8 @@ def infer_param_sharding(path: tuple, value, mesh: Mesh) -> NamedSharding:
     """Sharding for one parameter leaf, by name and rank.
 
     - conv kernels (rank 4, HWIO): P(None, None, None, 'model')
+    - expert-major MoE kernels (rank 3, (E, in, out)): P('model', None,
+      None) — expert parallelism reuses the 'model' axis
     - dense kernels (rank 2): P(None, 'model')
     - per-feature vectors (rank 1) under a norm/bias that feeds a sharded
       feature axis: P('model') when divisible, else replicated
@@ -27,6 +29,8 @@ def infer_param_sharding(path: tuple, value, mesh: Mesh) -> NamedSharding:
 
     if value.ndim == 4 and is_model_axis_ok(value.shape[3]):
         return NamedSharding(mesh, P(None, None, None, "model"))
+    if value.ndim == 3 and is_model_axis_ok(value.shape[0]):
+        return NamedSharding(mesh, P("model", None, None))
     if value.ndim == 2 and is_model_axis_ok(value.shape[1]):
         return NamedSharding(mesh, P(None, "model"))
     if value.ndim == 1 and is_model_axis_ok(value.shape[0]) and any(

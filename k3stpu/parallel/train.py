@@ -105,11 +105,15 @@ def make_train_bundle(
         variables = {"params": p}
         if has_stats:
             variables["batch_stats"] = stats
-            logits, mut = model.apply(variables, inputs, train=True,
-                                      mutable=["batch_stats"])
-            return loss_fn(logits, labels), mut["batch_stats"]
-        logits = model.apply(variables, inputs, train=True)
-        return loss_fn(logits, labels), stats
+        # "losses" collects pre-scaled auxiliary objectives modules sow
+        # (e.g. the MoE router's load-balance term, models/moe.py) — every
+        # sowed scalar is added to the objective.
+        logits, mut = model.apply(variables, inputs, train=True,
+                                  mutable=["batch_stats", "losses"])
+        loss = loss_fn(logits, labels)
+        for leaf in jax.tree.leaves(mut.get("losses", {})):
+            loss = loss + jnp.sum(leaf)
+        return loss, mut.get("batch_stats", stats)
 
     def step(p, stats, opt_state, inputs, labels):
         (loss, new_stats), grads = jax.value_and_grad(

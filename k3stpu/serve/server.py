@@ -51,6 +51,7 @@ class InferenceServer:
         self.seq_len = seq_len
         self._lock = threading.Lock()
         self._stats = {"requests": 0, "examples": 0, "seconds": 0.0}
+        self._gen_counter = 0  # per-request sampling key ordinal
 
         if model_name == "resnet50":
             from k3stpu.models.resnet import resnet50
@@ -162,10 +163,15 @@ class InferenceServer:
                 f"exceeds the KV cache ({self.seq_len}); lower one of them")
         gen_budget = 1 << (max_new_tokens - 1).bit_length()  # pow2 bucket
         gen_budget = min(gen_budget, self.seq_len - width)
+        vocab = getattr(self.model.config, "base",
+                        self.model.config).vocab_size
         temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
         if top_k is not None:  # pow2 bucket, capped at the vocab
-            top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(),
-                        self.model.config.vocab_size)
+            top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(), vocab)
+        if eos_id is not None:  # traced in generate(), so any value is one
+            eos_id = int(eos_id)  # program — just validate the range
+            if not 0 <= eos_id < vocab:
+                raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
         n = len(prompts)
         batch = self._served_batch(n)
 
@@ -176,13 +182,19 @@ class InferenceServer:
         block[n:] = block[n - 1 if n else 0]  # batch padding rows
         plens = np.array(lens + [lens[-1]] * (batch - n), np.int32)
 
+        import jax
+
         t0 = time.perf_counter()
         with self._lock:
+            # Fresh key per request (traced arg — no recompile): sampled
+            # continuations differ across requests but stay reproducible
+            # for a given request ordinal.
+            self._gen_counter += 1
+            rng = jax.random.key(self._gen_counter)
             out = np.asarray(generate(
                 self.model, self._variables["params"], jnp.asarray(block),
-                jnp.asarray(plens), gen_budget,
-                temperature=temperature, top_k=top_k,
-                eos_id=int(eos_id) if eos_id is not None else None))
+                jnp.asarray(plens), gen_budget, rng=rng,
+                temperature=temperature, top_k=top_k, eos_id=eos_id))
         dt = time.perf_counter() - t0
         with self._lock:
             self._stats["requests"] += 1

@@ -1,0 +1,120 @@
+"""MoE transformer: routing invariants, aux loss, EP-sharded training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.moe import MoeMlp, moe_lm_tiny
+from k3stpu.parallel.mesh import make_mesh
+from k3stpu.parallel.train import (
+    make_train_bundle,
+    run_synthetic_steps,
+    synth_token_batch,
+)
+
+
+def test_forward_shape_and_dtype():
+    model = moe_lm_tiny()
+    tokens = jnp.zeros((2, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    logits = model.apply({"params": variables["params"]}, tokens)
+    assert logits.shape == (2, 16, model.config.base.vocab_size)
+    assert logits.dtype == jnp.float32
+
+
+def test_moe_blocks_alternate():
+    model = moe_lm_tiny()
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32))
+    params = variables["params"]
+    # every_n_blocks=2 with 2 layers: block0 dense, block1 MoE.
+    assert "mlp_in" in params["block0"] and "moe" not in params["block0"]
+    assert "moe" in params["block1"] and "mlp_in" not in params["block1"]
+    w_in = params["block1"]["moe"]["w_in"]
+    cfg = model.config
+    assert w_in.shape == (cfg.num_experts, cfg.base.d_model, cfg.base.d_ff)
+
+
+def test_router_sows_aux_loss():
+    model = moe_lm_tiny()
+    tokens = jnp.zeros((1, 16), jnp.int32)
+    variables = model.init(jax.random.key(0), tokens)
+    _, mut = model.apply({"params": variables["params"]}, tokens,
+                         mutable=["losses"])
+    leaves = jax.tree.leaves(mut["losses"])
+    assert leaves, "router aux loss not sowed"
+    total = sum(float(jnp.sum(l)) for l in leaves)
+    # Switch-style balance loss is ~coef (0.01) when balanced; bounded by
+    # coef * E when fully collapsed. Must be positive and finite.
+    assert 0 < total < 1.0
+
+
+def test_route_top_k_invariants():
+    """Capacity routing: load <= capacity, unique slots, top-k dispatch."""
+    from k3stpu.models.moe import route_top_k
+
+    t, e, cap, k = 64, 4, 6, 2  # cap << t/e so overflow definitely happens
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.key(2), (t, e)) * 3.0, axis=-1)
+    dispatch, combine = route_top_k(probs, top_k=k, capacity=cap)
+    d = np.asarray(dispatch)
+
+    # Per-expert load never exceeds capacity.
+    load = d.sum(axis=(0, 2))
+    assert (load <= cap).all(), load
+    # With cap*e=24 slots for 128 dispatches, overflow occurred (drops).
+    assert d.sum() < t * k
+    # Every (expert, slot) is claimed by at most one token.
+    assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+    # Each token dispatches at most top_k times, to distinct experts.
+    assert (d.sum(axis=(1, 2)) <= k + 1e-6).all()
+    assert (d.sum(axis=2) <= 1.0 + 1e-6).all()
+    # combine carries the token's own gate probability on dispatched slots.
+    picked = d * np.asarray(probs)[:, :, None]
+    np.testing.assert_allclose(np.asarray(combine), picked, atol=1e-6)
+
+
+def test_route_top_k_no_overflow_when_capacity_ample():
+    from k3stpu.models.moe import route_top_k
+
+    t, e = 32, 4
+    probs = jax.nn.softmax(
+        jax.random.normal(jax.random.key(4), (t, e)), axis=-1)
+    dispatch, _ = route_top_k(probs, top_k=1, capacity=t)
+    # Nothing can overflow with capacity == t: every token is dispatched.
+    assert float(np.asarray(dispatch).sum()) == t
+
+
+def test_moe_trains_on_mesh_with_ep_sharding():
+    import optax
+
+    mesh = make_mesh(8, model_parallelism=2)
+    model = moe_lm_tiny()
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, 32), jnp.int32),
+        optimizer=optax.adamw(3e-4))
+
+    # Expert-major params shard over 'model' (expert parallelism).
+    w_in = bundle.params["block1"]["moe"]["w_in"]
+    shard_shapes = {s.data.shape for s in w_in.addressable_shards}
+    e, d, f = w_in.shape
+    assert shard_shapes == {(e // 2, d, f)}
+
+    vocab = model.config.base.vocab_size
+    losses = [run_synthetic_steps(
+        bundle, lambda k: synth_token_batch(k, 8, 32, vocab))
+        for _ in range(3)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] <= losses[0] + 1.0
+
+
+def test_generation_works_with_moe():
+    """KV-cache decode runs through MoE blocks too (shared Attention)."""
+    from k3stpu.models.generate import generate
+
+    model = moe_lm_tiny(max_seq_len=64)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32))["params"]
+    prompt = jax.random.randint(jax.random.key(1), (1, 8), 0, 512)
+    out = generate(model, params, prompt, jnp.array([8], jnp.int32), 4)
+    assert out.shape == (1, 4)
+    assert int(out.max()) < 512
