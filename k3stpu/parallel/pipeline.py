@@ -1,0 +1,144 @@
+"""Pipeline parallelism: GPipe-style microbatching over a 'pipe' mesh axis.
+
+The layer stack is cut into ``num_stages`` contiguous stages, one per
+device along the axis; stage-major-stacked parameters shard over that axis
+so each device holds only its own blocks' weights. A microbatched input
+streams through: every tick, each stage applies its blocks to the
+activation it holds and hands the result to the next stage with a single
+``ppermute`` hop (nearest-neighbor on ICI — the cheapest collective there
+is). After ``M + P - 1`` ticks every microbatch has crossed every stage.
+
+TPU-first specifics:
+- the tick loop is a ``lax.scan`` (one compiled program, reverse-mode
+  differentiable — ppermute transposes to the reverse ring in the
+  backward pass, so training through the pipeline works);
+- blocks within a stage run under an inner ``lax.scan`` over their stacked
+  weights (the standard scan-over-layers trick: one block's HLO, k
+  iterations, no code-size blowup);
+- bubble overhead is the usual (P-1)/(M+P-1); callers pick M >= ~4P.
+
+The reference has no model execution at all (SURVEY.md §2c) — this is the
+'pp' member of the dp/tp/sp/ep/pp family the K3S-TPU workloads compose.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def stack_block_params(block_params: list, num_stages: int):
+    """Stack per-block param trees (identical structure) stage-major:
+    leaves become (num_stages, blocks_per_stage, ...)."""
+    n = len(block_params)
+    if n % num_stages:
+        raise ValueError(f"{n} blocks not divisible by {num_stages} stages")
+    k = n // num_stages
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *block_params)
+    return jax.tree.map(
+        lambda a: a.reshape(num_stages, k, *a.shape[1:]), stacked)
+
+
+def unstack_block_params(stacked, num_stages: int, blocks_per_stage: int):
+    """Inverse of :func:`stack_block_params` -> list of per-block trees."""
+    flat = jax.tree.map(
+        lambda a: a.reshape(num_stages * blocks_per_stage, *a.shape[2:]),
+        stacked)
+    n = num_stages * blocks_per_stage
+    return [jax.tree.map(lambda a: a[i], flat) for i in range(n)]
+
+
+def _pipe_shard(mesh: Mesh, axis_name: str):
+    return NamedSharding(mesh, P(axis_name))
+
+
+def place_stacked_params(stacked, mesh: Mesh, axis_name: str = "pipe"):
+    """Shard stage-major stacked params: leading (stage) axis over the
+    pipe axis — each device materializes only its own stage's weights."""
+    sh = _pipe_shard(mesh, axis_name)
+    return jax.device_put(stacked, jax.tree.map(lambda _: sh, stacked))
+
+
+@functools.lru_cache(maxsize=16)
+def _pipeline_program(mesh: Mesh, block_apply, axis_name: str,
+                      num_micro: int):
+    from jax import shard_map
+
+    def run(params_local, x_micro):
+        # params_local leaves: (1, k, ...) — this device's stage.
+        params = jax.tree.map(lambda a: a[0], params_local)
+        p = jax.lax.psum(1, axis_name)
+        idx = jax.lax.axis_index(axis_name)
+        m = x_micro.shape[0]
+        perm = [(i, (i + 1) % p) for i in range(p)]
+
+        def stage(h):
+            def body(h, blk):
+                return block_apply(blk, h), None
+            h, _ = jax.lax.scan(body, h, params)
+            return h
+
+        vary = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+        outputs0 = vary(jnp.zeros_like(x_micro))
+        recv0 = vary(jnp.zeros_like(x_micro[0]))
+
+        def tick(carry, t):
+            recv, outputs = carry
+            feed = x_micro[jnp.clip(t, 0, m - 1)]
+            inp = jnp.where(idx == 0, feed, recv)
+            out = stage(inp)
+            o_idx = jnp.clip(t - (p - 1), 0, m - 1)
+            prev = jax.lax.dynamic_index_in_dim(outputs, o_idx, 0,
+                                                keepdims=False)
+            write = jnp.where(t >= p - 1, out, prev)
+            outputs = jax.lax.dynamic_update_index_in_dim(
+                outputs, write, o_idx, 0)
+            send = jax.lax.ppermute(out, axis_name, perm)
+            return (send, outputs), None
+
+        (_, outputs), _ = jax.lax.scan(
+            tick, (recv0, outputs0), jnp.arange(m + p - 1))
+        return outputs
+
+    spec_params = P(axis_name)
+    return jax.jit(shard_map(
+        run, mesh=mesh,
+        in_specs=(spec_params, P()),        # input microbatches replicated
+        out_specs=P(axis_name),             # (P*M, mb, ...); caller slices
+    ))
+
+
+def pipeline_forward(
+    mesh: Mesh,
+    block_apply,
+    stacked_params,
+    x: jax.Array,
+    num_microbatches: int,
+    *,
+    axis_name: str = "pipe",
+) -> jax.Array:
+    """Run ``x`` (B, ...) through the staged block stack.
+
+    ``block_apply(block_params, h) -> h`` applies ONE block;
+    ``stacked_params`` comes from :func:`stack_block_params` (+
+    :func:`place_stacked_params`). ``B`` must divide into
+    ``num_microbatches`` equal microbatches. ``block_apply`` must be a
+    stable (module-level) callable — the compiled program is cached on it.
+    """
+    b = x.shape[0]
+    if b % num_microbatches:
+        raise ValueError(
+            f"batch {b} not divisible by {num_microbatches} microbatches")
+    mb = b // num_microbatches
+    x_micro = x.reshape(num_microbatches, mb, *x.shape[1:])
+
+    program = _pipeline_program(mesh, block_apply, axis_name,
+                                num_microbatches)
+    outputs = program(stacked_params, x_micro)
+    # Every stage emitted an (M, mb, ...) buffer; only the LAST stage's is
+    # the pipeline output (out_specs concatenated them along axis 0).
+    out = outputs[-num_microbatches:]
+    return out.reshape(b, *out.shape[2:])

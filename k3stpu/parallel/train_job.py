@@ -35,6 +35,8 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--model", choices=["tiny", "small"], default=None,
                     help="default: small on TPU, tiny on CPU")
     ap.add_argument("--model-parallelism", type=int, default=None)
+    ap.add_argument("--profile-port", type=int, default=0,
+                    help="jax.profiler.start_server port (0 = off)")
     args = ap.parse_args(argv)
 
     from k3stpu.parallel.distributed import initialize
@@ -44,6 +46,11 @@ def main(argv: "list[str] | None" = None) -> int:
     import jax
     import jax.numpy as jnp
     import optax
+
+    if args.profile_port:
+        # Tracing hook (SURVEY.md §5): connect tensorboard's profile plugin
+        # or jax.profiler.trace to this port to capture device timelines.
+        jax.profiler.start_server(args.profile_port)
 
     from k3stpu.models.transformer import (
         transformer_lm_small,

@@ -290,7 +290,17 @@ def main(argv=None) -> int:
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--profile-port", type=int, default=0,
+                    help="expose jax.profiler.start_server on this port "
+                         "(0 = off); capture with jax.profiler.trace or "
+                         "tensorboard's profile plugin")
     args = ap.parse_args(argv)
+
+    if args.profile_port:
+        import jax
+
+        jax.profiler.start_server(args.profile_port)
+        print(f"profiler server on :{args.profile_port}", flush=True)
 
     server = InferenceServer(model_name=args.model,
                              image_size=args.image_size, seq_len=args.seq_len)

@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/k3stpu_common.dir/common/chips.cpp.o"
+  "CMakeFiles/k3stpu_common.dir/common/chips.cpp.o.d"
+  "CMakeFiles/k3stpu_common.dir/common/json.cpp.o"
+  "CMakeFiles/k3stpu_common.dir/common/json.cpp.o.d"
+  "libk3stpu_common.a"
+  "libk3stpu_common.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/k3stpu_common.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

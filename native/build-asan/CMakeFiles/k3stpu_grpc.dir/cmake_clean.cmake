@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/k3stpu_grpc.dir/common/grpc_transport.cpp.o"
+  "CMakeFiles/k3stpu_grpc.dir/common/grpc_transport.cpp.o.d"
+  "CMakeFiles/k3stpu_grpc.dir/common/hpack.cpp.o"
+  "CMakeFiles/k3stpu_grpc.dir/common/hpack.cpp.o.d"
+  "libk3stpu_grpc.a"
+  "libk3stpu_grpc.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/k3stpu_grpc.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,3 @@
+file(REMOVE_RECURSE
+  "libk3stpu_grpc.a"
+)

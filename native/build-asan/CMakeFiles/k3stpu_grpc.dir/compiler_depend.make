@@ -1,0 +1,2 @@
+# Empty compiler generated dependencies file for k3stpu_grpc.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,2 @@
+# Empty dependencies file for k3stpu_grpc.
+# This may be replaced when dependencies are built.

@@ -1,0 +1,21 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/native/tpu-container-runtime/main.cpp" "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/main.cpp.o" "gcc" "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/main.cpp.o.d"
+  "/root/repo/native/tpu-container-runtime/spec_patch.cpp" "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/spec_patch.cpp.o" "gcc" "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/spec_patch.cpp.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  "/root/repo/native/build-asan/CMakeFiles/k3stpu_common.dir/DependInfo.cmake"
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/main.cpp.o"
+  "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/main.cpp.o.d"
+  "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/spec_patch.cpp.o"
+  "CMakeFiles/tpu-container-runtime.dir/tpu-container-runtime/spec_patch.cpp.o.d"
+  "tpu-container-runtime"
+  "tpu-container-runtime.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/tpu-container-runtime.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

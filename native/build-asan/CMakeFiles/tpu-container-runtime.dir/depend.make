@@ -1,0 +1,2 @@
+# Empty dependencies file for tpu-container-runtime.
+# This may be replaced when dependencies are built.

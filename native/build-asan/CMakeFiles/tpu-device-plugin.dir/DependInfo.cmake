@@ -1,0 +1,22 @@
+
+# Consider dependencies only in project.
+set(CMAKE_DEPENDS_IN_PROJECT_ONLY OFF)
+
+# The set of languages for which implicit dependencies are needed:
+set(CMAKE_DEPENDS_LANGUAGES
+  )
+
+# The set of dependency files which are needed:
+set(CMAKE_DEPENDS_DEPENDENCY_FILES
+  "/root/repo/native/tpu-device-plugin/main.cpp" "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/main.cpp.o" "gcc" "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/main.cpp.o.d"
+  "/root/repo/native/tpu-device-plugin/plugin.cpp" "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/plugin.cpp.o" "gcc" "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/plugin.cpp.o.d"
+  )
+
+# Targets to which this target links.
+set(CMAKE_TARGET_LINKED_INFO_FILES
+  "/root/repo/native/build-asan/CMakeFiles/k3stpu_common.dir/DependInfo.cmake"
+  "/root/repo/native/build-asan/CMakeFiles/k3stpu_grpc.dir/DependInfo.cmake"
+  )
+
+# Fortran module output directory.
+set(CMAKE_Fortran_TARGET_MODULE_DIR "")

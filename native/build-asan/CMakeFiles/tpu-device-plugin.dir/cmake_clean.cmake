@@ -1,0 +1,13 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/main.cpp.o"
+  "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/main.cpp.o.d"
+  "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/plugin.cpp.o"
+  "CMakeFiles/tpu-device-plugin.dir/tpu-device-plugin/plugin.cpp.o.d"
+  "tpu-device-plugin"
+  "tpu-device-plugin.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/tpu-device-plugin.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

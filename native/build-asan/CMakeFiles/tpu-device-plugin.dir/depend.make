@@ -1,0 +1,2 @@
+# Empty dependencies file for tpu-device-plugin.
+# This may be replaced when dependencies are built.

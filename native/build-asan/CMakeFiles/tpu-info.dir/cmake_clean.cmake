@@ -1,0 +1,11 @@
+file(REMOVE_RECURSE
+  "CMakeFiles/tpu-info.dir/tpu-info/main.cpp.o"
+  "CMakeFiles/tpu-info.dir/tpu-info/main.cpp.o.d"
+  "tpu-info"
+  "tpu-info.pdb"
+)
+
+# Per-language clean rules from dependency scanning.
+foreach(lang CXX)
+  include(CMakeFiles/tpu-info.dir/cmake_clean_${lang}.cmake OPTIONAL)
+endforeach()

@@ -1,0 +1,85 @@
+"""ASan/UBSan build of the native components (SURVEY.md §5: the C++
+runtime shim runs under sanitizers in CI — the cluster layer has no data
+races to hunt, so memory/UB discipline on the native path is the analogue).
+
+Builds native/ with -DK3STPU_SANITIZE=ON into a separate build tree and
+drives the spec-rewrite and chip-inventory paths; any ASan/UBSan report
+makes the binary exit non-zero (abort_on_error) and fails the test.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUILD_DIR = os.path.join(REPO, "native", "build-asan")
+
+ASAN_ENV = {
+    **os.environ,
+    "ASAN_OPTIONS": "abort_on_error=1:detect_leaks=1",
+    "UBSAN_OPTIONS": "halt_on_error=1",
+}
+
+
+@pytest.fixture(scope="session")
+def asan_bins():
+    subprocess.run(
+        ["cmake", "-S", os.path.join(REPO, "native"), "-B", BUILD_DIR,
+         "-DK3STPU_SANITIZE=ON"],
+        check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", BUILD_DIR, "-j", "4"],
+                   check=True, capture_output=True)
+    return BUILD_DIR
+
+
+def test_spec_patch_under_sanitizers(asan_bins, fake_host_root, tmp_path):
+    bundle = tmp_path / "bundle"
+    bundle.mkdir()
+    spec = {
+        "ociVersion": "1.0.2",
+        "process": {"args": ["python"], "env": ["PATH=/usr/bin"]},
+        "root": {"path": "rootfs"},
+        "mounts": [{"destination": "/proc", "type": "proc",
+                    "source": "proc"}],
+        "linux": {"namespaces": [{"type": "pid"}]},
+    }
+    (bundle / "config.json").write_text(json.dumps(spec))
+
+    out = subprocess.run(
+        [os.path.join(asan_bins, "tpu-container-runtime"), "patch",
+         "--bundle", str(bundle), "--dry-run",
+         "--host-root", str(fake_host_root), "--always"],
+        capture_output=True, text=True, env=ASAN_ENV)
+    assert out.returncode == 0, out.stderr
+    patched = json.loads(out.stdout)
+    assert any("libtpu" in m.get("source", "")
+               for m in patched.get("mounts", [])), patched["mounts"]
+    assert "AddressSanitizer" not in out.stderr
+    assert "runtime error" not in out.stderr
+
+
+def test_tpu_info_under_sanitizers(asan_bins, fake_host_root):
+    out = subprocess.run(
+        [os.path.join(asan_bins, "tpu-info"), "--json",
+         "--host-root", str(fake_host_root)],
+        capture_output=True, text=True, env=ASAN_ENV)
+    assert out.returncode == 0, out.stderr
+    info = json.loads(out.stdout)
+    assert len(info["chips"]) == 4
+    assert "AddressSanitizer" not in out.stderr
+
+
+def test_malformed_spec_is_rejected_cleanly(asan_bins, tmp_path):
+    """Truncated/garbage JSON must fail with an error, not a crash."""
+    bundle = tmp_path / "bundle"
+    bundle.mkdir()
+    (bundle / "config.json").write_text('{"process": {"args": [')
+    out = subprocess.run(
+        [os.path.join(asan_bins, "tpu-container-runtime"), "patch",
+         "--bundle", str(bundle), "--dry-run"],
+        capture_output=True, text=True, env=ASAN_ENV)
+    assert out.returncode != 0
+    assert "AddressSanitizer" not in out.stderr
+    assert "Segmentation" not in out.stderr
