@@ -17,7 +17,11 @@ from k3stpu.parallel.pipeline import (
     unstack_block_params,
 )
 
-CFG = transformer_lm_tiny(n_layers=4, max_seq_len=32).config
+# float32 compute: the gradient-exactness test needs tolerances far below
+# bf16 rounding noise (~8e-3), and the pipeline is meant to be numerically
+# exact, not just close, so all comparisons here run in fp32.
+CFG = transformer_lm_tiny(n_layers=4, max_seq_len=32,
+                          dtype=jnp.float32).config
 
 
 def _block_apply(block_params, h):
