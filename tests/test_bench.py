@@ -1,9 +1,15 @@
-"""bench.py must print exactly one JSON line with the driver's schema."""
+"""bench.py must print exactly one JSON line with the driver's schema —
+in every outcome: success, wedged backend (bounded + structured error),
+or killed parent (no orphan left holding the chip claim)."""
 
 import json
 import os
+import signal
 import subprocess
 import sys
+import time
+
+import bench
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -37,3 +43,110 @@ def test_probe_cpu():
     assert out.returncode == 0, out.stderr
     assert "DEVICES_JSON" in out.stdout
     assert "BENCH_JSON" in out.stdout
+
+
+def test_run_bounded_kills_on_timeout():
+    t0 = time.monotonic()
+    rc, _, _ = bench._run_bounded(
+        [sys.executable, "-c", "import time; time.sleep(60)"], 1)
+    assert rc is None
+    assert time.monotonic() - t0 < 10
+
+
+def test_no_retry_on_timeout_when_disabled():
+    t0 = time.monotonic()
+    ok, rc, _, _ = bench._run_with_retry(
+        [sys.executable, "-c", "import time; time.sleep(60)"], 1,
+        retry_on_timeout=False)
+    assert not ok and rc is None
+    # a single attempt: well under timeout + RETRY_WAIT_S + timeout
+    assert time.monotonic() - t0 < 1 + bench.RETRY_WAIT_S
+
+
+def test_retry_recovers_fast_failure(tmp_path):
+    # rc=1 on the first run, rc=0 on the second — retry must recover it.
+    marker = tmp_path / "once"
+    prog = (f"import pathlib, sys\nm = pathlib.Path({str(marker)!r})\n"
+            "if m.exists():\n    sys.exit(0)\nm.touch()\nsys.exit(1)")
+    ok, rc, _, _ = bench._run_with_retry(
+        [sys.executable, "-c", prog], 30, retry_on_timeout=False)
+    assert ok and rc == 0
+
+
+def test_wedged_probe_yields_structured_error_line(monkeypatch):
+    """A probe that never returns must degrade to ONE parseable error
+    line with stage/detail — never a traceback or a hang."""
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import time; time.sleep(60)")
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 1)
+    monkeypatch.setattr(bench, "RETRY_WAIT_S", 0)
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.main()
+    assert rc == 0
+    lines = [l for l in buf.getvalue().strip().splitlines() if l.strip()]
+    assert len(lines) == 1
+    rec = json.loads(lines[0])
+    assert rec["stage"] == "backend_init"
+    assert rec["value"] == 0.0 and "error" in rec and "detail" in rec
+
+
+def test_sigterm_parent_does_not_orphan_child():
+    """Kill bench mid-probe (as an outer `timeout` would): the probe
+    child — which on TPU would hold the chip claim — must die with it."""
+    prog = (
+        "import sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import bench\n"
+        "bench._PROBE_SRC = 'import time; time.sleep(120)'\n"
+        "bench.PROBE_TIMEOUT_S = 100\n"
+        "sys.exit(bench.main())\n")
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-c", prog], env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        time.sleep(3)  # let it spawn the probe child
+        children = _pgrep_children(proc.pid)
+        assert children, "probe child never started"
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=15)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and any(map(_alive, children)):
+            time.sleep(0.5)
+        survivors = [pid for pid in children if _alive(pid)]
+    finally:
+        for pid in _pgrep_children(proc.pid):
+            _kill_quiet(pid)
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+    for pid in survivors:
+        _kill_quiet(pid)
+    assert not survivors, f"orphaned probe children: {survivors}"
+
+
+def _pgrep_children(ppid):
+    out = subprocess.run(["pgrep", "-P", str(ppid)],
+                         capture_output=True, text=True)
+    return [int(p) for p in out.stdout.split()]
+
+
+def _alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _kill_quiet(pid):
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
