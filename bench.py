@@ -30,9 +30,12 @@ BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
 PROBE_TIMEOUT_S = 120   # backend init: first tunnel contact + device list
 MEASURE_TIMEOUT_S = 480  # compile (~20-40s first time) + timed loop
 RETRY_WAIT_S = 10
-# Worst case: probe 2x120 + 10 + measure 480 (timeouts are not retried —
-# a wedge that ate the full budget will eat the retry too) ~= 730s.
-# Callers must wrap with a timeout ABOVE that (see verify skill: 900s).
+RETRY_FAST_S = 60       # only failures faster than this are worth retrying
+# Worst case: probe 2x120 + 10, then measure 480 (a timeout is never
+# retried — a wedge that ate the full budget will eat the retry too — and
+# an rc!=0 failure is retried only if it failed fast, < RETRY_FAST_S, so
+# the retry leg adds at most 60 + 10 + 480) ~= 800s. Callers must wrap
+# with a timeout ABOVE that (see verify skill: 900s).
 
 _child_pgid: int | None = None
 
@@ -84,10 +87,9 @@ def _run_bounded(cmd: list[str], timeout_s: int) -> tuple[int | None, str, str]:
     proc = subprocess.Popen(
         cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
         text=True, start_new_session=True)
-    try:
-        _child_pgid = os.getpgid(proc.pid)
-    except ProcessLookupError:
-        _child_pgid = None
+    # start_new_session guarantees the child's pgid == its pid — no
+    # getpgid lookup (which could itself fail and leave the var unset).
+    _child_pgid = proc.pid
     try:
         out, err = proc.communicate(timeout=timeout_s)
         return proc.returncode, out, err
@@ -103,17 +105,19 @@ def _run_bounded(cmd: list[str], timeout_s: int) -> tuple[int | None, str, str]:
 def _run_with_retry(cmd: list[str], timeout_s: int, *,
                     retry_on_timeout: bool):
     """One bounded attempt, plus one retry on failure. A timeout is only
-    retried when asked — it already consumed the full budget, so a wedged
-    backend would just double the cost. Returns (ok, rc, out, err)."""
+    retried when asked (it already consumed the full budget), and an rc!=0
+    failure only when it failed fast — a slow crash retried would blow the
+    documented worst-case budget. Returns (ok, rc, out, err)."""
+    t0 = time.monotonic()
     rc, out, err = _run_bounded(cmd, timeout_s)
-    if rc == 0:
-        return True, rc, out, err
-    if rc is not None or retry_on_timeout:
-        time.sleep(RETRY_WAIT_S)
-        rc, out, err = _run_bounded(cmd, timeout_s)
-        if rc == 0:
-            return True, rc, out, err
-    return False, rc, out, err
+    elapsed = time.monotonic() - t0
+    retry = (retry_on_timeout if rc is None
+             else rc != 0 and elapsed < RETRY_FAST_S)
+    if rc == 0 or not retry:
+        return rc == 0, rc, out, err
+    time.sleep(RETRY_WAIT_S)
+    rc, out, err = _run_bounded(cmd, timeout_s)
+    return rc == 0, rc, out, err
 
 
 def _worker() -> int:

@@ -17,11 +17,17 @@ formulation mapped onto the TPU memory hierarchy:
   above the diagonal skip their compute entirely via ``pl.when`` (the DMA
   still runs — block specs are static — but the MXU work is saved).
 
-The backward pass recomputes attention with a plain einsum (a standard
-rematerialization trade: the O(S^2) logits exist only inside the backward
-computation). Sequence lengths long enough for that to matter shard S over
-the mesh via ring attention (parallel/context.py), which makes the per-shard
-S small again.
+The backward pass is also Pallas (FlashAttention-2 style): the forward
+additionally emits the per-row logsumexp (lane-replicated (bh, S, 128) fp32,
+the standard TPU residual layout), and two backward kernels recompute the
+probability tiles from (q, k, lse) — one sweeping q tiles innermost to
+accumulate dK/dV per k tile, one sweeping k tiles innermost to accumulate dQ
+per q tile. Nothing O(S^2) is ever materialized in HBM in either direction;
+the einsum attention below remains as the gradient oracle for tests.
+
+Causal masking is END-aligned in both directions (query i attends to key
+j <= i + s_kv - s_q — the decode/KV-prefix convention), matching the einsum
+oracle's ``tril(k=s_kv-s_q)`` exactly for s_q != s_kv.
 """
 
 from __future__ import annotations
@@ -41,8 +47,27 @@ _LANES = 128  # TPU lane width: trailing dim of any VMEM tile
 DEFAULT_BLOCK = 256
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                  *, scale: float, causal: bool, block_q: int, block_k: int):
+def _causal_tile_mask(s, qi, ki, block_q: int, block_k: int, offset: int):
+    """Mask s (block_q, block_k) end-aligned: row r sees col c <= r + offset
+    at absolute positions, offset = s_kv - s_q (the decode convention)."""
+    rows = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, s.shape, 0) + offset
+    cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    return jnp.where(rows >= cols, s, _NEG_INF)
+
+
+def _causal_tile_live(qi, ki, block_q: int, block_k: int, offset: int):
+    """False iff the whole (qi, ki) tile sits above the causal diagonal."""
+    return ki * block_k <= qi * block_q + block_q - 1 + offset
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
+                  scale: float, causal: bool, block_q: int, block_k: int,
+                  offset: int, with_lse: bool):
+    if with_lse:
+        lse_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        lse_ref, (m_ref, l_ref, acc_ref) = None, rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -56,7 +81,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
     # A k tile is live unless it sits entirely above the causal diagonal.
     live = True
     if causal:
-        live = ki * block_k <= qi * block_q + block_q - 1
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
 
     @pl.when(live)
     def _update():
@@ -70,16 +95,18 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         ) * scale                          # (block_q, block_k) fp32
 
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0)
-            cols = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(rows >= cols, s, _NEG_INF)
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
 
         m_prev = m_ref[:, :1]                             # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)                   # (block_q, 1)
         p = jnp.exp(s - m_new)                            # (block_q, block_k)
+        if causal:
+            # A row fully masked within a live tile has every s at the
+            # finite _NEG_INF and m_new still _NEG_INF, so exp(s - m_new)
+            # would be 1 (uniform garbage); force masked entries to 0 so
+            # such rows keep l == 0 and finalize to zeros / -inf lse.
+            p = jnp.where(s > _NEG_INF / 2, p, 0.0)
 
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
@@ -91,15 +118,21 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        # Fully-masked q rows (can't happen causally, but guard anyway)
-        # would have l == 0; emit zeros instead of inf.
+        # Fully-masked q rows (possible causally when s_q > s_kv) have
+        # l == 0; emit zeros, and -inf lse so the backward yields p == 0.
+        m = m_ref[:, :1]
         l = l_ref[:, :1]
         denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        if with_lse:
+            lse = jnp.where(l > 0.0, m + jnp.log(denom), _NEG_INF)
+            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
 def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
-                   vmem_limit_bytes=32 * 1024 * 1024):
+                   with_lse, vmem_limit_bytes=32 * 1024 * 1024):
+    """Returns (o, lse) when with_lse (the training path needs the residual)
+    else just o — the inference hot path skips the lse HBM write entirely."""
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     block_q = min(block_q, s_q)
@@ -112,7 +145,13 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
     grid = (bh, s_q // block_q, s_kv // block_k)
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k)
+        block_q=block_q, block_k=block_k, offset=s_kv - s_q,
+        with_lse=with_lse)
+
+    o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    o_shape = jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)
+    lse_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    lse_shape = jax.ShapeDtypeStruct((bh, s_q, _LANES), jnp.float32)
 
     return pl.pallas_call(
         kernel,
@@ -122,8 +161,8 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, s_q, d), q.dtype),
+        out_specs=(o_spec, lse_spec) if with_lse else o_spec,
+        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
@@ -150,28 +189,202 @@ def _reference_attention(q, k, v, *, scale, causal):
     if causal:
         mask = jnp.tril(jnp.ones((s_q, s_kv), bool), k=s_kv - s_q)
         logits = jnp.where(mask[None], logits, _NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if causal:
+        # Fully-masked rows (s_q > s_kv top rows): softmax of an all -inf
+        # row is uniform garbage; the semantic (and the kernel) is zeros.
+        any_live = jnp.any(mask, axis=-1)[None, :, None]
+        probs = jnp.where(any_live, probs, 0.0)
+    probs = probs.astype(q.dtype)
     return jnp.einsum("bqk,bkd->bqd", probs, v)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc,
+                    *, scale: float, causal: bool, block_q: int,
+                    block_k: int, offset: int):
+    """Accumulate dK/dV for one k tile across the q sweep (innermost)."""
+    ki = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    live = True
+    if causal:
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]                       # (block_q, d)
+        k = k_ref[0]                       # (block_k, d)
+        v = v_ref[0]                       # (block_k, d)
+        do = do_ref[0]                     # (block_q, d)
+        # Fully-masked rows carry -inf lse; substitute 0 so the (already
+        # -inf-masked) logits still produce p == 0, not nan.
+        lse = lse_ref[0][:, :1]            # (block_q, 1) fp32
+        lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
+        di = di_ref[0][:, :1]              # (block_q, 1) fp32
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse)               # (block_q, block_k) probs
+
+        # dV += P^T dO
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dP = dO V^T ; dS = P * (dP - di) * scale
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di) * scale
+        # dK += dS^T Q
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
+                   dq_ref, dq_acc,
+                   *, scale: float, causal: bool, block_q: int,
+                   block_k: int, offset: int):
+    """Accumulate dQ for one q tile across the k sweep (innermost)."""
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    live = True
+    if causal:
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
+
+    @pl.when(live)
+    def _update():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, :1]
+        lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
+        di = di_ref[0][:, :1]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
+        p = jnp.exp(s - lse)
+
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - di) * scale
+        # dQ += dS K
+        dq_acc[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
+                    interpret, vmem_limit_bytes=32 * 1024 * 1024):
+    bh, s_q, d = q.shape
+    s_kv = k.shape[1]
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_kv)
+    offset = s_kv - s_q
+
+    # di = rowsum(dO * O) — O(S d) elementwise; XLA fuses it. Replicated to
+    # the standard 128-lane residual layout.
+    di = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    di = jnp.broadcast_to(di[..., None], (bh, s_q, _LANES))
+
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
+    r_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, offset=offset)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, s_kv // block_k, s_q // block_q),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
+        out_specs=(kv_spec, kv_spec),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
+                        pltpu.VMEM((block_k, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_bytes),
+        cost_estimate=pl.CostEstimate(
+            flops=8 * bh * s_q * s_kv * d,
+            bytes_accessed=2 * bh * (2 * s_q + 2 * s_kv) * d,
+            transcendentals=bh * s_q * s_kv),
+        interpret=interpret,
+    )(q, k, v, g, lse, di)
+
+    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, s_q // block_q, s_kv // block_k),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=q_spec2,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_bytes),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * bh * s_q * s_kv * d,
+            bytes_accessed=2 * bh * (2 * s_q + 2 * s_kv) * d,
+            transcendentals=bh * s_q * s_kv),
+        interpret=interpret,
+    )(q, k, v, g, lse, di)
+
+    return dq, dk, dv
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
     return _flash_forward(q, k, v, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret)
+                          interpret=interpret, with_lse=False)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
-    out = _flash(q, k, v, scale, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              interpret=interpret, with_lse=True)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda q, k, v: _reference_attention(q, k, v, scale=scale,
-                                             causal=causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = res
+    return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -192,7 +405,8 @@ def flash_attention(
 
     Heads fold into the grid's batch dimension; each (batch, head) pair sweeps
     its k/v tiles through VMEM against a resident q tile. Differentiable via
-    einsum rematerialization. ``interpret=True`` runs the kernel in the Pallas
+    Pallas backward kernels (tile recomputation from the saved logsumexp —
+    O(S) memory both ways). ``interpret=True`` runs the kernels in the Pallas
     interpreter (CPU CI — SURVEY.md §4's "CPU-JAX stand-in" test tier).
     """
     b, s_q, h, d = q.shape

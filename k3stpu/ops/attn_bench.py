@@ -1,0 +1,129 @@
+"""Flash-attention throughput measurement (fwd and fwd+bwd) vs the einsum
+reference, at sequence lengths where the O(S^2) einsum stops being viable.
+
+The reference stack has no attention op to benchmark (SURVEY.md §2c); this
+is the oracle-table analogue for the K3S-TPU transformer workload: the probe
+pod logs a line per (S, impl, direction) so the reader can see the compiled
+Pallas kernel beating the einsum as S grows — and running at all at S where
+the einsum would OOM on materialized logits.
+
+Timing uses the same device->host scalar pull as ops/matmul.py: a relayed
+PJRT backend can return from ``block_until_ready`` optimistically, but a
+host transfer cannot complete before the work has.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from k3stpu.ops.attention import flash_attention, reference_attention
+from k3stpu.ops.matmul import _abs_sum, peak_tflops_for
+
+# Above this S the einsum reference materializes multi-GB logits; skip it.
+EINSUM_MAX_S = 8192
+
+
+@dataclass
+class AttnResult:
+    impl: str            # "flash" | "einsum"
+    direction: str       # "fwd" | "fwd+bwd"
+    batch: int
+    seq: int
+    heads: int
+    head_dim: int
+    causal: bool
+    iters: int
+    seconds: float       # median wall time for `iters` chained calls
+    tflops: float        # achieved, from the causal-aware flop count
+    mfu: float | None
+
+    def to_dict(self) -> dict:
+        d = self.__dict__.copy()
+        d["seconds"] = round(d["seconds"], 4)
+        d["tflops"] = round(d["tflops"], 2)
+        if d["mfu"] is not None:
+            d["mfu"] = round(d["mfu"], 4)
+        return d
+
+
+def _attn_flops(b, s, h, d, causal, backward):
+    # fwd: qk^T and pv — 2 matmuls = 4*b*h*s^2*d flops; causal halves.
+    # bwd adds 5 matmuls (s recompute, dv, dp, dk, dq) = 2.5x fwd.
+    f = 4.0 * b * h * s * s * d
+    if causal:
+        f /= 2
+    return f * 3.5 if backward else f
+
+
+def _time_fn(fn, args, iters, trials=3):
+    pull = lambda x: float(_abs_sum(jax.tree.leaves(x)[0]))
+
+    pull(fn(*args))  # compile + pipeline warm-up
+    times = []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
+        s = pull(out)  # device->host sync ends the clock
+        assert s == s, "attention produced NaN"
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times[len(times) // 2]
+
+
+def measure_attention(
+    seq: int,
+    batch: int = 1,
+    heads: int = 8,
+    head_dim: int = 128,
+    causal: bool = True,
+    iters: int = 10,
+    backward: bool = True,
+    include_einsum: bool | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> list[AttnResult]:
+    """Benchmark flash (and optionally einsum) attention at one S."""
+    if include_einsum is None:
+        include_einsum = seq <= EINSUM_MAX_S
+    ks = jax.random.split(jax.random.key(0), 3)
+    shape = (batch, seq, heads, head_dim)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+    bq = min(block_q, seq)
+    bk = min(block_k, seq)
+
+    impls = {"flash": jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=causal, block_q=bq, block_k=bk,
+        interpret=interpret))}
+    if include_einsum:
+        impls["einsum"] = jax.jit(
+            lambda q, k, v: reference_attention(q, k, v, causal=causal))
+
+    results = []
+    peak = peak_tflops_for()
+    for name, fwd in impls.items():
+        directions = {"fwd": fwd}
+        if backward:
+            def grad_fn(q, k, v, _f=fwd):
+                return jax.grad(
+                    lambda q, k, v: jnp.sum(
+                        _f(q, k, v).astype(jnp.float32) ** 2),
+                    argnums=(0, 1, 2))(q, k, v)
+            directions["fwd+bwd"] = jax.jit(grad_fn)
+        for dname, fn in directions.items():
+            elapsed = _time_fn(fn, (q, k, v), iters)
+            fl = _attn_flops(batch, seq, heads, head_dim, causal,
+                             dname == "fwd+bwd")
+            tflops = fl * iters / elapsed / 1e12
+            results.append(AttnResult(
+                impl=name, direction=dname, batch=batch, seq=seq,
+                heads=heads, head_dim=head_dim, causal=causal, iters=iters,
+                seconds=elapsed, tflops=tflops,
+                mfu=(tflops / peak) if peak else None))
+    return results

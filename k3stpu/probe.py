@@ -9,6 +9,7 @@ then, unlike nvidia-smi, proves the chip actually computes by logging matmul
 TFLOP/s and MFU (the BASELINE.json metric).
 
 Run:  python -m k3stpu.probe [--m 8192 --iters 30] [--skip-bench]
+      python -m k3stpu.probe --attn [--attn-seqs 1024,4096,16384]
 """
 
 from __future__ import annotations
@@ -40,6 +41,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--m", type=int, default=8192, help="matmul dimension")
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--skip-bench", action="store_true")
+    ap.add_argument("--attn", action="store_true",
+                    help="benchmark flash vs einsum attention")
+    ap.add_argument("--attn-seqs", default="1024,4096,16384",
+                    help="comma-separated sequence lengths for --attn")
     args = ap.parse_args(argv)
 
     import jax
@@ -68,6 +73,23 @@ def main(argv: list[str] | None = None) -> int:
             + (f" ({res.mfu * 100:.1f}% MFU)" if res.mfu is not None else "")
         )
         print("BENCH_JSON " + json.dumps(res.to_dict()))
+
+    if args.attn:
+        from k3stpu.ops.attn_bench import measure_attention
+
+        seqs = [int(s) for s in args.attn_seqs.split(",")]
+        if not ok:  # CPU stand-in: one interpreted run at a clamped shape
+            seqs = [min(min(seqs), 512)]
+        for seq in seqs:
+            kwargs = dict(seq=seq)
+            if not ok:
+                kwargs.update(heads=2, head_dim=64, iters=2,
+                              interpret=True)
+            for r in measure_attention(**kwargs):
+                print(f"attn S={r.seq} {r.impl:<6} {r.direction:<7}: "
+                      f"{r.seconds / r.iters * 1e3:8.2f} ms/iter "
+                      f"{r.tflops:7.1f} TFLOP/s")
+                print("ATTN_JSON " + json.dumps(r.to_dict()))
     return 0
 
 

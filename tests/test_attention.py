@@ -58,17 +58,120 @@ def test_indivisible_seq_raises():
         flash_attention(q, k, v, block_q=128, block_k=128, interpret=True)
 
 
-def test_gradients_match_reference():
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_reference(causal):
     q, k, v = _qkv(b=1, s=128, h=2, d=32)
 
     def loss_flash(q, k, v):
-        return jnp.sum(flash_attention(q, k, v, interpret=True) ** 2)
+        return jnp.sum(
+            flash_attention(q, k, v, causal=causal, interpret=True) ** 2)
 
     def loss_ref(q, k, v):
-        return jnp.sum(reference_attention(q, k, v) ** 2)
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
 
     g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
     g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
     for gf, gr in zip(g_flash, g_ref):
         np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
                                    atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_multiblock(causal):
+    # Several q AND k tiles so the backward's two accumulation sweeps (and
+    # the causal tile-skip on both grids) are actually exercised.
+    q, k, v = _qkv(b=1, s=256, h=2, d=32, seed=3)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=64,
+                                       block_k=64, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=causal) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+@pytest.mark.parametrize("s_q,s_kv", [(128, 256), (256, 128)])
+def test_causal_cross_length_matches_reference(s_q, s_kv):
+    """End-aligned causal semantics must agree between kernel fwd, kernel
+    bwd, and the einsum oracle when s_q != s_kv (the KV-prefix case; when
+    s_q > s_kv the top rows are fully masked and must stay zero/nan-free)."""
+    ks = jax.random.split(jax.random.key(7), 3)
+    q = jax.random.normal(ks[0], (1, s_q, 2, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (1, s_kv, 2, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (1, s_kv, 2, 32), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=True, block_q=64,
+                                       block_k=64, interpret=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        assert np.all(np.isfinite(np.asarray(gf)))
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_fully_masked_rows_inside_live_tile_are_zero():
+    """s_q > s_kv with the offset NOT a multiple of block_q: rows 0..31 of
+    tile (0, 0) are fully masked but the tile is live — exp(s - m) with
+    every s at the finite _NEG_INF must not turn into uniform weights."""
+    ks = jax.random.split(jax.random.key(11), 3)
+    q = jax.random.normal(ks[0], (1, 128, 2, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 96, 2, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 96, 2, 32), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=32,
+                          interpret=True)
+    ref = reference_attention(q, k, v, causal=True)
+    # Rows 0..31 see no keys (row r attends to cols <= r - 32): exact zero.
+    np.testing.assert_array_equal(np.asarray(out[:, :32]), 0.0)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, block_q=64, block_k=32,
+        interpret=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        reference_attention(q, k, v, causal=True) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        assert np.all(np.isfinite(np.asarray(a)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
+
+
+def test_gradients_bf16():
+    q, k, v = _qkv(b=1, s=128, h=2, d=32, dtype=jnp.bfloat16, seed=5)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            flash_attention(q, k, v, interpret=True).astype(jnp.float32)
+            ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            reference_attention(q, k, v).astype(jnp.float32) ** 2)
+
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for gf, gr in zip(g_flash, g_ref):
+        assert gf.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gr, np.float32),
+            atol=6e-2, rtol=6e-2)
