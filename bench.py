@@ -172,7 +172,7 @@ def main() -> int:
         MEASURE_TIMEOUT_S, retry_on_timeout=False)
     if not ok:
         why = (f"measurement did not finish within {MEASURE_TIMEOUT_S}s"
-               if rc is None else f"worker exited rc={rc} (x2 attempts)")
+               if rc is None else f"worker exited rc={rc}")
         return _fail("measure", f"{why}; stderr: {err.strip()}")
 
     # Re-emit the worker's metric line (last parseable metric dict wins).

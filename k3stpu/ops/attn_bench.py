@@ -60,7 +60,9 @@ def _attn_flops(b, s, h, d, causal, backward):
 
 
 def _time_fn(fn, args, iters, trials=3):
-    pull = lambda x: float(_abs_sum(jax.tree.leaves(x)[0]))
+    # Reduce over EVERY output leaf (fwd+bwd returns (dq, dk, dv)): the
+    # device->host pull is the sync point and the NaN check must see all.
+    pull = lambda x: sum(float(_abs_sum(l)) for l in jax.tree.leaves(x))
 
     pull(fn(*args))  # compile + pipeline warm-up
     times = []

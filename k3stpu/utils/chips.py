@@ -45,6 +45,10 @@ class TpuChip:
     generation: str                # e.g. "tpu-v5e"
     numa_node: int                 # -1 if unknown
     dev_paths: tuple[str, ...]     # device nodes to inject, e.g. ("/dev/accel0",)
+    # ICI mesh coordinates on the host tray: a driver/provisioning-exposed
+    # `tpu_coords` sysfs attribute ("x,y") when present, else row-major tray
+    # defaults (v5e trays are wired row-major). Mirrors native TpuChip.
+    coords: tuple[int, int] = (-1, -1)
 
 
 @dataclass
@@ -100,10 +104,19 @@ def enumerate_chips(root: str | None = None) -> TpuInventory:
     accel_nodes = _accel_nodes(root)
     vfio_nodes = _vfio_nodes(root)
 
+    cols = tray_cols(len(tpu_bdfs))
     for idx, bdf in enumerate(tpu_bdfs):
         dev_dir = os.path.join(pci_dir, bdf)
         device_id = (_read(os.path.join(dev_dir, "device")) or "").lower()
         numa = _read(os.path.join(dev_dir, "numa_node"))
+        raw_coords = _read(os.path.join(dev_dir, "tpu_coords"))
+        coords = (idx % cols, idx // cols)
+        if raw_coords and "," in raw_coords:
+            x, _, y = raw_coords.partition(",")
+            try:
+                coords = (int(x), int(y))
+            except ValueError:
+                pass
         # Chips consume accel nodes first (in index order); any remaining
         # chips map onto the vfio groups starting from vfio[0].
         devs: tuple[str, ...]
@@ -122,9 +135,16 @@ def enumerate_chips(root: str | None = None) -> TpuInventory:
                 generation=PCI_DEVICE_IDS.get(device_id, "tpu-unknown"),
                 numa_node=int(numa) if numa and numa.lstrip("-").isdigit() else -1,
                 dev_paths=devs,
+                coords=coords,
             )
         )
     return inv
+
+
+def tray_cols(n_chips: int) -> int:
+    """Columns of the host tray mesh (x extent of row-major coords):
+    8 -> 4 (a 2x4 v5e tray), 4 -> 2, else a 1xN line."""
+    return {4: 2, 8: 4, 16: 4}.get(n_chips, n_chips or 1)
 
 
 def _accel_nodes(root: str) -> list[str]:

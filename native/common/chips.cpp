@@ -111,8 +111,35 @@ std::vector<TpuChip> enumerate_chips(const std::string& root_in) {
       chip.dev_paths = {"/dev/vfio/" + vfio[idx - accel.size()],
                         "/dev/vfio/vfio"};
     }
+    // ICI coords: a `tpu_coords` sysfs attribute ("x,y") is ground truth
+    // when present (driver/provisioning-exposed adjacency).
+    const std::string coords = read_trimmed(dev_dir + "/tpu_coords");
+    size_t comma = coords.find(',');
+    if (comma != std::string::npos) {
+      const std::string xs = coords.substr(0, comma);
+      const std::string ys = coords.substr(comma + 1);
+      // Digits-only on both halves; anything else falls back to the
+      // row-major defaults below (atoi would silently yield (0,0)).
+      if (!xs.empty() && !ys.empty() &&
+          xs.find_first_not_of("0123456789") == std::string::npos &&
+          ys.find_first_not_of("0123456789") == std::string::npos) {
+        chip.coord_x = std::atoi(xs.c_str());
+        chip.coord_y = std::atoi(ys.c_str());
+      }
+    }
+
     chips.push_back(std::move(chip));
     ++idx;
+  }
+
+  // Chips without driver-exposed coords get row-major tray defaults (v5e
+  // host trays are wired row-major), so adjacency is always defined.
+  const int cols = tray_cols(chips.size());
+  for (auto& chip : chips) {
+    if (chip.coord_x < 0 || chip.coord_y < 0) {
+      chip.coord_x = chip.index % cols;
+      chip.coord_y = chip.index / cols;
+    }
   }
   return chips;
 }
@@ -137,6 +164,15 @@ std::string topology_for(size_t n) {
     case 8: return "2x4";
     case 16: return "4x4";
     default: return "1x" + std::to_string(n);
+  }
+}
+
+int tray_cols(size_t n) {
+  switch (n) {
+    case 4: return 2;   // 2x2
+    case 8: return 4;   // 2x4
+    case 16: return 4;  // 4x4
+    default: return n ? static_cast<int>(n) : 1;  // 1xN line
   }
 }
 

@@ -20,6 +20,12 @@ struct TpuChip {
   std::string generation;              // "tpu-v5e" | "tpu-unknown" | ...
   int numa_node = -1;
   std::vector<std::string> dev_paths;  // e.g. {"/dev/accel0"}
+  // ICI mesh coordinates on the host tray. Ground truth when the driver
+  // (or site provisioning) exposes a per-chip `tpu_coords` sysfs attribute
+  // ("x,y"); otherwise derived row-major from the tray shape — v5e host
+  // trays are wired row-major, so (index % cols, index / cols).
+  int coord_x = -1;
+  int coord_y = -1;
 };
 
 inline constexpr const char* kGoogleVendorId = "0x1ae0";
@@ -38,5 +44,9 @@ std::string find_libtpu(const std::string& root = "");
 
 // "1x1", "2x2", "2x4" ... best-effort local ICI topology for n chips.
 std::string topology_for(size_t n_chips);
+
+// Columns of the host tray mesh for n chips (rows = n / cols): the x extent
+// of the row-major coordinate assignment. 8 -> 4 (a 2x4 tray), 4 -> 2.
+int tray_cols(size_t n_chips);
 
 }  // namespace k3stpu

@@ -42,6 +42,9 @@ int dump_inventory(const k3stpu::plugin::PluginConfig& config) {
     o->set("pci", Value::make_string(c.pci_address));
     o->set("generation", Value::make_string(c.generation));
     o->set("numa", Value::make_int(c.numa_node));
+    auto coords = o->ensure_array("coords");
+    coords->arr_v.push_back(Value::make_int(c.coord_x));
+    coords->arr_v.push_back(Value::make_int(c.coord_y));
     auto devs = o->ensure_array("dev_paths");
     for (const auto& d : c.dev_paths)
       devs->arr_v.push_back(Value::make_string(d));

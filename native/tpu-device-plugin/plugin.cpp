@@ -239,10 +239,12 @@ std::string TpuDevicePlugin::handle_preferred(const std::string& request) {
       }
     }
 
-    // Topology-aware choice (SURVEY.md §7 "Hard parts"): prefer replicas on
-    // the fewest chips, and chips in the tightest contiguous index window —
-    // contiguous indices are ICI neighbors on a v5e host tray, so multi-chip
-    // pods land on a connected sub-mesh.
+    // Topology-aware choice (SURVEY.md §7 "Hard parts"): pick chips that
+    // form the tightest axis-aligned rectangle in actual ICI coordinates
+    // (TpuChip.coord_x/y — sysfs-exposed when available, row-major tray
+    // defaults otherwise). Contiguous *indices* are NOT always neighbors:
+    // on a 2x4 tray, chips 3 (3,0) and 4 (0,1) share no ICI link, while
+    // {0,1,4,5} form a perfect 2x2 sub-mesh.
     std::map<int, std::vector<std::string>> by_chip;
     for (auto& id : available) {
       DeviceId d;
@@ -253,37 +255,101 @@ std::string TpuDevicePlugin::handle_preferred(const std::string& request) {
 
     std::vector<std::string> chosen(must.begin(), must.end());
     std::set<std::string> chosen_set(must.begin(), must.end());
-    std::vector<int> chip_order;
-    for (const auto& [chip, _] : by_chip) chip_order.push_back(chip);
+    for (auto& [_, ids] : by_chip) {  // must-ids no longer count as free
+      ids.erase(std::remove_if(ids.begin(), ids.end(),
+                               [&](const std::string& id) {
+                                 return chosen_set.count(id) > 0;
+                               }),
+                ids.end());
+    }
 
-    // Find the shortest contiguous chip window whose capacity covers `size`.
-    size_t best_lo = 0, best_len = chip_order.size() + 1;
-    for (size_t lo = 0; lo < chip_order.size(); ++lo) {
-      size_t have = 0;
-      for (size_t hi = lo; hi < chip_order.size(); ++hi) {
-        if (hi > lo && chip_order[hi] != chip_order[hi - 1] + 1) break;
-        have += by_chip[chip_order[hi]].size();
-        if (have >= static_cast<size_t>(size)) {
-          if (hi - lo + 1 < best_len) {
-            best_len = hi - lo + 1;
-            best_lo = lo;
-          }
-          break;
-        }
+    struct ChipPos { int chip; int x; int y; size_t free; };
+    std::vector<ChipPos> pos;
+    std::set<int> must_chips;
+    for (const auto& id : must) {
+      DeviceId d;
+      if (parse_device_id(id, d)) must_chips.insert(d.chip);
+    }
+    std::vector<std::pair<int, int>> must_pos;  // coords of pinned chips
+    {
+      std::lock_guard<std::mutex> lock(mu_);
+      for (const auto& c : chips_) {
+        if (c.coord_x < 0 || c.coord_y < 0) continue;
+        auto it = by_chip.find(c.index);
+        if (it != by_chip.end())
+          pos.push_back({c.index, c.coord_x, c.coord_y, it->second.size()});
+        if (must_chips.count(c.index))
+          must_pos.emplace_back(c.coord_x, c.coord_y);
       }
     }
-    if (best_len <= chip_order.size()) {
-      for (size_t i = best_lo;
-           i < best_lo + best_len &&
-           chosen.size() < static_cast<size_t>(size);
-           ++i) {
-        for (const auto& id : by_chip[chip_order[i]]) {
+
+    const size_t need =
+        size > static_cast<int64_t>(chosen.size())
+            ? static_cast<size_t>(size) - chosen.size() : 0;
+
+    // Enumerate all rectangles over the tray; among those whose available
+    // capacity covers the request, minimize (area, perimeter) — the most
+    // compact connected sub-mesh — tie-broken toward the origin for
+    // determinism.
+    int max_x = 0, max_y = 0;
+    for (const auto& p : pos) {
+      max_x = std::max(max_x, p.x);
+      max_y = std::max(max_y, p.y);
+    }
+    for (const auto& [x, y] : must_pos) {
+      max_x = std::max(max_x, x);
+      max_y = std::max(max_y, y);
+    }
+    struct Rect { int x0, y0, x1, y1; };
+    Rect best{};
+    long best_area = -1, best_perim = 0;
+    if (need > 0 && !pos.empty()) {
+      for (int y0 = 0; y0 <= max_y; ++y0)
+        for (int y1 = y0; y1 <= max_y; ++y1)
+          for (int x0 = 0; x0 <= max_x; ++x0)
+            for (int x1 = x0; x1 <= max_x; ++x1) {
+              // Pinned (must-include) chips anchor the rectangle: the
+              // extra chips must form one sub-mesh WITH them, not a
+              // compact island somewhere else on the tray.
+              bool covers_must = true;
+              for (const auto& [mx, my] : must_pos)
+                if (mx < x0 || mx > x1 || my < y0 || my > y1) {
+                  covers_must = false;
+                  break;
+                }
+              if (!covers_must) continue;
+              size_t cap = 0;
+              for (const auto& p : pos)
+                if (p.x >= x0 && p.x <= x1 && p.y >= y0 && p.y <= y1)
+                  cap += p.free;
+              if (cap < need) continue;
+              long area = long(x1 - x0 + 1) * (y1 - y0 + 1);
+              long perim = long(x1 - x0 + 1) + (y1 - y0 + 1);
+              if (best_area < 0 || area < best_area ||
+                  (area == best_area && perim < best_perim)) {
+                best = {x0, y0, x1, y1};
+                best_area = area;
+                best_perim = perim;
+              }
+            }
+    }
+    if (best_area >= 0) {
+      // Fill row-major within the winning rectangle.
+      std::sort(pos.begin(), pos.end(), [](const ChipPos& a, const ChipPos& b) {
+        return a.y != b.y ? a.y < b.y : a.x < b.x;
+      });
+      for (const auto& p : pos) {
+        if (chosen.size() >= static_cast<size_t>(size)) break;
+        if (p.x < best.x0 || p.x > best.x1 || p.y < best.y0 || p.y > best.y1)
+          continue;
+        for (const auto& id : by_chip[p.chip]) {
           if (chosen.size() >= static_cast<size_t>(size)) break;
           if (chosen_set.insert(id).second) chosen.push_back(id);
         }
       }
     }
-    // Fall back to any available ids if the window search came up short.
+    // Fall back to any available ids if the rectangle search came up short
+    // (e.g. ids for chips that vanished from inventory).
     for (const auto& id : available) {
       if (chosen.size() >= static_cast<size_t>(size)) break;
       if (chosen_set.insert(id).second) chosen.push_back(id);

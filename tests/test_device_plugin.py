@@ -161,9 +161,122 @@ def test_preferred_allocation_contiguous(plugin):
     [chosen] = pb.parse_preferred_response(resp)
     assert len(chosen) == 8
     chips = {int(d.split("-")[1]) for d in chosen}
-    # Chips 0,1 are contiguous (ICI neighbors) and cover 8 ids; chip 3 is
-    # isolated from them and must be avoided.
+    # On the 2x2 tray, chips 0,1 form a 1x2 sub-mesh covering 8 ids; chip 3
+    # at (1,1) would widen the rectangle and must be avoided.
     assert chips == {0, 1}
+
+
+def make_tray_root(tmp_path, n_chips, coords=None):
+    """Fake host fs with an n-chip v5e tray; optional per-chip tpu_coords
+    sysfs attributes (the driver-exposed ground truth)."""
+    for i in range(n_chips):
+        bdf = (tmp_path / "sys" / "bus" / "pci" / "devices"
+               / f"0000:00:{4 + i:02x}.0")
+        bdf.mkdir(parents=True)
+        (bdf / "vendor").write_text("0x1ae0\n")
+        (bdf / "device").write_text("0x0062\n")
+        (bdf / "numa_node").write_text(f"{i * 2 // n_chips}\n")
+        if coords is not None:
+            (bdf / "tpu_coords").write_text("%d,%d\n" % coords[i])
+    dev = tmp_path / "dev"
+    dev.mkdir()
+    for i in range(n_chips):
+        (dev / f"accel{i}").write_text("")
+    return tmp_path
+
+
+@pytest.fixture()
+def tray8_plugin(plugin_bin, tmp_path, request):
+    """Plugin over an 8-chip 2x4 tray (row-major coords), 2 replicas."""
+    coords = getattr(request, "param", None)
+    root = make_tray_root(tmp_path / "root", 8, coords)
+    plugin_dir = tmp_path / "kubelet"
+    plugin_dir.mkdir()
+    proc = subprocess.Popen(
+        [plugin_bin, "--no-register", "--replicas", "2",
+         "--plugin-dir", str(plugin_dir), "--host-root", str(root),
+         "--scan-seconds", "60"],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    sock = plugin_dir / "k3stpu.sock"
+    try:
+        wait_for_socket(str(sock))
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        yield channel
+        channel.close()
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _preferred(channel, available, size, must=()):
+    call = channel.unary_unary(
+        "/v1beta1.DevicePlugin/GetPreferredAllocation", **IDENT)
+    resp = call(pb.preferred_request(list(available), size, list(must)),
+                timeout=5)
+    [chosen] = pb.parse_preferred_response(resp)
+    return chosen
+
+
+def test_preferred_prefers_submesh_over_contiguous_indices(tray8_plugin):
+    """2x4 tray: chips 3 (3,0) and 4 (0,1) are index-contiguous but share
+    no ICI link; chips 4,5 form a real 1x2 sub-mesh and must win."""
+    available = [f"tpu-{c}-0" for c in (3, 4, 5)]
+    chosen = _preferred(tray8_plugin, available, 2)
+    assert {int(d.split("-")[1]) for d in chosen} == {4, 5}
+
+
+def test_preferred_picks_2x2_rectangle_from_noncontiguous(tray8_plugin):
+    """Available {0,1,4,5} (non-contiguous indices) is a perfect 2x2
+    sub-mesh; {2,6} would stretch the rectangle and must be avoided."""
+    available = [f"tpu-{c}-0" for c in (0, 1, 2, 4, 5, 6)]
+    chosen = _preferred(tray8_plugin, available, 4)
+    assert {int(d.split("-")[1]) for d in chosen} == {0, 1, 4, 5}
+
+
+def test_preferred_square_beats_row(tray8_plugin):
+    """For 4 chips with both a 1x4 row and a 2x2 square free, the square
+    wins (equal area, smaller perimeter — more ICI bisection links)."""
+    available = [f"tpu-{c}-0" for c in (0, 1, 2, 3, 4, 5)]
+    chosen = _preferred(tray8_plugin, available, 4)
+    chips = {int(d.split("-")[1]) for d in chosen}
+    assert chips == {0, 1, 4, 5}
+
+
+def test_preferred_counts_replicas_within_rectangle(tray8_plugin):
+    """8 replica-ids on the 2x2 {0,1,4,5} (2 replicas each x 4 chips)
+    satisfy size=8 without leaving the rectangle."""
+    available = [f"tpu-{c}-{r}" for c in (0, 1, 3, 4, 5, 7)
+                 for r in range(2)]
+    chosen = _preferred(tray8_plugin, available, 8)
+    assert {int(d.split("-")[1]) for d in chosen} == {0, 1, 4, 5}
+
+
+def test_preferred_must_include_anchors_rectangle(tray8_plugin):
+    """A pinned chip at (3,0) must pull its companion to an adjacent chip
+    ((2,0) or (3,1)), not to a compact island at the origin."""
+    available = [f"tpu-{c}-0" for c in range(8)]
+    chosen = _preferred(tray8_plugin, available, 2, must=["tpu-3-0"])
+    chips = {int(d.split("-")[1]) for d in chosen}
+    assert "tpu-3-0" in chosen and len(chips) == 2
+    assert chips - {3} <= {2, 7}, chips
+
+
+@pytest.mark.parametrize(
+    "tray8_plugin",
+    # Driver-exposed coords override the row-major default: snake layout,
+    # second row reversed — chip 4 sits at (3,1) under chip 3 (3,0).
+    [[(0, 0), (1, 0), (2, 0), (3, 0), (3, 1), (2, 1), (1, 1), (0, 1)]],
+    indirect=True)
+def test_preferred_uses_sysfs_coords_when_present(tray8_plugin):
+    """With snake-order tpu_coords, index neighbors 3,4 ARE mesh neighbors
+    ((3,0)/(3,1)) while 4,5 are still adjacent; 3,4 must now win over the
+    lexically-earlier-but-wider {3,5} or index pairs like {5,6}."""
+    available = [f"tpu-{c}-0" for c in (3, 4, 6)]
+    chosen = _preferred(tray8_plugin, available, 2)
+    assert {int(d.split("-")[1]) for d in chosen} == {3, 4}
 
 
 def test_health_flips_on_device_loss(plugin, fake_host_root):
