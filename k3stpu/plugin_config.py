@@ -39,7 +39,7 @@ def parse_config(text: str) -> dict:
 
     flags = doc.get("flags") or {}
     granularity = flags.get("granularity", "chip")
-    if granularity not in ("chip",):
+    if granularity not in ("chip", "core"):
         raise ValueError(f"unsupported granularity: {granularity}")
 
     out = {
@@ -77,6 +77,8 @@ def argv_for(settings: dict, binary: str, extra: "list[str] | None" = None) -> l
         "--resource", settings["resource"],
         "--replicas", str(settings["replicas"]),
     ]
+    if settings["granularity"] != "chip":
+        argv.extend(["--granularity", settings["granularity"]])
     if settings["fail_multi"]:
         argv.append("--fail-multi")
     argv.extend(extra or [])

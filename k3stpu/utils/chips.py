@@ -113,10 +113,11 @@ def enumerate_chips(root: str | None = None) -> TpuInventory:
         coords = (idx % cols, idx // cols)
         if raw_coords and "," in raw_coords:
             x, _, y = raw_coords.partition(",")
-            try:
+            # Same validation as native/common/chips.cpp: digits-only and
+            # within the n x n tray extent, else the row-major default.
+            n = len(tpu_bdfs)
+            if x.isdigit() and y.isdigit() and int(x) < n and int(y) < n:
                 coords = (int(x), int(y))
-            except ValueError:
-                pass
         # Chips consume accel nodes first (in index order); any remaining
         # chips map onto the vfio groups starting from vfio[0].
         devs: tuple[str, ...]

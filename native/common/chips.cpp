@@ -118,9 +118,9 @@ std::vector<TpuChip> enumerate_chips(const std::string& root_in) {
     if (comma != std::string::npos) {
       const std::string xs = coords.substr(0, comma);
       const std::string ys = coords.substr(comma + 1);
-      // Digits-only on both halves; anything else falls back to the
-      // row-major defaults below (atoi would silently yield (0,0)).
-      if (!xs.empty() && !ys.empty() &&
+      // Digits-only on both halves (atoi would silently yield (0,0));
+      // length-capped so the bounds check below can't overflow.
+      if (!xs.empty() && !ys.empty() && xs.size() <= 6 && ys.size() <= 6 &&
           xs.find_first_not_of("0123456789") == std::string::npos &&
           ys.find_first_not_of("0123456789") == std::string::npos) {
         chip.coord_x = std::atoi(xs.c_str());
@@ -132,11 +132,16 @@ std::vector<TpuChip> enumerate_chips(const std::string& root_in) {
     ++idx;
   }
 
-  // Chips without driver-exposed coords get row-major tray defaults (v5e
+  // Coords are only trusted within the tray extent: an n-chip tray fits in
+  // an n x n grid, and the allocator's rectangle search is O(extent^4) —
+  // out-of-range values (junk, or global slice coords) would wedge it.
+  // Rejected or absent coords fall back to row-major tray defaults (v5e
   // host trays are wired row-major), so adjacency is always defined.
+  const int n = static_cast<int>(chips.size());
   const int cols = tray_cols(chips.size());
   for (auto& chip : chips) {
-    if (chip.coord_x < 0 || chip.coord_y < 0) {
+    if (chip.coord_x < 0 || chip.coord_y < 0 ||
+        chip.coord_x >= n || chip.coord_y >= n) {
       chip.coord_x = chip.index % cols;
       chip.coord_y = chip.index / cols;
     }
@@ -165,6 +170,13 @@ std::string topology_for(size_t n) {
     case 16: return "4x4";
     default: return "1x" + std::to_string(n);
   }
+}
+
+int cores_per_chip(const std::string& generation) {
+  if (generation == "tpu-v2/v3" || generation == "tpu-v4" ||
+      generation == "tpu-v5p")
+    return 2;
+  return 1;  // v5e, v6e, unknown: one TensorCore per chip
 }
 
 int tray_cols(size_t n) {

@@ -49,4 +49,9 @@ std::string topology_for(size_t n_chips);
 // of the row-major coordinate assignment. 8 -> 4 (a 2x4 tray), 4 -> 2.
 int tray_cols(size_t n_chips);
 
+// TensorCores per chip for a generation string ("tpu-v5p" -> 2): v2/v3/v4/
+// v5p chips carry two TensorCores (megacore), v5e/v6e one. The per-core
+// sharing granularity (the reference's MIG-analogue knob) splits on this.
+int cores_per_chip(const std::string& generation);
+
 }  // namespace k3stpu

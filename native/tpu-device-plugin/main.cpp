@@ -31,9 +31,12 @@ int dump_inventory(const k3stpu::plugin::PluginConfig& config) {
   root->set("resource", Value::make_string(config.resource_name));
   root->set("replicas", Value::make_int(config.replicas));
   root->set("chip_count", Value::make_int(static_cast<int64_t>(chips.size())));
-  root->set("schedulable",
-            Value::make_int(static_cast<int64_t>(chips.size()) *
-                            config.replicas));
+  root->set("granularity", Value::make_string(config.granularity));
+  int64_t units = 0;
+  for (const auto& c : chips)
+    units += config.granularity == "core"
+                 ? k3stpu::cores_per_chip(c.generation) : 1;
+  root->set("schedulable", Value::make_int(units * config.replicas));
   root->set("topology", Value::make_string(k3stpu::topology_for(chips.size())));
   auto arr = root->ensure_array("chips");
   for (const auto& c : chips) {
@@ -59,6 +62,7 @@ void usage() {
       "tpu-device-plugin [options]\n"
       "  --resource NAME       extended resource name (google.com/tpu)\n"
       "  --replicas N          shares per chip, parity with time-slicing\n"
+      "  --granularity G       chip (default) | core (per-TensorCore units)\n"
       "  --fail-multi          reject >1 device per container\n"
       "  --plugin-dir DIR      kubelet device-plugin dir\n"
       "  --socket NAME         plugin socket filename (k3stpu.sock)\n"
@@ -85,6 +89,7 @@ int main(int argc, char** argv) {
     };
     if (a == "--resource") config.resource_name = next("--resource");
     else if (a == "--replicas") config.replicas = std::stoi(next("--replicas"));
+    else if (a == "--granularity") config.granularity = next("--granularity");
     else if (a == "--fail-multi") config.fail_requests_greater_than_one = true;
     else if (a == "--plugin-dir") config.device_plugin_dir = next("--plugin-dir");
     else if (a == "--socket") config.socket_name = next("--socket");
@@ -98,6 +103,10 @@ int main(int argc, char** argv) {
   }
   if (config.replicas < 1) {
     std::cerr << "--replicas must be >= 1\n";
+    return 2;
+  }
+  if (config.granularity != "chip" && config.granularity != "core") {
+    std::cerr << "--granularity must be chip or core\n";
     return 2;
   }
   if (dump) return dump_inventory(config);

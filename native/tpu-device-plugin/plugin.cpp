@@ -64,7 +64,16 @@ bool parse_device_id(const std::string& id, DeviceId& out) {
   if (dash == std::string::npos) return false;
   try {
     out.chip = std::stoi(id.substr(4, dash - 4));
-    out.replica = std::stoi(id.substr(dash + 1));
+    std::string rest = id.substr(dash + 1);
+    out.core = -1;
+    if (!rest.empty() && rest[0] == 'c') {  // "c<core>-<replica>"
+      size_t d2 = rest.find('-');
+      if (d2 == std::string::npos) return false;
+      out.core = std::stoi(rest.substr(1, d2 - 1));
+      rest = rest.substr(d2 + 1);
+      if (out.core < 0) return false;
+    }
+    out.replica = std::stoi(rest);
   } catch (...) {
     return false;
   }
@@ -73,6 +82,11 @@ bool parse_device_id(const std::string& id, DeviceId& out) {
 
 std::string format_device_id(int chip, int replica) {
   return "tpu-" + std::to_string(chip) + "-" + std::to_string(replica);
+}
+
+std::string format_device_id(int chip, int core, int replica) {
+  return "tpu-" + std::to_string(chip) + "-c" + std::to_string(core) + "-" +
+         std::to_string(replica);
 }
 
 TpuDevicePlugin::TpuDevicePlugin(PluginConfig config)
@@ -95,13 +109,18 @@ std::string TpuDevicePlugin::handle_options(const std::string&) const {
 std::string TpuDevicePlugin::list_and_watch_payload() {
   std::lock_guard<std::mutex> lock(mu_);
   std::string out;
+  const bool per_core = config_.granularity == "core";
   for (const auto& chip : chips_) {
     const std::string health =
         chip.dev_paths.empty() ? "Unhealthy" : kHealthy;
-    for (int r = 0; r < config_.replicas; ++r)
-      pw::put_message(
-          out, 1, encode_device(format_device_id(chip.index, r), health,
-                                chip.numa_node));
+    const int cores = per_core ? cores_per_chip(chip.generation) : 1;
+    for (int c = 0; c < cores; ++c)
+      for (int r = 0; r < config_.replicas; ++r)
+        pw::put_message(
+            out, 1,
+            encode_device(per_core ? format_device_id(chip.index, c, r)
+                                   : format_device_id(chip.index, r),
+                          health, chip.numa_node));
   }
   return out;
 }
@@ -114,11 +133,13 @@ std::string TpuDevicePlugin::allocate_one_container(
                             " are disabled (failRequestsGreaterThanOne)"};
 
   std::set<int> chip_set;
+  std::map<int, std::set<int>> cores_by_chip;  // core-granularity ids only
   for (const auto& id : ids) {
     DeviceId d;
     if (!parse_device_id(id, d))
       throw h2::GrpcError{3, "malformed device id: " + id};
     chip_set.insert(d.chip);
+    if (d.core >= 0) cores_by_chip[d.chip].insert(d.core);
   }
 
   std::lock_guard<std::mutex> lock(mu_);
@@ -139,13 +160,34 @@ std::string TpuDevicePlugin::allocate_one_container(
   if (!chips_.empty())
     put_env("TPU_ACCELERATOR_TYPE",
             chips_.front().generation + "-" + std::to_string(chip_list.size()));
-  if (config_.replicas > 1) {
-    // Shared chips: multiple JAX processes coexist on one chip, so cap each
-    // pod's premapped HBM slice instead of letting libtpu assume exclusive
-    // ownership (SURVEY.md §7 "Hard parts": Allocate semantics for shared
-    // chips).
-    put_env("TPU_MEM_FRACTION",
-            std::to_string(1.0 / config_.replicas).substr(0, 6));
+
+  // Per-core (MIG-analogue) allocations: tell the pod which TensorCores of
+  // its visible chips it owns ("chip:core" csv, consumed by the workload
+  // launcher to pin XLA to a core), and derive its HBM share from the
+  // fraction of the chip it holds.
+  double min_core_share = 1.0;
+  if (config_.granularity == "core" && !cores_by_chip.empty()) {
+    std::string vis;
+    for (const auto& [chip, cores] : cores_by_chip) {
+      auto it = by_index.find(chip);
+      const int n_cores =
+          it != by_index.end() ? cores_per_chip(it->second->generation) : 1;
+      min_core_share = std::min(
+          min_core_share, double(cores.size()) / std::max(n_cores, 1));
+      for (int c : cores)
+        vis += (vis.empty() ? "" : ",") + std::to_string(chip) + ":" +
+               std::to_string(c);
+    }
+    put_env("TPU_VISIBLE_TENSORCORES", vis);
+  }
+
+  const double share = min_core_share / config_.replicas;
+  if (share < 1.0) {
+    // Shared chips (replica time-slicing and/or per-core split): multiple
+    // JAX processes coexist on one chip, so cap each pod's premapped HBM
+    // slice instead of letting libtpu assume exclusive ownership
+    // (SURVEY.md §7 "Hard parts": Allocate semantics for shared chips).
+    put_env("TPU_MEM_FRACTION", std::to_string(share).substr(0, 6));
     put_env("TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES", "1");
   }
 

@@ -30,22 +30,29 @@ namespace k3stpu::plugin {
 
 struct PluginConfig {
   std::string resource_name = "google.com/tpu";
-  int replicas = 1;  // shares per physical chip (1 = exclusive)
+  int replicas = 1;  // shares per physical chip/core (1 = exclusive)
   bool fail_requests_greater_than_one = false;
   std::string device_plugin_dir = "/var/lib/kubelet/device-plugins";
   std::string socket_name = "k3stpu.sock";
   std::string host_root;  // "" = real /
   int health_scan_seconds = 5;
+  // "chip": one schedulable unit per chip (x replicas). "core": one per
+  // TensorCore (the reference's MIG-analogue spatial split, SURVEY.md §2c)
+  // — on 2-core generations (v2-v4, v5p) a chip becomes 2 units.
+  std::string granularity = "chip";
 };
 
 struct DeviceId {
   int chip = 0;
+  int core = -1;  // -1 = whole chip (chip-granularity id)
   int replica = 0;
 };
 
-// "tpu-<chip>-<replica>"; returns false on malformed input.
+// "tpu-<chip>-<replica>" (chip granularity) or "tpu-<chip>-c<core>-<replica>"
+// (core granularity); returns false on malformed input.
 bool parse_device_id(const std::string& id, DeviceId& out);
 std::string format_device_id(int chip, int replica);
+std::string format_device_id(int chip, int core, int replica);
 
 class TpuDevicePlugin {
  public:

@@ -25,7 +25,67 @@ REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
 if str(REPO_ROOT) not in sys.path:
     sys.path.insert(0, str(REPO_ROOT))
 
+import contextlib
+import signal
+import subprocess
+import time
+
 import pytest
+
+NATIVE_BUILD_DIR = REPO_ROOT / "native" / "build"
+
+
+@pytest.fixture(scope="session")
+def native_build():
+    """Build all native binaries once per session; returns the build dir."""
+    subprocess.run(
+        ["cmake", "-S", str(REPO_ROOT / "native"), "-B",
+         str(NATIVE_BUILD_DIR)], check=True, capture_output=True)
+    subprocess.run(["cmake", "--build", str(NATIVE_BUILD_DIR)],
+                   check=True, capture_output=True)
+    return NATIVE_BUILD_DIR
+
+
+def wait_for_socket(path, timeout=5.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return
+        time.sleep(0.02)
+    raise TimeoutError(f"socket {path} never appeared")
+
+
+@contextlib.contextmanager
+def plugin_channel_for(build_dir, host_root, plugin_dir, *extra_argv,
+                       expect_clean_exit=True):
+    """Run the device plugin over host_root and yield a grpc channel to its
+    socket; SIGTERM + reap on exit. The single home for this boilerplate —
+    unit, tray, core-granularity, and integration tiers all enter here."""
+    import grpc
+
+    plugin_dir.mkdir(exist_ok=True)
+    proc = subprocess.Popen(
+        [str(build_dir / "tpu-device-plugin"), "--no-register",
+         "--plugin-dir", str(plugin_dir), "--host-root", str(host_root),
+         *extra_argv],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    sock = plugin_dir / "k3stpu.sock"
+    try:
+        wait_for_socket(str(sock))
+        channel = grpc.insecure_channel(f"unix://{sock}")
+        yield channel, proc
+        channel.close()
+        if expect_clean_exit:
+            early = proc.poll()
+            assert early is None, (
+                f"plugin died during test rc={early} "
+                f"stderr={proc.stderr.read()[-2000:]}")
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
 
 
 @pytest.fixture()

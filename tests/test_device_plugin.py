@@ -15,64 +15,28 @@ import grpc
 import pytest
 
 import dp_proto as pb
+from conftest import plugin_channel_for, wait_for_socket
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BIN = os.path.join(REPO, "native", "build", "tpu-device-plugin")
 
 IDENT = dict(request_serializer=lambda x: x,
              response_deserializer=lambda x: x)
 
 
 @pytest.fixture(scope="session")
-def plugin_bin():
-    subprocess.run(
-        ["cmake", "-S", os.path.join(REPO, "native"), "-B",
-         os.path.join(REPO, "native", "build")],
-        check=True, capture_output=True)
-    subprocess.run(
-        ["cmake", "--build", os.path.join(REPO, "native", "build")],
-        check=True, capture_output=True)
-    return BIN
-
-
-def wait_for_socket(path, timeout=5.0):
-    deadline = time.time() + timeout
-    while time.time() < deadline:
-        if os.path.exists(path):
-            return
-        time.sleep(0.02)
-    raise TimeoutError(f"socket {path} never appeared")
+def plugin_bin(native_build):
+    return str(native_build / "tpu-device-plugin")
 
 
 @pytest.fixture()
-def plugin(plugin_bin, fake_host_root, tmp_path, request):
+def plugin(native_build, fake_host_root, tmp_path, request):
     """Plugin with 4 fake v5e chips x 4 replicas, no kubelet registration."""
     kills_plugin = "sigterm" in request.node.name
     plugin_dir = tmp_path / "kubelet"
-    plugin_dir.mkdir()
-    proc = subprocess.Popen(
-        [plugin_bin, "--no-register", "--replicas", "4",
-         "--plugin-dir", str(plugin_dir), "--host-root", str(fake_host_root),
-         "--scan-seconds", "1"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    sock = plugin_dir / "k3stpu.sock"
-    try:
-        wait_for_socket(str(sock))
-        channel = grpc.insecure_channel(f"unix://{sock}")
-        yield channel, proc, plugin_dir
-        channel.close()
-        if not kills_plugin:
-            early = proc.poll()
-            assert early is None, (
-                f"plugin died during test rc={early} "
-                f"stderr={proc.stderr.read()[-2000:]}"
-            )
-    finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+    with plugin_channel_for(native_build, fake_host_root, plugin_dir,
+                            "--replicas", "4", "--scan-seconds", "1",
+                            expect_clean_exit=not kills_plugin) as (ch, proc):
+        yield ch, proc, plugin_dir
 
 
 def test_dump_inventory(plugin_bin, fake_host_root):
@@ -186,29 +150,14 @@ def make_tray_root(tmp_path, n_chips, coords=None):
 
 
 @pytest.fixture()
-def tray8_plugin(plugin_bin, tmp_path, request):
+def tray8_plugin(native_build, tmp_path, request):
     """Plugin over an 8-chip 2x4 tray (row-major coords), 2 replicas."""
     coords = getattr(request, "param", None)
     root = make_tray_root(tmp_path / "root", 8, coords)
-    plugin_dir = tmp_path / "kubelet"
-    plugin_dir.mkdir()
-    proc = subprocess.Popen(
-        [plugin_bin, "--no-register", "--replicas", "2",
-         "--plugin-dir", str(plugin_dir), "--host-root", str(root),
-         "--scan-seconds", "60"],
-        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
-    sock = plugin_dir / "k3stpu.sock"
-    try:
-        wait_for_socket(str(sock))
-        channel = grpc.insecure_channel(f"unix://{sock}")
-        yield channel
-        channel.close()
-    finally:
-        proc.send_signal(signal.SIGTERM)
-        try:
-            proc.wait(timeout=5)
-        except subprocess.TimeoutExpired:
-            proc.kill()
+    with plugin_channel_for(native_build, root, tmp_path / "kubelet",
+                            "--replicas", "2", "--scan-seconds", "60"
+                            ) as (ch, _):
+        yield ch
 
 
 def _preferred(channel, available, size, must=()):
@@ -262,6 +211,64 @@ def test_preferred_must_include_anchors_rectangle(tray8_plugin):
     chips = {int(d.split("-")[1]) for d in chosen}
     assert "tpu-3-0" in chosen and len(chips) == 2
     assert chips - {3} <= {2, 7}, chips
+
+
+@pytest.fixture()
+def core_plugin(native_build, tmp_path):
+    """Plugin in per-TensorCore granularity over 2 v5p chips (2 cores
+    each), replicas=1 — the reference's MIG-analogue spatial split."""
+    root = make_tray_root(tmp_path / "root", 2)
+    for bdf in (root / "sys" / "bus" / "pci" / "devices").iterdir():
+        if (bdf / "vendor").read_text().strip() == "0x1ae0":
+            (bdf / "device").write_text("0x0063\n")  # v5p: 2 TensorCores
+    with plugin_channel_for(native_build, root, tmp_path / "kubelet",
+                            "--replicas", "1", "--granularity", "core",
+                            "--scan-seconds", "60") as (ch, _):
+        yield ch
+
+
+def test_core_granularity_doubles_schedulable_units(core_plugin):
+    stream = core_plugin.unary_stream(
+        "/v1beta1.DevicePlugin/ListAndWatch", **IDENT)(pb.empty())
+    devices = pb.parse_devices(next(iter(stream)))
+    # 2 chips x 2 TensorCores x 1 replica.
+    assert {d["id"] for d in devices} == {
+        "tpu-0-c0-0", "tpu-0-c1-0", "tpu-1-c0-0", "tpu-1-c1-0"}
+    stream.cancel()
+
+
+def test_core_granularity_allocate_single_core(core_plugin):
+    call = core_plugin.unary_unary(
+        "/v1beta1.DevicePlugin/Allocate", **IDENT)
+    [alloc] = pb.parse_allocate_response(
+        call(pb.allocate_request(["tpu-1-c1-0"]), timeout=5))
+    assert alloc["envs"]["TPU_VISIBLE_CHIPS"] == "1"
+    assert alloc["envs"]["TPU_VISIBLE_TENSORCORES"] == "1:1"
+    # Half a 2-core chip -> half its HBM, shared-process mode on.
+    assert alloc["envs"]["TPU_MEM_FRACTION"].startswith("0.5")
+    assert alloc["envs"]["TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES"] == "1"
+    assert [d["container_path"] for d in alloc["devices"]] == ["/dev/accel1"]
+
+
+def test_core_granularity_whole_chip_is_exclusive(core_plugin):
+    """Both cores of a chip in one pod = the whole chip: no HBM cap, no
+    shared-process mode."""
+    call = core_plugin.unary_unary(
+        "/v1beta1.DevicePlugin/Allocate", **IDENT)
+    [alloc] = pb.parse_allocate_response(
+        call(pb.allocate_request(["tpu-0-c0-0", "tpu-0-c1-0"]), timeout=5))
+    assert alloc["envs"]["TPU_VISIBLE_CHIPS"] == "0"
+    assert alloc["envs"]["TPU_VISIBLE_TENSORCORES"] == "0:0,0:1"
+    assert "TPU_MEM_FRACTION" not in alloc["envs"]
+    assert "TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES" not in alloc["envs"]
+
+
+def test_core_granularity_preferred_allocation(core_plugin):
+    """Rectangle search still groups per-core ids by chip: prefer both
+    cores of one chip over cores spread across two chips."""
+    available = ["tpu-0-c1-0", "tpu-1-c0-0", "tpu-1-c1-0"]
+    chosen = _preferred(core_plugin, available, 2)
+    assert set(chosen) == {"tpu-1-c0-0", "tpu-1-c1-0"}
 
 
 @pytest.mark.parametrize(

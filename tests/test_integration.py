@@ -1,0 +1,150 @@
+"""Integration tier (SURVEY.md §4): device plugin and runtime shim TOGETHER.
+
+The reference's end-to-end check is scheduling a pod and reading the device
+table from its logs (reference README.md:128-156) — kubelet merges the
+plugin's Allocate response into the container, then the accelerator runtime
+patches the OCI spec. Unit tiers cover each half; this tier proves the two
+halves COMPOSE: the spec a pod actually gets after (1) kubelet applies
+Allocate's env/devices/mounts and (2) containerd's RuntimeClass invokes the
+shim, has no duplicate devices, no duplicate mounts, and exactly one value
+for every TPU_* env var — the plugin's.
+"""
+
+import json
+import os
+import subprocess
+
+import pytest
+
+import dp_proto as pb
+from conftest import plugin_channel_for
+
+IDENT = dict(request_serializer=lambda x: x,
+             response_deserializer=lambda x: x)
+
+
+@pytest.fixture()
+def plugin_channel(native_build, fake_host_root, tmp_path):
+    with plugin_channel_for(native_build, fake_host_root,
+                            tmp_path / "kubelet", "--replicas", "4",
+                            "--scan-seconds", "60") as (ch, _):
+        yield ch
+
+
+def kubelet_apply(alloc: dict, fake_host_root) -> dict:
+    """What kubelet+containerd do with an Allocate response before the
+    runtime ever runs: env merged into the container process, DeviceSpecs
+    into linux.devices (+ cgroup allow rules), Mounts into mounts."""
+    spec = {
+        "ociVersion": "1.0.2",
+        "process": {
+            "args": ["python", "-m", "k3stpu.probe"],
+            "env": ["PATH=/usr/bin",
+                    "POD_NAME=probe"] +
+                   [f"{k}={v}" for k, v in sorted(alloc["envs"].items())],
+        },
+        "root": {"path": "rootfs"},
+        "mounts": [
+            {"destination": "/proc", "type": "proc", "source": "proc"},
+        ],
+        "linux": {"namespaces": [{"type": "pid"}],
+                  "devices": [], "resources": {"devices": []}},
+        "annotations": dict(alloc.get("annotations", {})),
+    }
+    for d in alloc["devices"]:
+        spec["linux"]["devices"].append({
+            "path": d["container_path"], "type": "c",
+            "major": 0, "minor": 0, "fileMode": 0o666, "uid": 0, "gid": 0,
+        })
+        spec["linux"]["resources"]["devices"].append({
+            "allow": True, "type": "c", "major": 0, "minor": 0,
+            "access": d["permissions"],
+        })
+    for m in alloc["mounts"]:
+        spec["mounts"].append({
+            "destination": m["container_path"], "type": "bind",
+            "source": str(fake_host_root) + m["host_path"],
+            "options": ["rbind", "ro" if m["read_only"] else "rw"],
+        })
+    return spec
+
+
+def run_shim(build_dir, spec, fake_host_root, tmp_path):
+    bundle = tmp_path / "bundle"
+    bundle.mkdir(exist_ok=True)
+    (bundle / "config.json").write_text(json.dumps(spec))
+    out = subprocess.run(
+        [str(build_dir / "tpu-container-runtime"), "patch",
+         "--bundle", str(bundle), "--dry-run",
+         "--host-root", str(fake_host_root)],
+        capture_output=True, text=True)
+    assert out.returncode == 0, out.stderr
+    return json.loads(out.stdout)
+
+
+def test_allocate_then_shim_compose(plugin_channel, native_build,
+                                    fake_host_root, tmp_path):
+    # 1. kubelet Allocate: two shared replicas collapsing to chips 1,2.
+    call = plugin_channel.unary_unary(
+        "/v1beta1.DevicePlugin/Allocate", **IDENT)
+    resp = call(pb.allocate_request(["tpu-1-0", "tpu-1-2", "tpu-2-0"]),
+                timeout=5)
+    [alloc] = pb.parse_allocate_response(resp)
+    assert alloc["envs"]["TPU_VISIBLE_CHIPS"] == "1,2"
+
+    # 2. kubelet/containerd apply it, 3. the RuntimeClass shim re-patches.
+    spec = kubelet_apply(alloc, fake_host_root)
+    patched = run_shim(native_build, spec, fake_host_root, tmp_path)
+
+    # Env: every TPU_* var appears EXACTLY once, with the plugin's value —
+    # the shim must fill gaps (TPU_LIBRARY_PATH), never duplicate/override.
+    env = patched["process"]["env"]
+    tpu_env = {}
+    for e in env:
+        k, _, v = e.partition("=")
+        if k.startswith("TPU_"):
+            assert k not in tpu_env, f"duplicate env {k}: {env}"
+            tpu_env[k] = v
+    assert tpu_env["TPU_VISIBLE_CHIPS"] == "1,2"
+    assert tpu_env["TPU_CHIPS_PER_PROCESS_BOUNDS"] == "1,1,2"
+    assert tpu_env["TPU_PROCESS_BOUNDS"] == "1,1,1"
+    assert tpu_env["TPU_ACCELERATOR_TYPE"] == "tpu-v5e-2"
+    # Plugin-only (sharing) and shim-only (library path) halves both land.
+    assert tpu_env["TPU_MEM_FRACTION"].startswith("0.25")
+    assert tpu_env["TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES"] == "1"
+    assert tpu_env["TPU_LIBRARY_PATH"] == "/lib/libtpu.so"
+
+    # Devices: exactly the allocated chips' nodes, each once, allow-listed.
+    dev_paths = [d["path"] for d in patched["linux"]["devices"]]
+    assert sorted(dev_paths) == ["/dev/accel1", "/dev/accel2"]
+    allow = patched["linux"]["resources"]["devices"]
+    assert len(allow) == 2 and all(r["allow"] for r in allow)
+
+    # Mounts: libtpu bound exactly once (kubelet's copy wins, shim skips).
+    libtpu = [m for m in patched["mounts"]
+              if m["destination"] == "/lib/libtpu.so"]
+    assert len(libtpu) == 1
+    assert libtpu[0]["source"].endswith("/usr/lib/libtpu.so")
+
+    # Allocation annotation survives the shim untouched.
+    assert patched["annotations"]["tpu.google.com/chips"] == "1,2"
+
+
+def test_shim_alone_still_injects_for_manual_pods(fake_host_root, tmp_path,
+                                                  native_build):
+    """A pod bypassing the plugin (annotation opt-in, no Allocate env) must
+    still get devices + libtpu from the shim alone — the reference's
+    'runtime copies everything needed' behavior (README.md:164)."""
+    spec = {
+        "ociVersion": "1.0.2",
+        "process": {"args": ["python"], "env": ["PATH=/usr/bin"]},
+        "root": {"path": "rootfs"},
+        "annotations": {"tpu.google.com/inject": "true"},
+    }
+    patched = run_shim(native_build, spec, fake_host_root, tmp_path)
+    env = {e.partition("=")[0]: e.partition("=")[2]
+           for e in patched["process"]["env"]}
+    assert env["TPU_VISIBLE_CHIPS"] == "0,1,2,3"
+    assert len(patched["linux"]["devices"]) == 4
+    assert any(m["destination"] == "/lib/libtpu.so"
+               for m in patched["mounts"])

@@ -34,6 +34,18 @@ def test_empty_config_is_exclusive():
                                   "--replicas", "1"]
 
 
+def test_core_granularity_accepted():
+    s = parse_config(V1.replace("granularity: chip", "granularity: core"))
+    assert s["granularity"] == "core"
+    argv = argv_for(s, "bin")
+    assert argv[argv.index("--granularity") + 1] == "core"
+
+
+def test_chip_granularity_omits_flag():
+    argv = argv_for(parse_config(V1), "bin")
+    assert "--granularity" not in argv
+
+
 def test_fail_requests_greater_than_one():
     s = parse_config(V1.replace("failRequestsGreaterThanOne: false",
                                 "failRequestsGreaterThanOne: true"))
