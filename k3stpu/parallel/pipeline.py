@@ -101,13 +101,27 @@ def _pipeline_program(mesh: Mesh, block_apply, axis_name: str,
 
         (_, outputs), _ = jax.lax.scan(
             tick, (recv0, outputs0), jnp.arange(m + p - 1))
-        return outputs
 
+        # Only the LAST stage's (M, mb, ...) buffer is the pipeline output;
+        # every other stage's holds in-flight garbage. Mask those to zero and
+        # move O(M) data — never gather all P buffers (P-fold waste):
+        #  - M % P == 0: psum_scatter leaves microbatch chunk i on device i
+        #    (ring traffic ~M/P per hop; output stays pipe-sharded);
+        #  - otherwise: psum replicates the single real buffer (~M per hop).
+        masked = jnp.where(idx == p - 1, outputs, jnp.zeros_like(outputs))
+        if m % p == 0:
+            return jax.lax.psum_scatter(masked, axis_name,
+                                        scatter_dimension=0, tiled=True)
+        return jax.lax.psum(masked, axis_name)
+
+    scattered = num_micro % mesh.shape[axis_name] == 0
     spec_params = P(axis_name)
     return jax.jit(shard_map(
         run, mesh=mesh,
         in_specs=(spec_params, P()),        # input microbatches replicated
-        out_specs=P(axis_name),             # (P*M, mb, ...); caller slices
+        # (M, mb, ...) global either way — microbatch-sharded over the pipe
+        # axis when psum_scatter applies, replicated otherwise.
+        out_specs=P(axis_name) if scattered else P(),
     ))
 
 
@@ -137,8 +151,7 @@ def pipeline_forward(
 
     program = _pipeline_program(mesh, block_apply, axis_name,
                                 num_microbatches)
+    # (M, mb, ...) — exactly the output, microbatch-sharded over the pipe
+    # axis when M % P == 0 (see _pipeline_program; no P-fold over-gather).
     outputs = program(stacked_params, x_micro)
-    # Every stage emitted an (M, mb, ...) buffer; only the LAST stage's is
-    # the pipeline output (out_specs concatenated them along axis 0).
-    out = outputs[-num_microbatches:]
-    return out.reshape(b, *out.shape[2:])
+    return outputs.reshape(b, *outputs.shape[2:])

@@ -43,8 +43,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--skip-bench", action="store_true")
     ap.add_argument("--attn", action="store_true",
                     help="benchmark flash vs einsum attention")
-    ap.add_argument("--attn-seqs", default="1024,4096,16384",
-                    help="comma-separated sequence lengths for --attn")
+    ap.add_argument("--attn-seqs", default="1024,4096,8192x1,16384",
+                    help="comma-separated S or SxB specs for --attn "
+                         "(batch defaults to 8; 8192x1 keeps the einsum "
+                         "comparison in-memory at long S)")
     args = ap.parse_args(argv)
 
     import jax
@@ -62,6 +64,12 @@ def main(argv: list[str] | None = None) -> int:
     if not ok:
         print("WARNING: no accelerator devices visible (cpu-only backend)")
 
+    # Export live device metrics for host tpu-info's MEMORY/UTIL columns
+    # (hostPath /run/k3stpu; silently skipped where unwritable, e.g. CI).
+    from k3stpu.utils.telemetry import write_metrics
+
+    write_metrics()
+
     if not args.skip_bench:
         from k3stpu.ops.matmul import measure_matmul
 
@@ -75,20 +83,37 @@ def main(argv: list[str] | None = None) -> int:
         print("BENCH_JSON " + json.dumps(res.to_dict()))
 
     if args.attn:
-        from k3stpu.ops.attn_bench import measure_attention
+        from k3stpu.ops.attn_bench import check_attention, measure_attention
 
-        seqs = [int(s) for s in args.attn_seqs.split(",")]
+        # Compiled-vs-oracle correctness first (interpret-mode on CPU): the
+        # bench numbers below only count if the compiled kernel is right.
+        chk = check_attention(seq=1024 if ok else 256,
+                              heads=4 if ok else 2,
+                              head_dim=128 if ok else 64,
+                              interpret=not ok)
+        print(f"attn check S={chk['seq']}: fwd_err={chk['fwd_max_err']:.2e} "
+              f"dq_err={chk['dq_max_err']:.2e} dk_err={chk['dk_max_err']:.2e} "
+              f"dv_err={chk['dv_max_err']:.2e} ok={chk['ok']}")
+        print("ATTN_CHECK_JSON " + json.dumps(chk))
+
+        specs = []  # (seq, batch) pairs; "8192x1" pins batch for that S
+        for tok in args.attn_seqs.split(","):
+            s, _, b = tok.partition("x")
+            specs.append((int(s), int(b) if b else 8))
         if not ok:  # CPU stand-in: one interpreted run at a clamped shape
-            seqs = [min(min(seqs), 512)]
-        for seq in seqs:
-            kwargs = dict(seq=seq)
+            specs = [(min(min(s for s, _ in specs), 512), 2)]
+        for seq, batch in specs:
+            kwargs = dict(seq=seq, batch=batch)
             if not ok:
                 kwargs.update(heads=2, head_dim=64, iters=2,
                               interpret=True)
             for r in measure_attention(**kwargs):
-                print(f"attn S={r.seq} {r.impl:<6} {r.direction:<7}: "
+                print(f"attn S={r.seq} b={r.batch} {r.impl:<6} "
+                      f"{r.direction:<7}: "
                       f"{r.seconds / r.iters * 1e3:8.2f} ms/iter "
-                      f"{r.tflops:7.1f} TFLOP/s")
+                      f"{r.tflops:7.1f} TFLOP/s"
+                      + (f" ({r.mfu * 100:.1f}% MFU)"
+                         if r.mfu is not None else ""))
                 print("ATTN_JSON " + json.dumps(r.to_dict()))
     return 0
 

@@ -1,0 +1,64 @@
+"""Export live per-device metrics for host tools (tpu-info's MEMORY/UTIL).
+
+The reference's nvidia-smi shows live memory and utilization because NVML
+reads them from the driver (reference README.md:78-84). libtpu has no host
+NVML analogue, so the TPU-native design inverts the flow: the process that
+actually holds the chip (probe, serving, training) periodically drops a
+small JSON file that host tools merge into their tables —
+``native/common/chips.cpp:fill_telemetry`` reads it right after the sysfs
+attributes. Pods get it onto the host via a hostPath mount of /run/k3stpu
+(see deploy/manifests/tpu-inference.yaml).
+
+The file: ``{"ts": <unix>, "devices": [{"index", "bytes_in_use",
+"bytes_limit", "duty_cycle_pct"}]}``. ``bytes_*`` come from jax's
+``device.memory_stats()`` (PJRT allocator truth); ``duty_cycle_pct`` is -1
+unless the caller supplies one (serving reports busy-fraction between
+writes). Fields whose source is unavailable are -1, rendered "n/a".
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+DROP_PATH = "/run/k3stpu/metrics.json"
+
+
+def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
+    """Snapshot per-device memory stats from the live jax backend."""
+    import jax
+
+    devices = []
+    for d in jax.local_devices():
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except (RuntimeError, AttributeError, jax.errors.JaxRuntimeError):
+            pass  # backend without memory_stats (e.g. some CPU builds)
+        devices.append({
+            "index": d.id,
+            "bytes_in_use": int(stats.get("bytes_in_use", -1)),
+            "bytes_limit": int(stats.get("bytes_limit", -1)),
+            "duty_cycle_pct": int(duty_cycle_pct),
+        })
+    return {"ts": int(time.time()), "devices": devices}
+
+
+def write_metrics(path: str = DROP_PATH, duty_cycle_pct: int = -1) -> dict:
+    """Atomically write the drop file; returns the payload.
+
+    Atomic (write + rename) so a concurrently-reading tpu-info never sees a
+    torn file; errors never propagate into the workload's hot path — the
+    caller's compute matters more than its observability.
+    """
+    payload = collect_device_metrics(duty_cycle_pct)
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+    except OSError:
+        pass
+    return payload

@@ -3,9 +3,12 @@
 #include <algorithm>
 #include <cstdlib>
 #include <fstream>
+#include <sstream>
 
 #include <dirent.h>
 #include <sys/stat.h>
+
+#include "json.hpp"
 
 namespace k3stpu {
 
@@ -185,6 +188,86 @@ int tray_cols(size_t n) {
     case 8: return 4;   // 2x4
     case 16: return 4;  // 4x4
     default: return n ? static_cast<int>(n) : 1;  // 1xN line
+  }
+}
+
+long long hbm_bytes_for(const std::string& generation) {
+  constexpr long long kGiB = 1024LL * 1024 * 1024;
+  if (generation == "tpu-v2/v3") return 16 * kGiB;  // v2 figure (v3 is 32)
+  if (generation == "tpu-v4") return 32 * kGiB;
+  if (generation == "tpu-v5e") return 16 * kGiB;
+  if (generation == "tpu-v5p") return 95 * kGiB;
+  if (generation == "tpu-v6e") return 32 * kGiB;
+  return -1;
+}
+
+namespace {
+
+long long read_ll(const std::string& path) {
+  const std::string s = read_trimmed(path);
+  if (s.empty() || s.find_first_not_of("0123456789") != std::string::npos)
+    return -1;
+  return std::atoll(s.c_str());
+}
+
+}  // namespace
+
+void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
+  std::string root = host_root(root_in);
+  if (root.back() == '/') root.pop_back();
+
+  // Workload-exported drop file, keyed by chip index. Best-effort: a
+  // missing, stale, or malformed file simply leaves fields at -1.
+  struct Live { long long used = -1, total = -1; int duty = -1; };
+  std::vector<Live> live;
+  std::ifstream f(root + kMetricsDropPath);
+  if (f) {
+    std::stringstream ss;
+    ss << f.rdbuf();
+    try {
+      auto doc = json::parse(ss.str());
+      auto devs = doc && doc->is_object() ? doc->get("devices") : nullptr;
+      if (devs && devs->is_array()) {
+        for (const auto& d : devs->arr_v) {
+          if (!d || !d->is_object()) continue;
+          Live l;
+          if (auto v = d->get("bytes_in_use")) l.used = v->int_v;
+          if (auto v = d->get("bytes_limit")) l.total = v->int_v;
+          if (auto v = d->get("duty_cycle_pct"))
+            l.duty = static_cast<int>(v->int_v);
+          long long idx = -1;
+          if (auto v = d->get("index")) idx = v->int_v;
+          if (idx >= 0 && idx < 4096) {
+            if (live.size() <= static_cast<size_t>(idx))
+              live.resize(idx + 1);
+            live[idx] = l;
+          }
+        }
+      }
+    } catch (const json::ParseError&) {
+      // malformed drop file: ignore, fields stay n/a
+    }
+  }
+
+  const std::string pci_dir = root + "/sys/bus/pci/devices";
+  for (auto& chip : chips) {
+    const std::string dev_dir = pci_dir + "/" + chip.pci_address;
+    // 1) driver sysfs attributes (authoritative when present)
+    chip.mem_used_bytes = read_ll(dev_dir + "/tpu_mem_used_bytes");
+    chip.mem_total_bytes = read_ll(dev_dir + "/tpu_mem_total_bytes");
+    long long duty = read_ll(dev_dir + "/tpu_duty_cycle_pct");
+    chip.duty_cycle_pct = duty > 100 ? -1 : static_cast<int>(duty);
+    // 2) workload drop file
+    if (static_cast<size_t>(chip.index) < live.size()) {
+      const Live& l = live[chip.index];
+      if (chip.mem_used_bytes < 0) chip.mem_used_bytes = l.used;
+      if (chip.mem_total_bytes < 0) chip.mem_total_bytes = l.total;
+      if (chip.duty_cycle_pct < 0 && l.duty >= 0 && l.duty <= 100)
+        chip.duty_cycle_pct = l.duty;
+    }
+    // 3) generation table for the capacity column
+    if (chip.mem_total_bytes < 0)
+      chip.mem_total_bytes = hbm_bytes_for(chip.generation);
   }
 }
 

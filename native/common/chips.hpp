@@ -26,10 +26,23 @@ struct TpuChip {
   // trays are wired row-major, so (index % cols, index / cols).
   int coord_x = -1;
   int coord_y = -1;
+  // Live telemetry (the reference's nvidia-smi shows memory + utilization,
+  // reference README.md:78-84). -1 == unavailable, rendered "n/a". Sources,
+  // best first: per-chip sysfs attributes if the driver exposes them
+  // (tpu_mem_used_bytes / tpu_mem_total_bytes / tpu_duty_cycle_pct), then
+  // the workload-exported metrics drop file (see kMetricsDropPath), then —
+  // for the total only — the generation's known HBM size.
+  long long mem_total_bytes = -1;
+  long long mem_used_bytes = -1;
+  int duty_cycle_pct = -1;
 };
 
 inline constexpr const char* kGoogleVendorId = "0x1ae0";
 inline constexpr const char* kHostRootEnv = "K3STPU_HOST_ROOT";
+// Where TPU workloads export live device metrics for host tools (written by
+// k3stpu/utils/telemetry.py from jax memory_stats; the host CLI merges it
+// into its table the way nvidia-smi merges NVML live data).
+inline constexpr const char* kMetricsDropPath = "/run/k3stpu/metrics.json";
 
 // Root directory of the host filesystem ("/" unless K3STPU_HOST_ROOT is set
 // or an explicit override is given).
@@ -53,5 +66,13 @@ int tray_cols(size_t n_chips);
 // v5p chips carry two TensorCores (megacore), v5e/v6e one. The per-core
 // sharing granularity (the reference's MIG-analogue knob) splits on this.
 int cores_per_chip(const std::string& generation);
+
+// HBM capacity per chip for a generation (public figures); -1 if unknown.
+long long hbm_bytes_for(const std::string& generation);
+
+// Merge live telemetry into `chips`: per-chip sysfs attributes win, then the
+// workload-exported metrics drop file {root}{kMetricsDropPath}, then the
+// generation HBM table fills mem_total. Missing data stays -1 ("n/a").
+void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root = "");
 
 }  // namespace k3stpu

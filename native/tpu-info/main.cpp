@@ -24,8 +24,21 @@ void usage() {
                "  TPU chip inventory from the host PCI/dev tree.\n";
 }
 
+// "123MiB / 16384MiB" (nvidia-smi style, reference README.md:78-84); used
+// may be unknown ("n/a / 16384MiB"); whole cell "n/a" when total unknown.
+std::string mem_cell(long long used, long long total) {
+  if (total < 0) return "n/a";
+  auto mib = [](long long b) { return std::to_string(b >> 20) + "MiB"; };
+  return (used < 0 ? std::string("n/a") : mib(used)) + " / " + mib(total);
+}
+
+std::string util_cell(int pct) {
+  return pct < 0 ? "n/a" : std::to_string(pct) + "%";
+}
+
 int run(const std::string& root, bool as_json) {
   auto chips = k3stpu::enumerate_chips(root);
+  k3stpu::fill_telemetry(chips, root);
   auto libtpu = k3stpu::find_libtpu(root);
 
   if (as_json) {
@@ -42,6 +55,10 @@ int run(const std::string& root, bool as_json) {
       o->set("device_id", Value::make_string(c.device_id));
       o->set("generation", Value::make_string(c.generation));
       o->set("numa", Value::make_int(c.numa_node));
+      // -1 == unavailable, mirroring the "n/a" cells of the human table.
+      o->set("mem_used_bytes", Value::make_int(c.mem_used_bytes));
+      o->set("mem_total_bytes", Value::make_int(c.mem_total_bytes));
+      o->set("duty_cycle_pct", Value::make_int(c.duty_cycle_pct));
       auto devs = o->ensure_array("dev_paths");
       for (const auto& d : c.dev_paths)
         devs->arr_v.push_back(Value::make_string(d));
@@ -49,23 +66,31 @@ int run(const std::string& root, bool as_json) {
     }
     std::cout << k3stpu::json::dump(doc) << "\n";
   } else {
-    std::cout << "+-----------------------------------------------------------+\n";
+    const char* rule =
+        "+-----+---------------+------------+------+----------------------+"
+        "------+-----------------+\n";
+    std::cout << "+------------------------------------------------------------"
+                 "----------------------------+\n";
     std::cout << "| tpu-info            chips: " << chips.size()
               << "   topology: " << k3stpu::topology_for(chips.size()) << "\n";
     std::cout << "| libtpu: " << (libtpu.empty() ? "(not found)" : libtpu) << "\n";
-    std::cout << "+-----+---------------+------------+------+-----------------+\n";
-    std::cout << "| IDX | PCI           | GENERATION | NUMA | DEV             |\n";
-    std::cout << "+-----+---------------+------------+------+-----------------+\n";
+    std::cout << rule;
+    std::cout << "| IDX | PCI           | GENERATION | NUMA | MEMORY           "
+                 "    | UTIL | DEV             |\n";
+    std::cout << rule;
     for (const auto& c : chips) {
       std::string devs;
       for (const auto& d : c.dev_paths) devs += (devs.empty() ? "" : ",") + d;
-      char line[160];
-      std::snprintf(line, sizeof(line), "| %3d | %-13s | %-10s | %4d | %-15s |",
+      char line[200];
+      std::snprintf(line, sizeof(line),
+                    "| %3d | %-13s | %-10s | %4d | %-20s | %4s | %-15s |",
                     c.index, c.pci_address.c_str(), c.generation.c_str(),
-                    c.numa_node, devs.c_str());
+                    c.numa_node,
+                    mem_cell(c.mem_used_bytes, c.mem_total_bytes).c_str(),
+                    util_cell(c.duty_cycle_pct).c_str(), devs.c_str());
       std::cout << line << "\n";
     }
-    std::cout << "+-----+---------------+------------+------+-----------------+\n";
+    std::cout << rule;
   }
   return chips.empty() ? 1 : 0;
 }

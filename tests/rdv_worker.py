@@ -1,0 +1,60 @@
+"""Worker subprocess for the two-process rendezvous integration test.
+
+Launched twice by tests/test_distributed.py with fake Indexed-Job env
+(HOSTNAME=<job>-<i>, JOB_COMPLETION_INDEX=<i>, localhost coordinator) — the
+exact environment deploy/manifests/tpu-pjit-job.yaml gives its pods. Joins
+the JAX process group via k3stpu.parallel.distributed.initialize, forms the
+GLOBAL mesh, runs a psum over it, and prints one JSON result line.
+"""
+
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from k3stpu.parallel.distributed import initialize, rendezvous_from_env  # noqa: E402
+
+
+def main() -> int:
+    rdv = rendezvous_from_env()
+    initialize(rdv)
+
+    import jax.numpy as jnp
+    from jax import shard_map
+
+    devices = jax.devices()  # GLOBAL list after initialize
+    mesh = Mesh(np.array(devices), ("d",))
+    n = len(devices)
+
+    # Global (n,) array, device i holding value i + 1; psum must see every
+    # process's shard — the number cannot come out right from one process.
+    sharding = NamedSharding(mesh, P("d"))
+    x = jax.make_array_from_callback(
+        (n,), sharding, lambda idx: np.arange(1, n + 1, dtype=np.float32)[idx])
+
+    allreduce = jax.jit(shard_map(
+        lambda v: jax.lax.psum(v, "d"), mesh=mesh,
+        in_specs=P("d"), out_specs=P()))
+    total = float(np.asarray(
+        jax.device_get(allreduce(x).addressable_data(0)))[0])
+
+    print(json.dumps({
+        "process_id": rdv.process_id,
+        "num_processes": rdv.num_processes,
+        "coordinator": rdv.coordinator_address,
+        "jax_process_count": jax.process_count(),
+        "global_devices": n,
+        "local_devices": len(jax.local_devices()),
+        "psum_total": total,
+        "expected_total": float(n * (n + 1) / 2),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

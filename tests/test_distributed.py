@@ -77,3 +77,62 @@ def test_launch_main_single_process(capsys, monkeypatch):
     assert events["rendezvous"]["global_devices"] == len(jax.devices())
     assert events["pjit_matmul"]["seconds"] > 0
     assert events["psum_allreduce"]["bus_gbps"] > 0
+
+
+def test_two_process_rendezvous_and_psum(tmp_path):
+    """The north-star Job path actually executes: two real processes with
+    fake Indexed-Job env rendezvous via jax.distributed.initialize on a
+    localhost coordinator, form the GLOBAL 2-device mesh, and a psum sums
+    both processes' shards (SURVEY.md §3.5; tpu-pjit-job.yaml env)."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "rdv_worker.py")
+    with socket.socket() as s:  # free localhost port for the coordinator
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+
+    procs = []
+    for i in range(2):
+        env = dict(os.environ)
+        # No axon/TPU tunnel in the children; 1 CPU device per process.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        repo_root = os.path.dirname(os.path.dirname(worker))
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (repo_root, env.get("PYTHONPATH")) if p)
+        # The Indexed-Job pod environment (deploy/manifests/tpu-pjit-job.yaml):
+        # pod hostname <job>-<index>, kubelet-set JOB_COMPLETION_INDEX, and a
+        # coordinator address (in-cluster it comes from the headless Service;
+        # here the explicit-override leg pins it to localhost).
+        env["HOSTNAME"] = f"tpu-pjit-{i}"
+        env["JOB_COMPLETION_INDEX"] = str(i)
+        env["K3STPU_NUM_PROCESSES"] = "2"
+        env["K3STPU_COORDINATOR"] = f"127.0.0.1:{port}"
+        procs.append(subprocess.Popen(
+            [sys.executable, worker], env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+
+    results = {}
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=180)
+            assert p.returncode == 0, f"worker failed rc={p.returncode}: {err[-2000:]}"
+            rec = json.loads(out.strip().splitlines()[-1])
+            results[rec["process_id"]] = rec
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    assert set(results) == {0, 1}
+    for rec in results.values():
+        assert rec["num_processes"] == 2
+        assert rec["jax_process_count"] == 2
+        assert rec["global_devices"] == 2
+        assert rec["local_devices"] == 1
+        assert rec["psum_total"] == rec["expected_total"] == 3.0
