@@ -22,9 +22,14 @@ from __future__ import annotations
 import json
 import os
 import signal
-import subprocess
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Group-killed bounded subprocesses (shared wedge-proof discipline); pulls in
+# k3stpu/utils only — the parent still never imports jax.
+from k3stpu.utils.subproc import kill_active_groups, run_bounded  # noqa: E402
 
 BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
 PROBE_TIMEOUT_S = 120   # backend init: first tunnel contact + device list
@@ -37,22 +42,11 @@ RETRY_FAST_S = 60       # only failures faster than this are worth retrying
 # the retry leg adds at most 60 + 10 + 480) ~= 800s. Callers must wrap
 # with a timeout ABOVE that (see verify skill: 900s).
 
-_child_pgid: int | None = None
-
-
-def _kill_child_group() -> None:
-    if _child_pgid is not None:
-        try:
-            os.killpg(_child_pgid, signal.SIGKILL)
-        except (ProcessLookupError, PermissionError):
-            pass
-
-
 def _on_term(signum, frame):
     # If the bench itself is killed (e.g. an outer `timeout`), take the
     # chip-holding child down with us — an orphaned wedged jax process
     # would keep the device claim and hang every later run.
-    _kill_child_group()
+    kill_active_groups()
     sys.exit(128 + signum)
 
 _PROBE_SRC = (
@@ -79,29 +73,6 @@ def _fail(stage: str, detail: str) -> int:
     return 0  # structured failure IS the output; don't turn it into an rc
 
 
-def _run_bounded(cmd: list[str], timeout_s: int) -> tuple[int | None, str, str]:
-    """Run cmd in its own process group; on timeout SIGKILL the whole group
-    (a wedged libtpu client must not be left holding the chip claim).
-    Returns (rc, stdout, stderr); rc is None on timeout."""
-    global _child_pgid
-    proc = subprocess.Popen(
-        cmd, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        text=True, start_new_session=True)
-    # start_new_session guarantees the child's pgid == its pid — no
-    # getpgid lookup (which could itself fail and leave the var unset).
-    _child_pgid = proc.pid
-    try:
-        out, err = proc.communicate(timeout=timeout_s)
-        return proc.returncode, out, err
-    except subprocess.TimeoutExpired:
-        _kill_child_group()
-        proc.kill()
-        out, err = proc.communicate()
-        return None, out, err
-    finally:
-        _child_pgid = None
-
-
 def _run_with_retry(cmd: list[str], timeout_s: int, *,
                     retry_on_timeout: bool):
     """One bounded attempt, plus one retry on failure. A timeout is only
@@ -109,14 +80,14 @@ def _run_with_retry(cmd: list[str], timeout_s: int, *,
     failure only when it failed fast — a slow crash retried would blow the
     documented worst-case budget. Returns (ok, rc, out, err)."""
     t0 = time.monotonic()
-    rc, out, err = _run_bounded(cmd, timeout_s)
+    rc, out, err = run_bounded(cmd, timeout_s)
     elapsed = time.monotonic() - t0
     retry = (retry_on_timeout if rc is None
              else rc != 0 and elapsed < RETRY_FAST_S)
     if rc == 0 or not retry:
         return rc == 0, rc, out, err
     time.sleep(RETRY_WAIT_S)
-    rc, out, err = _run_bounded(cmd, timeout_s)
+    rc, out, err = run_bounded(cmd, timeout_s)
     return rc == 0, rc, out, err
 
 

@@ -89,6 +89,15 @@ def main(argv: "list[str] | None" = None) -> int:
             start_step = last
             print(json.dumps({"event": "resume", "step": last}), flush=True)
 
+    # MFU from the standard 6*N*T training-flop estimate (fwd+bwd matmuls;
+    # attention's O(S^2) term is <10% at these shapes) against the chip's
+    # peak — same accounting as ops/matmul.py's probe oracle.
+    from k3stpu.ops.matmul import peak_tflops_for
+
+    n_params = sum(int(x.size) for x in jax.tree.leaves(bundle.params))
+    peak = peak_tflops_for()
+    n_chips = len(devices)
+
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
     for step in range(start_step, args.steps):
@@ -97,9 +106,13 @@ def main(argv: "list[str] | None" = None) -> int:
         t0 = time.perf_counter()
         loss = bundle.run(inputs, labels)
         dt = time.perf_counter() - t0
+        tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
         print(json.dumps({
             "event": "step", "step": step + 1, "loss": round(loss, 4),
+            "step_s": round(dt, 4),
             "tokens_per_s": round(tokens_per_step / dt, 1),
+            "tflops_per_chip": round(tflops, 2),
+            "mfu": round(tflops / peak, 4) if peak else None,
         }), flush=True)
         if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
             ckpt.save_bundle(args.ckpt_dir, step + 1, bundle)

@@ -1,0 +1,154 @@
+"""Concurrent-client load generator for the inference server.
+
+Measures what the micro-batcher exists to improve: aggregate examples/s and
+per-request latency when N clients hit /v1/predict at once. Run it twice —
+``--batch-window-ms 0`` (each request its own device dispatch, the
+pre-coalescing behavior) vs the default window — and the delta is the
+committed before/after artifact (the reference proves its stack with logged
+oracles the same way, reference README.md:128-156).
+
+Self-hosting mode (default) starts the server in-process on a free port so
+one command produces a number on any box (CPU CI or a TPU pod):
+
+    python -m k3stpu.serve.loadgen --model transformer --clients 8 \
+        --seconds 10 --batch-window-ms 5
+
+Point it at a live server instead with --url http://host:8096.
+Emits one LOADGEN_JSON line (pod-log interface, like the probe).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+
+def _client_loop(url: str, payload: bytes, stop: "threading.Event",
+                 latencies: list, lock: "threading.Lock", errors: list):
+    import urllib.request
+
+    while not stop.is_set():
+        req = urllib.request.Request(
+            url + "/v1/predict", data=payload,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        try:
+            with urllib.request.urlopen(req, timeout=300) as r:
+                json.loads(r.read())
+        except Exception as e:  # noqa: BLE001 — record, don't kill the run
+            with lock:
+                errors.append(str(e))
+            return
+        with lock:
+            latencies.append(time.perf_counter() - t0)
+
+
+def run_load(url: str, *, clients: int, seconds: float, rows: int,
+             input_shape: "tuple[int, ...]", input_dtype: str) -> dict:
+    rng = np.random.default_rng(0)
+    if input_dtype == "int32":
+        block = rng.integers(0, 1000, size=(rows, *input_shape),
+                             dtype=np.int32)
+    else:
+        block = rng.standard_normal((rows, *input_shape)).astype(np.float32)
+    payload = json.dumps({"inputs": block.tolist()}).encode()
+
+    latencies: list[float] = []
+    errors: list[str] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    threads = [threading.Thread(
+        target=_client_loop, args=(url, payload, stop, latencies, lock,
+                                   errors), daemon=True)
+        for _ in range(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.perf_counter() - t0
+
+    if errors:
+        raise RuntimeError(f"client errors: {errors[:3]}")
+    lat_ms = sorted(1e3 * l for l in latencies)
+    pick = lambda q: lat_ms[min(len(lat_ms) - 1, int(q * len(lat_ms)))]
+    return {
+        "clients": clients,
+        "rows_per_request": rows,
+        "wall_s": round(wall, 2),
+        "requests": len(lat_ms),
+        "examples": len(lat_ms) * rows,
+        "examples_per_s": round(len(lat_ms) * rows / wall, 2),
+        "p50_ms": round(pick(0.50), 2) if lat_ms else None,
+        "p95_ms": round(pick(0.95), 2) if lat_ms else None,
+    }
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="inference-server load test")
+    ap.add_argument("--url", default=None,
+                    help="existing server; default self-hosts one in-process")
+    ap.add_argument("--model", default="transformer",
+                    choices=["resnet50", "resnet18-tiny", "transformer",
+                             "transformer-tiny"])
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--rows", type=int, default=1,
+                    help="examples per request (1 = worst case for an "
+                         "uncoalesced server)")
+    ap.add_argument("--batch-window-ms", type=float, default=5.0,
+                    help="self-hosted server's coalescing window (0 = off)")
+    ap.add_argument("--image-size", type=int, default=224)
+    ap.add_argument("--seq-len", type=int, default=128)
+    args = ap.parse_args(argv)
+
+    url = args.url
+    card_url = None
+    if url is None:
+        from http.server import ThreadingHTTPServer
+
+        from k3stpu.serve.server import InferenceServer, make_app
+
+        server = InferenceServer(
+            model_name=args.model, image_size=args.image_size,
+            seq_len=args.seq_len, batch_window_ms=args.batch_window_ms)
+        print("warming up...", flush=True)
+        server.warmup()
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    card_url = url + "/v1/models"
+
+    import urllib.request
+
+    with urllib.request.urlopen(card_url, timeout=60) as r:
+        card = json.loads(r.read())
+
+    result = run_load(
+        url, clients=args.clients, seconds=args.seconds, rows=args.rows,
+        input_shape=tuple(card["input_shape"]),
+        input_dtype=card["input_dtype"])
+
+    with urllib.request.urlopen(card_url, timeout=60) as r:
+        card = json.loads(r.read())
+    result.update({
+        "model": card["model"],
+        "window_ms": card["batching"]["window_ms"],
+        "avg_examples_per_dispatch":
+            card["throughput"]["avg_examples_per_dispatch"],
+        "device_examples_per_s": card["throughput"]["examples_per_s"],
+        "devices": card["devices"][:1],
+    })
+    print("LOADGEN_JSON " + json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,77 @@
+"""Bounded subprocess execution with process-group kill — the wedge-proof
+discipline shared by bench.py, share_proof, and tools/capture_artifacts.
+
+The chip is reached through a tunnel that can wedge: a hung child holding
+the device claim would hang every later run, so every child (1) gets its own
+process group (``start_new_session``) and (2) is SIGKILLed as a GROUP on
+timeout — grandchildren included. ``kill_active_groups()`` lets a signal
+handler take every in-flight child down with the parent (bench.py's SIGTERM
+path). Jax is never imported here, so wedge-sensitive parents can import
+this before deciding whether to touch the backend.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import threading
+
+_active_pgids: "set[int]" = set()
+_lock = threading.Lock()
+
+
+def kill_active_groups() -> None:
+    """SIGKILL every process group spawned through this module that has not
+    been reaped yet. Safe from signal handlers (no allocation-heavy work)."""
+    with _lock:
+        pgids = list(_active_pgids)
+    for pgid in pgids:
+        try:
+            os.killpg(pgid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+
+def spawn(cmd: "list[str]", *, env: "dict | None" = None,
+          cwd: "str | None" = None,
+          merge_streams: bool = False) -> subprocess.Popen:
+    """Start cmd in its own process group and register it for group kill."""
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT if merge_streams else subprocess.PIPE,
+        text=True, start_new_session=True, env=env, cwd=cwd)
+    # start_new_session guarantees the child's pgid == its pid.
+    with _lock:
+        _active_pgids.add(proc.pid)
+    return proc
+
+
+def wait_bounded(proc: subprocess.Popen,
+                 timeout_s: float) -> "tuple[int | None, str, str]":
+    """Wait for a spawn()ed child; on timeout SIGKILL its whole group.
+    Returns (rc, stdout, stderr); rc is None on timeout."""
+    try:
+        try:
+            out, err = proc.communicate(timeout=timeout_s)
+            return proc.returncode, out, err or ""
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            proc.kill()  # belt-and-braces if the group vanished mid-kill
+            out, err = proc.communicate()
+            return None, out, err or ""
+    finally:
+        with _lock:
+            _active_pgids.discard(proc.pid)
+
+
+def run_bounded(cmd: "list[str]", timeout_s: float, *,
+                env: "dict | None" = None, cwd: "str | None" = None,
+                merge_streams: bool = False
+                ) -> "tuple[int | None, str, str]":
+    """spawn() + wait_bounded() in one call."""
+    return wait_bounded(
+        spawn(cmd, env=env, cwd=cwd, merge_streams=merge_streams), timeout_s)

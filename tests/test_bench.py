@@ -46,8 +46,10 @@ def test_probe_cpu():
 
 
 def test_run_bounded_kills_on_timeout():
+    from k3stpu.utils.subproc import run_bounded
+
     t0 = time.monotonic()
-    rc, _, _ = bench._run_bounded(
+    rc, _, _ = run_bounded(
         [sys.executable, "-c", "import time; time.sleep(60)"], 1)
     assert rc is None
     assert time.monotonic() - t0 < 10

@@ -143,3 +143,107 @@ def test_generate_rejects_cache_overflow(lm_server):
                          "max_new_tokens": 32})
     assert status == 400
     assert "KV cache" in body["error"]
+
+
+# --- Micro-batching ---------------------------------------------------------
+
+def test_concurrent_requests_coalesce():
+    # 6 concurrent batch-1 requests within one window must land in far
+    # fewer device dispatches (ideally 1) and all get correct slices back.
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16,
+                             batch_window_ms=200.0)
+    server.warmup(batch_sizes=(1, 8))
+    tokens = np.arange(6 * 16, dtype=np.int32).reshape(6, 16) % 50
+    single = [server.predict(tokens[i:i + 1]) for i in range(6)]
+    stats0 = server.model_card()["stats"]
+    d0, e0 = stats0["dispatches"], stats0["examples"]
+
+    results: dict[int, np.ndarray] = {}
+    lock = threading.Lock()
+
+    def call(i):
+        out = server.predict(tokens[i:i + 1])
+        with lock:
+            results[i] = out
+
+    threads = [threading.Thread(target=call, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    card = server.model_card()
+    assert len(results) == 6
+    for i in range(6):  # same rows as the sequential singles
+        np.testing.assert_allclose(results[i], single[i], rtol=2e-5,
+                                   atol=2e-5)
+    dispatches = card["stats"]["dispatches"] - d0
+    assert dispatches <= 3, f"6 concurrent requests took {dispatches} dispatches"
+    assert card["stats"]["examples"] - e0 == 6
+    assert card["throughput"]["examples_per_s"] > 0
+
+
+def test_batcher_carries_overflow():
+    # A request that would overflow max_batch is carried whole, never split.
+    from k3stpu.serve.server import MicroBatcher
+
+    calls = []
+
+    def run(batch, n_requests):
+        calls.append((len(batch), n_requests))
+        return batch
+
+    mb = MicroBatcher(run, window_s=0.05, max_batch=4)
+    outs = {}
+
+    def submit(i, rows):
+        outs[i] = mb.submit(np.full((rows, 2), i, np.float32))
+
+    threads = [threading.Thread(target=submit, args=(0, 3)),
+               threading.Thread(target=submit, args=(1, 3))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert sorted(len(v) for v in outs.values()) == [3, 3]
+    for i, out in outs.items():
+        assert (out == i).all()
+    assert sorted(c[0] for c in calls) == [3, 3]  # two whole dispatches
+
+
+def test_batcher_failure_propagates_to_all():
+    from k3stpu.serve.server import MicroBatcher
+
+    def run(batch, n_requests):
+        raise RuntimeError("device exploded")
+
+    mb = MicroBatcher(run, window_s=0.02, max_batch=8)
+    errs = []
+
+    def submit():
+        try:
+            mb.submit(np.zeros((1, 2), np.float32))
+        except RuntimeError as e:
+            errs.append(str(e))
+
+    threads = [threading.Thread(target=submit) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    assert errs == ["device exploded"] * 3
+    # The dispatcher loop must survive a failed batch.
+    out = None
+    def ok_run(batch, n_requests):
+        return batch
+    mb2 = MicroBatcher(ok_run, window_s=0.01, max_batch=8)
+    out = mb2.submit(np.ones((2, 2), np.float32))
+    assert out.shape == (2, 2)
+
+
+def test_window_zero_disables_coalescing():
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16,
+                             batch_window_ms=0.0)
+    assert server._batcher is None
+    out = server.predict(np.zeros((2, 16), np.int32))
+    assert out.shape[0] == 2
+    assert server.model_card()["stats"]["dispatches"] == 1

@@ -1,0 +1,39 @@
+"""Chip-sharing proof harness on the CPU stand-in backend.
+
+The real artifact runs against the chip (k3stpu/share_proof.py docstring);
+here the same parent/children machinery runs with the CPU backend so CI
+verifies: env construction matches the plugin's Allocate, children really
+execute concurrently, windows overlap, and the JSON oracle is well-formed.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_share_proof_concurrent_cpu():
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU tunnel in children
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (REPO, env.get("PYTHONPATH")) if p)
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.share_proof",
+         "--replicas", "2", "--dim", "256", "--timeout", "120"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    line = next(l for l in out.stdout.splitlines()
+                if l.startswith("SHARE_JSON "))
+    rec = json.loads(line[len("SHARE_JSON "):])
+    assert rec["mode"] == "concurrent"
+    assert rec["ok"] is True
+    assert rec["overlap_s"] > 0
+    assert rec["env"]["TPU_MEM_FRACTION"] == "0.5000"
+    assert rec["env"]["TPU_ALLOW_MULTIPLE_LIBTPU_PROCESSES"] == "1"
+    assert len(rec["children"]) == 2
+    for c in rec["children"]:
+        assert c["ok"] and abs(c["checksum_per_elem"] - 1.0) < 0.05

@@ -1,0 +1,138 @@
+"""Capture the round's on-TPU proof artifacts into artifacts/.
+
+The reference proves its stack with logged oracles read out of pods
+(reference README.md:128-156); this script is the one-command equivalent for
+the repo's TPU claims, each stage a bounded subprocess (same wedge-proof
+discipline as bench.py — a hung tunnel degrades to a structured error line,
+never a hang):
+
+  probe   — device table + matmul MFU + compiled-attention correctness line
+            + flash-vs-einsum bench table   -> artifacts/attn_rNN.log
+  share   — N-way chip-sharing proof        -> artifacts/share_rNN.log
+  train   — train_job run, then a SECOND run that must log a resume line
+                                            -> artifacts/train_rNN.log
+  serve   — loadgen before/after micro-batching (window 0 vs 5 ms)
+                                            -> artifacts/serve_rNN.log
+
+Run: python tools/capture_artifacts.py [--round 3] [--stages probe,share,...]
+Exit 0 if every requested stage produced its artifact, 1 otherwise.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from k3stpu.utils.subproc import run_bounded  # noqa: E402 (needs REPO path)
+
+PROBE_TIMEOUT_S = 120
+
+_PROBE_SRC = ("import jax; ds = jax.devices(); "
+              "print('PROBE_OK', ds[0].platform, len(ds))")
+
+
+def _run_bounded(cmd, timeout_s, log_path=None, env=None):
+    """Bounded group-killed run (k3stpu/utils/subproc) + combined-output log."""
+    rc, out, _ = run_bounded(cmd, timeout_s, env=env, cwd=REPO,
+                             merge_streams=True)
+    if rc is None:
+        out += f"\n[capture] TIMEOUT after {timeout_s}s (process group killed)\n"
+    if log_path:
+        with open(log_path, "a") as f:
+            f.write(f"$ {' '.join(cmd)}\n{out}\n[capture] rc={rc}\n\n")
+    return rc, out
+
+
+def backend_reachable() -> bool:
+    for _ in range(2):
+        rc, out = _run_bounded([sys.executable, "-c", _PROBE_SRC],
+                               PROBE_TIMEOUT_S)
+        if rc == 0 and "PROBE_OK" in out:
+            return True
+        time.sleep(5)
+    return False
+
+
+def stage_probe(log):
+    rc, out = _run_bounded(
+        [sys.executable, "-m", "k3stpu.probe", "--attn", "--iters", "30"],
+        1800, log)
+    return rc == 0 and "ATTN_JSON" in out and "ATTN_CHECK_JSON" in out
+
+
+def stage_share(log):
+    rc, out = _run_bounded(
+        [sys.executable, "-m", "k3stpu.share_proof", "--replicas", "2"],
+        900, log)
+    # rc 0 == concurrent PASS or documented sequential fallback; rc 1 means
+    # neither worked — that log is a failure record, not a proof artifact.
+    return rc == 0 and "SHARE_JSON" in out
+
+
+def stage_train(log):
+    import tempfile
+
+    ckpt = tempfile.mkdtemp(prefix="k3stpu-train-")
+    rc1, out1 = _run_bounded(
+        [sys.executable, "-m", "k3stpu.parallel.train_job", "--steps", "20",
+         "--ckpt-dir", ckpt, "--ckpt-every", "10"], 1800, log)
+    rc2, out2 = _run_bounded(
+        [sys.executable, "-m", "k3stpu.parallel.train_job", "--steps", "30",
+         "--ckpt-dir", ckpt, "--ckpt-every", "10"], 1800, log)
+    return (rc1 == 0 and rc2 == 0 and '"event": "resume"' in out2
+            and '"event": "step"' in out2)
+
+
+def stage_serve(log):
+    ok = True
+    for window in ("0", "5"):
+        rc, out = _run_bounded(
+            [sys.executable, "-m", "k3stpu.serve.loadgen", "--model",
+             "transformer", "--clients", "8", "--seconds", "15",
+             "--batch-window-ms", window], 1800, log)
+        ok = ok and rc == 0 and "LOADGEN_JSON" in out
+    return ok
+
+
+STAGES = {"probe": stage_probe, "share": stage_share,
+          "train": stage_train, "serve": stage_serve}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="capture on-TPU artifacts")
+    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--stages", default="probe,share,train,serve")
+    ap.add_argument("--skip-reachability", action="store_true")
+    args = ap.parse_args(argv)
+
+    os.makedirs(os.path.join(REPO, "artifacts"), exist_ok=True)
+    if not args.skip_reachability and not backend_reachable():
+        print(json.dumps({"event": "capture_abort",
+                          "reason": "backend unreachable (tunnel wedged?)"}),
+              flush=True)
+        return 1
+
+    results = {}
+    for name in args.stages.split(","):
+        log = os.path.join(REPO, "artifacts", f"{name}_r{args.round:02d}.log")
+        open(log, "w").close()  # fresh file per capture
+        t0 = time.time()
+        ok = STAGES[name](log)
+        results[name] = ok
+        print(json.dumps({"event": "stage", "stage": name, "ok": ok,
+                          "seconds": round(time.time() - t0, 1),
+                          "log": os.path.relpath(log, REPO)}), flush=True)
+
+    print(json.dumps({"event": "capture_done", "results": results}),
+          flush=True)
+    return 0 if all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
