@@ -420,6 +420,39 @@ def flash_attention(
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
 
 
+def flash_attention_fwd_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> "tuple[jax.Array, jax.Array]":
+    """Forward flash attention returning ``(out, lse)`` over (B, S, H, D).
+
+    The composition building block for ring/blockwise attention
+    (parallel/context.py): partial outputs from different K/V shards merge
+    exactly via their logsumexp. ``lse`` is (B, S_q, H) fp32; fully-masked
+    rows carry a large-negative lse and a zero output, which the merge
+    treats as a no-contribution. Forward-only — no custom VJP on this path
+    (the training path is :func:`flash_attention`).
+    """
+    b, s_q, h, d = q.shape
+    s_kv = k.shape[1]
+    if scale is None:
+        scale = d ** -0.5
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    out, lse = _flash_forward(
+        fold(q), fold(k), fold(v), scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret, with_lse=True)
+    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    lse = lse[..., 0].reshape(b, h, s_q).transpose(0, 2, 1)
+    return out, lse
+
+
 def reference_attention(q, k, v, *, causal: bool = True,
                         scale: float | None = None) -> jax.Array:
     """(B, S, H, D) einsum attention — the correctness oracle for tests."""

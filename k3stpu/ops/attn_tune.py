@@ -1,0 +1,82 @@
+"""Block-size sweep for the Pallas flash-attention kernels.
+
+The kernel's only free parameters are the q/k tile edges; the best point
+depends on head_dim, VMEM budget, and generation. This sweeps a small grid
+at the flagship shape and prints one line per point plus the winner, so a
+single bounded run on the chip picks the production default (DEFAULT_BLOCK
+in ops/attention.py). Bench discipline is measure_attention's: chained
+iterations, device->host sync, causal-aware flop accounting.
+
+Run: python -m k3stpu.ops.attn_tune [--seq 4096] [--batch 8] [--fast]
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import sys
+
+from k3stpu.ops.attn_bench import measure_attention
+
+
+def sweep(seq: int = 4096, batch: int = 8, heads: int = 8,
+          head_dim: int = 128, iters: int = 10, backward: bool = True,
+          blocks: "tuple[int, ...]" = (256, 512, 1024, 2048),
+          square_only: bool = False, interpret: bool = False) -> list[dict]:
+    rows = []
+    grid = (zip(blocks, blocks) if square_only
+            else itertools.product(blocks, blocks))
+    for bq, bk in grid:
+        if bq > seq or bk > seq:
+            continue
+        try:
+            results = measure_attention(
+                seq=seq, batch=batch, heads=heads, head_dim=head_dim,
+                iters=iters, backward=backward, include_einsum=False,
+                block_q=bq, block_k=bk, interpret=interpret)
+        except Exception as e:  # noqa: BLE001 — a block combo can exceed VMEM
+            rows.append({"block_q": bq, "block_k": bk,
+                         "error": f"{type(e).__name__}: {e}"[:200]})
+            print(json.dumps(rows[-1]), flush=True)
+            continue
+        row = {"block_q": bq, "block_k": bk}
+        for r in results:
+            key = "fwd" if r.direction == "fwd" else "bwd"
+            row[f"{key}_tflops"] = round(r.tflops, 2)
+            row[f"{key}_mfu"] = round(r.mfu, 4) if r.mfu else None
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="flash-attention block sweep")
+    ap.add_argument("--seq", type=int, default=4096)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--fwd-only", action="store_true")
+    ap.add_argument("--fast", action="store_true",
+                    help="3-point sweep (256/512/1024 square tiles only)")
+    ap.add_argument("--interpret", action="store_true")
+    args = ap.parse_args(argv)
+
+    blocks = (256, 512, 1024) if args.fast else (256, 512, 1024, 2048)
+    rows = sweep(seq=args.seq, batch=args.batch, heads=args.heads,
+                 head_dim=args.head_dim, iters=args.iters,
+                 backward=not args.fwd_only, blocks=blocks,
+                 square_only=args.fast, interpret=args.interpret)
+    good = [r for r in rows if "fwd_tflops" in r]
+    if good:
+        # Rank by the fwd+bwd chained rate when measured — DEFAULT_BLOCK
+        # serves training, so the winner must be fast through the backward
+        # kernels too; fall back to fwd-only rate otherwise.
+        best = max(good, key=lambda r: r.get("bwd_tflops", r["fwd_tflops"]))
+        print("ATTN_TUNE_BEST " + json.dumps(best), flush=True)
+    return 0 if good else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

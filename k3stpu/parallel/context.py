@@ -111,6 +111,85 @@ def ring_attention(
     return (acc / denom).astype(q.dtype)
 
 
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention with the Pallas flash kernel as the per-shard compute.
+
+    Same ring schedule as :func:`ring_attention` but each visiting K/V shard
+    runs the O(S_local)-memory flash kernel (ops/attention.py) instead of a
+    materialized (Sq, Skv) einsum — on-chip memory stays O(S_local · D) at
+    any sequence length, so one more mesh axis is the answer to "sequence
+    doesn't fit", never a bigger logits buffer.
+
+    Partial results merge exactly through each shard's logsumexp: the ring
+    carries unnormalized (num, den, running-max) in fp32 and every shard
+    contributes ``exp(lse_t - m) * out_t``. Causality per ring step t
+    (unrolled — the axis size is static): t == 0 is the diagonal shard
+    (causal kernel); t > 0 holds the shard from rank ``my - t``, fully
+    visible when ``my >= t`` and fully masked otherwise — masked shards are
+    dropped by forcing their lse to the masked sentinel before the merge
+    (the uniform-SPMD load imbalance every causal ring has). Forward-only,
+    like :func:`ring_attention`.
+    """
+    from k3stpu.ops.attention import flash_attention_fwd_lse
+
+    b, s_local, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    n = jax.lax.psum(1, axis_name)  # static: the mesh axis size
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def divisor_block(limit: int) -> int:
+        # Largest block <= limit that divides the shard length — a bare
+        # min() would trip the kernel's divisibility check for shard
+        # lengths like 768 with the 512 default.
+        b = min(limit, s_local)
+        while s_local % b:
+            b -= 1
+        return b
+
+    bq, bk = divisor_block(block_q), divisor_block(block_k)
+
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
+    m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
+    k_t, v_t = k, v
+
+    for t in range(n):
+        out_t, lse_t = flash_attention_fwd_lse(
+            q, k_t, v_t, causal=causal and t == 0, scale=scale,
+            block_q=bq, block_k=bk, interpret=interpret)
+        lse_t = lse_t[..., None]                      # (B, S, H, 1)
+        if causal and t > 0:
+            # Shard from rank my-t: fully visible iff it sits behind us.
+            lse_t = jnp.where(my_idx >= t, lse_t, _NEG_INF)
+        m_new = jnp.maximum(m_run, lse_t)
+        alpha = jnp.exp(m_run - m_new)                # rescale old partials
+        w = jnp.exp(lse_t - m_new)                    # this shard's weight
+        num = num * alpha + w * out_t.astype(jnp.float32)
+        den = den * alpha + w
+        m_run = m_new
+        if t < n - 1:
+            k_t = jax.lax.ppermute(k_t, axis_name, perm)
+            v_t = jax.lax.ppermute(v_t, axis_name, perm)
+
+    # Fully-masked rows: every shard contributed w == 1 on a zero output
+    # (masked-sentinel lse all around), so num == 0 and out is exactly 0.
+    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+
+
 def make_context_mesh(n_devices: int | None = None,
                       devices: list | None = None) -> Mesh:
     """1-D ('seq',) mesh: every device is a sequence shard on the ring."""
@@ -125,14 +204,26 @@ def make_context_mesh(n_devices: int | None = None,
 
 @functools.lru_cache(maxsize=32)
 def _ring_program(mesh: Mesh, axis_name: str, causal: bool,
-                  scale: "float | None"):
+                  scale: "float | None", impl: str, interpret: bool):
     """Jitted shard_map ring program, cached so repeated calls with the
-    same (mesh, axis, causal, scale) hit the XLA compile cache."""
+    same (mesh, axis, causal, scale, impl) hit the XLA compile cache."""
     from jax import shard_map
 
     spec = P(None, axis_name, None, None)
-    fn = functools.partial(ring_attention, axis_name=axis_name,
-                           causal=causal, scale=scale)
+    if impl == "flash":
+        fn = functools.partial(ring_flash_attention, axis_name=axis_name,
+                               causal=causal, scale=scale,
+                               interpret=interpret)
+        # pallas_call's out_shape carries no varying-mesh-axes annotation,
+        # so shard_map's vma check can't type it; disable for this program.
+        return jax.jit(shard_map(fn, mesh=mesh,
+                                 in_specs=(spec, spec, spec),
+                                 out_specs=spec, check_vma=False))
+    if impl == "einsum":
+        fn = functools.partial(ring_attention, axis_name=axis_name,
+                               causal=causal, scale=scale)
+    else:
+        raise ValueError(f"unknown ring impl {impl!r}")
     return jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
                              out_specs=spec))
 
@@ -146,10 +237,17 @@ def context_parallel_attention(
     axis_name: str = "seq",
     causal: bool = True,
     scale: float | None = None,
+    impl: str = "einsum",
+    interpret: bool = False,
 ):
     """Jit-ready global-array entry: shards (B, S, H, D) inputs over
-    ``axis_name`` and runs :func:`ring_attention` under ``shard_map``."""
-    sharded = _ring_program(mesh, axis_name, causal, scale)
+    ``axis_name`` and runs the ring under ``shard_map``.
+
+    ``impl="flash"`` uses the Pallas kernel per shard (O(S_local) memory —
+    the production long-context path on TPU; ``interpret=True`` for the CPU
+    test tier); ``impl="einsum"`` keeps the materialized-logits reference.
+    """
+    sharded = _ring_program(mesh, axis_name, causal, scale, impl, interpret)
     sh = NamedSharding(mesh, P(None, axis_name, None, None))
     q = jax.device_put(q, sh)
     k = jax.device_put(k, sh)

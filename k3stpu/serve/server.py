@@ -64,12 +64,23 @@ class MicroBatcher:
         self._run_batch = run_batch  # (np.ndarray, n_requests) -> np.ndarray
         self._window_s = window_s
         self._max = max_batch
-        self._q: "queue.SimpleQueue[dict]" = queue.SimpleQueue()
+        self._q: "queue.SimpleQueue[dict | None]" = queue.SimpleQueue()
         self._carry: dict | None = None
-        threading.Thread(target=self._loop, daemon=True,
-                         name="microbatcher").start()
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="microbatcher")
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop the dispatcher thread (it exits after draining in-flight
+        work). Without this the daemon thread pins the server — and its
+        weights — for the life of the process."""
+        self._closed = True
+        self._q.put(None)
 
     def submit(self, inputs: np.ndarray) -> np.ndarray:
+        if self._closed:
+            raise RuntimeError("MicroBatcher is closed")
         item = {"inputs": inputs, "event": threading.Event(),
                 "result": None, "error": None}
         self._q.put(item)
@@ -78,9 +89,11 @@ class MicroBatcher:
             raise item["error"]
         return item["result"]
 
-    def _gather(self) -> "list[dict]":
+    def _gather(self) -> "list[dict] | None":
         first = self._carry if self._carry is not None else self._q.get()
         self._carry = None
+        if first is None:  # close() sentinel
+            return None
         items, rows = [first], len(first["inputs"])
         deadline = time.perf_counter() + self._window_s
         while rows < self._max:
@@ -90,6 +103,10 @@ class MicroBatcher:
             try:
                 nxt = self._q.get(timeout=remaining)
             except queue.Empty:
+                break
+            if nxt is None:
+                self._carry = None  # drop sentinel; loop exits next round
+                self._q.put(None)
                 break
             if rows + len(nxt["inputs"]) > self._max:
                 self._carry = nxt  # head-of-line for the next round
@@ -101,6 +118,8 @@ class MicroBatcher:
     def _loop(self) -> None:
         while True:
             items = self._gather()
+            if items is None:
+                return
             try:
                 batch = (np.concatenate([it["inputs"] for it in items])
                          if len(items) > 1 else items[0]["inputs"])
@@ -233,6 +252,12 @@ class InferenceServer:
         if self._batcher is not None:
             return self._batcher.submit(inputs)
         return self._run_forward(inputs)
+
+    def close(self) -> None:
+        """Release the dispatcher thread (embedders/tests; the serving
+        process itself runs until killed)."""
+        if self._batcher is not None:
+            self._batcher.close()
 
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,

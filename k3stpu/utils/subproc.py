@@ -17,16 +17,19 @@ import signal
 import subprocess
 import threading
 
-_active_pgids: "set[int]" = set()
+# Immutable snapshot, REBOUND (never mutated) under _lock by spawn/wait —
+# so the signal-handler path below can read it without taking the lock: a
+# handler that fired inside a `with _lock:` region would self-deadlock on a
+# non-reentrant lock, leaving the wedged child alive.
+_active_pgids: "frozenset[int]" = frozenset()
 _lock = threading.Lock()
 
 
 def kill_active_groups() -> None:
     """SIGKILL every process group spawned through this module that has not
-    been reaped yet. Safe from signal handlers (no allocation-heavy work)."""
-    with _lock:
-        pgids = list(_active_pgids)
-    for pgid in pgids:
+    been reaped yet. Signal-handler safe: lock-free reference read of the
+    immutable snapshot, no allocation-heavy work."""
+    for pgid in _active_pgids:
         try:
             os.killpg(pgid, signal.SIGKILL)
         except (ProcessLookupError, PermissionError):
@@ -42,8 +45,9 @@ def spawn(cmd: "list[str]", *, env: "dict | None" = None,
         stderr=subprocess.STDOUT if merge_streams else subprocess.PIPE,
         text=True, start_new_session=True, env=env, cwd=cwd)
     # start_new_session guarantees the child's pgid == its pid.
+    global _active_pgids
     with _lock:
-        _active_pgids.add(proc.pid)
+        _active_pgids = _active_pgids | {proc.pid}
     return proc
 
 
@@ -51,6 +55,7 @@ def wait_bounded(proc: subprocess.Popen,
                  timeout_s: float) -> "tuple[int | None, str, str]":
     """Wait for a spawn()ed child; on timeout SIGKILL its whole group.
     Returns (rc, stdout, stderr); rc is None on timeout."""
+    global _active_pgids
     try:
         try:
             out, err = proc.communicate(timeout=timeout_s)
@@ -65,7 +70,7 @@ def wait_bounded(proc: subprocess.Popen,
             return None, out, err or ""
     finally:
         with _lock:
-            _active_pgids.discard(proc.pid)
+            _active_pgids = _active_pgids - {proc.pid}
 
 
 def run_bounded(cmd: "list[str]", timeout_s: float, *,

@@ -175,3 +175,44 @@ def test_gradients_bf16():
         np.testing.assert_allclose(
             np.asarray(gf, np.float32), np.asarray(gr, np.float32),
             atol=6e-2, rtol=6e-2)
+
+
+def test_bench_bwd_chain_keeps_all_grad_kernels():
+    """The fwd+bwd bench step must keep dq, dk AND dv live: a dq-only chain
+    lets XLA dead-code-eliminate the dK/dV kernel and the 'backward' number
+    measures a fraction of the backward (caught on-chip in round 2)."""
+    import jax
+    import jax.numpy as jnp
+
+    from k3stpu.ops.attention import reference_attention
+
+    # Mirror attn_bench's bwd_step shape with the einsum impl (kernel-free,
+    # so the HLO dot count is a clean proxy; flash uses the same chaining).
+    def bwd_step(q, k, v):
+        dq, dk, dv = jax.grad(
+            lambda q, k, v: jnp.sum(
+                reference_attention(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        g = (dq.astype(jnp.float32)
+             + 1e-3 * (dk.astype(jnp.float32) + dv.astype(jnp.float32)))
+        rms = jnp.sqrt(jnp.mean(g * g) + 1e-12)
+        return (g / rms).astype(q.dtype), k, v
+
+    def bwd_step_dq_only(q, k, v):
+        dq, _, _ = jax.grad(
+            lambda q, k, v: jnp.sum(
+                reference_attention(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        return dq, k, v
+
+    shape = (1, 64, 2, 16)
+    q = jnp.zeros(shape, jnp.bfloat16)
+
+    def n_dots(fn):
+        hlo = jax.jit(fn).lower(q, q, q).compile().as_text()
+        return hlo.count(" dot(") + hlo.count(" dot.")
+
+    full, partial = n_dots(bwd_step), n_dots(bwd_step_dq_only)
+    assert full > partial, (
+        f"chained bwd step compiled to {full} dots vs dq-only {partial}: "
+        "dk/dv work is being dead-code-eliminated from the benchmark")

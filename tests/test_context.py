@@ -85,3 +85,42 @@ def test_ring_attention_differentiable():
     for gr, gf in zip(g_ring, g_ref):
         np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
                                    atol=1e-4, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_flash_matches_full(causal):
+    # Flash-per-shard ring (interpret-mode kernels on CPU) must be exact
+    # against full attention, like the einsum ring.
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv(seed=3)
+    out = context_parallel_attention(mesh, q, k, v, causal=causal,
+                                     impl="flash", interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ring_flash_bf16_and_sharded_output():
+    mesh = make_context_mesh(4)
+    q, k, v = _qkv(b=1, s=128, h=2, d=32, seed=9, dtype=jnp.bfloat16)
+    out = context_parallel_attention(mesh, q, k, v, impl="flash",
+                                     interpret=True)
+    assert out.dtype == jnp.bfloat16
+    assert out.sharding.spec == jax.sharding.PartitionSpec(
+        None, "seq", None, None)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, np.float32),
+                               np.asarray(ref, np.float32),
+                               atol=3e-2, rtol=3e-2)
+
+
+def test_ring_flash_non_divisible_shard_length():
+    # 8 devices x s=384 -> s_local=48; default 512 blocks must round down
+    # to a divisor instead of raising.
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv(s=384, seed=11)
+    out = context_parallel_attention(mesh, q, k, v, impl="flash",
+                                     interpret=True)
+    ref = reference_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)

@@ -247,3 +247,17 @@ def test_window_zero_disables_coalescing():
     out = server.predict(np.zeros((2, 16), np.int32))
     assert out.shape[0] == 2
     assert server.model_card()["stats"]["dispatches"] == 1
+
+
+def test_batcher_close_stops_dispatcher():
+    import time as _time
+
+    from k3stpu.serve.server import MicroBatcher
+
+    mb = MicroBatcher(lambda b, n: b, window_s=0.01, max_batch=8)
+    assert mb.submit(np.ones((1, 2), np.float32)).shape == (1, 2)
+    mb.close()
+    mb._thread.join(timeout=5)  # drains the sentinel and exits
+    assert not mb._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        mb.submit(np.ones((1, 2), np.float32))
