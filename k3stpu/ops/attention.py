@@ -453,6 +453,44 @@ def flash_attention_fwd_lse(
     return out, lse
 
 
+def flash_attention_bwd_shard(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    out: jax.Array,
+    lse: jax.Array,
+    g: jax.Array,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
+    interpret: bool = False,
+) -> "tuple[jax.Array, jax.Array, jax.Array]":
+    """Backward against ONE K/V shard given the GLOBAL (out, lse).
+
+    The ring-attention backward building block (parallel/context.py): with
+    the global logsumexp, each row's probabilities against any K/V shard
+    recompute locally as ``exp(s - lse)``, so (dq-contribution, dk, dv) for
+    a shard need only that shard — O(S_local) memory, Pallas kernels
+    throughout. ``q, out, g``: (B, S_q, H, D); ``k, v``: (B, S_kv, H, D);
+    ``lse``: (B, S_q, H) fp32 from :func:`flash_attention_fwd_lse` (or the
+    ring's merged total).
+    """
+    b, s_q, h, d = q.shape
+    if scale is None:
+        scale = d ** -0.5
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    lse_f = jnp.broadcast_to(
+        lse.transpose(0, 2, 1).reshape(b * h, s_q, 1), (b * h, s_q, _LANES))
+    dq, dk, dv = _flash_backward(
+        fold(q), fold(k), fold(v), fold(out), lse_f, fold(g),
+        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret)
+    unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
 def reference_attention(q, k, v, *, causal: bool = True,
                         scale: float | None = None) -> jax.Array:
     """(B, S, H, D) einsum attention — the correctness oracle for tests."""

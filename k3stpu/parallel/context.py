@@ -111,6 +111,129 @@ def ring_attention(
     return (acc / denom).astype(q.dtype)
 
 
+def _divisor_block(limit: int, s_local: int) -> int:
+    # Largest block <= limit that divides the shard length — a bare min()
+    # would trip the kernel's divisibility check for shard lengths like 768
+    # with the 512 default.
+    b = min(limit, s_local)
+    while s_local % b:
+        b -= 1
+    return b
+
+
+def _ring_flash_fwd_core(q, k, v, axis_name, causal, scale, block_q,
+                         block_k, interpret):
+    """The flash ring forward; returns (out, merged global lse (B,S,H,1))."""
+    from k3stpu.ops.attention import flash_attention_fwd_lse
+
+    b, s_local, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)  # static: the mesh axis size
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq = _divisor_block(block_q, s_local)
+    bk = _divisor_block(block_k, s_local)
+
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
+    m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
+    k_t, v_t = k, v
+
+    for t in range(n):
+        out_t, lse_t = flash_attention_fwd_lse(
+            q, k_t, v_t, causal=causal and t == 0, scale=scale,
+            block_q=bq, block_k=bk, interpret=interpret)
+        lse_t = lse_t[..., None]                      # (B, S, H, 1)
+        if causal and t > 0:
+            # Shard from rank my-t: fully visible iff it sits behind us.
+            lse_t = jnp.where(my_idx >= t, lse_t, _NEG_INF)
+        m_new = jnp.maximum(m_run, lse_t)
+        alpha = jnp.exp(m_run - m_new)                # rescale old partials
+        w = jnp.exp(lse_t - m_new)                    # this shard's weight
+        num = num * alpha + w * out_t.astype(jnp.float32)
+        den = den * alpha + w
+        m_run = m_new
+        if t < n - 1:
+            k_t = jax.lax.ppermute(k_t, axis_name, perm)
+            v_t = jax.lax.ppermute(v_t, axis_name, perm)
+
+    den = jnp.maximum(den, 1e-30)
+    # Fully-masked rows: every shard contributed w == 1 on a zero output
+    # (masked-sentinel lse all around), so num == 0 and out is exactly 0 —
+    # and their merged lse stays at the masked sentinel (m_run ~ _NEG_INF),
+    # which the backward kernels already treat as p == 0. (In a causal ring
+    # with equal shard lengths such rows cannot occur: every position sees
+    # at least itself in its diagonal shard.)
+    return (num / den).astype(q.dtype), m_run + jnp.log(den)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                interpret):
+    out, _ = _ring_flash_fwd_core(q, k, v, axis_name, causal, scale,
+                                  block_q, block_k, interpret)
+    return out
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                    interpret):
+    out, lse = _ring_flash_fwd_core(q, k, v, axis_name, causal, scale,
+                                    block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
+                    res, g):
+    """Ring backward with O(S_local) memory: the global (out, lse) lets each
+    device recompute its rows' probabilities against ANY K/V shard locally
+    (p = exp(s - lse)), so per ring step the Pallas backward kernels produce
+    this q-shard's dq contribution plus (dk, dv) for the visiting shard;
+    the (k, v, dk, dv) quartet rotates together and after a full cycle each
+    shard's gradient accumulator arrives back at its owner."""
+    from k3stpu.ops.attention import flash_attention_bwd_shard
+
+    q, k, v, out, lse = res
+    b, s_local, h, d = q.shape
+    n = jax.lax.psum(1, axis_name)
+    my_idx = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq = _divisor_block(block_q, s_local)
+    bk = _divisor_block(block_k, s_local)
+    lse3 = lse[..., 0]                                 # (B, S, H)
+
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    dq = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    dk_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    dv_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    k_t, v_t = k, v
+
+    for t in range(n):
+        dq_c, dk_c, dv_c = flash_attention_bwd_shard(
+            q, k_t, v_t, out, lse3, g, causal=causal and t == 0,
+            scale=scale, block_q=bq, block_k=bk, interpret=interpret)
+        if causal and t > 0:
+            # Shard from rank my-t is invisible to ranks my < t: neither my
+            # dq nor its dk/dv get contributions from this pairing.
+            live = my_idx >= t
+            dq_c = jnp.where(live, dq_c, 0)
+            dk_c = jnp.where(live, dk_c, 0)
+            dv_c = jnp.where(live, dv_c, 0)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_t = dk_t + dk_c.astype(jnp.float32)
+        dv_t = dv_t + dv_c.astype(jnp.float32)
+        # Rotate every step (n rotations total) so the grad accumulators
+        # land back on their shards' owners at loop end.
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        dk_t = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_t = jax.lax.ppermute(dv_t, axis_name, perm)
+
+    return dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype)
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
 def ring_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -138,56 +261,16 @@ def ring_flash_attention(
     (causal kernel); t > 0 holds the shard from rank ``my - t``, fully
     visible when ``my >= t`` and fully masked otherwise — masked shards are
     dropped by forcing their lse to the masked sentinel before the merge
-    (the uniform-SPMD load imbalance every causal ring has). Forward-only,
-    like :func:`ring_attention`.
+    (the uniform-SPMD load imbalance every causal ring has).
+
+    Differentiable: a custom VJP runs the ring backward with the Pallas
+    backward kernels per shard (see :func:`_ring_flash_bwd`) — long-context
+    TRAINING stays O(S_local) memory end to end.
     """
-    from k3stpu.ops.attention import flash_attention_fwd_lse
-
-    b, s_local, h, d = q.shape
     if scale is None:
-        scale = d ** -0.5
-    n = jax.lax.psum(1, axis_name)  # static: the mesh axis size
-    my_idx = jax.lax.axis_index(axis_name)
-    perm = [(i, (i + 1) % n) for i in range(n)]
-
-    def divisor_block(limit: int) -> int:
-        # Largest block <= limit that divides the shard length — a bare
-        # min() would trip the kernel's divisibility check for shard
-        # lengths like 768 with the 512 default.
-        b = min(limit, s_local)
-        while s_local % b:
-            b -= 1
-        return b
-
-    bq, bk = divisor_block(block_q), divisor_block(block_k)
-
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
-    num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
-    den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
-    m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
-    k_t, v_t = k, v
-
-    for t in range(n):
-        out_t, lse_t = flash_attention_fwd_lse(
-            q, k_t, v_t, causal=causal and t == 0, scale=scale,
-            block_q=bq, block_k=bk, interpret=interpret)
-        lse_t = lse_t[..., None]                      # (B, S, H, 1)
-        if causal and t > 0:
-            # Shard from rank my-t: fully visible iff it sits behind us.
-            lse_t = jnp.where(my_idx >= t, lse_t, _NEG_INF)
-        m_new = jnp.maximum(m_run, lse_t)
-        alpha = jnp.exp(m_run - m_new)                # rescale old partials
-        w = jnp.exp(lse_t - m_new)                    # this shard's weight
-        num = num * alpha + w * out_t.astype(jnp.float32)
-        den = den * alpha + w
-        m_run = m_new
-        if t < n - 1:
-            k_t = jax.lax.ppermute(k_t, axis_name, perm)
-            v_t = jax.lax.ppermute(v_t, axis_name, perm)
-
-    # Fully-masked rows: every shard contributed w == 1 on a zero output
-    # (masked-sentinel lse all around), so num == 0 and out is exactly 0.
-    return (num / jnp.maximum(den, 1e-30)).astype(q.dtype)
+        scale = q.shape[-1] ** -0.5
+    return _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                       interpret)
 
 
 def make_context_mesh(n_devices: int | None = None,

@@ -51,6 +51,7 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
             if my_errors >= _MAX_ERRORS_PER_CLIENT:
                 return  # persistently failing client stops; others continue
             continue
+        my_errors = 0  # consecutive-failure counter: success resets it
         with lock:
             latencies.append(time.perf_counter() - t0)
 

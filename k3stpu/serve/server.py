@@ -84,7 +84,17 @@ class MicroBatcher:
         item = {"inputs": inputs, "event": threading.Event(),
                 "result": None, "error": None}
         self._q.put(item)
-        item["event"].wait()
+        # A bounded wait + closed re-check: a submit racing close() can land
+        # its item behind the shutdown sentinel, after which no dispatcher
+        # will ever set the event — an unbounded wait would strand this
+        # thread forever. On close, grant one grace period so a request the
+        # dispatcher already picked up can still deliver its result.
+        while not item["event"].wait(timeout=1.0):
+            if self._closed:
+                if item["event"].wait(timeout=30.0):
+                    break
+                raise RuntimeError(
+                    "MicroBatcher closed with request in flight")
         if item["error"] is not None:
             raise item["error"]
         return item["result"]
