@@ -310,6 +310,13 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     s_kv = k.shape[1]
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        # Same check as the forward: the grids floor-divide, so a
+        # non-divisor block would silently skip the tail rows/cols and
+        # return garbage gradients instead of an error.
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
+            f"({block_q}, {block_k})")
     offset = s_kv - s_q
 
     # di = rowsum(dO * O) — O(S d) elementwise; XLA fuses it. Replicated to

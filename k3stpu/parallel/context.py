@@ -111,6 +111,19 @@ def ring_attention(
     return (acc / denom).astype(q.dtype)
 
 
+def _lse_merge(num, den, m_run, out_t, lse_t):
+    """One online-softmax merge of a normalized partial result into the
+    running (num, den, max) triple — the single home for this numerically
+    delicate update, shared by the contiguous and zigzag rings. ``lse_t``
+    is (B, S, H, 1) fp32; masked contributions carry the _NEG_INF sentinel
+    (weight underflows to 0 against any real max)."""
+    m_new = jnp.maximum(m_run, lse_t)
+    alpha = jnp.exp(m_run - m_new)                    # rescale old partials
+    w = jnp.exp(lse_t - m_new)                        # this shard's weight
+    return (num * alpha + w * out_t.astype(jnp.float32),
+            den * alpha + w, m_new)
+
+
 def _divisor_block(limit: int, s_local: int) -> int:
     # Largest block <= limit that divides the shard length — a bare min()
     # would trip the kernel's divisibility check for shard lengths like 768
@@ -147,12 +160,7 @@ def _ring_flash_fwd_core(q, k, v, axis_name, causal, scale, block_q,
         if causal and t > 0:
             # Shard from rank my-t: fully visible iff it sits behind us.
             lse_t = jnp.where(my_idx >= t, lse_t, _NEG_INF)
-        m_new = jnp.maximum(m_run, lse_t)
-        alpha = jnp.exp(m_run - m_new)                # rescale old partials
-        w = jnp.exp(lse_t - m_new)                    # this shard's weight
-        num = num * alpha + w * out_t.astype(jnp.float32)
-        den = den * alpha + w
-        m_run = m_new
+        num, den, m_run = _lse_merge(num, den, m_run, out_t, lse_t)
         if t < n - 1:
             k_t = jax.lax.ppermute(k_t, axis_name, perm)
             v_t = jax.lax.ppermute(v_t, axis_name, perm)
@@ -273,6 +281,279 @@ def ring_flash_attention(
                        interpret)
 
 
+# --- Zigzag (load-balanced) causal ring -------------------------------------
+#
+# A contiguous causal ring is imbalanced: rank r's queries see only r+1 of
+# the n K/V shards, but SPMD uniformity makes every rank pay for all n ring
+# steps — half the fleet's compute is masked away. The zigzag layout fixes
+# the imbalance by giving every device one EARLY and one LATE chunk of the
+# sequence: split S into 2n chunks and put chunks (i, 2n-1-i) on device i.
+# Then at every ring step each device has exactly the same amount of visible
+# work — two half-shard attention blocks — which runs as ONE stacked flash
+# kernel over (2B, S_local/2): ~2x the causal throughput of the contiguous
+# ring at the same exactness. (This is the standard zigzag/striped remedy
+# for causal ring imbalance, built here on the same flash+lse merge.)
+#
+# Chunk visibility at step t (kv from src = my - t mod n; early chunks are
+# their rank id, late chunk of rank r is 2n-1-r):
+#   (early_q,  late_kv)  -> never visible
+#   (late_q,   early_kv) -> always fully visible
+#   (early_q,  early_kv) -> diagonal at t == 0, full iff src < my
+#   (late_q,   late_kv)  -> diagonal at t == 0, full iff src > my
+# so for t > 0 exactly ONE of the last two is live — selected with a
+# jnp.where on the operands, keeping the program uniform across devices.
+
+
+def zigzag_to_local(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Permute a global sequence so contiguous shard i = chunks (i, 2n-1-i).
+
+    Apply BEFORE device_put/shard_map; :func:`zigzag_from_local` inverts.
+    """
+    s = x.shape[axis]
+    if s % (2 * n):
+        raise ValueError(f"seq {s} not divisible by 2n={2 * n} chunks")
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    order = []
+    for i in range(n):
+        order += [i, 2 * n - 1 - i]
+    return jnp.concatenate([chunks[c] for c in order], axis=axis)
+
+
+def zigzag_from_local(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_to_local`."""
+    s = x.shape[axis]
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    inv = [0] * (2 * n)
+    pos = 0
+    for i in range(n):
+        inv[i] = pos
+        inv[2 * n - 1 - i] = pos + 1
+        pos += 2
+    return jnp.concatenate([chunks[inv[c]] for c in range(2 * n)], axis=axis)
+
+
+def _zz_halves(x):
+    half = x.shape[1] // 2
+    return x[:, :half], x[:, half:]
+
+
+def _zigzag_fwd_core(q, k, v, axis_name, scale, block_q, block_k, interpret):
+    """Zigzag causal forward; local layout (early_chunk ++ late_chunk).
+
+    Returns (out, global lse (B, S_local, H, 1)). Merge discipline is
+    identical to the contiguous ring's (num/den/m in fp32, weights from
+    each contribution's lse)."""
+    from k3stpu.ops.attention import flash_attention_fwd_lse
+
+    b, s_local, h, d = q.shape
+    half = s_local // 2
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq = _divisor_block(block_q, half)
+    bk = _divisor_block(block_k, half)
+
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
+    m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
+    q_e, q_l = _zz_halves(q)
+    k_t, v_t = k, v
+
+    def merge(num, den, m_run, out_t, lse_t):
+        return _lse_merge(num, den, m_run, out_t, lse_t[..., None])
+
+    for t in range(n):
+        ke, kl = _zz_halves(k_t)
+        ve, vl = _zz_halves(v_t)
+        if t == 0:
+            # Two diagonal (causal) blocks in one stacked kernel...
+            o2, lse2 = flash_attention_fwd_lse(
+                jnp.concatenate([q_e, q_l]), jnp.concatenate([ke, kl]),
+                jnp.concatenate([ve, vl]), causal=True, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            out_t = jnp.concatenate([o2[:b], o2[b:]], axis=1)
+            lse_t = jnp.concatenate([lse2[:b], lse2[b:]], axis=1)
+            num, den, m_run = merge(num, den, m_run, out_t, lse_t)
+            # ...plus the always-visible (late_q, early_kv) full block.
+            o, lse = flash_attention_fwd_lse(
+                q_l, ke, ve, causal=False, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            out_t = jnp.concatenate([jnp.zeros_like(o), o], axis=1)
+            lse_t = jnp.concatenate(
+                [jnp.full_like(lse, _NEG_INF), lse], axis=1)
+            num, den, m_run = merge(num, den, m_run, out_t, lse_t)
+        else:
+            # Visible pairs: (late_q, early_kv) always; (early_q, early_kv)
+            # iff src < my (src = my - t, no wrap); else (late_q, late_kv).
+            early_live = my >= t
+            q_sel = jnp.where(early_live, q_e, q_l)
+            k_sel = jnp.where(early_live, ke, kl)
+            v_sel = jnp.where(early_live, ve, vl)
+            o2, lse2 = flash_attention_fwd_lse(
+                jnp.concatenate([q_l, q_sel]), jnp.concatenate([ke, k_sel]),
+                jnp.concatenate([ve, v_sel]), causal=False, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            o_lq, o_sel = o2[:b], o2[b:]
+            lse_lq, lse_sel = lse2[:b], lse2[b:]
+            neg = jnp.full_like(lse_sel, _NEG_INF)
+            zero = jnp.zeros_like(o_sel)
+            # Merge 1: (late_q, early_kv) into the late half; the selected
+            # contribution into the EARLY half when it belongs there
+            # (masked-sentinel otherwise — zero weight in the merge).
+            num, den, m_run = merge(
+                num, den, m_run,
+                jnp.concatenate([jnp.where(early_live, o_sel, zero),
+                                 o_lq], axis=1),
+                jnp.concatenate([jnp.where(early_live, lse_sel, neg),
+                                 lse_lq], axis=1))
+            # Merge 2: the selected contribution into the LATE half when it
+            # was (late_q, late_kv) — a separate merge because that half
+            # already received o_lq this step.
+            num, den, m_run = merge(
+                num, den, m_run,
+                jnp.concatenate([zero,
+                                 jnp.where(early_live, zero, o_sel)],
+                                axis=1),
+                jnp.concatenate([neg,
+                                 jnp.where(early_live, neg, lse_sel)],
+                                axis=1))
+        if t < n - 1:
+            k_t = jax.lax.ppermute(k_t, axis_name, perm)
+            v_t = jax.lax.ppermute(v_t, axis_name, perm)
+
+    den = jnp.maximum(den, 1e-30)
+    return (num / den).astype(q.dtype), m_run + jnp.log(den)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _zigzag_flash(q, k, v, axis_name, scale, block_q, block_k, interpret):
+    out, _ = _zigzag_fwd_core(q, k, v, axis_name, scale, block_q, block_k,
+                              interpret)
+    return out
+
+
+def _zigzag_fwd(q, k, v, axis_name, scale, block_q, block_k, interpret):
+    out, lse = _zigzag_fwd_core(q, k, v, axis_name, scale, block_q, block_k,
+                                interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _zigzag_bwd(axis_name, scale, block_q, block_k, interpret, res, g):
+    """Zigzag ring backward: mirrors the forward's visible pairs with the
+    Pallas backward kernels (global lse), accumulating dq locally and
+    rotating (k, v, dk, dv) so shard grads land home after a full cycle."""
+    from k3stpu.ops.attention import flash_attention_bwd_shard
+
+    q, k, v, out, lse = res
+    b, s_local, h, d = q.shape
+    half = s_local // 2
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    bq = _divisor_block(block_q, half)
+    bk = _divisor_block(block_k, half)
+
+    q_e, q_l = _zz_halves(q)
+    out_e, out_l = _zz_halves(out)
+    g_e, g_l = _zz_halves(g)
+    lse3 = lse[..., 0]
+    lse_e, lse_l = lse3[:, :half], lse3[:, half:]
+
+    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    dq = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    dk_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    dv_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
+    k_t, v_t = k, v
+
+    def split2(x2):
+        return x2[:b], x2[b:]
+
+    for t in range(n):
+        ke, kl = _zz_halves(k_t)
+        ve, vl = _zz_halves(v_t)
+        if t == 0:
+            dq2, dk2, dv2 = flash_attention_bwd_shard(
+                jnp.concatenate([q_e, q_l]), jnp.concatenate([ke, kl]),
+                jnp.concatenate([ve, vl]),
+                jnp.concatenate([out_e, out_l]),
+                jnp.concatenate([lse_e, lse_l]),
+                jnp.concatenate([g_e, g_l]), causal=True, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            dq_e_c, dq_l_c = split2(dq2)
+            dk_e_c, dk_l_c = split2(dk2)
+            dv_e_c, dv_l_c = split2(dv2)
+            dqf, dkf, dvf = flash_attention_bwd_shard(
+                q_l, ke, ve, out_l, lse_l, g_l, causal=False, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            dq_c = jnp.concatenate([dq_e_c, dq_l_c + dqf], axis=1)
+            dk_c = jnp.concatenate([dk_e_c + dkf, dk_l_c], axis=1)
+            dv_c = jnp.concatenate([dv_e_c + dvf, dv_l_c], axis=1)
+        else:
+            early_live = my >= t
+            q_sel = jnp.where(early_live, q_e, q_l)
+            k_sel = jnp.where(early_live, ke, kl)
+            v_sel = jnp.where(early_live, ve, vl)
+            out_sel = jnp.where(early_live, out_e, out_l)
+            lse_sel = jnp.where(early_live, lse_e, lse_l)
+            g_sel = jnp.where(early_live, g_e, g_l)
+            dq2, dk2, dv2 = flash_attention_bwd_shard(
+                jnp.concatenate([q_l, q_sel]), jnp.concatenate([ke, k_sel]),
+                jnp.concatenate([ve, v_sel]),
+                jnp.concatenate([out_l, out_sel]),
+                jnp.concatenate([lse_l, lse_sel]),
+                jnp.concatenate([g_l, g_sel]), causal=False, scale=scale,
+                block_q=bq, block_k=bk, interpret=interpret)
+            dq_lq, dq_sel = split2(dq2)
+            dk_lq, dk_sel = split2(dk2)
+            dv_lq, dv_sel = split2(dv2)
+            dq_c = jnp.concatenate(
+                [jnp.where(early_live, dq_sel, 0.0),
+                 dq_lq + jnp.where(early_live, 0.0, dq_sel)], axis=1)
+            dk_c = jnp.concatenate(
+                [dk_lq + jnp.where(early_live, dk_sel, 0.0),
+                 jnp.where(early_live, 0.0, dk_sel)], axis=1)
+            dv_c = jnp.concatenate(
+                [dv_lq + jnp.where(early_live, dv_sel, 0.0),
+                 jnp.where(early_live, 0.0, dv_sel)], axis=1)
+        dq = dq + dq_c.astype(jnp.float32)
+        dk_t = dk_t + dk_c.astype(jnp.float32)
+        dv_t = dv_t + dv_c.astype(jnp.float32)
+        k_t = jax.lax.ppermute(k_t, axis_name, perm)
+        v_t = jax.lax.ppermute(v_t, axis_name, perm)
+        dk_t = jax.lax.ppermute(dk_t, axis_name, perm)
+        dv_t = jax.lax.ppermute(dv_t, axis_name, perm)
+
+    return dq.astype(q.dtype), dk_t.astype(k.dtype), dv_t.astype(v.dtype)
+
+
+_zigzag_flash.defvjp(_zigzag_fwd, _zigzag_bwd)
+
+
+def zigzag_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    scale: float | None = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention (zigzag layout; see module note).
+
+    Local shards must hold (early chunk ++ late chunk) — permute the global
+    sequence with :func:`zigzag_to_local` before sharding and invert the
+    output with :func:`zigzag_from_local` (context_parallel_attention with
+    ``impl="zigzag"`` does both). Differentiable like the plain flash ring.
+    """
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _zigzag_flash(q, k, v, axis_name, scale, block_q, block_k,
+                         interpret)
+
+
 def make_context_mesh(n_devices: int | None = None,
                       devices: list | None = None) -> Mesh:
     """1-D ('seq',) mesh: every device is a sequence shard on the ring."""
@@ -293,10 +574,18 @@ def _ring_program(mesh: Mesh, axis_name: str, causal: bool,
     from jax import shard_map
 
     spec = P(None, axis_name, None, None)
-    if impl == "flash":
-        fn = functools.partial(ring_flash_attention, axis_name=axis_name,
-                               causal=causal, scale=scale,
-                               interpret=interpret)
+    if impl in ("flash", "zigzag"):
+        if impl == "zigzag":
+            if not causal:
+                raise ValueError("zigzag layout only balances causal rings; "
+                                 "use impl='flash' for non-causal")
+            fn = functools.partial(zigzag_flash_attention,
+                                   axis_name=axis_name, scale=scale,
+                                   interpret=interpret)
+        else:
+            fn = functools.partial(ring_flash_attention, axis_name=axis_name,
+                                   causal=causal, scale=scale,
+                                   interpret=interpret)
         # pallas_call's out_shape carries no varying-mesh-axes annotation,
         # so shard_map's vma check can't type it; disable for this program.
         return jax.jit(shard_map(fn, mesh=mesh,
@@ -328,11 +617,20 @@ def context_parallel_attention(
 
     ``impl="flash"`` uses the Pallas kernel per shard (O(S_local) memory —
     the production long-context path on TPU; ``interpret=True`` for the CPU
-    test tier); ``impl="einsum"`` keeps the materialized-logits reference.
+    test tier); ``impl="zigzag"`` additionally load-balances the causal
+    ring (each device holds an early+late chunk pair; ~2x the causal
+    throughput — the permutation in and out is handled here);
+    ``impl="einsum"`` keeps the materialized-logits reference.
     """
     sharded = _ring_program(mesh, axis_name, causal, scale, impl, interpret)
+    n = mesh.shape[axis_name]
+    if impl == "zigzag":
+        q, k, v = (zigzag_to_local(x, n) for x in (q, k, v))
     sh = NamedSharding(mesh, P(None, axis_name, None, None))
     q = jax.device_put(q, sh)
     k = jax.device_put(k, sh)
     v = jax.device_put(v, sh)
-    return sharded(q, k, v)
+    out = sharded(q, k, v)
+    if impl == "zigzag":
+        out = zigzag_from_local(out, n)
+    return out
