@@ -49,6 +49,41 @@ def make_mesh(
     return Mesh(grid, axis_names)
 
 
+def make_hybrid_mesh(
+    model_parallelism: int | None = None,
+    axis_names: tuple[str, str] = ("data", "model"),
+) -> Mesh:
+    """(data, model) mesh for multi-host Jobs: 'model' maps onto each pod's
+    LOCAL devices (collectives ride ICI), 'data' spans pods (gradient psum
+    rides DCN — the bandwidth hierarchy SURVEY.md §2d prescribes). With one
+    process this is exactly :func:`make_mesh`.
+
+    Model parallelism must divide the local device count — a 'model' axis
+    crossing hosts would put every tensor-parallel all_gather on DCN, which
+    is the one layout a TPU pod must never use.
+    """
+    n_local = jax.local_device_count()
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(model_parallelism=model_parallelism,
+                         axis_names=axis_names)
+    if model_parallelism is None:
+        model_parallelism = 2 if n_local % 2 == 0 and n_local >= 2 else 1
+    if n_local % model_parallelism:
+        raise ValueError(
+            f"model_parallelism={model_parallelism} must divide the local "
+            f"device count {n_local} (a cross-host model axis would put "
+            f"tensor-parallel collectives on DCN)")
+    from jax.experimental import mesh_utils
+
+    # One K3S pod == one process == one granule of the DCN mesh (pods don't
+    # share ICI even on one physical host — device cgroups isolate them).
+    grid = mesh_utils.create_hybrid_device_mesh(
+        (n_local // model_parallelism, model_parallelism), (n_proc, 1),
+        process_is_granule=True)
+    return Mesh(grid, axis_names)
+
+
 def mesh_shape_for(n: int) -> tuple[int, int]:
     """Near-square (data, model) factorization, used for topology labels."""
     m = int(math.sqrt(n))

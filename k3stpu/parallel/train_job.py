@@ -56,7 +56,7 @@ def main(argv: "list[str] | None" = None) -> int:
         transformer_lm_small,
         transformer_lm_tiny,
     )
-    from k3stpu.parallel.mesh import make_mesh
+    from k3stpu.parallel.mesh import make_hybrid_mesh
     from k3stpu.parallel.train import make_train_bundle, synth_token_batch
     from k3stpu.utils import checkpoint as ckpt
 
@@ -66,7 +66,9 @@ def main(argv: "list[str] | None" = None) -> int:
     seq = args.seq or (512 if model_name == "small" else 64)
     model = (transformer_lm_small(max_seq_len=max(seq, 512))
              if model_name == "small" else transformer_lm_tiny())
-    mesh = make_mesh(len(devices), model_parallelism=args.model_parallelism)
+    # Hybrid layout across Job pods: 'model' stays on each pod's local ICI,
+    # 'data' (the gradient psum) spans pods over DCN.
+    mesh = make_hybrid_mesh(model_parallelism=args.model_parallelism)
     batch = args.batch or 8 * mesh.shape["data"]
     vocab = model.config.vocab_size
 

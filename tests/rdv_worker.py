@@ -13,6 +13,7 @@ import sys
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 2)  # 2 local devices per process
 
 import numpy as np  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
@@ -30,6 +31,15 @@ def main() -> int:
     devices = jax.devices()  # GLOBAL list after initialize
     mesh = Mesh(np.array(devices), ("d",))
     n = len(devices)
+
+    # Hybrid (data, model) mesh: the 'model' axis must stay within one
+    # process's local devices (ICI), 'data' spans processes (DCN).
+    from k3stpu.parallel.mesh import make_hybrid_mesh
+
+    hybrid = make_hybrid_mesh(model_parallelism=2)
+    hybrid_ok = (dict(hybrid.shape) == {"data": n // 2, "model": 2}
+                 and all(len({d.process_index for d in row}) == 1
+                         for row in hybrid.devices))
 
     # Global (n,) array, device i holding value i + 1; psum must see every
     # process's shard — the number cannot come out right from one process.
@@ -52,6 +62,7 @@ def main() -> int:
         "local_devices": len(jax.local_devices()),
         "psum_total": total,
         "expected_total": float(n * (n + 1) / 2),
+        "hybrid_mesh_ok": bool(hybrid_ok),
     }), flush=True)
     return 0
 

@@ -133,6 +133,8 @@ def test_two_process_rendezvous_and_psum(tmp_path):
     for rec in results.values():
         assert rec["num_processes"] == 2
         assert rec["jax_process_count"] == 2
-        assert rec["global_devices"] == 2
-        assert rec["local_devices"] == 1
-        assert rec["psum_total"] == rec["expected_total"] == 3.0
+        assert rec["global_devices"] == 4   # 2 processes x 2 local devices
+        assert rec["local_devices"] == 2
+        assert rec["psum_total"] == rec["expected_total"] == 10.0
+        # model axis confined to one process's devices (ICI not DCN)
+        assert rec["hybrid_mesh_ok"] is True
