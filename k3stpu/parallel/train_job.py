@@ -35,6 +35,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--model", choices=["tiny", "small"], default=None,
                     help="default: small on TPU, tiny on CPU")
     ap.add_argument("--model-parallelism", type=int, default=None)
+    ap.add_argument("--remat", action="store_true",
+                    help="rematerialize block activations in the backward "
+                         "(fits deeper/longer configs in HBM at ~1 extra "
+                         "forward of FLOPs)")
     ap.add_argument("--profile-port", type=int, default=0,
                     help="jax.profiler.start_server port (0 = off)")
     args = ap.parse_args(argv)
@@ -64,8 +68,10 @@ def main(argv: "list[str] | None" = None) -> int:
     on_accel = devices[0].platform != "cpu"
     model_name = args.model or ("small" if on_accel else "tiny")
     seq = args.seq or (512 if model_name == "small" else 64)
-    model = (transformer_lm_small(max_seq_len=max(seq, 512))
-             if model_name == "small" else transformer_lm_tiny())
+    model = (transformer_lm_small(max_seq_len=max(seq, 512),
+                                  remat=args.remat)
+             if model_name == "small"
+             else transformer_lm_tiny(remat=args.remat))
     # Hybrid layout across Job pods: 'model' stays on each pod's local ICI,
     # 'data' (the gradient psum) spans pods over DCN.
     mesh = make_hybrid_mesh(model_parallelism=args.model_parallelism)
@@ -117,16 +123,19 @@ def main(argv: "list[str] | None" = None) -> int:
             "mfu": round(tflops / peak, 4) if peak else None,
         }), flush=True)
         if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
-            ckpt.save_bundle(args.ckpt_dir, step + 1, bundle)
-            print(json.dumps({"event": "checkpoint", "step": step + 1}),
-                  flush=True)
+            # Async: the persist overlaps the next steps' compute; the next
+            # save (or the final wait) drains it.
+            ckpt.save_bundle(args.ckpt_dir, step + 1, bundle, blocking=False)
+            print(json.dumps({"event": "checkpoint", "step": step + 1,
+                              "async": True}), flush=True)
 
     # Final save, unless the loop's periodic save already covered this step.
     if (args.ckpt_dir and args.steps > start_step
             and args.steps % args.ckpt_every != 0):
-        ckpt.save_bundle(args.ckpt_dir, args.steps, bundle)
-        print(json.dumps({"event": "checkpoint", "step": args.steps}),
-              flush=True)
+        ckpt.save_bundle(args.ckpt_dir, args.steps, bundle, blocking=False)
+        print(json.dumps({"event": "checkpoint", "step": args.steps,
+                          "async": True}), flush=True)
+    ckpt.wait_for_saves()  # all in-flight saves must commit before exit
     return 0
 
 

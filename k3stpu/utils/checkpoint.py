@@ -27,14 +27,50 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
+_async_ckptr = None
+
+
+def _async_checkpointer():
+    global _async_ckptr
+    if _async_ckptr is None:
+        import orbax.checkpoint as ocp
+
+        _async_ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _async_ckptr
+
+
+def wait_for_saves() -> None:
+    """Block until every in-flight async save has committed (call before
+    process exit, or before reading back a just-written step)."""
+    if _async_ckptr is not None:
+        _async_ckptr.wait_until_finished()
+
+
 def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
-                     *, force: bool = True) -> pathlib.Path:
+                     *, force: bool = True,
+                     blocking: bool = True) -> pathlib.Path:
     """Write ``state`` (any pytree of jax.Arrays, e.g. a dict of
-    params/batch_stats/opt_state) under ``directory/step``."""
+    params/batch_stats/opt_state) under ``directory/step``.
+
+    ``blocking=False`` uses orbax's AsyncCheckpointer: device arrays are
+    snapshotted to host, the persist runs on a background thread, and the
+    train loop keeps stepping — the standard TPU trade of a little host RAM
+    for zero step-time stall. Only one async save is in flight at a time
+    (a new save first drains the previous); ``latest_step`` already skips
+    unfinalized steps, so an interrupted async save can never be resumed
+    from.
+    """
     path = pathlib.Path(directory).resolve() / str(step)
-    ckptr = _checkpointer()
-    ckptr.save(path, state, force=force)
-    ckptr.wait_until_finished()
+    if blocking:
+        ckptr = _checkpointer()
+        ckptr.save(path, state, force=force)
+        ckptr.wait_until_finished()
+    else:
+        import orbax.checkpoint as ocp
+
+        ckptr = _async_checkpointer()
+        ckptr.wait_until_finished()  # previous in-flight save must land
+        ckptr.save(path, args=ocp.args.StandardSave(state), force=force)
     return path
 
 
@@ -80,13 +116,14 @@ def latest_step(directory: str | pathlib.Path) -> int | None:
     return max(steps) if steps else None
 
 
-def save_bundle(directory: str | pathlib.Path, step: int, bundle) -> pathlib.Path:
+def save_bundle(directory: str | pathlib.Path, step: int, bundle,
+                *, blocking: bool = True) -> pathlib.Path:
     """Checkpoint a parallel.train.TrainBundle's mutable state."""
     return save_train_state(directory, step, {
         "params": bundle.params,
         "batch_stats": bundle.batch_stats,
         "opt_state": bundle.opt_state,
-    })
+    }, blocking=blocking)
 
 
 def restore_bundle(directory: str | pathlib.Path, step: int, bundle) -> None:

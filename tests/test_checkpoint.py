@@ -61,3 +61,21 @@ def test_bundle_resume_preserves_training(tmp_path):
     # Restored arrays keep their mesh shardings (no silent host gather).
     leaf = jax.tree.leaves(resumed.params)[0]
     assert leaf.sharding.mesh.shape == mesh.shape
+
+
+def test_async_save_restore_roundtrip(tmp_path):
+    """blocking=False saves commit in the background; wait_for_saves() makes
+    them durable and latest_step sees only finalized steps."""
+    import jax.numpy as jnp
+
+    from k3stpu.utils import checkpoint as ckpt
+
+    state = {"w": jnp.arange(8, dtype=jnp.float32), "n": jnp.ones(())}
+    ckpt.save_train_state(tmp_path, 1, state, blocking=False)
+    ckpt.save_train_state(tmp_path, 2, jax.tree.map(lambda x: x * 2, state),
+                          blocking=False)  # drains save 1 first
+    ckpt.wait_for_saves()
+    assert ckpt.latest_step(tmp_path) == 2
+    restored = ckpt.restore_train_state(tmp_path, 2, state)
+    np.testing.assert_allclose(np.asarray(restored["w"]),
+                               2 * np.arange(8, dtype=np.float32))

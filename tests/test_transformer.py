@@ -96,3 +96,30 @@ def test_bad_attn_impl_raises():
     tokens = jnp.zeros((1, 16), jnp.int32)
     with pytest.raises(ValueError, match="attn_impl"):
         model.init(jax.random.key(0), tokens)
+
+
+def test_remat_same_forward_and_grads():
+    """cfg.remat must change memory behavior only: identical params tree,
+    identical logits, identical gradients (activation recomputation)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+
+    plain = transformer_lm_tiny(dtype=jnp.float32)
+    remat = transformer_lm_tiny(dtype=jnp.float32, remat=True)
+    tokens = jnp.arange(2 * 32, dtype=jnp.int32).reshape(2, 32) % 512
+    vs = plain.init(jax.random.key(0), tokens)
+    assert (jax.tree.structure(remat.init(jax.random.key(0), tokens))
+            == jax.tree.structure(vs))
+
+    def loss(model, params):
+        return jnp.mean(model.apply({"params": params}, tokens) ** 2)
+
+    lp, gp = jax.value_and_grad(lambda p: loss(plain, p))(vs["params"])
+    lr, gr = jax.value_and_grad(lambda p: loss(remat, p))(vs["params"])
+    np.testing.assert_allclose(float(lp), float(lr), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gr)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
