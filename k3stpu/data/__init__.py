@@ -1,0 +1,16 @@
+"""Input pipeline: memory-mapped token corpora + async device prefetch.
+
+The reference stack has no training and therefore no input path (SURVEY.md
+§2c); a complete training framework needs one that never makes the chip
+wait on the host. Two pieces:
+
+- :mod:`k3stpu.data.corpus` — zero-copy ``np.memmap`` token corpus with
+  random-crop batch sampling (no tokenizer dependency: the on-disk format
+  is a flat array of token ids, the lingua franca every tokenizer can emit).
+- :mod:`k3stpu.data.prefetch` — a background thread that stages upcoming
+  batches onto the device (double-buffered by default) so ``device_put``
+  H2D transfers overlap the current step's compute.
+"""
+
+from k3stpu.data.corpus import TokenCorpus, synthetic_corpus  # noqa: F401
+from k3stpu.data.prefetch import DevicePrefetcher  # noqa: F401

@@ -1,0 +1,105 @@
+"""Memory-mapped token corpus with random-crop LM batch sampling.
+
+On-disk format: a flat little-endian array of token ids (uint16 when the
+vocab fits, else uint32) — the least-common-denominator output every
+tokenizer pipeline can produce; write with :func:`write_token_file` (which
+picks the dtype from the vocab and range-checks) rather than a bare
+``tofile`` so the reader's dtype inference can't silently disagree. The
+corpus never loads into RAM: ``np.memmap`` pages in only the crops a batch
+touches, so a multi-GB corpus costs page-cache, not heap, and K8s memory
+limits stay honest (the pod's working set is ~batch-size, not corpus-size).
+
+Batches are next-token-prediction pairs: ``inputs[i] = crop[:-1]``,
+``labels[i] = crop[1:]`` for independent uniformly-random crops — the
+stateless sampling makes resume trivial (the RNG seed + step count is the
+full data-order state; no iterator checkpointing).
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+import numpy as np
+
+
+def write_token_file(path: "str | pathlib.Path", tokens,
+                     vocab_size: int) -> pathlib.Path:
+    """Persist a token-id sequence in the corpus format (dtype by vocab)."""
+    path = pathlib.Path(path)
+    dtype = np.uint16 if vocab_size <= np.iinfo(np.uint16).max + 1 else np.uint32
+    arr = np.asarray(tokens)
+    if arr.min() < 0 or arr.max() >= vocab_size:
+        raise ValueError(
+            f"token ids outside [0, {vocab_size}): "
+            f"[{arr.min()}, {arr.max()}]")
+    arr.astype(dtype).tofile(path)
+    return path
+
+
+def synthetic_corpus(path: "str | pathlib.Path", vocab_size: int = 512,
+                     n_tokens: int = 1 << 16, seed: int = 0) -> pathlib.Path:
+    """A fabricated corpus file for tests/dry-runs (SURVEY.md §4's fake
+    fixtures tier — the data analogue of the fake sysfs tree)."""
+    rng = np.random.default_rng(seed)
+    return write_token_file(
+        path, rng.integers(0, vocab_size, size=n_tokens), vocab_size)
+
+
+class TokenCorpus:
+    """Random-crop LM batches over a memory-mapped token file."""
+
+    def __init__(self, path: "str | pathlib.Path", vocab_size: int,
+                 dtype=None):
+        self.path = pathlib.Path(path)
+        if dtype is None:
+            dtype = (np.uint16
+                     if vocab_size <= np.iinfo(np.uint16).max + 1
+                     else np.uint32)
+        size = self.path.stat().st_size
+        if size % np.dtype(dtype).itemsize:
+            raise ValueError(
+                f"corpus {self.path} is {size} bytes — not a whole number "
+                f"of {np.dtype(dtype).name} tokens; was it written with a "
+                f"different dtype? (use write_token_file)")
+        self.tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        self.vocab_size = vocab_size
+        if len(self.tokens) < 2:
+            raise ValueError(f"corpus {self.path} has {len(self.tokens)} "
+                             "tokens; need at least 2")
+        # Cheap dtype-mismatch tripwire: a file written as int64 (or with a
+        # different vocab) read as uint16 shows out-of-vocab values almost
+        # immediately — fail loudly instead of training on garbage. Bounded
+        # scan so multi-GB corpora stay cheap to open.
+        head = np.asarray(self.tokens[: 1 << 20])
+        if head.size and int(head.max()) >= vocab_size:
+            raise ValueError(
+                f"corpus {self.path} contains token id {int(head.max())} "
+                f">= vocab_size {vocab_size}: dtype/vocab mismatch "
+                "(write with write_token_file, read with the same vocab)")
+
+    def __len__(self) -> int:
+        return len(self.tokens)
+
+    def sample_batch(self, rng: np.random.Generator, batch: int,
+                     seq: int) -> "tuple[np.ndarray, np.ndarray]":
+        """(inputs, labels) of shape (batch, seq) int32: seq+1-token crops
+        at independent uniform offsets, shifted by one for next-token loss."""
+        span = seq + 1
+        if len(self.tokens) < span:
+            raise ValueError(
+                f"corpus has {len(self.tokens)} tokens < seq+1 = {span}")
+        starts = rng.integers(0, len(self.tokens) - span + 1, size=batch)
+        crops = np.stack([self.tokens[s:s + span] for s in starts])
+        crops = crops.astype(np.int32)
+        return crops[:, :-1], crops[:, 1:]
+
+    def batches(self, batch: int, seq: int, seed: int = 0,
+                start_step: int = 0):
+        """Infinite deterministic batch stream; resuming at ``start_step``
+        reproduces the exact data order a fresh run would have seen there
+        (one child seed per step — no sequential RNG state to restore)."""
+        step = start_step
+        while True:
+            rng = np.random.default_rng(np.random.SeedSequence((seed, step)))
+            yield self.sample_batch(rng, batch, seq)
+            step += 1

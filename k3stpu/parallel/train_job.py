@@ -39,6 +39,10 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="rematerialize block activations in the backward "
                          "(fits deeper/longer configs in HBM at ~1 extra "
                          "forward of FLOPs)")
+    ap.add_argument("--data", default=None,
+                    help="token corpus file (k3stpu.data.corpus format, "
+                         "e.g. a volume mount); omit for synthetic batches")
+    ap.add_argument("--data-seed", type=int, default=0)
     ap.add_argument("--profile-port", type=int, default=0,
                     help="jax.profiler.start_server port (0 = off)")
     args = ap.parse_args(argv)
@@ -106,36 +110,67 @@ def main(argv: "list[str] | None" = None) -> int:
     peak = peak_tflops_for()
     n_chips = len(devices)
 
+    # Input pipeline: real corpus batches prefetch to the device on a
+    # background thread (H2D overlaps compute); the stateless per-step
+    # sampling means resume needs no iterator state — start_step IS the
+    # data-order state. Synthetic fallback keeps the smoke path hermetic.
+    prefetch = None
+    if args.data:
+        from k3stpu.data import DevicePrefetcher, TokenCorpus
+        from k3stpu.parallel.sharding import batch_sharding
+
+        corpus = TokenCorpus(args.data, vocab)
+        sh = batch_sharding(mesh)
+        prefetch = DevicePrefetcher(
+            corpus.batches(batch, seq, seed=args.data_seed,
+                           start_step=start_step),
+            sharding=(sh, sh))
+        batches = iter(prefetch)
+        print(json.dumps({"event": "data", "path": args.data,
+                          "corpus_tokens": len(corpus)}), flush=True)
+
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
-    for step in range(start_step, args.steps):
-        rng, k = jax.random.split(rng)
-        inputs, labels = synth_token_batch(k, batch, seq, vocab)
-        t0 = time.perf_counter()
-        loss = bundle.run(inputs, labels)
-        dt = time.perf_counter() - t0
-        tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
-        print(json.dumps({
-            "event": "step", "step": step + 1, "loss": round(loss, 4),
-            "step_s": round(dt, 4),
-            "tokens_per_s": round(tokens_per_step / dt, 1),
-            "tflops_per_chip": round(tflops, 2),
-            "mfu": round(tflops / peak, 4) if peak else None,
-        }), flush=True)
-        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
-            # Async: the persist overlaps the next steps' compute; the next
-            # save (or the final wait) drains it.
-            ckpt.save_bundle(args.ckpt_dir, step + 1, bundle, blocking=False)
-            print(json.dumps({"event": "checkpoint", "step": step + 1,
-                              "async": True}), flush=True)
+    try:
+        for step in range(start_step, args.steps):
+            if prefetch is not None:
+                inputs, labels = next(batches)
+            else:
+                rng, k = jax.random.split(rng)
+                inputs, labels = synth_token_batch(k, batch, seq, vocab)
+            t0 = time.perf_counter()
+            loss = bundle.run(inputs, labels)
+            dt = time.perf_counter() - t0
+            tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
+            print(json.dumps({
+                "event": "step", "step": step + 1, "loss": round(loss, 4),
+                "step_s": round(dt, 4),
+                "tokens_per_s": round(tokens_per_step / dt, 1),
+                "tflops_per_chip": round(tflops, 2),
+                "mfu": round(tflops / peak, 4) if peak else None,
+            }), flush=True)
+            if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+                # Async: the persist overlaps the next steps' compute; the
+                # next save (or the final wait) drains it.
+                ckpt.save_bundle(args.ckpt_dir, step + 1, bundle,
+                                 blocking=False)
+                print(json.dumps({"event": "checkpoint", "step": step + 1,
+                                  "async": True}), flush=True)
 
-    # Final save, unless the loop's periodic save already covered this step.
-    if (args.ckpt_dir and args.steps > start_step
-            and args.steps % args.ckpt_every != 0):
-        ckpt.save_bundle(args.ckpt_dir, args.steps, bundle, blocking=False)
-        print(json.dumps({"event": "checkpoint", "step": args.steps,
-                          "async": True}), flush=True)
-    ckpt.wait_for_saves()  # all in-flight saves must commit before exit
+        # Final save, unless the periodic save already covered this step.
+        if (args.ckpt_dir and args.steps > start_step
+                and args.steps % args.ckpt_every != 0):
+            ckpt.save_bundle(args.ckpt_dir, args.steps, bundle,
+                             blocking=False)
+            print(json.dumps({"event": "checkpoint", "step": args.steps,
+                              "async": True}), flush=True)
+    finally:
+        # A crashing loop must still land any in-flight async save — that
+        # snapshot is already host-resident and is exactly the state the
+        # restarted pod should resume from.
+        if prefetch is not None:
+            prefetch.close()
+        ckpt.wait_for_saves()
     return 0
 
 

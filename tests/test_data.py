@@ -1,0 +1,128 @@
+"""Input pipeline: memmap corpus sampling + async device prefetch."""
+
+import numpy as np
+import pytest
+
+from k3stpu.data import DevicePrefetcher, TokenCorpus, synthetic_corpus
+from k3stpu.data.corpus import write_token_file
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    path = synthetic_corpus(tmp_path / "toks.bin", vocab_size=512,
+                            n_tokens=4096, seed=3)
+    return TokenCorpus(path, vocab_size=512)
+
+
+def test_corpus_shapes_and_shift(corpus):
+    rng = np.random.default_rng(0)
+    inputs, labels = corpus.sample_batch(rng, batch=4, seq=32)
+    assert inputs.shape == labels.shape == (4, 32)
+    assert inputs.dtype == labels.dtype == np.int32
+    # labels are inputs shifted by one within the same crop
+    np.testing.assert_array_equal(inputs[:, 1:], labels[:, :-1])
+    assert inputs.max() < 512 and inputs.min() >= 0
+
+
+def test_corpus_crops_come_from_file(tmp_path):
+    toks = np.arange(100) % 64
+    path = write_token_file(tmp_path / "t.bin", toks, vocab_size=64)
+    c = TokenCorpus(path, vocab_size=64)
+    inputs, labels = c.sample_batch(np.random.default_rng(1), 2, 8)
+    for row_in, row_lab in zip(inputs, labels):
+        # Contiguity: each crop is consecutive mod-64 ramp values.
+        np.testing.assert_array_equal((row_in[1:] - row_in[:-1]) % 64,
+                                      np.ones(7, np.int32))
+        np.testing.assert_array_equal(row_lab[:-1], row_in[1:])
+
+
+def test_batches_deterministic_resume(corpus):
+    a = corpus.batches(batch=2, seq=16, seed=7)
+    first_five = [next(a) for _ in range(5)]
+    b = corpus.batches(batch=2, seq=16, seed=7, start_step=3)
+    for expect, got in zip(first_five[3:], [next(b), next(b)]):
+        np.testing.assert_array_equal(expect[0], got[0])
+        np.testing.assert_array_equal(expect[1], got[1])
+
+
+def test_write_rejects_out_of_range(tmp_path):
+    with pytest.raises(ValueError, match="outside"):
+        write_token_file(tmp_path / "bad.bin", [0, 5, 700], vocab_size=512)
+
+
+def test_prefetcher_preserves_order_and_values(corpus):
+    batches = [corpus.sample_batch(np.random.default_rng(i), 2, 8)
+               for i in range(6)]
+    with DevicePrefetcher(iter(batches), depth=2) as pf:
+        out = list(pf)
+    assert len(out) == 6
+    for (ei, el), (gi, gl) in zip(batches, out):
+        np.testing.assert_array_equal(ei, np.asarray(gi))
+        np.testing.assert_array_equal(el, np.asarray(gl))
+
+
+def test_prefetcher_propagates_source_error():
+    def bad_iter():
+        yield (np.zeros((1, 2), np.int32), np.zeros((1, 2), np.int32))
+        raise RuntimeError("corpus disappeared")
+
+    pf = DevicePrefetcher(bad_iter())
+    next(pf)
+    with pytest.raises(RuntimeError, match="corpus disappeared"):
+        next(pf)
+
+
+def test_prefetcher_close_unblocks_producer(corpus):
+    # An unconsumed infinite stream must not hang close().
+    pf = DevicePrefetcher(corpus.batches(2, 8, seed=1), depth=1)
+    next(pf)
+    pf.close()
+    pf._thread.join(timeout=5)
+    assert not pf._thread.is_alive()
+
+
+def test_train_job_with_corpus(tmp_path):
+    """End to end: train_job consumes a corpus file through the prefetcher,
+    checkpoints, and resumes with the same data order."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data = synthetic_corpus(tmp_path / "corpus.bin", vocab_size=512,
+                            n_tokens=1 << 14)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+
+    def run(steps):
+        out = subprocess.run(
+            [sys.executable, "-m", "k3stpu.parallel.train_job",
+             "--steps", str(steps), "--ckpt-dir", str(tmp_path / "ck"),
+             "--ckpt-every", "2", "--data", str(data)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return [json.loads(l) for l in out.stdout.splitlines()]
+
+    first = run(4)
+    assert any(e["event"] == "data" for e in first)
+    assert sum(e["event"] == "step" for e in first) == 4
+    second = run(6)
+    assert any(e["event"] == "resume" and e["step"] == 4 for e in second)
+    assert sum(e["event"] == "step" for e in second) == 2
+
+
+def test_corpus_rejects_dtype_mismatch(tmp_path):
+    # A file written with the wrong dtype must fail loudly at open (the
+    # head scan sees out-of-vocab values), not train on garbage.
+    np.full(100, 70000, dtype=np.int64).tofile(tmp_path / "x.bin")
+    with pytest.raises(ValueError, match="vocab"):
+        TokenCorpus(tmp_path / "x.bin", vocab_size=512)
+    # Non-whole-token file sizes are rejected outright.
+    (tmp_path / "odd.bin").write_bytes(b"\x01\x02\x03")
+    with pytest.raises(ValueError, match="whole number"):
+        TokenCorpus(tmp_path / "odd.bin", vocab_size=512)
