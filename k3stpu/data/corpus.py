@@ -28,6 +28,12 @@ def write_token_file(path: "str | pathlib.Path", tokens,
     path = pathlib.Path(path)
     dtype = np.uint16 if vocab_size <= np.iinfo(np.uint16).max + 1 else np.uint32
     arr = np.asarray(tokens)
+    if arr.size == 0:
+        raise ValueError("refusing to write an empty corpus")
+    if not np.issubdtype(arr.dtype, np.integer):
+        raise ValueError(
+            f"token ids must be integers, got dtype {arr.dtype} "
+            "(astype would silently truncate)")
     if arr.min() < 0 or arr.max() >= vocab_size:
         raise ValueError(
             f"token ids outside [0, {vocab_size}): "

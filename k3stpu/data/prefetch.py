@@ -53,6 +53,10 @@ class DevicePrefetcher:
                         continue
         except Exception as e:  # noqa: BLE001 — surface in the consumer
             self._q.put(e)
+            # Terminal sentinel even after an error: a consumer that logs
+            # the exception and calls next() again must get StopIteration,
+            # not a forever-blocking get().
+            self._q.put(self._DONE)
             return
         self._q.put(self._DONE)
 

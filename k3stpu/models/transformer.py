@@ -32,6 +32,11 @@ class TransformerConfig:
     vocab_size: int = 32768
     d_model: int = 768
     n_heads: int = 12
+    # KV heads for GQA/MQA (None = n_heads, i.e. plain MHA). Fewer KV heads
+    # shrink the serving KV cache by n_heads/n_kv_heads — the lever that
+    # fits longer contexts per chip; the flash kernel reads the small
+    # tensors directly (no head repeat materialized).
+    n_kv_heads: int | None = None
     n_layers: int = 12
     d_ff: int = 3072
     max_seq_len: int = 2048
@@ -104,24 +109,33 @@ class Attention(nn.Module):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
+        kv_heads = cfg.n_kv_heads or cfg.n_heads
+        if cfg.n_heads % kv_heads:
+            raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
+                             f"n_kv_heads {kv_heads}")
+        kv_dim = kv_heads * head_dim
 
-        qkv = nn.Dense(3 * cfg.d_model, use_bias=False, dtype=cfg.dtype,
-                       param_dtype=jnp.float32, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, cfg.n_heads, head_dim)
-        k = k.reshape(b, s, cfg.n_heads, head_dim)
-        v = v.reshape(b, s, cfg.n_heads, head_dim)
+        # One fused projection; with GQA the K/V slices are simply narrower
+        # (the parameter is (d_model, d_model + 2*kv_dim)).
+        qkv = nn.Dense(cfg.d_model + 2 * kv_dim, use_bias=False,
+                       dtype=cfg.dtype, param_dtype=jnp.float32,
+                       name="qkv")(x)
+        q = qkv[..., :cfg.d_model].reshape(b, s, cfg.n_heads, head_dim)
+        k = qkv[..., cfg.d_model:cfg.d_model + kv_dim].reshape(
+            b, s, kv_heads, head_dim)
+        v = qkv[..., cfg.d_model + kv_dim:].reshape(b, s, kv_heads, head_dim)
 
         angles = jnp.asarray(rope_frequencies(head_dim, cfg.max_seq_len))
         scale = 1.0 / np.sqrt(head_dim)
 
         if mode in ("prefill", "decode"):
+            # GQA shrinks the cache by n_heads/kv_heads — the whole point.
             cache_k = self.variable(
                 "cache", "key", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.n_heads, head_dim), cfg.dtype)
+                (b, cfg.max_seq_len, kv_heads, head_dim), cfg.dtype)
             cache_v = self.variable(
                 "cache", "value", jnp.zeros,
-                (b, cfg.max_seq_len, cfg.n_heads, head_dim), cfg.dtype)
+                (b, cfg.max_seq_len, kv_heads, head_dim), cfg.dtype)
             cache_idx = self.variable(
                 "cache", "index", lambda: jnp.zeros((), jnp.int32))
 
@@ -139,6 +153,11 @@ class Attention(nn.Module):
             cache_k.value, cache_v.value = ck, cv
             cache_idx.value = idx + 1
 
+            if kv_heads != cfg.n_heads:
+                # Decode is tiny (one q token); repeating the cached heads
+                # for the einsum costs far less than the cache savings.
+                ck = jnp.repeat(ck, cfg.n_heads // kv_heads, axis=2)
+                cv = jnp.repeat(cv, cfg.n_heads // kv_heads, axis=2)
             logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
                                 preferred_element_type=jnp.float32) * scale
             visible = jnp.arange(cfg.max_seq_len) <= idx
@@ -167,9 +186,14 @@ class Attention(nn.Module):
             else:
                 use_flash = resolved == "flash" and s % DEFAULT_BLOCK == 0
             if use_flash:
+                # GQA goes straight through: the kernel reads the narrow
+                # k/v tensors (grid cell b -> kv block b // group).
                 out = flash_attention(q, k, v, causal=True, scale=scale,
                                       interpret=jax.default_backend() != "tpu")
             else:
+                if kv_heads != cfg.n_heads:
+                    k = jnp.repeat(k, cfg.n_heads // kv_heads, axis=2)
+                    v = jnp.repeat(v, cfg.n_heads // kv_heads, axis=2)
                 logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
                                     preferred_element_type=jnp.float32) * scale
                 mask = jnp.tril(jnp.ones((s, s), bool))

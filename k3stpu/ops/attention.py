@@ -129,12 +129,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
+def _group_of(q, k) -> int:
+    """Query-heads-per-KV-head ratio from the FOLDED (b*h, s, d) shapes —
+    GQA/MQA share one K/V head across `group` consecutive query heads."""
+    bh_q, bh_kv = q.shape[0], k.shape[0]
+    if bh_q % bh_kv:
+        raise ValueError(
+            f"query heads ({bh_q}) must be a multiple of kv heads ({bh_kv})")
+    return bh_q // bh_kv
+
+
 def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
                    with_lse, vmem_limit_bytes=32 * 1024 * 1024):
     """Returns (o, lse) when with_lse (the training path needs the residual)
-    else just o — the inference hot path skips the lse HBM write entirely."""
+    else just o — the inference hot path skips the lse HBM write entirely.
+    GQA: k/v may carry fewer folded heads; grid cell b reads kv block
+    b // group (no repeat is ever materialized)."""
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
+    group = _group_of(q, k)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)
     if s_q % block_q or s_kv % block_k:
@@ -158,8 +171,10 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d),
+                         lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
@@ -308,6 +323,7 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
                     interpret, vmem_limit_bytes=32 * 1024 * 1024):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
+    group = _group_of(q, k)
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)
     if s_q % block_q or s_kv % block_k:
@@ -326,7 +342,13 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
 
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
     r_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
-    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d),
+                           lambda b, i, j: (b // group, i, 0))
+    # GQA: each grid cell owns ONE query head, so dK/dV land per-q-head
+    # (no cross-cell write races on the shared kv head) and the group-sum
+    # below folds them onto the kv heads.
+    dkv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
+    dkv_shape = (bh, s_kv, d)
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, offset=offset)
 
@@ -334,9 +356,9 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
         functools.partial(_bwd_dkv_kernel, **common),
         grid=(bh, s_kv // block_k, s_q // block_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
-        out_specs=(kv_spec, kv_spec),
-        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        out_specs=(dkv_spec, dkv_spec),
+        out_shape=(jax.ShapeDtypeStruct(dkv_shape, k.dtype),
+                   jax.ShapeDtypeStruct(dkv_shape, v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -348,10 +370,19 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
             transcendentals=bh * s_q * s_kv),
         interpret=interpret,
     )(q, k, v, g, lse, di)
+    if group > 1:
+        # Fold the per-q-head partials onto shared kv heads: consecutive
+        # `group` q heads read kv head bh // group, so the reduction is a
+        # contiguous reshape-sum (fp32 accumulation).
+        fold_g = lambda x: x.reshape(bh // group, group, s_kv, d).astype(
+            jnp.float32).sum(axis=1)
+        dk = fold_g(dk).astype(k.dtype)
+        dv = fold_g(dv).astype(v.dtype)
 
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     r_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
-    kv_spec2 = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    kv_spec2 = pl.BlockSpec((1, block_k, d),
+                            lambda b, i, j: (b // group, j, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -415,13 +446,19 @@ def flash_attention(
     Pallas backward kernels (tile recomputation from the saved logsumexp —
     O(S) memory both ways). ``interpret=True`` runs the kernels in the Pallas
     interpreter (CPU CI — SURVEY.md §4's "CPU-JAX stand-in" test tier).
+
+    GQA/MQA: ``k``/``v`` may carry fewer heads than ``q`` (any divisor, 1 =
+    multi-query); kv blocks are read once per shared group straight from the
+    smaller tensors — nothing head-repeated is ever materialized, in either
+    direction.
     """
     b, s_q, h, d = q.shape
     s_kv = k.shape[1]
     if scale is None:
         scale = d ** -0.5
 
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], x.shape[1], d)
     out = _flash(fold(q), fold(k), fold(v), scale, causal,
                  block_q, block_k, interpret)
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
@@ -451,7 +488,8 @@ def flash_attention_fwd_lse(
     s_kv = k.shape[1]
     if scale is None:
         scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], x.shape[1], d)
     out, lse = _flash_forward(
         fold(q), fold(k), fold(v), scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret, with_lse=True)
@@ -487,21 +525,29 @@ def flash_attention_bwd_shard(
     b, s_q, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
+        b * x.shape[2], x.shape[1], d)
     lse_f = jnp.broadcast_to(
         lse.transpose(0, 2, 1).reshape(b * h, s_q, 1), (b * h, s_q, _LANES))
     dq, dk, dv = _flash_backward(
         fold(q), fold(k), fold(v), fold(out), lse_f, fold(g),
         scale=scale, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret)
-    unfold = lambda x: x.reshape(b, h, x.shape[1], d).transpose(0, 2, 1, 3)
-    return unfold(dq), unfold(dk), unfold(dv)
+    unfold = lambda x, heads: x.reshape(
+        b, heads, x.shape[1], d).transpose(0, 2, 1, 3)
+    return (unfold(dq, h), unfold(dk, k.shape[2]), unfold(dv, v.shape[2]))
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
                         scale: float | None = None) -> jax.Array:
-    """(B, S, H, D) einsum attention — the correctness oracle for tests."""
+    """(B, S, H, D) einsum attention — the correctness oracle for tests.
+    GQA kv tensors are head-repeated up front (the oracle optimizes for
+    clarity, not memory)."""
     b, s_q, h, d = q.shape
+    if k.shape[2] != h:
+        rep = h // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     if scale is None:
         scale = d ** -0.5
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)

@@ -126,3 +126,25 @@ def test_corpus_rejects_dtype_mismatch(tmp_path):
     (tmp_path / "odd.bin").write_bytes(b"\x01\x02\x03")
     with pytest.raises(ValueError, match="whole number"):
         TokenCorpus(tmp_path / "odd.bin", vocab_size=512)
+
+
+def test_prefetcher_stops_after_error():
+    # "log and continue" consumers must get StopIteration after the error,
+    # never a forever-blocking get().
+    def bad_iter():
+        raise RuntimeError("boom")
+        yield  # noqa: unreachable — makes this a generator
+
+    pf = DevicePrefetcher(bad_iter())
+    with pytest.raises(RuntimeError, match="boom"):
+        next(pf)
+    with pytest.raises(StopIteration):
+        next(pf)
+
+
+def test_write_rejects_empty_and_float(tmp_path):
+    with pytest.raises(ValueError, match="empty"):
+        write_token_file(tmp_path / "e.bin", [], vocab_size=512)
+    with pytest.raises(ValueError, match="integers"):
+        write_token_file(tmp_path / "f.bin", np.array([0.9, 1.7]),
+                         vocab_size=512)
