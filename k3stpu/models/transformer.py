@@ -40,6 +40,10 @@ class TransformerConfig:
     n_layers: int = 12
     d_ff: int = 3072
     max_seq_len: int = 2048
+    # Sliding-window (Mistral-style) causal attention: each position sees
+    # its trailing `sliding_window` keys only. Attention cost and the live
+    # kernel tiles drop to O(S * window); None = full causal.
+    sliding_window: int | None = None
     dtype: Any = jnp.bfloat16
     # Rematerialize each block's activations in the backward pass
     # (jax.checkpoint via nn.remat): trades ~1 extra forward of FLOPs for
@@ -109,11 +113,25 @@ class Attention(nn.Module):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
-        kv_heads = cfg.n_kv_heads or cfg.n_heads
-        if cfg.n_heads % kv_heads:
-            raise ValueError(f"n_heads {cfg.n_heads} not divisible by "
-                             f"n_kv_heads {kv_heads}")
+        kv_heads = (cfg.n_kv_heads if cfg.n_kv_heads is not None
+                    else cfg.n_heads)
+        if kv_heads < 1 or cfg.n_heads % kv_heads:
+            raise ValueError(f"n_kv_heads {kv_heads} must be a positive "
+                             f"divisor of n_heads {cfg.n_heads}")
         kv_dim = kv_heads * head_dim
+
+        def grouped_attention(q, k, v, mask):
+            """Einsum attention with GQA-grouped queries — K/V stay at
+            kv_heads width (nothing head-repeated, matching the flash
+            kernel's in-place read). mask: (S_q, S_kv) bool."""
+            grp = cfg.n_heads // kv_heads
+            qg = q.reshape(*q.shape[:2], kv_heads, grp, head_dim)
+            logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
+                                preferred_element_type=jnp.float32) * scale
+            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
+            out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
+            return out.reshape(*q.shape[:2], cfg.n_heads, head_dim)
 
         # One fused projection; with GQA the K/V slices are simply narrower
         # (the parameter is (d_model, d_model + 2*kv_dim)).
@@ -153,17 +171,11 @@ class Attention(nn.Module):
             cache_k.value, cache_v.value = ck, cv
             cache_idx.value = idx + 1
 
-            if kv_heads != cfg.n_heads:
-                # Decode is tiny (one q token); repeating the cached heads
-                # for the einsum costs far less than the cache savings.
-                ck = jnp.repeat(ck, cfg.n_heads // kv_heads, axis=2)
-                cv = jnp.repeat(cv, cfg.n_heads // kv_heads, axis=2)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, ck,
-                                preferred_element_type=jnp.float32) * scale
-            visible = jnp.arange(cfg.max_seq_len) <= idx
-            logits = jnp.where(visible[None, None, None], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, cv)
+            pos = jnp.arange(cfg.max_seq_len)
+            visible = pos <= idx
+            if cfg.sliding_window is not None:
+                visible &= pos > idx - cfg.sliding_window
+            out = grouped_attention(q, ck, cv, visible[None, :])
         else:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)
@@ -189,17 +201,14 @@ class Attention(nn.Module):
                 # GQA goes straight through: the kernel reads the narrow
                 # k/v tensors (grid cell b -> kv block b // group).
                 out = flash_attention(q, k, v, causal=True, scale=scale,
+                                      window=cfg.sliding_window,
                                       interpret=jax.default_backend() != "tpu")
             else:
-                if kv_heads != cfg.n_heads:
-                    k = jnp.repeat(k, cfg.n_heads // kv_heads, axis=2)
-                    v = jnp.repeat(v, cfg.n_heads // kv_heads, axis=2)
-                logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                                    preferred_element_type=jnp.float32) * scale
                 mask = jnp.tril(jnp.ones((s, s), bool))
-                logits = jnp.where(mask[None, None], logits, -1e30)
-                probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
-                out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+                if cfg.sliding_window is not None:
+                    mask &= ~jnp.tril(jnp.ones((s, s), bool),
+                                      k=-cfg.sliding_window)
+                out = grouped_attention(q, k, v, mask)
         out = out.reshape(b, s, cfg.d_model)
         return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
                         param_dtype=jnp.float32, name="proj")(out)

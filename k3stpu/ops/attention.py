@@ -47,23 +47,37 @@ _LANES = 128  # TPU lane width: trailing dim of any VMEM tile
 DEFAULT_BLOCK = 256
 
 
-def _causal_tile_mask(s, qi, ki, block_q: int, block_k: int, offset: int):
+def _causal_tile_mask(s, qi, ki, block_q: int, block_k: int, offset: int,
+                      window: "int | None" = None):
     """Mask s (block_q, block_k) end-aligned: row r sees col c <= r + offset
-    at absolute positions, offset = s_kv - s_q (the decode convention)."""
+    at absolute positions, offset = s_kv - s_q (the decode convention).
+    With ``window``, additionally c > r + offset - window (sliding-window
+    attention: each query sees its trailing `window` keys only)."""
     rows = qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, s.shape, 0) + offset
     cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    return jnp.where(rows >= cols, s, _NEG_INF)
+    live = rows >= cols
+    if window is not None:
+        live = live & (cols > rows - window)
+    return jnp.where(live, s, _NEG_INF)
 
 
-def _causal_tile_live(qi, ki, block_q: int, block_k: int, offset: int):
-    """False iff the whole (qi, ki) tile sits above the causal diagonal."""
-    return ki * block_k <= qi * block_q + block_q - 1 + offset
+def _causal_tile_live(qi, ki, block_q: int, block_k: int, offset: int,
+                      window: "int | None" = None):
+    """False iff the whole (qi, ki) tile is masked: above the causal
+    diagonal, or (windowed) entirely behind every row's trailing window."""
+    live = ki * block_k <= qi * block_q + block_q - 1 + offset
+    if window is not None:
+        # Tile's last col must reach the band start of the tile's first
+        # row: col > row + offset - window for some (row, col) in tile.
+        live = live & ((ki + 1) * block_k - 1 > qi * block_q + offset
+                       - window)
+    return live
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  offset: int, with_lse: bool):
+                  offset: int, window: "int | None", with_lse: bool):
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
     else:
@@ -81,7 +95,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     # A k tile is live unless it sits entirely above the causal diagonal.
     live = True
     if causal:
-        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _update():
@@ -95,7 +109,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         ) * scale                          # (block_q, block_k) fp32
 
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+                                  window)
 
         m_prev = m_ref[:, :1]                             # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
@@ -140,7 +155,8 @@ def _group_of(q, k) -> int:
 
 
 def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
-                   with_lse, vmem_limit_bytes=32 * 1024 * 1024):
+                   with_lse, window=None,
+                   vmem_limit_bytes=32 * 1024 * 1024):
     """Returns (o, lse) when with_lse (the training path needs the residual)
     else just o — the inference hot path skips the lse HBM write entirely.
     GQA: k/v may carry fewer folded heads; grid cell b reads kv block
@@ -159,7 +175,7 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
-        with_lse=with_lse)
+        window=window, with_lse=with_lse)
 
     o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     o_shape = jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)
@@ -196,13 +212,16 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
     )(q, k, v)
 
 
-def _reference_attention(q, k, v, *, scale, causal):
+def _reference_attention(q, k, v, *, scale, causal, window=None):
     """Einsum attention with fp32 softmax — the oracle and the bwd remat."""
     s_q, s_kv = q.shape[1], k.shape[1]
     logits = jnp.einsum("bqd,bkd->bqk", q, k,
                         preferred_element_type=jnp.float32) * scale
     if causal:
         mask = jnp.tril(jnp.ones((s_q, s_kv), bool), k=s_kv - s_q)
+        if window is not None:
+            mask &= ~jnp.tril(jnp.ones((s_q, s_kv), bool),
+                              k=s_kv - s_q - window)
         logits = jnp.where(mask[None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     if causal:
@@ -217,7 +236,7 @@ def _reference_attention(q, k, v, *, scale, causal):
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                     dk_ref, dv_ref, dk_acc, dv_acc,
                     *, scale: float, causal: bool, block_q: int,
-                    block_k: int, offset: int):
+                    block_k: int, offset: int, window: "int | None"):
     """Accumulate dK/dV for one k tile across the q sweep (innermost)."""
     ki = pl.program_id(1)
     qi = pl.program_id(2)
@@ -230,7 +249,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     live = True
     if causal:
-        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _update():
@@ -248,7 +267,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+                                  window)
         p = jnp.exp(s - lse)               # (block_q, block_k) probs
 
         # dV += P^T dO
@@ -274,7 +294,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                    dq_ref, dq_acc,
                    *, scale: float, causal: bool, block_q: int,
-                   block_k: int, offset: int):
+                   block_k: int, offset: int, window: "int | None"):
     """Accumulate dQ for one q tile across the k sweep (innermost)."""
     qi = pl.program_id(1)
     ki = pl.program_id(2)
@@ -286,7 +306,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     live = True
     if causal:
-        live = _causal_tile_live(qi, ki, block_q, block_k, offset)
+        live = _causal_tile_live(qi, ki, block_q, block_k, offset, window)
 
     @pl.when(live)
     def _update():
@@ -302,7 +322,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset)
+            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+                                  window)
         p = jnp.exp(s - lse)
 
         dp = jax.lax.dot_general(
@@ -320,7 +341,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
 
 def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
-                    interpret, vmem_limit_bytes=32 * 1024 * 1024):
+                    interpret, window=None,
+                    vmem_limit_bytes=32 * 1024 * 1024):
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     group = _group_of(q, k)
@@ -350,7 +372,7 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     dkv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
     dkv_shape = (bh, s_kv, d)
     common = dict(scale=scale, causal=causal, block_q=block_q,
-                  block_k=block_k, offset=offset)
+                  block_k=block_k, offset=offset, window=window)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -404,25 +426,27 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
     return _flash_forward(q, k, v, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
-                          interpret=interpret, with_lse=False)
+                          interpret=interpret, with_lse=False,
+                          window=window)
 
 
-def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret):
+def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
     out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
                               block_q=block_q, block_k=block_k,
-                              interpret=interpret, with_lse=True)
+                              interpret=interpret, with_lse=True,
+                              window=window)
     return out, (q, k, v, out, lse)
 
 
-def _flash_bwd(scale, causal, block_q, block_k, interpret, res, g):
+def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
     q, k, v, o, lse = res
     return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
-                           interpret=interpret)
+                           interpret=interpret, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -438,6 +462,7 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK,
     block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
+    window: "int | None" = None,
 ) -> jax.Array:
     """Flash attention over ``(B, S, H, D)`` tensors (transformer layout).
 
@@ -457,10 +482,12 @@ def flash_attention(
     if scale is None:
         scale = d ** -0.5
 
+    if window is not None and not causal:
+        raise ValueError("window requires causal=True")
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
         b * x.shape[2], x.shape[1], d)
     out = _flash(fold(q), fold(k), fold(v), scale, causal,
-                 block_q, block_k, interpret)
+                 block_q, block_k, interpret, window)
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
 
 
@@ -539,7 +566,8 @@ def flash_attention_bwd_shard(
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
-                        scale: float | None = None) -> jax.Array:
+                        scale: float | None = None,
+                        window: "int | None" = None) -> jax.Array:
     """(B, S, H, D) einsum attention — the correctness oracle for tests.
     GQA kv tensors are head-repeated up front (the oracle optimizes for
     clarity, not memory)."""
@@ -552,5 +580,5 @@ def reference_attention(q, k, v, *, causal: bool = True,
         scale = d ** -0.5
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
     out = _reference_attention(fold(q), fold(k), fold(v),
-                               scale=scale, causal=causal)
+                               scale=scale, causal=causal, window=window)
     return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)

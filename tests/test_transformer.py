@@ -164,3 +164,33 @@ def test_gqa_generate_roundtrip():
                    jnp.array([4, 4], jnp.int32), 8)
     assert out.shape == (2, 8)
     assert bool(jnp.all((out >= 0) & (out < 512)))
+
+
+def test_sliding_window_full_vs_decode_consistent():
+    """A windowed model's incremental decode must reproduce the full
+    forward's logits position by position (window masks agree)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+
+    model = transformer_lm_tiny(sliding_window=8, max_seq_len=32,
+                                dtype=jnp.float32)
+    tokens = (jnp.arange(24, dtype=jnp.int32)[None] * 7) % 512
+    vs = model.init(jax.random.key(0), tokens)
+    full = model.apply(vs, tokens)  # (1, 24, V)
+
+    # prefill the first 16, then decode the rest one token at a time,
+    # checking EVERY decoded position against the full forward (catches
+    # window off-by-ones at the prefill/decode seam, not just the end).
+    _, state = model.apply(vs, tokens[:, :16], mode="prefill",
+                           mutable=["cache"])
+    for t in range(16, 24):
+        logits, state = model.apply(
+            {**vs, **state}, tokens[:, t:t + 1], mode="decode",
+            mutable=["cache"])
+        np.testing.assert_allclose(np.asarray(logits[0, 0]),
+                                   np.asarray(full[0, t]),
+                                   atol=2e-4, rtol=2e-4,
+                                   err_msg=f"decode position {t}")
