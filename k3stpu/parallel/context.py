@@ -554,6 +554,56 @@ def zigzag_flash_attention(
                          interpret)
 
 
+# --- Ulysses (all-to-all) sequence parallelism ------------------------------
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = True,
+    scale: float | None = None,
+    window: "int | None" = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    interpret: bool = False,
+) -> jax.Array:
+    """All-to-all sequence parallelism: the other canonical CP scheme.
+
+    Where the ring rotates K/V shards through every device, Ulysses swaps
+    the sharded dimension instead: one ``all_to_all`` turns sequence-sharded
+    (B, S_local, H, D) activations into head-sharded (B, S_global, H/n, D),
+    each device runs the ordinary flash kernel over the FULL sequence for
+    its own heads, and a second all_to_all swaps back. Two collectives
+    total (vs n-1 ppermute rounds), at the cost of requiring n | H — the
+    right trade when heads are plentiful and the axis is small. Composes
+    with GQA (kv heads must also divide) and sliding windows, and is
+    differentiable for free: all_to_all transposes to all_to_all and the
+    kernel brings its own VJP — no custom backward needed.
+    """
+    from k3stpu.ops.attention import flash_attention
+
+    n = jax.lax.psum(1, axis_name)
+    h, h_kv = q.shape[2], k.shape[2]
+    if h % n or h_kv % n:
+        raise ValueError(
+            f"ulysses needs the axis size ({n}) to divide query heads "
+            f"({h}) and kv heads ({h_kv}); use ring attention otherwise")
+
+    def to_heads(x):  # (B, S_local, H, D) -> (B, S_global, H/n, D)
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    out = flash_attention(
+        to_heads(q), to_heads(k), to_heads(v), causal=causal, scale=scale,
+        window=window, block_q=block_q, block_k=block_k, interpret=interpret)
+    # (B, S_global, H/n, D) -> (B, S_local, H, D)
+    return jax.lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+
 def make_context_mesh(n_devices: int | None = None,
                       devices: list | None = None) -> Mesh:
     """1-D ('seq',) mesh: every device is a sequence shard on the ring."""
@@ -574,7 +624,14 @@ def _ring_program(mesh: Mesh, axis_name: str, causal: bool,
     from jax import shard_map
 
     spec = P(None, axis_name, None, None)
-    if impl in ("flash", "zigzag"):
+    if impl in ("flash", "zigzag", "ulysses"):
+        if impl == "ulysses":
+            fn = functools.partial(ulysses_attention, axis_name=axis_name,
+                                   causal=causal, scale=scale,
+                                   interpret=interpret)
+            return jax.jit(shard_map(fn, mesh=mesh,
+                                     in_specs=(spec, spec, spec),
+                                     out_specs=spec, check_vma=False))
         if impl == "zigzag":
             if not causal:
                 raise ValueError("zigzag layout only balances causal rings; "

@@ -205,3 +205,52 @@ def test_zigzag_rejects_non_causal():
     with pytest.raises(ValueError, match="causal"):
         context_parallel_attention(mesh, q, k, v, causal=False,
                                    impl="zigzag", interpret=True)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_ulysses_matches_full(causal):
+    # 4-device axis, 4 heads -> 1 head per device after the all-to-all.
+    mesh = make_context_mesh(4)
+    q, k, v = _qkv(s=128, seed=17)
+    out = context_parallel_attention(mesh, q, k, v, causal=causal,
+                                     impl="ulysses", interpret=True)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ulysses_gradients_match_reference():
+    from functools import partial
+
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from k3stpu.parallel.context import ulysses_attention
+
+    mesh = make_context_mesh(2)
+    q, k, v = _qkv(b=1, s=64, h=2, d=16, seed=18)
+    spec = P(None, "seq", None, None)
+    sh = NamedSharding(mesh, spec)
+    q, k, v = (jax.device_put(x, sh) for x in (q, k, v))
+
+    ul = shard_map(partial(ulysses_attention, axis_name="seq",
+                           interpret=True),
+                   mesh=mesh, in_specs=(spec,) * 3, out_specs=spec,
+                   check_vma=False)
+    g_ul = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(ul(q, k, v) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.grad(
+        lambda q, k, v: jnp.sum(reference_attention(q, k, v) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for gu, gf in zip(g_ul, g_ref):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gf),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_ulysses_rejects_indivisible_heads():
+    mesh = make_context_mesh(8)
+    q, k, v = _qkv(s=64, h=4)  # 4 heads, 8-way axis
+    with pytest.raises(ValueError, match="divide"):
+        context_parallel_attention(mesh, q, k, v, impl="ulysses",
+                                   interpret=True)
