@@ -173,7 +173,7 @@ def test_zigzag_layout_roundtrip():
 
 def test_zigzag_matches_full_causal():
     mesh = make_context_mesh(8)
-    q, k, v = _qkv(s=256, seed=13)
+    q, k, v = _qkv(s=128, h=2, seed=13)  # 16 chunks of 8; exactness only
     out = context_parallel_attention(mesh, q, k, v, impl="zigzag",
                                      interpret=True)
     ref = reference_attention(q, k, v, causal=True)
