@@ -152,7 +152,13 @@ class InferenceServer:
 
     def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
                  image_size: int = 224, seq_len: int = 128,
-                 batch_window_ms: float = 5.0):
+                 batch_window_ms: float = 5.0,
+                 shard_devices: "int | None" = None):
+        """``shard_devices``: tensor-parallel serving over that many local
+        devices (the multi-chip-pod workload — a pod requesting
+        ``google.com/tpu: 4`` shards the model across its 4 chips; the
+        plugin's GetPreferredAllocation already made them ICI-adjacent).
+        None = all local devices when there are several, else single."""
         import jax
 
         self.model_name = model_name
@@ -192,8 +198,33 @@ class InferenceServer:
 
         self._variables = self.model.init(jax.random.key(0), example[:1],
                                           train=False)
-        self._forward = jax.jit(
-            lambda x: self.model.apply(self._variables, x, train=False))
+
+        n_local = len(jax.local_devices())
+        if shard_devices is None:
+            shard_devices = n_local if n_local > 1 else 1
+        self._mesh = None
+        if shard_devices > 1:
+            from k3stpu.parallel.mesh import make_mesh
+            from k3stpu.parallel.sharding import replicated, shard_params
+
+            # Pure tensor parallelism: every weight's feature axis splits
+            # over 'model' (parallel/sharding.py rules); XLA partitions the
+            # matmuls/convs and inserts the ICI collectives itself. Inputs
+            # and logits stay replicated — each request already fits one
+            # chip, the chips pool their FLOPs and HBM.
+            # Local devices only: under jax.distributed, jax.devices() is
+            # the global list and would hand this pod another host's chips.
+            self._mesh = make_mesh(shard_devices,
+                                   model_parallelism=shard_devices,
+                                   devices=jax.local_devices())
+            self._variables = shard_params(self._variables, self._mesh)[0]
+            repl = replicated(self._mesh)
+            self._forward = jax.jit(
+                lambda x: self.model.apply(self._variables, x, train=False),
+                in_shardings=(repl,), out_shardings=repl)
+        else:
+            self._forward = jax.jit(
+                lambda x: self.model.apply(self._variables, x, train=False))
         # batch_window_ms == 0 disables cross-request coalescing (each
         # request runs its own padded forward — the pre-coalescing behavior,
         # kept as the loadgen baseline).
@@ -377,6 +408,7 @@ class InferenceServer:
             "batch_sizes": list(BATCH_SIZES),
             "batching": {"window_ms": (self._batcher._window_s * 1e3
                                        if self._batcher else 0.0)},
+            "sharding": (dict(self._mesh.shape) if self._mesh else None),
             "devices": [str(d) for d in jax.devices()],
             "stats": stats,
             "throughput": throughput,
@@ -461,6 +493,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="coalescing window for concurrent /v1/predict "
                          "requests (0 disables cross-request batching)")
+    ap.add_argument("--shard-devices", type=int, default=None,
+                    help="tensor-parallel serving over N local chips "
+                         "(default: all local devices when a multi-chip "
+                         "pod granted several; 1 = single-chip)")
     ap.add_argument("--profile-port", type=int, default=0,
                     help="expose jax.profiler.start_server on this port "
                          "(0 = off); capture with jax.profiler.trace or "
@@ -475,7 +511,8 @@ def main(argv=None) -> int:
 
     server = InferenceServer(model_name=args.model,
                              image_size=args.image_size, seq_len=args.seq_len,
-                             batch_window_ms=args.batch_window_ms)
+                             batch_window_ms=args.batch_window_ms,
+                             shard_devices=args.shard_devices)
     if not args.no_warmup:
         print("warming up (pre-compiling batch sizes)...", flush=True)
         server.warmup()
