@@ -1,4 +1,4 @@
-"""Batched JAX inference server (ResNet-50 or transformer LM) on one chip.
+"""Batched JAX inference server (ResNet-50, transformer LM, or MoE LM).
 
 Parity with the reference's real workload (reference jellyfin.yaml:1-43):
 long-running Deployment, one accelerator, ClusterIP Service in front. TPU-
@@ -27,7 +27,7 @@ Endpoints:
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
   POST /v1/generate     -> {"prompt_tokens": [[...]], "max_new_tokens": N,
                             "temperature": t, "top_k": k, "eos_id": e}
-                        -> {"tokens": [[...]]}  (transformer models only;
+                        -> {"tokens": [[...]]}  (LM families only;
                            KV-cache prefill + lax.scan decode)
 
 Run: python -m k3stpu.serve.server --model resnet50 --port 8096
@@ -188,6 +188,16 @@ class InferenceServer:
 
             self.model = transformer_lm_tiny(max_seq_len=seq_len)
             example = np.zeros((1, seq_len), np.int32)
+        elif model_name == "moe":
+            from k3stpu.models.moe import moe_lm_small
+
+            self.model = moe_lm_small(max_seq_len=seq_len)
+            example = np.zeros((1, seq_len), np.int32)
+        elif model_name == "moe-tiny":  # tests / CPU smoke
+            from k3stpu.models.moe import moe_lm_tiny
+
+            self.model = moe_lm_tiny(max_seq_len=seq_len)
+            example = np.zeros((1, seq_len), np.int32)
         elif model_name == "resnet18-tiny":  # tests / CPU smoke
             from k3stpu.models.resnet import resnet18
 
@@ -315,7 +325,7 @@ class InferenceServer:
 
         from k3stpu.models.generate import generate
 
-        if not self.model_name.startswith("transformer"):
+        if not self.model_name.startswith(("transformer", "moe")):
             raise ValueError(f"{self.model_name} is not a generative LM")
         if not prompts or any(len(p) == 0 for p in prompts):
             raise ValueError("prompts must be non-empty token lists")
@@ -485,7 +495,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="K3S-TPU inference server")
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet18-tiny", "transformer",
-                             "transformer-tiny"])
+                             "transformer-tiny", "moe", "moe-tiny"])
     ap.add_argument("--port", type=int, default=8096)  # jellyfin.yaml:40-42
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)

@@ -296,3 +296,16 @@ def test_sharded_serving_resnet():
     out = server.predict(np.random.rand(2, 32, 32, 3).astype(np.float32))
     assert out.shape == (2, 10)
     assert np.isfinite(out).all()
+
+
+def test_moe_serving_predict_and_generate():
+    """The MoE family serves through the same endpoints: predict logits and
+    KV-cache generation (router sow is a no-op outside training)."""
+    server = InferenceServer(model_name="moe-tiny", seq_len=32,
+                             batch_window_ms=0.0)
+    tokens = np.arange(2 * 32, dtype=np.int32).reshape(2, 32) % 500
+    logits = server.predict(tokens)
+    assert logits.shape == (2, 32, 512)
+    assert np.isfinite(logits).all()
+    out = server.generate_tokens([[1, 2, 3]], max_new_tokens=4)
+    assert len(out) == 1 and len(out[0]) == 4
