@@ -153,7 +153,9 @@ class InferenceServer:
     def __init__(self, model_name: str = "resnet50", num_classes: int = 1000,
                  image_size: int = 224, seq_len: int = 128,
                  batch_window_ms: float = 5.0,
-                 shard_devices: "int | None" = None):
+                 shard_devices: "int | None" = None,
+                 ckpt_dir: "str | None" = None,
+                 ckpt_step: "int | None" = None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -208,6 +210,46 @@ class InferenceServer:
 
         self._variables = self.model.init(jax.random.key(0), example[:1],
                                           train=False)
+
+        # Serve trained weights: restore params from a train_job checkpoint
+        # (volume/GCS mount — the train -> checkpoint -> serve loop). The
+        # freshly-initialized tree is the restore target, so architecture
+        # mismatches fail loudly at boot, not at first request.
+        self.loaded_step: "int | None" = None
+        if ckpt_dir is not None:
+            from k3stpu.utils import checkpoint as ckpt
+
+            import jax.numpy as jnp
+
+            step = ckpt_step if ckpt_step is not None \
+                else ckpt.latest_step(ckpt_dir)
+            if step is None:
+                raise ValueError(f"no finalized checkpoint under {ckpt_dir}")
+            # Partial restore: only the serving collections are read (the
+            # optimizer state — ~2x params under adamw — never touches
+            # boot I/O). Structure mismatches raise inside orbax.
+            want = {coll: tree for coll, tree in self._variables.items()
+                    if coll in ("params", "batch_stats")}
+            if not want.get("params"):
+                raise ValueError("model has no params tree to restore into")
+            state = ckpt.restore_collections(ckpt_dir, step, want)
+
+            def adopt(init, new):
+                new = jnp.asarray(new, init.dtype)
+                if new.shape != init.shape:
+                    # Same tree, different hyperparameters (seq len, vocab,
+                    # widths): fail at boot, not at first request.
+                    raise ValueError(
+                        f"checkpoint leaf shape {new.shape} != model's "
+                        f"{init.shape} — wrong architecture/config for "
+                        f"--ckpt-dir {ckpt_dir}")
+                return new
+
+            merged = dict(self._variables)
+            for coll, tree in state.items():
+                merged[coll] = jax.tree.map(adopt, merged[coll], tree)
+            self._variables = merged
+            self.loaded_step = step
 
         n_local = len(jax.local_devices())
         if shard_devices is None:
@@ -419,6 +461,7 @@ class InferenceServer:
             "batching": {"window_ms": (self._batcher._window_s * 1e3
                                        if self._batcher else 0.0)},
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
+            "checkpoint_step": self.loaded_step,
             "devices": [str(d) for d in jax.devices()],
             "stats": stats,
             "throughput": throughput,
@@ -503,6 +546,11 @@ def main(argv=None) -> int:
     ap.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="coalescing window for concurrent /v1/predict "
                          "requests (0 disables cross-request batching)")
+    ap.add_argument("--ckpt-dir", default=None,
+                    help="restore trained params from this train_job "
+                         "checkpoint directory (volume mount)")
+    ap.add_argument("--ckpt-step", type=int, default=None,
+                    help="specific step to load (default: latest finalized)")
     ap.add_argument("--shard-devices", type=int, default=None,
                     help="tensor-parallel serving over N local chips "
                          "(default: all local devices when a multi-chip "
@@ -522,7 +570,12 @@ def main(argv=None) -> int:
     server = InferenceServer(model_name=args.model,
                              image_size=args.image_size, seq_len=args.seq_len,
                              batch_window_ms=args.batch_window_ms,
-                             shard_devices=args.shard_devices)
+                             shard_devices=args.shard_devices,
+                             ckpt_dir=args.ckpt_dir,
+                             ckpt_step=args.ckpt_step)
+    if server.loaded_step is not None:
+        print(f"loaded checkpoint step {server.loaded_step} "
+              f"from {args.ckpt_dir}", flush=True)
     if not args.no_warmup:
         print("warming up (pre-compiling batch sizes)...", flush=True)
         server.warmup()

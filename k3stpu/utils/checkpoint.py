@@ -91,6 +91,23 @@ def restore_train_state(directory: str | pathlib.Path, step: int,
     return _checkpointer().restore(path, abstract)
 
 
+def restore_collections(directory: str | pathlib.Path, step: int,
+                        target: Any) -> Any:
+    """Partial restore: only the sub-tree ``target`` spans is read.
+
+    For consumers that want a SUBSET of the training state — serving needs
+    params (+ batch_stats), not the 2x-params optimizer state, and skipping
+    it keeps boot I/O and host RAM proportional to what is kept. A
+    collection requested but absent from the checkpoint raises (never a
+    silent fresh-init fallback)."""
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(directory).resolve() / str(step)
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    return ckptr.restore(path, args=ocp.args.PyTreeRestore(
+        item=target, partial_restore=True))
+
+
 def latest_step(directory: str | pathlib.Path) -> int | None:
     """Highest step with a *finalized* checkpoint under ``directory``.
 
