@@ -35,6 +35,17 @@ class DevicePrefetcher:
             name="device-prefetch")
         self._thread.start()
 
+    def _put_bounded(self, item) -> bool:
+        """Put that re-checks stop so close() never deadlocks the producer
+        against a full queue; returns False if stopped first."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _produce(self, batch_iter):
         try:
             for batch in batch_iter:
@@ -43,22 +54,17 @@ class DevicePrefetcher:
                 staged = (self._device_put(batch, self._sharding)
                           if self._sharding is not None
                           else self._device_put(batch))
-                # A bounded put that re-checks stop so close() never
-                # deadlocks against a full queue.
-                while not self._stop.is_set():
-                    try:
-                        self._q.put(staged, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                if not self._put_bounded(staged):
+                    return
         except Exception as e:  # noqa: BLE001 — surface in the consumer
-            self._q.put(e)
             # Terminal sentinel even after an error: a consumer that logs
-            # the exception and calls next() again must get StopIteration,
-            # not a forever-blocking get().
-            self._q.put(self._DONE)
+            # the exception and calls next() again must get StopIteration.
+            # Both puts stay stop-aware — an unbounded put here could hang
+            # this thread forever after close() against a full queue.
+            if self._put_bounded(e):
+                self._put_bounded(self._DONE)
             return
-        self._q.put(self._DONE)
+        self._put_bounded(self._DONE)
 
     def __iter__(self):
         return self

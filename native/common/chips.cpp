@@ -3,6 +3,7 @@
 #include <algorithm>
 #include <cstdlib>
 #include <fstream>
+#include <ctime>
 #include <sstream>
 
 #include <dirent.h>
@@ -193,7 +194,10 @@ int tray_cols(size_t n) {
 
 long long hbm_bytes_for(const std::string& generation) {
   constexpr long long kGiB = 1024LL * 1024 * 1024;
-  if (generation == "tpu-v2/v3") return 16 * kGiB;  // v2 figure (v3 is 32)
+  // v2 (16 GiB) and v3 (32 GiB) share a PCI device id, so the merged
+  // bucket would be confidently wrong for half the hardware: report
+  // unknown ("n/a") rather than a number known to be wrong.
+  if (generation == "tpu-v2/v3") return -1;
   if (generation == "tpu-v4") return 32 * kGiB;
   if (generation == "tpu-v5e") return 16 * kGiB;
   if (generation == "tpu-v5p") return 95 * kGiB;
@@ -217,7 +221,11 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
   if (root.back() == '/') root.pop_back();
 
   // Workload-exported drop file, keyed by chip index. Best-effort: a
-  // missing, stale, or malformed file simply leaves fields at -1.
+  // missing, stale, or malformed file simply leaves fields at -1. Staleness
+  // is judged by the writer's own "ts": a run-to-completion probe (or a
+  // crashed server) leaves its last snapshot behind, and presenting hours-
+  // old bytes_in_use as live would be worse than "n/a".
+  constexpr long long kMaxDropAgeS = 120;
   struct Live { long long used = -1, total = -1; int duty = -1; };
   std::vector<Live> live;
   std::ifstream f(root + kMetricsDropPath);
@@ -226,7 +234,16 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
     ss << f.rdbuf();
     try {
       auto doc = json::parse(ss.str());
-      auto devs = doc && doc->is_object() ? doc->get("devices") : nullptr;
+      bool fresh = false;
+      if (doc && doc->is_object()) {
+        if (auto ts = doc->get("ts")) {
+          const long long now =
+              static_cast<long long>(::time(nullptr));
+          fresh = ts->int_v > 0 && now - ts->int_v <= kMaxDropAgeS;
+        }
+      }
+      auto devs = doc && doc->is_object() && fresh
+                      ? doc->get("devices") : nullptr;
       if (devs && devs->is_array()) {
         for (const auto& d : devs->arr_v) {
           if (!d || !d->is_object()) continue;

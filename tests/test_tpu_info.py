@@ -3,6 +3,7 @@
 import json
 import os
 import subprocess
+import time
 
 import pytest
 
@@ -95,7 +96,7 @@ def test_live_columns_from_metrics_drop_file(info_bin, fake_host_root):
     run_dir = fake_host_root / "run" / "k3stpu"
     run_dir.mkdir(parents=True)
     (run_dir / "metrics.json").write_text(json.dumps({
-        "ts": 0,
+        "ts": int(time.time()),  # fresh: stale drops are ignored
         "devices": [
             {"index": 1, "bytes_in_use": 1024**3,
              "bytes_limit": 16 * 1024**3, "duty_cycle_pct": 83},
@@ -138,3 +139,20 @@ def test_telemetry_writer_roundtrip(info_bin, fake_host_root):
     for c in chips:
         if c["index"] in by_idx and by_idx[c["index"]]["duty_cycle_pct"] >= 0:
             assert c["duty_cycle_pct"] == 12
+
+
+def test_stale_drop_file_ignored(info_bin, fake_host_root):
+    # A snapshot from an exited workload must not render as live data.
+    run_dir = fake_host_root / "run" / "k3stpu"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metrics.json").write_text(json.dumps({
+        "ts": int(time.time()) - 3600,
+        "devices": [{"index": 0, "bytes_in_use": 1024**3,
+                     "bytes_limit": 16 * 1024**3, "duty_cycle_pct": 83}],
+    }))
+    out = subprocess.run(
+        [info_bin, "--json", "--host-root", str(fake_host_root)],
+        capture_output=True, text=True)
+    chip0 = json.loads(out.stdout)["chips"][0]
+    assert chip0["mem_used_bytes"] == -1
+    assert chip0["duty_cycle_pct"] == -1
