@@ -13,9 +13,15 @@ formulation mapped onto the TPU memory hierarchy:
   (``preferred_element_type``); everything streamed from HBM is bf16.
 - running max/denominator are kept in (block_q, 128) fp32 scratch — the
   128-lane replication keeps the VPU happy (last dim must be 128).
-- causal masking is done per tile with ``broadcasted_iota``; k tiles fully
-  above the diagonal skip their compute entirely via ``pl.when`` (the DMA
-  still runs — block specs are static — but the MXU work is saved).
+- causal masking is done per tile with ``broadcasted_iota``, and ONLY on
+  tiles that straddle the diagonal (or the sliding-window edge): interior
+  tiles skip the iota/compare/select VPU work via ``lax.cond``, which is
+  where the cycles go once the matmuls are on the MXU.
+- k tiles fully above the diagonal skip their compute entirely via
+  ``pl.when``, and their DMAs are elided too: the k/v index map CLAMPS the
+  sweep index into the live band, so a dead iteration re-names the previous
+  live block and Pallas skips the copy (block specs stay static; the grid
+  shape is unchanged).
 
 The backward pass is also Pallas (FlashAttention-2 style): the forward
 additionally emits the per-row logsumexp (lane-replicated (bh, S, 128) fp32,
@@ -75,6 +81,80 @@ def _causal_tile_live(qi, ki, block_q: int, block_k: int, offset: int,
     return live
 
 
+def _causal_tile_needs_mask(qi, ki, block_q: int, block_k: int, offset: int,
+                            window: "int | None" = None):
+    """True iff any element of a LIVE (qi, ki) tile is masked — i.e. the
+    tile straddles the causal diagonal (its last col can exceed its first
+    row's reach) or, windowed, some row's trailing window starts inside it.
+    Interior tiles (the bulk at long S) skip masking entirely."""
+    needs = (ki + 1) * block_k - 1 > qi * block_q + offset
+    if window is not None:
+        needs |= ki * block_k < qi * block_q + block_q + offset - window
+    return needs
+
+
+def _masked_if_needed(s, qi, ki, block_q: int, block_k: int, offset: int,
+                      window: "int | None"):
+    """Apply the causal/window mask only on diagonal-straddling tiles.
+
+    The mask costs ~4 full VPU passes over the (block_q, block_k) tile
+    (two iotas, compare, select); on interior tiles — all-live by
+    construction — the cond's identity branch skips all of it."""
+    return jax.lax.cond(
+        _causal_tile_needs_mask(qi, ki, block_q, block_k, offset, window),
+        lambda x: _causal_tile_mask(x, qi, ki, block_q, block_k, offset,
+                                    window),
+        lambda x: x, s)
+
+
+def _ceil_div(n, d: int):
+    """ceil(n / d) for a possibly-traced, possibly-negative numerator
+    (floor-division semantics make (n + d - 1) // d exact for any sign)."""
+    return (n + d - 1) // d
+
+
+def _clamped_kv_index_map(group: int, block_q: int, block_k: int, nk: int,
+                          offset: int, window: "int | None", causal: bool):
+    """k/v index map for a q-resident sweep: dead iterations (tiles fully
+    above the diagonal / behind every window) are renamed to the nearest
+    live tile so Pallas elides their DMA (same index => copy skipped);
+    their compute is already skipped by the ``pl.when(live)`` guard."""
+    if not causal:
+        return lambda b, i, j: (b // group, j, 0)
+
+    def index_map(b, i, j):
+        last = (i * block_q + block_q - 1 + offset) // block_k
+        lo = 0
+        if window is not None:
+            lo = jnp.maximum(
+                0, (i * block_q + offset - window + 1) // block_k)
+        j_eff = jnp.clip(j, lo, jnp.maximum(last, lo))
+        return (b // group, jnp.clip(j_eff, 0, nk - 1), 0)
+
+    return index_map
+
+
+def _clamped_q_index_map(block_q: int, block_k: int, nq: int, offset: int,
+                         window: "int | None", causal: bool):
+    """q-side index map for a k-resident sweep (the dK/dV kernel): clamp
+    the q sweep into [first live q tile, last windowed q tile]."""
+    if not causal:
+        return lambda b, i, j: (b, j, 0)
+
+    def index_map(b, i, j):
+        first = jnp.maximum(
+            0, _ceil_div(i * block_k - offset - block_q + 1, block_q))
+        hi = nq - 1
+        if window is not None:
+            hi = jnp.clip(
+                ((i + 1) * block_k - 2 - offset + window) // block_q,
+                first, nq - 1)
+        j_eff = jnp.clip(j, jnp.minimum(first, hi), hi)
+        return (b, jnp.clip(j_eff, 0, nq - 1), 0)
+
+    return index_map
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   offset: int, window: "int | None", with_lse: bool):
@@ -109,18 +189,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         ) * scale                          # (block_q, block_k) fp32
 
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+            s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
 
         m_prev = m_ref[:, :1]                             # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)                   # (block_q, 1)
         p = jnp.exp(s - m_new)                            # (block_q, block_k)
-        if causal:
-            # A row fully masked within a live tile has every s at the
-            # finite _NEG_INF and m_new still _NEG_INF, so exp(s - m_new)
-            # would be 1 (uniform garbage); force masked entries to 0 so
-            # such rows keep l == 0 and finalize to zeros / -inf lse.
+        if causal and offset < 0:
+            # Only when s_q > s_kv can a q row be masked in EVERY tile
+            # (r + offset < 0): such a row's s stays at the finite _NEG_INF,
+            # m_new stays _NEG_INF, and exp(s - m_new) would be 1 (uniform
+            # garbage); force masked entries to 0 so the row keeps l == 0
+            # and finalizes to zeros / -inf lse. With offset >= 0 every row
+            # has a live diagonal entry: transiently-masked rows self-heal
+            # when their live tile arrives (alpha = exp(-inf - m) = 0 wipes
+            # the junk), so the standard path skips this VPU pass.
             p = jnp.where(s > _NEG_INF / 2, p, 0.0)
 
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
@@ -182,15 +266,16 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
     lse_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
     lse_shape = jax.ShapeDtypeStruct((bh, s_q, _LANES), jnp.float32)
 
+    kv_map = _clamped_kv_index_map(group, block_q, block_k,
+                                   s_kv // block_k, s_kv - s_q, window,
+                                   causal)
     return pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
-            pl.BlockSpec((1, block_k, d),
-                         lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), kv_map),
+            pl.BlockSpec((1, block_k, d), kv_map),
         ],
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
@@ -267,7 +352,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+            s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
         p = jnp.exp(s - lse)               # (block_q, block_k) probs
 
@@ -322,7 +407,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            s = _causal_tile_mask(s, qi, ki, block_q, block_k, offset,
+            s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
         p = jnp.exp(s - lse)
 
@@ -362,8 +447,12 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     di = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     di = jnp.broadcast_to(di[..., None], (bh, s_q, _LANES))
 
-    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, j, 0))
-    r_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, j, 0))
+    # Dead q iterations for a k tile (tiles above the diagonal sweep first)
+    # are clamped onto the first live q tile so their DMAs are elided.
+    q_map = _clamped_q_index_map(block_q, block_k, s_q // block_q, offset,
+                                 window, causal)
+    q_spec = pl.BlockSpec((1, block_q, d), q_map)
+    r_spec = pl.BlockSpec((1, block_q, _LANES), q_map)
     kv_spec = pl.BlockSpec((1, block_k, d),
                            lambda b, i, j: (b // group, i, 0))
     # GQA: each grid cell owns ONE query head, so dK/dV land per-q-head
@@ -403,8 +492,9 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
 
     q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     r_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
-    kv_spec2 = pl.BlockSpec((1, block_k, d),
-                            lambda b, i, j: (b // group, j, 0))
+    kv_map2 = _clamped_kv_index_map(group, block_q, block_k,
+                                    s_kv // block_k, offset, window, causal)
+    kv_spec2 = pl.BlockSpec((1, block_k, d), kv_map2)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),

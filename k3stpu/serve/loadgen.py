@@ -129,7 +129,16 @@ def main(argv: "list[str] | None" = None) -> int:
             model_name=args.model, image_size=args.image_size,
             seq_len=args.seq_len, batch_window_ms=args.batch_window_ms)
         print("warming up...", flush=True)
-        server.warmup()
+        # Warm only the batch sizes this load can dispatch (largest
+        # coalesced batch = clients * rows, padded by the server's own
+        # _served_batch policy): each warmup is a full JIT round-trip
+        # through the device tunnel, and compiling the 32-wide forward for
+        # an 8-client run is pure exposure to tunnel flakes.
+        from k3stpu.serve.server import BATCH_SIZES
+        target = min(args.clients * args.rows, BATCH_SIZES[-1])
+        needed = [b for b in BATCH_SIZES if b < target]
+        needed.append(InferenceServer._served_batch(target))
+        server.warmup(tuple(needed))
         httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         url = f"http://127.0.0.1:{httpd.server_address[1]}"

@@ -239,7 +239,12 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
         if (auto ts = doc->get("ts")) {
           const long long now =
               static_cast<long long>(::time(nullptr));
-          fresh = ts->int_v > 0 && now - ts->int_v <= kMaxDropAgeS;
+          // Writers commonly emit time.time() (a double); accept both.
+          const long long t =
+              ts->type == json::Type::Double
+                  ? static_cast<long long>(ts->dbl_v)
+                  : ts->int_v;
+          fresh = t > 0 && now - t <= kMaxDropAgeS;
         }
       }
       auto devs = doc && doc->is_object() && fresh

@@ -24,12 +24,15 @@ void usage() {
                "  TPU chip inventory from the host PCI/dev tree.\n";
 }
 
-// "123MiB / 16384MiB" (nvidia-smi style, reference README.md:78-84); used
-// may be unknown ("n/a / 16384MiB"); whole cell "n/a" when total unknown.
+// "123MiB / 16384MiB" (nvidia-smi style, reference README.md:78-84); either
+// side may be unknown ("n/a / 16384MiB", "1024MiB / n/a"); whole cell "n/a"
+// only when both are — live used-bytes must not vanish because the
+// generation's total is unreported (v2/v3 report -1).
 std::string mem_cell(long long used, long long total) {
-  if (total < 0) return "n/a";
+  if (total < 0 && used < 0) return "n/a";
   auto mib = [](long long b) { return std::to_string(b >> 20) + "MiB"; };
-  return (used < 0 ? std::string("n/a") : mib(used)) + " / " + mib(total);
+  return (used < 0 ? std::string("n/a") : mib(used)) + " / " +
+         (total < 0 ? std::string("n/a") : mib(total));
 }
 
 std::string util_cell(int pct) {
