@@ -262,6 +262,16 @@ def transformer_lm_small(**overrides) -> TransformerLM:
     return TransformerLM(TransformerConfig(**overrides))
 
 
+def transformer_lm_medium(**overrides) -> TransformerLM:
+    """~350M params (GPT-2-medium scale) — the single-chip training
+    flagship: large enough that a v5e step is matmul-bound (~34 TFLOP at
+    batch 16 x seq 1024) instead of dispatch-bound, small enough that
+    params + AdamW state + remat activations fit 16 GB HBM."""
+    defaults = dict(d_model=1024, n_heads=16, n_layers=24, d_ff=4096)
+    defaults.update(overrides)
+    return TransformerLM(TransformerConfig(**defaults))
+
+
 def transformer_lm_tiny(**overrides) -> TransformerLM:
     """Test/dry-run scale: compiles in seconds on CPU."""
     defaults = dict(vocab_size=512, d_model=64, n_heads=4, n_layers=2,

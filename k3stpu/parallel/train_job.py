@@ -30,10 +30,13 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="checkpoint directory (volume mount); omit to disable")
     ap.add_argument("--ckpt-every", type=int, default=20)
     ap.add_argument("--batch", type=int, default=None,
-                    help="global batch (default 8 per data-shard)")
+                    help="global batch (default: 8 per data-shard; 16 for "
+                         "--model medium)")
     ap.add_argument("--seq", type=int, default=None)
-    ap.add_argument("--model", choices=["tiny", "small"], default=None,
-                    help="default: small on TPU, tiny on CPU")
+    ap.add_argument("--model", choices=["tiny", "small", "medium"],
+                    default=None,
+                    help="default: small on TPU, tiny on CPU; medium "
+                         "(~350M) is the matmul-bound single-chip flagship")
     ap.add_argument("--model-parallelism", type=int, default=None)
     ap.add_argument("--remat", action="store_true",
                     help="rematerialize block activations in the backward "
@@ -61,6 +64,7 @@ def main(argv: "list[str] | None" = None) -> int:
         jax.profiler.start_server(args.profile_port)
 
     from k3stpu.models.transformer import (
+        transformer_lm_medium,
         transformer_lm_small,
         transformer_lm_tiny,
     )
@@ -71,15 +75,16 @@ def main(argv: "list[str] | None" = None) -> int:
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
     model_name = args.model or ("small" if on_accel else "tiny")
-    seq = args.seq or (512 if model_name == "small" else 64)
-    model = (transformer_lm_small(max_seq_len=max(seq, 512),
-                                  remat=args.remat)
-             if model_name == "small"
-             else transformer_lm_tiny(remat=args.remat))
+    seq = args.seq or {"tiny": 64, "small": 512, "medium": 1024}[model_name]
+    maker = {"tiny": transformer_lm_tiny, "small": transformer_lm_small,
+             "medium": transformer_lm_medium}[model_name]
+    model = (transformer_lm_tiny(remat=args.remat) if model_name == "tiny"
+             else maker(max_seq_len=max(seq, 512), remat=args.remat))
     # Hybrid layout across Job pods: 'model' stays on each pod's local ICI,
     # 'data' (the gradient psum) spans pods over DCN.
     mesh = make_hybrid_mesh(model_parallelism=args.model_parallelism)
-    batch = args.batch or 8 * mesh.shape["data"]
+    batch = args.batch or ((16 if model_name == "medium" else 8)
+                           * mesh.shape["data"])
     vocab = model.config.vocab_size
 
     print(json.dumps({

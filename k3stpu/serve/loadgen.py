@@ -123,7 +123,12 @@ def main(argv: "list[str] | None" = None) -> int:
     if url is None:
         from http.server import ThreadingHTTPServer
 
-        from k3stpu.serve.server import InferenceServer, make_app
+        from k3stpu.serve.server import (
+            BATCH_SIZES,
+            InferenceServer,
+            make_app,
+            served_batch,
+        )
 
         server = InferenceServer(
             model_name=args.model, image_size=args.image_size,
@@ -131,13 +136,12 @@ def main(argv: "list[str] | None" = None) -> int:
         print("warming up...", flush=True)
         # Warm only the batch sizes this load can dispatch (largest
         # coalesced batch = clients * rows, padded by the server's own
-        # _served_batch policy): each warmup is a full JIT round-trip
+        # served_batch policy): each warmup is a full JIT round-trip
         # through the device tunnel, and compiling the 32-wide forward for
         # an 8-client run is pure exposure to tunnel flakes.
-        from k3stpu.serve.server import BATCH_SIZES
         target = min(args.clients * args.rows, BATCH_SIZES[-1])
         needed = [b for b in BATCH_SIZES if b < target]
-        needed.append(InferenceServer._served_batch(target))
+        needed.append(served_batch(target))
         server.warmup(tuple(needed))
         httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
         threading.Thread(target=httpd.serve_forever, daemon=True).start()

@@ -48,6 +48,17 @@ import numpy as np
 BATCH_SIZES = (1, 8, 32)
 
 
+def served_batch(n: int) -> int:
+    """Smallest served (pre-compilable) batch size >= n — the padding
+    policy for every dispatch path; public so tools (loadgen) can warm
+    exactly the sizes a given load will hit."""
+    padded = next((b for b in BATCH_SIZES if b >= n), None)
+    if padded is None:
+        raise ValueError(
+            f"batch {n} exceeds max served batch {BATCH_SIZES[-1]}")
+    return padded
+
+
 class MicroBatcher:
     """Coalesces concurrent predict() calls into one padded device batch.
 
@@ -307,11 +318,7 @@ class InferenceServer:
     @staticmethod
     def _served_batch(n: int) -> int:
         """Smallest pre-compiled batch size >= n."""
-        padded = next((b for b in BATCH_SIZES if b >= n), None)
-        if padded is None:
-            raise ValueError(
-                f"batch {n} exceeds max served batch {BATCH_SIZES[-1]}")
-        return padded
+        return served_batch(n)
 
     def _run_forward(self, inputs: np.ndarray, n_requests: int = 1
                      ) -> np.ndarray:

@@ -234,16 +234,20 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
     ss << f.rdbuf();
     try {
       auto doc = json::parse(ss.str());
+      // Drop-file writers are external (Python json emits computed
+      // numbers as doubles): accept Int or Double for every numeric
+      // field, not just the ones our own telemetry.py happens to write.
+      auto as_ll = [](const auto& v) -> long long {
+        return v->type == json::Type::Double
+                   ? static_cast<long long>(v->dbl_v)
+                   : v->int_v;
+      };
       bool fresh = false;
       if (doc && doc->is_object()) {
         if (auto ts = doc->get("ts")) {
           const long long now =
               static_cast<long long>(::time(nullptr));
-          // Writers commonly emit time.time() (a double); accept both.
-          const long long t =
-              ts->type == json::Type::Double
-                  ? static_cast<long long>(ts->dbl_v)
-                  : ts->int_v;
+          const long long t = as_ll(ts);
           fresh = t > 0 && now - t <= kMaxDropAgeS;
         }
       }
@@ -253,12 +257,12 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
         for (const auto& d : devs->arr_v) {
           if (!d || !d->is_object()) continue;
           Live l;
-          if (auto v = d->get("bytes_in_use")) l.used = v->int_v;
-          if (auto v = d->get("bytes_limit")) l.total = v->int_v;
+          if (auto v = d->get("bytes_in_use")) l.used = as_ll(v);
+          if (auto v = d->get("bytes_limit")) l.total = as_ll(v);
           if (auto v = d->get("duty_cycle_pct"))
-            l.duty = static_cast<int>(v->int_v);
+            l.duty = static_cast<int>(as_ll(v));
           long long idx = -1;
-          if (auto v = d->get("index")) idx = v->int_v;
+          if (auto v = d->get("index")) idx = as_ll(v);
           if (idx >= 0 && idx < 4096) {
             if (live.size() <= static_cast<size_t>(idx))
               live.resize(idx + 1);

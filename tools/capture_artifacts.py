@@ -49,11 +49,18 @@ def _run_bounded(cmd, timeout_s, log_path=None, env=None):
     return rc, out
 
 
+_PLATFORM = None  # set by backend_reachable(): "tpu" | "cpu" | ...
+
+
 def backend_reachable() -> bool:
+    global _PLATFORM
     for _ in range(2):
         rc, out = _run_bounded([sys.executable, "-c", _PROBE_SRC],
                                PROBE_TIMEOUT_S)
         if rc == 0 and "PROBE_OK" in out:
+            toks = out.split()
+            i = toks.index("PROBE_OK")
+            _PLATFORM = toks[i + 1] if i + 1 < len(toks) else None
             return True
         time.sleep(5)
     return False
@@ -79,12 +86,20 @@ def stage_train(log):
     import tempfile
 
     ckpt = tempfile.mkdtemp(prefix="k3stpu-train-")
+    # On a real chip, the medium (~350M) flagship: big enough that the v5e
+    # step is matmul-bound (~34 TFLOP at 16x1024), so the logged MFU
+    # reflects the chip, not dispatch overheads the tiny configs measure.
+    # On CPU (smoke runs of this harness), train_job's own tiny default —
+    # 350M on CPU would just eat both 1800 s bounds.
+    cfg = ["--ckpt-dir", ckpt, "--ckpt-every", "10"]
+    if _PLATFORM not in (None, "cpu"):
+        cfg = ["--model", "medium", "--remat", *cfg]
     rc1, out1 = _run_bounded(
         [sys.executable, "-m", "k3stpu.parallel.train_job", "--steps", "20",
-         "--ckpt-dir", ckpt, "--ckpt-every", "10"], 1800, log)
+         *cfg], 1800, log)
     rc2, out2 = _run_bounded(
         [sys.executable, "-m", "k3stpu.parallel.train_job", "--steps", "30",
-         "--ckpt-dir", ckpt, "--ckpt-every", "10"], 1800, log)
+         *cfg], 1800, log)
     return (rc1 == 0 and rc2 == 0 and '"event": "resume"' in out2
             and '"event": "step"' in out2)
 
