@@ -1,0 +1,102 @@
+"""Weight-only int8 post-training quantization for serving.
+
+The reference stack serves its workload at whatever precision the image
+shipped with (SURVEY.md §2a #4 — it has no quantization surface at all);
+this is the TPU-first serving lever the hardware actually rewards: batch-1
+decode on a v5e is HBM-bandwidth-bound on streaming the weights, so storing
+every projection matrix as int8 (+ one fp32 scale per output channel)
+halves the bytes the matmul pulls per token vs bf16 — XLA fuses the
+``int8 -> f32 * scale -> bf16`` dequant into the dot's operand read, so
+nothing wide is ever re-materialized in HBM.
+
+Scope (deliberate):
+- The four projection Dense kernels per block (``qkv``, ``proj``,
+  ``mlp_in``, ``mlp_out``) — >70% of non-embedding parameter bytes.
+- NOT the embedding table: the token gather reads one row (already cheap)
+  and the weight-tied head's logit matmul feeds the fp32 softmax, where
+  quantization error lands directly on the output distribution.
+
+Quantization is symmetric per-output-channel absmax: ``w_int8[i, j] =
+round(w[i, j] / scale[j])``, ``scale[j] = absmax(w[:, j]) / 127``.
+Inference-only — ``quantize_lm_params`` converts a trained float tree; the
+quantized tree is never trained (no STE / QAT here).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+# Dense submodules (relative leaf-module names) that carry int8 weights
+# when TransformerConfig.quant == "int8". Everything else stays float.
+QUANT_DENSE_NAMES = ("qkv", "proj", "mlp_in", "mlp_out")
+
+
+class QuantDense(nn.Module):
+    """Bias-free Dense over int8 weights with per-output-channel scales.
+
+    Parameter tree: ``{w_int8: (in, out) int8, scale: (out,) float32}`` —
+    produced by :func:`quantize_lm_params`, not by training. ``init`` gives
+    zeros/ones so shape-inference paths (server boot before checkpoint
+    adoption) still trace.
+    """
+
+    features: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        w8 = self.param("w_int8", nn.initializers.zeros,
+                        (in_features, self.features), jnp.int8)
+        scale = self.param("scale", nn.initializers.ones,
+                           (self.features,), jnp.float32)
+        # Dequant in fp32 then cast: the int8 stays the HBM-resident form;
+        # XLA fuses convert+scale into the matmul's weight read.
+        w = (w8.astype(jnp.float32) * scale[None, :]).astype(self.dtype)
+        return jnp.dot(x.astype(self.dtype), w)
+
+
+def quantize_kernel(w: jax.Array) -> "tuple[jax.Array, jax.Array]":
+    """(in, out) float kernel -> (w_int8, scale) per-output-channel."""
+    w = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(w), axis=0)          # (out,)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    w8 = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return w8, scale
+
+
+def dequantize_kernel(w8: jax.Array, scale: jax.Array) -> jax.Array:
+    """Exact inverse of the storage form (fp32)."""
+    return w8.astype(jnp.float32) * scale[None, :].astype(jnp.float32)
+
+
+def quantize_lm_params(params: dict) -> dict:
+    """Float TransformerLM param tree -> the quant=int8 model's tree.
+
+    Every ``{kernel}`` dict under a module named in QUANT_DENSE_NAMES
+    becomes ``{w_int8, scale}``; all other subtrees pass through unchanged,
+    so the result matches ``TransformerLM(cfg(quant="int8")).init`` shapes
+    exactly and drops into the same serving/generate code paths.
+    """
+
+    def walk(tree, name):
+        if isinstance(tree, dict):
+            if (name in QUANT_DENSE_NAMES and set(tree) == {"kernel"}):
+                w8, scale = quantize_kernel(tree["kernel"])
+                return {"w_int8": w8, "scale": scale}
+            return {k: walk(v, k) for k, v in tree.items()}
+        return tree
+
+    return walk(params, "")
+
+
+def param_bytes(params: dict) -> int:
+    """Total stored bytes of a param tree — compare the float tree against
+    its quantized form for the serving card's storage figure (counted,
+    not estimated)."""
+    return sum(int(x.size) * x.dtype.itemsize
+               for x in jax.tree.leaves(params))

@@ -50,6 +50,11 @@ class TransformerConfig:
     # O(n_layers) less activation HBM — how long-sequence/deep configs fit
     # on a 16 GB v5e. Parameter tree is unchanged (lifted transform).
     remat: bool = False
+    # None | "int8": weight-only int8 on the four projection kernels
+    # (models/quant.py) — the serving form for bandwidth-bound decode.
+    # Inference-only: params come from quantize_lm_params on a trained
+    # float tree, never from training this config directly.
+    quant: "str | None" = None
     # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
     # (ops/attention.py) only on a single-device TPU process: the Mosaic
     # custom call has no GSPMD partitioning rule, so under a multi-device
@@ -70,6 +75,20 @@ def _resolve_attn_impl(impl: str) -> str:
         return impl
     on_tpu = jax.default_backend() == "tpu"
     return "flash" if on_tpu and jax.device_count() == 1 else "einsum"
+
+
+def _proj(cfg: TransformerConfig, features: int, name: str):
+    """Projection Dense — float by default, int8 weight-only under
+    cfg.quant (same module path, different leaf names; models/quant.py
+    converts between the trees)."""
+    if cfg.quant == "int8":
+        from k3stpu.models.quant import QuantDense
+
+        return QuantDense(features, dtype=cfg.dtype, name=name)
+    if cfg.quant is not None:
+        raise ValueError(f"unknown quant mode {cfg.quant!r}")
+    return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
+                    param_dtype=jnp.float32, name=name)
 
 
 def rope_frequencies(head_dim: int, max_seq_len: int) -> np.ndarray:
@@ -135,9 +154,7 @@ class Attention(nn.Module):
 
         # One fused projection; with GQA the K/V slices are simply narrower
         # (the parameter is (d_model, d_model + 2*kv_dim)).
-        qkv = nn.Dense(cfg.d_model + 2 * kv_dim, use_bias=False,
-                       dtype=cfg.dtype, param_dtype=jnp.float32,
-                       name="qkv")(x)
+        qkv = _proj(cfg, cfg.d_model + 2 * kv_dim, "qkv")(x)
         q = qkv[..., :cfg.d_model].reshape(b, s, cfg.n_heads, head_dim)
         k = qkv[..., cfg.d_model:cfg.d_model + kv_dim].reshape(
             b, s, kv_heads, head_dim)
@@ -210,8 +227,7 @@ class Attention(nn.Module):
                                       k=-cfg.sliding_window)
                 out = grouped_attention(q, k, v, mask)
         out = out.reshape(b, s, cfg.d_model)
-        return nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
-                        param_dtype=jnp.float32, name="proj")(out)
+        return _proj(cfg, cfg.d_model, "proj")(out)
 
 
 class Block(nn.Module):
@@ -225,11 +241,9 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(h, mode=mode)
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
-        h = nn.Dense(cfg.d_ff, use_bias=False, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlp_in")(h)
+        h = _proj(cfg, cfg.d_ff, "mlp_in")(h)
         h = nn.gelu(h)
-        h = nn.Dense(cfg.d_model, use_bias=False, dtype=cfg.dtype,
-                     param_dtype=jnp.float32, name="mlp_out")(h)
+        h = _proj(cfg, cfg.d_model, "mlp_out")(h)
         return x + h
 
 

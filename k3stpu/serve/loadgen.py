@@ -106,7 +106,7 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="existing server; default self-hosts one in-process")
     ap.add_argument("--model", default="transformer",
                     choices=["resnet50", "resnet18-tiny", "transformer",
-                             "transformer-tiny"])
+                             "transformer-medium", "transformer-tiny"])
     ap.add_argument("--clients", type=int, default=8)
     ap.add_argument("--seconds", type=float, default=10.0)
     ap.add_argument("--rows", type=int, default=1,

@@ -166,7 +166,8 @@ class InferenceServer:
                  batch_window_ms: float = 5.0,
                  shard_devices: "int | None" = None,
                  ckpt_dir: "str | None" = None,
-                 ckpt_step: "int | None" = None):
+                 ckpt_step: "int | None" = None,
+                 quant: "str | None" = None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -195,6 +196,13 @@ class InferenceServer:
             from k3stpu.models.transformer import transformer_lm_small
 
             self.model = transformer_lm_small(max_seq_len=seq_len)
+            example = np.zeros((1, seq_len), np.int32)
+        elif model_name == "transformer-medium":
+            # The train flagship (~350M): what train_job --model medium
+            # checkpoints, servable through the same train->serve loop.
+            from k3stpu.models.transformer import transformer_lm_medium
+
+            self.model = transformer_lm_medium(max_seq_len=max(seq_len, 512))
             example = np.zeros((1, seq_len), np.int32)
         elif model_name == "transformer-tiny":  # tests / CPU smoke
             from k3stpu.models.transformer import transformer_lm_tiny
@@ -262,6 +270,30 @@ class InferenceServer:
             self._variables = merged
             self.loaded_step = step
 
+        # Weight-only int8 (models/quant.py): swap the float projection
+        # kernels for int8+scale AFTER checkpoint adoption (quantize what
+        # will actually be served) and rebuild the model in its quant
+        # config — every downstream path (predict, generate, warmup) then
+        # runs the dequant-fused matmuls with no further branching.
+        self.quant = quant
+        self.float_param_bytes: "int | None" = None
+        if quant is not None:
+            if not model_name.startswith("transformer"):
+                raise ValueError(
+                    f"--quant int8 supports the transformer LM family; "
+                    f"{model_name!r} stays float")
+            import dataclasses
+
+            from k3stpu.models.quant import param_bytes, quantize_lm_params
+
+            self.float_param_bytes = param_bytes(self._variables["params"])
+            self._variables = {
+                **self._variables,
+                "params": quantize_lm_params(self._variables["params"]),
+            }
+            self.model = type(self.model)(
+                dataclasses.replace(self.model.config, quant=quant))
+
         n_local = len(jax.local_devices())
         if shard_devices is None:
             shard_devices = n_local if n_local > 1 else 1
@@ -315,10 +347,6 @@ class InferenceServer:
     def input_dtype(self):
         return np.float32 if self.model_name.startswith("resnet") else np.int32
 
-    @staticmethod
-    def _served_batch(n: int) -> int:
-        """Smallest pre-compiled batch size >= n."""
-        return served_batch(n)
 
     def _run_forward(self, inputs: np.ndarray, n_requests: int = 1
                      ) -> np.ndarray:
@@ -329,7 +357,7 @@ class InferenceServer:
         import jax
 
         n = inputs.shape[0]
-        padded = self._served_batch(n)
+        padded = served_batch(n)
         if padded != n:
             pad = np.zeros((padded - n, *inputs.shape[1:]), inputs.dtype)
             inputs = np.concatenate([inputs, pad], axis=0)
@@ -348,7 +376,7 @@ class InferenceServer:
     def predict(self, inputs: np.ndarray) -> np.ndarray:
         """Predict a batch; concurrent callers coalesce into shared device
         batches when the micro-batcher is on (see MicroBatcher)."""
-        self._served_batch(inputs.shape[0])  # reject oversize before queueing
+        served_batch(inputs.shape[0])  # reject oversize before queueing
         if self._batcher is not None:
             return self._batcher.submit(inputs)
         return self._run_forward(inputs)
@@ -408,7 +436,7 @@ class InferenceServer:
             if not 0 <= eos_id < vocab:
                 raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
         n = len(prompts)
-        batch = self._served_batch(n)
+        batch = served_batch(n)
 
         block = np.zeros((batch, width), np.int32)
         for i, p in enumerate(prompts):
@@ -443,6 +471,15 @@ class InferenceServer:
         with self._lock:
             return self._stats["seconds"] + self._stats["gen_seconds"]
 
+    def _quant_card(self) -> "dict | None":
+        if self.quant is None:
+            return None
+        from k3stpu.models.quant import param_bytes
+
+        return {"mode": self.quant,
+                "param_bytes": param_bytes(self._variables["params"]),
+                "float_param_bytes": self.float_param_bytes}
+
     def model_card(self) -> dict:
         import jax
 
@@ -468,6 +505,7 @@ class InferenceServer:
             "batching": {"window_ms": (self._batcher._window_s * 1e3
                                        if self._batcher else 0.0)},
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
+            "quant": self._quant_card(),
             "checkpoint_step": self.loaded_step,
             "devices": [str(d) for d in jax.devices()],
             "stats": stats,
@@ -545,7 +583,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="K3S-TPU inference server")
     ap.add_argument("--model", default="resnet50",
                     choices=["resnet50", "resnet18-tiny", "transformer",
-                             "transformer-tiny", "moe", "moe-tiny"])
+                             "transformer-medium", "transformer-tiny",
+                             "moe", "moe-tiny"])
     ap.add_argument("--port", type=int, default=8096)  # jellyfin.yaml:40-42
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)
@@ -566,6 +605,11 @@ def main(argv=None) -> int:
                     help="expose jax.profiler.start_server on this port "
                          "(0 = off); capture with jax.profiler.trace or "
                          "tensorboard's profile plugin")
+    ap.add_argument("--quant", default=None, choices=["int8"],
+                    help="weight-only int8 serving (transformer LM family):"
+                         " projection kernels stored int8 + per-channel "
+                         "scales — halves weight HBM traffic for "
+                         "bandwidth-bound decode (models/quant.py)")
     args = ap.parse_args(argv)
 
     if args.profile_port:
@@ -579,7 +623,8 @@ def main(argv=None) -> int:
                              batch_window_ms=args.batch_window_ms,
                              shard_devices=args.shard_devices,
                              ckpt_dir=args.ckpt_dir,
-                             ckpt_step=args.ckpt_step)
+                             ckpt_step=args.ckpt_step,
+                             quant=args.quant)
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)

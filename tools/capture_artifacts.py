@@ -58,9 +58,13 @@ def backend_reachable() -> bool:
         rc, out = _run_bounded([sys.executable, "-c", _PROBE_SRC],
                                PROBE_TIMEOUT_S)
         if rc == 0 and "PROBE_OK" in out:
+            # Parse defensively: merged streams can glue log bytes onto
+            # the marker token, and a parse miss must degrade to an
+            # unknown platform, never kill the capture run.
             toks = out.split()
-            i = toks.index("PROBE_OK")
-            _PLATFORM = toks[i + 1] if i + 1 < len(toks) else None
+            _PLATFORM = next(
+                (toks[i + 1] for i, t in enumerate(toks[:-1])
+                 if t.endswith("PROBE_OK")), None)
             return True
         time.sleep(5)
     return False
