@@ -10,7 +10,9 @@
 // Exit code: 0 when at least one chip is visible, 1 when none (script-able
 // the way `nvidia-smi` exit codes are), 2 on usage error.
 
+#include <cstdlib>
 #include <cstring>
+#include <ctime>
 #include <iostream>
 #include <string>
 
@@ -20,8 +22,10 @@
 namespace {
 
 void usage() {
-  std::cerr << "tpu-info [--json] [--host-root DIR]\n"
-               "  TPU chip inventory from the host PCI/dev tree.\n";
+  std::cerr << "tpu-info [--json] [--watch [SECONDS]] [--host-root DIR]\n"
+               "  TPU chip inventory from the host PCI/dev tree.\n"
+               "  --watch: redraw every SECONDS (default 2), like "
+               "`watch nvidia-smi`; ctrl-c exits.\n";
 }
 
 // "123MiB / 16384MiB" (nvidia-smi style, reference README.md:78-84); either
@@ -103,11 +107,21 @@ int run(const std::string& root, bool as_json) {
 int main(int argc, char** argv) {
   std::string root;
   bool as_json = false;
+  int watch_s = 0;
   for (int i = 1; i < argc; ++i) {
     if (!std::strcmp(argv[i], "--json")) {
       as_json = true;
     } else if (!std::strcmp(argv[i], "--host-root") && i + 1 < argc) {
       root = argv[++i];
+    } else if (!std::strcmp(argv[i], "--watch")) {
+      watch_s = 2;
+      if (i + 1 < argc && argv[i + 1][0] != '-') {
+        watch_s = std::atoi(argv[++i]);
+        if (watch_s <= 0) {
+          usage();
+          return 2;
+        }
+      }
     } else if (!std::strcmp(argv[i], "--help") || !std::strcmp(argv[i], "-h")) {
       usage();
       return 0;
@@ -116,5 +130,16 @@ int main(int argc, char** argv) {
       return 2;
     }
   }
-  return run(root, as_json);
+  if (!watch_s) return run(root, as_json);
+  // `watch nvidia-smi` is the reference's live-observability idiom
+  // (reference README.md:71-93's table, re-read); the telemetry drop file
+  // refreshes between draws, so MEMORY/UTIL move while a workload runs.
+  while (true) {
+    if (!as_json) std::cout << "\033[H\033[2J";  // clear like watch(1)
+    int rc = run(root, as_json);
+    std::cout.flush();
+    if (rc == 2) return rc;
+    struct timespec ts = {watch_s, 0};
+    ::nanosleep(&ts, nullptr);
+  }
 }

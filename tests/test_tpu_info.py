@@ -156,3 +156,38 @@ def test_stale_drop_file_ignored(info_bin, fake_host_root):
     chip0 = json.loads(out.stdout)["chips"][0]
     assert chip0["mem_used_bytes"] == -1
     assert chip0["duty_cycle_pct"] == -1
+
+
+def test_float_ts_and_values_accepted(info_bin, fake_host_root):
+    # External drop-file writers emit time.time() floats (Python json turns
+    # computed numbers into doubles); every numeric field must still parse.
+    run_dir = fake_host_root / "run" / "k3stpu"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metrics.json").write_text(json.dumps({
+        "ts": time.time() + 0.5,
+        "devices": [{"index": 0.0, "bytes_in_use": 2.0 * 1024**3,
+                     "bytes_limit": 16.0 * 1024**3, "duty_cycle_pct": 42.0}],
+    }))
+    out = subprocess.run(
+        [info_bin, "--json", "--host-root", str(fake_host_root)],
+        capture_output=True, text=True)
+    chip0 = json.loads(out.stdout)["chips"][0]
+    assert chip0["mem_used_bytes"] == 2 * 1024**3
+    assert chip0["duty_cycle_pct"] == 42
+
+
+def test_watch_mode_redraws(info_bin, fake_host_root):
+    # --watch N redraws until killed (the `watch nvidia-smi` idiom).
+    proc = subprocess.Popen(
+        [info_bin, "--watch", "1", "--host-root", str(fake_host_root)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    time.sleep(2.5)
+    proc.terminate()
+    out, _ = proc.communicate(timeout=30)
+    assert out.count("chips: 4") >= 2, "expected at least two redraws"
+
+
+def test_watch_rejects_bad_interval(info_bin):
+    out = subprocess.run([BIN, "--watch", "0"], capture_output=True,
+                         text=True)
+    assert out.returncode == 2
