@@ -60,18 +60,34 @@ class QuantDense(nn.Module):
         return jnp.dot(x.astype(self.dtype), w)
 
 
+def quantize_absmax(x: jax.Array, axis: int
+                    ) -> "tuple[jax.Array, jax.Array]":
+    """Symmetric absmax int8 along ``axis``: the ONE quantization contract
+    (clip to +-127, zero-absmax -> scale 1.0) shared by weight kernels
+    (axis=0, per output channel) and the KV cache (axis=-1, per
+    token/kv-head — transformer.py)."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=axis)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    x8 = jnp.clip(jnp.round(xf / jnp.expand_dims(scale, axis)),
+                  -127, 127).astype(jnp.int8)
+    return x8, scale
+
+
+def dequantize_absmax(x8: jax.Array, scale: jax.Array,
+                      axis: int) -> jax.Array:
+    """Exact inverse of the storage form (fp32)."""
+    return (x8.astype(jnp.float32)
+            * jnp.expand_dims(scale.astype(jnp.float32), axis))
+
+
 def quantize_kernel(w: jax.Array) -> "tuple[jax.Array, jax.Array]":
     """(in, out) float kernel -> (w_int8, scale) per-output-channel."""
-    w = w.astype(jnp.float32)
-    absmax = jnp.max(jnp.abs(w), axis=0)          # (out,)
-    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
-    w8 = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
-    return w8, scale
+    return quantize_absmax(w, axis=0)
 
 
 def dequantize_kernel(w8: jax.Array, scale: jax.Array) -> jax.Array:
-    """Exact inverse of the storage form (fp32)."""
-    return w8.astype(jnp.float32) * scale[None, :].astype(jnp.float32)
+    return dequantize_absmax(w8, scale, axis=0)
 
 
 def quantize_lm_params(params: dict) -> dict:

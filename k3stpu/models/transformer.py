@@ -55,6 +55,11 @@ class TransformerConfig:
     # Inference-only: params come from quantize_lm_params on a trained
     # float tree, never from training this config directly.
     quant: "str | None" = None
+    # None | "int8": KV-cache storage dtype. int8 + one fp32 scale per
+    # (token, kv-head) halves the cache's HBM footprint — the ceiling on
+    # context length x batch a serving chip can hold; the dequant fuses
+    # into the decode attention's operand read. Orthogonal to `quant`.
+    kv_cache_dtype: "str | None" = None
     # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
     # (ops/attention.py) only on a single-device TPU process: the Mosaic
     # custom call has no GSPMD partitioning rule, so under a multi-device
@@ -163,14 +168,42 @@ class Attention(nn.Module):
         angles = jnp.asarray(rope_frequencies(head_dim, cfg.max_seq_len))
         scale = 1.0 / np.sqrt(head_dim)
 
+        if cfg.kv_cache_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_cache_dtype {cfg.kv_cache_dtype!r} not in (None, 'int8')")
+        kv_int8 = cfg.kv_cache_dtype == "int8"
+
+        def kv_quant(x):
+            """(..., D) float -> (int8, (...,) fp32 scale) per token/head
+            (the shared absmax contract in models/quant.py)."""
+            from k3stpu.models.quant import quantize_absmax
+
+            return quantize_absmax(x, axis=-1)
+
+        def kv_dequant(x8, s):
+            # int8 stays the HBM-resident form; XLA fuses convert*scale
+            # into the attention einsum's operand read.
+            from k3stpu.models.quant import dequantize_absmax
+
+            return dequantize_absmax(x8, s, axis=-1).astype(cfg.dtype)
+
         if mode in ("prefill", "decode"):
-            # GQA shrinks the cache by n_heads/kv_heads — the whole point.
+            # GQA shrinks the cache by n_heads/kv_heads — the whole point;
+            # int8 storage halves it again (scales are D/4x smaller still).
+            store_dtype = jnp.int8 if kv_int8 else cfg.dtype
             cache_k = self.variable(
                 "cache", "key", jnp.zeros,
-                (b, cfg.max_seq_len, kv_heads, head_dim), cfg.dtype)
+                (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
             cache_v = self.variable(
                 "cache", "value", jnp.zeros,
-                (b, cfg.max_seq_len, kv_heads, head_dim), cfg.dtype)
+                (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
+            if kv_int8:
+                scale_k = self.variable(
+                    "cache", "key_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, kv_heads), jnp.float32)
+                scale_v = self.variable(
+                    "cache", "value_scale", jnp.zeros,
+                    (b, cfg.max_seq_len, kv_heads), jnp.float32)
             cache_idx = self.variable(
                 "cache", "index", lambda: jnp.zeros((), jnp.int32))
 
@@ -181,11 +214,26 @@ class Attention(nn.Module):
             pos_angles = jax.lax.dynamic_slice_in_dim(angles, idx, 1, axis=0)
             q = apply_rope(q, pos_angles)
             k = apply_rope(k, pos_angles)
-            ck = jax.lax.dynamic_update_slice(
-                cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-            cv = jax.lax.dynamic_update_slice(
-                cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
-            cache_k.value, cache_v.value = ck, cv
+            if kv_int8:
+                k8, ks = kv_quant(k)
+                v8, vs = kv_quant(v)
+                ck8 = jax.lax.dynamic_update_slice(
+                    cache_k.value, k8, (0, idx, 0, 0))
+                cv8 = jax.lax.dynamic_update_slice(
+                    cache_v.value, v8, (0, idx, 0, 0))
+                ksc = jax.lax.dynamic_update_slice(
+                    scale_k.value, ks, (0, idx, 0))
+                vsc = jax.lax.dynamic_update_slice(
+                    scale_v.value, vs, (0, idx, 0))
+                cache_k.value, cache_v.value = ck8, cv8
+                scale_k.value, scale_v.value = ksc, vsc
+                ck, cv = kv_dequant(ck8, ksc), kv_dequant(cv8, vsc)
+            else:
+                ck = jax.lax.dynamic_update_slice(
+                    cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
+                cv = jax.lax.dynamic_update_slice(
+                    cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                cache_k.value, cache_v.value = ck, cv
             cache_idx.value = idx + 1
 
             pos = jnp.arange(cfg.max_seq_len)
@@ -197,10 +245,24 @@ class Attention(nn.Module):
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)
             if mode == "prefill":
-                cache_k.value = jax.lax.dynamic_update_slice(
-                    cache_k.value, k.astype(cfg.dtype), (0, 0, 0, 0))
-                cache_v.value = jax.lax.dynamic_update_slice(
-                    cache_v.value, v.astype(cfg.dtype), (0, 0, 0, 0))
+                if kv_int8:
+                    # Prompt attention below still runs on the float k/v
+                    # (full precision); only the stored cache quantizes.
+                    k8, ks = kv_quant(k)
+                    v8, vs = kv_quant(v)
+                    cache_k.value = jax.lax.dynamic_update_slice(
+                        cache_k.value, k8, (0, 0, 0, 0))
+                    cache_v.value = jax.lax.dynamic_update_slice(
+                        cache_v.value, v8, (0, 0, 0, 0))
+                    scale_k.value = jax.lax.dynamic_update_slice(
+                        scale_k.value, ks, (0, 0, 0))
+                    scale_v.value = jax.lax.dynamic_update_slice(
+                        scale_v.value, vs, (0, 0, 0))
+                else:
+                    cache_k.value = jax.lax.dynamic_update_slice(
+                        cache_k.value, k.astype(cfg.dtype), (0, 0, 0, 0))
+                    cache_v.value = jax.lax.dynamic_update_slice(
+                        cache_v.value, v.astype(cfg.dtype), (0, 0, 0, 0))
                 cache_idx.value = jnp.int32(s)
 
             from k3stpu.ops.attention import DEFAULT_BLOCK, flash_attention

@@ -167,7 +167,8 @@ class InferenceServer:
                  shard_devices: "int | None" = None,
                  ckpt_dir: "str | None" = None,
                  ckpt_step: "int | None" = None,
-                 quant: "str | None" = None):
+                 quant: "str | None" = None,
+                 kv_cache_dtype: "str | None" = None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -293,6 +294,28 @@ class InferenceServer:
             }
             self.model = type(self.model)(
                 dataclasses.replace(self.model.config, quant=quant))
+
+        # int8 KV cache (no param change — the cache collection is built
+        # per generate call from the live config): halves the HBM the
+        # serving chip spends per cached token, i.e. doubles the context
+        # length x batch ceiling. Orthogonal to --quant.
+        self.kv_cache_dtype = kv_cache_dtype
+        if kv_cache_dtype is not None:
+            import dataclasses
+
+            if model_name.startswith("transformer"):
+                self.model = type(self.model)(dataclasses.replace(
+                    self.model.config, kv_cache_dtype=kv_cache_dtype))
+            elif model_name.startswith("moe"):
+                self.model = type(self.model)(dataclasses.replace(
+                    self.model.config,
+                    base=dataclasses.replace(
+                        self.model.config.base,
+                        kv_cache_dtype=kv_cache_dtype)))
+            else:
+                raise ValueError(
+                    f"--kv-cache-dtype applies to LM families, not "
+                    f"{model_name!r}")
 
         n_local = len(jax.local_devices())
         if shard_devices is None:
@@ -472,13 +495,19 @@ class InferenceServer:
             return self._stats["seconds"] + self._stats["gen_seconds"]
 
     def _quant_card(self) -> "dict | None":
-        if self.quant is None:
+        if self.quant is None and self.kv_cache_dtype is None:
             return None
-        from k3stpu.models.quant import param_bytes
+        card = {"kv_cache_dtype": self.kv_cache_dtype}
+        if self.quant is not None:
+            # Weight-quant fields only when weights ARE quantized — a
+            # kv-only card must not read as a broken weight-quant state.
+            from k3stpu.models.quant import param_bytes
 
-        return {"mode": self.quant,
-                "param_bytes": param_bytes(self._variables["params"]),
-                "float_param_bytes": self.float_param_bytes}
+            card.update(
+                mode=self.quant,
+                param_bytes=param_bytes(self._variables["params"]),
+                float_param_bytes=self.float_param_bytes)
+        return card
 
     def model_card(self) -> dict:
         import jax
@@ -610,6 +639,11 @@ def main(argv=None) -> int:
                          " projection kernels stored int8 + per-channel "
                          "scales — halves weight HBM traffic for "
                          "bandwidth-bound decode (models/quant.py)")
+    ap.add_argument("--kv-cache-dtype", default=None, choices=["int8"],
+                    help="store the KV cache int8 (+ per-token-head fp32 "
+                         "scales): half the HBM per cached token, so the "
+                         "chip holds ~2x the context length x batch; "
+                         "composes with --quant")
     args = ap.parse_args(argv)
 
     if args.profile_port:
@@ -624,7 +658,8 @@ def main(argv=None) -> int:
                              shard_devices=args.shard_devices,
                              ckpt_dir=args.ckpt_dir,
                              ckpt_step=args.ckpt_step,
-                             quant=args.quant)
+                             quant=args.quant,
+                             kv_cache_dtype=args.kv_cache_dtype)
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)

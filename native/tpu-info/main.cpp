@@ -136,9 +136,8 @@ int main(int argc, char** argv) {
   // refreshes between draws, so MEMORY/UTIL move while a workload runs.
   while (true) {
     if (!as_json) std::cout << "\033[H\033[2J";  // clear like watch(1)
-    int rc = run(root, as_json);
+    run(root, as_json);  // keep watching even while no chips are visible
     std::cout.flush();
-    if (rc == 2) return rc;
     struct timespec ts = {watch_s, 0};
     ::nanosleep(&ts, nullptr);
   }

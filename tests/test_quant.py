@@ -112,3 +112,77 @@ def test_server_quant_rejects_non_lm():
     with pytest.raises(ValueError, match="quant"):
         InferenceServer(model_name="resnet18-tiny", image_size=32,
                         quant="int8")
+
+
+# --- int8 KV cache ---------------------------------------------------------
+
+
+def test_kv_cache_int8_shapes_and_decode_fidelity():
+    """Prefill+decode with an int8 cache tracks the float-cache output."""
+    from k3stpu.models.generate import init_cache
+
+    model, variables = _float_model_and_params(max_seq_len=32)
+    qcfg = dataclasses.replace(model.config, kv_cache_dtype="int8")
+    qmodel = type(model)(qcfg)
+
+    prompt = jax.random.randint(jax.random.key(3), (2, 8), 0,
+                                model.config.vocab_size)
+    cache_f = init_cache(model, 2)
+    cache_q = init_cache(qmodel, 2)
+    k_leaf = cache_q["block0"]["attn"]["key"]
+    assert k_leaf.dtype == jnp.int8
+    assert cache_q["block0"]["attn"]["key_scale"].shape == k_leaf.shape[:3]
+
+    params = variables["params"]  # same float params for both
+    lf, mf = model.apply({"params": params, "cache": cache_f}, prompt,
+                         mode="prefill", mutable=["cache"])
+    lq, mq = qmodel.apply({"params": params, "cache": cache_q}, prompt,
+                          mode="prefill", mutable=["cache"])
+    # Prefill attention runs on the float k/v in both: logits match tightly.
+    assert float(jnp.max(jnp.abs(lf - lq))) < 1e-3
+
+    tok = jnp.full((2, 1), 7, jnp.int32)
+    df, _ = model.apply({"params": params, "cache": mf["cache"]}, tok,
+                        mode="decode", mutable=["cache"])
+    dq, _ = qmodel.apply({"params": params, "cache": mq["cache"]}, tok,
+                         mode="decode", mutable=["cache"])
+    err = float(jnp.max(jnp.abs(df - dq)))
+    span = float(jnp.max(jnp.abs(df))) + 1e-6
+    assert err / span < 0.15, f"int8 KV drift {err:.4f} vs span {span:.4f}"
+
+
+def test_kv_cache_int8_halves_cache_bytes():
+    from k3stpu.models.generate import init_cache
+
+    model, _ = _float_model_and_params(max_seq_len=32)
+    qmodel = type(model)(dataclasses.replace(model.config,
+                                             kv_cache_dtype="int8"))
+    fbytes = param_bytes(init_cache(model, 2))
+    qbytes = param_bytes(init_cache(qmodel, 2))
+    # int8 tensors + small fp32 scale planes: comfortably under 3/4.
+    assert qbytes < 0.75 * fbytes
+
+
+def test_kv_cache_int8_generate_and_server():
+    from k3stpu.serve.server import InferenceServer
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16,
+                             batch_window_ms=0.0, quant="int8",
+                             kv_cache_dtype="int8")
+    try:
+        toks = server.generate_tokens([[3, 4, 5]], max_new_tokens=4)
+        assert len(toks) == 1 and len(toks[0]) == 4
+        card = server.model_card()
+        assert card["quant"]["kv_cache_dtype"] == "int8"
+    finally:
+        server.close()
+
+
+def test_kv_cache_dtype_rejects_unknown():
+    model, variables = _float_model_and_params()
+    bad = type(model)(dataclasses.replace(model.config,
+                                          kv_cache_dtype="fp8"))
+    with pytest.raises(ValueError, match="kv_cache_dtype"):
+        bad.apply({"params": variables["params"]},
+                  jnp.zeros((1, 4), jnp.int32), mode="prefill",
+                  mutable=["cache"])
