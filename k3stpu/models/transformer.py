@@ -61,25 +61,31 @@ class TransformerConfig:
     # into the decode attention's operand read. Orthogonal to `quant`.
     kv_cache_dtype: "str | None" = None
     # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
-    # (ops/attention.py) only on a single-device TPU process: the Mosaic
-    # custom call has no GSPMD partitioning rule, so under a multi-device
-    # mesh the einsum path (which XLA partitions itself) is the safe and
-    # fast choice until attention is wired through shard_map/ring
-    # (parallel/context.py). "flash" forces the kernel anywhere — on
-    # non-TPU backends it runs in the Pallas interpreter (slow; tests).
+    # (ops/attention.py) on TPU: single-device always; under a multi-device
+    # mesh too for MHA, where the kernel's custom_partitioning rule lets
+    # pjit split it on batch x heads per shard (sequence splits stay ring
+    # attention's job — parallel/context.py). GQA under a mesh keeps the
+    # einsum path (its narrower k/v shares no Shardy factor with q).
+    # "flash" forces the kernel anywhere — on non-TPU backends it runs in
+    # the Pallas interpreter (slow; tests).
     attn_impl: str = "auto"
 
 
 _ATTN_IMPLS = ("auto", "einsum", "flash")
 
 
-def _resolve_attn_impl(impl: str) -> str:
+def _resolve_attn_impl(impl: str, mha: bool = False) -> str:
     if impl not in _ATTN_IMPLS:
         raise ValueError(f"attn_impl={impl!r} not in {_ATTN_IMPLS}")
     if impl != "auto":
         return impl
     on_tpu = jax.default_backend() == "tpu"
-    return "flash" if on_tpu and jax.device_count() == 1 else "einsum"
+    # Multi-device: the MHA kernel carries a custom_partitioning rule
+    # (ops/attention.py) so pjit splits it on batch x heads; GQA's
+    # narrower k/v has no shared Shardy factor with q, so it keeps the
+    # einsum path XLA partitions itself.
+    return ("flash" if on_tpu and (jax.device_count() == 1 or mha)
+            else "einsum")
 
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
@@ -271,7 +277,8 @@ class Attention(nn.Module):
             # multiple-of-block sequences (init passes s=8, which must take
             # the einsum path). An explicit "flash" is honored for anything
             # the kernel accepts: s <= block (clamped) or a multiple of it.
-            resolved = _resolve_attn_impl(cfg.attn_impl)
+            resolved = _resolve_attn_impl(cfg.attn_impl,
+                                          mha=kv_heads == cfg.n_heads)
             if cfg.attn_impl == "flash":
                 use_flash = s <= DEFAULT_BLOCK or s % DEFAULT_BLOCK == 0
             else:

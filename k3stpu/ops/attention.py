@@ -43,6 +43,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.custom_partitioning import custom_partitioning
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
@@ -516,8 +517,102 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     return dq, dk, dv
 
 
+# --- SPMD partitioning -----------------------------------------------------
+#
+# The Mosaic custom call has no built-in GSPMD rule, so under pjit a bare
+# pallas_call forces replication (or an error). custom_partitioning teaches
+# XLA the rule the math implies: the folded (b*h, s, d) tensors may split
+# on dim 0 (batch x heads — data/tensor parallelism; each grid cell is
+# already independent per b*h), while s/t/d must stay whole (splitting the
+# sequence is ring attention's job — parallel/context.py — not a local
+# kernel's). The per-shard body is the same single-device kernel on the
+# shard's shapes. MHA-only (q and k/v share dim-0 size, one Shardy factor);
+# GQA under a mesh keeps the einsum path (models/transformer.py gates).
+
+
+def _cp_partition(make_lower):
+    """def_partition 'partition' callback: per-shard shapes run the plain
+    kernel; shardings pass through as Shardy already propagated them (the
+    rule's need_replication factors keep s/t/d whole). The callback
+    receives the wrapped function's static args first; ``make_lower``
+    closes the per-shard body over them."""
+
+    def partition(*args):
+        *statics, mesh, arg_infos, result_infos = args
+        arg_sh = tuple(a.sharding for a in arg_infos)
+        out_sh = jax.tree.map(lambda r: r.sharding, result_infos)
+        return mesh, make_lower(*statics), out_sh, arg_sh
+
+    return partition
+
+
+@functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_fwd_spmd(q, k, v, scale, causal, block_q, block_k, interpret,
+                    window):
+    return _flash_forward(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, with_lse=True, window=window)
+
+
+_flash_fwd_spmd.def_partition(
+    partition=_cp_partition(
+        lambda scale, causal, block_q, block_k, interpret, window:
+        lambda q, k, v:
+        _flash_forward(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                       block_k=block_k, interpret=interpret, with_lse=True,
+                       window=window)),
+    sharding_rule="b s d, b t d, b t d -> b s d, b s l",
+    need_replication_factors=("s", "d", "t", "l"),
+)
+
+
+@functools.partial(custom_partitioning,
+                   static_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
+                    interpret, window):
+    return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
+                           block_q=block_q, block_k=block_k,
+                           interpret=interpret, window=window)
+
+
+_flash_bwd_spmd.def_partition(
+    partition=_cp_partition(
+        lambda scale, causal, block_q, block_k, interpret, window:
+        lambda q, k, v, o, lse, g:
+        _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret, window=window)),
+    sharding_rule=("b s d, b t d, b t d, b s d, b s l, b s d "
+                   "-> b s d, b t d, b t d"),
+    need_replication_factors=("s", "d", "t", "l"),
+)
+
+
+@functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_fwd_nolse_spmd(q, k, v, scale, causal, block_q, block_k,
+                          interpret, window):
+    return _flash_forward(q, k, v, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k,
+                          interpret=interpret, with_lse=False, window=window)
+
+
+_flash_fwd_nolse_spmd.def_partition(
+    partition=_cp_partition(
+        lambda scale, causal, block_q, block_k, interpret, window:
+        lambda q, k, v:
+        _flash_forward(q, k, v, scale=scale, causal=causal, block_q=block_q,
+                       block_k=block_k, interpret=interpret, with_lse=False,
+                       window=window)),
+    sharding_rule="b s d, b t d, b t d -> b s d",
+    need_replication_factors=("s", "d", "t"),
+)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
+    if q.shape[0] == k.shape[0]:  # MHA: the SPMD-partitionable path
+        return _flash_fwd_nolse_spmd(q, k, v, scale, causal, block_q,
+                                     block_k, interpret, window)
     return _flash_forward(q, k, v, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k,
                           interpret=interpret, with_lse=False,
@@ -525,15 +620,22 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
-                              block_q=block_q, block_k=block_k,
-                              interpret=interpret, with_lse=True,
-                              window=window)
+    if q.shape[0] == k.shape[0]:  # MHA: the SPMD-partitionable path
+        out, lse = _flash_fwd_spmd(q, k, v, scale, causal, block_q,
+                                   block_k, interpret, window)
+    else:
+        out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
+                                  block_q=block_q, block_k=block_k,
+                                  interpret=interpret, with_lse=True,
+                                  window=window)
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
     q, k, v, o, lse = res
+    if q.shape[0] == k.shape[0]:
+        return _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal, block_q,
+                               block_k, interpret, window)
     return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
                            block_q=block_q, block_k=block_k,
                            interpret=interpret, window=window)
