@@ -63,12 +63,11 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
     lengths. Returns (B, max_new_tokens) int32; once a sequence emits
     ``eos_id`` (if given) it keeps emitting eos.
 
-    Ragged batches run without recompiling: prefill is width-P for every
-    row and each row's first token is sampled from its own last real
-    position. The KV cache keeps one shared write index, so rows shorter
-    than P carry their pad tokens' K/V in the window decode attends to —
-    pad with each row's last real token (the serving layer does) to keep
-    that benign, or batch equal-length prompts for exactness.
+    Ragged batches run without recompiling AND exactly: prefill is width-P
+    for every row, each row's first token is sampled from its own last real
+    position, and the cache write index is PER ROW (set to the row's true
+    length at prefill) — a short row's first generated token overwrites its
+    first pad slot, so pad K/V never enters any row's visible window.
     """
     b, p = prompt.shape
     max_seq = getattr(model.config, "base", model.config).max_seq_len
@@ -83,7 +82,8 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
 
     cache = init_cache(model, b)
     logits, mut = model.apply({"params": params, "cache": cache}, prompt,
-                              mode="prefill", mutable=["cache"])
+                              mode="prefill", seq_lens=prompt_lens,
+                              mutable=["cache"])
     cache = mut["cache"]
     # Each row's next-token logits come from its last REAL position.
     last = jnp.take_along_axis(

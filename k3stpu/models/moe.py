@@ -133,11 +133,12 @@ class MoeBlock(nn.Module):
     config: MoeConfig
 
     @nn.compact
-    def __call__(self, x, *, mode: str = "full"):
+    def __call__(self, x, *, mode: str = "full", seq_lens=None):
         base = self.config.base
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
-        x = x + Attention(base, name="attn")(h, mode=mode)
+        x = x + Attention(base, name="attn")(h, mode=mode,
+                                              seq_lens=seq_lens)
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         return x + MoeMlp(self.config, name="moe")(h)
@@ -149,7 +150,8 @@ class MoeTransformerLM(nn.Module):
     config: MoeConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, mode: str = "full"):
+    def __call__(self, tokens, *, train: bool = False, mode: str = "full",
+                 seq_lens=None):
         del train
         cfg, base = self.config, self.config.base
         embed = nn.Embed(base.vocab_size, base.d_model,
@@ -159,9 +161,10 @@ class MoeTransformerLM(nn.Module):
         for i in range(base.n_layers):
             use_moe = (i % cfg.every_n_blocks) == cfg.every_n_blocks - 1
             if use_moe:
-                x = MoeBlock(cfg, name=f"block{i}")(x, mode=mode)
+                x = MoeBlock(cfg, name=f"block{i}")(x, mode=mode,
+                                                    seq_lens=seq_lens)
             else:  # identical param tree to the dense LM's blocks
-                x = Block(base, name=f"block{i}")(x, mode=mode)
+                x = Block(base, name=f"block{i}")(x, mode, seq_lens)
         x = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         return embed.attend(x).astype(jnp.float32)

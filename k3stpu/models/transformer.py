@@ -122,6 +122,15 @@ def apply_rope(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
+def apply_rope_rows(x: jnp.ndarray, angles_rows: jnp.ndarray) -> jnp.ndarray:
+    """Per-ROW positions: x (B, 1, H, D), angles_rows (B, D//2) — the
+    decode step where each batch row sits at its own cache index."""
+    cos = jnp.cos(angles_rows)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(angles_rows)[:, None, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
 class Attention(nn.Module):
     """Causal self-attention with an optional KV cache.
 
@@ -139,7 +148,7 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mode: str = "full"):
+    def __call__(self, x, *, mode: str = "full", seq_lens=None):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
@@ -153,12 +162,13 @@ class Attention(nn.Module):
         def grouped_attention(q, k, v, mask):
             """Einsum attention with GQA-grouped queries — K/V stay at
             kv_heads width (nothing head-repeated, matching the flash
-            kernel's in-place read). mask: (S_q, S_kv) bool."""
+            kernel's in-place read). mask: (B | 1, S_q, S_kv) bool —
+            per-row masks carry each row's own cache position (decode)."""
             grp = cfg.n_heads // kv_heads
             qg = q.reshape(*q.shape[:2], kv_heads, grp, head_dim)
             logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k,
                                 preferred_element_type=jnp.float32) * scale
-            logits = jnp.where(mask[None, None, None], logits, -1e30)
+            logits = jnp.where(mask[:, None, None], logits, -1e30)
             probs = jax.nn.softmax(logits, axis=-1).astype(cfg.dtype)
             out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, v)
             return out.reshape(*q.shape[:2], cfg.n_heads, head_dim)
@@ -211,42 +221,48 @@ class Attention(nn.Module):
                     "cache", "value_scale", jnp.zeros,
                     (b, cfg.max_seq_len, kv_heads), jnp.float32)
             cache_idx = self.variable(
-                "cache", "index", lambda: jnp.zeros((), jnp.int32))
+                "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
 
         if mode == "decode":
             if s != 1:
                 raise ValueError(f"decode mode is one token at a time, got s={s}")
-            idx = cache_idx.value
-            pos_angles = jax.lax.dynamic_slice_in_dim(angles, idx, 1, axis=0)
-            q = apply_rope(q, pos_angles)
-            k = apply_rope(k, pos_angles)
+            # PER-ROW cache positions: each batch row writes its token at
+            # its own index and attends its own window — rows at different
+            # depths coexist in one decode batch (ragged prompts land
+            # exactly, and the continuous-batching engine interleaves
+            # requests mid-generation; serve/engine.py).
+            idx = cache_idx.value                           # (b,)
+            rows = jnp.arange(b)
+            pos_angles = angles[jnp.clip(idx, 0, cfg.max_seq_len - 1)]
+            q = apply_rope_rows(q, pos_angles)
+            k = apply_rope_rows(k, pos_angles)
+            # Clamp writes so an over-run row (engine slots past budget)
+            # scribbles its own last slot instead of wrapping — that slot
+            # is past every live row's window by construction.
+            widx = jnp.clip(idx, 0, cfg.max_seq_len - 1)
             if kv_int8:
                 k8, ks = kv_quant(k)
                 v8, vs = kv_quant(v)
-                ck8 = jax.lax.dynamic_update_slice(
-                    cache_k.value, k8, (0, idx, 0, 0))
-                cv8 = jax.lax.dynamic_update_slice(
-                    cache_v.value, v8, (0, idx, 0, 0))
-                ksc = jax.lax.dynamic_update_slice(
-                    scale_k.value, ks, (0, idx, 0))
-                vsc = jax.lax.dynamic_update_slice(
-                    scale_v.value, vs, (0, idx, 0))
+                ck8 = cache_k.value.at[rows, widx].set(k8[:, 0])
+                cv8 = cache_v.value.at[rows, widx].set(v8[:, 0])
+                ksc = scale_k.value.at[rows, widx].set(ks[:, 0])
+                vsc = scale_v.value.at[rows, widx].set(vs[:, 0])
                 cache_k.value, cache_v.value = ck8, cv8
                 scale_k.value, scale_v.value = ksc, vsc
                 ck, cv = kv_dequant(ck8, ksc), kv_dequant(cv8, vsc)
             else:
-                ck = jax.lax.dynamic_update_slice(
-                    cache_k.value, k.astype(cfg.dtype), (0, idx, 0, 0))
-                cv = jax.lax.dynamic_update_slice(
-                    cache_v.value, v.astype(cfg.dtype), (0, idx, 0, 0))
+                ck = cache_k.value.at[rows, widx].set(
+                    k[:, 0].astype(cfg.dtype))
+                cv = cache_v.value.at[rows, widx].set(
+                    v[:, 0].astype(cfg.dtype))
                 cache_k.value, cache_v.value = ck, cv
             cache_idx.value = idx + 1
 
             pos = jnp.arange(cfg.max_seq_len)
-            visible = pos <= idx
+            visible = pos[None, :] <= idx[:, None]          # (b, S)
             if cfg.sliding_window is not None:
-                visible &= pos > idx - cfg.sliding_window
-            out = grouped_attention(q, ck, cv, visible[None, :])
+                visible &= pos[None, :] > idx[:, None] - cfg.sliding_window
+            out = grouped_attention(q, ck, cv, visible[:, None, :])
         else:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)
@@ -269,7 +285,12 @@ class Attention(nn.Module):
                         cache_k.value, k.astype(cfg.dtype), (0, 0, 0, 0))
                     cache_v.value = jax.lax.dynamic_update_slice(
                         cache_v.value, v.astype(cfg.dtype), (0, 0, 0, 0))
-                cache_idx.value = jnp.int32(s)
+                # Per-row true lengths (ragged prompts): the next decode
+                # token lands AT each row's length, overwriting its first
+                # pad slot — no pad K/V ever enters a row's visible window.
+                cache_idx.value = (
+                    jnp.full((b,), s, jnp.int32) if seq_lens is None
+                    else jnp.asarray(seq_lens, jnp.int32))
 
             from k3stpu.ops.attention import DEFAULT_BLOCK, flash_attention
 
@@ -294,7 +315,7 @@ class Attention(nn.Module):
                 if cfg.sliding_window is not None:
                     mask &= ~jnp.tril(jnp.ones((s, s), bool),
                                       k=-cfg.sliding_window)
-                out = grouped_attention(q, k, v, mask)
+                out = grouped_attention(q, k, v, mask[None])
         out = out.reshape(b, s, cfg.d_model)
         return _proj(cfg, cfg.d_model, "proj")(out)
 
@@ -303,11 +324,11 @@ class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mode: str = "full"):
+    def __call__(self, x, mode: str = "full", seq_lens=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
-        x = x + Attention(cfg, name="attn")(h, mode=mode)
+        x = x + Attention(cfg, name="attn")(h, mode=mode, seq_lens=seq_lens)
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         h = _proj(cfg, cfg.d_ff, "mlp_in")(h)
@@ -320,7 +341,8 @@ class TransformerLM(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False, mode: str = "full"):
+    def __call__(self, tokens, *, train: bool = False, mode: str = "full",
+                 seq_lens=None):
         del train  # no dropout: inference-first; training uses weight decay
         cfg = self.config
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
@@ -333,7 +355,7 @@ class TransformerLM(nn.Module):
         block_cls = (nn.remat(Block, static_argnums=(2,)) if cfg.remat
                      else Block)
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"block{i}")(x, mode)
+            x = block_cls(cfg, name=f"block{i}")(x, mode, seq_lens)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # Weight-tied head; logits cast to fp32 for a stable softmax/loss.

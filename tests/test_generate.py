@@ -89,4 +89,28 @@ def test_init_cache_shapes():
     key0 = cache["block0"]["attn"]["key"]
     assert key0.shape == (3, cfg.max_seq_len, cfg.n_heads,
                           cfg.d_model // cfg.n_heads)
-    assert int(cache["block0"]["attn"]["index"]) == 0
+    # Per-row write indices (ragged prompts / continuous batching).
+    idx = cache["block0"]["attn"]["index"]
+    assert idx.shape == (3,) and int(idx.sum()) == 0
+
+
+def test_ragged_batch_matches_solo_generation():
+    """Per-row cache indices make ragged batches EXACT: each row's greedy
+    continuation equals generating that prompt alone (no pad K/V leaks
+    into any visible window)."""
+    model, params = _model_and_params()
+    prompts = [[5, 6, 7], [9, 10, 11, 12, 13, 14, 15, 16]]
+    width = 8
+    block = np.zeros((2, width), np.int32)
+    for i, p in enumerate(prompts):
+        block[i, :len(p)] = p          # zero-padded — pads must not matter
+    lens = jnp.array([len(p) for p in prompts], jnp.int32)
+
+    batched = generate(model, params, jnp.asarray(block), lens, 6,
+                       temperature=0.0)
+    for i, p in enumerate(prompts):
+        solo = generate(model, params,
+                        jnp.asarray(np.array([p], np.int32)),
+                        jnp.array([len(p)], jnp.int32), 6, temperature=0.0)
+        assert jnp.array_equal(batched[i], solo[0]), (
+            f"row {i}: ragged-batch continuation diverged from solo")
