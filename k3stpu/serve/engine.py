@@ -1,0 +1,358 @@
+"""Continuous batching for LM generation — slot-based decode scheduling.
+
+``generate_tokens`` runs whole requests back-to-back: a 256-token
+generation holds the chip while later requests queue, and a batch-1
+request decodes alone at batch-1 arithmetic intensity. This engine is the
+TPU-native fix (the serving pattern vLLM/Orca made standard, built here on
+XLA-static shapes):
+
+- ONE decode program, compiled once, over a fixed block of ``slots`` cache
+  rows. Every step advances all active slots together; per-row cache
+  indices (models/transformer.py) let rows sit at different depths.
+- Requests JOIN mid-flight: a free slot gets the new request's prefilled
+  cache rows scattered in between decode steps; finished slots free
+  immediately. No request waits for another to finish, and decode batch
+  density — the thing MXU throughput scales with — stays high under load.
+- Everything device-side is shape-static: prefill widths and admitted-row
+  counts come from small power-of-two bucket sets, so steady state runs a
+  handful of compiled programs, never a recompile.
+- Per-slot sampling params travel as traced (B,) arrays (temperature,
+  top-k, eos), so heterogeneous requests share the one decode program.
+
+The reference has no serving scheduler at all (its workload is a stock
+binary behind a Service, reference jellyfin.yaml:1-43); this is the
+match-or-beat half of the serving story.
+"""
+
+from __future__ import annotations
+
+import functools
+import queue
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.generate import init_cache
+
+_NEG_INF = -1e30
+
+
+def _pow2_at_least(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def _sample_rows(logits, temps, topks, key):
+    """Per-row sampling over (B, V) logits: temperature <= 0 is greedy;
+    top-k cuts below each row's own k-th value (k == V disables)."""
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
+    srt = jnp.sort(scaled, axis=-1)
+    kth = jnp.take_along_axis(
+        srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
+    cut = jnp.where(scaled < kth, _NEG_INF, scaled)
+    sampled = jax.random.categorical(key, cut, axis=-1).astype(jnp.int32)
+    return jnp.where(temps <= 0.0, greedy, sampled)
+
+
+class _Request:
+    __slots__ = ("block", "lens", "budget", "temp", "top_k", "eos",
+                 "event", "tokens", "error", "slot_rows")
+
+    def __init__(self, block, lens, budget, temp, top_k, eos):
+        self.block = block          # (n, P) int32, right-padded
+        self.lens = lens            # (n,) true lengths
+        self.budget = budget        # max new tokens (shared by the rows)
+        self.temp = temp
+        self.top_k = top_k
+        self.eos = eos              # int | None
+        self.event = threading.Event()
+        self.tokens: "list[list[int]] | None" = None
+        self.error: "Exception | None" = None
+        self.slot_rows: "list[int]" = []
+
+
+class GenerateEngine:
+    """Owns a ``slots``-row KV cache and a single decode loop thread.
+
+    ``submit()`` blocks the calling (HTTP handler) thread until its
+    request's rows finish; the loop thread interleaves every live request
+    into one decode batch. ``close()`` drains and stops the thread.
+    """
+
+    def __init__(self, model, params, *, slots: int = 8,
+                 seed: int = 0):
+        self.model = model
+        self.params = params
+        self.slots = slots
+        cfg = getattr(model.config, "base", model.config)
+        self.max_seq = cfg.max_seq_len
+        self.vocab = cfg.vocab_size
+
+        self._cache = init_cache(model, slots)
+        self._base_key = jax.random.key(seed)
+        self._step_counter = 0
+
+        # Host-side slot state (numpy: mutated only by the loop thread).
+        self._active = np.zeros((slots,), bool)
+        self._last_tok = np.zeros((slots,), np.int32)
+        self._left = np.zeros((slots,), np.int64)
+        self._temps = np.zeros((slots,), np.float32)
+        self._topks = np.full((slots,), 1, np.int32)
+        self._eos = np.full((slots,), -1, np.int32)
+        self._owner: "list[_Request | None]" = [None] * slots
+        self._collected: "list[list[int]]" = [[] for _ in range(slots)]
+
+        self._q: "queue.SimpleQueue[_Request | None]" = queue.SimpleQueue()
+        self._pending: "list[_Request]" = []
+        self._closed = False
+        self._lock = threading.Lock()
+        self._stats = {"tokens": 0, "steps": 0, "busy_s": 0.0,
+                       "requests": 0, "slot_occupancy_sum": 0.0}
+
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="generate-engine")
+        self._thread.start()
+
+    # --- jitted device programs (compiled once per static bucket) -------
+
+    # params travel as jit ARGUMENTS (donated weights would bake into the
+    # compiled program as constants otherwise — double the HBM).
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _decode_step(self, params, cache, toks, temps, topks, step,
+                     base_key):
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            mode="decode", mutable=["cache"])
+        key = jax.random.fold_in(base_key, step)
+        nxt = _sample_rows(logits[:, -1].astype(jnp.float32), temps, topks,
+                           key)
+        return mut["cache"], nxt
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill(self, params, block, lens):
+        cache = init_cache(self.model, block.shape[0])
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, block, mode="prefill",
+            seq_lens=lens, mutable=["cache"])
+        last = jnp.take_along_axis(
+            logits, (lens - 1)[:, None, None], axis=1)[:, 0]
+        return mut["cache"], last.astype(jnp.float32)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _scatter(self, big, small, slot_ids):
+        return jax.tree.map(lambda b, s: b.at[slot_ids].set(s), big, small)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _first_sample(self, last_logits, temps, topks, step, base_key):
+        key = jax.random.fold_in(base_key, step)
+        return _sample_rows(last_logits, temps, topks, key)
+
+    # --- client API -----------------------------------------------------
+
+    def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
+               temperature: float = 0.0, top_k: "int | None" = None,
+               eos_id: "int | None" = None,
+               timeout_s: float = 600.0) -> "list[list[int]]":
+        """Blocking: returns (n, max_new_tokens) token lists."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        n = len(prompts)
+        if n == 0 or n > self.slots:
+            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        lens = [len(p) for p in prompts]
+        if min(lens) == 0:
+            raise ValueError("prompts must be non-empty")
+        width = min(_pow2_at_least(max(lens), 8), self.max_seq)
+        if max(lens) > width or width + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
+                f"cache ({self.max_seq})")
+        block = np.zeros((n, width), np.int32)
+        for i, p in enumerate(prompts):
+            block[i, :len(p)] = p
+        req = _Request(block, np.asarray(lens, np.int32), max_new_tokens,
+                       float(temperature), top_k, eos_id)
+        self._q.put(req)
+        if not req.event.wait(timeout_s):
+            raise TimeoutError("generation did not finish in time")
+        if req.error is not None:
+            raise req.error
+        return req.tokens
+
+    def close(self) -> None:
+        self._closed = True
+        self._q.put(None)
+        self._thread.join(timeout=60)
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = dict(self._stats)
+        s["tokens_per_s"] = (round(s["tokens"] / s["busy_s"], 2)
+                             if s["busy_s"] > 0 else None)
+        s["avg_active_slots"] = (round(s["slot_occupancy_sum"] / s["steps"],
+                                       2) if s["steps"] else None)
+        return s
+
+    # --- loop internals (single thread; owns all slot state) ------------
+
+    def _free_slots(self) -> "list[int]":
+        return [i for i in range(self.slots) if not self._active[i]]
+
+    def _drain_queue(self, block: bool) -> bool:
+        """Move queued requests into pending. Returns False on shutdown."""
+        try:
+            timeout = 0.2 if block else 0.0
+            while True:
+                req = self._q.get(block=block, timeout=timeout)
+                if req is None:
+                    return False
+                self._pending.append(req)
+                block = False  # only the first get may wait
+        except queue.Empty:
+            return True
+
+    def _admit(self) -> None:
+        """Prefill + scatter as many pending requests as slots allow."""
+        while self._pending:
+            req = self._pending[0]
+            # The pow2 bucket is the admission unit: bucket rows beyond n
+            # also land in free slots (they must not overwrite live rows),
+            # so the fit check runs on nb BEFORE any device work.
+            n, width = req.block.shape
+            nb = min(_pow2_at_least(n), self.slots)
+            free = self._free_slots()
+            if len(free) < nb:
+                return  # decode continues; retry when slots free up
+            self._pending.pop(0)
+            try:
+                block = np.zeros((nb, width), np.int32)
+                block[:n] = req.block
+                lens = np.concatenate(
+                    [req.lens, np.ones((nb - n,), np.int32)])
+                small, last = self._prefill(self.params, jnp.asarray(block),
+                                            jnp.asarray(lens))
+                all_rows = free[:nb]
+                rows = all_rows[:n]
+                self._cache = self._scatter(
+                    self._cache, small, jnp.asarray(all_rows, np.int32))
+                temps = np.full((nb,), req.temp, np.float32)
+                topks = np.full(
+                    (nb,),
+                    req.top_k if req.top_k else self.vocab, np.int32)
+                self._step_counter += 1
+                first = np.asarray(self._first_sample(
+                    last, jnp.asarray(temps), jnp.asarray(topks),
+                    self._step_counter, self._base_key))
+            except Exception as e:  # noqa: BLE001 — fail the one request
+                req.error = e
+                req.event.set()
+                continue
+            req.slot_rows = rows
+            for j, r in enumerate(rows):
+                self._active[r] = True
+                self._owner[r] = req
+                self._last_tok[r] = int(first[j])
+                self._left[r] = req.budget - 1
+                self._temps[r] = req.temp
+                self._topks[r] = req.top_k if req.top_k else self.vocab
+                self._eos[r] = -1 if req.eos is None else int(req.eos)
+                self._collected[r] = [int(first[j])]
+            with self._lock:
+                self._stats["requests"] += 1
+                self._stats["tokens"] += len(rows)  # first sampled tokens
+            # eos on the very first token / budget 1 finishes immediately.
+            for r in rows:
+                if (self._left[r] <= 0
+                        or (self._eos[r] >= 0
+                            and self._last_tok[r] == self._eos[r])):
+                    self._finish_row(r)
+            self._maybe_complete(req)
+
+    def _finish_row(self, r: int) -> None:
+        self._active[r] = False
+
+    def _maybe_complete(self, req: "_Request") -> None:
+        if any(self._active[r] for r in req.slot_rows):
+            return
+        pad_to = req.budget
+        out = []
+        for r in req.slot_rows:
+            toks = self._collected[r][:pad_to]
+            toks += [toks[-1]] * (pad_to - len(toks))  # eos-extend
+            out.append(toks)
+            self._owner[r] = None
+            self._collected[r] = []
+        req.tokens = out
+        req.event.set()
+
+    def _loop(self) -> None:
+        while True:
+            any_active = bool(self._active.any())
+            if not self._drain_queue(block=not any_active
+                                     and not self._pending):
+                break  # shutdown sentinel
+            self._admit()
+            if not self._active.any():
+                continue
+            t0 = time.perf_counter()
+            self._step_counter += 1
+            try:
+                self._cache, nxt = self._decode_step(
+                    self.params, self._cache, jnp.asarray(self._last_tok),
+                    jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    self._step_counter, self._base_key)
+                nxt = np.asarray(nxt)
+            except Exception as e:  # noqa: BLE001 — fail every live request
+                for req in {self._owner[r] for r in range(self.slots)
+                            if self._owner[r] is not None}:
+                    req.error = e
+                    req.event.set()
+                self._active[:] = False
+                self._owner = [None] * self.slots
+                continue
+            dt = time.perf_counter() - t0
+            n_active = int(self._active.sum())
+            with self._lock:
+                self._stats["steps"] += 1
+                self._stats["tokens"] += n_active
+                self._stats["busy_s"] += dt
+                self._stats["slot_occupancy_sum"] += n_active
+            done_reqs = set()
+            for r in range(self.slots):
+                if not self._active[r]:
+                    continue
+                tok = int(nxt[r])
+                self._last_tok[r] = tok
+                self._collected[r].append(tok)
+                self._left[r] -= 1
+                if self._left[r] <= 0 or (self._eos[r] >= 0
+                                          and tok == self._eos[r]):
+                    self._finish_row(r)
+                    done_reqs.add(self._owner[r])
+            for req in done_reqs:
+                self._maybe_complete(req)
+        # Shutdown: fail anything still waiting — INCLUDING requests a
+        # racing submit() enqueued behind the sentinel (they would
+        # otherwise block their caller for the full submit timeout).
+        err = RuntimeError("engine closed")
+        try:
+            while True:
+                req = self._q.get(block=False)
+                if req is not None:
+                    self._pending.append(req)
+        except queue.Empty:
+            pass
+        for req in self._pending:
+            req.error = err
+            req.event.set()
+        for req in {o for o in self._owner if o is not None}:
+            req.error = err
+            req.event.set()

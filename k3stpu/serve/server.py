@@ -168,7 +168,9 @@ class InferenceServer:
                  ckpt_dir: "str | None" = None,
                  ckpt_step: "int | None" = None,
                  quant: "str | None" = None,
-                 kv_cache_dtype: "str | None" = None):
+                 kv_cache_dtype: "str | None" = None,
+                 continuous_batching: bool = False,
+                 engine_slots: int = 8):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -350,6 +352,25 @@ class InferenceServer:
                                       window_s=batch_window_ms / 1e3)
                          if batch_window_ms > 0 else None)
 
+        # Continuous batching (serve/engine.py): concurrent /v1/generate
+        # requests share one slot-based decode loop — a new request joins
+        # mid-flight instead of queueing behind a long generation.
+        self._engine = None
+        if continuous_batching:
+            if not model_name.startswith(("transformer", "moe")):
+                raise ValueError(
+                    "--continuous-batching applies to LM families, not "
+                    f"{model_name!r}")
+            if self._mesh is not None:
+                raise ValueError(
+                    "--continuous-batching with tensor-parallel serving is "
+                    "not supported yet (engine cache is single-device); "
+                    "pass --shard-devices 1")
+            from k3stpu.serve.engine import GenerateEngine
+
+            self._engine = GenerateEngine(
+                self.model, self._variables["params"], slots=engine_slots)
+
     def warmup(self, batch_sizes=BATCH_SIZES):
         """Pre-compile every served batch size so first requests are fast.
 
@@ -405,10 +426,12 @@ class InferenceServer:
         return self._run_forward(inputs)
 
     def close(self) -> None:
-        """Release the dispatcher thread (embedders/tests; the serving
-        process itself runs until killed)."""
+        """Release the dispatcher/engine threads (embedders/tests; the
+        serving process itself runs until killed)."""
         if self._batcher is not None:
             self._batcher.close()
+        if self._engine is not None:
+            self._engine.close()
 
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,
@@ -458,6 +481,28 @@ class InferenceServer:
             eos_id = int(eos_id)  # program — just validate the range
             if not 0 <= eos_id < vocab:
                 raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
+        if self._engine is not None:
+            # Continuous batching: no global lock — the engine interleaves
+            # this request with whatever is already decoding. Requests
+            # wider than the slot block split into slot-sized chunks (the
+            # engine interleaves those too; BATCH_SIZES[-1] stays the
+            # served maximum either way).
+            t0 = time.perf_counter()
+            out = []
+            for ofs in range(0, len(prompts), self._engine.slots):
+                out.extend(self._engine.submit(
+                    prompts[ofs:ofs + self._engine.slots],
+                    max_new_tokens=gen_budget, temperature=temperature,
+                    top_k=top_k, eos_id=eos_id))
+            dt = time.perf_counter() - t0
+            out = [row[:max_new_tokens] for row in out]
+            with self._lock:
+                self._stats["gen_requests"] += 1
+                self._stats["gen_examples"] += len(prompts)
+                self._stats["tokens"] += sum(len(r) for r in out)
+                self._stats["gen_seconds"] += dt
+            return out
+
         n = len(prompts)
         batch = served_batch(n)
 
@@ -535,6 +580,7 @@ class InferenceServer:
                                        if self._batcher else 0.0)},
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
             "quant": self._quant_card(),
+            "engine": (self._engine.stats() if self._engine else None),
             "checkpoint_step": self.loaded_step,
             "devices": [str(d) for d in jax.devices()],
             "stats": stats,
@@ -644,6 +690,14 @@ def main(argv=None) -> int:
                          "scales): half the HBM per cached token, so the "
                          "chip holds ~2x the context length x batch; "
                          "composes with --quant")
+    ap.add_argument("--continuous-batching", action="store_true",
+                    help="slot-based decode scheduling for /v1/generate "
+                         "(serve/engine.py): concurrent generations share "
+                         "one decode batch and new requests join mid-"
+                         "flight instead of queueing behind long ones")
+    ap.add_argument("--engine-slots", type=int, default=8,
+                    help="decode slots (max concurrent generation rows) "
+                         "for --continuous-batching")
     args = ap.parse_args(argv)
 
     if args.profile_port:
@@ -659,7 +713,9 @@ def main(argv=None) -> int:
                              ckpt_dir=args.ckpt_dir,
                              ckpt_step=args.ckpt_step,
                              quant=args.quant,
-                             kv_cache_dtype=args.kv_cache_dtype)
+                             kv_cache_dtype=args.kv_cache_dtype,
+                             continuous_batching=args.continuous_batching,
+                             engine_slots=args.engine_slots)
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)
