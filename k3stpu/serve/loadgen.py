@@ -32,13 +32,14 @@ _MAX_ERRORS_PER_CLIENT = 10
 
 
 def _client_loop(url: str, payload: bytes, stop: "threading.Event",
-                 latencies: list, lock: "threading.Lock", errors: list):
+                 latencies: list, lock: "threading.Lock", errors: list,
+                 route: str = "/v1/predict"):
     import urllib.request
 
     my_errors = 0
     while not stop.is_set():
         req = urllib.request.Request(
-            url + "/v1/predict", data=payload,
+            url + route, data=payload,
             headers={"Content-Type": "application/json"})
         t0 = time.perf_counter()
         try:
@@ -57,14 +58,26 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
 
 
 def run_load(url: str, *, clients: int, seconds: float, rows: int,
-             input_shape: "tuple[int, ...]", input_dtype: str) -> dict:
+             input_shape: "tuple[int, ...]", input_dtype: str,
+             generate_tokens: int = 0) -> dict:
+    """``generate_tokens > 0`` switches to /v1/generate load (each request
+    one ragged prompt, ``generate_tokens`` new tokens) — the decode-loop
+    workload the continuous-batching engine schedules."""
     rng = np.random.default_rng(0)
-    if input_dtype == "int32":
-        block = rng.integers(0, 1000, size=(rows, *input_shape),
-                             dtype=np.int32)
+    if generate_tokens > 0:
+        prompt = rng.integers(1, 1000, size=(max(4, rows),)).tolist()
+        payload = json.dumps({"prompt_tokens": [prompt],
+                              "max_new_tokens": generate_tokens}).encode()
+        route = "/v1/generate"
     else:
-        block = rng.standard_normal((rows, *input_shape)).astype(np.float32)
-    payload = json.dumps({"inputs": block.tolist()}).encode()
+        if input_dtype == "int32":
+            block = rng.integers(0, 1000, size=(rows, *input_shape),
+                                 dtype=np.int32)
+        else:
+            block = rng.standard_normal(
+                (rows, *input_shape)).astype(np.float32)
+        payload = json.dumps({"inputs": block.tolist()}).encode()
+        route = "/v1/predict"
 
     latencies: list[float] = []
     errors: list[str] = []
@@ -72,7 +85,7 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     stop = threading.Event()
     threads = [threading.Thread(
         target=_client_loop, args=(url, payload, stop, latencies, lock,
-                                   errors), daemon=True)
+                                   errors, route), daemon=True)
         for _ in range(clients)]
     t0 = time.perf_counter()
     for t in threads:
@@ -87,7 +100,7 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         raise RuntimeError(f"no request succeeded; errors: {errors[:3]}")
     lat_ms = sorted(1e3 * l for l in latencies)
     pick = lambda q: lat_ms[min(len(lat_ms) - 1, int(q * len(lat_ms)))]
-    return {
+    out = {
         "clients": clients,
         "rows_per_request": rows,
         "wall_s": round(wall, 2),
@@ -98,6 +111,11 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         "p50_ms": round(pick(0.50), 2),
         "p95_ms": round(pick(0.95), 2),
     }
+    if generate_tokens > 0:
+        out["gen_tokens_per_request"] = generate_tokens
+        out["client_tokens_per_s"] = round(
+            len(lat_ms) * generate_tokens / wall, 2)
+    return out
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -116,6 +134,14 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="self-hosted server's coalescing window (0 = off)")
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--generate-tokens", type=int, default=0,
+                    help="load /v1/generate instead of /v1/predict: each "
+                         "request generates this many tokens (measures the "
+                         "decode loop the engine schedules)")
+    ap.add_argument("--continuous-batching", action="store_true",
+                    help="self-hosted server runs the slot-scheduled "
+                         "generate engine (the before/after comparison "
+                         "for --generate-tokens load)")
     args = ap.parse_args(argv)
 
     url = args.url
@@ -132,17 +158,20 @@ def main(argv: "list[str] | None" = None) -> int:
 
         server = InferenceServer(
             model_name=args.model, image_size=args.image_size,
-            seq_len=args.seq_len, batch_window_ms=args.batch_window_ms)
-        print("warming up...", flush=True)
-        # Warm only the batch sizes this load can dispatch (largest
-        # coalesced batch = clients * rows, padded by the server's own
-        # served_batch policy): each warmup is a full JIT round-trip
-        # through the device tunnel, and compiling the 32-wide forward for
-        # an 8-client run is pure exposure to tunnel flakes.
-        target = min(args.clients * args.rows, BATCH_SIZES[-1])
-        needed = [b for b in BATCH_SIZES if b < target]
-        needed.append(served_batch(target))
-        server.warmup(tuple(needed))
+            seq_len=args.seq_len, batch_window_ms=args.batch_window_ms,
+            continuous_batching=args.continuous_batching,
+            shard_devices=1 if args.continuous_batching else None)
+        if args.generate_tokens <= 0:
+            print("warming up...", flush=True)
+            # Warm only the batch sizes this load can dispatch (largest
+            # coalesced batch = clients * rows, padded by the server's own
+            # served_batch policy): each warmup is a full JIT round-trip
+            # through the device tunnel, and compiling the 32-wide forward
+            # for an 8-client run is pure exposure to tunnel flakes.
+            target = min(args.clients * args.rows, BATCH_SIZES[-1])
+            needed = [b for b in BATCH_SIZES if b < target]
+            needed.append(served_batch(target))
+            server.warmup(tuple(needed))
         httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
         url = f"http://127.0.0.1:{httpd.server_address[1]}"
@@ -156,7 +185,8 @@ def main(argv: "list[str] | None" = None) -> int:
     result = run_load(
         url, clients=args.clients, seconds=args.seconds, rows=args.rows,
         input_shape=tuple(card["input_shape"]),
-        input_dtype=card["input_dtype"])
+        input_dtype=card["input_dtype"],
+        generate_tokens=args.generate_tokens)
 
     with urllib.request.urlopen(card_url, timeout=60) as r:
         card = json.loads(r.read())
@@ -166,6 +196,8 @@ def main(argv: "list[str] | None" = None) -> int:
         "avg_examples_per_dispatch":
             card["throughput"]["avg_examples_per_dispatch"],
         "device_examples_per_s": card["throughput"]["examples_per_s"],
+        "device_tokens_per_s": card["throughput"]["tokens_per_s"],
+        "engine": card.get("engine"),
         "devices": card["devices"][:1],
     })
     print("LOADGEN_JSON " + json.dumps(result), flush=True)

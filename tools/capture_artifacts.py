@@ -110,11 +110,20 @@ def stage_train(log):
 
 def stage_serve(log):
     ok = True
+    # /v1/predict: coalescing window off vs on (the micro-batcher win).
     for window in ("0", "5"):
         rc, out = _run_bounded(
             [sys.executable, "-m", "k3stpu.serve.loadgen", "--model",
              "transformer", "--clients", "8", "--seconds", "15",
              "--batch-window-ms", window], 1800, log)
+        ok = ok and rc == 0 and "LOADGEN_JSON" in out
+    # /v1/generate: sequential requests vs the continuous-batching engine
+    # (the decode-scheduling win), same concurrent-client load.
+    for extra in ((), ("--continuous-batching",)):
+        rc, out = _run_bounded(
+            [sys.executable, "-m", "k3stpu.serve.loadgen", "--model",
+             "transformer", "--clients", "8", "--seconds", "20",
+             "--generate-tokens", "64", *extra], 1800, log)
         ok = ok and rc == 0 and "LOADGEN_JSON" in out
     return ok
 
