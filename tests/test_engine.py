@@ -193,3 +193,32 @@ def test_server_chunks_wide_requests_through_engine():
             plain.close()
     finally:
         server.close()
+
+
+def test_engine_composes_with_quant_and_int8_kv():
+    """The engine must schedule the quantized model + int8 cache exactly
+    like the float one schedules the float model (same code path the
+    server wires with --quant/--kv-cache-dtype/--continuous-batching)."""
+    from k3stpu.serve.server import InferenceServer
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                             batch_window_ms=0.0, quant="int8",
+                             kv_cache_dtype="int8",
+                             continuous_batching=True, engine_slots=2,
+                             shard_devices=1)
+    try:
+        toks = server.generate_tokens([[3, 4, 5], [7, 8]],
+                                      max_new_tokens=4)
+        assert len(toks) == 2 and all(len(t) == 4 for t in toks)
+        # Same quantized model WITHOUT the engine must emit the same
+        # greedy tokens — scheduling must not change sampling.
+        plain = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                                batch_window_ms=0.0, quant="int8",
+                                kv_cache_dtype="int8", shard_devices=1)
+        try:
+            assert plain.generate_tokens([[3, 4, 5], [7, 8]],
+                                         max_new_tokens=4) == toks
+        finally:
+            plain.close()
+    finally:
+        server.close()
