@@ -55,7 +55,12 @@ class TokenCorpus:
     """Random-crop LM batches over a memory-mapped token file."""
 
     def __init__(self, path: "str | pathlib.Path", vocab_size: int,
-                 dtype=None):
+                 dtype=None, split: "str | None" = None,
+                 holdout_fraction: float = 0.05):
+        """``split``: None = the whole file; "train"/"eval" = the leading
+        (1 - holdout_fraction) / trailing holdout_fraction token windows —
+        a contiguous tail holdout, so eval crops never overlap training
+        crops (both splits stay memmap windows; nothing is copied)."""
         self.path = pathlib.Path(path)
         if dtype is None:
             dtype = (np.uint16
@@ -68,6 +73,21 @@ class TokenCorpus:
                 f"of {np.dtype(dtype).name} tokens; was it written with a "
                 f"different dtype? (use write_token_file)")
         self.tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        if split is not None:
+            if split not in ("train", "eval"):
+                raise ValueError(f"split {split!r} not in (train, eval)")
+            if not 0.0 < holdout_fraction < 1.0:
+                raise ValueError(
+                    f"holdout_fraction {holdout_fraction} not in (0, 1)")
+            cut = len(self.tokens) - max(
+                2, int(len(self.tokens) * holdout_fraction))
+            if cut < 2:
+                raise ValueError(
+                    f"corpus {self.path} too small to split: "
+                    f"{len(self.tokens)} tokens")
+            self.tokens = (self.tokens[:cut] if split == "train"
+                           else self.tokens[cut:])
+        self.split = split
         self.vocab_size = vocab_size
         if len(self.tokens) < 2:
             raise ValueError(f"corpus {self.path} has {len(self.tokens)} "

@@ -37,21 +37,32 @@ class TrainBundle:
     batch_stats: Any
     opt_state: Any
     mesh: Mesh
+    eval_fn: Any = None
 
-    def run(self, inputs: jax.Array, labels: jax.Array) -> float:
-        """One step on an already-formed batch; returns the loss."""
+    def _shard_batch(self, inputs, labels):
         if inputs.shape[0] % self.mesh.shape["data"]:
             raise ValueError(
                 f"batch {inputs.shape[0]} not divisible by data axis "
                 f"{self.mesh.shape['data']}"
             )
         data_sh = batch_sharding(self.mesh)
-        inputs = jax.device_put(inputs, data_sh)
-        labels = jax.device_put(labels, data_sh)
+        return jax.device_put(inputs, data_sh), jax.device_put(labels, data_sh)
+
+    def run(self, inputs: jax.Array, labels: jax.Array) -> float:
+        """One step on an already-formed batch; returns the loss."""
+        inputs, labels = self._shard_batch(inputs, labels)
         self.params, self.batch_stats, self.opt_state, loss = self.step_fn(
             self.params, self.batch_stats, self.opt_state, inputs, labels
         )
         return float(loss)
+
+    def evaluate(self, inputs: jax.Array, labels: jax.Array) -> float:
+        """Loss on a held-out batch: no gradients, no state mutation
+        (train=False apply — BatchNorm runs in inference mode, MoE aux
+        losses are not added; the number is the plain objective)."""
+        inputs, labels = self._shard_batch(inputs, labels)
+        return float(self.eval_fn(self.params, self.batch_stats,
+                                  inputs, labels))
 
 
 def cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -128,8 +139,21 @@ def make_train_bundle(
         out_shardings=(param_sh, stats_sh, None, repl),
         donate_argnums=(0, 1, 2),
     )
+
+    def eval_loss(p, stats, inputs, labels):
+        variables = {"params": p}
+        if has_stats:
+            variables["batch_stats"] = stats
+        logits = model.apply(variables, inputs, train=False)
+        return loss_fn(logits, labels)
+
+    eval_fn = jax.jit(
+        eval_loss,
+        in_shardings=(param_sh, stats_sh, data_sh, data_sh),
+        out_shardings=repl,
+    )
     return TrainBundle(step_fn=step_fn, params=params, batch_stats=batch_stats,
-                       opt_state=opt_state, mesh=mesh)
+                       opt_state=opt_state, mesh=mesh, eval_fn=eval_fn)
 
 
 # ----------------------------------------------------- synthetic batches

@@ -46,6 +46,12 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="token corpus file (k3stpu.data.corpus format, "
                          "e.g. a volume mount); omit for synthetic batches")
     ap.add_argument("--data-seed", type=int, default=0)
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="evaluate held-out loss/perplexity every N steps "
+                         "(0 = off); with --data, eval crops come from a "
+                         "disjoint tail holdout of the corpus")
+    ap.add_argument("--eval-batches", type=int, default=4)
+    ap.add_argument("--holdout-fraction", type=float, default=0.05)
     ap.add_argument("--profile-port", type=int, default=0,
                     help="jax.profiler.start_server port (0 = off)")
     args = ap.parse_args(argv)
@@ -120,11 +126,16 @@ def main(argv: "list[str] | None" = None) -> int:
     # sampling means resume needs no iterator state — start_step IS the
     # data-order state. Synthetic fallback keeps the smoke path hermetic.
     prefetch = None
+    eval_batches_fn = None
     if args.data:
         from k3stpu.data import DevicePrefetcher, TokenCorpus
         from k3stpu.parallel.sharding import batch_sharding
 
-        corpus = TokenCorpus(args.data, vocab)
+        # With eval on, training samples only the leading split so the
+        # held-out tail is genuinely unseen.
+        split = "train" if args.eval_every else None
+        corpus = TokenCorpus(args.data, vocab, split=split,
+                             holdout_fraction=args.holdout_fraction)
         sh = batch_sharding(mesh)
         prefetch = DevicePrefetcher(
             corpus.batches(batch, seq, seed=args.data_seed,
@@ -132,7 +143,26 @@ def main(argv: "list[str] | None" = None) -> int:
             sharding=(sh, sh))
         batches = iter(prefetch)
         print(json.dumps({"event": "data", "path": args.data,
-                          "corpus_tokens": len(corpus)}), flush=True)
+                          "corpus_tokens": len(corpus),
+                          "split": split}), flush=True)
+        if args.eval_every:
+            eval_corpus = TokenCorpus(
+                args.data, vocab, split="eval",
+                holdout_fraction=args.holdout_fraction)
+
+            def eval_batches_fn():
+                # Fixed seed: the same held-out batches every eval, so the
+                # logged curve is comparable across steps and resumes.
+                stream = eval_corpus.batches(batch, seq, seed=10**9)
+                return [next(stream) for _ in range(args.eval_batches)]
+    elif args.eval_every:
+        def eval_batches_fn():
+            k = jax.random.key(10**9)
+            out = []
+            for i in range(args.eval_batches):
+                out.append(synth_token_batch(
+                    jax.random.fold_in(k, i), batch, seq, vocab))
+            return out
 
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
@@ -154,6 +184,18 @@ def main(argv: "list[str] | None" = None) -> int:
                 "tflops_per_chip": round(tflops, 2),
                 "mfu": round(tflops / peak, 4) if peak else None,
             }), flush=True)
+            if args.eval_every and (step + 1) % args.eval_every == 0:
+                import math
+
+                losses = [bundle.evaluate(x, y)
+                          for x, y in eval_batches_fn()]
+                ev = sum(losses) / len(losses)
+                print(json.dumps({
+                    "event": "eval", "step": step + 1,
+                    "loss": round(ev, 4),
+                    "ppl": round(math.exp(min(ev, 30.0)), 2),
+                    "batches": len(losses),
+                }), flush=True)
             if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
                 # Async: the persist overlaps the next steps' compute; the
                 # next save (or the final wait) drains it.

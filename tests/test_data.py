@@ -148,3 +148,49 @@ def test_write_rejects_empty_and_float(tmp_path):
     with pytest.raises(ValueError, match="integers"):
         write_token_file(tmp_path / "f.bin", np.array([0.9, 1.7]),
                          vocab_size=512)
+
+
+def test_corpus_split_windows_are_disjoint(tmp_path):
+    from k3stpu.data.corpus import TokenCorpus, write_token_file
+
+    toks = np.arange(1000) % 97  # recognizable values
+    path = write_token_file(tmp_path / "c.bin", toks, vocab_size=128)
+    train = TokenCorpus(path, 128, split="train", holdout_fraction=0.1)
+    ev = TokenCorpus(path, 128, split="eval", holdout_fraction=0.1)
+    assert len(train) + len(ev) == 1000
+    assert len(ev) == 100
+    # The eval window is exactly the tail: its tokens continue where the
+    # train window stops.
+    assert np.array_equal(np.asarray(ev.tokens),
+                          np.asarray(toks[900:]).astype(ev.tokens.dtype))
+    with pytest.raises(ValueError, match="split"):
+        TokenCorpus(path, 128, split="test")
+
+
+def test_train_job_eval_loop(tmp_path):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    data = synthetic_corpus(tmp_path / "c.bin", vocab_size=512,
+                            n_tokens=1 << 14)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.parallel.train_job",
+         "--steps", "4", "--data", str(data), "--eval-every", "2",
+         "--eval-batches", "2"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    events = [json.loads(l) for l in out.stdout.splitlines()]
+    assert next(e for e in events
+                if e["event"] == "data")["split"] == "train"
+    evals = [e for e in events if e["event"] == "eval"]
+    assert [e["step"] for e in evals] == [2, 4]
+    assert all(e["ppl"] > 0 for e in evals)
