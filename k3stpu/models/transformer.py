@@ -123,10 +123,10 @@ def apply_rope(x: jnp.ndarray, angles: jnp.ndarray) -> jnp.ndarray:
 
 
 def apply_rope_rows(x: jnp.ndarray, angles_rows: jnp.ndarray) -> jnp.ndarray:
-    """Per-ROW positions: x (B, 1, H, D), angles_rows (B, D//2) — the
-    decode step where each batch row sits at its own cache index."""
-    cos = jnp.cos(angles_rows)[:, None, None, :].astype(x.dtype)
-    sin = jnp.sin(angles_rows)[:, None, None, :].astype(x.dtype)
+    """Per-ROW positions: x (B, S, H, D), angles_rows (B, S, D//2) — the
+    decode/extend steps where each batch row sits at its own cache index."""
+    cos = jnp.cos(angles_rows)[:, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles_rows)[:, :, None, :].astype(x.dtype)
     x1, x2 = jnp.split(x, 2, axis=-1)
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
@@ -203,7 +203,7 @@ class Attention(nn.Module):
 
             return dequantize_absmax(x8, s, axis=-1).astype(cfg.dtype)
 
-        if mode in ("prefill", "decode"):
+        if mode in ("prefill", "decode", "extend"):
             # GQA shrinks the cache by n_heads/kv_heads — the whole point;
             # int8 storage halves it again (scales are D/4x smaller still).
             store_dtype = jnp.int8 if kv_int8 else cfg.dtype
@@ -223,46 +223,52 @@ class Attention(nn.Module):
             cache_idx = self.variable(
                 "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
 
-        if mode == "decode":
-            if s != 1:
+        if mode in ("decode", "extend"):
+            if mode == "decode" and s != 1:
                 raise ValueError(f"decode mode is one token at a time, got s={s}")
-            # PER-ROW cache positions: each batch row writes its token at
-            # its own index and attends its own window — rows at different
-            # depths coexist in one decode batch (ragged prompts land
-            # exactly, and the continuous-batching engine interleaves
-            # requests mid-generation; serve/engine.py).
+            # PER-ROW cache positions: each batch row appends its s tokens
+            # at its own index and attends its own window — rows at
+            # different depths coexist in one batch (ragged prompts land
+            # exactly; the continuous-batching engine interleaves requests
+            # mid-generation; serve/engine.py). "extend" is the s >= 1
+            # generalization (chunked prefill / speculative verify) —
+            # rollback is free: dropping cache_idx back makes the slots
+            # beyond it invisible (pos <= index masking) and the next
+            # append overwrites them.
             idx = cache_idx.value                           # (b,)
-            rows = jnp.arange(b)
-            pos_angles = angles[jnp.clip(idx, 0, cfg.max_seq_len - 1)]
-            q = apply_rope_rows(q, pos_angles)
-            k = apply_rope_rows(k, pos_angles)
+            rows = jnp.arange(b)[:, None]                   # (b, 1)
+            offs = idx[:, None] + jnp.arange(s)[None, :]    # (b, s) abs pos
             # Clamp writes so an over-run row (engine slots past budget)
             # scribbles its own last slot instead of wrapping — that slot
             # is past every live row's window by construction.
-            widx = jnp.clip(idx, 0, cfg.max_seq_len - 1)
+            woffs = jnp.clip(offs, 0, cfg.max_seq_len - 1)
+            pos_angles = angles[woffs]                      # (b, s, d/2)
+            q = apply_rope_rows(q, pos_angles)
+            k = apply_rope_rows(k, pos_angles)
             if kv_int8:
                 k8, ks = kv_quant(k)
                 v8, vs = kv_quant(v)
-                ck8 = cache_k.value.at[rows, widx].set(k8[:, 0])
-                cv8 = cache_v.value.at[rows, widx].set(v8[:, 0])
-                ksc = scale_k.value.at[rows, widx].set(ks[:, 0])
-                vsc = scale_v.value.at[rows, widx].set(vs[:, 0])
+                ck8 = cache_k.value.at[rows, woffs].set(k8)
+                cv8 = cache_v.value.at[rows, woffs].set(v8)
+                ksc = scale_k.value.at[rows, woffs].set(ks)
+                vsc = scale_v.value.at[rows, woffs].set(vs)
                 cache_k.value, cache_v.value = ck8, cv8
                 scale_k.value, scale_v.value = ksc, vsc
                 ck, cv = kv_dequant(ck8, ksc), kv_dequant(cv8, vsc)
             else:
-                ck = cache_k.value.at[rows, widx].set(
-                    k[:, 0].astype(cfg.dtype))
-                cv = cache_v.value.at[rows, widx].set(
-                    v[:, 0].astype(cfg.dtype))
+                ck = cache_k.value.at[rows, woffs].set(k.astype(cfg.dtype))
+                cv = cache_v.value.at[rows, woffs].set(v.astype(cfg.dtype))
                 cache_k.value, cache_v.value = ck, cv
-            cache_idx.value = idx + 1
+            cache_idx.value = idx + s
 
             pos = jnp.arange(cfg.max_seq_len)
-            visible = pos[None, :] <= idx[:, None]          # (b, S)
+            # Query j of row r sits at absolute position offs[r, j] and
+            # sees cache positions <= it (within the sliding window).
+            visible = pos[None, None, :] <= offs[..., None]   # (b, s, S)
             if cfg.sliding_window is not None:
-                visible &= pos[None, :] > idx[:, None] - cfg.sliding_window
-            out = grouped_attention(q, ck, cv, visible[:, None, :])
+                visible &= (pos[None, None, :]
+                            > offs[..., None] - cfg.sliding_window)
+            out = grouped_attention(q, ck, cv, visible)
         else:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)

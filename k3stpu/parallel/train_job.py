@@ -164,6 +164,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     jax.random.fold_in(k, i), batch, seq, vocab))
             return out
 
+    if args.eval_every:
+        # Fail-fast: sampling the held-out batches surfaces a too-small
+        # holdout (or bad split config) at startup, not at step N mid-run.
+        eval_batches_fn()
+
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
     try:

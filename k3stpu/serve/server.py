@@ -170,7 +170,10 @@ class InferenceServer:
                  quant: "str | None" = None,
                  kv_cache_dtype: "str | None" = None,
                  continuous_batching: bool = False,
-                 engine_slots: int = 8):
+                 engine_slots: int = 8,
+                 draft_model: "str | None" = None,
+                 draft_ckpt_dir: "str | None" = None,
+                 spec_gamma: int = 4):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -371,6 +374,29 @@ class InferenceServer:
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots)
 
+        # Speculative decoding (serve/speculative.py): greedy /v1/generate
+        # requests draft with a small model and verify whole proposal
+        # chunks in one target `extend` — fewer HBM-bound target steps,
+        # identical output. Sampled requests fall back to the plain path.
+        self._draft = None
+        self.spec_gamma = spec_gamma
+        self._spec_stats = {"requests": 0, "proposed": 0, "accepted": 0}
+        if draft_model is not None:
+            if not model_name.startswith("transformer"):
+                raise ValueError(
+                    "--draft-model pairs with the transformer LM family, "
+                    f"not {model_name!r}")
+            if self._engine is not None:
+                raise ValueError(
+                    "--draft-model and --continuous-batching are separate "
+                    "decode schedulers; pick one")
+            draft = InferenceServer(
+                model_name=draft_model, seq_len=seq_len,
+                batch_window_ms=0.0, shard_devices=1,
+                ckpt_dir=draft_ckpt_dir)
+            self._draft = (draft.model, draft._variables["params"])
+            draft.close()
+
     def warmup(self, batch_sizes=BATCH_SIZES):
         """Pre-compile every served batch size so first requests are fast.
 
@@ -481,6 +507,51 @@ class InferenceServer:
             eos_id = int(eos_id)  # program — just validate the range
             if not 0 <= eos_id < vocab:
                 raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
+        # Spec decode needs a gamma-token margin in the cache; requests
+        # without it (or sampled ones) take the plain path instead.
+        if (self._draft is not None and temperature == 0.0
+                and width + gen_budget + self.spec_gamma + 1
+                <= self.seq_len):
+            from k3stpu.serve.speculative import speculative_generate
+
+            # Same bounded-compile-cache discipline as every other route:
+            # the batch pads to a served bucket (and oversize requests are
+            # rejected), so spec programs compile per bucket, not per n.
+            n = len(prompts)
+            batch = served_batch(n)
+            block = np.zeros((batch, width), np.int32)
+            for i, p in enumerate(prompts):
+                block[i, :len(p)] = p
+            block[n:] = block[n - 1]
+            plens = np.asarray(lens + [lens[-1]] * (batch - n), np.int32)
+            t0 = time.perf_counter()
+            with self._lock:
+                out, spec = speculative_generate(
+                    self.model, self._variables["params"],
+                    self._draft[0], self._draft[1], block,
+                    plens, gen_budget,
+                    gamma=self.spec_gamma)
+            out = out[:n]
+            dt = time.perf_counter() - t0
+            out = out[:, :max_new_tokens]
+            if eos_id is not None:
+                # Greedy spec emits the target's tokens; apply the same
+                # eos-latch semantics as the plain path post hoc.
+                out = out.copy()
+                for r in range(n):
+                    hits = np.nonzero(out[r] == eos_id)[0]
+                    if hits.size:
+                        out[r, hits[0]:] = eos_id
+            with self._lock:
+                self._stats["gen_requests"] += 1
+                self._stats["gen_examples"] += n
+                self._stats["tokens"] += int(out.size)
+                self._stats["gen_seconds"] += dt
+                self._spec_stats["requests"] += 1
+                self._spec_stats["proposed"] += spec["proposed"]
+                self._spec_stats["accepted"] += spec["accepted"]
+            return out.tolist()
+
         if self._engine is not None:
             # Continuous batching: no global lock — the engine interleaves
             # this request with whatever is already decoding. Requests
@@ -539,6 +610,16 @@ class InferenceServer:
         with self._lock:
             return self._stats["seconds"] + self._stats["gen_seconds"]
 
+    def _spec_card(self) -> "dict | None":
+        if self._draft is None:
+            return None
+        with self._lock:
+            s = dict(self._spec_stats)
+        s["gamma"] = self.spec_gamma
+        s["acceptance_rate"] = (round(s["accepted"] / s["proposed"], 4)
+                                if s["proposed"] else None)
+        return s
+
     def _quant_card(self) -> "dict | None":
         if self.quant is None and self.kv_cache_dtype is None:
             return None
@@ -581,6 +662,7 @@ class InferenceServer:
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
             "quant": self._quant_card(),
             "engine": (self._engine.stats() if self._engine else None),
+            "speculative": self._spec_card(),
             "checkpoint_step": self.loaded_step,
             "devices": [str(d) for d in jax.devices()],
             "stats": stats,
@@ -698,6 +780,16 @@ def main(argv=None) -> int:
     ap.add_argument("--engine-slots", type=int, default=8,
                     help="decode slots (max concurrent generation rows) "
                          "for --continuous-batching")
+    ap.add_argument("--draft-model", default=None,
+                    choices=["transformer", "transformer-tiny"],
+                    help="speculative decoding draft for greedy "
+                         "/v1/generate: the draft proposes --spec-gamma "
+                         "tokens per round, the target verifies them in "
+                         "one chunked forward; output is exactly the "
+                         "target's greedy continuation")
+    ap.add_argument("--draft-ckpt-dir", default=None,
+                    help="checkpoint dir for the draft model's weights")
+    ap.add_argument("--spec-gamma", type=int, default=4)
     args = ap.parse_args(argv)
 
     if args.profile_port:
@@ -715,7 +807,10 @@ def main(argv=None) -> int:
                              quant=args.quant,
                              kv_cache_dtype=args.kv_cache_dtype,
                              continuous_batching=args.continuous_batching,
-                             engine_slots=args.engine_slots)
+                             engine_slots=args.engine_slots,
+                             draft_model=args.draft_model,
+                             draft_ckpt_dir=args.draft_ckpt_dir,
+                             spec_gamma=args.spec_gamma)
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)

@@ -1,0 +1,166 @@
+"""Speculative decoding: draft-proposed, target-verified generation.
+
+Decode on a TPU is HBM-bound — each token streams every weight once for
+one matmul row. Speculative decoding converts that into MXU work the chip
+has to spare: a small DRAFT model proposes ``gamma`` tokens with cheap
+decode steps, then the TARGET verifies all of them in ONE ``extend``
+forward (models/transformer.py) whose chunk matmuls batch over the
+proposals. With greedy verification the output is EXACTLY the target's
+own greedy continuation — the draft affects only how many steps it takes,
+never what comes out (tested against ``generate()`` token for token, with
+a deliberately unrelated draft model).
+
+Rollback rides the per-row cache index: rejected proposals are "undone"
+by moving the row's index back — slots beyond it are invisible to the
+pos <= index mask and the next append overwrites them. No copies, no
+paged bookkeeping.
+
+Per-row acceptance: each batch row keeps its own matched-prefix length
+every round, so ragged batches verify independently inside the shared
+static-shape programs.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.generate import init_cache
+
+
+def _set_cache_index(cache, new_idx):
+    """Per-row rollback/advance: rewrite every layer's index leaf."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: (jnp.broadcast_to(new_idx, x.shape).astype(x.dtype)
+                      if getattr(p[-1], "key", None) == "index" else x),
+        cache)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _prefill(model, params, block, lens):
+    cache = init_cache(model, block.shape[0])
+    logits, mut = model.apply({"params": params, "cache": cache}, block,
+                              mode="prefill", seq_lens=lens,
+                              mutable=["cache"])
+    last = jnp.take_along_axis(logits, (lens - 1)[:, None, None],
+                               axis=1)[:, 0]
+    return mut["cache"], jnp.argmax(last, axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _decode_argmax(model, params, cache, toks):
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              toks[:, None], mode="decode",
+                              mutable=["cache"])
+    return mut["cache"], jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnums=(0,))
+def _extend_argmax(model, params, cache, chunk):
+    """Verify chunk (B, G): returns per-position greedy next tokens
+    (B, G) — g[:, j] is the target's next token after chunk[:, :j+1]."""
+    logits, mut = model.apply({"params": params, "cache": cache}, chunk,
+                              mode="extend", mutable=["cache"])
+    return mut["cache"], jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def speculative_generate(
+    target_model, target_params, draft_model, draft_params,
+    prompt: np.ndarray, prompt_lens: np.ndarray, max_new_tokens: int,
+    *, gamma: int = 4,
+) -> "tuple[np.ndarray, dict]":
+    """Greedy speculative generation for a padded (B, P) prompt block.
+
+    Returns ``(tokens (B, max_new_tokens) int32, stats)`` where tokens are
+    EXACTLY the target model's greedy continuation per row. ``stats``
+    reports rounds, mean accepted proposals per round, and the proposal
+    acceptance rate (the speedup knob: wall clock ~ rounds x (gamma draft
+    steps + 1 target extend) instead of max_new_tokens target steps).
+    """
+    b, p = prompt.shape
+    for model, name in ((target_model, "target"), (draft_model, "draft")):
+        cfg = getattr(model.config, "base", model.config)
+        if p + max_new_tokens + gamma + 1 > cfg.max_seq_len:
+            raise ValueError(
+                f"prompt {p} + budget {max_new_tokens} + gamma+1 "
+                f"{gamma + 1} exceeds the {name} cache "
+                f"({cfg.max_seq_len})")
+    if gamma < 1:
+        raise ValueError("gamma must be >= 1")
+
+    block = jnp.asarray(prompt, jnp.int32)
+    lens = jnp.asarray(prompt_lens, jnp.int32)
+    t_cache, x0 = _prefill(target_model, target_params, block, lens)
+    d_cache, _ = _prefill(draft_model, draft_params, block, lens)
+    # Both caches hold the prompt K/V; x0 (the first emitted token) is the
+    # target's greedy pick at each row's last real position.
+    base_idx = np.asarray(lens)               # tokens strictly before x0
+    emitted = [[int(t)] for t in np.asarray(x0)]
+    rounds = 0
+    accepted_total = 0
+    proposed_total = 0
+
+    need = lambda: any(len(e) < max_new_tokens for e in emitted)
+    while need():
+        rounds += 1
+        # Draft proposes gamma tokens. One EXTRA step consumes d_gamma so
+        # the draft cache holds K/V for x0..d_gamma — required when full
+        # acceptance carries the bonus token and the next round's draft
+        # starts right after d_gamma. (Its proposal is discarded; the
+        # draft is the cheap model, the extra step is noise.)
+        cur = x0
+        props = []
+        for _ in range(gamma + 1):
+            d_cache, cur = _decode_argmax(draft_model, draft_params,
+                                          d_cache, cur)
+            props.append(cur)
+        props_arr = jnp.stack(props[:gamma], axis=1)  # (b, gamma)
+        # gamma+1-wide verify chunk [x0, d1..d_gamma]: position j scores
+        # the next token after chunk[:, :j+1], so g[:, :gamma] judges the
+        # proposals AND g[:, gamma] is a free bonus token when everything
+        # matches — the standard gamma+1 tokens per fully-accepted round.
+        chunk = jnp.concatenate([x0[:, None], props_arr], axis=1)
+        t_cache, g = _extend_argmax(target_model, target_params, t_cache,
+                                    chunk)            # (b, gamma+1)
+
+        eq = np.asarray(props_arr == g[:, :gamma])    # (b, gamma)
+        # m_r = longest all-matched prefix of this row's proposals.
+        m = np.cumprod(eq, axis=1).sum(axis=1)        # (b,)
+        props_np, g_np = np.asarray(props_arr), np.asarray(g)
+        new_x0 = np.empty((b,), np.int32)
+        consumed = np.empty((b,), np.int64)
+        for r in range(b):
+            mr = int(m[r])
+            # Emit the matched proposals plus the target's token at the
+            # first divergence — which on full acceptance IS the bonus.
+            take = props_np[r, :mr].tolist() + [int(g_np[r, mr])]
+            emitted[r].extend(take)
+            new_x0[r] = take[-1]
+            # Cache rows hold everything strictly before new_x0:
+            # x0 + the mr accepted proposals.
+            consumed[r] = mr + 1
+        accepted_total += int(m.sum())
+        proposed_total += b * gamma
+        base_idx = base_idx + consumed
+        new_idx = jnp.asarray(base_idx, jnp.int32)
+        # Per-row rollback (free: slots past the index are invisible).
+        t_cache = _set_cache_index(t_cache, new_idx)
+        d_cache = _set_cache_index(d_cache, new_idx)
+        x0 = jnp.asarray(new_x0)
+
+    out = np.stack([np.asarray(e[:max_new_tokens], np.int32)
+                    for e in emitted])
+    stats = {
+        "rounds": rounds,
+        "gamma": gamma,
+        "proposed": proposed_total,
+        "accepted": accepted_total,
+        "acceptance_rate": (round(accepted_total / proposed_total, 4)
+                            if proposed_total else None),
+        "tokens_per_round": (round(sum(len(e) for e in emitted) / b / rounds,
+                                   2) if rounds else None),
+    }
+    return out, stats

@@ -194,3 +194,69 @@ def test_sliding_window_full_vs_decode_consistent():
                                    np.asarray(full[0, t]),
                                    atol=2e-4, rtol=2e-4,
                                    err_msg=f"decode position {t}")
+
+
+def test_extend_mode_matches_prefill():
+    """prefill(P) == prefill(P0) then extend(P - P0): same final logits
+    and identical cache contents up to each row's index — the chunked
+    prefill / speculative-verify building block."""
+    model = transformer_lm_tiny(max_seq_len=32)
+    vs = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)
+    toks = jax.random.randint(jax.random.key(5), (2, 12), 0,
+                              model.config.vocab_size)
+
+    from k3stpu.models.generate import init_cache
+    full_logits, full_mut = model.apply(
+        {"params": vs["params"], "cache": init_cache(model, 2)}, toks,
+        mode="prefill", mutable=["cache"])
+
+    first, rest = toks[:, :8], toks[:, 8:]
+    _, mut = model.apply(
+        {"params": vs["params"], "cache": init_cache(model, 2)}, first,
+        mode="prefill", mutable=["cache"])
+    ext_logits, mut = model.apply(
+        {"params": vs["params"], "cache": mut["cache"]}, rest,
+        mode="extend", mutable=["cache"])
+
+    assert jnp.allclose(ext_logits, full_logits[:, 8:], atol=2e-2), (
+        float(jnp.max(jnp.abs(ext_logits - full_logits[:, 8:]))))
+    idx = mut["cache"]["block0"]["attn"]["index"]
+    assert jnp.array_equal(idx, jnp.array([12, 12]))
+    k_full = full_mut["cache"]["block0"]["attn"]["key"][:, :12]
+    k_ext = mut["cache"]["block0"]["attn"]["key"][:, :12]
+    assert jnp.allclose(k_full.astype(jnp.float32),
+                        k_ext.astype(jnp.float32), atol=2e-2)
+
+
+def test_extend_rollback_is_free():
+    """Dropping the cache index back hides the speculated slots: decoding
+    after a rollback produces the same logits as if the rolled-back
+    extension never happened."""
+    model = transformer_lm_tiny(max_seq_len=32)
+    vs = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                    train=False)
+    from k3stpu.models.generate import init_cache
+    prompt = jax.random.randint(jax.random.key(6), (1, 8), 0,
+                                model.config.vocab_size)
+    _, mut = model.apply(
+        {"params": vs["params"], "cache": init_cache(model, 1)}, prompt,
+        mode="prefill", mutable=["cache"])
+    clean = mut["cache"]
+
+    # Speculate 4 junk tokens, then roll back by resetting the index.
+    junk = jnp.full((1, 4), 3, jnp.int32)
+    _, mut2 = model.apply({"params": vs["params"], "cache": clean}, junk,
+                          mode="extend", mutable=["cache"])
+    rolled = jax.tree.map(lambda x: x, mut2["cache"])
+    rolled = jax.tree_util.tree_map_with_path(
+        lambda p, x: (jnp.full_like(x, 8)
+                      if p[-1].key == "index" else x), rolled)
+
+    tok = jnp.array([[7]], jnp.int32)
+    ref, _ = model.apply({"params": vs["params"], "cache": clean}, tok,
+                         mode="decode", mutable=["cache"])
+    got, _ = model.apply({"params": vs["params"], "cache": rolled}, tok,
+                         mode="decode", mutable=["cache"])
+    assert jnp.allclose(ref, got, atol=1e-5), (
+        float(jnp.max(jnp.abs(ref - got))))
