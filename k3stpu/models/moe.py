@@ -108,20 +108,35 @@ class MoeMlp(nn.Module):
         aux = e * jnp.sum(frac * mean_gate) * cfg.aux_loss_coef
         self.sow("losses", "router_balance", aux)
 
-        # Expert-major params: leading E shards over 'model' (EP).
-        w_in = self.param(
-            "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (e, d, base.d_ff), jnp.float32)
-        w_out = self.param(
-            "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
-            (e, base.d_ff, d), jnp.float32)
+        # Expert-major params: leading E shards over 'model' (EP). Under
+        # base.quant the experts store int8 with per-(expert, out-channel)
+        # scales — kept rank-3 (E, 1, out) so the rank-based sharding rule
+        # splits them over 'model' WITH the experts, like the kernels.
+        if base.quant == "int8":
+            w_in8 = self.param("w_in_int8", nn.initializers.zeros,
+                               (e, d, base.d_ff), jnp.int8)
+            w_in_s = self.param("w_in_scale", nn.initializers.ones,
+                                (e, 1, base.d_ff), jnp.float32)
+            w_out8 = self.param("w_out_int8", nn.initializers.zeros,
+                                (e, base.d_ff, d), jnp.int8)
+            w_out_s = self.param("w_out_scale", nn.initializers.ones,
+                                 (e, 1, d), jnp.float32)
+            w_in = (w_in8.astype(jnp.float32) * w_in_s).astype(base.dtype)
+            w_out = (w_out8.astype(jnp.float32)
+                     * w_out_s).astype(base.dtype)
+        else:
+            w_in = self.param(
+                "w_in", nn.initializers.lecun_normal(batch_axis=(0,)),
+                (e, d, base.d_ff), jnp.float32).astype(base.dtype)
+            w_out = self.param(
+                "w_out", nn.initializers.lecun_normal(batch_axis=(0,)),
+                (e, base.d_ff, d), jnp.float32).astype(base.dtype)
 
         xs = tokens.astype(base.dtype)
         expert_in = jnp.einsum("td,tec->ecd", xs,
                                dispatch.astype(base.dtype))
-        h = nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in,
-                               w_in.astype(base.dtype)))
-        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out.astype(base.dtype))
+        h = nn.gelu(jnp.einsum("ecd,edf->ecf", expert_in, w_in))
+        expert_out = jnp.einsum("ecf,efd->ecd", h, w_out)
         out = jnp.einsum("ecd,tec->td", expert_out,
                          combine.astype(base.dtype))
         return out.reshape(b, s, d)

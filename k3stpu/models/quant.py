@@ -90,13 +90,20 @@ def dequantize_kernel(w8: jax.Array, scale: jax.Array) -> jax.Array:
     return dequantize_absmax(w8, scale, axis=0)
 
 
+# MoE expert tensors (models/moe.py): (E, in, out) arrays quantized
+# per-(expert, out-channel), scales stored (E, 1, out) — see MoeMlp.
+QUANT_EXPERT_NAMES = ("w_in", "w_out")
+
+
 def quantize_lm_params(params: dict) -> dict:
-    """Float TransformerLM param tree -> the quant=int8 model's tree.
+    """Float LM param tree -> the quant=int8 model's tree.
 
     Every ``{kernel}`` dict under a module named in QUANT_DENSE_NAMES
-    becomes ``{w_int8, scale}``; all other subtrees pass through unchanged,
-    so the result matches ``TransformerLM(cfg(quant="int8")).init`` shapes
-    exactly and drops into the same serving/generate code paths.
+    becomes ``{w_int8, scale}``, and every (E, in, out) expert leaf named
+    in QUANT_EXPERT_NAMES becomes ``{name}_int8`` + ``{name}_scale``; all
+    other subtrees pass through unchanged, so the result matches the
+    quant="int8" model's ``init`` shapes exactly and drops into the same
+    serving/generate code paths (dense TransformerLM and MoE alike).
     """
 
     def walk(tree, name):
@@ -104,7 +111,16 @@ def quantize_lm_params(params: dict) -> dict:
             if (name in QUANT_DENSE_NAMES and set(tree) == {"kernel"}):
                 w8, scale = quantize_kernel(tree["kernel"])
                 return {"w_int8": w8, "scale": scale}
-            return {k: walk(v, k) for k, v in tree.items()}
+            out = {}
+            for k, v in tree.items():
+                if (k in QUANT_EXPERT_NAMES and not isinstance(v, dict)
+                        and getattr(v, "ndim", 0) == 3):
+                    w8, scale = quantize_absmax(v, axis=1)
+                    out[f"{k}_int8"] = w8
+                    out[f"{k}_scale"] = scale[:, None, :]
+                else:
+                    out[k] = walk(v, k)
+            return out
         return tree
 
     return walk(params, "")

@@ -284,9 +284,9 @@ class InferenceServer:
         self.quant = quant
         self.float_param_bytes: "int | None" = None
         if quant is not None:
-            if not model_name.startswith("transformer"):
+            if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
-                    f"--quant int8 supports the transformer LM family; "
+                    f"--quant int8 supports the LM families; "
                     f"{model_name!r} stays float")
             import dataclasses
 
@@ -297,8 +297,13 @@ class InferenceServer:
                 **self._variables,
                 "params": quantize_lm_params(self._variables["params"]),
             }
-            self.model = type(self.model)(
-                dataclasses.replace(self.model.config, quant=quant))
+            if model_name.startswith("moe"):
+                cfg = self.model.config
+                self.model = type(self.model)(dataclasses.replace(
+                    cfg, base=dataclasses.replace(cfg.base, quant=quant)))
+            else:
+                self.model = type(self.model)(
+                    dataclasses.replace(self.model.config, quant=quant))
 
         # int8 KV cache (no param change — the cache collection is built
         # per generate call from the live config): halves the HBM the
