@@ -35,6 +35,19 @@ def init_cache(model, batch: int):
                         shapes["cache"])
 
 
+def set_cache_index(cache, new_idx: jax.Array):
+    """Rewrite every layer's per-row cache index (B,) — rollback/advance.
+
+    Moving an index BACK is a free rollback: slots beyond it are invisible
+    to the ``pos <= index`` mask and the next append overwrites them
+    (speculative decoding's reject path, chunked admission's ragged-pad
+    reset)."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, x: (jnp.broadcast_to(new_idx, x.shape).astype(x.dtype)
+                      if getattr(p[-1], "key", None) == "index" else x),
+        cache)
+
+
 def _sample(logits: jax.Array, rng: jax.Array, *, temperature: float,
             top_k: int | None) -> jax.Array:
     """(B, V) logits -> (B,) token ids. temperature == 0 means greedy."""

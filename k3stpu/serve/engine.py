@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k3stpu.models.generate import init_cache
+from k3stpu.models.generate import init_cache, set_cache_index
 
 _NEG_INF = -1e30
 
@@ -87,10 +87,18 @@ class GenerateEngine:
     """
 
     def __init__(self, model, params, *, slots: int = 8,
-                 seed: int = 0):
+                 seed: int = 0, chunk_prefill: "int | None" = None):
+        """``chunk_prefill``: admit long prompts in chunks of this many
+        tokens, one chunk per loop iteration — bounds how long a decode
+        step can be delayed by an arriving prompt to one chunk's latency
+        instead of the whole prompt's. None = single-shot admission."""
+        if chunk_prefill is not None and chunk_prefill < 1:
+            raise ValueError(f"chunk_prefill must be >= 1, got "
+                             f"{chunk_prefill}")
         self.model = model
         self.params = params
         self.slots = slots
+        self.chunk_prefill = chunk_prefill
         cfg = getattr(model.config, "base", model.config)
         self.max_seq = cfg.max_seq_len
         self.vocab = cfg.vocab_size
@@ -101,6 +109,7 @@ class GenerateEngine:
 
         # Host-side slot state (numpy: mutated only by the loop thread).
         self._active = np.zeros((slots,), bool)
+        self._reserved = np.zeros((slots,), bool)  # chunked admission holds
         self._last_tok = np.zeros((slots,), np.int32)
         self._left = np.zeros((slots,), np.int64)
         self._temps = np.zeros((slots,), np.float32)
@@ -111,10 +120,12 @@ class GenerateEngine:
 
         self._q: "queue.SimpleQueue[_Request | None]" = queue.SimpleQueue()
         self._pending: "list[_Request]" = []
+        self._adm: "dict | None" = None  # in-flight chunked admission
         self._closed = False
         self._lock = threading.Lock()
         self._stats = {"tokens": 0, "steps": 0, "busy_s": 0.0,
-                       "requests": 0, "slot_occupancy_sum": 0.0}
+                       "requests": 0, "slot_occupancy_sum": 0.0,
+                       "adm_chunks": 0}
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generate-engine")
@@ -149,6 +160,20 @@ class GenerateEngine:
     @functools.partial(jax.jit, static_argnums=(0,))
     def _scatter(self, big, small, slot_ids):
         return jax.tree.map(lambda b, s: b.at[slot_ids].set(s), big, small)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _extend_chunk(self, params, cache, chunk):
+        _, mut = self.model.apply(
+            {"params": params, "cache": cache}, chunk, mode="extend",
+            mutable=["cache"])
+        return mut["cache"]
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _decode_logits(self, params, cache, toks):
+        logits, mut = self.model.apply(
+            {"params": params, "cache": cache}, toks[:, None],
+            mode="decode", mutable=["cache"])
+        return mut["cache"], logits[:, -1].astype(jnp.float32)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _first_sample(self, last_logits, temps, topks, step, base_key):
@@ -204,7 +229,8 @@ class GenerateEngine:
     # --- loop internals (single thread; owns all slot state) ------------
 
     def _free_slots(self) -> "list[int]":
-        return [i for i in range(self.slots) if not self._active[i]]
+        return [i for i in range(self.slots)
+                if not self._active[i] and not self._reserved[i]]
 
     def _drain_queue(self, block: bool) -> bool:
         """Move queued requests into pending. Returns False on shutdown."""
@@ -220,61 +246,145 @@ class GenerateEngine:
             return True
 
     def _admit(self) -> None:
-        """Prefill + scatter as many pending requests as slots allow."""
-        while self._pending:
-            req = self._pending[0]
+        """Admit pending requests. Chunked admissions advance ONE chunk
+        per call, so an arriving long prompt delays in-flight decode by at
+        most one chunk's latency, never the whole prefill. While a
+        chunked admission is in flight, ONE short (single-shot) request
+        may still slip in per call — no head-of-line blocking behind a
+        long prefill when free slots exist."""
+        if self._adm is not None:
+            self._admission_step()
+            self._admit_pending(allow_chunked=False, limit=1)
+            return
+        self._admit_pending(allow_chunked=True)
+
+    def _admit_pending(self, *, allow_chunked: bool,
+                       limit: "int | None" = None) -> None:
+        admitted = 0
+        i = 0
+        while i < len(self._pending) and (limit is None
+                                          or admitted < limit):
+            req = self._pending[i]
             # The pow2 bucket is the admission unit: bucket rows beyond n
             # also land in free slots (they must not overwrite live rows),
             # so the fit check runs on nb BEFORE any device work.
             n, width = req.block.shape
             nb = min(_pow2_at_least(n), self.slots)
+            c = self.chunk_prefill
+            chunked = c is not None and width > c
+            if chunked and not allow_chunked:
+                i += 1  # long prompts wait for the in-flight one
+                continue
             free = self._free_slots()
             if len(free) < nb:
-                return  # decode continues; retry when slots free up
-            self._pending.pop(0)
+                return  # strict FIFO on capacity: big requests don't starve
+            self._pending.pop(i)
+            admitted += 1
+            block = np.zeros((nb, width), np.int32)
+            block[:n] = req.block
+            lens = np.concatenate([req.lens, np.ones((nb - n,), np.int32)])
+            all_rows = free[:nb]
+            if chunked:
+                # Start a chunked admission: reserve the slots, run the
+                # first chunk, and let subsequent loop iterations (with
+                # decode steps in between) carry the rest.
+                try:
+                    small, _ = self._prefill(
+                        self.params, jnp.asarray(block[:, :c]),
+                        jnp.full((nb,), c, jnp.int32))
+                except Exception as e:  # noqa: BLE001
+                    req.error = e
+                    req.event.set()
+                    continue
+                for r in all_rows:
+                    self._reserved[r] = True
+                self._adm = {"req": req, "cache": small, "block": block,
+                             "lens": lens, "pos": c, "rows": all_rows,
+                             "n": n}
+                with self._lock:
+                    self._stats["adm_chunks"] += 1
+                return
             try:
-                block = np.zeros((nb, width), np.int32)
-                block[:n] = req.block
-                lens = np.concatenate(
-                    [req.lens, np.ones((nb - n,), np.int32)])
                 small, last = self._prefill(self.params, jnp.asarray(block),
                                             jnp.asarray(lens))
-                all_rows = free[:nb]
-                rows = all_rows[:n]
-                self._cache = self._scatter(
-                    self._cache, small, jnp.asarray(all_rows, np.int32))
-                temps = np.full((nb,), req.temp, np.float32)
-                topks = np.full(
-                    (nb,),
-                    req.top_k if req.top_k else self.vocab, np.int32)
-                self._step_counter += 1
-                first = np.asarray(self._first_sample(
-                    last, jnp.asarray(temps), jnp.asarray(topks),
-                    self._step_counter, self._base_key))
+                self._activate(req, all_rows, n, small, last)
             except Exception as e:  # noqa: BLE001 — fail the one request
                 req.error = e
                 req.event.set()
                 continue
-            req.slot_rows = rows
-            for j, r in enumerate(rows):
-                self._active[r] = True
-                self._owner[r] = req
-                self._last_tok[r] = int(first[j])
-                self._left[r] = req.budget - 1
-                self._temps[r] = req.temp
-                self._topks[r] = req.top_k if req.top_k else self.vocab
-                self._eos[r] = -1 if req.eos is None else int(req.eos)
-                self._collected[r] = [int(first[j])]
-            with self._lock:
-                self._stats["requests"] += 1
-                self._stats["tokens"] += len(rows)  # first sampled tokens
-            # eos on the very first token / budget 1 finishes immediately.
-            for r in rows:
-                if (self._left[r] <= 0
-                        or (self._eos[r] >= 0
-                            and self._last_tok[r] == self._eos[r])):
-                    self._finish_row(r)
-            self._maybe_complete(req)
+
+    def _admission_step(self) -> None:
+        """One chunk of the in-flight admission (or its finalize)."""
+        a = self._adm
+        req, c = a["req"], self.chunk_prefill
+        width = a["block"].shape[1]
+        try:
+            if a["pos"] < width:
+                end = min(a["pos"] + c, width)
+                a["cache"] = self._extend_chunk(
+                    self.params, a["cache"],
+                    jnp.asarray(a["block"][:, a["pos"]:end]))
+                a["pos"] = end
+                with self._lock:
+                    self._stats["adm_chunks"] += 1
+                return
+            # Finalize: every row consumed the padded width (short rows
+            # carry junk K/V beyond their length). Reset each row's index
+            # to len-1 (free rollback: junk becomes invisible) and decode
+            # the row's LAST REAL token — recomputing its K/V in place and
+            # yielding the exact first-token logits; index lands on len,
+            # the engine's steady-state invariant.
+            lens = a["lens"]
+            cache = set_cache_index(a["cache"],
+                                    jnp.asarray(lens - 1, jnp.int32))
+            last_toks = a["block"][np.arange(len(lens)), lens - 1]
+            cache, last = self._decode_logits(self.params, cache,
+                                              jnp.asarray(last_toks))
+            for r in a["rows"]:
+                self._reserved[r] = False
+            self._adm = None
+            self._activate(req, a["rows"], a["n"], cache, last)
+        except Exception as e:  # noqa: BLE001 — fail the one request
+            for r in a["rows"]:
+                self._reserved[r] = False
+            self._adm = None
+            req.error = e
+            req.event.set()
+
+    def _activate(self, req, all_rows, n, small_cache, last_logits) -> None:
+        """Scatter an admitted small cache into the slot block and light
+        up the rows (shared tail of both admission paths)."""
+        rows = all_rows[:n]
+        self._cache = self._scatter(
+            self._cache, small_cache, jnp.asarray(all_rows, np.int32))
+        nb = len(all_rows)
+        temps = np.full((nb,), req.temp, np.float32)
+        topks = np.full(
+            (nb,), req.top_k if req.top_k else self.vocab, np.int32)
+        self._step_counter += 1
+        first = np.asarray(self._first_sample(
+            last_logits, jnp.asarray(temps), jnp.asarray(topks),
+            self._step_counter, self._base_key))
+        req.slot_rows = rows
+        for j, r in enumerate(rows):
+            self._active[r] = True
+            self._owner[r] = req
+            self._last_tok[r] = int(first[j])
+            self._left[r] = req.budget - 1
+            self._temps[r] = req.temp
+            self._topks[r] = req.top_k if req.top_k else self.vocab
+            self._eos[r] = -1 if req.eos is None else int(req.eos)
+            self._collected[r] = [int(first[j])]
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["tokens"] += len(rows)  # first sampled tokens
+        # eos on the very first token / budget 1 finishes immediately.
+        for r in rows:
+            if (self._left[r] <= 0
+                    or (self._eos[r] >= 0
+                        and self._last_tok[r] == self._eos[r])):
+                self._finish_row(r)
+        self._maybe_complete(req)
 
     def _finish_row(self, r: int) -> None:
         self._active[r] = False
@@ -297,7 +407,8 @@ class GenerateEngine:
         while True:
             any_active = bool(self._active.any())
             if not self._drain_queue(block=not any_active
-                                     and not self._pending):
+                                     and not self._pending
+                                     and self._adm is None):
                 break  # shutdown sentinel
             self._admit()
             if not self._active.any():
@@ -350,6 +461,9 @@ class GenerateEngine:
                     self._pending.append(req)
         except queue.Empty:
             pass
+        if self._adm is not None:
+            self._pending.append(self._adm["req"])
+            self._adm = None
         for req in self._pending:
             req.error = err
             req.event.set()

@@ -171,6 +171,7 @@ class InferenceServer:
                  kv_cache_dtype: "str | None" = None,
                  continuous_batching: bool = False,
                  engine_slots: int = 8,
+                 prefill_chunk: "int | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
                  spec_gamma: int = 4):
@@ -377,7 +378,8 @@ class InferenceServer:
             from k3stpu.serve.engine import GenerateEngine
 
             self._engine = GenerateEngine(
-                self.model, self._variables["params"], slots=engine_slots)
+                self.model, self._variables["params"], slots=engine_slots,
+                chunk_prefill=prefill_chunk)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -785,6 +787,11 @@ def main(argv=None) -> int:
     ap.add_argument("--engine-slots", type=int, default=8,
                     help="decode slots (max concurrent generation rows) "
                          "for --continuous-batching")
+    ap.add_argument("--prefill-chunk", type=int, default=None,
+                    help="with --continuous-batching: admit long prompts "
+                         "in chunks of this many tokens, decode steps "
+                         "interleaved — bounds the decode stall an "
+                         "arriving prompt causes to one chunk's latency")
     ap.add_argument("--draft-model", default=None,
                     choices=["transformer", "transformer-tiny"],
                     help="speculative decoding draft for greedy "
@@ -813,6 +820,7 @@ def main(argv=None) -> int:
                              kv_cache_dtype=args.kv_cache_dtype,
                              continuous_batching=args.continuous_batching,
                              engine_slots=args.engine_slots,
+                             prefill_chunk=args.prefill_chunk,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
                              spec_gamma=args.spec_gamma)

@@ -28,15 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k3stpu.models.generate import init_cache
-
-
-def _set_cache_index(cache, new_idx):
-    """Per-row rollback/advance: rewrite every layer's index leaf."""
-    return jax.tree_util.tree_map_with_path(
-        lambda p, x: (jnp.broadcast_to(new_idx, x.shape).astype(x.dtype)
-                      if getattr(p[-1], "key", None) == "index" else x),
-        cache)
+from k3stpu.models.generate import init_cache, set_cache_index
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
@@ -147,8 +139,8 @@ def speculative_generate(
         base_idx = base_idx + consumed
         new_idx = jnp.asarray(base_idx, jnp.int32)
         # Per-row rollback (free: slots past the index are invisible).
-        t_cache = _set_cache_index(t_cache, new_idx)
-        d_cache = _set_cache_index(d_cache, new_idx)
+        t_cache = set_cache_index(t_cache, new_idx)
+        d_cache = set_cache_index(d_cache, new_idx)
         x0 = jnp.asarray(new_x0)
 
     out = np.stack([np.asarray(e[:max_new_tokens], np.int32)

@@ -222,3 +222,75 @@ def test_engine_composes_with_quant_and_int8_kv():
             plain.close()
     finally:
         server.close()
+
+
+def test_chunked_prefill_admission_exact():
+    """chunk_prefill=8 with prompts longer than one chunk (ragged lengths
+    crossing chunk boundaries): outputs still equal solo generation, and
+    chunked admission actually ran."""
+    model, params = _model_and_params(max_seq_len=64)
+    engine = GenerateEngine(model, params, slots=4, chunk_prefill=8)
+    try:
+        prompts = [list(range(1, 20)),          # 19 tokens: 3 chunks
+                   list(range(30, 41))]         # 11 tokens: 2 chunks
+        got = engine.submit(prompts, max_new_tokens=5)
+        for g, p in zip(got, prompts):
+            assert g == _solo(model, params, p, 5), p
+        assert engine.stats()["adm_chunks"] >= 2
+    finally:
+        engine.close()
+
+
+def test_chunked_admission_interleaves_with_decode():
+    """A long-prompt admission must not freeze an in-flight generation:
+    the active request keeps emitting decode steps between chunks."""
+    model, params = _model_and_params(max_seq_len=64)
+    engine = GenerateEngine(model, params, slots=4, chunk_prefill=8)
+    try:
+        # Warm the compiled programs.
+        engine.submit([[1, 2]], max_new_tokens=2)
+        engine.submit([list(range(1, 20))], max_new_tokens=2)
+
+        long_prompt = list(range(1, 25))
+        results = {}
+        t = threading.Thread(target=lambda: results.update(
+            a=engine.submit([[5, 6, 7]], max_new_tokens=30)[0]))
+        t.start()
+        deadline = time.time() + 60
+        while engine.stats()["steps"] < 3:
+            assert time.time() < deadline
+            time.sleep(0.005)
+        got = engine.submit([long_prompt], max_new_tokens=4)[0]
+        t.join(120)
+        assert got == _solo(model, params, long_prompt, 4)
+        assert results["a"] == _solo(model, params, [5, 6, 7], 30)
+    finally:
+        engine.close()
+
+
+def test_short_request_admits_during_chunked_prefill():
+    """No head-of-line blocking: a short prompt admits (and can finish)
+    while a long prompt's chunked admission is still in flight."""
+    model, params = _model_and_params(max_seq_len=64)
+    engine = GenerateEngine(model, params, slots=4, chunk_prefill=8)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm programs
+        engine.submit([list(range(1, 25))], max_new_tokens=2)
+        long_prompt = list(range(1, 33))
+        results = {}
+        t = threading.Thread(target=lambda: results.update(
+            long=engine.submit([long_prompt], max_new_tokens=20)[0]))
+        t.start()
+        time.sleep(0.01)  # let the chunked admission start
+        short = engine.submit([[5, 6]], max_new_tokens=2)[0]
+        t.join(120)
+        assert short == _solo(model, params, [5, 6], 2)
+        assert results["long"] == _solo(model, params, long_prompt, 20)
+    finally:
+        engine.close()
+
+
+def test_bad_chunk_prefill_rejected():
+    model, params = _model_and_params(max_seq_len=32)
+    with pytest.raises(ValueError, match="chunk_prefill"):
+        GenerateEngine(model, params, slots=2, chunk_prefill=0)
