@@ -128,8 +128,19 @@ def stage_serve(log):
     return ok
 
 
+def stage_tune(log):
+    """Block-size sweep on the chip: the winner calibrates DEFAULT_BLOCK
+    (ops/attention.py) — committed as an artifact so the choice is a
+    measurement, not a guess."""
+    rc, out = _run_bounded(
+        [sys.executable, "-m", "k3stpu.ops.attn_tune", "--seq", "4096",
+         "--batch", "8"], 1800, log)
+    return rc == 0 and "ATTN_TUNE_BEST" in out
+
+
 STAGES = {"probe": stage_probe, "share": stage_share,
-          "train": stage_train, "serve": stage_serve}
+          "train": stage_train, "serve": stage_serve,
+          "tune": stage_tune}
 
 
 def main(argv=None) -> int:
