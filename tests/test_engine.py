@@ -294,3 +294,60 @@ def test_bad_chunk_prefill_rejected():
     model, params = _model_and_params(max_seq_len=32)
     with pytest.raises(ValueError, match="chunk_prefill"):
         GenerateEngine(model, params, slots=2, chunk_prefill=0)
+
+
+def test_engine_mixed_sampling_params_concurrently():
+    """Heterogeneous requests share the one decode program: a greedy
+    request stays exact while a sampled request runs in the same batch."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=4)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm
+        results = {}
+        t = threading.Thread(target=lambda: results.update(
+            sampled=engine.submit([[9, 10, 11]], max_new_tokens=24,
+                                  temperature=1.0, top_k=8)[0]))
+        t.start()
+        greedy = engine.submit([[5, 6, 7]], max_new_tokens=6)[0]
+        t.join(120)
+        assert greedy == _solo(model, params, [5, 6, 7], 6)
+        s = results["sampled"]
+        assert len(s) == 24
+        assert all(0 <= tok < model.config.vocab_size for tok in s)
+    finally:
+        engine.close()
+
+
+def test_engine_moe_model():
+    from k3stpu.models.moe import moe_lm_tiny
+
+    model = moe_lm_tiny(max_seq_len=32)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    engine = GenerateEngine(model, variables["params"], slots=2)
+    try:
+        got = engine.submit([[3, 4, 5]], max_new_tokens=4)[0]
+        assert got == _solo(model, variables["params"], [3, 4, 5], 4)
+    finally:
+        engine.close()
+
+
+def test_chunked_prefill_with_int8_kv_cache():
+    import dataclasses
+
+    base = transformer_lm_tiny(max_seq_len=64)
+    variables = base.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                          train=False)
+    qmodel = type(base)(dataclasses.replace(base.config,
+                                            kv_cache_dtype="int8"))
+    engine = GenerateEngine(qmodel, variables["params"], slots=2,
+                            chunk_prefill=8)
+    plain = GenerateEngine(qmodel, variables["params"], slots=2)
+    try:
+        prompt = list(range(1, 22))
+        a = engine.submit([prompt], max_new_tokens=5)[0]
+        b = plain.submit([prompt], max_new_tokens=5)[0]
+        assert a == b, "chunked admission must not change int8-KV decode"
+    finally:
+        engine.close()
+        plain.close()
