@@ -63,15 +63,16 @@ def _sample_rows(logits, temps, topks, key):
 
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "eos",
-                 "event", "tokens", "error", "slot_rows")
+                 "event", "tokens", "error", "slot_rows", "samples")
 
-    def __init__(self, block, lens, budget, temp, top_k, eos):
+    def __init__(self, block, lens, budget, temp, top_k, eos, samples=1):
         self.block = block          # (n, P) int32, right-padded
         self.lens = lens            # (n,) true lengths
         self.budget = budget        # max new tokens (shared by the rows)
         self.temp = temp
         self.top_k = top_k
         self.eos = eos              # int | None
+        self.samples = samples      # >1: one prompt, n sampled rows
         self.event = threading.Event()
         self.tokens: "list[list[int]] | None" = None
         self.error: "Exception | None" = None
@@ -180,7 +181,42 @@ class GenerateEngine:
         key = jax.random.fold_in(base_key, step)
         return _sample_rows(last_logits, temps, topks, key)
 
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _broadcast_rows(self, cache, last, n: int):
+        """Row 0 of a 1-row admission cache replicated to n rows — the
+        shared-prefix fan-out (one prefill, n sampled continuations)."""
+        rep = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[:1], (n, *x.shape[1:])), cache)
+        return rep, jnp.broadcast_to(last[:1], (n, *last.shape[1:]))
+
     # --- client API -----------------------------------------------------
+
+    def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
+                        eos_id, samples=1) -> "_Request":
+        """Shared validation + packing for both entry points: right-pad to
+        a pow2 width bucket and bound against the cache."""
+        lens = [len(p) for p in prompts]
+        if min(lens) == 0:
+            raise ValueError("prompts must be non-empty")
+        width = min(_pow2_at_least(max(lens), 8), self.max_seq)
+        if max(lens) > width or width + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
+                f"cache ({self.max_seq})")
+        block = np.zeros((len(prompts), width), np.int32)
+        for i, p in enumerate(prompts):
+            block[i, :len(p)] = p
+        return _Request(block, np.asarray(lens, np.int32), max_new_tokens,
+                        float(temperature), top_k, eos_id, samples=samples)
+
+    def _enqueue_and_wait(self, req: "_Request",
+                          timeout_s: float) -> "list[list[int]]":
+        self._q.put(req)
+        if not req.event.wait(timeout_s):
+            raise TimeoutError("generation did not finish in time")
+        if req.error is not None:
+            raise req.error
+        return req.tokens
 
     def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
                temperature: float = 0.0, top_k: "int | None" = None,
@@ -192,25 +228,26 @@ class GenerateEngine:
         n = len(prompts)
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
-        lens = [len(p) for p in prompts]
-        if min(lens) == 0:
-            raise ValueError("prompts must be non-empty")
-        width = min(_pow2_at_least(max(lens), 8), self.max_seq)
-        if max(lens) > width or width + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
-                f"cache ({self.max_seq})")
-        block = np.zeros((n, width), np.int32)
-        for i, p in enumerate(prompts):
-            block[i, :len(p)] = p
-        req = _Request(block, np.asarray(lens, np.int32), max_new_tokens,
-                       float(temperature), top_k, eos_id)
-        self._q.put(req)
-        if not req.event.wait(timeout_s):
-            raise TimeoutError("generation did not finish in time")
-        if req.error is not None:
-            raise req.error
-        return req.tokens
+        req = self._packed_request(prompts, max_new_tokens, temperature,
+                                   top_k, eos_id)
+        return self._enqueue_and_wait(req, timeout_s)
+
+    def submit_samples(self, prompt: "list[int]", n: int, *,
+                       max_new_tokens: int, temperature: float = 1.0,
+                       top_k: "int | None" = None,
+                       eos_id: "int | None" = None,
+                       timeout_s: float = 600.0) -> "list[list[int]]":
+        """n sampled continuations of ONE prompt for the price of one
+        prefill: the prefilled cache row broadcasts across n slots and the
+        rows diverge through per-row sampling noise. (With temperature 0
+        all rows are the same greedy continuation — use submit().)"""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not 1 <= n <= self.slots:
+            raise ValueError(f"need 1..{self.slots} samples, got {n}")
+        req = self._packed_request([prompt], max_new_tokens, temperature,
+                                   top_k, eos_id, samples=n)
+        return self._enqueue_and_wait(req, timeout_s)
 
     def close(self) -> None:
         self._closed = True
@@ -269,7 +306,8 @@ class GenerateEngine:
             # also land in free slots (they must not overwrite live rows),
             # so the fit check runs on nb BEFORE any device work.
             n, width = req.block.shape
-            nb = min(_pow2_at_least(n), self.slots)
+            n_rows = req.samples if req.samples > 1 else n
+            nb = min(_pow2_at_least(n_rows), self.slots)
             c = self.chunk_prefill
             chunked = c is not None and width > c
             if chunked and not allow_chunked:
@@ -280,9 +318,15 @@ class GenerateEngine:
                 return  # strict FIFO on capacity: big requests don't starve
             self._pending.pop(i)
             admitted += 1
-            block = np.zeros((nb, width), np.int32)
-            block[:n] = req.block
-            lens = np.concatenate([req.lens, np.ones((nb - n,), np.int32)])
+            if req.samples > 1:
+                # Shared-prefix fan-out: prefill the ONE prompt row; the
+                # broadcast to nb rows happens at activation/finalize.
+                block, lens = req.block, req.lens
+            else:
+                block = np.zeros((nb, width), np.int32)
+                block[:n] = req.block
+                lens = np.concatenate(
+                    [req.lens, np.ones((nb - n,), np.int32)])
             all_rows = free[:nb]
             if chunked:
                 # Start a chunked admission: reserve the slots, run the
@@ -291,7 +335,7 @@ class GenerateEngine:
                 try:
                     small, _ = self._prefill(
                         self.params, jnp.asarray(block[:, :c]),
-                        jnp.full((nb,), c, jnp.int32))
+                        jnp.full((block.shape[0],), c, jnp.int32))
                 except Exception as e:  # noqa: BLE001
                     req.error = e
                     req.event.set()
@@ -300,14 +344,16 @@ class GenerateEngine:
                     self._reserved[r] = True
                 self._adm = {"req": req, "cache": small, "block": block,
                              "lens": lens, "pos": c, "rows": all_rows,
-                             "n": n}
+                             "n": n_rows}
                 with self._lock:
                     self._stats["adm_chunks"] += 1
                 return
             try:
                 small, last = self._prefill(self.params, jnp.asarray(block),
                                             jnp.asarray(lens))
-                self._activate(req, all_rows, n, small, last)
+                if req.samples > 1:
+                    small, last = self._broadcast_rows(small, last, nb)
+                self._activate(req, all_rows, n_rows, small, last)
             except Exception as e:  # noqa: BLE001 — fail the one request
                 req.error = e
                 req.event.set()
@@ -340,6 +386,9 @@ class GenerateEngine:
             last_toks = a["block"][np.arange(len(lens)), lens - 1]
             cache, last = self._decode_logits(self.params, cache,
                                               jnp.asarray(last_toks))
+            if req.samples > 1:
+                cache, last = self._broadcast_rows(cache, last,
+                                                   len(a["rows"]))
             for r in a["rows"]:
                 self._reserved[r] = False
             self._adm = None

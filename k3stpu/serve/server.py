@@ -469,13 +469,19 @@ class InferenceServer:
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,
                         top_k: "int | None" = None,
-                        eos_id: "int | None" = None) -> "list[list[int]]":
+                        eos_id: "int | None" = None,
+                        num_samples: int = 1) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
         power-of-two width, and the batch to the next served batch size —
         both keep the jitted prefill/decode programs to a small fixed set
         (models/generate.py handles the ragged lengths exactly).
+
+        ``num_samples > 1`` (single prompt only) returns n sampled
+        continuations; under the continuous-batching engine the prompt
+        prefills ONCE and fans out across slots (shared-prefix sampling),
+        otherwise it expands to n batch rows.
         """
         import jax.numpy as jnp
 
@@ -488,6 +494,20 @@ class InferenceServer:
         max_new_tokens = int(max_new_tokens)
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        num_samples = int(num_samples)
+        if num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+        if num_samples > 1:
+            if len(prompts) != 1:
+                raise ValueError(
+                    "num_samples > 1 takes exactly one prompt")
+            if self._engine is None:
+                # No engine: expand to n batch rows (n prefills of the
+                # same prompt — correct, without the shared-prefix
+                # saving). The engine route happens AFTER the shared
+                # sanitization block below.
+                prompts = prompts * num_samples
+                num_samples = 1
 
         # Everything that reaches generate() as a STATIC jit argument is
         # bucketed/quantized here, so a hostile or chatty client can only
@@ -514,6 +534,24 @@ class InferenceServer:
             eos_id = int(eos_id)  # program — just validate the range
             if not 0 <= eos_id < vocab:
                 raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
+
+        if num_samples > 1:  # engine-backed shared-prefix sampling
+            t0 = time.perf_counter()
+            out = []
+            for ofs in range(0, num_samples, self._engine.slots):
+                k = min(self._engine.slots, num_samples - ofs)
+                out.extend(self._engine.submit_samples(
+                    prompts[0], k, max_new_tokens=gen_budget,
+                    temperature=temperature, top_k=top_k, eos_id=eos_id))
+            dt = time.perf_counter() - t0
+            out = [row[:max_new_tokens] for row in out]
+            with self._lock:
+                self._stats["gen_requests"] += 1
+                self._stats["gen_examples"] += num_samples
+                self._stats["tokens"] += sum(len(r) for r in out)
+                self._stats["gen_seconds"] += dt
+            return out
+
         # Spec decode needs a gamma-token margin in the cache; requests
         # without it (or sampled ones) take the plain path instead.
         if (self._draft is not None and temperature == 0.0
@@ -713,7 +751,8 @@ def make_app(server: InferenceServer):
                         max_new_tokens=req.get("max_new_tokens", 32),
                         temperature=req.get("temperature", 0.0),
                         top_k=req.get("top_k"),
-                        eos_id=req.get("eos_id"))
+                        eos_id=req.get("eos_id"),
+                        num_samples=req.get("num_samples", 1))
                     self._send(200, {"tokens": tokens})
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:

@@ -304,13 +304,21 @@ def test_engine_mixed_sampling_params_concurrently():
     try:
         engine.submit([[1, 2]], max_new_tokens=2)  # warm
         results = {}
-        t = threading.Thread(target=lambda: results.update(
-            sampled=engine.submit([[9, 10, 11]], max_new_tokens=24,
-                                  temperature=1.0, top_k=8)[0]))
+
+        def run_sampled():
+            try:
+                results["sampled"] = engine.submit(
+                    [[9, 10, 11]], max_new_tokens=24, temperature=1.0,
+                    top_k=8)[0]
+            except Exception as e:  # noqa: BLE001 — surface in the assert
+                results["error"] = e
+
+        t = threading.Thread(target=run_sampled)
         t.start()
         greedy = engine.submit([[5, 6, 7]], max_new_tokens=6)[0]
         t.join(120)
         assert greedy == _solo(model, params, [5, 6, 7], 6)
+        assert "error" not in results, results.get("error")
         s = results["sampled"]
         assert len(s) == 24
         assert all(0 <= tok < model.config.vocab_size for tok in s)
@@ -350,4 +358,61 @@ def test_chunked_prefill_with_int8_kv_cache():
         assert a == b, "chunked admission must not change int8-KV decode"
     finally:
         engine.close()
+        plain.close()
+
+
+def test_submit_samples_shared_prefix():
+    """One prefill, n rows: greedy samples are all the solo continuation;
+    sampled rows are valid and (statistically) diverge."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=4)
+    try:
+        sol = _solo(model, params, [5, 6, 7], 6)
+        greedy = engine.submit_samples([5, 6, 7], 3, max_new_tokens=6,
+                                       temperature=0.0)
+        assert greedy == [sol, sol, sol]
+        sampled = engine.submit_samples([5, 6, 7], 4, max_new_tokens=16,
+                                        temperature=1.0)
+        assert len(sampled) == 4
+        assert all(len(s) == 16 for s in sampled)
+        assert all(0 <= t < model.config.vocab_size
+                   for s in sampled for t in s)
+        assert len({tuple(s) for s in sampled}) > 1, (
+            "independent sampling noise should diverge the rows")
+    finally:
+        engine.close()
+
+
+def test_submit_samples_chunked_prefill():
+    model, params = _model_and_params(max_seq_len=64)
+    engine = GenerateEngine(model, params, slots=4, chunk_prefill=8)
+    try:
+        prompt = list(range(1, 20))
+        sol = _solo(model, params, prompt, 4)
+        greedy = engine.submit_samples(prompt, 2, max_new_tokens=4,
+                                       temperature=0.0)
+        assert greedy == [sol, sol]
+    finally:
+        engine.close()
+
+
+def test_server_num_samples_routes():
+    from k3stpu.serve.server import InferenceServer
+
+    eng = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                          batch_window_ms=0.0, continuous_batching=True,
+                          engine_slots=4, shard_devices=1)
+    plain = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                            batch_window_ms=0.0, shard_devices=1)
+    try:
+        for server in (eng, plain):
+            out = server.generate_tokens([[3, 4, 5]], max_new_tokens=4,
+                                         temperature=1.0, num_samples=3)
+            assert len(out) == 3 and all(len(r) == 4 for r in out)
+        import pytest as _pt
+        with _pt.raises(ValueError, match="num_samples"):
+            eng.generate_tokens([[1, 2], [3, 4]], max_new_tokens=2,
+                                num_samples=2)
+    finally:
+        eng.close()
         plain.close()
