@@ -54,6 +54,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--holdout-fraction", type=float, default=0.05)
     ap.add_argument("--profile-port", type=int, default=0,
                     help="jax.profiler.start_server port (0 = off)")
+    ap.add_argument("--compilation-cache", default=None, metavar="DIR",
+                    help="persistent XLA compilation cache (volume "
+                         "mount): a restarted/resumed Job pod skips "
+                         "recompiling the train step")
     args = ap.parse_args(argv)
 
     from k3stpu.parallel.distributed import initialize
@@ -63,6 +67,11 @@ def main(argv: "list[str] | None" = None) -> int:
     import jax
     import jax.numpy as jnp
     import optax
+
+    if args.compilation_cache:
+        jax.config.update("jax_compilation_cache_dir",
+                          args.compilation_cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
     if args.profile_port:
         # Tracing hook (SURVEY.md §5): connect tensorboard's profile plugin

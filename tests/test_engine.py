@@ -416,3 +416,30 @@ def test_server_num_samples_routes():
     finally:
         eng.close()
         plain.close()
+
+
+def test_decode_failure_fails_requests_and_engine_recovers(monkeypatch):
+    """A device-side decode failure must fail every in-flight request
+    cleanly (no hang, no stuck slots) and leave the engine serviceable."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm + sanity
+
+        real = engine._decode_step
+        calls = {"n": 0}
+
+        def boom(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise RuntimeError("injected decode failure")
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(engine, "_decode_step", boom)
+        with pytest.raises(RuntimeError, match="injected"):
+            engine.submit([[5, 6, 7]], max_new_tokens=8)
+        # Slots freed, loop alive: the next request succeeds.
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
