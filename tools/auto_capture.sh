@@ -1,0 +1,40 @@
+#!/bin/bash
+# Poll the device tunnel; on the first healthy window, run the round's
+# remaining artifact captures exactly once. Survives the shell that
+# launched it (run with nohup/setsid). All chip work stays inside
+# capture_artifacts.py's bounded, group-killed subprocesses.
+#
+#   nohup tools/auto_capture.sh 3 "probe,tune,serve" \
+#       > /tmp/auto_capture.log 2>&1 & disown
+#
+ROUND="${1:-3}"
+STAGES="${2:-probe,tune,serve}"
+MARKER="/tmp/auto_capture_done_r${ROUND}"
+cd "$(dirname "$0")/.." || exit 1
+
+[ -e "$MARKER" ] && { echo "already captured (rm $MARKER to redo)"; exit 0; }
+
+for i in $(seq 1 200); do
+  out=$(timeout 170 python - <<'PY' 2>/dev/null
+from k3stpu.utils.subproc import run_bounded
+import sys
+rc, _, _ = run_bounded([sys.executable, "-c",
+    "import jax, jax.numpy as jnp; "
+    "x = jnp.ones((256, 256), jnp.bfloat16); print(float((x @ x).sum()))"],
+    150)
+print("HEALTHY" if rc == 0 else "WEDGED")
+PY
+)
+  echo "$(date -u +%H:%M:%S) $out (poll $i)"
+  if [ "$out" = "HEALTHY" ]; then
+    echo "$(date -u +%H:%M:%S) tunnel healthy -> capturing stages: $STAGES"
+    python tools/capture_artifacts.py --round "$ROUND" --stages "$STAGES"
+    rc=$?
+    echo "$(date -u +%H:%M:%S) capture exited rc=$rc"
+    touch "$MARKER"
+    exit "$rc"
+  fi
+  sleep 120
+done
+echo "gave up after 200 polls"
+exit 1
