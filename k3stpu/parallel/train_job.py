@@ -42,6 +42,16 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="rematerialize block activations in the backward "
                          "(fits deeper/longer configs in HBM at ~1 extra "
                          "forward of FLOPs)")
+    ap.add_argument("--grad-accum", type=int, default=1,
+                    help="accumulate gradients over N micro-steps before "
+                         "one optimizer update (effective batch = batch*N "
+                         "without the activation memory of batch*N)")
+    ap.add_argument("--lr", type=float, default=3e-4)
+    ap.add_argument("--warmup-steps", type=int, default=0,
+                    help="linear LR warmup; with --lr-schedule cosine the "
+                         "LR then decays to 10%% of peak by --steps")
+    ap.add_argument("--lr-schedule", choices=["constant", "cosine"],
+                    default="constant")
     ap.add_argument("--data", default=None,
                     help="token corpus file (k3stpu.data.corpus format, "
                          "e.g. a volume mount); omit for synthetic batches")
@@ -108,9 +118,28 @@ def main(argv: "list[str] | None" = None) -> int:
         "process_id": rdv.process_id, "num_processes": rdv.num_processes,
     }), flush=True)
 
+    # LR schedule: optimizer updates tick once per --grad-accum
+    # micro-steps (MultiSteps), so schedule horizons count UPDATES.
+    n_updates = max(1, args.steps // args.grad_accum)
+    if args.lr_schedule == "cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=args.lr,
+            warmup_steps=args.warmup_steps,
+            decay_steps=n_updates, end_value=0.1 * args.lr)
+    elif args.warmup_steps:
+        lr = optax.linear_schedule(0.0, args.lr, args.warmup_steps)
+    else:
+        lr = args.lr
+    optimizer = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    if args.grad_accum > 1:
+        # Gradient accumulation: grads sum across micro-steps on device;
+        # params move every N-th call — batch*N effective batch with
+        # batch-sized activation memory.
+        optimizer = optax.MultiSteps(optimizer,
+                                     every_k_schedule=args.grad_accum)
     bundle = make_train_bundle(
         model, mesh, example_input=jnp.zeros((1, seq), jnp.int32),
-        optimizer=optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1),
+        optimizer=optimizer,
     )
 
     start_step = 0

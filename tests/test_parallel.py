@@ -60,3 +60,56 @@ def test_batch_divisibility_validated():
         model, mesh, example_input=jnp.zeros((1, 8, 8, 3), jnp.float32))
     with pytest.raises(ValueError, match="not divisible"):
         bundle.run(jnp.zeros((6, 8, 8, 3)), jnp.zeros((6,), jnp.int32))
+
+
+def test_grad_accumulation_updates_every_k():
+    """optax.MultiSteps through the sharded bundle: grads accumulate for
+    k micro-steps, params move only on the k-th."""
+    import optax
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+    from k3stpu.parallel.mesh import make_mesh
+    from k3stpu.parallel.train import make_train_bundle, synth_token_batch
+
+    model = transformer_lm_tiny()
+    mesh = make_mesh(4, model_parallelism=2)
+    tx = optax.MultiSteps(optax.sgd(0.1), every_k_schedule=2)
+    bundle = make_train_bundle(
+        model, mesh, example_input=jnp.zeros((1, 16), jnp.int32),
+        optimizer=tx)
+    p0 = jax.tree.map(lambda x: np.asarray(x).copy(), bundle.params)
+    x, y = synth_token_batch(jax.random.key(0), 4, 16,
+                             model.config.vocab_size)
+    bundle.run(x, y)
+    p1 = jax.tree.map(lambda x: np.asarray(x), bundle.params)
+    same = all(np.array_equal(a, b) for a, b in zip(
+        jax.tree.leaves(p0), jax.tree.leaves(p1)))
+    assert same, "params must not move on an accumulation micro-step"
+    bundle.run(x, y)
+    p2 = jax.tree.map(lambda x: np.asarray(x), bundle.params)
+    moved = any(not np.array_equal(a, b) for a, b in zip(
+        jax.tree.leaves(p0), jax.tree.leaves(p2)))
+    assert moved, "params must move on the k-th micro-step"
+
+
+def test_train_job_grad_accum_and_cosine_cli(tmp_path):
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.parallel.train_job",
+         "--steps", "4", "--grad-accum", "2", "--lr-schedule", "cosine",
+         "--warmup-steps", "1"],
+        env=env, capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr[-2000:]
+    events = [json.loads(l) for l in out.stdout.splitlines()]
+    assert sum(e["event"] == "step" for e in events) == 4
