@@ -24,9 +24,11 @@ first serving choices:
 Endpoints:
   GET  /healthz         -> {"ok": true, "devices": [...]}   (readiness)
   GET  /v1/models       -> model card
+  GET  /metrics         -> Prometheus counters (scrape surface)
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
   POST /v1/generate     -> {"prompt_tokens": [[...]], "max_new_tokens": N,
-                            "temperature": t, "top_k": k, "eos_id": e}
+                            "temperature": t, "top_k": k, "eos_id": e,
+                            "num_samples": n}
                         -> {"tokens": [[...]]}  (LM families only;
                            KV-cache prefill + lax.scan decode)
 
@@ -655,6 +657,50 @@ class InferenceServer:
         with self._lock:
             return self._stats["seconds"] + self._stats["gen_seconds"]
 
+    def prometheus_metrics(self) -> str:
+        """Prometheus text exposition of the live counters — the
+        K8s-native scrape surface (a ServiceMonitor against the Service
+        port replaces reading /v1/models by hand). Counters only; rates
+        are the scraper's job."""
+        with self._lock:
+            s = dict(self._stats)
+        lines = [
+            "# TYPE k3stpu_predict_requests_total counter",
+            f"k3stpu_predict_requests_total {s['requests']}",
+            "# TYPE k3stpu_predict_examples_total counter",
+            f"k3stpu_predict_examples_total {s['examples']}",
+            "# TYPE k3stpu_predict_dispatches_total counter",
+            f"k3stpu_predict_dispatches_total {s['dispatches']}",
+            "# TYPE k3stpu_predict_device_seconds_total counter",
+            f"k3stpu_predict_device_seconds_total {s['seconds']:.6f}",
+            "# TYPE k3stpu_generate_requests_total counter",
+            f"k3stpu_generate_requests_total {s['gen_requests']}",
+            "# TYPE k3stpu_generate_tokens_total counter",
+            f"k3stpu_generate_tokens_total {s['tokens']}",
+            "# TYPE k3stpu_generate_device_seconds_total counter",
+            f"k3stpu_generate_device_seconds_total {s['gen_seconds']:.6f}",
+        ]
+        if self._engine is not None:
+            e = self._engine.stats()
+            lines += [
+                "# TYPE k3stpu_engine_decode_steps_total counter",
+                f"k3stpu_engine_decode_steps_total {e['steps']}",
+                "# TYPE k3stpu_engine_tokens_total counter",
+                f"k3stpu_engine_tokens_total {e['tokens']}",
+                "# TYPE k3stpu_engine_busy_seconds_total counter",
+                f"k3stpu_engine_busy_seconds_total {e['busy_s']:.6f}",
+            ]
+        if self._draft is not None:
+            with self._lock:
+                sp = dict(self._spec_stats)
+            lines += [
+                "# TYPE k3stpu_spec_proposed_total counter",
+                f"k3stpu_spec_proposed_total {sp['proposed']}",
+                "# TYPE k3stpu_spec_accepted_total counter",
+                f"k3stpu_spec_accepted_total {sp['accepted']}",
+            ]
+        return "\n".join(lines) + "\n"
+
     def _spec_card(self) -> "dict | None":
         if self._draft is None:
             return None
@@ -738,6 +784,14 @@ def make_app(server: InferenceServer):
                                  "devices": [str(d) for d in jax.devices()]})
             elif self.path == "/v1/models":
                 self._send(200, server.model_card())
+            elif self.path == "/metrics":
+                body = server.prometheus_metrics().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 

@@ -380,3 +380,28 @@ def test_serve_rejects_wrong_architecture_checkpoint(tmp_path):
     with pytest.raises(ValueError, match="architecture|shape"):
         InferenceServer(model_name="transformer-tiny", seq_len=16,
                         ckpt_dir=str(tmp_path))
+
+
+def test_prometheus_metrics_endpoint():
+    import urllib.request
+
+    from k3stpu.serve.server import InferenceServer, make_app
+    from http.server import ThreadingHTTPServer
+    import threading as _th
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16,
+                             batch_window_ms=0.0, shard_devices=1)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    _th.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        server.predict(np.zeros((2, 16), np.int32))
+        url = f"http://127.0.0.1:{httpd.server_address[1]}/metrics"
+        with urllib.request.urlopen(url, timeout=60) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            body = r.read().decode()
+        assert "k3stpu_predict_examples_total 2" in body
+        assert "# TYPE k3stpu_predict_requests_total counter" in body
+        assert "k3stpu_generate_tokens_total 0" in body
+    finally:
+        httpd.shutdown()
+        server.close()
