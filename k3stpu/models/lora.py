@@ -1,0 +1,110 @@
+"""LoRA: low-rank adaptation for parameter-efficient fine-tuning.
+
+The reference has no training story at all (SURVEY.md §2c); the TPU stack
+trains, and the fine-tune-a-big-base workflow everyone actually runs is
+LoRA: freeze the base kernels, train two skinny matrices per projection
+(``delta W = B A * alpha/r``). On a v5e the payoff is memory — AdamW
+state exists only for the adapters, so a model whose full fine-tune would
+blow 16 GB trains in nearly the footprint of inference.
+
+Note on bytes: the win is OPTIMIZER-STATE memory (AdamW moments exist
+only for the adapters — the HBM that decides whether a fine-tune fits a
+16 GB chip); train_job checkpoints still save the full bundle so resume
+stays one code path.
+
+Three pieces:
+- :class:`LoraDense` — the projection module ``cfg.lora_rank`` selects
+  (transformer.py `_proj`): base ``kernel`` (same leaf path as
+  ``nn.Dense``, so base checkpoints restore into it directly) plus
+  ``lora_a`` (in, r) and ``lora_b`` (r, out), B zero-initialized — a
+  fresh LoRA model computes exactly its base.
+- :func:`lora_label_tree` / :func:`lora_optimizer` — the frozen-base
+  training mask (optax.multi_transform: adapters train, everything else
+  is ``set_to_zero``).
+- :func:`merge_lora_params` — fold ``kernel + B A * alpha/r`` back into
+  plain Dense trees for serving (compose with models/quant.py after).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax import linen as nn
+
+LORA_LEAVES = ("lora_a", "lora_b")
+# One alpha for the forward AND the merge — desynced values would fold a
+# wrong fraction of the learned delta into served kernels.
+LORA_ALPHA = 16.0
+
+
+class LoraDense(nn.Module):
+    """Bias-free Dense with a trainable low-rank delta.
+
+    ``y = x W + (x A) B * (alpha / rank)`` — W frozen by the optimizer
+    mask, A/B trainable. alpha follows the common convention of scaling
+    the delta independently of rank.
+    """
+
+    features: int
+    rank: int
+    dtype: object = jnp.bfloat16
+    alpha: float = LORA_ALPHA
+
+    @nn.compact
+    def __call__(self, x):
+        in_features = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (in_features, self.features), jnp.float32)
+        a = self.param("lora_a", nn.initializers.lecun_normal(),
+                       (in_features, self.rank), jnp.float32)
+        b = self.param("lora_b", nn.initializers.zeros,
+                       (self.rank, self.features), jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        delta = jnp.dot(jnp.dot(x.astype(self.dtype), a.astype(self.dtype)),
+                        b.astype(self.dtype))
+        return y + delta * (self.alpha / self.rank)
+
+
+def lora_label_tree(params) -> dict:
+    """'train' on adapter leaves, 'freeze' everywhere else — the
+    param_labels tree for optax.multi_transform."""
+    return jax.tree_util.tree_map_with_path(
+        lambda p, _: ("train"
+                      if getattr(p[-1], "key", None) in LORA_LEAVES
+                      else "freeze"),
+        params)
+
+
+def lora_optimizer(inner: "optax.GradientTransformation"
+                   ) -> "optax.GradientTransformation":
+    """Frozen-base LoRA training: ``inner`` updates the adapters, every
+    other leaf gets a zero update (and, under adamw, no optimizer state
+    worth the bytes — set_to_zero keeps none). param_labels is the
+    labeling FUNCTION, so this composes before the params exist."""
+    return optax.multi_transform(
+        {"train": inner, "freeze": optax.set_to_zero()},
+        param_labels=lora_label_tree)
+
+
+def merge_lora_params(params: dict, *,
+                      alpha: float = LORA_ALPHA) -> dict:
+    """Fold every adapter pair into its kernel: the resulting tree matches
+    the BASE (lora_rank=None) model's init exactly — ready for plain
+    serving, tensor-parallel sharding, or int8 quantization."""
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if set(LORA_LEAVES) <= set(tree) and "kernel" in tree:
+            a, b = tree["lora_a"], tree["lora_b"]
+            rank = a.shape[-1]
+            merged = (tree["kernel"].astype(jnp.float32)
+                      + (a.astype(jnp.float32) @ b.astype(jnp.float32))
+                      * (alpha / rank))
+            rest = {k: v for k, v in tree.items()
+                    if k not in (*LORA_LEAVES, "kernel")}
+            return {"kernel": merged, **{k: walk(v) for k, v in rest.items()}}
+        return {k: walk(v) for k, v in tree.items()}
+
+    return walk(params)

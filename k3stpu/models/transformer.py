@@ -60,6 +60,12 @@ class TransformerConfig:
     # context length x batch a serving chip can hold; the dequant fuses
     # into the decode attention's operand read. Orthogonal to `quant`.
     kv_cache_dtype: "str | None" = None
+    # None | int: LoRA rank. Adds trainable low-rank adapters (lora_a,
+    # lora_b) beside every projection kernel; models/lora.py provides the
+    # frozen-base optimizer mask and the merge-for-serving transform.
+    # B initializes to zero, so a fresh LoRA model computes exactly its
+    # base model until the adapters train.
+    lora_rank: "int | None" = None
     # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
     # (ops/attention.py) on TPU: single-device always; under a multi-device
     # mesh too for MHA, where the kernel's custom_partitioning rule lets
@@ -90,14 +96,23 @@ def _resolve_attn_impl(impl: str, mha: bool = False) -> str:
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
     """Projection Dense — float by default, int8 weight-only under
-    cfg.quant (same module path, different leaf names; models/quant.py
-    converts between the trees)."""
+    cfg.quant, low-rank-adapted under cfg.lora_rank (same module path;
+    models/quant.py and models/lora.py convert between the trees)."""
     if cfg.quant == "int8":
+        if cfg.lora_rank is not None:
+            raise ValueError("quant and lora_rank are exclusive: merge "
+                             "the adapters first (models/lora.py), then "
+                             "quantize the merged tree")
         from k3stpu.models.quant import QuantDense
 
         return QuantDense(features, dtype=cfg.dtype, name=name)
     if cfg.quant is not None:
         raise ValueError(f"unknown quant mode {cfg.quant!r}")
+    if cfg.lora_rank is not None:
+        from k3stpu.models.lora import LoraDense
+
+        return LoraDense(features, rank=cfg.lora_rank, dtype=cfg.dtype,
+                         name=name)
     return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name=name)
 

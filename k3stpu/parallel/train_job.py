@@ -28,6 +28,12 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--steps", type=int, default=50)
     ap.add_argument("--ckpt-dir", default=None,
                     help="checkpoint directory (volume mount); omit to disable")
+    ap.add_argument("--init-from", default=None, metavar="DIR",
+                    help="warm-start params from another run's checkpoint "
+                         "(e.g. the pretrained base for --lora-rank): "
+                         "leaves matching by path load, extras (adapters) "
+                         "keep their init; ignored when --ckpt-dir already "
+                         "has a checkpoint to resume")
     ap.add_argument("--ckpt-every", type=int, default=20)
     ap.add_argument("--batch", type=int, default=None,
                     help="global batch (default: 8 per data-shard; 16 for "
@@ -42,6 +48,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="rematerialize block activations in the backward "
                          "(fits deeper/longer configs in HBM at ~1 extra "
                          "forward of FLOPs)")
+    ap.add_argument("--lora-rank", type=int, default=None,
+                    help="LoRA fine-tuning: train only rank-N adapters "
+                         "beside each projection kernel (base frozen; "
+                         "~1%% of the parameter bytes get optimizer "
+                         "state); merge for serving with models/lora.py")
     ap.add_argument("--grad-accum", type=int, default=1,
                     help="accumulate gradients over N micro-steps before "
                          "one optimizer update (effective batch = batch*N "
@@ -103,8 +114,11 @@ def main(argv: "list[str] | None" = None) -> int:
     seq = args.seq or {"tiny": 64, "small": 512, "medium": 1024}[model_name]
     maker = {"tiny": transformer_lm_tiny, "small": transformer_lm_small,
              "medium": transformer_lm_medium}[model_name]
-    model = (transformer_lm_tiny(remat=args.remat) if model_name == "tiny"
-             else maker(max_seq_len=max(seq, 512), remat=args.remat))
+    extra = {} if args.lora_rank is None else {"lora_rank": args.lora_rank}
+    model = (transformer_lm_tiny(remat=args.remat, **extra)
+             if model_name == "tiny"
+             else maker(max_seq_len=max(seq, 512), remat=args.remat,
+                        **extra))
     # Hybrid layout across Job pods: 'model' stays on each pod's local ICI,
     # 'data' (the gradient psum) spans pods over DCN.
     mesh = make_hybrid_mesh(model_parallelism=args.model_parallelism)
@@ -131,6 +145,10 @@ def main(argv: "list[str] | None" = None) -> int:
     else:
         lr = args.lr
     optimizer = optax.adamw(lr, b1=0.9, b2=0.95, weight_decay=0.1)
+    if args.lora_rank is not None:
+        from k3stpu.models.lora import lora_optimizer
+
+        optimizer = lora_optimizer(optimizer)
     if args.grad_accum > 1:
         # Gradient accumulation: grads sum across micro-steps on device;
         # params move every N-th call — batch*N effective batch with
@@ -149,6 +167,38 @@ def main(argv: "list[str] | None" = None) -> int:
             ckpt.restore_bundle(args.ckpt_dir, last, bundle)
             start_step = last
             print(json.dumps({"event": "resume", "step": last}), flush=True)
+
+    if args.init_from and start_step == 0:
+        # Warm start: restore the params ANOTHER run saved into the leaves
+        # this bundle shares with it (LoRA adapters and any other extras
+        # keep their fresh init; optimizer state starts clean — this is a
+        # new run, not a resume). Restored leaves are re-placed with the
+        # bundle's shardings.
+        base_step = ckpt.latest_step(args.init_from)
+        if base_step is None:
+            raise ValueError(
+                f"--init-from {args.init_from}: no finalized checkpoint")
+
+        def prune(tree):
+            if isinstance(tree, dict):
+                return {k: prune(v) for k, v in tree.items()
+                        if k not in ("lora_a", "lora_b")}
+            return tree
+
+        restored = ckpt.restore_collections(
+            args.init_from, base_step,
+            {"params": prune(bundle.params)})["params"]
+
+        def graft(orig, sub):
+            if isinstance(orig, dict):
+                return {k: (graft(v, sub[k]) if k in sub else v)
+                        for k, v in orig.items()}
+            return jax.device_put(jnp.asarray(sub, orig.dtype),
+                                  orig.sharding)
+
+        bundle.params = graft(bundle.params, restored)
+        print(json.dumps({"event": "init_from", "path": args.init_from,
+                          "step": base_step}), flush=True)
 
     # MFU from the standard 6*N*T training-flop estimate (fwd+bwd matmuls;
     # attention's O(S^2) term is <10% at these shapes) against the chip's

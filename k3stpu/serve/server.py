@@ -260,7 +260,38 @@ class InferenceServer:
                     if coll in ("params", "batch_stats")}
             if not want.get("params"):
                 raise ValueError("model has no params tree to restore into")
-            state = ckpt.restore_collections(ckpt_dir, step, want)
+
+            # LoRA checkpoints (train_job --lora-rank) carry the learning
+            # in adapter leaves a base-shaped partial restore would
+            # SILENTLY DROP — serving the frozen base as if it were the
+            # fine-tune. Sniff the checkpoint's structure (metadata, no
+            # data reads), restore the adapter-shaped tree, and fold the
+            # delta into the kernels before adoption.
+            lora_rank = self._lora_rank_in(
+                ckpt.tree_metadata(ckpt_dir, step))
+            if lora_rank is not None:
+                import dataclasses
+
+                from k3stpu.models.lora import merge_lora_params
+
+                cfg = self.model.config
+                lcfg = (dataclasses.replace(
+                            cfg, base=dataclasses.replace(
+                                cfg.base, lora_rank=lora_rank))
+                        if model_name.startswith("moe")
+                        else dataclasses.replace(cfg,
+                                                 lora_rank=lora_rank))
+                lmodel = type(self.model)(lcfg)
+                lvars = lmodel.init(jax.random.key(0), example[:1],
+                                    train=False)
+                want = dict(want, params=lvars["params"])
+                state = ckpt.restore_collections(ckpt_dir, step, want)
+                state = dict(state,
+                             params=merge_lora_params(state["params"]))
+                print(f"merged rank-{lora_rank} LoRA adapters from "
+                      f"checkpoint step {step}", flush=True)
+            else:
+                state = ckpt.restore_collections(ckpt_dir, step, want)
 
             def adopt(init, new):
                 new = jnp.asarray(new, init.dtype)
@@ -656,6 +687,20 @@ class InferenceServer:
     def busy_seconds(self) -> float:
         with self._lock:
             return self._stats["seconds"] + self._stats["gen_seconds"]
+
+    @staticmethod
+    def _lora_rank_in(meta_tree) -> "int | None":
+        """Rank of the first lora_a leaf in a checkpoint metadata tree
+        (None when the checkpoint carries no adapters)."""
+        if isinstance(meta_tree, dict):
+            a = meta_tree.get("lora_a")
+            if a is not None and hasattr(a, "shape"):
+                return int(a.shape[-1])
+            for v in meta_tree.values():
+                r = InferenceServer._lora_rank_in(v)
+                if r is not None:
+                    return r
+        return None
 
     def prometheus_metrics(self) -> str:
         """Prometheus text exposition of the live counters — the

@@ -108,6 +108,19 @@ def restore_collections(directory: str | pathlib.Path, step: int,
         item=target, partial_restore=True))
 
 
+def tree_metadata(directory: str | pathlib.Path, step: int):
+    """The checkpoint's nested structure (shapes/dtypes, NO data reads) —
+    how consumers detect what a checkpoint actually contains (e.g. the
+    server sniffing LoRA adapter leaves before choosing a restore
+    target)."""
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(directory).resolve() / str(step)
+    ckptr = ocp.Checkpointer(ocp.PyTreeCheckpointHandler())
+    md = ckptr.metadata(path)
+    return md.item_metadata.tree if hasattr(md, "item_metadata") else md.tree
+
+
 def latest_step(directory: str | pathlib.Path) -> int | None:
     """Highest step with a *finalized* checkpoint under ``directory``.
 

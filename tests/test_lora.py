@@ -1,0 +1,174 @@
+"""LoRA fine-tuning (k3stpu/models/lora.py).
+
+Invariants: a fresh LoRA model computes exactly its base (B is zero);
+frozen-base training moves ONLY the adapters; merging folds the learned
+delta into plain Dense trees that the base config serves unchanged.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from k3stpu.models.lora import (
+    lora_label_tree,
+    lora_optimizer,
+    merge_lora_params,
+)
+from k3stpu.models.transformer import transformer_lm_tiny
+
+
+def _base_and_lora(rank=4):
+    base = transformer_lm_tiny(max_seq_len=32)
+    lora = type(base)(dataclasses.replace(base.config, lora_rank=rank))
+    bvars = base.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                      train=False)
+    lvars = lora.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                      train=False)
+
+    # Graft the base kernels into the LoRA tree (same module paths).
+    def graft(lt, bt):
+        if isinstance(lt, dict):
+            out = {}
+            for k, v in lt.items():
+                out[k] = v if k in ("lora_a", "lora_b") else graft(
+                    v, bt[k])
+            return out
+        return bt
+
+    lparams = graft(lvars["params"], bvars["params"])
+    return base, bvars["params"], lora, lparams
+
+
+def test_fresh_lora_equals_base():
+    base, bparams, lora, lparams = _base_and_lora()
+    toks = jax.random.randint(jax.random.key(1), (2, 16), 0,
+                              base.config.vocab_size)
+    ref = base.apply({"params": bparams}, toks, train=False)
+    out = lora.apply({"params": lparams}, toks, train=False)
+    assert jnp.allclose(out, ref, atol=1e-4), (
+        float(jnp.max(jnp.abs(out - ref))))
+
+
+def test_frozen_base_training_moves_only_adapters():
+    _, _, lora, lparams = _base_and_lora()
+    tx = lora_optimizer(optax.sgd(0.5))
+    state = tx.init(lparams)
+    toks = jax.random.randint(jax.random.key(2), (2, 16), 0,
+                              lora.config.vocab_size)
+    labels = jax.random.randint(jax.random.key(3), (2, 16), 0,
+                                lora.config.vocab_size)
+
+    def loss(p):
+        logits = lora.apply({"params": p}, toks, train=False)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], -1))
+
+    l0 = float(loss(lparams))
+    p = lparams
+    for _ in range(3):
+        grads = jax.grad(loss)(p)
+        updates, state = tx.update(grads, state, p)
+        p = optax.apply_updates(p, updates)
+    l1 = float(loss(p))
+    assert l1 < l0, f"LoRA training did not reduce loss ({l0} -> {l1})"
+
+    labels_tree = lora_label_tree(lparams)
+    flat0 = jax.tree_util.tree_flatten_with_path(lparams)[0]
+    flat1 = jax.tree_util.tree_flatten_with_path(p)[0]
+    lbls = jax.tree_util.tree_flatten_with_path(labels_tree)[0]
+    moved_adapters = frozen_moved = 0
+    for (path, v0), (_, v1), (_, lab) in zip(flat0, flat1, lbls):
+        changed = not np.array_equal(np.asarray(v0), np.asarray(v1))
+        if lab == "train":
+            moved_adapters += changed
+        else:
+            frozen_moved += changed
+    assert frozen_moved == 0, "a frozen base leaf moved"
+    assert moved_adapters > 0, "no adapter moved"
+
+
+def test_merge_serves_through_base_config():
+    base, _, lora, lparams = _base_and_lora()
+    # Train-free but non-trivial delta: poke lora_b away from zero.
+    lparams = jax.tree_util.tree_map_with_path(
+        lambda pth, x: (x + 0.01 if getattr(pth[-1], "key", "") == "lora_b"
+                        else x), lparams)
+    toks = jax.random.randint(jax.random.key(4), (2, 16), 0,
+                              base.config.vocab_size)
+    ref = lora.apply({"params": lparams}, toks, train=False)
+
+    merged = merge_lora_params(lparams)
+    flat_m = jax.tree_util.tree_flatten_with_path(merged)[0]
+    base_init = base.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                          train=False)["params"]
+    flat_b = jax.tree_util.tree_flatten_with_path(base_init)[0]
+    assert [(p, v.shape) for p, v in flat_m] == \
+           [(p, v.shape) for p, v in flat_b], "merged tree != base tree"
+
+    out = base.apply({"params": merged}, toks, train=False)
+    # bf16 path difference: the LoRA model rounds x@A@B separately, the
+    # merged kernel rounds once — O(1e-1) absolute on O(1) logits.
+    assert jnp.allclose(out, ref, atol=1e-1), (
+        float(jnp.max(jnp.abs(out - ref))))
+
+
+def test_quant_and_lora_are_exclusive():
+    base = transformer_lm_tiny(max_seq_len=32)
+    bad = type(base)(dataclasses.replace(base.config, lora_rank=4,
+                                         quant="int8"))
+    with pytest.raises(ValueError, match="merge"):
+        bad.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                 train=False)
+
+
+def test_pretrain_finetune_serve_loop(tmp_path):
+    """The full workflow: base pretrain -> LoRA fine-tune warm-started
+    from it (--init-from) -> serve the LoRA checkpoint, whose adapters
+    the server detects and MERGES (not silently drops)."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo, env.get("PYTHONPATH")) if p)
+
+    def run(extra):
+        out = subprocess.run(
+            [sys.executable, "-m", "k3stpu.parallel.train_job",
+             "--steps", "2", "--ckpt-every", "2", *extra],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert out.returncode == 0, out.stderr[-2000:]
+        return [json.loads(l) for l in out.stdout.splitlines()]
+
+    base_dir, lora_dir = str(tmp_path / "base"), str(tmp_path / "lora")
+    run(["--ckpt-dir", base_dir])
+    events = run(["--ckpt-dir", lora_dir, "--lora-rank", "4",
+                  "--init-from", base_dir])
+    assert any(e["event"] == "init_from" for e in events)
+
+    from k3stpu.serve.server import InferenceServer
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                             batch_window_ms=0.0, shard_devices=1,
+                             ckpt_dir=lora_dir)
+    try:
+        assert server.loaded_step == 2
+        # Served tree is the BASE structure (adapters folded in).
+        flat = jax.tree_util.tree_flatten_with_path(
+            server._variables["params"])[0]
+        leaf_names = {getattr(p[-1], "key", "") for p, _ in flat}
+        assert "lora_a" not in leaf_names
+        out = server.predict(np.zeros((1, 64), np.int32))
+        assert np.all(np.isfinite(out))
+    finally:
+        server.close()
