@@ -112,7 +112,7 @@ class MoeMlp(nn.Module):
         # base.quant the experts store int8 with per-(expert, out-channel)
         # scales — kept rank-3 (E, 1, out) so the rank-based sharding rule
         # splits them over 'model' WITH the experts, like the kernels.
-        if base.quant == "int8":
+        if base.quant in ("int8", "int8-dynamic"):
             w_in8 = self.param("w_in_int8", nn.initializers.zeros,
                                (e, d, base.d_ff), jnp.int8)
             w_in_s = self.param("w_in_scale", nn.initializers.ones,

@@ -42,10 +42,18 @@ class QuantDense(nn.Module):
     produced by :func:`quantize_lm_params`, not by training. ``init`` gives
     zeros/ones so shape-inference paths (server boot before checkpoint
     adoption) still trace.
+
+    ``dynamic_act=True`` (the "int8-dynamic" / W8A8 mode) additionally
+    quantizes the ACTIVATIONS per token at run time and runs the matmul
+    as int8 x int8 -> int32 — the MXU's int8 path has 2x the bf16 peak
+    (394 vs 197 TOPS on v5e), so compute-bound shapes (prefill, batched
+    predict) get faster, not just less HBM-bound. The fp32 rescale
+    (per-token x per-channel) fuses into the dot's epilogue.
     """
 
     features: int
     dtype: Any = jnp.bfloat16
+    dynamic_act: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -54,8 +62,16 @@ class QuantDense(nn.Module):
                         (in_features, self.features), jnp.int8)
         scale = self.param("scale", nn.initializers.ones,
                            (self.features,), jnp.float32)
-        # Dequant in fp32 then cast: the int8 stays the HBM-resident form;
-        # XLA fuses convert+scale into the matmul's weight read.
+        if self.dynamic_act:
+            x8, xs = quantize_absmax(x, axis=-1)      # per-token absmax
+            y32 = jax.lax.dot_general(
+                x8, w8, (((x8.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            y = (y32.astype(jnp.float32)
+                 * xs[..., None] * scale[None, :])
+            return y.astype(self.dtype)
+        # Weight-only: dequant in fp32 then cast — the int8 stays the
+        # HBM-resident form; XLA fuses convert+scale into the weight read.
         w = (w8.astype(jnp.float32) * scale[None, :]).astype(self.dtype)
         return jnp.dot(x.astype(self.dtype), w)
 

@@ -50,10 +50,12 @@ class TransformerConfig:
     # O(n_layers) less activation HBM — how long-sequence/deep configs fit
     # on a 16 GB v5e. Parameter tree is unchanged (lifted transform).
     remat: bool = False
-    # None | "int8": weight-only int8 on the four projection kernels
-    # (models/quant.py) — the serving form for bandwidth-bound decode.
-    # Inference-only: params come from quantize_lm_params on a trained
-    # float tree, never from training this config directly.
+    # None | "int8" | "int8-dynamic": int8 projection kernels
+    # (models/quant.py) — the serving form. "int8" is weight-only
+    # (halves weight HBM traffic; decode lever); "int8-dynamic" (W8A8)
+    # also quantizes activations per token and runs int8 x int8 on the
+    # MXU's double-rate path (prefill/predict lever). Inference-only:
+    # params come from quantize_lm_params on a trained float tree.
     quant: "str | None" = None
     # None | "int8": KV-cache storage dtype. int8 + one fp32 scale per
     # (token, kv-head) halves the cache's HBM footprint — the ceiling on
@@ -98,14 +100,15 @@ def _proj(cfg: TransformerConfig, features: int, name: str):
     """Projection Dense — float by default, int8 weight-only under
     cfg.quant, low-rank-adapted under cfg.lora_rank (same module path;
     models/quant.py and models/lora.py convert between the trees)."""
-    if cfg.quant == "int8":
+    if cfg.quant in ("int8", "int8-dynamic"):
         if cfg.lora_rank is not None:
             raise ValueError("quant and lora_rank are exclusive: merge "
                              "the adapters first (models/lora.py), then "
                              "quantize the merged tree")
         from k3stpu.models.quant import QuantDense
 
-        return QuantDense(features, dtype=cfg.dtype, name=name)
+        return QuantDense(features, dtype=cfg.dtype, name=name,
+                          dynamic_act=cfg.quant == "int8-dynamic")
     if cfg.quant is not None:
         raise ValueError(f"unknown quant mode {cfg.quant!r}")
     if cfg.lora_rank is not None:

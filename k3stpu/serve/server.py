@@ -907,7 +907,8 @@ def main(argv=None) -> int:
                     help="expose jax.profiler.start_server on this port "
                          "(0 = off); capture with jax.profiler.trace or "
                          "tensorboard's profile plugin")
-    ap.add_argument("--quant", default=None, choices=["int8"],
+    ap.add_argument("--quant", default=None,
+                    choices=["int8", "int8-dynamic"],
                     help="weight-only int8 serving (transformer LM family):"
                          " projection kernels stored int8 + per-channel "
                          "scales — halves weight HBM traffic for "

@@ -235,3 +235,36 @@ def test_server_moe_quant_generate():
         assert card["quant"]["param_bytes"] < card["quant"]["float_param_bytes"]
     finally:
         server.close()
+
+
+# --- W8A8 dynamic activation quantization -----------------------------------
+
+
+def test_dynamic_quant_forward_tracks_float():
+    model, variables = _float_model_and_params()
+    qmodel = type(model)(dataclasses.replace(model.config,
+                                             quant="int8-dynamic"))
+    qparams = quantize_lm_params(variables["params"])  # same tree as int8
+    tokens = jax.random.randint(jax.random.key(5), (2, 16), 0,
+                                model.config.vocab_size)
+    ref = model.apply(variables, tokens, train=False)
+    out = qmodel.apply({"params": qparams}, tokens, train=False)
+    assert out.shape == ref.shape and bool(jnp.all(jnp.isfinite(out)))
+    # W8A8 adds per-token activation error on top of weight error.
+    err = float(jnp.max(jnp.abs(out - ref)))
+    span = float(jnp.max(jnp.abs(ref))) + 1e-6
+    assert err / span < 0.25, f"W8A8 drift {err:.4f} vs span {span:.4f}"
+
+
+def test_server_dynamic_quant_generate():
+    from k3stpu.serve.server import InferenceServer
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=16,
+                             batch_window_ms=0.0, quant="int8-dynamic",
+                             shard_devices=1)
+    try:
+        toks = server.generate_tokens([[3, 4, 5]], max_new_tokens=4)
+        assert len(toks) == 1 and len(toks[0]) == 4
+        assert server.model_card()["quant"]["mode"] == "int8-dynamic"
+    finally:
+        server.close()
