@@ -142,6 +142,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="self-hosted server runs the slot-scheduled "
                          "generate engine (the before/after comparison "
                          "for --generate-tokens load)")
+    ap.add_argument("--quant", default=None,
+                    choices=["int8", "int8-dynamic"],
+                    help="self-hosted server serves quantized weights "
+                         "(compare against the float run)")
+    ap.add_argument("--kv-cache-dtype", default=None, choices=["int8"])
     args = ap.parse_args(argv)
 
     url = args.url
@@ -160,6 +165,7 @@ def main(argv: "list[str] | None" = None) -> int:
             model_name=args.model, image_size=args.image_size,
             seq_len=args.seq_len, batch_window_ms=args.batch_window_ms,
             continuous_batching=args.continuous_batching,
+            quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=1 if args.continuous_batching else None)
         if args.generate_tokens <= 0:
             print("warming up...", flush=True)
