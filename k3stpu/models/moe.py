@@ -38,6 +38,9 @@ class MoeConfig:
     router_top_k: int = 2           # tokens dispatched to their top-k experts
     capacity_factor: float = 1.25   # C = ceil(T/E * factor * top_k)
     aux_loss_coef: float = 0.01
+    # Router z-loss (ST-MoE): penalizes large router logits — the standard
+    # fix for router logit drift/overflow in long bf16 training runs.
+    router_z_coef: float = 1e-3
     every_n_blocks: int = 2         # MoE MLP in every n-th block, dense rest
 
 
@@ -107,6 +110,12 @@ class MoeMlp(nn.Module):
         mean_gate = jnp.mean(probs, axis=0)                 # (E,)
         aux = e * jnp.sum(frac * mean_gate) * cfg.aux_loss_coef
         self.sow("losses", "router_balance", aux)
+        if cfg.router_z_coef:
+            # z-loss = mean(logsumexp(logits)^2): keeps router logits
+            # small so the fp32 softmax stays sharp and stable.
+            z = jax.nn.logsumexp(logits, axis=-1)
+            self.sow("losses", "router_z",
+                     jnp.mean(z * z) * cfg.router_z_coef)
 
         # Expert-major params: leading E shards over 'model' (EP). Under
         # base.quant the experts store int8 with per-(expert, out-channel)

@@ -254,6 +254,13 @@ class GenerateEngine:
         self._q.put(None)
         self._thread.join(timeout=60)
 
+    def reset_stats(self) -> None:
+        """Zero the counters (post-warmup: compile-dominated dispatches
+        would poison the reported tokens_per_s)."""
+        with self._lock:
+            for k in self._stats:
+                self._stats[k] = type(self._stats[k])()
+
     def stats(self) -> dict:
         with self._lock:
             s = dict(self._stats)

@@ -440,14 +440,23 @@ class InferenceServer:
     def warmup(self, batch_sizes=BATCH_SIZES):
         """Pre-compile every served batch size so first requests are fast.
 
-        Resets the stats afterwards: warmup dispatches are dominated by JIT
-        compile time and would poison the /v1/models throughput numbers
-        (which loadgen commits as the before/after artifact)."""
+        LM families also warm the generation path (prefill + decode — and
+        through it the engine/speculative programs when configured), so a
+        pod is genuinely ready when the readiness probe passes, not just
+        for /v1/predict. Resets the stats afterwards: warmup dispatches
+        are dominated by JIT compile time and would poison the /v1/models
+        throughput numbers (which loadgen commits as the artifact)."""
         for b in batch_sizes:
             self.predict(np.zeros((b, *self.input_shape()), self.input_dtype()))
+        if self.model_name.startswith(("transformer", "moe")):
+            self.generate_tokens([[1]], max_new_tokens=2)
+        if self._engine is not None:
+            self._engine.reset_stats()
         with self._lock:
             for k in self._stats:
                 self._stats[k] = type(self._stats[k])()
+            for k in self._spec_stats:
+                self._spec_stats[k] = 0
 
     def input_shape(self):
         if self.model_name.startswith("resnet"):

@@ -118,3 +118,17 @@ def test_generation_works_with_moe():
     out = generate(model, params, prompt, jnp.array([8], jnp.int32), 4)
     assert out.shape == (1, 4)
     assert int(out.max()) < 512
+
+
+def test_router_z_loss_sown_and_scales():
+    model = moe_lm_tiny(max_seq_len=32)
+    toks = jax.random.randint(jax.random.key(9), (2, 16), 0,
+                              model.config.base.vocab_size)
+    variables = model.init(jax.random.key(0), toks, train=True)
+    _, mut = model.apply(variables, toks, train=True, mutable=["losses"])
+    flat = jax.tree_util.tree_flatten_with_path(mut["losses"])[0]
+    names = {getattr(p[-2], "key", "") for p, _ in flat}
+    assert "router_z" in names and "router_balance" in names
+    z_vals = [float(v.sum()) for p, v in flat
+              if getattr(p[-2], "key", "") == "router_z"]
+    assert all(v >= 0 for v in z_vals) and any(v > 0 for v in z_vals)
