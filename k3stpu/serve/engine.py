@@ -36,6 +36,12 @@ import jax.numpy as jnp
 import numpy as np
 
 from k3stpu.models.generate import init_cache, set_cache_index
+from k3stpu.serve.programs import (
+    decode_core,
+    extend_core,
+    prefill_core,
+    prompt_width_bucket,
+)
 
 _NEG_INF = -1e30
 
@@ -135,28 +141,20 @@ class GenerateEngine:
     # --- jitted device programs (compiled once per static bucket) -------
 
     # params travel as jit ARGUMENTS (donated weights would bake into the
-    # compiled program as constants otherwise — double the HBM).
+    # compiled program as constants otherwise — double the HBM). The
+    # cache-model programs themselves are the shared cores in
+    # serve/programs.py (one definition for engine + speculative).
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _decode_step(self, params, cache, toks, temps, topks, step,
                      base_key):
-        logits, mut = self.model.apply(
-            {"params": params, "cache": cache}, toks[:, None],
-            mode="decode", mutable=["cache"])
+        cache, logits = decode_core(self.model, params, cache, toks)
         key = jax.random.fold_in(base_key, step)
-        nxt = _sample_rows(logits[:, -1].astype(jnp.float32), temps, topks,
-                           key)
-        return mut["cache"], nxt
+        return cache, _sample_rows(logits, temps, topks, key)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, block, lens):
-        cache = init_cache(self.model, block.shape[0])
-        logits, mut = self.model.apply(
-            {"params": params, "cache": cache}, block, mode="prefill",
-            seq_lens=lens, mutable=["cache"])
-        last = jnp.take_along_axis(
-            logits, (lens - 1)[:, None, None], axis=1)[:, 0]
-        return mut["cache"], last.astype(jnp.float32)
+        return prefill_core(self.model, params, block, lens)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _scatter(self, big, small, slot_ids):
@@ -164,17 +162,11 @@ class GenerateEngine:
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _extend_chunk(self, params, cache, chunk):
-        _, mut = self.model.apply(
-            {"params": params, "cache": cache}, chunk, mode="extend",
-            mutable=["cache"])
-        return mut["cache"]
+        return extend_core(self.model, params, cache, chunk)[0]
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _decode_logits(self, params, cache, toks):
-        logits, mut = self.model.apply(
-            {"params": params, "cache": cache}, toks[:, None],
-            mode="decode", mutable=["cache"])
-        return mut["cache"], logits[:, -1].astype(jnp.float32)
+        return decode_core(self.model, params, cache, toks)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _first_sample(self, last_logits, temps, topks, step, base_key):
@@ -198,7 +190,7 @@ class GenerateEngine:
         lens = [len(p) for p in prompts]
         if min(lens) == 0:
             raise ValueError("prompts must be non-empty")
-        width = min(_pow2_at_least(max(lens), 8), self.max_seq)
+        width = prompt_width_bucket(max(lens), self.max_seq)
         if max(lens) > width or width + max_new_tokens > self.max_seq:
             raise ValueError(
                 f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "

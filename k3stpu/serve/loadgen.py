@@ -167,7 +167,13 @@ def main(argv: "list[str] | None" = None) -> int:
             continuous_batching=args.continuous_batching,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=1 if args.continuous_batching else None)
-        if args.generate_tokens <= 0:
+        if args.generate_tokens > 0:
+            # Compile prefill+decode (and engine programs) BEFORE the
+            # measured window — first-request JIT would otherwise land in
+            # the committed before/after numbers.
+            print("warming up (generate path)...", flush=True)
+            server.generate_tokens([[1]], max_new_tokens=2)
+        else:
             print("warming up...", flush=True)
             # Warm only the batch sizes this load can dispatch (largest
             # coalesced batch = clients * rows, padded by the server's own

@@ -1,0 +1,56 @@
+"""Shared cache-model programs for the serving stack.
+
+ONE definition of "apply the LM against its KV cache" per mode, used by
+the continuous-batching engine and speculative decoding alike (each jits
+these cores with its own epilogue — argmax for the spec verifier, raw
+logits for the engine's sampler — so no cross-module drift in the
+prefill/decode/extend semantics is possible).
+
+Also home of the prompt-width bucket policy: server-side validation and
+engine admission MUST agree on it, or the server accepts requests the
+engine rejects.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from k3stpu.models.generate import init_cache
+
+
+def prompt_width_bucket(max_len: int, max_seq: int, floor: int = 8) -> int:
+    """Next power of two >= max_len (min ``floor``), capped at the cache —
+    the one bucket policy every generate entry point quantizes widths
+    with (bounded compiled-program set, reference of truth)."""
+    width = 1 << (max(1, max_len) - 1).bit_length()
+    return min(max(width, floor), max_seq)
+
+
+def prefill_core(model, params, block, lens):
+    """Prefill the prompt block: returns ``(cache, last_logits)`` where
+    ``last_logits[r]`` is row r's distribution at its last REAL position
+    (fp32) — the first-token source for every scheduler."""
+    cache = init_cache(model, block.shape[0])
+    logits, mut = model.apply({"params": params, "cache": cache}, block,
+                              mode="prefill", seq_lens=lens,
+                              mutable=["cache"])
+    last = jnp.take_along_axis(logits, (lens - 1)[:, None, None],
+                               axis=1)[:, 0]
+    return mut["cache"], last.astype(jnp.float32)
+
+
+def decode_core(model, params, cache, toks):
+    """One decode step for (B,) tokens: ``(cache, logits (B, V) fp32)``."""
+    logits, mut = model.apply({"params": params, "cache": cache},
+                              toks[:, None], mode="decode",
+                              mutable=["cache"])
+    return mut["cache"], logits[:, -1].astype(jnp.float32)
+
+
+def extend_core(model, params, cache, chunk):
+    """Chunk-append (B, G) tokens at per-row offsets:
+    ``(cache, logits (B, G, V) fp32)`` — logits[:, j] scores the next
+    token after chunk[:, :j+1]."""
+    logits, mut = model.apply({"params": params, "cache": cache}, chunk,
+                              mode="extend", mutable=["cache"])
+    return mut["cache"], logits.astype(jnp.float32)

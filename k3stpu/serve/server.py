@@ -187,7 +187,12 @@ class InferenceServer:
         self.model_name = model_name
         self.image_size = image_size
         self.seq_len = seq_len
+        # Two locks with distinct jobs: _lock serializes DEVICE dispatch
+        # ("one chip, one queue" — held for whole generations), while
+        # _stats_lock guards only the counters, so /metrics scrapes and
+        # /v1/models reads never stall behind an in-flight generation.
         self._lock = threading.Lock()
+        self._stats_lock = threading.Lock()
         # predict and generate keep DISJOINT counters: predict throughput
         # (examples/seconds/dispatches — the micro-batching metrics) must
         # not be diluted by generate traffic, whose cost scales with tokens.
@@ -421,6 +426,10 @@ class InferenceServer:
         self._draft = None
         self.spec_gamma = spec_gamma
         self._spec_stats = {"requests": 0, "proposed": 0, "accepted": 0}
+        if draft_model is not None and spec_gamma < 1:
+            # Fail at boot: a bad gamma would otherwise 400 every greedy
+            # generate while /healthz keeps passing.
+            raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
         if draft_model is not None:
             if not model_name.startswith("transformer"):
                 raise ValueError(
@@ -452,7 +461,7 @@ class InferenceServer:
             self.generate_tokens([[1]], max_new_tokens=2)
         if self._engine is not None:
             self._engine.reset_stats()
-        with self._lock:
+        with self._stats_lock:
             for k in self._stats:
                 self._stats[k] = type(self._stats[k])()
             for k in self._spec_stats:
@@ -485,7 +494,7 @@ class InferenceServer:
         with self._lock:  # one chip, one queue
             out = np.asarray(jax.block_until_ready(self._forward(inputs)))
         dt = time.perf_counter() - t0
-        with self._lock:
+        with self._stats_lock:
             self._stats["requests"] += n_requests
             self._stats["examples"] += n
             self._stats["dispatches"] += 1
@@ -539,6 +548,9 @@ class InferenceServer:
         num_samples = int(num_samples)
         if num_samples < 1:
             raise ValueError("num_samples must be >= 1")
+        # EVERY route honors the served maximum — the engine would happily
+        # chunk an unbounded request into hours of work otherwise.
+        served_batch(len(prompts) * num_samples)
         if num_samples > 1:
             if len(prompts) != 1:
                 raise ValueError(
@@ -556,8 +568,11 @@ class InferenceServer:
         # ever populate a small fixed set of compiled programs (same
         # reasoning as the BATCH_SIZES padding for predict()).
         lens = [len(p) for p in prompts]
-        width = 1 << (max(lens) - 1).bit_length()  # next power of two
-        width = min(max(width, 8), self.seq_len)
+        # Bucketed width: ONE policy shared with the engine's admission
+        # (serve/programs.py), so validation here == acceptance there.
+        from k3stpu.serve.programs import prompt_width_bucket
+
+        width = prompt_width_bucket(max(lens), self.seq_len)
         if max(lens) > width:
             raise ValueError(
                 f"prompt length {max(lens)} exceeds max seq {width}")
@@ -587,7 +602,7 @@ class InferenceServer:
                     temperature=temperature, top_k=top_k, eos_id=eos_id))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
-            with self._lock:
+            with self._stats_lock:
                 self._stats["gen_requests"] += 1
                 self._stats["gen_examples"] += num_samples
                 self._stats["tokens"] += sum(len(r) for r in out)
@@ -629,7 +644,7 @@ class InferenceServer:
                     hits = np.nonzero(out[r] == eos_id)[0]
                     if hits.size:
                         out[r, hits[0]:] = eos_id
-            with self._lock:
+            with self._stats_lock:
                 self._stats["gen_requests"] += 1
                 self._stats["gen_examples"] += n
                 self._stats["tokens"] += int(out.size)
@@ -654,7 +669,7 @@ class InferenceServer:
                     top_k=top_k, eos_id=eos_id))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
-            with self._lock:
+            with self._stats_lock:
                 self._stats["gen_requests"] += 1
                 self._stats["gen_examples"] += len(prompts)
                 self._stats["tokens"] += sum(len(r) for r in out)
@@ -686,7 +701,7 @@ class InferenceServer:
                 temperature=temperature, top_k=top_k, eos_id=eos_id))
         dt = time.perf_counter() - t0
         out = out[:n, :max_new_tokens]
-        with self._lock:
+        with self._stats_lock:
             self._stats["gen_requests"] += 1
             self._stats["gen_examples"] += n
             self._stats["tokens"] += int(out.size)
@@ -694,7 +709,7 @@ class InferenceServer:
         return out.tolist()
 
     def busy_seconds(self) -> float:
-        with self._lock:
+        with self._stats_lock:
             return self._stats["seconds"] + self._stats["gen_seconds"]
 
     @staticmethod
@@ -716,7 +731,7 @@ class InferenceServer:
         K8s-native scrape surface (a ServiceMonitor against the Service
         port replaces reading /v1/models by hand). Counters only; rates
         are the scraper's job."""
-        with self._lock:
+        with self._stats_lock:
             s = dict(self._stats)
         lines = [
             "# TYPE k3stpu_predict_requests_total counter",
@@ -745,7 +760,7 @@ class InferenceServer:
                 f"k3stpu_engine_busy_seconds_total {e['busy_s']:.6f}",
             ]
         if self._draft is not None:
-            with self._lock:
+            with self._stats_lock:
                 sp = dict(self._spec_stats)
             lines += [
                 "# TYPE k3stpu_spec_proposed_total counter",
@@ -758,7 +773,7 @@ class InferenceServer:
     def _spec_card(self) -> "dict | None":
         if self._draft is None:
             return None
-        with self._lock:
+        with self._stats_lock:
             s = dict(self._spec_stats)
         s["gamma"] = self.spec_gamma
         s["acceptance_rate"] = (round(s["accepted"] / s["proposed"], 4)
@@ -783,7 +798,7 @@ class InferenceServer:
     def model_card(self) -> dict:
         import jax
 
-        with self._lock:
+        with self._stats_lock:
             stats = dict(self._stats)
         # Throughput over device-busy time (the chip's achieved rate; wall
         # time would also bill idle periods between requests), plus the
@@ -862,9 +877,13 @@ def make_app(server: InferenceServer):
                         eos_id=req.get("eos_id"),
                         num_samples=req.get("num_samples", 1))
                     self._send(200, {"tokens": tokens})
-                except (KeyError, ValueError, TypeError,
+                except (KeyError, ValueError, TypeError, OverflowError,
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
+                except TimeoutError as e:
+                    # Engine queue backlog exceeded the wait budget: a
+                    # clean 503 beats an http.server traceback + reset.
+                    self._send(503, {"error": str(e)})
                 return
             if self.path != "/v1/predict":
                 self._send(404, {"error": f"no route {self.path}"})

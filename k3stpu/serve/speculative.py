@@ -28,35 +28,31 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k3stpu.models.generate import init_cache, set_cache_index
+from k3stpu.models.generate import set_cache_index
+from k3stpu.serve.programs import decode_core, extend_core, prefill_core
+
+# Shared cores (serve/programs.py) + the verifier's in-jit argmax epilogue
+# (shipping (B, G, V) logits to the host every round would swamp the win).
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def _prefill(model, params, block, lens):
-    cache = init_cache(model, block.shape[0])
-    logits, mut = model.apply({"params": params, "cache": cache}, block,
-                              mode="prefill", seq_lens=lens,
-                              mutable=["cache"])
-    last = jnp.take_along_axis(logits, (lens - 1)[:, None, None],
-                               axis=1)[:, 0]
-    return mut["cache"], jnp.argmax(last, axis=-1).astype(jnp.int32)
+    cache, last = prefill_core(model, params, block, lens)
+    return cache, jnp.argmax(last, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def _decode_argmax(model, params, cache, toks):
-    logits, mut = model.apply({"params": params, "cache": cache},
-                              toks[:, None], mode="decode",
-                              mutable=["cache"])
-    return mut["cache"], jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    cache, logits = decode_core(model, params, cache, toks)
+    return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnums=(0,))
 def _extend_argmax(model, params, cache, chunk):
     """Verify chunk (B, G): returns per-position greedy next tokens
     (B, G) — g[:, j] is the target's next token after chunk[:, :j+1]."""
-    logits, mut = model.apply({"params": params, "cache": cache}, chunk,
-                              mode="extend", mutable=["cache"])
-    return mut["cache"], jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    cache, logits = extend_core(model, params, cache, chunk)
+    return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def speculative_generate(
