@@ -51,13 +51,56 @@ def synthetic_corpus(path: "str | pathlib.Path", vocab_size: int = 512,
         path, rng.integers(0, vocab_size, size=n_tokens), vocab_size)
 
 
+class _ShardView:
+    """Zero-copy logical concatenation of memmapped shards.
+
+    Supports ``len``, sub-``window`` views (for train/eval splits — no
+    materialization), and small-slice reads that copy ONLY the requested
+    span (crops), concatenating across a shard boundary when one falls
+    inside the span."""
+
+    def __init__(self, shards, cum, start: int, stop: int):
+        self._shards, self._cum = shards, cum
+        self._start, self._stop = start, stop
+
+    def __len__(self) -> int:
+        return self._stop - self._start
+
+    def window(self, a: int, b: int) -> "_ShardView":
+        return _ShardView(self._shards, self._cum,
+                          self._start + a, self._start + b)
+
+    def __getitem__(self, key):
+        if not isinstance(key, slice):
+            raise TypeError("shard views read slices only")
+        a, b, step = key.indices(len(self))
+        if step != 1:
+            raise ValueError("shard views read contiguous slices only")
+        lo, hi = self._start + a, self._start + b
+        out = []
+        i = int(np.searchsorted(self._cum, lo, side="right")) - 1
+        while lo < hi:
+            s = self._shards[i]
+            off = lo - int(self._cum[i])
+            take = min(hi - lo, len(s) - off)
+            out.append(np.asarray(s[off:off + take]))
+            lo += take
+            i += 1
+        return out[0] if len(out) == 1 else np.concatenate(out)
+
+
 class TokenCorpus:
-    """Random-crop LM batches over a memory-mapped token file."""
+    """Random-crop LM batches over memory-mapped token file(s).
+
+    ``path`` may be one token file or a DIRECTORY of them (the shape real
+    tokenizer pipelines emit: shard-0000.bin, shard-0001.bin, ...), read
+    as one logical stream in sorted-name order — still zero-copy memmaps;
+    only the sampled crops are ever materialized."""
 
     def __init__(self, path: "str | pathlib.Path", vocab_size: int,
                  dtype=None, split: "str | None" = None,
                  holdout_fraction: float = 0.05):
-        """``split``: None = the whole file; "train"/"eval" = the leading
+        """``split``: None = the whole corpus; "train"/"eval" = the leading
         (1 - holdout_fraction) / trailing holdout_fraction token windows —
         a contiguous tail holdout, so eval crops never overlap training
         crops (both splits stay memmap windows; nothing is copied)."""
@@ -66,27 +109,40 @@ class TokenCorpus:
             dtype = (np.uint16
                      if vocab_size <= np.iinfo(np.uint16).max + 1
                      else np.uint32)
-        size = self.path.stat().st_size
-        if size % np.dtype(dtype).itemsize:
-            raise ValueError(
-                f"corpus {self.path} is {size} bytes — not a whole number "
-                f"of {np.dtype(dtype).name} tokens; was it written with a "
-                f"different dtype? (use write_token_file)")
-        self.tokens = np.memmap(self.path, dtype=dtype, mode="r")
+        if self.path.is_dir():
+            files = sorted(p for p in self.path.iterdir() if p.is_file())
+            if not files:
+                raise ValueError(f"corpus dir {self.path} has no files")
+        else:
+            files = [self.path]
+        for f in files:
+            size = f.stat().st_size
+            if size % np.dtype(dtype).itemsize:
+                raise ValueError(
+                    f"corpus shard {f} is {size} bytes — not a whole "
+                    f"number of {np.dtype(dtype).name} tokens; was it "
+                    "written with a different dtype? (use write_token_file)")
+        shards = [np.memmap(f, dtype=dtype, mode="r") for f in files]
+        if len(shards) == 1:
+            self.tokens = shards[0]
+        else:
+            cum = np.concatenate([[0], np.cumsum([len(s) for s in shards])])
+            self.tokens = _ShardView(shards, cum, 0, int(cum[-1]))
         if split is not None:
             if split not in ("train", "eval"):
                 raise ValueError(f"split {split!r} not in (train, eval)")
             if not 0.0 < holdout_fraction < 1.0:
                 raise ValueError(
                     f"holdout_fraction {holdout_fraction} not in (0, 1)")
-            cut = len(self.tokens) - max(
-                2, int(len(self.tokens) * holdout_fraction))
+            n = len(self.tokens)
+            cut = n - max(2, int(n * holdout_fraction))
             if cut < 2:
                 raise ValueError(
-                    f"corpus {self.path} too small to split: "
-                    f"{len(self.tokens)} tokens")
-            self.tokens = (self.tokens[:cut] if split == "train"
-                           else self.tokens[cut:])
+                    f"corpus {self.path} too small to split: {n} tokens")
+            lo, hi = (0, cut) if split == "train" else (cut, n)
+            self.tokens = (self.tokens.window(lo, hi)
+                           if isinstance(self.tokens, _ShardView)
+                           else self.tokens[lo:hi])
         self.split = split
         self.vocab_size = vocab_size
         if len(self.tokens) < 2:
