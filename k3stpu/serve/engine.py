@@ -69,7 +69,8 @@ def _sample_rows(logits, temps, topks, key):
 
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "eos",
-                 "event", "tokens", "error", "slot_rows", "samples")
+                 "event", "tokens", "error", "slot_rows", "samples",
+                 "deadline")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1):
         self.block = block          # (n, P) int32, right-padded
@@ -83,6 +84,7 @@ class _Request:
         self.tokens: "list[list[int]] | None" = None
         self.error: "Exception | None" = None
         self.slot_rows: "list[int]" = []
+        self.deadline: float = float("inf")  # set by _enqueue_and_wait
 
 
 class GenerateEngine:
@@ -203,8 +205,12 @@ class GenerateEngine:
 
     def _enqueue_and_wait(self, req: "_Request",
                           timeout_s: float) -> "list[list[int]]":
+        # The loop thread enforces the same deadline: a request whose
+        # client gave up is dropped from the queue / its slots freed,
+        # instead of decoding its full budget for nobody.
+        req.deadline = time.time() + timeout_s
         self._q.put(req)
-        if not req.event.wait(timeout_s):
+        if not req.event.wait(timeout_s + 1.0):
             raise TimeoutError("generation did not finish in time")
         if req.error is not None:
             raise req.error
@@ -437,6 +443,28 @@ class GenerateEngine:
     def _finish_row(self, r: int) -> None:
         self._active[r] = False
 
+    def _fail_request(self, req: "_Request", err: Exception) -> None:
+        for r in req.slot_rows:
+            self._active[r] = False
+            self._owner[r] = None
+            self._collected[r] = []
+        req.error = err
+        req.event.set()
+
+    def _expire_deadlines(self) -> None:
+        """Free resources of requests whose client stopped waiting."""
+        now = time.time()
+        expired = [r for r in self._pending if now > r.deadline]
+        for req in expired:
+            self._pending.remove(req)
+            req.error = TimeoutError("expired while queued")
+            req.event.set()
+        for req in {self._owner[r] for r in range(self.slots)
+                    if self._owner[r] is not None}:
+            if now > req.deadline:
+                self._fail_request(
+                    req, TimeoutError("expired while decoding"))
+
     def _maybe_complete(self, req: "_Request") -> None:
         if any(self._active[r] for r in req.slot_rows):
             return
@@ -458,6 +486,7 @@ class GenerateEngine:
                                      and not self._pending
                                      and self._adm is None):
                 break  # shutdown sentinel
+            self._expire_deadlines()
             self._admit()
             if not self._active.any():
                 continue

@@ -443,3 +443,23 @@ def test_decode_failure_fails_requests_and_engine_recovers(monkeypatch):
         assert got == [_solo(model, params, [5, 6, 7], 4)]
     finally:
         engine.close()
+
+
+def test_expired_request_frees_slots():
+    """A request whose client stopped waiting is evicted mid-decode: its
+    slots free up and the engine keeps serving."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm
+        with pytest.raises(TimeoutError):
+            # Tiny timeout: the client gives up while decode is running.
+            engine.submit([[5, 6, 7]], max_new_tokens=48, timeout_s=0.05)
+        deadline = time.time() + 30
+        while engine._active.any():
+            assert time.time() < deadline, "expired slots never freed"
+            time.sleep(0.05)
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
