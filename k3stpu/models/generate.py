@@ -48,8 +48,24 @@ def set_cache_index(cache, new_idx: jax.Array):
         cache)
 
 
+def top_p_mask(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus cut: keep the smallest probability-sorted prefix whose mass
+    reaches ``top_p`` (per row — top_p may be scalar or (B,)); everything
+    else drops to -inf. Shape-static: one sort + cumsum on (B, V)."""
+    srt = jnp.sort(logits, axis=-1)[:, ::-1]              # desc
+    probs = jax.nn.softmax(srt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    p = jnp.broadcast_to(jnp.asarray(top_p), logits.shape[:1])[:, None]
+    # Keep entries whose PRECEDING mass is < p (always keeps the top-1).
+    keep_sorted = (cum - probs) < p
+    n_keep = keep_sorted.sum(axis=-1)                     # (B,)
+    # Threshold = the smallest kept sorted logit per row.
+    thresh = jnp.take_along_axis(srt, (n_keep - 1)[:, None], axis=-1)
+    return jnp.where(logits >= thresh, logits, -1e30)
+
+
 def _sample(logits: jax.Array, rng: jax.Array, *, temperature: float,
-            top_k: int | None) -> jax.Array:
+            top_k: int | None, top_p: float | None = None) -> jax.Array:
     """(B, V) logits -> (B,) token ids. temperature == 0 means greedy."""
     if temperature == 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -57,6 +73,8 @@ def _sample(logits: jax.Array, rng: jax.Array, *, temperature: float,
     if top_k is not None:
         kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None:
+        logits = top_p_mask(logits, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -65,10 +83,12 @@ def _sample(logits: jax.Array, rng: jax.Array, *, temperature: float,
 # compiled program. Presence/absence (None) is still a static structure.
 @functools.partial(
     jax.jit,
-    static_argnames=("model", "max_new_tokens", "temperature", "top_k"))
+    static_argnames=("model", "max_new_tokens", "temperature", "top_k",
+                     "top_p"))
 def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
              max_new_tokens: int, *, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: "int | None" = None,
+             top_p: "float | None" = None,
              eos_id: "jax.Array | int | None" = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for a padded prompt block.
 
@@ -103,7 +123,8 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
         logits, (prompt_lens - 1)[:, None, None], axis=1)[:, 0]
 
     rng, k0 = jax.random.split(rng)
-    first = _sample(last, k0, temperature=temperature, top_k=top_k)
+    first = _sample(last, k0, temperature=temperature, top_k=top_k,
+                    top_p=top_p)
     done0 = jnp.zeros((b,), bool) if eos_id is None else first == eos_id
 
     def step(carry, _):
@@ -112,7 +133,8 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], mode="decode",
                                   mutable=["cache"])
-        nxt = _sample(logits[:, -1], k, temperature=temperature, top_k=top_k)
+        nxt = _sample(logits[:, -1], k, temperature=temperature,
+                      top_k=top_k, top_p=top_p)
         if eos_id is not None:
             nxt = jnp.where(done, eos_id, nxt)
             done = done | (nxt == eos_id)

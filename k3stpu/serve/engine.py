@@ -53,9 +53,12 @@ def _pow2_at_least(n: int, lo: int = 1) -> int:
     return p
 
 
-def _sample_rows(logits, temps, topks, key):
+def _sample_rows(logits, temps, topks, topps, key):
     """Per-row sampling over (B, V) logits: temperature <= 0 is greedy;
-    top-k cuts below each row's own k-th value (k == V disables)."""
+    top-k cuts below each row's own k-th value (k == V disables); top-p
+    keeps each row's smallest nucleus reaching mass p (1.0 disables)."""
+    from k3stpu.models.generate import top_p_mask
+
     v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
@@ -63,21 +66,24 @@ def _sample_rows(logits, temps, topks, key):
     kth = jnp.take_along_axis(
         srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
     cut = jnp.where(scaled < kth, _NEG_INF, scaled)
+    cut = top_p_mask(cut, topps)
     sampled = jax.random.categorical(key, cut, axis=-1).astype(jnp.int32)
     return jnp.where(temps <= 0.0, greedy, sampled)
 
 
 class _Request:
-    __slots__ = ("block", "lens", "budget", "temp", "top_k", "eos",
-                 "event", "tokens", "error", "slot_rows", "samples",
+    __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
+                 "eos", "event", "tokens", "error", "slot_rows", "samples",
                  "deadline")
 
-    def __init__(self, block, lens, budget, temp, top_k, eos, samples=1):
+    def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
+                 top_p=None):
         self.block = block          # (n, P) int32, right-padded
         self.lens = lens            # (n,) true lengths
         self.budget = budget        # max new tokens (shared by the rows)
         self.temp = temp
         self.top_k = top_k
+        self.top_p = top_p          # float | None (None == 1.0, no cut)
         self.eos = eos              # int | None
         self.samples = samples      # >1: one prompt, n sampled rows
         self.event = threading.Event()
@@ -123,6 +129,7 @@ class GenerateEngine:
         self._left = np.zeros((slots,), np.int64)
         self._temps = np.zeros((slots,), np.float32)
         self._topks = np.full((slots,), 1, np.int32)
+        self._topps = np.ones((slots,), np.float32)
         self._eos = np.full((slots,), -1, np.int32)
         self._owner: "list[_Request | None]" = [None] * slots
         self._collected: "list[list[int]]" = [[] for _ in range(slots)]
@@ -148,11 +155,11 @@ class GenerateEngine:
     # serve/programs.py (one definition for engine + speculative).
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _decode_step(self, params, cache, toks, temps, topks, step,
-                     base_key):
+    def _decode_step(self, params, cache, toks, temps, topks, topps,
+                     step, base_key):
         cache, logits = decode_core(self.model, params, cache, toks)
         key = jax.random.fold_in(base_key, step)
-        return cache, _sample_rows(logits, temps, topks, key)
+        return cache, _sample_rows(logits, temps, topks, topps, key)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, block, lens):
@@ -171,9 +178,10 @@ class GenerateEngine:
         return decode_core(self.model, params, cache, toks)
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _first_sample(self, last_logits, temps, topks, step, base_key):
+    def _first_sample(self, last_logits, temps, topks, topps, step,
+                      base_key):
         key = jax.random.fold_in(base_key, step)
-        return _sample_rows(last_logits, temps, topks, key)
+        return _sample_rows(last_logits, temps, topks, topps, key)
 
     @functools.partial(jax.jit, static_argnums=(0, 3))
     def _broadcast_rows(self, cache, last, n: int):
@@ -186,7 +194,7 @@ class GenerateEngine:
     # --- client API -----------------------------------------------------
 
     def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
-                        eos_id, samples=1) -> "_Request":
+                        eos_id, samples=1, top_p=None) -> "_Request":
         """Shared validation + packing for both entry points: right-pad to
         a pow2 width bucket and bound against the cache."""
         lens = [len(p) for p in prompts]
@@ -201,7 +209,8 @@ class GenerateEngine:
         for i, p in enumerate(prompts):
             block[i, :len(p)] = p
         return _Request(block, np.asarray(lens, np.int32), max_new_tokens,
-                        float(temperature), top_k, eos_id, samples=samples)
+                        float(temperature), top_k, eos_id, samples=samples,
+                        top_p=top_p)
 
     def _enqueue_and_wait(self, req: "_Request",
                           timeout_s: float) -> "list[list[int]]":
@@ -218,6 +227,7 @@ class GenerateEngine:
 
     def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
                temperature: float = 0.0, top_k: "int | None" = None,
+               top_p: "float | None" = None,
                eos_id: "int | None" = None,
                timeout_s: float = 600.0) -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists."""
@@ -227,12 +237,13 @@ class GenerateEngine:
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
         req = self._packed_request(prompts, max_new_tokens, temperature,
-                                   top_k, eos_id)
+                                   top_k, eos_id, top_p=top_p)
         return self._enqueue_and_wait(req, timeout_s)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
                        max_new_tokens: int, temperature: float = 1.0,
                        top_k: "int | None" = None,
+                       top_p: "float | None" = None,
                        eos_id: "int | None" = None,
                        timeout_s: float = 600.0) -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
@@ -244,7 +255,7 @@ class GenerateEngine:
         if not 1 <= n <= self.slots:
             raise ValueError(f"need 1..{self.slots} samples, got {n}")
         req = self._packed_request([prompt], max_new_tokens, temperature,
-                                   top_k, eos_id, samples=n)
+                                   top_k, eos_id, samples=n, top_p=top_p)
         return self._enqueue_and_wait(req, timeout_s)
 
     def close(self) -> None:
@@ -415,10 +426,12 @@ class GenerateEngine:
         temps = np.full((nb,), req.temp, np.float32)
         topks = np.full(
             (nb,), req.top_k if req.top_k else self.vocab, np.int32)
+        topps = np.full(
+            (nb,), 1.0 if req.top_p is None else req.top_p, np.float32)
         self._step_counter += 1
         first = np.asarray(self._first_sample(
             last_logits, jnp.asarray(temps), jnp.asarray(topks),
-            self._step_counter, self._base_key))
+            jnp.asarray(topps), self._step_counter, self._base_key))
         req.slot_rows = rows
         for j, r in enumerate(rows):
             self._active[r] = True
@@ -427,6 +440,7 @@ class GenerateEngine:
             self._left[r] = req.budget - 1
             self._temps[r] = req.temp
             self._topks[r] = req.top_k if req.top_k else self.vocab
+            self._topps[r] = 1.0 if req.top_p is None else req.top_p
             self._eos[r] = -1 if req.eos is None else int(req.eos)
             self._collected[r] = [int(first[j])]
         with self._lock:
@@ -496,6 +510,7 @@ class GenerateEngine:
                 self._cache, nxt = self._decode_step(
                     self.params, self._cache, jnp.asarray(self._last_tok),
                     jnp.asarray(self._temps), jnp.asarray(self._topks),
+                    jnp.asarray(self._topps),
                     self._step_counter, self._base_key)
                 nxt = np.asarray(nxt)
             except Exception as e:  # noqa: BLE001 — fail every live request

@@ -27,8 +27,8 @@ Endpoints:
   GET  /metrics         -> Prometheus counters (scrape surface)
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
   POST /v1/generate     -> {"prompt_tokens": [[...]], "max_new_tokens": N,
-                            "temperature": t, "top_k": k, "eos_id": e,
-                            "num_samples": n}
+                            "temperature": t, "top_k": k, "top_p": p,
+                            "eos_id": e, "num_samples": n}
                         -> {"tokens": [[...]]}  (LM families only;
                            KV-cache prefill + lax.scan decode)
 
@@ -520,6 +520,7 @@ class InferenceServer:
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,
                         top_k: "int | None" = None,
+                        top_p: "float | None" = None,
                         eos_id: "int | None" = None,
                         num_samples: int = 1) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
@@ -585,6 +586,10 @@ class InferenceServer:
         vocab = getattr(self.model.config, "base",
                         self.model.config).vocab_size
         temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
+        if top_p is not None:  # 0.1 bucket: top_p is STATIC in generate()
+            top_p = round(max(0.05, min(float(top_p), 1.0)), 1)
+            if top_p >= 1.0:
+                top_p = None  # 1.0 == no cut; keep one compiled program
         if top_k is not None:  # pow2 bucket, capped at the vocab
             top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(), vocab)
         if eos_id is not None:  # traced in generate(), so any value is one
@@ -599,7 +604,8 @@ class InferenceServer:
                 k = min(self._engine.slots, num_samples - ofs)
                 out.extend(self._engine.submit_samples(
                     prompts[0], k, max_new_tokens=gen_budget,
-                    temperature=temperature, top_k=top_k, eos_id=eos_id))
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_id=eos_id))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -666,7 +672,7 @@ class InferenceServer:
                 out.extend(self._engine.submit(
                     prompts[ofs:ofs + self._engine.slots],
                     max_new_tokens=gen_budget, temperature=temperature,
-                    top_k=top_k, eos_id=eos_id))
+                    top_k=top_k, top_p=top_p, eos_id=eos_id))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -698,7 +704,8 @@ class InferenceServer:
             out = np.asarray(generate(
                 self.model, self._variables["params"], jnp.asarray(block),
                 jnp.asarray(plens), gen_budget, rng=rng,
-                temperature=temperature, top_k=top_k, eos_id=eos_id))
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id))
         dt = time.perf_counter() - t0
         out = out[:n, :max_new_tokens]
         with self._stats_lock:
@@ -874,6 +881,7 @@ def make_app(server: InferenceServer):
                         max_new_tokens=req.get("max_new_tokens", 32),
                         temperature=req.get("temperature", 0.0),
                         top_k=req.get("top_k"),
+                        top_p=req.get("top_p"),
                         eos_id=req.get("eos_id"),
                         num_samples=req.get("num_samples", 1))
                     self._send(200, {"tokens": tokens})

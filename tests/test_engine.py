@@ -463,3 +463,18 @@ def test_expired_request_frees_slots():
         assert got == [_solo(model, params, [5, 6, 7], 4)]
     finally:
         engine.close()
+
+
+def test_engine_top_p_sampling():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    try:
+        out = engine.submit([[5, 6, 7]], max_new_tokens=12,
+                            temperature=1.0, top_p=0.9)[0]
+        assert len(out) == 12
+        assert all(0 <= t < model.config.vocab_size for t in out)
+        # top_p must not perturb greedy (temperature 0 short-circuits).
+        g = engine.submit([[5, 6, 7]], max_new_tokens=4, top_p=0.5)[0]
+        assert g == _solo(model, params, [5, 6, 7], 4)
+    finally:
+        engine.close()

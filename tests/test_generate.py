@@ -114,3 +114,33 @@ def test_ragged_batch_matches_solo_generation():
                         jnp.array([len(p)], jnp.int32), 6, temperature=0.0)
         assert jnp.array_equal(batched[i], solo[0]), (
             f"row {i}: ragged-batch continuation diverged from solo")
+
+
+def test_top_p_mask_keeps_nucleus():
+    from k3stpu.models.generate import top_p_mask
+
+    logits = jnp.log(jnp.array([[0.5, 0.3, 0.15, 0.05]]))
+    # p=0.6: top-1 has 0.5 < 0.6 so the second (0.3) is still needed.
+    cut = top_p_mask(logits, 0.6)
+    assert bool(jnp.isfinite(cut[0, 0])) and bool(cut[0, 1] > -1e29)
+    assert bool(cut[0, 2] < -1e29) and bool(cut[0, 3] < -1e29)
+    # p tiny: only the argmax survives.
+    cut1 = top_p_mask(logits, 0.01)
+    assert bool(cut1[0, 0] > -1e29)
+    assert bool(jnp.all(cut1[0, 1:] < -1e29))
+    # p=1.0 keeps everything.
+    assert bool(jnp.all(top_p_mask(logits, 1.0) > -1e29))
+    # Per-row p.
+    two = jnp.concatenate([logits, logits])
+    cut2 = top_p_mask(two, jnp.array([0.01, 1.0]))
+    assert bool(jnp.all(cut2[1] > -1e29)) and bool(
+        jnp.all(cut2[0, 1:] < -1e29))
+
+
+def test_generate_top_p_valid_tokens():
+    model, params = _model_and_params()
+    prompts = jnp.array([[5, 6, 7, 8]], jnp.int32)
+    out = generate(model, params, prompts, jnp.array([4], jnp.int32), 8,
+                   rng=jax.random.key(1), temperature=1.0, top_p=0.9)
+    assert out.shape == (1, 8)
+    assert bool(jnp.all((out >= 0) & (out < model.config.vocab_size)))
