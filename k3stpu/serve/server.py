@@ -26,6 +26,7 @@ Endpoints:
   GET  /v1/models       -> model card
   GET  /metrics         -> Prometheus counters (scrape surface)
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
+  POST /v1/score        -> {"tokens": [[...]]} -> per-token logprobs + NLL
   POST /v1/generate     -> {"prompt_tokens": [[...]], "max_new_tokens": N,
                             "temperature": t, "top_k": k, "top_p": p,
                             "eos_id": e, "num_samples": n}
@@ -509,6 +510,43 @@ class InferenceServer:
             return self._batcher.submit(inputs)
         return self._run_forward(inputs)
 
+    def score_tokens(self, token_lists: "list[list[int]]"
+                     ) -> "list[list[float]]":
+        """Per-token log-probabilities for given sequences (LM families):
+        out[r][i] = log P(tokens[r][i+1] | tokens[r][:i+1]) — the scoring
+        primitive behind reranking and perplexity evaluation. Rides the
+        same padded-bucket forward as /v1/predict (one teacher-forced
+        pass, no decode loop)."""
+        if not self.model_name.startswith(("transformer", "moe")):
+            raise ValueError(f"{self.model_name} is not a generative LM")
+        if not token_lists or any(len(t) < 2 for t in token_lists):
+            raise ValueError("each sequence needs at least 2 tokens")
+        lens = [len(t) for t in token_lists]
+        if max(lens) > self.seq_len:
+            raise ValueError(
+                f"sequence length {max(lens)} exceeds max seq "
+                f"{self.seq_len}")
+        n = len(token_lists)
+        batch = served_batch(n)
+        from k3stpu.serve.programs import prompt_width_bucket
+
+        width = prompt_width_bucket(max(lens), self.seq_len)
+        block = np.zeros((batch, width), np.int32)
+        for i, t in enumerate(token_lists):
+            block[i, :len(t)] = t
+        logits = self.predict(block)          # (batch, width, V) fp32
+        logits = np.asarray(logits, np.float32)
+        # log softmax per position, gathered at the NEXT token.
+        m = logits.max(axis=-1, keepdims=True)
+        logz = m[..., 0] + np.log(
+            np.exp(logits - m).sum(axis=-1))  # (batch, width)
+        out = []
+        for r, toks in enumerate(token_lists):
+            idx = np.asarray(toks[1:], np.int64)
+            picked = logits[r, np.arange(len(idx)), idx]
+            out.append((picked - logz[r, :len(idx)]).tolist())
+        return out
+
     def close(self) -> None:
         """Release the dispatcher/engine threads (embedders/tests; the
         serving process itself runs until killed)."""
@@ -872,6 +910,19 @@ def make_app(server: InferenceServer):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path == "/v1/score":
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length))
+                    lp = server.score_tokens(req["tokens"])
+                    self._send(200, {
+                        "logprobs": lp,
+                        "nll": [-float(np.mean(r)) for r in lp],
+                    })
+                except (KeyError, ValueError, TypeError, OverflowError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                return
             if self.path == "/v1/generate":
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
