@@ -10,6 +10,9 @@
 ROUND="${1:-3}"
 STAGES="${2:-probe,tune,serve}"
 DEADLINE_EPOCH="${3:-0}"   # 0 = no deadline; else stop polling after this
+case "$DEADLINE_EPOCH" in
+  ''|*[!0-9]*) echo "DEADLINE_EPOCH must be a unix timestamp (or 0)"; exit 2;;
+esac
 MARKER="/tmp/auto_capture_done_r${ROUND}"
 cd "$(dirname "$0")/.." || exit 1
 
@@ -34,6 +37,13 @@ PY
 )
   echo "$(date -u +%H:%M:%S) $out (poll $i)"
   if [ "$out" = "HEALTHY" ]; then
+    if [ "$DEADLINE_EPOCH" -gt 0 ] \
+        && [ "$(( $(date +%s) + 600 ))" -ge "$DEADLINE_EPOCH" ]; then
+      # Too close to the deadline for a multi-minute capture — a run
+      # spilling past it would contend with the round-end bench.
+      echo "$(date -u +%H:%M:%S) healthy but inside deadline margin; stop"
+      exit 0
+    fi
     echo "$(date -u +%H:%M:%S) tunnel healthy -> capturing stages: $STAGES"
     python tools/capture_artifacts.py --round "$ROUND" --stages "$STAGES"
     rc=$?
