@@ -18,8 +18,14 @@ full data-order state; no iterator checkpointing).
 from __future__ import annotations
 
 import pathlib
+import warnings
 
 import numpy as np
+
+# Directory mode reads only files with these suffixes as token shards
+# (flat little-endian id arrays); anything else in the directory —
+# manifests, READMEs, index files — is ignored.
+SHARD_SUFFIXES = frozenset({".bin", ".tok", ".tokens"})
 
 
 def write_token_file(path: "str | pathlib.Path", tokens,
@@ -110,9 +116,26 @@ class TokenCorpus:
                      if vocab_size <= np.iinfo(np.uint16).max + 1
                      else np.uint32)
         if self.path.is_dir():
-            files = sorted(p for p in self.path.iterdir() if p.is_file())
+            # Token shards only: real tokenizer pipelines drop manifests /
+            # READMEs / index files beside the shards, and a stray file
+            # whose byte size happens to divide the dtype width would
+            # silently concatenate garbage tokens into the stream.
+            regular = sorted(p for p in self.path.iterdir() if p.is_file())
+            files = [p for p in regular if p.suffix in SHARD_SUFFIXES]
             if not files:
-                raise ValueError(f"corpus dir {self.path} has no files")
+                raise ValueError(
+                    f"corpus dir {self.path} has no token shards "
+                    f"(looked for {'/'.join(sorted(SHARD_SUFFIXES))})")
+            if len(files) < len(regular):
+                # Loud, not fatal: ignoring metadata files is the point,
+                # but a shard misnamed outside the suffix set would mean
+                # silently training on partial data.
+                ignored = [p.name for p in regular if p not in files]
+                warnings.warn(
+                    f"corpus dir {self.path}: ignoring "
+                    f"{len(ignored)} non-shard file(s) {ignored[:5]} "
+                    f"(shards need a {'/'.join(sorted(SHARD_SUFFIXES))} "
+                    "suffix)", stacklevel=2)
         else:
             files = [self.path]
         for f in files:

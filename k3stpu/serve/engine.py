@@ -410,11 +410,17 @@ class GenerateEngine:
             self._adm = None
             self._activate(req, a["rows"], a["n"], cache, last)
         except Exception as e:  # noqa: BLE001 — fail the one request
-            for r in a["rows"]:
-                self._reserved[r] = False
-            self._adm = None
-            req.error = e
-            req.event.set()
+            self._abort_admission(e)
+
+    def _abort_admission(self, err: Exception) -> None:
+        """The one admission-abort path: release the reserved rows, null
+        the in-flight record, and fail its request — in that order, so no
+        exit leaves rows reserved for a request nobody is waiting on."""
+        a, self._adm = self._adm, None
+        for r in a["rows"]:
+            self._reserved[r] = False
+        a["req"].error = err
+        a["req"].event.set()
 
     def _activate(self, req, all_rows, n, small_cache, last_logits) -> None:
         """Scatter an admitted small cache into the slot block and light
@@ -473,6 +479,11 @@ class GenerateEngine:
             self._pending.remove(req)
             req.error = TimeoutError("expired while queued")
             req.event.set()
+        # The in-flight chunked admission too: its client may have given
+        # up mid-prefill, and without this check the remaining chunks (and
+        # the whole decode budget) would still run for nobody.
+        if self._adm is not None and now > self._adm["req"].deadline:
+            self._abort_admission(TimeoutError("expired during admission"))
         for req in {self._owner[r] for r in range(self.slots)
                     if self._owner[r] is not None}:
             if now > req.deadline:

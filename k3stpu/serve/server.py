@@ -144,21 +144,31 @@ class MicroBatcher:
             items = self._gather()
             if items is None:
                 return
-            try:
-                batch = (np.concatenate([it["inputs"] for it in items])
-                         if len(items) > 1 else items[0]["inputs"])
-                out = self._run_batch(batch, len(items))
-                ofs = 0
-                for it in items:
-                    k = len(it["inputs"])
-                    it["result"] = out[ofs:ofs + k]
-                    ofs += k
-            except Exception as e:  # noqa: BLE001 — fail every caller, not the loop
-                for it in items:
-                    it["error"] = e
-            finally:
-                for it in items:
-                    it["event"].set()
+            # One dispatch per trailing shape: /v1/score submits
+            # width-bucketed blocks (e.g. (n, 8)) through the same batcher
+            # as full-width /v1/predict rows — concatenating across widths
+            # would raise and fail every coalesced caller. Same-shape
+            # requests still coalesce; a mixed window costs one extra
+            # dispatch, and a failure only fails its own shape group.
+            groups: "dict[tuple, list[dict]]" = {}
+            for it in items:
+                groups.setdefault(it["inputs"].shape[1:], []).append(it)
+            for group in groups.values():
+                try:
+                    batch = (np.concatenate([it["inputs"] for it in group])
+                             if len(group) > 1 else group[0]["inputs"])
+                    out = self._run_batch(batch, len(group))
+                    ofs = 0
+                    for it in group:
+                        k = len(it["inputs"])
+                        it["result"] = out[ofs:ofs + k]
+                        ofs += k
+                except Exception as e:  # noqa: BLE001 — fail the group, not the loop
+                    for it in group:
+                        it["error"] = e
+                finally:
+                    for it in group:
+                        it["event"].set()
 
 
 class InferenceServer:

@@ -118,9 +118,18 @@ def speculative_generate(
         # m_r = longest all-matched prefix of this row's proposals.
         m = np.cumprod(eq, axis=1).sum(axis=1)        # (b,)
         props_np, g_np = np.asarray(props_arr), np.asarray(g)
-        new_x0 = np.empty((b,), np.int32)
-        consumed = np.empty((b,), np.int64)
+        new_x0 = np.asarray(x0, np.int32).copy()
+        consumed = np.zeros((b,), np.int64)
+        n_live = 0
         for r in range(b):
+            if len(emitted[r]) >= max_new_tokens:
+                # Frozen row: it rode the batch's static-shape draft/verify
+                # but must not advance — its index would otherwise creep
+                # ~gamma+1 per round past the p+budget+gamma+1 bound the
+                # entry check enforced, and its dead work would inflate
+                # the acceptance stats.
+                continue
+            n_live += 1
             mr = int(m[r])
             # Emit the matched proposals plus the target's token at the
             # first divergence — which on full acceptance IS the bonus.
@@ -130,8 +139,8 @@ def speculative_generate(
             # Cache rows hold everything strictly before new_x0:
             # x0 + the mr accepted proposals.
             consumed[r] = mr + 1
-        accepted_total += int(m.sum())
-        proposed_total += b * gamma
+            accepted_total += mr
+        proposed_total += n_live * gamma
         base_idx = base_idx + consumed
         new_idx = jnp.asarray(base_idx, jnp.int32)
         # Per-row rollback (free: slots past the index are invisible).

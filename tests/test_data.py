@@ -228,5 +228,30 @@ def test_sharded_corpus_directory(tmp_path):
 def test_sharded_corpus_rejects_empty_dir(tmp_path):
     d = tmp_path / "empty"
     d.mkdir()
-    with pytest.raises(ValueError, match="no files"):
+    with pytest.raises(ValueError, match="no token shards"):
         TokenCorpus(d, 128)
+
+
+def test_sharded_corpus_ignores_stray_files(tmp_path):
+    """Manifests/READMEs beside the shards (what real tokenizer pipelines
+    emit) must not enter the token stream — even when their byte size
+    happens to divide the dtype width."""
+    d = tmp_path / "shards"
+    d.mkdir()
+    all_toks = np.arange(200) % 97
+    write_token_file(d / "shard-0000.bin", all_toks[:100], vocab_size=128)
+    write_token_file(d / "shard-0001.bin", all_toks[100:], vocab_size=128)
+    # 4 bytes: divides uint16 width, would silently prepend garbage tokens
+    # (sorted first) without the suffix filter.
+    (d / "MANIFEST.json").write_bytes(b'{"n"')
+    (d / "README.md").write_text("tokenizer output")
+
+    c = TokenCorpus(d, 128)
+    assert len(c) == 200
+    assert np.array_equal(c.tokens[0:200], all_toks.astype(np.uint16))
+
+    with pytest.raises(ValueError, match="no token shards"):
+        only_stray = tmp_path / "stray"
+        only_stray.mkdir()
+        (only_stray / "README.md").write_text("x")
+        TokenCorpus(only_stray, 128)

@@ -465,6 +465,29 @@ def test_expired_request_frees_slots():
         engine.close()
 
 
+def test_expired_chunked_admission_aborts():
+    """A request whose client gave up mid-chunked-prefill must not run
+    its remaining chunks + full decode budget: the deadline check covers
+    the in-flight admission, clears the reserved rows, and the engine
+    keeps serving."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, chunk_prefill=4)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm all programs
+        with pytest.raises(TimeoutError):
+            # 32-token prompt = 8 chunks; the client gives up immediately.
+            engine.submit([list(range(1, 33))], max_new_tokens=24,
+                          timeout_s=0.01)
+        deadline = time.time() + 30
+        while engine._adm is not None or engine._reserved.any():
+            assert time.time() < deadline, "expired admission never cleared"
+            time.sleep(0.05)
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
+
+
 def test_engine_top_p_sampling():
     model, params = _model_and_params()
     engine = GenerateEngine(model, params, slots=2)

@@ -240,6 +240,43 @@ def test_batcher_failure_propagates_to_all():
     assert out.shape == (2, 2)
 
 
+def test_batcher_mixed_shapes_dispatch_separately():
+    """A /v1/score width bucket (e.g. (n, 8)) landing in the same window
+    as a full-width /v1/predict must not fail the batch: the batcher
+    groups by trailing shape — one dispatch per shape, correct slices
+    back to every caller."""
+    from k3stpu.serve.server import MicroBatcher
+
+    calls = []
+
+    def run(batch, n_requests):
+        calls.append(batch.shape)
+        return batch
+
+    mb = MicroBatcher(run, window_s=0.25, max_batch=8)
+    outs = {}
+
+    def submit(key, arr):
+        outs[key] = mb.submit(arr)
+
+    arrs = {"wide": np.full((2, 16), 1, np.float32),
+            "narrow": np.full((3, 8), 2, np.float32),
+            "narrow2": np.full((1, 8), 3, np.float32)}
+    threads = [threading.Thread(target=submit, args=(k, v))
+               for k, v in arrs.items()]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+    mb.close()
+    for k, arr in arrs.items():
+        np.testing.assert_array_equal(outs[k], arr)
+    # Same-shape requests still coalesce: at most one dispatch per shape
+    # (narrow + narrow2 may share one if they landed in the same window).
+    assert len(calls) <= 3
+    assert all(s[1] in (8, 16) for s in calls)
+
+
 def test_window_zero_disables_coalescing():
     server = InferenceServer(model_name="transformer-tiny", seq_len=16,
                              batch_window_ms=0.0)
