@@ -410,13 +410,16 @@ class GenerateEngine:
             self._adm = None
             self._activate(req, a["rows"], a["n"], cache, last)
         except Exception as e:  # noqa: BLE001 — fail the one request
-            self._abort_admission(e)
+            self._abort_admission(a, e)
 
-    def _abort_admission(self, err: Exception) -> None:
+    def _abort_admission(self, a: dict, err: Exception) -> None:
         """The one admission-abort path: release the reserved rows, null
         the in-flight record, and fail its request — in that order, so no
-        exit leaves rows reserved for a request nobody is waiting on."""
-        a, self._adm = self._adm, None
+        exit leaves rows reserved for a request nobody is waiting on.
+        Takes the record explicitly (NOT via self._adm): the finalize
+        branch nulls self._adm before _activate, so an _activate failure
+        must still reach the record it was admitting."""
+        self._adm = None
         for r in a["rows"]:
             self._reserved[r] = False
         a["req"].error = err
@@ -483,7 +486,8 @@ class GenerateEngine:
         # up mid-prefill, and without this check the remaining chunks (and
         # the whole decode budget) would still run for nobody.
         if self._adm is not None and now > self._adm["req"].deadline:
-            self._abort_admission(TimeoutError("expired during admission"))
+            self._abort_admission(self._adm,
+                                  TimeoutError("expired during admission"))
         for req in {self._owner[r] for r in range(self.slots)
                     if self._owner[r] is not None}:
             if now > req.deadline:
