@@ -69,24 +69,31 @@ def _attn_flops(b, s, h, d, causal, backward):
 
 
 def _time_step(step, args0, iters, trials=3):
-    """Median wall time of ``iters`` chained calls of ``step`` over ``trials``.
+    """Median wall time of ``iters`` chained iterations of ``step``, ALL
+    inside one jitted ``fori_loop`` dispatch per trial.
 
     ``step`` maps (q, k, v) -> (q', k, v): each iteration's query depends on
     the previous iteration's output, so the device must execute the kernels
-    back-to-back and the host's dispatch overhead hides under device time
-    (same discipline as matmul.py's chained product). The clock stops on a
-    device->host scalar pull of the final q, which doubles as the NaN check.
+    back-to-back (same discipline as matmul.py's chained product) — and the
+    single dispatch means the ~8 ms/call relay floor is paid once per trial,
+    not once per iteration (round-3 capture: flash and einsum both "pinned"
+    at ~8.1 ms/iter at S=1024 because each chained step was still its own
+    dispatch through the relay). The clock stops on a device->host scalar
+    pull of the final q, which doubles as the NaN check.
     """
-    args = step(*args0)  # compile + relay-pipeline warm-up
-    s = float(_abs_sum(args[0]))
+    @jax.jit
+    def chain(q, k, v):
+        return jax.lax.fori_loop(0, iters,
+                                 lambda _, qq: step(qq, k, v)[0], q)
+
+    q = chain(*args0)  # compile + relay-pipeline warm-up
+    s = float(_abs_sum(q))
     assert s == s, "attention produced NaN during warm-up"
     times = []
     for _ in range(trials):
-        args = args0
         t0 = time.perf_counter()
-        for _ in range(iters):
-            args = step(*args)
-        s = float(_abs_sum(args[0]))  # device->host sync ends the clock
+        q = chain(*args0)            # one dispatch covers all iters
+        s = float(_abs_sum(q))       # device->host sync ends the clock
         times.append(time.perf_counter() - t0)
         assert s == s, "attention produced NaN"
     times.sort()

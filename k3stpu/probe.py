@@ -8,7 +8,7 @@ table from ``jax.devices()`` — the oracle is a ``TpuDevice``/TPU entry — and
 then, unlike nvidia-smi, proves the chip actually computes by logging matmul
 TFLOP/s and MFU (the BASELINE.json metric).
 
-Run:  python -m k3stpu.probe [--m 8192 --iters 30] [--skip-bench]
+Run:  python -m k3stpu.probe [--m 8192 --iters 50] [--skip-bench]
       python -m k3stpu.probe --attn [--attn-seqs 1024,4096,16384]
 """
 
@@ -39,7 +39,8 @@ def device_table() -> list[dict]:
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="K3S-TPU probe (nvidia-smi parity)")
     ap.add_argument("--m", type=int, default=8192, help="matmul dimension")
-    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--iters", type=int, default=50,
+                    help="matmul chain length (bench.py uses the SAME default\n                    so probe and driver numbers are comparable)")
     ap.add_argument("--skip-bench", action="store_true")
     ap.add_argument("--attn", action="store_true",
                     help="benchmark flash vs einsum attention")

@@ -33,12 +33,26 @@ from k3stpu.utils.subproc import run_bounded  # noqa: E402 (needs REPO path)
 
 PROBE_TIMEOUT_S = 120
 
+# Persistent XLA compilation cache shared by EVERY stage (and pre-warmed by
+# backend_reachable): tunnel windows are scarce — round 3 burned 87 s of a
+# 35-minute window recompiling the train step on resume — so no stage may
+# pay the same compile twice. JAX reads these env vars natively; a backend
+# that can't serialize executables just ignores the cache (no harm).
+CACHE_DIR = os.path.join(REPO, ".jax_cache")
+_CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": CACHE_DIR,
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
+}
+
 _PROBE_SRC = ("import jax; ds = jax.devices(); "
               "print('PROBE_OK', ds[0].platform, len(ds))")
 
 
 def _run_bounded(cmd, timeout_s, log_path=None, env=None):
     """Bounded group-killed run (k3stpu/utils/subproc) + combined-output log."""
+    env = dict(os.environ if env is None else env)
+    for k, v in _CACHE_ENV.items():
+        env.setdefault(k, v)
     rc, out, _ = run_bounded(cmd, timeout_s, env=env, cwd=REPO,
                              merge_streams=True)
     if rc is None:
@@ -71,8 +85,10 @@ def backend_reachable() -> bool:
 
 
 def stage_probe(log):
+    # No --iters override: the probe's default IS bench.py's (one shared
+    # measurement core, ops/matmul.py) so the two numbers are comparable.
     rc, out = _run_bounded(
-        [sys.executable, "-m", "k3stpu.probe", "--attn", "--iters", "30"],
+        [sys.executable, "-m", "k3stpu.probe", "--attn"],
         1800, log)
     return rc == 0 and "ATTN_JSON" in out and "ATTN_CHECK_JSON" in out
 
@@ -95,7 +111,10 @@ def stage_train(log):
     # reflects the chip, not dispatch overheads the tiny configs measure.
     # On CPU (smoke runs of this harness), train_job's own tiny default —
     # 350M on CPU would just eat both 1800 s bounds.
-    cfg = ["--ckpt-dir", ckpt, "--ckpt-every", "10"]
+    # --compilation-cache: the second run's resume recompile was 87 s of a
+    # 35-minute round-3 window; with the persistent cache it is a reload.
+    cfg = ["--ckpt-dir", ckpt, "--ckpt-every", "10",
+           "--compilation-cache", CACHE_DIR]
     if _PLATFORM not in (None, "cpu"):
         cfg = ["--model", "medium", "--remat", *cfg]
     rc1, out1 = _run_bounded(
