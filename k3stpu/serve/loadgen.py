@@ -159,6 +159,7 @@ def main(argv: "list[str] | None" = None) -> int:
             InferenceServer,
             make_app,
             served_batch,
+            start_telemetry_thread,
         )
 
         server = InferenceServer(
@@ -186,6 +187,10 @@ def main(argv: "list[str] | None" = None) -> int:
             server.warmup(tuple(needed))
         httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
         threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        # Short interval: a 15-20 s load window must produce fresh drops
+        # so a tpu-info run right after shows live MEMORY/UTIL, not "n/a"
+        # (the host tool treats drops older than 120 s as stale).
+        start_telemetry_thread(server, interval=2.0)
         url = f"http://127.0.0.1:{httpd.server_address[1]}"
     card_url = url + "/v1/models"
 

@@ -978,6 +978,33 @@ def make_app(server: InferenceServer):
     return Handler
 
 
+def start_telemetry_thread(server: InferenceServer,
+                           interval: float = 10.0) -> threading.Thread:
+    """Periodic telemetry drop for host tpu-info's MEMORY/UTIL columns.
+
+    Duty cycle = device-busy fraction since the last drop; the file rides
+    the /run/k3stpu hostPath to the node (k3stpu/utils/telemetry.py;
+    tpu-inference.yaml volumeMounts). Shared by the serving main() and
+    loadgen's self-hosted server so any driven run populates the table.
+    """
+    from k3stpu.utils.telemetry import write_metrics
+
+    def loop() -> None:
+        last_busy, last_t = server.busy_seconds(), time.monotonic()
+        while True:
+            time.sleep(interval)
+            busy, now = server.busy_seconds(), time.monotonic()
+            duty = int(min(100.0,
+                           100.0 * (busy - last_busy)
+                           / max(now - last_t, 1e-9)))
+            write_metrics(duty_cycle_pct=duty)
+            last_busy, last_t = busy, now
+
+    t = threading.Thread(target=loop, daemon=True, name="telemetry")
+    t.start()
+    return t
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="K3S-TPU inference server")
     ap.add_argument("--model", default="resnet50",
@@ -1080,22 +1107,7 @@ def main(argv=None) -> int:
         print("warming up (pre-compiling batch sizes)...", flush=True)
         server.warmup()
 
-    def telemetry_loop(interval: float = 10.0) -> None:
-        # Duty cycle = device-busy fraction since the last drop; feeds host
-        # tpu-info's UTIL column through the /run/k3stpu hostPath
-        # (k3stpu/utils/telemetry.py; tpu-inference.yaml volumeMounts).
-        from k3stpu.utils.telemetry import write_metrics
-
-        last_busy, last_t = server.busy_seconds(), time.monotonic()
-        while True:
-            time.sleep(interval)
-            busy, now = server.busy_seconds(), time.monotonic()
-            duty = int(min(100.0, 100.0 * (busy - last_busy) / (now - last_t)))
-            write_metrics(duty_cycle_pct=duty)
-            last_busy, last_t = busy, now
-
-    threading.Thread(target=telemetry_loop, daemon=True,
-                     name="telemetry").start()
+    start_telemetry_thread(server)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_app(server))
     print(f"serving {args.model} on :{args.port}", flush=True)
     httpd.serve_forever()

@@ -53,6 +53,33 @@ try:
     a = jnp.full((dim, dim), 1.0 / dim, jnp.bfloat16)
     out = jnp.dot(a, a, preferred_element_type=jnp.float32)
     rec["t_claimed"] = time.time() - t_start
+    # HBM-pressure evidence: memory_stats() is empty through the relayed
+    # backend, so the per-child memory split is proven by USE instead —
+    # each replica allocates ~80% of its TPU_MEM_FRACTION share (known
+    # chip HBM) in 256 MiB chunks and holds it through the compute
+    # window. N children surviving this concurrently is the
+    # allocation-level sharing proof the table can't give us.
+    rec["pressure_bytes"] = 0
+    rec["pressure_target"] = 0
+    held = []
+    if devices[0].platform not in ("cpu",):
+        # The one fraction-aware limit helper (ValueError-safe, clamped):
+        # the same number tpu-info's MEMORY column would show this child.
+        from k3stpu.utils.telemetry import _hbm_limit_for
+        target = int(0.8 * max(_hbm_limit_for(devices[0]), 0))
+        rec["pressure_target"] = target
+        chunk = 256 * 1024 * 1024  # bytes; bf16 ones
+        try:
+            while rec["pressure_bytes"] + chunk <= target:
+                arr = jnp.ones((chunk // 2,), jnp.bfloat16)
+                arr.block_until_ready()
+                held.append(arr)
+                rec["pressure_bytes"] += chunk
+        except Exception as e:
+            rec["pressure_error"] = f"{type(e).__name__}: {e}"[:200]
+    rec["pressure_ok"] = (rec["pressure_target"] == 0
+                          or rec["pressure_bytes"]
+                          >= 0.5 * rec["pressure_target"])
     # Hold the chip busy briefly so two children's device windows overlap
     # if concurrency works at all; checksum forces real execution.
     t0 = time.time()
@@ -74,7 +101,8 @@ try:
     except Exception:
         rec["memory_stats"] = None
     rec["window"] = [t_start + rec["t_claimed"], time.time()]
-    rec["ok"] = abs(rec["checksum_per_elem"] - 1.0) < 0.05
+    rec["ok"] = (abs(rec["checksum_per_elem"] - 1.0) < 0.05
+                 and rec["pressure_ok"])
 except Exception as e:  # structured failure, never a silent hang
     rec["ok"] = False
     rec["error"] = f"{type(e).__name__}: {e}"[:500]

@@ -24,9 +24,46 @@ import time
 
 DROP_PATH = "/run/k3stpu/metrics.json"
 
+# Known HBM per chip by device_kind substring — the bytes_limit fallback
+# when the backend's memory_stats() is empty (observed through the relayed
+# PJRT backend). Public figures, same sourcing as ops/matmul.py's peaks.
+HBM_BYTES = {
+    "v5 lite": 16 * 1024**3,
+    "v5e": 16 * 1024**3,
+    "v5p": 95 * 1024**3,
+    "v4": 32 * 1024**3,
+    "v6": 32 * 1024**3,
+}
+
+
+def _hbm_limit_for(device) -> int:
+    import os
+
+    kind = getattr(device, "device_kind", "").lower()
+    for key, hbm in HBM_BYTES.items():
+        if key in kind:
+            # The device plugin's Allocate caps a shared replica at its
+            # fraction (native/tpu-device-plugin/plugin.cpp) — report the
+            # limit this process actually has, not the whole chip's.
+            try:
+                frac = float(os.environ.get("TPU_MEM_FRACTION", "1.0"))
+            except ValueError:
+                frac = 1.0
+            return int(hbm * min(max(frac, 0.0), 1.0))
+    return -1
+
 
 def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
-    """Snapshot per-device memory stats from the live jax backend."""
+    """Snapshot per-device memory stats from the live jax backend.
+
+    Source order per device: PJRT ``memory_stats()`` (allocator truth)
+    when it returns data; otherwise client-side accounting — the summed
+    bytes of this process's live jax arrays on that device, with the
+    chip's known HBM (x TPU_MEM_FRACTION) as the limit. The relayed
+    backend on the dev tunnel returns ``{}`` from memory_stats, and
+    "n/a" columns forever would be worse than an honest lower bound;
+    the ``source`` field says which one a reader is looking at.
+    """
     import jax
 
     devices = []
@@ -36,11 +73,30 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
             stats = d.memory_stats() or {}
         except (RuntimeError, AttributeError, jax.errors.JaxRuntimeError):
             pass  # backend without memory_stats (e.g. some CPU builds)
+        in_use = int(stats.get("bytes_in_use", -1))
+        limit = int(stats.get("bytes_limit", -1))
+        source = "pjrt"
+        if in_use < 0:
+            try:
+                # A sharded array holds nbytes / |device_set| per device
+                # (even shards; charging the global size to every device
+                # would overcount a fully-sharded model n_devices-fold).
+                in_use = sum(
+                    int(a.nbytes)
+                    // max(1, len(getattr(a.sharding, "device_set", ())))
+                    for a in jax.live_arrays()
+                    if d in getattr(a.sharding, "device_set", ()))
+                source = "live_arrays"
+            except Exception:  # noqa: BLE001 — observability never raises
+                in_use = -1
+        if limit < 0:
+            limit = _hbm_limit_for(d)
         devices.append({
             "index": d.id,
-            "bytes_in_use": int(stats.get("bytes_in_use", -1)),
-            "bytes_limit": int(stats.get("bytes_limit", -1)),
+            "bytes_in_use": in_use,
+            "bytes_limit": limit,
             "duty_cycle_pct": int(duty_cycle_pct),
+            "source": source,
         })
     return {"ts": int(time.time()), "devices": devices}
 

@@ -317,3 +317,16 @@ def test_flash_under_pjit_mesh_matches_oracle():
     for a, b_ in zip(gf, go):
         assert float(jnp.max(jnp.abs(a.astype(jnp.float32)
                                      - b_.astype(jnp.float32)))) < 2e-2
+
+
+def test_spmd_flash_check_on_mesh():
+    """The probe's SPMD oracle (k3stpu/probe.py:spmd_flash_check): flash
+    fwd+grad THROUGH the custom_partitioning rule on the 8-device CPU mesh
+    agrees with the direct kernel call. This is the CI stand-in for the
+    on-chip SPMD_ATTN_JSON line the probe captures on hardware."""
+    from k3stpu.probe import spmd_flash_check
+
+    out = spmd_flash_check(interpret=True, seq=128, batch=8, heads=2,
+                           head_dim=32)
+    assert out["ok"], out
+    assert out["mesh"].startswith("data:")

@@ -94,8 +94,12 @@ def stage_probe(log):
 
 
 def stage_share(log):
+    # replicas=4 IS the reference headline (reference values.yaml:18) and
+    # the chart default; each child also holds ~80% of its 25% HBM share
+    # through the compute window (allocation-pressure evidence, since
+    # memory_stats() is empty through the relay).
     rc, out = _run_bounded(
-        [sys.executable, "-m", "k3stpu.share_proof", "--replicas", "2"],
+        [sys.executable, "-m", "k3stpu.share_proof", "--replicas", "4"],
         900, log)
     # rc 0 == concurrent PASS or documented sequential fallback; rc 1 means
     # neither worked — that log is a failure record, not a proof artifact.
@@ -128,6 +132,10 @@ def stage_train(log):
 
 
 def stage_serve(log):
+    # Build tpu-info FIRST: a from-scratch cmake build can take minutes,
+    # and the live-columns render below must happen within 120 s of the
+    # last serving run's telemetry drop.
+    tpu_info_bin = _build_tpu_info(log)
     ok = True
     # /v1/predict: coalescing window off vs on (the micro-batcher win).
     for window in ("0", "5"):
@@ -144,7 +152,85 @@ def stage_serve(log):
              "transformer", "--clients", "8", "--seconds", "20",
              "--generate-tokens", "64", *extra], 1800, log)
         ok = ok and rc == 0 and "LOADGEN_JSON" in out
-    return ok
+    # tpu-info's live columns, fed by the telemetry the serving runs just
+    # dropped — rendered IMMEDIATELY so the drop file is inside the
+    # tool's 120 s freshness window.
+    return _capture_tpu_info(log, tpu_info_bin) and ok
+
+
+def _build_tpu_info(log) -> "str | None":
+    build = os.path.join(REPO, "native", "build")
+    for cmd in ((["cmake", "-S", os.path.join(REPO, "native"),
+                  "-B", build]),
+                (["cmake", "--build", build, "--target", "tpu-info"])):
+        rc, _ = _run_bounded(cmd, 600, log)
+        if rc != 0:
+            return None
+    return os.path.join(build, "tpu-info")
+
+
+def _capture_tpu_info(log, tpu_info_bin) -> bool:
+    """Render the host tpu-info table with LIVE MEMORY/UTIL columns.
+
+    The MEMORY/UTIL values come from the real drop file the serving
+    process just wrote (/run/k3stpu/metrics.json, utils/telemetry.py).
+    The sysfs side uses a one-v5e fake host tree: the dev box reaches its
+    chip through a relay, so there is no local TPU PCI device for the
+    inventory scan — the tree is the same fixture the unit tests use, and
+    the log says so. Parity target: the reference's live memory/util
+    table (reference README.md:78-84)."""
+    import shutil
+    import tempfile
+
+    if tpu_info_bin is None:
+        return False
+    root = tempfile.mkdtemp(prefix="k3stpu-info-root-")
+    try:
+        return _render_tpu_info(log, tpu_info_bin, root)
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+
+def _render_tpu_info(log, tpu_info_bin, root) -> bool:
+    import shutil
+    bdf = os.path.join(root, "sys", "bus", "pci", "devices",
+                       "0000:00:04.0")
+    os.makedirs(bdf)
+    with open(os.path.join(bdf, "vendor"), "w") as f:
+        f.write("0x1ae0\n")
+    with open(os.path.join(bdf, "device"), "w") as f:
+        f.write("0x0062\n")
+    os.makedirs(os.path.join(root, "dev"))
+    open(os.path.join(root, "dev", "accel0"), "w").close()
+    drop_src = "/run/k3stpu/metrics.json"
+    if os.path.exists(drop_src):
+        os.makedirs(os.path.join(root, "run", "k3stpu"))
+        shutil.copy(drop_src, os.path.join(root, "run", "k3stpu",
+                                           "metrics.json"))
+    with open(log, "a") as f:
+        f.write("[capture] tpu-info host-root: fake 1-chip sysfs tree "
+                "(no local TPU PCI device on a relay dev box); MEMORY/"
+                "UTIL values are LIVE from the serving run's drop file "
+                f"{drop_src}\n")
+    ok = True
+    rc, _ = _run_bounded([tpu_info_bin, "--host-root", root], 60, log)
+    ok = ok and rc == 0
+    rc, out = _run_bounded([tpu_info_bin, "--json",
+                            "--host-root", root], 60, log)
+    try:
+        # The merged-stream log wraps the JSON ("$ cmd" header, rc
+        # trailer): raw_decode from the first brace reads exactly the
+        # object and ignores the trailer.
+        doc, _ = json.JSONDecoder().raw_decode(out[out.index("{"):])
+        populated = any(c.get("mem_used_bytes", -1) >= 0
+                        and c.get("duty_cycle_pct", -1) >= 0
+                        for c in doc.get("chips", []))
+    except (ValueError, json.JSONDecodeError):
+        populated = False
+    with open(log, "a") as f:
+        f.write(f"[capture] tpu-info live columns populated: "
+                f"{populated}\n")
+    return ok and rc == 0 and populated
 
 
 def stage_tune(log):
