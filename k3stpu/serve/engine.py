@@ -102,18 +102,32 @@ class GenerateEngine:
     """
 
     def __init__(self, model, params, *, slots: int = 8,
-                 seed: int = 0, chunk_prefill: "int | None" = None):
+                 seed: int = 0, chunk_prefill: "int | None" = None,
+                 decode_block: int = 1):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
-        instead of the whole prompt's. None = single-shot admission."""
+        instead of the whole prompt's. None = single-shot admission.
+
+        ``decode_block``: decode this many tokens per device dispatch
+        (an inner ``lax.scan``), host-side eos/budget/deadline checks in
+        between blocks. Through a relayed backend each dispatch costs
+        ~8 ms regardless of work, capping a per-token loop at ~125
+        steps/s; a K-token block amortizes that floor K-fold. Trade-off:
+        a new request joins on a block boundary (K-token granularity),
+        and a row that hits eos mid-block rides out the rest of the
+        block with its surplus tokens discarded host-side."""
         if chunk_prefill is not None and chunk_prefill < 1:
             raise ValueError(f"chunk_prefill must be >= 1, got "
                              f"{chunk_prefill}")
+        if decode_block < 1:
+            raise ValueError(f"decode_block must be >= 1, got "
+                             f"{decode_block}")
         self.model = model
         self.params = params
         self.slots = slots
         self.chunk_prefill = chunk_prefill
+        self.decode_block = decode_block
         cfg = getattr(model.config, "base", model.config)
         self.max_seq = cfg.max_seq_len
         self.vocab = cfg.vocab_size
@@ -160,6 +174,30 @@ class GenerateEngine:
         cache, logits = decode_core(self.model, params, cache, toks)
         key = jax.random.fold_in(base_key, step)
         return cache, _sample_rows(logits, temps, topks, topps, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 9))
+    def _decode_block_step(self, params, cache, toks, temps, topks,
+                           topps, step, base_key, k_tokens: int):
+        """K decode steps in ONE dispatch: ``lax.scan`` over the
+        single-token core, sampling on-device each step. Returns the
+        (K, B) token block; greedy rows are exactly K steps of argmax,
+        so engine output stays pinned to ``generate()`` token for
+        token. Rows that finish mid-block keep decoding (static shapes;
+        the host discards their surplus) — their cache writes clamp at
+        the row's last slot and the slot's next reuse scatters a fresh
+        prefill over everything, index included."""
+        block_key = jax.random.fold_in(base_key, step)
+
+        def body(carry, i):
+            cache, tok = carry
+            cache, logits = decode_core(self.model, params, cache, tok)
+            key = jax.random.fold_in(block_key, i)
+            nxt = _sample_rows(logits, temps, topks, topps, key)
+            return (cache, nxt), nxt
+
+        (cache, _), out = jax.lax.scan(
+            body, (cache, toks), jnp.arange(k_tokens))
+        return cache, out
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, block, lens):
@@ -521,13 +559,26 @@ class GenerateEngine:
                 continue
             t0 = time.perf_counter()
             self._step_counter += 1
+            k_tok = self.decode_block
             try:
-                self._cache, nxt = self._decode_step(
-                    self.params, self._cache, jnp.asarray(self._last_tok),
-                    jnp.asarray(self._temps), jnp.asarray(self._topks),
-                    jnp.asarray(self._topps),
-                    self._step_counter, self._base_key)
-                nxt = np.asarray(nxt)
+                if k_tok == 1:
+                    self._cache, nxt = self._decode_step(
+                        self.params, self._cache,
+                        jnp.asarray(self._last_tok),
+                        jnp.asarray(self._temps),
+                        jnp.asarray(self._topks),
+                        jnp.asarray(self._topps),
+                        self._step_counter, self._base_key)
+                    block = np.asarray(nxt)[None]          # (1, B)
+                else:
+                    self._cache, nxt = self._decode_block_step(
+                        self.params, self._cache,
+                        jnp.asarray(self._last_tok),
+                        jnp.asarray(self._temps),
+                        jnp.asarray(self._topks),
+                        jnp.asarray(self._topps),
+                        self._step_counter, self._base_key, k_tok)
+                    block = np.asarray(nxt)                # (K, B)
             except Exception as e:  # noqa: BLE001 — fail every live request
                 for req in {self._owner[r] for r in range(self.slots)
                             if self._owner[r] is not None}:
@@ -538,23 +589,26 @@ class GenerateEngine:
                 continue
             dt = time.perf_counter() - t0
             n_active = int(self._active.sum())
+            done_reqs = set()
+            consumed = 0
+            for j in range(block.shape[0]):
+                for r in range(self.slots):
+                    if not self._active[r]:
+                        continue  # finished mid-block: surplus discarded
+                    tok = int(block[j, r])
+                    self._last_tok[r] = tok
+                    self._collected[r].append(tok)
+                    self._left[r] -= 1
+                    consumed += 1
+                    if self._left[r] <= 0 or (self._eos[r] >= 0
+                                              and tok == self._eos[r]):
+                        self._finish_row(r)
+                        done_reqs.add(self._owner[r])
             with self._lock:
                 self._stats["steps"] += 1
-                self._stats["tokens"] += n_active
+                self._stats["tokens"] += consumed
                 self._stats["busy_s"] += dt
                 self._stats["slot_occupancy_sum"] += n_active
-            done_reqs = set()
-            for r in range(self.slots):
-                if not self._active[r]:
-                    continue
-                tok = int(nxt[r])
-                self._last_tok[r] = tok
-                self._collected[r].append(tok)
-                self._left[r] -= 1
-                if self._left[r] <= 0 or (self._eos[r] >= 0
-                                          and tok == self._eos[r]):
-                    self._finish_row(r)
-                    done_reqs.add(self._owner[r])
             for req in done_reqs:
                 self._maybe_complete(req)
         # Shutdown: fail anything still waiting — INCLUDING requests a

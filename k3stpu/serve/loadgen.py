@@ -147,6 +147,9 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="self-hosted server serves quantized weights "
                          "(compare against the float run)")
     ap.add_argument("--kv-cache-dtype", default=None, choices=["int8"])
+    ap.add_argument("--decode-block", type=int, default=4,
+                    help="engine tokens per device dispatch when "
+                         "--continuous-batching (see server --decode-block)")
     args = ap.parse_args(argv)
 
     url = args.url
@@ -166,6 +169,7 @@ def main(argv: "list[str] | None" = None) -> int:
             model_name=args.model, image_size=args.image_size,
             seq_len=args.seq_len, batch_window_ms=args.batch_window_ms,
             continuous_batching=args.continuous_batching,
+            decode_block=args.decode_block,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=1 if args.continuous_batching else None)
         if args.generate_tokens > 0:

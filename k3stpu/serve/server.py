@@ -185,6 +185,7 @@ class InferenceServer:
                  continuous_batching: bool = False,
                  engine_slots: int = 8,
                  prefill_chunk: "int | None" = None,
+                 decode_block: int = 1,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
                  spec_gamma: int = 4):
@@ -428,7 +429,7 @@ class InferenceServer:
 
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
-                chunk_prefill=prefill_chunk)
+                chunk_prefill=prefill_chunk, decode_block=decode_block)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -1055,6 +1056,12 @@ def main(argv=None) -> int:
                          "in chunks of this many tokens, decode steps "
                          "interleaved — bounds the decode stall an "
                          "arriving prompt causes to one chunk's latency")
+    ap.add_argument("--decode-block", type=int, default=4,
+                    help="with --continuous-batching: tokens decoded per "
+                         "device dispatch (inner lax.scan). Each dispatch "
+                         "through a relayed backend costs ~8 ms flat, so "
+                         "K>1 amortizes the floor K-fold; new requests "
+                         "join on block boundaries (K-token granularity)")
     ap.add_argument("--draft-model", default=None,
                     choices=["transformer", "transformer-tiny"],
                     help="speculative decoding draft for greedy "
@@ -1097,6 +1104,7 @@ def main(argv=None) -> int:
                              continuous_batching=args.continuous_batching,
                              engine_slots=args.engine_slots,
                              prefill_chunk=args.prefill_chunk,
+                             decode_block=args.decode_block,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
                              spec_gamma=args.spec_gamma)

@@ -78,14 +78,17 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
         source = "pjrt"
         if in_use < 0:
             try:
-                # A sharded array holds nbytes / |device_set| per device
-                # (even shards; charging the global size to every device
-                # would overcount a fully-sharded model n_devices-fold).
+                # Per-device truth via each array's shards: a row-sharded
+                # array charges one shard's bytes here, a replicated one
+                # its full size on every device — dividing global nbytes
+                # by |device_set| would get the replicated case N-fold
+                # wrong, charging it N-fold light.
                 in_use = sum(
-                    int(a.nbytes)
-                    // max(1, len(getattr(a.sharding, "device_set", ())))
+                    int(s.data.nbytes)
                     for a in jax.live_arrays()
-                    if d in getattr(a.sharding, "device_set", ()))
+                    if d in getattr(a.sharding, "device_set", ())
+                    for s in a.addressable_shards
+                    if s.device == d)
                 source = "live_arrays"
             except Exception:  # noqa: BLE001 — observability never raises
                 in_use = -1

@@ -488,6 +488,72 @@ def test_expired_chunked_admission_aborts():
         engine.close()
 
 
+def test_decode_block_matches_generate():
+    """decode_block=4 (multi-token dispatch) must stay EXACTLY pinned to
+    generate(): greedy K-step scan == K greedy steps, budgets that aren't
+    multiples of K discard the surplus, eos mid-block truncates."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=4, decode_block=4)
+    try:
+        for budget in (1, 3, 4, 6, 11):
+            got = engine.submit([[5, 6, 7]], max_new_tokens=budget)
+            assert got == [_solo(model, params, [5, 6, 7], budget)], budget
+        # Multi-prompt ragged batch through the block path.
+        prompts = [[3, 4], [9, 10, 11, 12, 13]]
+        got = engine.submit(prompts, max_new_tokens=7)
+        for g, p in zip(got, prompts):
+            assert g == _solo(model, params, p, 7)
+    finally:
+        engine.close()
+
+
+def test_decode_block_concurrent_interleave():
+    """Concurrent requests through the K-block path each match their solo
+    output (slot interleaving must not leak across rows within a block)."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=4, decode_block=3)
+    try:
+        prompts = [[5, 6], [7, 8, 9], [10], [11, 12, 13, 14]]
+        outs: dict[int, list] = {}
+
+        def call(i):
+            outs[i] = engine.submit([prompts[i]], max_new_tokens=9)[0]
+
+        threads = [threading.Thread(target=call, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(outs) == 4
+        for i, p in enumerate(prompts):
+            assert outs[i] == _solo(model, params, p, 9), i
+    finally:
+        engine.close()
+
+
+def test_decode_block_eos_and_expiry():
+    """eos stopping and deadline expiry still work at block granularity."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, decode_block=4)
+    try:
+        ref = _solo(model, params, [5, 6, 7], 10)
+        eos = ref[4]  # force a mid-generation eos
+        got = engine.submit([[5, 6, 7]], max_new_tokens=10, eos_id=eos)[0]
+        cut = ref.index(eos)
+        assert got[:cut + 1] == ref[:cut + 1]
+        assert all(t == eos for t in got[cut:])  # eos-extended tail
+        assert engine.decode_block == 4
+    finally:
+        engine.close()
+
+
+def test_bad_decode_block_rejected():
+    model, params = _model_and_params()
+    with pytest.raises(ValueError, match="decode_block"):
+        GenerateEngine(model, params, decode_block=0)
+
+
 def test_engine_top_p_sampling():
     model, params = _model_and_params()
     engine = GenerateEngine(model, params, slots=2)

@@ -141,6 +141,27 @@ def test_telemetry_writer_roundtrip(info_bin, fake_host_root):
             assert c["duty_cycle_pct"] == 12
 
 
+def _empty_stats_dev(real):
+    """Fake device: real identity (so device_set membership works) but
+    empty PJRT memory_stats — the relayed-backend shape that forces the
+    live-arrays fallback."""
+
+    class EmptyStatsDev:
+        id = real.id
+        device_kind = "TPU v5 lite"
+
+        def memory_stats(self):
+            return {}
+
+        def __eq__(self, other):
+            return other == real or other is self
+
+        def __hash__(self):
+            return hash(real)
+
+    return EmptyStatsDev()
+
+
 def test_telemetry_live_arrays_fallback(monkeypatch):
     """When PJRT memory_stats() is empty (the relayed backend returns {}),
     bytes_in_use falls back to summing this process's live jax arrays on
@@ -157,23 +178,8 @@ def test_telemetry_live_arrays_fallback(monkeypatch):
     big = jnp.ones((1024, 1024), jnp.float32)  # 4 MiB, forced live
     big.block_until_ready()
     real = jax.local_devices()[0]
-
-    class EmptyStatsDev:
-        """Real device for identity/sharding membership; empty stats."""
-        id = real.id
-        device_kind = "TPU v5 lite"
-
-        def memory_stats(self):
-            return {}
-
-        def __eq__(self, other):  # membership test: d in device_set
-            return other == real or other is self
-
-        def __hash__(self):
-            return hash(real)
-
     monkeypatch.setattr(jax, "local_devices",
-                        lambda *a, **k: [EmptyStatsDev()])
+                        lambda *a, **k: [_empty_stats_dev(real)])
     payload = telemetry.collect_device_metrics(duty_cycle_pct=7)
     d0 = payload["devices"][0]
     assert d0["source"] == "live_arrays"
@@ -183,9 +189,10 @@ def test_telemetry_live_arrays_fallback(monkeypatch):
 
 
 def test_telemetry_sharded_array_counts_per_device_share(monkeypatch):
-    """A sharded array charges nbytes / |device_set| to each device
-    through the REAL collect_device_metrics fallback — not its full
-    global size n_devices times over."""
+    """A sharded array charges each device its own shard's bytes through
+    the REAL collect_device_metrics fallback — not its full global size
+    n_devices times over (a replicated array, by the same per-shard
+    accounting, correctly charges its full size per device)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
@@ -198,22 +205,8 @@ def test_telemetry_sharded_array_counts_per_device_share(monkeypatch):
         import pytest
         pytest.skip("needs the multi-device CPU mesh")
     real = jax.local_devices()[0]
-
-    class EmptyStatsDev:
-        id = real.id
-        device_kind = "TPU v5 lite"
-
-        def memory_stats(self):
-            return {}
-
-        def __eq__(self, other):
-            return other == real or other is self
-
-        def __hash__(self):
-            return hash(real)
-
     monkeypatch.setattr(jax, "local_devices",
-                        lambda *a, **k: [EmptyStatsDev()])
+                        lambda *a, **k: [_empty_stats_dev(real)])
     before = telemetry.collect_device_metrics()["devices"][0]
     mesh = make_mesh(n, model_parallelism=1, axis_names=("data", "model"))
     arr = jax.device_put(jnp.zeros((n * 512, 512), jnp.float32),

@@ -90,7 +90,8 @@ def stage_probe(log):
     rc, out = _run_bounded(
         [sys.executable, "-m", "k3stpu.probe", "--attn"],
         1800, log)
-    return rc == 0 and "ATTN_JSON" in out and "ATTN_CHECK_JSON" in out
+    return (rc == 0 and "ATTN_JSON" in out and "ATTN_CHECK_JSON" in out
+            and "SPMD_ATTN_JSON" in out)
 
 
 def stage_share(log):
