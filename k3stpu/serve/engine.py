@@ -153,9 +153,9 @@ class GenerateEngine:
         self._adm: "dict | None" = None  # in-flight chunked admission
         self._closed = False
         self._lock = threading.Lock()
-        self._stats = {"tokens": 0, "steps": 0, "busy_s": 0.0,
-                       "requests": 0, "slot_occupancy_sum": 0.0,
-                       "adm_chunks": 0}
+        self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
+                       "busy_s": 0.0, "requests": 0,
+                       "slot_occupancy_sum": 0.0, "adm_chunks": 0}
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generate-engine")
@@ -605,10 +605,16 @@ class GenerateEngine:
                         self._finish_row(r)
                         done_reqs.add(self._owner[r])
             with self._lock:
-                self._stats["steps"] += 1
+                # "steps" keeps its per-token meaning (device decode
+                # steps) so the exported counter's unit survives the
+                # k>1 default; "dispatches" counts device round-trips —
+                # steps/dispatches is the realized block amortization.
+                self._stats["steps"] += block.shape[0]
+                self._stats["dispatches"] += 1
                 self._stats["tokens"] += consumed
                 self._stats["busy_s"] += dt
-                self._stats["slot_occupancy_sum"] += n_active
+                self._stats["slot_occupancy_sum"] += (n_active
+                                                      * block.shape[0])
             for req in done_reqs:
                 self._maybe_complete(req)
         # Shutdown: fail anything still waiting — INCLUDING requests a

@@ -185,7 +185,7 @@ class InferenceServer:
                  continuous_batching: bool = False,
                  engine_slots: int = 8,
                  prefill_chunk: "int | None" = None,
-                 decode_block: int = 1,
+                 decode_block: int = 4,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
                  spec_gamma: int = 4):

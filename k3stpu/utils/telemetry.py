@@ -67,6 +67,7 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
     import jax
 
     devices = []
+    per_dev_live: "dict | None" = None  # built once, on first fallback
     for d in jax.local_devices():
         stats = {}
         try:
@@ -78,17 +79,21 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
         source = "pjrt"
         if in_use < 0:
             try:
-                # Per-device truth via each array's shards: a row-sharded
-                # array charges one shard's bytes here, a replicated one
-                # its full size on every device — dividing global nbytes
-                # by |device_set| would get the replicated case N-fold
-                # wrong, charging it N-fold light.
-                in_use = sum(
-                    int(s.data.nbytes)
-                    for a in jax.live_arrays()
-                    if d in getattr(a.sharding, "device_set", ())
-                    for s in a.addressable_shards
-                    if s.device == d)
+                if per_dev_live is None:
+                    # ONE pass over all live arrays' shards, accumulated
+                    # per device (not a rescan per device). Per-device
+                    # truth via shards: a row-sharded array charges one
+                    # shard's bytes to its device, a replicated one its
+                    # full size on every device — dividing global nbytes
+                    # by |device_set| would get the replicated case
+                    # N-fold wrong.
+                    per_dev_live = {}
+                    for a in jax.live_arrays():
+                        for s in a.addressable_shards:
+                            per_dev_live[s.device] = (
+                                per_dev_live.get(s.device, 0)
+                                + int(s.data.nbytes))
+                in_use = per_dev_live.get(d, 0)
                 source = "live_arrays"
             except Exception:  # noqa: BLE001 — observability never raises
                 in_use = -1

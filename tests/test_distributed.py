@@ -79,6 +79,79 @@ def test_launch_main_single_process(capsys, monkeypatch):
     assert events["psum_allreduce"]["bus_gbps"] > 0
 
 
+def _mp_env(i, port, n_local_devices):
+    """The Indexed-Job pod environment (tpu-pjit-job.yaml) for a local
+    2-process rehearsal: CPU backend, no axon tunnel, localhost
+    coordinator pinned via the explicit-override leg."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count="
+                        f"{n_local_devices}")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p)
+    env["HOSTNAME"] = f"tpu-pjit-{i}"
+    env["JOB_COMPLETION_INDEX"] = str(i)
+    env["K3STPU_NUM_PROCESSES"] = "2"
+    env["K3STPU_COORDINATOR"] = f"127.0.0.1:{port}"
+    return env
+
+
+def test_two_process_train_job_loss_parity():
+    """The north-star train Job (BASELINE config 5's closest executable
+    stand-in): train_job itself runs 2 processes x 4 devices each — dp
+    over a DCN-like process boundary — and its per-step losses match both
+    across the two processes AND a single-process 8-device run of the
+    same config. Gradient psum over the process boundary therefore
+    computes exactly what one host computes."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    args = ["-m", "k3stpu.parallel.train_job", "--steps", "3",
+            "--model", "tiny", "--batch", "8", "--seq", "32"]
+
+    def step_losses(out):
+        recs = [json.loads(l) for l in out.splitlines()
+                if l.startswith('{"event": "step"')]
+        return [r["loss"] for r in recs]
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    procs = [subprocess.Popen([sys.executable, *args],
+                              env=_mp_env(i, port, 4), text=True,
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE)
+             for i in range(2)]
+    losses = {}
+    try:
+        for i, p in enumerate(procs):
+            out, err = p.communicate(timeout=300)
+            assert p.returncode == 0, f"rank {i} rc={p.returncode}: {err[-2000:]}"
+            losses[i] = step_losses(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    assert len(losses[0]) == 3
+    assert losses[0] == losses[1], "ranks disagree on the loss sequence"
+
+    env1 = _mp_env(0, 0, 8)
+    for k in ("HOSTNAME", "JOB_COMPLETION_INDEX", "K3STPU_NUM_PROCESSES",
+              "K3STPU_COORDINATOR"):
+        env1.pop(k, None)
+    single = subprocess.run([sys.executable, *args], env=env1, text=True,
+                            capture_output=True, timeout=300)
+    assert single.returncode == 0, single.stderr[-2000:]
+    assert step_losses(single.stdout) == losses[0], (
+        "2-process dp loss differs from single-process")
+
+
 def test_two_process_rendezvous_and_psum(tmp_path):
     """The north-star Job path actually executes: two real processes with
     fake Indexed-Job env rendezvous via jax.distributed.initialize on a
@@ -95,27 +168,12 @@ def test_two_process_rendezvous_and_psum(tmp_path):
         s.bind(("127.0.0.1", 0))
         port = s.getsockname()[1]
 
-    procs = []
-    for i in range(2):
-        env = dict(os.environ)
-        # No axon/TPU tunnel in the children; 1 CPU device per process.
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("XLA_FLAGS", None)
-        env["JAX_PLATFORMS"] = "cpu"
-        repo_root = os.path.dirname(os.path.dirname(worker))
-        env["PYTHONPATH"] = os.pathsep.join(
-            p for p in (repo_root, env.get("PYTHONPATH")) if p)
-        # The Indexed-Job pod environment (deploy/manifests/tpu-pjit-job.yaml):
-        # pod hostname <job>-<index>, kubelet-set JOB_COMPLETION_INDEX, and a
-        # coordinator address (in-cluster it comes from the headless Service;
-        # here the explicit-override leg pins it to localhost).
-        env["HOSTNAME"] = f"tpu-pjit-{i}"
-        env["JOB_COMPLETION_INDEX"] = str(i)
-        env["K3STPU_NUM_PROCESSES"] = "2"
-        env["K3STPU_COORDINATOR"] = f"127.0.0.1:{port}"
-        procs.append(subprocess.Popen(
-            [sys.executable, worker], env=env, text=True,
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE))
+    # Same fake pod env as the train rehearsal (the worker pins its own
+    # 2-device count in-process, overriding _mp_env's XLA_FLAGS).
+    procs = [subprocess.Popen(
+        [sys.executable, worker], env=_mp_env(i, port, 2), text=True,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
 
     results = {}
     try:
