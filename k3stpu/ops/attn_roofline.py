@@ -1,0 +1,174 @@
+"""Analytic roofline for the flash-attention kernel on TPU.
+
+VERDICT r3 set a >=30%-of-peak bar for flash fwd at S=4096 b=8 and asked,
+failing an on-chip measurement, for a committed roofline showing where the
+ceiling actually is. This module IS that analysis, as executable code: it
+models the kernel in ops/attention.py (blocked online softmax, bf16 IO,
+fp32 accumulation, diagonal-only masking, dead-tile DMA elision) against a
+chip's three hard limits —
+
+  MXU:  the two matmuls (q k^T and p v), 2 * 2 * s_q * s_kv * d flops
+        per folded head, halved by causal tile-skipping;
+  VPU:  the online-softmax elementwise work — per LIVE logits tile a
+        fixed number of full-tile passes (running max, exp, sum, rescale
+        + accumulate) that the MXU cannot absorb; exp costs several VPU
+        ops per element;
+  HBM:  q read once, o written once, and k/v streamed once per q tile
+        (the k sweep is innermost, so k/v traffic multiplies by the
+        number of LIVE q tiles — the price flash pays for O(S) memory).
+
+MXU and VPU work is dependent within a tile (s -> exp -> p@v), but Mosaic
+double-buffers tiles through the grid, so across tiles the units overlap:
+the kernel-time model is max(MXU, VPU, HBM), and the printed per-unit
+times say which wall you are standing at. Single-dispatch bench loops
+(ops/matmul.py discipline) make dispatch overhead a per-TRIAL constant,
+so it is deliberately not part of the per-iteration model; the old
+per-iteration ~8 ms relay floor is reported separately as what the
+round-3 numbers actually measured.
+
+Run: python -m k3stpu.ops.attn_roofline [--seq 4096 --batch 8 ...]
+Every modeled number prints as one ROOFLINE_JSON line per shape.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import asdict, dataclass
+
+from k3stpu.ops.matmul import PEAK_BF16_TFLOPS
+
+# v5e figures: the MXU peak IS the bench's divisor (ops/matmul.py), so
+# roofline MFUs and captured ATTN_JSON MFUs stay comparable by
+# construction; HBM matches utils/telemetry.py HBM_BYTES sourcing.
+V5E = {
+    "name": "v5e",
+    "mxu_tflops": PEAK_BF16_TFLOPS["v5e"],   # dense bf16
+    "hbm_gbps": 819.0,
+    # VPU: 8x128 lanes x 4 ALUs x ~0.94 GHz ~= 3.85e12 elementwise op/s.
+    "vpu_teraops": 3.85,
+}
+
+# exp() on the VPU is not 1 op/element; Mosaic lowers it to a polynomial +
+# scale sequence. 6 is the planning number used throughout (order-of-
+# magnitude right; the conclusion is insensitive to +-2).
+EXP_OPS = 6.0
+
+# Full-tile VPU passes per LIVE logits tile in the fwd kernel
+# (ops/attention.py:_flash_kernel): tile max + running max merge (1),
+# s - m_new subtract (1), exp (EXP_OPS), p row-sum (1), p bf16 cast (1).
+# The acc rescale + add is O(block_q * d) not O(tile), counted separately.
+FWD_TILE_PASSES = 4.0 + EXP_OPS
+
+
+@dataclass
+class Roofline:
+    chip: str
+    batch: int
+    seq: int
+    heads: int
+    head_dim: int
+    causal: bool
+    block_q: int
+    block_k: int
+    flops: float            # causal-aware, what the bench credits
+    mxu_ms: float           # flops / MXU peak
+    vpu_ms: float           # softmax elementwise wall
+    hbm_ms: float           # streamed bytes / HBM bandwidth
+    kernel_ms: float        # max of the three (pipelined units)
+    bound_by: str
+    ceiling_mfu: float      # flops / (kernel_ms * MXU peak)
+    # What a PER-ITERATION dispatch would add (the round-3 harness):
+    relay_floor_ms: float
+    measured_mfu_with_floor: float
+
+    def to_dict(self) -> dict:
+        d = asdict(self)
+        for k, v in d.items():
+            if isinstance(v, float):
+                d[k] = round(v, 4)
+        return d
+
+
+def model(seq: int = 4096, batch: int = 8, heads: int = 8,
+          head_dim: int = 128, causal: bool = True, block_q: int = 256,
+          block_k: int = 256, chip: dict = V5E,
+          relay_floor_ms: float = 8.0) -> Roofline:
+    bh = batch * heads
+    s, d = seq, head_dim
+    nq, nk = s // block_q, s // block_k
+    # Credited flops use the ideal 1/2 causal discount — matching
+    # attn_bench._attn_flops, the number every captured MFU divides by.
+    flops = 4.0 * bh * s * s * d * (0.5 if causal else 1.0)
+
+    # EXECUTED work quantizes to tiles: q tile i runs k tiles 0..last(i)
+    # inclusive, so the live fraction is (n+1)/(2n)-ish, not 1/2 — a
+    # 25% extra at n=4 (S=1024, block 256) that the credited flops
+    # rightly ignore but the time model must not.
+    if causal:
+        live_tiles = sum(
+            min(nk, (i * block_q + block_q - 1) // block_k + 1)
+            for i in range(nq))
+    else:
+        live_tiles = nq * nk
+    exec_frac = live_tiles / (nq * nk)
+
+    # --- MXU: two matmuls over executed tiles (pl.when skips the rest).
+    exec_flops = 4.0 * bh * s * s * d * exec_frac
+    mxu_ms = exec_flops / (chip["mxu_tflops"] * 1e12) * 1e3
+
+    # --- VPU: FWD_TILE_PASSES over each executed logits element, plus
+    # the acc rescale+add (2 passes over (block_q, d) per live k step).
+    logits_elems = bh * s * s * exec_frac
+    acc_elems = bh * live_tiles * block_q * d
+    vpu_ops = FWD_TILE_PASSES * logits_elems + 2.0 * acc_elems
+    vpu_ms = vpu_ops / (chip["vpu_teraops"] * 1e12) * 1e3
+
+    # --- HBM: q in + o out once; k/v streamed once per EXECUTED tile.
+    # Dead-tile index-map clamping (_clamped_kv_index_map) is what makes
+    # the causal discount real — without it every dead tile still paid
+    # its DMA.
+    qo_bytes = 2.0 * bh * s * d * 2          # bf16 in + out
+    kv_bytes = 2.0 * bh * live_tiles * block_k * d * 2
+    hbm_ms = (qo_bytes + kv_bytes) / (chip["hbm_gbps"] * 1e9) * 1e3
+
+    kernel_ms = max(mxu_ms, vpu_ms, hbm_ms)
+    bound_by = {mxu_ms: "mxu", vpu_ms: "vpu", hbm_ms: "hbm"}[kernel_ms]
+    ceiling = flops / (kernel_ms * 1e-3) / (chip["mxu_tflops"] * 1e12)
+    with_floor = flops / ((kernel_ms + relay_floor_ms) * 1e-3) \
+        / (chip["mxu_tflops"] * 1e12)
+    return Roofline(
+        chip=chip["name"], batch=batch, seq=seq, heads=heads,
+        head_dim=head_dim, causal=causal, block_q=block_q, block_k=block_k,
+        flops=flops, mxu_ms=mxu_ms, vpu_ms=vpu_ms, hbm_ms=hbm_ms,
+        kernel_ms=kernel_ms, bound_by=bound_by, ceiling_mfu=ceiling,
+        relay_floor_ms=relay_floor_ms,
+        measured_mfu_with_floor=with_floor)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(description="flash-attention roofline")
+    ap.add_argument("--seqs", default="1024,4096,8192,16384")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--block", type=int, default=256)
+    ap.add_argument("--relay-floor-ms", type=float, default=8.0)
+    args = ap.parse_args(argv)
+
+    print(f"{'S':>6} {'kernel':>9} {'bound':>6} {'ceil MFU':>9} "
+          f"{'w/ 8ms floor':>13}")
+    for s in (int(x) for x in args.seqs.split(",")):
+        r = model(seq=s, batch=args.batch, heads=args.heads,
+                  head_dim=args.head_dim, block_q=args.block,
+                  block_k=args.block,
+                  relay_floor_ms=args.relay_floor_ms)
+        print(f"{s:>6} {r.kernel_ms:>7.2f}ms {r.bound_by:>6} "
+              f"{r.ceiling_mfu * 100:>8.1f}% "
+              f"{r.measured_mfu_with_floor * 100:>12.1f}%")
+        print("ROOFLINE_JSON " + json.dumps(r.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

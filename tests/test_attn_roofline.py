@@ -1,0 +1,48 @@
+"""The roofline model's accounting must agree with the bench's."""
+
+import json
+import subprocess
+import sys
+
+from k3stpu.ops.attn_bench import _attn_flops
+from k3stpu.ops.attn_roofline import V5E, model
+
+
+def test_flops_match_the_bench_accounting():
+    # The model must credit exactly the flops the bench divides by —
+    # otherwise the doc's MFU ceilings and the captured ATTN_JSON MFUs
+    # are not comparable numbers.
+    for s in (1024, 4096, 16384):
+        r = model(seq=s, batch=8, heads=8, head_dim=128, causal=True)
+        assert r.flops == _attn_flops(8, s, 8, 128, True, False)
+
+
+def test_bound_transitions_and_monotonic_ceiling():
+    # Short S: k/v restreaming is amortized over few q tiles -> HBM wall.
+    assert model(seq=1024).bound_by == "hbm"
+    # Long S: the softmax elementwise work dominates -> VPU wall.
+    assert model(seq=8192).bound_by == "vpu"
+    # Ceiling MFU never exceeds 1 and the dispatch floor only hurts.
+    for s in (1024, 4096, 8192):
+        r = model(seq=s)
+        assert 0 < r.ceiling_mfu <= 1.0
+        assert r.measured_mfu_with_floor < r.ceiling_mfu
+
+
+def test_kernel_time_is_max_of_units():
+    r = model(seq=4096)
+    assert r.kernel_ms == max(r.mxu_ms, r.vpu_ms, r.hbm_ms)
+
+
+def test_cli_emits_roofline_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "k3stpu.ops.attn_roofline",
+         "--seqs", "2048"],
+        capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0
+    lines = [l for l in out.stdout.splitlines()
+             if l.startswith("ROOFLINE_JSON ")]
+    assert len(lines) == 1
+    rec = json.loads(lines[0].split(" ", 1)[1])
+    assert rec["chip"] == V5E["name"]
+    assert rec["bound_by"] in ("mxu", "vpu", "hbm")
