@@ -88,6 +88,49 @@ def spmd_flash_check(interpret: bool = False, seq: int = 512,
     return out
 
 
+def cp_flash_check(interpret: bool = False, seq: int = 512,
+                   batch: int = 2, heads: int = 4,
+                   head_dim: int = 64) -> dict:
+    """Context-parallel attention (ring + zigzag + Ulysses,
+    parallel/context.py) COMPILED on the local devices vs the einsum
+    oracle. On a 1-chip pod the mesh is 1-device — collectives are
+    trivial but the per-shard Pallas kernel and the shard_map programs
+    compile for real, which the interpret-mode CPU tests never prove."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+
+    from k3stpu.ops.attention import reference_attention
+    from k3stpu.parallel.context import context_parallel_attention
+
+    devs = np.asarray(jax.devices())
+    mesh = Mesh(devs, ("seq",))
+    n = len(devs)
+    ks = jax.random.split(jax.random.key(13), 3)
+    # Round shapes to the impls' real constraints: zigzag splits each
+    # device's shard into an early+late chunk pair (seq % 2n == 0), and
+    # Ulysses all-to-alls heads across the mesh (heads % n == 0).
+    seq = -(-max(seq, 128 * n) // (2 * n)) * (2 * n)
+    heads = -(-heads // n) * n
+    shape = (batch, seq, heads, head_dim)
+    q, k, v = (jax.random.normal(kk, shape, jnp.bfloat16) for kk in ks)
+
+    oracle = np.asarray(jax.jit(lambda q, k, v: reference_attention(
+        q, k, v, causal=True))(q, k, v), np.float32)
+
+    out = {"mesh": f"seq:{n}", "seq": seq, "batch": batch, "heads": heads,
+           "head_dim": head_dim}
+    for name in ("flash", "zigzag", "ulysses"):
+        got = np.asarray(context_parallel_attention(
+            mesh, q, k, v, impl=name, interpret=interpret), np.float32)
+        out[f"{name}_max_err"] = float(np.max(np.abs(got - oracle)))
+    out["ok"] = all(out[f"{m}_max_err"] < 5e-2
+                    for m in ("flash", "zigzag", "ulysses"))
+    return out
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="K3S-TPU probe (nvidia-smi parity)")
     ap.add_argument("--m", type=int, default=8192, help="matmul dimension")
@@ -158,6 +201,18 @@ def main(argv: list[str] | None = None) -> int:
               f"fwd_err={chk_spmd['fwd_max_err']:.2e} "
               f"dq_err={chk_spmd['dq_max_err']:.2e} ok={chk_spmd['ok']}")
         print("SPMD_ATTN_JSON " + json.dumps(chk_spmd))
+
+        # Context-parallel paths (ring/zigzag/Ulysses) compiled on the
+        # local mesh — the long-context shard programs' first compiled
+        # execution happens HERE, not on some future multi-chip slice.
+        chk_cp = (cp_flash_check(interpret=False) if ok else
+                  cp_flash_check(interpret=True, seq=128, heads=2,
+                                 head_dim=32))
+        print(f"cp attn mesh={chk_cp['mesh']}: "
+              + " ".join(f"{m}_err={chk_cp[f'{m}_max_err']:.2e}"
+                         for m in ("flash", "zigzag", "ulysses"))
+              + f" ok={chk_cp['ok']}")
+        print("CP_ATTN_JSON " + json.dumps(chk_cp))
 
         # Compiled-vs-oracle correctness first (interpret-mode on CPU): the
         # bench numbers below only count if the compiled kernel is right.

@@ -319,6 +319,18 @@ def test_flash_under_pjit_mesh_matches_oracle():
                                      - b_.astype(jnp.float32)))) < 2e-2
 
 
+def test_cp_flash_check_on_mesh():
+    """The probe's context-parallel oracle (k3stpu/probe.py:cp_flash_check)
+    on the 8-device CPU mesh: ring flash, zigzag, and Ulysses all agree
+    with the einsum oracle through the real shard_map programs."""
+    from k3stpu.probe import cp_flash_check
+
+    out = cp_flash_check(interpret=True, seq=256, batch=2, heads=8,
+                         head_dim=32)
+    assert out["ok"], out
+    assert out["mesh"] == "seq:8"
+
+
 def test_spmd_flash_check_on_mesh():
     """The probe's SPMD oracle (k3stpu/probe.py:spmd_flash_check): flash
     fwd+grad THROUGH the custom_partitioning rule on the 8-device CPU mesh

@@ -84,14 +84,29 @@ def backend_reachable() -> bool:
     return False
 
 
+def _oracle_ok(out: str, marker: str) -> bool:
+    """True iff `marker` appears AND its JSON payload says ok: a failing
+    numeric oracle must not count as a captured proof."""
+    for line in out.splitlines():
+        if marker in line:
+            try:
+                payload = line.split(marker, 1)[1].strip()
+                doc, _ = json.JSONDecoder().raw_decode(payload)
+                return bool(doc.get("ok"))
+            except (ValueError, json.JSONDecodeError):
+                return False
+    return False
+
+
 def stage_probe(log):
     # No --iters override: the probe's default IS bench.py's (one shared
     # measurement core, ops/matmul.py) so the two numbers are comparable.
     rc, out = _run_bounded(
         [sys.executable, "-m", "k3stpu.probe", "--attn"],
         1800, log)
-    return (rc == 0 and "ATTN_JSON" in out and "ATTN_CHECK_JSON" in out
-            and "SPMD_ATTN_JSON" in out)
+    return (rc == 0 and "ATTN_JSON" in out
+            and all(_oracle_ok(out, m) for m in
+                    ("ATTN_CHECK_JSON", "SPMD_ATTN_JSON", "CP_ATTN_JSON")))
 
 
 def stage_share(log):
