@@ -56,19 +56,31 @@ def _pow2_at_least(n: int, lo: int = 1) -> int:
 def _sample_rows(logits, temps, topks, topps, key):
     """Per-row sampling over (B, V) logits: temperature <= 0 is greedy;
     top-k cuts below each row's own k-th value (k == V disables); top-p
-    keeps each row's smallest nucleus reaching mass p (1.0 disables)."""
+    keeps each row's smallest nucleus reaching mass p (1.0 disables).
+
+    The all-greedy batch — the dominant serving case, and every decode
+    step of the exactness-pinned capture runs — skips the sampling
+    machinery entirely via ``lax.cond``: the mixed path pays two full
+    (B, V) sorts (top-k kth-value + top-p nucleus) per step, pure
+    VPU/HBM waste when no row will use the result."""
     from k3stpu.models.generate import top_p_mask
 
-    v = logits.shape[-1]
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
-    srt = jnp.sort(scaled, axis=-1)
-    kth = jnp.take_along_axis(
-        srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
-    cut = jnp.where(scaled < kth, _NEG_INF, scaled)
-    cut = top_p_mask(cut, topps)
-    sampled = jax.random.categorical(key, cut, axis=-1).astype(jnp.int32)
-    return jnp.where(temps <= 0.0, greedy, sampled)
+
+    def mixed(_):
+        v = logits.shape[-1]
+        scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
+        srt = jnp.sort(scaled, axis=-1)
+        kth = jnp.take_along_axis(
+            srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
+        cut = jnp.where(scaled < kth, _NEG_INF, scaled)
+        cut = top_p_mask(cut, topps)
+        sampled = jax.random.categorical(key, cut,
+                                         axis=-1).astype(jnp.int32)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(jnp.all(temps <= 0.0), lambda _: greedy, mixed,
+                        None)
 
 
 class _Request:
@@ -503,10 +515,16 @@ class GenerateEngine:
 
     def _finish_row(self, r: int) -> None:
         self._active[r] = False
+        # Reset the slot's sampling temp: inactive rows still ride the
+        # decode batch, and one stale temp>0 would disable the all-greedy
+        # lax.cond fast path in _sample_rows for every later step until
+        # the slot is reused.
+        self._temps[r] = 0.0
 
     def _fail_request(self, req: "_Request", err: Exception) -> None:
         for r in req.slot_rows:
             self._active[r] = False
+            self._temps[r] = 0.0  # keep the all-greedy fast path alive
             self._owner[r] = None
             self._collected[r] = []
         req.error = err

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 import time
 
@@ -104,7 +105,11 @@ def stage_probe(log):
     rc, out = _run_bounded(
         [sys.executable, "-m", "k3stpu.probe", "--attn"],
         1800, log)
-    return (rc == 0 and "ATTN_JSON" in out
+    # Line-anchored: "SPMD_ATTN_JSON"/"CP_ATTN_JSON" contain "ATTN_JSON"
+    # as a substring, so a bare `in` check could pass with zero actual
+    # per-shape bench lines.
+    has_bench = re.search(r"^ATTN_JSON ", out, re.M) is not None
+    return (rc == 0 and has_bench
             and all(_oracle_ok(out, m) for m in
                     ("ATTN_CHECK_JSON", "SPMD_ATTN_JSON", "CP_ATTN_JSON")))
 
