@@ -48,6 +48,14 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LANES = 128  # TPU lane width: trailing dim of any VMEM tile
+# exp(x) lowers to exp2(x * log2(e)) — a full-tile VPU multiply per call.
+# The kernels work in the log2 domain instead: log2(e) folds into the
+# softmax scale (a compile-time constant on the O(S d) q side / the
+# per-tile s multiply the bwd already pays), and every O(S^2) exp becomes
+# a raw exp2. The VPU is the binding wall at S >= 4096 (docs/
+# ATTN_ROOFLINE.md), so the saved pass lands on the critical path.
+_LOG2E = 1.4426950408889634
+_LN2 = 0.6931471805599453
 
 # Default q/k tile edge; callers gating on shape divisibility (e.g. the
 # transformer's Attention) should test against this, not a literal.
@@ -157,7 +165,7 @@ def _clamped_q_index_map(block_q: int, block_k: int, nq: int, offset: int,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                  scale: float, causal: bool, block_q: int, block_k: int,
+                  causal: bool, block_q: int, block_k: int,
                   offset: int, window: "int | None", with_lse: bool):
     if with_lse:
         lse_ref, m_ref, l_ref, acc_ref = rest
@@ -184,21 +192,24 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         k = k_ref[0]                      # (block_k, d) bf16
         v = v_ref[0]                      # (block_k, d) bf16
 
+        # The caller folded scale * log2(e) into q — s arrives in the
+        # log2 domain with no per-tile multiply owed here.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )                                  # (block_q, block_k) fp32
-        if scale != 1.0:  # the fwd folds scale into q; bwd passes it here
-            s = s * scale
 
         if causal:
             s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
 
+        # s is in the LOG2 domain (log2(e) folded into the scale by the
+        # caller), so the softmax runs on raw exp2 — no per-element
+        # log2(e) multiply inside the exp lowering.
         m_prev = m_ref[:, :1]                             # (block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)                   # (block_q, 1)
-        p = jnp.exp(s - m_new)                            # (block_q, block_k)
+        alpha = jnp.exp2(m_prev - m_new)                  # (block_q, 1)
+        p = jnp.exp2(s - m_new)                           # (block_q, block_k)
         if causal and offset < 0:
             # Only when s_q > s_kv can a q row be masked in EVERY tile
             # (r + offset < 0): such a row's s stays at the finite _NEG_INF,
@@ -227,7 +238,12 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         denom = jnp.where(l == 0.0, 1.0, l)
         o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
         if with_lse:
-            lse = jnp.where(l > 0.0, m + jnp.log(denom), _NEG_INF)
+            # m is log2-domain; convert so the emitted lse stays NATURAL
+            # log (the residual layout every consumer — the backward,
+            # ring-attention combiners — expects). Row-wise O(block_q):
+            # noise next to the O(S^2) passes the domain change removed.
+            lse = jnp.where(l > 0.0,
+                            (m + jnp.log2(denom)) * _LN2, _NEG_INF)
             lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
 
 
@@ -258,16 +274,16 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
             f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
             f"({block_q}, {block_k})")
 
-    # Fold the softmax scale into q up front: one multiply over O(S d)
-    # instead of a VPU pass over every O(S^2) logits tile (the scaled q
-    # is reused across the whole k sweep). bf16 rounding of scaled q is
-    # ~0.4% relative — inside the kernel's bf16 IO tolerance.
-    if scale != 1.0:
-        q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    # Fold the softmax scale AND log2(e) into q up front: one multiply
+    # over O(S d) instead of a VPU pass over every O(S^2) logits tile
+    # (the scaled q is reused across the whole k sweep), and the log2
+    # domain turns every in-kernel exp into a raw exp2. bf16 rounding of
+    # scaled q is ~0.4% relative — inside the kernel's bf16 IO tolerance.
+    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
 
     grid = (bh, s_q // block_q, s_kv // block_k)
     kernel = functools.partial(
-        _flash_kernel, scale=1.0, causal=causal,
+        _flash_kernel, causal=causal,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
         window=window, with_lse=with_lse)
 
@@ -358,13 +374,17 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
         di = di_ref[0][:, :1]              # (block_q, 1) fp32
 
+        # Log2-domain recompute: the s multiply is paid either way, so
+        # scale carries log2(e) too and p comes from a raw exp2 against
+        # the pre-converted lse (caller multiplies the residual by
+        # log2(e) once, O(S) — the O(S^2) in-exp multiply is gone).
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if causal:
             s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
-        p = jnp.exp(s - lse)               # (block_q, block_k) probs
+        p = jnp.exp2(s - lse)              # (block_q, block_k) probs
 
         # dV += P^T dO
         dv_acc[:] += jax.lax.dot_general(
@@ -413,13 +433,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
         lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
         di = di_ref[0][:, :1]
 
+        # Same log2-domain recompute as the dK/dV kernel.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32) * (scale * _LOG2E)
         if causal:
             s = _masked_if_needed(s, qi, ki, block_q, block_k, offset,
                                   window)
-        p = jnp.exp(s - lse)
+        p = jnp.exp2(s - lse)
 
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -456,6 +477,12 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
     # the standard 128-lane residual layout.
     di = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     di = jnp.broadcast_to(di[..., None], (bh, s_q, _LANES))
+
+    # The natural-log residual converts to the kernels' log2 domain ONCE
+    # here (O(S) elementwise) so every O(S^2) p-recompute is a raw exp2.
+    # -inf rows scale to a bigger -inf: the kernels' fully-masked guard
+    # (lse > _NEG_INF/2) still catches them.
+    lse = lse * _LOG2E
 
     # Dead q iterations for a k tile (tiles above the diagonal sweep first)
     # are clamped onto the first live q tile so their DMAs are elided.

@@ -49,10 +49,13 @@ V5E = {
     "vpu_teraops": 3.85,
 }
 
-# exp() on the VPU is not 1 op/element; Mosaic lowers it to a polynomial +
-# scale sequence. 6 is the planning number used throughout (order-of-
-# magnitude right; the conclusion is insensitive to +-2).
-EXP_OPS = 6.0
+# exp2() on the VPU is not 1 op/element; Mosaic lowers it to a polynomial
+# sequence. 5 is the planning number used throughout (order-of-magnitude
+# right; the conclusion is insensitive to +-2). The kernel works in the
+# log2 domain (log2(e) folded into the softmax scale, attention.py:_LOG2E)
+# precisely so this is raw exp2 — a natural exp would add one more
+# full-tile multiply inside the lowering.
+EXP_OPS = 5.0
 
 # Full-tile VPU passes per LIVE logits tile in the fwd kernel
 # (ops/attention.py:_flash_kernel): tile max + running max merge (1),

@@ -20,8 +20,12 @@ def test_flops_match_the_bench_accounting():
 def test_bound_transitions_and_monotonic_ceiling():
     # Short S: k/v restreaming is amortized over few q tiles -> HBM wall.
     assert model(seq=1024).bound_by == "hbm"
-    # Long S: the softmax elementwise work dominates -> VPU wall.
-    assert model(seq=8192).bound_by == "vpu"
+    # Long S with the log2-domain kernel: the three walls are a near-tie
+    # (no unit more than 40% over the cheapest) — the headline claim the
+    # doc makes about why the kernel design is balanced.
+    r = model(seq=8192)
+    units = (r.mxu_ms, r.vpu_ms, r.hbm_ms)
+    assert max(units) / min(units) < 1.4, units
     # Ceiling MFU never exceeds 1 and the dispatch floor only hurts.
     for s in (1024, 4096, 8192):
         r = model(seq=s)
