@@ -83,6 +83,8 @@ class _ShardView:
         if step != 1:
             raise ValueError("shard views read contiguous slices only")
         lo, hi = self._start + a, self._start + b
+        if hi <= lo:  # empty slice: mirror numpy, don't crash concatenate
+            return np.empty((0,), self._shards[0].dtype)
         out = []
         i = int(np.searchsorted(self._cum, lo, side="right")) - 1
         while lo < hi:

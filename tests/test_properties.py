@@ -1,0 +1,162 @@
+"""Property-based tests (hypothesis) for the pure invariants the stack
+leans on.
+
+These functions are small but load-bearing: the causal tile predicates
+decide which kernel tiles skip masking/compute/DMA (a wrong predicate is
+silent garbage attention), the width bucket is the contract between
+server validation and engine admission, and top_p_mask is the sampling
+cut every generate path shares. Example-based tests pin known cases;
+these pin the ALGEBRA over the whole input space.
+"""
+
+import numpy as np
+import pytest
+
+hypothesis = pytest.importorskip(
+    "hypothesis", reason="property tests need hypothesis (present in the "
+    "dev image; optional everywhere else — skip-when-absent like helm)")
+from hypothesis import given, settings, strategies as st  # noqa: E402
+
+# Deterministic, CI-sized: the default profile is plenty here because
+# every property is O(block^2) numpy at most.
+settings.register_profile("ci", max_examples=60, deadline=None)
+settings.load_profile("ci")
+
+blocks = st.sampled_from([8, 16, 32, 64, 128, 256])
+small = st.integers(min_value=0, max_value=16)
+
+
+# --- causal tile predicates (ops/attention.py) --------------------------
+
+def _brute_mask(qi, ki, bq, bk, offset, window):
+    """Element-level truth: live[r, c] for the (qi, ki) tile."""
+    rows = qi * bq + np.arange(bq)[:, None] + offset
+    cols = ki * bk + np.arange(bk)[None, :]
+    live = rows >= cols
+    if window is not None:
+        live &= cols > rows - window
+    return live
+
+
+@given(qi=small, ki=small, bq=blocks, bk=blocks,
+       offset=st.integers(min_value=-64, max_value=64),
+       window=st.one_of(st.none(), st.integers(min_value=1, max_value=512)))
+def test_tile_predicates_match_elementwise_truth(qi, ki, bq, bk, offset,
+                                                 window):
+    from k3stpu.ops.attention import (
+        _causal_tile_live,
+        _causal_tile_needs_mask,
+    )
+
+    truth = _brute_mask(qi, ki, bq, bk, offset, window)
+    live = bool(_causal_tile_live(qi, ki, bq, bk, offset, window))
+    needs = bool(_causal_tile_needs_mask(qi, ki, bq, bk, offset, window))
+
+    # live is exact for the no-window upper-triangle side: a tile with
+    # any live element MUST be marked live (skipping it would drop real
+    # attention mass — the unforgivable direction).
+    if truth.any():
+        assert live, "live tile marked dead: real attention mass dropped"
+    if window is None and not truth.any():
+        assert not live, "dead tile marked live (pure waste)"
+    # needs_mask must hold whenever a LIVE tile contains any masked
+    # element — skipping the mask there corrupts the softmax.
+    if live and not truth.all():
+        assert needs, "partially-masked tile skipped masking"
+
+
+@given(qi=small, ki=small, bq=blocks, bk=blocks,
+       offset=st.integers(min_value=-64, max_value=64),
+       window=st.one_of(st.none(), st.integers(min_value=1, max_value=512)))
+def test_masked_tile_values_match_elementwise_truth(qi, ki, bq, bk,
+                                                    offset, window):
+    """_causal_tile_mask itself: kept entries pass through, masked ones
+    land at the -inf sentinel — elementwise, against the brute mask."""
+    import jax.numpy as jnp
+
+    from k3stpu.ops.attention import _NEG_INF, _causal_tile_mask
+
+    s = jnp.asarray(np.random.default_rng(0).standard_normal((bq, bk)),
+                    jnp.float32)
+    got = np.asarray(_causal_tile_mask(s, qi, ki, bq, bk, offset, window))
+    truth = _brute_mask(qi, ki, bq, bk, offset, window)
+    np.testing.assert_array_equal(got == np.asarray(s), truth)
+    assert (got[~truth] == _NEG_INF).all()
+
+
+# --- prompt width bucket (serve/programs.py) ----------------------------
+
+@given(max_len=st.integers(min_value=1, max_value=1 << 14),
+       max_seq=st.sampled_from([64, 128, 1024, 1 << 14]))
+def test_prompt_width_bucket_contract(max_len, max_seq):
+    from k3stpu.serve.programs import prompt_width_bucket
+
+    w = prompt_width_bucket(max_len, max_seq)
+    assert w & (w - 1) == 0, "bucket must be a power of two"
+    assert w <= max_seq
+    # The server/engine contract: a prompt fits its bucket unless the
+    # cache itself is the binding constraint.
+    assert w >= min(max_len, max_seq)
+    # Monotone: longer prompts never get smaller buckets.
+    assert prompt_width_bucket(max_len + 1, max_seq) >= w
+
+
+# --- top-p nucleus mask (models/generate.py) ----------------------------
+
+@given(
+    logits=st.lists(
+        st.floats(min_value=-20, max_value=20, allow_nan=False),
+        min_size=2, max_size=64),
+    p=st.floats(min_value=0.05, max_value=1.0),
+)
+def test_top_p_mask_keeps_smallest_sufficient_nucleus(logits, p):
+    import jax.numpy as jnp
+
+    from k3stpu.models.generate import top_p_mask
+
+    row = jnp.asarray([logits], jnp.float32)
+    out = np.asarray(top_p_mask(row, p))[0]
+    kept = out > -1e29
+    assert kept.any(), "top-p must always keep at least the argmax"
+    assert kept[np.argmax(logits)], "argmax must survive any p"
+    probs = np.exp(logits - np.max(logits))
+    probs = probs / probs.sum()
+    kept_mass = probs[kept].sum()
+    # Kept set reaches the target mass...
+    assert kept_mass >= min(p, 1.0) - 1e-4
+    # ...and is minimal up to ties: dropping EVERY kept entry tied at
+    # the minimum kept probability must dip below p (ties at the cut
+    # boundary are all kept — a deliberate property of the threshold
+    # formulation, and the right call: arbitrary tie-breaking would make
+    # the nucleus depend on sort order).
+    if kept.sum() > 1:
+        weakest_p = np.min(probs[kept])
+        tied_mass = probs[kept & np.isclose(probs, weakest_p, atol=1e-9)]
+        assert kept_mass - tied_mass.sum() < p + 1e-4
+
+
+# --- sharded corpus view (data/corpus.py) -------------------------------
+
+@given(
+    sizes=st.lists(st.integers(min_value=1, max_value=40), min_size=1,
+                   max_size=6),
+    data=st.data(),
+)
+def test_shard_view_slices_match_concatenation(sizes, data):
+    from k3stpu.data.corpus import _ShardView
+
+    rng = np.random.default_rng(7)
+    shards = [rng.integers(0, 1000, size=n).astype(np.uint16)
+              for n in sizes]
+    cum = np.concatenate([[0], np.cumsum([len(s) for s in shards])])
+    full = np.concatenate(shards)
+    view = _ShardView(shards, cum, 0, int(cum[-1]))
+    assert len(view) == len(full)
+
+    a = data.draw(st.integers(min_value=0, max_value=len(full)))
+    b = data.draw(st.integers(min_value=a, max_value=len(full)))
+    np.testing.assert_array_equal(np.asarray(view[a:b]), full[a:b])
+    # Sub-windows compose.
+    if b > a:
+        w = view.window(a, b)
+        np.testing.assert_array_equal(np.asarray(w[0:b - a]), full[a:b])
