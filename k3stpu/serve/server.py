@@ -810,6 +810,8 @@ class InferenceServer:
             lines += [
                 "# TYPE k3stpu_engine_decode_steps_total counter",
                 f"k3stpu_engine_decode_steps_total {e['steps']}",
+                "# TYPE k3stpu_engine_dispatches_total counter",
+                f"k3stpu_engine_dispatches_total {e['dispatches']}",
                 "# TYPE k3stpu_engine_tokens_total counter",
                 f"k3stpu_engine_tokens_total {e['tokens']}",
                 "# TYPE k3stpu_engine_busy_seconds_total counter",

@@ -45,7 +45,10 @@ PY
       exit 0
     fi
     echo "$(date -u +%H:%M:%S) tunnel healthy -> capturing stages: $STAGES"
-    python tools/capture_artifacts.py --round "$ROUND" --stages "$STAGES"
+    # The capture honors the deadline itself (clamped subprocess bounds,
+    # stage skips); 0 means "no deadline" on both sides.
+    K3STPU_CAPTURE_DEADLINE="$DEADLINE_EPOCH" \
+      python tools/capture_artifacts.py --round "$ROUND" --stages "$STAGES"
     rc=$?
     echo "$(date -u +%H:%M:%S) capture exited rc=$rc"
     touch "$MARKER"

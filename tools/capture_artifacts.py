@@ -45,12 +45,32 @@ _CACHE_ENV = {
     "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0.5",
 }
 
+# Hard stop (unix epoch) the CAPTURE itself honors — set by
+# auto_capture.sh from its own deadline. The watcher's start-margin
+# alone can't stop a long stage (the tune sweep's worst case is ~45 min)
+# from spilling past the round-end bench and contending for the chip:
+# every subprocess bound is clamped to the remaining time, and stages
+# that can't get a useful slice are skipped with a structured event.
+_DEADLINE = float(os.environ.get("K3STPU_CAPTURE_DEADLINE", "0")) or None
+
 _PROBE_SRC = ("import jax; ds = jax.devices(); "
               "print('PROBE_OK', ds[0].platform, len(ds))")
 
 
 def _run_bounded(cmd, timeout_s, log_path=None, env=None):
     """Bounded group-killed run (k3stpu/utils/subproc) + combined-output log."""
+    if _DEADLINE is not None:
+        # Clamp to remaining-minus-margin so the child AND its
+        # group-kill teardown finish before the deadline instant.
+        remaining = _DEADLINE - time.time() - 60
+        if remaining < 60:
+            msg = (f"[capture] skipped (deadline in "
+                   f"{remaining + 60:.0f}s): {' '.join(cmd)}\n")
+            if log_path:
+                with open(log_path, "a") as f:
+                    f.write(msg)
+            return None, msg
+        timeout_s = min(timeout_s, int(remaining))
     env = dict(os.environ if env is None else env)
     for k, v in _CACHE_ENV.items():
         env.setdefault(k, v)
@@ -257,10 +277,18 @@ def _render_tpu_info(log, tpu_info_bin, root) -> bool:
 def stage_tune(log):
     """Block-size sweep on the chip: the winner calibrates DEFAULT_BLOCK
     (ops/attention.py) — committed as an artifact so the choice is a
-    measurement, not a guess."""
+    measurement, not a guess. The full 16-combo fwd+bwd sweep is ~32
+    cold compiles; if it blows its bound on a cold cache, salvage with
+    the 3-point square fwd-only sweep (whose compiles the full attempt
+    likely already cached) so the window still yields a calibration."""
     rc, out = _run_bounded(
         [sys.executable, "-m", "k3stpu.ops.attn_tune", "--seq", "4096",
          "--batch", "8"], 1800, log)
+    if rc == 0 and "ATTN_TUNE_BEST" in out:
+        return True
+    rc, out = _run_bounded(
+        [sys.executable, "-m", "k3stpu.ops.attn_tune", "--seq", "4096",
+         "--batch", "8", "--fast", "--fwd-only"], 900, log)
     return rc == 0 and "ATTN_TUNE_BEST" in out
 
 
@@ -285,6 +313,13 @@ def main(argv=None) -> int:
 
     results = {}
     for name in args.stages.split(","):
+        if _DEADLINE is not None and time.time() > _DEADLINE - 120:
+            # Not enough runway for a useful stage: leave its existing
+            # artifact (if any) untouched rather than truncating it.
+            print(json.dumps({"event": "stage_skipped", "stage": name,
+                              "reason": "deadline"}), flush=True)
+            results[name] = False
+            continue
         log = os.path.join(REPO, "artifacts", f"{name}_r{args.round:02d}.log")
         open(log, "w").close()  # fresh file per capture
         t0 = time.time()
