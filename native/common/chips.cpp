@@ -226,7 +226,11 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
   // crashed server) leaves its last snapshot behind, and presenting hours-
   // old bytes_in_use as live would be worse than "n/a".
   constexpr long long kMaxDropAgeS = 120;
-  struct Live { long long used = -1, total = -1; int duty = -1; };
+  struct Live {
+    long long used = -1, total = -1;
+    int duty = -1;
+    bool est = false;
+  };
   std::vector<Live> live;
   std::ifstream f(root + kMetricsDropPath);
   if (f) {
@@ -259,6 +263,8 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
           Live l;
           if (auto v = d->get("bytes_in_use")) l.used = as_ll(v);
           if (auto v = d->get("bytes_limit")) l.total = as_ll(v);
+          if (auto v = d->get("source"))
+            l.est = v->is_string() && v->str_v == "live_arrays";
           if (auto v = d->get("duty_cycle_pct"))
             l.duty = static_cast<int>(as_ll(v));
           long long idx = -1;
@@ -286,7 +292,10 @@ void fill_telemetry(std::vector<TpuChip>& chips, const std::string& root_in) {
     // 2) workload drop file
     if (static_cast<size_t>(chip.index) < live.size()) {
       const Live& l = live[chip.index];
-      if (chip.mem_used_bytes < 0) chip.mem_used_bytes = l.used;
+      if (chip.mem_used_bytes < 0) {
+        chip.mem_used_bytes = l.used;
+        chip.mem_estimated = l.used >= 0 && l.est;
+      }
       if (chip.mem_total_bytes < 0) chip.mem_total_bytes = l.total;
       if (chip.duty_cycle_pct < 0 && l.duty >= 0 && l.duty <= 100)
         chip.duty_cycle_pct = l.duty;

@@ -35,6 +35,12 @@ struct TpuChip {
   long long mem_total_bytes = -1;
   long long mem_used_bytes = -1;
   int duty_cycle_pct = -1;
+  // True when mem_used_bytes came from client-side accounting (the drop
+  // file's source == "live_arrays" — the writer's own live-array sum, an
+  // honest lower bound used when PJRT memory_stats() is empty). Rendered
+  // with a '~' prefix so the reader knows it is an estimate, not
+  // allocator truth.
+  bool mem_estimated = false;
 };
 
 inline constexpr const char* kGoogleVendorId = "0x1ae0";

@@ -32,11 +32,15 @@ void usage() {
 // side may be unknown ("n/a / 16384MiB", "1024MiB / n/a"); whole cell "n/a"
 // only when both are — live used-bytes must not vanish because the
 // generation's total is unreported (v2/v3 report -1).
-std::string mem_cell(long long used, long long total) {
+std::string mem_cell(long long used, long long total,
+                     bool estimated = false) {
   if (total < 0 && used < 0) return "n/a";
   auto mib = [](long long b) { return std::to_string(b >> 20) + "MiB"; };
-  return (used < 0 ? std::string("n/a") : mib(used)) + " / " +
-         (total < 0 ? std::string("n/a") : mib(total));
+  // '~' marks client-side accounting (drop-file source=live_arrays):
+  // an honest lower bound, not allocator truth.
+  return (used < 0 ? std::string("n/a")
+                   : (estimated ? "~" : "") + mib(used)) +
+         " / " + (total < 0 ? std::string("n/a") : mib(total));
 }
 
 std::string util_cell(int pct) {
@@ -64,6 +68,7 @@ int run(const std::string& root, bool as_json) {
       o->set("numa", Value::make_int(c.numa_node));
       // -1 == unavailable, mirroring the "n/a" cells of the human table.
       o->set("mem_used_bytes", Value::make_int(c.mem_used_bytes));
+      o->set("mem_estimated", Value::make_bool(c.mem_estimated));
       o->set("mem_total_bytes", Value::make_int(c.mem_total_bytes));
       o->set("duty_cycle_pct", Value::make_int(c.duty_cycle_pct));
       auto devs = o->ensure_array("dev_paths");
@@ -93,7 +98,9 @@ int run(const std::string& root, bool as_json) {
                     "| %3d | %-13s | %-10s | %4d | %-20s | %4s | %-15s |",
                     c.index, c.pci_address.c_str(), c.generation.c_str(),
                     c.numa_node,
-                    mem_cell(c.mem_used_bytes, c.mem_total_bytes).c_str(),
+                    mem_cell(c.mem_used_bytes, c.mem_total_bytes,
+                             c.mem_estimated)
+                        .c_str(),
                     util_cell(c.duty_cycle_pct).c_str(), devs.c_str());
       std::cout << line << "\n";
     }

@@ -229,6 +229,36 @@ def test_hbm_limit_respects_mem_fraction(monkeypatch):
     assert telemetry._hbm_limit_for(Dev()) == 16 * 1024**3
 
 
+def test_estimated_memory_renders_tilde(info_bin, fake_host_root):
+    """A drop file whose source is client-side accounting
+    (source=live_arrays) renders MEMORY with a '~' prefix and sets
+    mem_estimated in JSON — the reader must be able to tell an honest
+    lower bound from allocator truth (PJRT stats render unmarked)."""
+    run_dir = fake_host_root / "run" / "k3stpu"
+    run_dir.mkdir(parents=True)
+    (run_dir / "metrics.json").write_text(json.dumps({
+        "ts": int(time.time()),
+        "devices": [
+            {"index": 0, "bytes_in_use": 512 * 1024**2,
+             "bytes_limit": 16 * 1024**3, "duty_cycle_pct": 40,
+             "source": "live_arrays"},
+            {"index": 1, "bytes_in_use": 256 * 1024**2,
+             "bytes_limit": 16 * 1024**3, "duty_cycle_pct": 10,
+             "source": "pjrt"},
+        ],
+    }))
+    doc = json.loads(subprocess.run(
+        [info_bin, "--json", "--host-root", str(fake_host_root)],
+        capture_output=True, text=True).stdout)
+    assert doc["chips"][0]["mem_estimated"] is True
+    assert doc["chips"][1]["mem_estimated"] is False
+    human = subprocess.run([info_bin, "--host-root", str(fake_host_root)],
+                           capture_output=True, text=True).stdout
+    assert "~512MiB / 16384MiB" in human
+    assert "256MiB / 16384MiB" in human
+    assert "~256MiB" not in human
+
+
 def test_stale_drop_file_ignored(info_bin, fake_host_root):
     # A snapshot from an exited workload must not render as live data.
     run_dir = fake_host_root / "run" / "k3stpu"
