@@ -99,17 +99,39 @@ def _worker() -> int:
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
-    dim = 8192 if on_accel else 512
+    # The HEADLINE stays pinned to 8192^3 — the shape the probe measures
+    # and every prior round's BENCH used, so the trend is apples to
+    # apples (the round-3 lesson: harness deltas masquerade as hardware
+    # deltas). 16384^3 is measured additionally on real hardware and
+    # reported alongside; its compile hits the persistent cache on
+    # re-runs. A failure in one shape (e.g. an OOM or tunnel flake on
+    # the big one) must not void the other's measurement.
+    headline_dim = 8192 if on_accel else 512
+    dims = (headline_dim, 16384) if on_accel else (headline_dim,)
     iters = 50 if on_accel else 5
 
+    mesh = None
     if len(devices) > 1:
         from k3stpu.parallel.mesh import make_mesh
 
         mesh = make_mesh(len(devices), model_parallelism=1,
                          axis_names=("data", "model"))
-        res = measure_pjit_matmul(mesh, m=dim, n=dim, k=dim, iters=iters)
-    else:
-        res = measure_matmul(m=dim, n=dim, k=dim, iters=iters)
+
+    results, errors = {}, {}
+    for dim in dims:
+        try:
+            if mesh is not None:
+                results[dim] = measure_pjit_matmul(mesh, m=dim, n=dim,
+                                                   k=dim, iters=iters)
+            else:
+                results[dim] = measure_matmul(m=dim, n=dim, k=dim,
+                                              iters=iters)
+        except Exception as e:  # noqa: BLE001 — keep the other shape
+            errors[dim] = f"{type(e).__name__}: {e}"[:300]
+    if not results:
+        raise RuntimeError(f"every shape failed: {errors}")
+    res = results.get(headline_dim) or max(results.values(),
+                                           key=lambda r: r.tflops)
 
     _emit({
         "metric": "pjit_matmul_bf16_tflops_per_chip",
@@ -117,6 +139,8 @@ def _worker() -> int:
         "unit": "TFLOP/s/chip",
         "vs_baseline": round(res.tflops / BASELINE_TFLOPS, 4),
         "detail": res.to_dict(),
+        "all_shapes": [r.to_dict() for r in results.values()],
+        "shape_errors": errors or None,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "n_devices": len(devices),
     })
