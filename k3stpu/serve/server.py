@@ -1119,8 +1119,41 @@ def main(argv=None) -> int:
 
     start_telemetry_thread(server)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_app(server))
+
+    # Graceful pod termination (the Recreate-strategy restart path,
+    # reference jellyfin.yaml:13-14): on SIGTERM/SIGINT stop accepting,
+    # let in-flight requests finish, release the dispatcher/engine
+    # threads, and exit 0 — a chip-holding singleton killed mid-batch
+    # would otherwise strand clients and (on a shared chip) leave its
+    # process claim to time out. K8s default grace is 30 s; the drain
+    # must complete inside it or the kubelet SIGKILLs anyway.
+    import signal
+
+    draining = {"on": False}
+
+    def _drain(signum, frame):
+        if draining["on"]:
+            # Second signal: the drain is stuck (e.g. a handler thread
+            # wedged on a dead device dispatch) — restore default
+            # disposition so one more signal hard-kills; don't strand
+            # the operator behind an unjoinable thread.
+            print(f"signal {signum} again: next one is fatal", flush=True)
+            signal.signal(signum, signal.SIG_DFL)
+            return
+        draining["on"] = True
+        print(f"signal {signum}: draining (no new connections; "
+              "in-flight requests finish)...", flush=True)
+        # shutdown() blocks until serve_forever exits; run it off the
+        # signal frame so the handler returns immediately.
+        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
     print(f"serving {args.model} on :{args.port}", flush=True)
-    httpd.serve_forever()
+    httpd.serve_forever()          # returns after _drain fires
+    httpd.server_close()
+    server.close()                 # drain batcher + engine threads
+    print("drained; bye", flush=True)
     return 0
 
 

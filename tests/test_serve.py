@@ -496,3 +496,52 @@ def test_score_endpoint_http():
     finally:
         httpd.shutdown()
         server.close()
+
+
+def test_sigterm_drains_and_exits_cleanly():
+    """The serving pod's Recreate-strategy restart path: SIGTERM stops
+    accepting, in-flight work finishes, and the process exits 0 with the
+    drain log — not a mid-batch kill."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+    import urllib.request
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in (repo_root, env.get("PYTHONPATH")) if p)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "k3stpu.serve.server", "--model",
+         "transformer-tiny", "--seq-len", "16", "--port", str(port),
+         "--no-warmup"],
+        env=env, text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    try:
+        deadline = _time.time() + 120
+        while True:
+            if proc.poll() is not None:  # crashed at startup: show why
+                out, _ = proc.communicate()
+                raise AssertionError(
+                    f"server exited rc={proc.returncode}: {out[-2000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5):
+                    break
+            except Exception:
+                assert _time.time() < deadline, "server never came up"
+                _time.sleep(0.3)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-2000:]
+    assert "draining" in out and "drained; bye" in out
