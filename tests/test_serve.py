@@ -515,8 +515,11 @@ def test_sigterm_drains_and_exits_cleanly():
         port = s.getsockname()[1]
     env = dict(os.environ)
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    env["PYTHONPATH"] = os.pathsep.join(
-        p for p in (repo_root, env.get("PYTHONPATH")) if p)
+    # Deliberately REPLACE PYTHONPATH (don't join the parent's): the dev
+    # box injects a sitecustomize there that force-registers the TPU
+    # tunnel platform, which JAX_PLATFORMS=cpu does not override — the
+    # child would hang on a wedged tunnel instead of starting on CPU.
+    env["PYTHONPATH"] = repo_root
     env["JAX_PLATFORMS"] = "cpu"
     proc = subprocess.Popen(
         [sys.executable, "-m", "k3stpu.serve.server", "--model",
