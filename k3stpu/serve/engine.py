@@ -86,7 +86,7 @@ def _sample_rows(logits, temps, topks, topps, key):
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
-                 "deadline")
+                 "deadline", "stream_q", "_ptuple")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None):
@@ -103,6 +103,30 @@ class _Request:
         self.error: "Exception | None" = None
         self.slot_rows: "list[int]" = []
         self.deadline: float = float("inf")  # set by _enqueue_and_wait
+        # submit_stream() installs a queue here; the loop thread pushes
+        # per-block token deltas into it and signal() pushes the terminal
+        # None. Non-streaming requests leave it None (zero overhead).
+        self.stream_q: "queue.SimpleQueue | None" = None
+        self._ptuple: "tuple | None" = None  # memoized prompt key
+
+    def ptuple(self) -> tuple:
+        """The single-prompt cache key, computed once — the admission
+        probe re-runs while a request waits for free slots, and an
+        O(prompt) conversion per loop iteration on the engine thread
+        is waste (the block is immutable after packing)."""
+        if self._ptuple is None:
+            self._ptuple = tuple(
+                int(t) for t in self.block[0, :int(self.lens[0])])
+        return self._ptuple
+
+    def signal(self) -> None:
+        """Wake the submitter on EVERY terminal path (tokens ready, error,
+        expiry, shutdown): terminal stream marker first, THEN the event —
+        a streaming consumer must never wait on a queue nobody will feed
+        again."""
+        if self.stream_q is not None:
+            self.stream_q.put(None)
+        self.event.set()
 
 
 class GenerateEngine:
@@ -115,7 +139,7 @@ class GenerateEngine:
 
     def __init__(self, model, params, *, slots: int = 8,
                  seed: int = 0, chunk_prefill: "int | None" = None,
-                 decode_block: int = 1):
+                 decode_block: int = 1, prompt_cache: int = 0):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -128,13 +152,30 @@ class GenerateEngine:
         steps/s; a K-token block amortizes that floor K-fold. Trade-off:
         a new request joins on a block boundary (K-token granularity),
         and a row that hits eos mid-block rides out the rest of the
-        block with its surplus tokens discarded host-side."""
+        block with its surplus tokens discarded host-side.
+
+        ``prompt_cache``: keep up to this many prefilled single-prompt
+        KV rows (LRU) keyed by the exact prompt tokens. A repeat prompt
+        skips its prefill entirely; a prompt that EXTENDS a cached one
+        restores the row and appends only the new tokens (the chat /
+        shared-system-prompt pattern — prefill cost drops from O(whole
+        prompt) to O(new suffix)). Cost: one full-depth cache row of
+        HBM per entry (``stats()['pcache_bytes']``). Outputs are
+        bit-identical to the uncached path: the restored row IS the
+        prefilled row (jax arrays are immutable, so a cached row can't
+        be corrupted by the decodes of the slot it was scattered into),
+        and the suffix-append reuses the chunked-admission finalize
+        invariant (junk K/V beyond a row's index is invisible to the
+        position mask and gets overwritten slot-by-slot). 0 disables."""
         if chunk_prefill is not None and chunk_prefill < 1:
             raise ValueError(f"chunk_prefill must be >= 1, got "
                              f"{chunk_prefill}")
         if decode_block < 1:
             raise ValueError(f"decode_block must be >= 1, got "
                              f"{decode_block}")
+        if prompt_cache < 0:
+            raise ValueError(f"prompt_cache must be >= 0, got "
+                             f"{prompt_cache}")
         self.model = model
         self.params = params
         self.slots = slots
@@ -167,7 +208,13 @@ class GenerateEngine:
         self._lock = threading.Lock()
         self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
                        "busy_s": 0.0, "requests": 0,
-                       "slot_occupancy_sum": 0.0, "adm_chunks": 0}
+                       "slot_occupancy_sum": 0.0, "adm_chunks": 0,
+                       "pcache_hits": 0, "pcache_prefix_hits": 0,
+                       "pcache_misses": 0, "pcache_bytes": 0}
+        # Prompt cache: tuple(prompt tokens) -> (cache_1row, last_1row),
+        # insertion-ordered dict as LRU (loop thread only).
+        self.prompt_cache = prompt_cache
+        self._pcache: "dict[tuple, tuple]" = {}
 
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="generate-engine")
@@ -241,6 +288,55 @@ class GenerateEngine:
             lambda x: jnp.broadcast_to(x[:1], (n, *x.shape[1:])), cache)
         return rep, jnp.broadcast_to(last[:1], (n, *last.shape[1:]))
 
+    # --- prompt cache (loop thread only; entries are immutable jax
+    #     arrays, so a cached row survives the decodes of whatever slot
+    #     its copy was scattered into) ------------------------------------
+
+    def _pcache_lookup(self, prompt: tuple):
+        """Longest cached entry equal to ``prompt`` or a proper prefix of
+        it; a hit refreshes its LRU position."""
+        best = None
+        for key in self._pcache:
+            if (len(key) <= len(prompt) and prompt[:len(key)] == key
+                    and (best is None or len(key) > len(best))):
+                best = key
+        if best is None:
+            return None, None
+        entry = self._pcache.pop(best)  # re-insert at MRU position
+        self._pcache[best] = entry
+        return best, entry
+
+    def _pcache_insert(self, prompt: tuple, cache1, last1) -> None:
+        if self.prompt_cache <= 0:
+            return
+        old = self._pcache.pop(prompt, None)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves((cache1, last1)))
+        self._pcache[prompt] = (cache1, last1, nbytes)
+        delta = nbytes - (old[2] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            evicted = self._pcache.pop(next(iter(self._pcache)))
+            delta -= evicted[2]
+        with self._lock:
+            self._stats["pcache_bytes"] = (
+                self._stats.get("pcache_bytes", 0) + delta)
+
+    def _pcache_extend(self, cache1, prompt: tuple, p0: int):
+        """Append ``prompt[p0:]`` to a restored 1-row cache (row index sits
+        at p0). Returns (cache, last_logits) in EXACTLY the post-prefill
+        state: the suffix pads to a pow2 chunk, the index rolls back to
+        len-1 (pad junk becomes invisible to the position mask, the
+        chunked-admission finalize invariant) and the last real token is
+        re-decoded in place for the exact first-token logits."""
+        extra = np.asarray(prompt[p0:], np.int32)[None]
+        g = _pow2_at_least(extra.shape[1])
+        pad = np.zeros((1, g), np.int32)
+        pad[:, :extra.shape[1]] = extra
+        cache = self._extend_chunk(self.params, cache1, jnp.asarray(pad))
+        cache = set_cache_index(
+            cache, jnp.asarray([len(prompt) - 1], jnp.int32))
+        return self._decode_logits(
+            self.params, cache, jnp.asarray([prompt[-1]], jnp.int32))
+
     # --- client API -----------------------------------------------------
 
     def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
@@ -308,6 +404,54 @@ class GenerateEngine:
                                    top_k, eos_id, samples=n, top_p=top_p)
         return self._enqueue_and_wait(req, timeout_s)
 
+    def submit_stream(self, prompts: "list[list[int]]", *,
+                      max_new_tokens: int, temperature: float = 0.0,
+                      top_k: "int | None" = None,
+                      top_p: "float | None" = None,
+                      eos_id: "int | None" = None,
+                      timeout_s: float = 600.0):
+        """Streaming submit(): returns an iterator of events.
+
+        Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
+        — one per decode dispatch that produced tokens for this request
+        (granularity = ``decode_block``; the first event carries each
+        row's first token straight off the prefill logits, so
+        time-to-first-token is prefill latency). The final event is
+        ``{"done": True, "tokens": [[...]]}`` with exactly submit()'s
+        return value (greedy exactness stays pinned to ``generate()``).
+        Rows that hit eos stop producing deltas; the final tokens are
+        eos-extended to the budget like submit()'s. Errors (deadline
+        expiry, decode failure, shutdown) raise from the iterator."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        n = len(prompts)
+        if n == 0 or n > self.slots:
+            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        req = self._packed_request(prompts, max_new_tokens, temperature,
+                                   top_k, eos_id, top_p=top_p)
+        req.stream_q = queue.SimpleQueue()
+        return self._stream_events(req, timeout_s)
+
+    def _stream_events(self, req: "_Request", timeout_s: float):
+        # Same deadline contract as _enqueue_and_wait: the loop thread
+        # drops expired requests; this consumer gets the terminal marker
+        # and raises the TimeoutError the loop recorded.
+        req.deadline = time.time() + timeout_s
+        self._q.put(req)
+        hard = req.deadline + 1.0
+        while True:
+            try:
+                item = req.stream_q.get(
+                    timeout=max(0.0, hard - time.time()))
+            except queue.Empty:
+                raise TimeoutError("generation did not finish in time")
+            if item is None:  # terminal: tokens ready or error
+                if req.error is not None:
+                    raise req.error
+                yield {"done": True, "tokens": req.tokens}
+                return
+            yield {"done": False, "rows": item}
+
     def close(self) -> None:
         self._closed = True
         self._q.put(None)
@@ -315,10 +459,13 @@ class GenerateEngine:
 
     def reset_stats(self) -> None:
         """Zero the counters (post-warmup: compile-dominated dispatches
-        would poison the reported tokens_per_s)."""
+        would poison the reported tokens_per_s). pcache_bytes is live
+        state, not a counter — it survives the reset."""
         with self._lock:
+            keep = self._stats["pcache_bytes"]
             for k in self._stats:
                 self._stats[k] = type(self._stats[k])()
+            self._stats["pcache_bytes"] = keep
 
     def stats(self) -> dict:
         with self._lock:
@@ -327,6 +474,7 @@ class GenerateEngine:
                              if s["busy_s"] > 0 else None)
         s["avg_active_slots"] = (round(s["slot_occupancy_sum"] / s["steps"],
                                        2) if s["steps"] else None)
+        s["pcache_entries"] = len(self._pcache)
         return s
 
     # --- loop internals (single thread; owns all slot state) ------------
@@ -375,7 +523,20 @@ class GenerateEngine:
             n_rows = req.samples if req.samples > 1 else n
             nb = min(_pow2_at_least(n_rows), self.slots)
             c = self.chunk_prefill
-            chunked = c is not None and width > c
+            # Prompt-cache probe (single-prompt requests): an exact hit
+            # skips the prefill outright; a prefix hit appends only the
+            # suffix — IF that suffix honors the same stall bound a
+            # chunked prefill enforces and fits the cache depth.
+            prompt = pkey = pentry = None
+            if self.prompt_cache > 0 and n == 1:
+                prompt = req.ptuple()
+                pkey, pentry = self._pcache_lookup(prompt)
+                if pkey is not None and len(pkey) < len(prompt):
+                    g = _pow2_at_least(len(prompt) - len(pkey))
+                    if (len(pkey) + g > self.max_seq
+                            or (c is not None and g > c)):
+                        pkey = pentry = None  # suffix too big: plain path
+            chunked = c is not None and width > c and pkey is None
             if chunked and not allow_chunked:
                 i += 1  # long prompts wait for the in-flight one
                 continue
@@ -384,6 +545,28 @@ class GenerateEngine:
                 return  # strict FIFO on capacity: big requests don't starve
             self._pending.pop(i)
             admitted += 1
+            if pkey is not None:
+                exact = len(pkey) == len(prompt)
+                with self._lock:
+                    self._stats["pcache_hits" if exact
+                                else "pcache_prefix_hits"] += 1
+                try:
+                    if exact:
+                        small, last = pentry[0], pentry[1]
+                    else:
+                        small, last = self._pcache_extend(
+                            pentry[0], prompt, len(pkey))
+                        self._pcache_insert(prompt, small, last)
+                    if req.samples > 1:
+                        small, last = self._broadcast_rows(small, last, nb)
+                    self._activate(req, free[:nb], n_rows, small, last)
+                except Exception as e:  # noqa: BLE001 — fail the one request
+                    req.error = e
+                    req.signal()
+                continue
+            if prompt is not None:
+                with self._lock:
+                    self._stats["pcache_misses"] += 1
             if req.samples > 1:
                 # Shared-prefix fan-out: prefill the ONE prompt row; the
                 # broadcast to nb rows happens at activation/finalize.
@@ -404,7 +587,7 @@ class GenerateEngine:
                         jnp.full((block.shape[0],), c, jnp.int32))
                 except Exception as e:  # noqa: BLE001
                     req.error = e
-                    req.event.set()
+                    req.signal()
                     continue
                 for r in all_rows:
                     self._reserved[r] = True
@@ -417,12 +600,14 @@ class GenerateEngine:
             try:
                 small, last = self._prefill(self.params, jnp.asarray(block),
                                             jnp.asarray(lens))
+                if prompt is not None:  # 1-row, pre-broadcast state
+                    self._pcache_insert(prompt, small, last)
                 if req.samples > 1:
                     small, last = self._broadcast_rows(small, last, nb)
                 self._activate(req, all_rows, n_rows, small, last)
             except Exception as e:  # noqa: BLE001 — fail the one request
                 req.error = e
-                req.event.set()
+                req.signal()
                 continue
 
     def _admission_step(self) -> None:
@@ -452,6 +637,10 @@ class GenerateEngine:
             last_toks = a["block"][np.arange(len(lens)), lens - 1]
             cache, last = self._decode_logits(self.params, cache,
                                               jnp.asarray(last_toks))
+            if self.prompt_cache > 0 and a["block"].shape[0] == 1:
+                self._pcache_insert(
+                    tuple(int(t) for t in a["block"][0, :int(lens[0])]),
+                    cache, last)
             if req.samples > 1:
                 cache, last = self._broadcast_rows(cache, last,
                                                    len(a["rows"]))
@@ -473,7 +662,7 @@ class GenerateEngine:
         for r in a["rows"]:
             self._reserved[r] = False
         a["req"].error = err
-        a["req"].event.set()
+        a["req"].signal()
 
     def _activate(self, req, all_rows, n, small_cache, last_logits) -> None:
         """Scatter an admitted small cache into the slot block and light
@@ -505,6 +694,11 @@ class GenerateEngine:
         with self._lock:
             self._stats["requests"] += 1
             self._stats["tokens"] += len(rows)  # first sampled tokens
+        if req.stream_q is not None:
+            # First token per row streams immediately — it came from the
+            # prefill's own logits, before any decode dispatch, so TTFT
+            # is prefill latency, not prefill + a decode block.
+            req.stream_q.put({j: [int(first[j])] for j in range(len(rows))})
         # eos on the very first token / budget 1 finishes immediately.
         for r in rows:
             if (self._left[r] <= 0
@@ -528,7 +722,7 @@ class GenerateEngine:
             self._owner[r] = None
             self._collected[r] = []
         req.error = err
-        req.event.set()
+        req.signal()
 
     def _expire_deadlines(self) -> None:
         """Free resources of requests whose client stopped waiting."""
@@ -537,7 +731,7 @@ class GenerateEngine:
         for req in expired:
             self._pending.remove(req)
             req.error = TimeoutError("expired while queued")
-            req.event.set()
+            req.signal()
         # The in-flight chunked admission too: its client may have given
         # up mid-prefill, and without this check the remaining chunks (and
         # the whole decode budget) would still run for nobody.
@@ -562,7 +756,7 @@ class GenerateEngine:
             self._owner[r] = None
             self._collected[r] = []
         req.tokens = out
-        req.event.set()
+        req.signal()
 
     def _loop(self) -> None:
         while True:
@@ -601,7 +795,7 @@ class GenerateEngine:
                 for req in {self._owner[r] for r in range(self.slots)
                             if self._owner[r] is not None}:
                     req.error = e
-                    req.event.set()
+                    req.signal()
                 self._active[:] = False
                 self._owner = [None] * self.slots
                 continue
@@ -609,6 +803,7 @@ class GenerateEngine:
             n_active = int(self._active.sum())
             done_reqs = set()
             consumed = 0
+            deltas: "dict[_Request, dict[int, list[int]]]" = {}
             for j in range(block.shape[0]):
                 for r in range(self.slots):
                     if not self._active[r]:
@@ -618,10 +813,18 @@ class GenerateEngine:
                     self._collected[r].append(tok)
                     self._left[r] -= 1
                     consumed += 1
+                    owner = self._owner[r]
+                    if owner is not None and owner.stream_q is not None:
+                        deltas.setdefault(owner, {}).setdefault(
+                            owner.slot_rows.index(r), []).append(tok)
                     if self._left[r] <= 0 or (self._eos[r] >= 0
                                               and tok == self._eos[r]):
                         self._finish_row(r)
                         done_reqs.add(self._owner[r])
+            # Deltas flush BEFORE completion: the terminal marker from
+            # signal() must be the stream's last item.
+            for req, d in deltas.items():
+                req.stream_q.put(d)
             with self._lock:
                 # "steps" keeps its per-token meaning (device decode
                 # steps) so the exported counter's unit survives the
@@ -651,7 +854,7 @@ class GenerateEngine:
             self._adm = None
         for req in self._pending:
             req.error = err
-            req.event.set()
+            req.signal()
         for req in {o for o in self._owner if o is not None}:
             req.error = err
-            req.event.set()
+            req.signal()

@@ -33,7 +33,12 @@ _MAX_ERRORS_PER_CLIENT = 10
 
 def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                  latencies: list, lock: "threading.Lock", errors: list,
-                 route: str = "/v1/predict"):
+                 route: str = "/v1/predict", ttfts: "list | None" = None):
+    """``ttfts`` non-None switches to SSE consumption: the request body
+    carries ``"stream": true`` and the client records time-to-first-token
+    (first ``data:`` frame) alongside the full-response latency — the
+    pair is the streaming story: TTFT ~ prefill latency while total
+    stays the full decode."""
     import urllib.request
 
     my_errors = 0
@@ -44,7 +49,25 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
         t0 = time.perf_counter()
         try:
             with urllib.request.urlopen(req, timeout=300) as r:
-                json.loads(r.read())
+                if ttfts is None:
+                    json.loads(r.read())
+                    ttft = None
+                else:
+                    ttft = None
+                    last = None
+                    for line in r:  # SSE frames, EOF-delimited
+                        if not line.startswith(b"data: "):
+                            continue
+                        if ttft is None:
+                            ttft = time.perf_counter() - t0
+                        last = json.loads(line[6:])
+                    # A truncated stream (no done frame) is a failure
+                    # too — counting it as success would understate
+                    # latency and overstate tokens/s.
+                    if last is None or "error" in last \
+                            or not last.get("done"):
+                        raise RuntimeError(
+                            f"stream ended badly: {last}")
         except Exception as e:  # noqa: BLE001 — record, don't kill the run
             with lock:
                 errors.append(str(e))
@@ -55,19 +78,27 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
         my_errors = 0  # consecutive-failure counter: success resets it
         with lock:
             latencies.append(time.perf_counter() - t0)
+            if ttft is not None:
+                ttfts.append(ttft)
 
 
 def run_load(url: str, *, clients: int, seconds: float, rows: int,
              input_shape: "tuple[int, ...]", input_dtype: str,
-             generate_tokens: int = 0) -> dict:
+             generate_tokens: int = 0, stream: bool = False) -> dict:
     """``generate_tokens > 0`` switches to /v1/generate load (each request
     one ragged prompt, ``generate_tokens`` new tokens) — the decode-loop
-    workload the continuous-batching engine schedules."""
+    workload the continuous-batching engine schedules. ``stream`` rides
+    the SSE route and adds time-to-first-token percentiles."""
     rng = np.random.default_rng(0)
+    ttfts: "list[float] | None" = None
     if generate_tokens > 0:
-        prompt = rng.integers(1, 1000, size=(max(4, rows),)).tolist()
-        payload = json.dumps({"prompt_tokens": [prompt],
-                              "max_new_tokens": generate_tokens}).encode()
+        body = {"prompt_tokens": [rng.integers(
+            1, 1000, size=(max(4, rows),)).tolist()],
+            "max_new_tokens": generate_tokens}
+        if stream:
+            body["stream"] = True
+            ttfts = []
+        payload = json.dumps(body).encode()
         route = "/v1/generate"
     else:
         if input_dtype == "int32":
@@ -85,7 +116,7 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     stop = threading.Event()
     threads = [threading.Thread(
         target=_client_loop, args=(url, payload, stop, latencies, lock,
-                                   errors, route), daemon=True)
+                                   errors, route, ttfts), daemon=True)
         for _ in range(clients)]
     t0 = time.perf_counter()
     for t in threads:
@@ -98,8 +129,12 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
 
     if not latencies:
         raise RuntimeError(f"no request succeeded; errors: {errors[:3]}")
+
+    def pct(sorted_ms: "list[float]", q: float) -> float:
+        return sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))]
+
     lat_ms = sorted(1e3 * l for l in latencies)
-    pick = lambda q: lat_ms[min(len(lat_ms) - 1, int(q * len(lat_ms)))]
+    pick = lambda q: pct(lat_ms, q)
     out = {
         "clients": clients,
         "rows_per_request": rows,
@@ -115,6 +150,10 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         out["gen_tokens_per_request"] = generate_tokens
         out["client_tokens_per_s"] = round(
             len(lat_ms) * generate_tokens / wall, 2)
+    if ttfts:
+        tt = sorted(1e3 * t for t in ttfts)
+        out["ttft_p50_ms"] = round(pct(tt, 0.50), 2)
+        out["ttft_p95_ms"] = round(pct(tt, 0.95), 2)
     return out
 
 
@@ -138,6 +177,10 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="load /v1/generate instead of /v1/predict: each "
                          "request generates this many tokens (measures the "
                          "decode loop the engine schedules)")
+    ap.add_argument("--stream", action="store_true",
+                    help="generate load rides the SSE streaming route; "
+                         "adds ttft_p50_ms/ttft_p95_ms (time to first "
+                         "token) to the result")
     ap.add_argument("--continuous-batching", action="store_true",
                     help="self-hosted server runs the slot-scheduled "
                          "generate engine (the before/after comparison "
@@ -151,6 +194,9 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="engine tokens per device dispatch when "
                          "--continuous-batching (see server --decode-block)")
     args = ap.parse_args(argv)
+    if args.stream and args.generate_tokens <= 0:
+        ap.error("--stream requires --generate-tokens (the SSE route is "
+                 "generation-only)")
 
     url = args.url
     card_url = None
@@ -207,7 +253,7 @@ def main(argv: "list[str] | None" = None) -> int:
         url, clients=args.clients, seconds=args.seconds, rows=args.rows,
         input_shape=tuple(card["input_shape"]),
         input_dtype=card["input_dtype"],
-        generate_tokens=args.generate_tokens)
+        generate_tokens=args.generate_tokens, stream=args.stream)
 
     with urllib.request.urlopen(card_url, timeout=60) as r:
         card = json.loads(r.read())

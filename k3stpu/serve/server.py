@@ -186,6 +186,7 @@ class InferenceServer:
                  engine_slots: int = 8,
                  prefill_chunk: "int | None" = None,
                  decode_block: int = 4,
+                 prompt_cache: int = 0,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
                  spec_gamma: int = 4):
@@ -429,7 +430,8 @@ class InferenceServer:
 
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
-                chunk_prefill=prefill_chunk, decode_block=decode_block)
+                chunk_prefill=prefill_chunk, decode_block=decode_block,
+                prompt_cache=prompt_cache)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -566,6 +568,43 @@ class InferenceServer:
         if self._engine is not None:
             self._engine.close()
 
+    def _sanitize_gen(self, lens: "list[int]", max_new_tokens: int,
+                      temperature: float, top_k: "int | None",
+                      top_p: "float | None", eos_id: "int | None"):
+        """Everything that reaches generate()/the engine as a STATIC jit
+        argument is bucketed/quantized here, so a hostile or chatty client
+        can only ever populate a small fixed set of compiled programs
+        (same reasoning as the BATCH_SIZES padding for predict()). ONE
+        policy shared by generate_tokens and generate_stream — the width
+        bucket is also the engine's admission unit (serve/programs.py),
+        so validation here == acceptance there."""
+        from k3stpu.serve.programs import prompt_width_bucket
+
+        width = prompt_width_bucket(max(lens), self.seq_len)
+        if max(lens) > width:
+            raise ValueError(
+                f"prompt length {max(lens)} exceeds max seq {width}")
+        if width + max_new_tokens > self.seq_len:
+            raise ValueError(
+                f"prompt width {width} + max_new_tokens {max_new_tokens} "
+                f"exceeds the KV cache ({self.seq_len}); lower one of them")
+        gen_budget = 1 << (max_new_tokens - 1).bit_length()  # pow2 bucket
+        gen_budget = min(gen_budget, self.seq_len - width)
+        vocab = getattr(self.model.config, "base",
+                        self.model.config).vocab_size
+        temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
+        if top_p is not None:  # 0.1 bucket: top_p is STATIC in generate()
+            top_p = round(max(0.05, min(float(top_p), 1.0)), 1)
+            if top_p >= 1.0:
+                top_p = None  # 1.0 == no cut; keep one compiled program
+        if top_k is not None:  # pow2 bucket, capped at the vocab
+            top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(), vocab)
+        if eos_id is not None:  # traced in generate(), so any value is one
+            eos_id = int(eos_id)  # program — just validate the range
+            if not 0 <= eos_id < vocab:
+                raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
+        return width, gen_budget, temperature, top_k, top_p, eos_id
+
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,
                         top_k: "int | None" = None,
@@ -613,38 +652,10 @@ class InferenceServer:
                 prompts = prompts * num_samples
                 num_samples = 1
 
-        # Everything that reaches generate() as a STATIC jit argument is
-        # bucketed/quantized here, so a hostile or chatty client can only
-        # ever populate a small fixed set of compiled programs (same
-        # reasoning as the BATCH_SIZES padding for predict()).
         lens = [len(p) for p in prompts]
-        # Bucketed width: ONE policy shared with the engine's admission
-        # (serve/programs.py), so validation here == acceptance there.
-        from k3stpu.serve.programs import prompt_width_bucket
-
-        width = prompt_width_bucket(max(lens), self.seq_len)
-        if max(lens) > width:
-            raise ValueError(
-                f"prompt length {max(lens)} exceeds max seq {width}")
-        if width + max_new_tokens > self.seq_len:
-            raise ValueError(
-                f"prompt width {width} + max_new_tokens {max_new_tokens} "
-                f"exceeds the KV cache ({self.seq_len}); lower one of them")
-        gen_budget = 1 << (max_new_tokens - 1).bit_length()  # pow2 bucket
-        gen_budget = min(gen_budget, self.seq_len - width)
-        vocab = getattr(self.model.config, "base",
-                        self.model.config).vocab_size
-        temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
-        if top_p is not None:  # 0.1 bucket: top_p is STATIC in generate()
-            top_p = round(max(0.05, min(float(top_p), 1.0)), 1)
-            if top_p >= 1.0:
-                top_p = None  # 1.0 == no cut; keep one compiled program
-        if top_k is not None:  # pow2 bucket, capped at the vocab
-            top_k = min(1 << (max(1, int(top_k)) - 1).bit_length(), vocab)
-        if eos_id is not None:  # traced in generate(), so any value is one
-            eos_id = int(eos_id)  # program — just validate the range
-            if not 0 <= eos_id < vocab:
-                raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
+        (width, gen_budget, temperature, top_k, top_p,
+         eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
+                                      top_k, top_p, eos_id)
 
         if num_samples > 1:  # engine-backed shared-prefix sampling
             t0 = time.perf_counter()
@@ -666,9 +677,7 @@ class InferenceServer:
 
         # Spec decode needs a gamma-token margin in the cache; requests
         # without it (or sampled ones) take the plain path instead.
-        if (self._draft is not None and temperature == 0.0
-                and width + gen_budget + self.spec_gamma + 1
-                <= self.seq_len):
+        if self._spec_eligible(width, gen_budget, temperature):
             from k3stpu.serve.speculative import speculative_generate
 
             # Same bounded-compile-cache discipline as every other route:
@@ -763,6 +772,97 @@ class InferenceServer:
             self._stats["tokens"] += int(out.size)
             self._stats["gen_seconds"] += dt
         return out.tolist()
+
+    def _spec_eligible(self, width: int, gen_budget: int,
+                       temperature: float) -> bool:
+        """ONE routing gate for speculative decode, shared by
+        generate_tokens and generate_stream — the same request must route
+        identically with and without "stream": true, or the final stream
+        frame stops matching the non-streaming response."""
+        return (self._draft is not None and temperature == 0.0
+                and width + gen_budget + self.spec_gamma + 1
+                <= self.seq_len)
+
+    def generate_stream(self, prompts: "list[list[int]]",
+                        max_new_tokens: int = 32, temperature: float = 0.0,
+                        top_k: "int | None" = None,
+                        top_p: "float | None" = None,
+                        eos_id: "int | None" = None,
+                        num_samples: int = 1):
+        """Streaming generate: an iterator of JSON-able events for the
+        SSE route. Engine-backed requests yield per-decode-block deltas
+        ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
+        decode (time-to-first-token = prefill latency, not full-budget
+        latency), then a final ``{"done": True, "tokens": [[...]]}``
+        identical to generate_tokens()'s return. Paths with no
+        incremental results — no engine, ``num_samples > 1``, the
+        speculative-decode route — degrade to the single final event.
+
+        Validation runs EAGERLY (this is not a generator function), so
+        bad arguments raise here and become a clean 400; only transport
+        of an already-admitted request can fail mid-stream."""
+        if not self.model_name.startswith(("transformer", "moe")):
+            raise ValueError(f"{self.model_name} is not a generative LM")
+        if not prompts or any(len(p) == 0 for p in prompts):
+            raise ValueError("prompts must be non-empty token lists")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        num_samples = int(num_samples)
+        if num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+        served_batch(len(prompts) * num_samples)
+        lens = [len(p) for p in prompts]
+        (width, gen_budget, temperature, top_k, top_p,
+         eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
+                                      top_k, top_p, eos_id)
+        spec_route = (num_samples == 1 and
+                      self._spec_eligible(width, gen_budget, temperature))
+        if self._engine is None or num_samples > 1 or spec_route:
+            tokens = self.generate_tokens(
+                prompts, max_new_tokens=max_new_tokens,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id, num_samples=num_samples)
+            return iter([{"done": True, "tokens": tokens}])
+        return self._stream_engine_events(
+            prompts, max_new_tokens, gen_budget, temperature, top_k,
+            top_p, eos_id)
+
+    def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
+                              temperature, top_k, top_p, eos_id):
+        """Engine-backed streaming (args pre-sanitized). Requests wider
+        than the slot block stream chunk by chunk with global row
+        indices; deltas clip at max_new_tokens per row (the engine
+        decodes the pow2 gen_budget — surplus never reaches the
+        client, matching the non-streaming truncation)."""
+        t0 = time.perf_counter()
+        out: "list[list[int]]" = []
+        for ofs in range(0, len(prompts), self._engine.slots):
+            chunk = prompts[ofs:ofs + self._engine.slots]
+            emitted = [0] * len(chunk)
+            for ev in self._engine.submit_stream(
+                    chunk, max_new_tokens=gen_budget,
+                    temperature=temperature, top_k=top_k, top_p=top_p,
+                    eos_id=eos_id):
+                if ev["done"]:
+                    out.extend(row[:max_new_tokens]
+                               for row in ev["tokens"])
+                    continue
+                rows = {}
+                for j, toks in ev["rows"].items():
+                    take = toks[:max_new_tokens - emitted[j]]
+                    if take:
+                        emitted[j] += len(take)
+                        rows[ofs + j] = take
+                if rows:
+                    yield {"done": False, "rows": rows}
+        dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self._stats["gen_requests"] += 1
+            self._stats["gen_examples"] += len(prompts)
+            self._stats["tokens"] += sum(len(r) for r in out)
+            self._stats["gen_seconds"] += dt
+        yield {"done": True, "tokens": out}
 
     def busy_seconds(self) -> float:
         with self._stats_lock:
@@ -903,6 +1003,34 @@ def make_app(server: InferenceServer):
         def log_message(self, *args):  # quiet; stats live in /v1/models
             pass
 
+        def _send_sse(self, events):
+            """Server-sent events: one ``data: {json}`` frame per event,
+            flushed as produced (the client's read unblocks on each
+            decode block — this is the whole point). HTTP/1.0 + an
+            explicit Connection: close delimit the stream by EOF; no
+            Content-Length. Mid-stream failures (the request was already
+            admitted, so no 4xx is possible) become a final
+            ``{"error": ...}`` frame."""
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            try:
+                for ev in events:
+                    self.wfile.write(
+                        b"data: " + json.dumps(ev).encode() + b"\n\n")
+                    self.wfile.flush()
+            except (BrokenPipeError, ConnectionResetError):
+                pass  # client went away; the engine's deadline reaps it
+            except Exception as e:  # noqa: BLE001 — headers already sent
+                try:
+                    self.wfile.write(
+                        b"data: "
+                        + json.dumps({"error": str(e)}).encode() + b"\n\n")
+                except OSError:
+                    pass
+
         def do_GET(self):
             if self.path == "/healthz":
                 import jax
@@ -940,14 +1068,20 @@ def make_app(server: InferenceServer):
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(length))
-                    tokens = server.generate_tokens(
-                        req["prompt_tokens"],
+                    kwargs = dict(
                         max_new_tokens=req.get("max_new_tokens", 32),
                         temperature=req.get("temperature", 0.0),
                         top_k=req.get("top_k"),
                         top_p=req.get("top_p"),
                         eos_id=req.get("eos_id"),
                         num_samples=req.get("num_samples", 1))
+                    if req.get("stream"):
+                        events = server.generate_stream(
+                            req["prompt_tokens"], **kwargs)
+                        self._send_sse(events)
+                        return
+                    tokens = server.generate_tokens(
+                        req["prompt_tokens"], **kwargs)
                     self._send(200, {"tokens": tokens})
                 except (KeyError, ValueError, TypeError, OverflowError,
                         json.JSONDecodeError) as e:
@@ -1064,6 +1198,12 @@ def main(argv=None) -> int:
                          "through a relayed backend costs ~8 ms flat, so "
                          "K>1 amortizes the floor K-fold; new requests "
                          "join on block boundaries (K-token granularity)")
+    ap.add_argument("--prompt-cache", type=int, default=0,
+                    help="with --continuous-batching: LRU-cache this many "
+                         "prefilled prompt KV rows — a repeat prompt skips "
+                         "its prefill, a prompt extending a cached one "
+                         "prefills only the suffix (chat/system-prompt "
+                         "reuse). Costs one cache row of HBM per entry")
     ap.add_argument("--draft-model", default=None,
                     choices=["transformer", "transformer-tiny"],
                     help="speculative decoding draft for greedy "
@@ -1107,6 +1247,7 @@ def main(argv=None) -> int:
                              engine_slots=args.engine_slots,
                              prefill_chunk=args.prefill_chunk,
                              decode_block=args.decode_block,
+                             prompt_cache=args.prompt_cache,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
                              spec_gamma=args.spec_gamma)

@@ -1,0 +1,203 @@
+"""Prompt/prefix KV caching in the continuous-batching engine.
+
+The correctness bar is absolute: a cache hit (exact or prefix) must
+produce BIT-IDENTICAL tokens to the uncached path, which is itself
+pinned to ``generate()``. The win being bought: an exact repeat skips
+its prefill dispatch entirely; a prompt extending a cached one prefills
+only the suffix (the chat / shared-system-prompt serving pattern).
+CPU-JAX stand-in per SURVEY.md §4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.generate import generate
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine
+
+
+def _model_and_params(max_seq_len=64):
+    model = transformer_lm_tiny(max_seq_len=max_seq_len)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.fixture(scope="module")
+def cached_engine():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=4, prompt_cache=4)
+    yield model, params, engine
+    engine.close()
+
+
+def test_exact_hit_matches_and_skips_prefill(cached_engine):
+    model, params, engine = cached_engine
+    prompt = [11, 12, 13, 14]
+    want = [_solo(model, params, prompt, 6)]
+    assert engine.submit([prompt], max_new_tokens=6) == want
+    s0 = engine.stats()
+    assert s0["pcache_entries"] >= 1 and s0["pcache_bytes"] > 0
+    # The repeat must hit (no new prefill) and stay bit-identical.
+    assert engine.submit([prompt], max_new_tokens=6) == want
+    s1 = engine.stats()
+    assert s1["pcache_hits"] == s0["pcache_hits"] + 1
+    assert s1["pcache_misses"] == s0["pcache_misses"]
+
+
+def test_prefix_hit_extends_and_matches(cached_engine):
+    model, params, engine = cached_engine
+    base = [21, 22, 23]
+    engine.submit([base], max_new_tokens=4)
+    s0 = engine.stats()
+    extended = base + [24, 25]
+    got = engine.submit([extended], max_new_tokens=6)
+    assert got == [_solo(model, params, extended, 6)]
+    s1 = engine.stats()
+    assert s1["pcache_prefix_hits"] == s0["pcache_prefix_hits"] + 1
+    # The extension itself is now cached: an exact repeat hits.
+    assert engine.submit([extended], max_new_tokens=6) == got
+    assert engine.stats()["pcache_hits"] == s1["pcache_hits"] + 1
+
+
+def test_cached_generation_not_corrupted_by_decodes(cached_engine):
+    """The cached row must survive the decodes of the slot its copy ran
+    in (jax immutability): generate twice with DIFFERENT budgets — if the
+    first generation's decode steps had leaked into the cached row, the
+    second's continuation would diverge."""
+    model, params, engine = cached_engine
+    prompt = [31, 32, 33, 34, 35]
+    engine.submit([prompt], max_new_tokens=8)
+    assert engine.submit([prompt], max_new_tokens=3) == \
+        [_solo(model, params, prompt, 3)]
+
+
+def test_samples_fan_out_from_cached_prompt(cached_engine):
+    _, _, engine = cached_engine
+    prompt = [41, 42, 43]
+    engine.submit([prompt], max_new_tokens=4)
+    s0 = engine.stats()
+    rows = engine.submit_samples(prompt, 3, max_new_tokens=5,
+                                 temperature=1.0, top_k=8)
+    assert len(rows) == 3 and all(len(r) == 5 for r in rows)
+    assert engine.stats()["pcache_hits"] == s0["pcache_hits"] + 1
+
+
+def test_lru_eviction_capacity_one():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, prompt_cache=1)
+    try:
+        p1, p2 = [1, 2, 3], [4, 5, 6]
+        w1 = [_solo(model, params, p1, 4)]
+        assert engine.submit([p1], max_new_tokens=4) == w1
+        assert engine.submit([p2], max_new_tokens=4) == \
+            [_solo(model, params, p2, 4)]  # evicts p1
+        assert engine.submit([p1], max_new_tokens=4) == w1  # re-prefills
+        s = engine.stats()
+        assert s["pcache_entries"] == 1
+        assert s["pcache_misses"] == 3 and s["pcache_hits"] == 0
+    finally:
+        engine.close()
+
+
+def test_chunked_admission_inserts_and_exact_hit_skips_chunking():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, chunk_prefill=8,
+                            prompt_cache=2)
+    try:
+        prompt = list(range(1, 25))  # width 32 > chunk 8: chunked admission
+        want = [_solo(model, params, prompt, 5)]
+        assert engine.submit([prompt], max_new_tokens=5) == want
+        s0 = engine.stats()
+        assert s0["adm_chunks"] >= 2 and s0["pcache_entries"] == 1
+        # Exact repeat: no chunked admission at all, identical tokens.
+        assert engine.submit([prompt], max_new_tokens=5) == want
+        s1 = engine.stats()
+        assert s1["pcache_hits"] == s0["pcache_hits"] + 1
+        assert s1["adm_chunks"] == s0["adm_chunks"]
+        # Small suffix (pow2 bucket 2 <= chunk 8): prefix path allowed.
+        ext = prompt + [30, 31]
+        assert engine.submit([ext], max_new_tokens=4) == \
+            [_solo(model, params, ext, 4)]
+        assert engine.stats()["pcache_prefix_hits"] == \
+            s1["pcache_prefix_hits"] + 1
+    finally:
+        engine.close()
+
+
+def test_long_suffix_falls_back_to_chunked_path():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, chunk_prefill=4,
+                            prompt_cache=2)
+    try:
+        base = [1, 2, 3]
+        engine.submit([base], max_new_tokens=3)
+        s0 = engine.stats()
+        # Suffix of 13 -> pow2 bucket 16 > chunk 4: stall bound says no
+        # prefix reuse; the request runs the plain chunked admission and
+        # must still be exact.
+        ext = base + list(range(10, 23))
+        assert engine.submit([ext], max_new_tokens=4) == \
+            [_solo(model, params, ext, 4)]
+        s1 = engine.stats()
+        assert s1["pcache_prefix_hits"] == s0["pcache_prefix_hits"]
+        assert s1["pcache_misses"] == s0["pcache_misses"] + 1
+    finally:
+        engine.close()
+
+
+def test_cache_disabled_by_default():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=3)
+        engine.submit([[1, 2]], max_new_tokens=3)
+        s = engine.stats()
+        assert s["pcache_entries"] == 0 and s["pcache_bytes"] == 0
+        assert s["pcache_hits"] == 0 and s["pcache_misses"] == 0
+    finally:
+        engine.close()
+
+
+def test_multi_prompt_requests_bypass_cache(cached_engine):
+    model, params, engine = cached_engine
+    prompts = [[51, 52], [53, 54, 55]]
+    s0 = engine.stats()
+    got = engine.submit(prompts, max_new_tokens=4)
+    assert got == [_solo(model, params, p, 4) for p in prompts]
+    s1 = engine.stats()
+    assert s1["pcache_hits"] == s0["pcache_hits"]
+    assert s1["pcache_misses"] == s0["pcache_misses"]
+
+
+def test_stream_from_cached_prompt(cached_engine):
+    """Streaming + cache hit: the first event still carries the first
+    token and the final result stays pinned."""
+    model, params, engine = cached_engine
+    prompt = [61, 62, 63]
+    want = [_solo(model, params, prompt, 5)]
+    assert engine.submit([prompt], max_new_tokens=5) == want
+    events = list(engine.submit_stream([prompt], max_new_tokens=5))
+    assert events[-1] == {"done": True, "tokens": want}
+    first = events[0]
+    assert first["done"] is False
+    assert first["rows"] == {0: [want[0][0]]}
+
+
+def test_reset_stats_preserves_pcache_bytes(cached_engine):
+    _, _, engine = cached_engine
+    assert engine.stats()["pcache_bytes"] > 0
+    before = engine.stats()["pcache_bytes"]
+    engine.reset_stats()
+    s = engine.stats()
+    assert s["pcache_bytes"] == before and s["pcache_hits"] == 0

@@ -1,0 +1,244 @@
+"""Streaming generation: engine submit_stream + the SSE /v1/generate route.
+
+The correctness bar mirrors the engine's: streamed deltas, concatenated
+per row, must be a prefix of EXACTLY the tokens the same request returns
+non-streaming (which is itself pinned to ``generate()``). The latency
+bar: the first event per request carries one token per row straight off
+the prefill logits — time-to-first-token must not wait for the full
+decode budget. CPU-JAX stand-in per SURVEY.md §4.
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.generate import generate
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine
+from k3stpu.serve.server import InferenceServer, make_app
+
+
+def _model_and_params(max_seq_len=64):
+    model = transformer_lm_tiny(max_seq_len=max_seq_len)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+@pytest.fixture(scope="module")
+def stream_engine():
+    model, params = _model_and_params()
+    # decode_block > 1: deltas arrive in blocks, the shape streaming must
+    # handle (and the default serving configuration).
+    engine = GenerateEngine(model, params, slots=4, decode_block=3)
+    yield model, params, engine
+    engine.close()
+
+
+def _drain(events):
+    """Consume a stream; return (per-row concatenated deltas, final)."""
+    rows: "dict[int, list[int]]" = {}
+    final = None
+    n_deltas = 0
+    for ev in events:
+        if ev["done"]:
+            final = ev["tokens"]
+        else:
+            n_deltas += 1
+            for r, toks in ev["rows"].items():
+                rows.setdefault(int(r), []).extend(toks)
+    assert final is not None, "stream ended without a done event"
+    return rows, final, n_deltas
+
+
+def test_stream_matches_submit_greedy(stream_engine):
+    model, params, engine = stream_engine
+    prompts = [[5, 6, 7], [9, 10, 11, 12]]
+    rows, final, n_deltas = _drain(
+        engine.submit_stream(prompts, max_new_tokens=7))
+    assert final == [_solo(model, params, p, 7) for p in prompts]
+    # Deltas are a prefix of the final (eos-extended) tokens; with no eos
+    # hit they are the whole row.
+    for r, streamed in rows.items():
+        assert streamed == final[r][:len(streamed)]
+        assert len(streamed) == 7  # no eos: everything streamed
+    # First event from prefill + ceil(6/3) decode blocks = at least 3.
+    assert n_deltas >= 3
+
+
+def test_stream_first_event_is_prefill_token(stream_engine):
+    model, params, engine = stream_engine
+    it = engine.submit_stream([[3, 4]], max_new_tokens=6)
+    first = next(it)
+    assert first["done"] is False
+    # TTFT semantics: exactly one token, before any decode dispatch.
+    assert list(first["rows"].values()) == [[_solo(model, params,
+                                                  [3, 4], 6)[0]]]
+    _drain(it)  # let the request finish cleanly
+
+
+def test_stream_eos_stops_deltas(stream_engine):
+    model, params, engine = stream_engine
+    prompt = [7, 8, 9]
+    full = _solo(model, params, prompt, 8)
+    eos = full[2]  # force an eos hit mid-budget (position 2 of 8)
+    rows, final, _ = _drain(
+        engine.submit_stream([prompt], max_new_tokens=8, eos_id=eos))
+    # Streamed tokens stop at the eos token (inclusive); the final row is
+    # eos-extended to the budget exactly like submit().
+    assert rows[0] == full[:3]
+    assert final[0] == full[:3] + [eos] * 5
+    got = engine.submit([prompt], max_new_tokens=8, eos_id=eos)
+    assert final == got
+
+
+def test_stream_concurrent_with_plain_submit(stream_engine):
+    model, params, engine = stream_engine
+    results = {}
+
+    def plain():
+        results["plain"] = engine.submit([[20, 21]], max_new_tokens=9)
+
+    t = threading.Thread(target=plain)
+    t.start()
+    rows, final, _ = _drain(
+        engine.submit_stream([[30, 31, 32]], max_new_tokens=9))
+    t.join(timeout=60)
+    assert results["plain"] == [_solo(model, params, [20, 21], 9)]
+    assert final == [_solo(model, params, [30, 31, 32], 9)]
+    assert rows[0] == final[0]
+
+
+def test_stream_validation_eager(stream_engine):
+    _, _, engine = stream_engine
+    with pytest.raises(ValueError):
+        engine.submit_stream([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.submit_stream([[1]] * (engine.slots + 1), max_new_tokens=4)
+
+
+def test_stream_closed_engine_rejects():
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    engine.close()
+    with pytest.raises(RuntimeError):
+        engine.submit_stream([[1, 2]], max_new_tokens=4)
+
+
+def test_stream_sampled_rows_complete(stream_engine):
+    """Sampled (non-greedy) streaming: deltas must still concatenate to
+    the final tokens (values are stochastic; structure is the bar)."""
+    _, _, engine = stream_engine
+    rows, final, _ = _drain(engine.submit_stream(
+        [[2, 3, 4]], max_new_tokens=6, temperature=1.0, top_k=8))
+    assert len(final) == 1 and len(final[0]) == 6
+    assert rows[0] == final[0][:len(rows[0])]
+
+
+# --- HTTP/SSE route ----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_server():
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                             batch_window_ms=0.0, continuous_batching=True,
+                             engine_slots=4, decode_block=3,
+                             shard_devices=1)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}", server
+    httpd.shutdown()
+    server.close()
+
+
+def _post_json(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def _post_sse(url, payload):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    frames = []
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.headers.get("Content-Type") == "text/event-stream"
+        for line in r:
+            if line.startswith(b"data: "):
+                frames.append(json.loads(line[6:]))
+    return frames
+
+
+def test_sse_route_matches_plain(engine_server):
+    url, _ = engine_server
+    body = {"prompt_tokens": [[1, 2, 3], [4, 5]], "max_new_tokens": 6}
+    status, plain = _post_json(url + "/v1/generate", body)
+    assert status == 200, plain
+    frames = _post_sse(url + "/v1/generate", dict(body, stream=True))
+    assert frames[-1]["done"] is True
+    assert frames[-1]["tokens"] == plain["tokens"]
+    assert len(frames) >= 3  # prefill event + >=1 block + done
+    rows: "dict[int, list[int]]" = {}
+    for f in frames[:-1]:
+        assert f["done"] is False
+        for r, toks in f["rows"].items():
+            rows.setdefault(int(r), []).extend(toks)
+    for r, streamed in rows.items():
+        assert streamed == plain["tokens"][r][:len(streamed)]
+
+
+def test_sse_bad_args_clean_400(engine_server):
+    url, _ = engine_server
+    status, body = _post_json(
+        url + "/v1/generate",
+        {"prompt_tokens": [[]], "max_new_tokens": 4, "stream": True})
+    assert status == 400
+    assert "error" in body
+
+
+def test_sse_fallback_without_engine():
+    """No engine: the stream degrades to one final event with the plain
+    route's exact tokens (uniform client API either way)."""
+    server = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                             batch_window_ms=0.0, shard_devices=1)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        body = {"prompt_tokens": [[3, 4, 5]], "max_new_tokens": 4}
+        _, plain = _post_json(url + "/v1/generate", body)
+        frames = _post_sse(url + "/v1/generate", dict(body, stream=True))
+        assert len(frames) == 1
+        assert frames[0] == {"done": True, "tokens": plain["tokens"]}
+    finally:
+        httpd.shutdown()
+        server.close()
+
+
+def test_stream_stats_counted(engine_server):
+    url, server = engine_server
+    before = server.model_card()["stats"]["gen_requests"]
+    _post_sse(url + "/v1/generate",
+              {"prompt_tokens": [[8, 9]], "max_new_tokens": 4,
+               "stream": True})
+    assert server.model_card()["stats"]["gen_requests"] == before + 1

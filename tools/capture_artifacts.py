@@ -186,8 +186,11 @@ def stage_serve(log):
              "--batch-window-ms", window], 1800, log)
         ok = ok and rc == 0 and "LOADGEN_JSON" in out
     # /v1/generate: sequential requests vs the continuous-batching engine
-    # (the decode-scheduling win), same concurrent-client load.
-    for extra in ((), ("--continuous-batching",)):
+    # (the decode-scheduling win), same concurrent-client load; the third
+    # run rides the SSE route for the on-chip TTFT number (first token ~
+    # prefill latency while the total stays the full decode).
+    for extra in ((), ("--continuous-batching",),
+                  ("--continuous-batching", "--stream")):
         rc, out = _run_bounded(
             [sys.executable, "-m", "k3stpu.serve.loadgen", "--model",
              "transformer", "--clients", "8", "--seconds", "20",
