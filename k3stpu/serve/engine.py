@@ -86,7 +86,7 @@ def _sample_rows(logits, temps, topks, topps, key):
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
-                 "deadline", "stream_q", "_ptuple")
+                 "deadline", "stream_q", "_ptuple", "probe")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None):
@@ -108,6 +108,12 @@ class _Request:
         # None. Non-streaming requests leave it None (zero overhead).
         self.stream_q: "queue.SimpleQueue | None" = None
         self._ptuple: "tuple | None" = None  # memoized prompt key
+        # Memoized prompt-cache probe result (pkey, pentry) — the probe
+        # re-runs every loop iteration while the request waits for free
+        # slots, and re-scanning the cache each time is pure engine-
+        # thread waste. A stale entry stays CORRECT (immutable arrays);
+        # the only cost is missing a better prefix inserted meanwhile.
+        self.probe: "tuple | None" = None
 
     def ptuple(self) -> tuple:
         """The single-prompt cache key, computed once — the admission
@@ -439,18 +445,27 @@ class GenerateEngine:
         req.deadline = time.time() + timeout_s
         self._q.put(req)
         hard = req.deadline + 1.0
-        while True:
-            try:
-                item = req.stream_q.get(
-                    timeout=max(0.0, hard - time.time()))
-            except queue.Empty:
-                raise TimeoutError("generation did not finish in time")
-            if item is None:  # terminal: tokens ready or error
-                if req.error is not None:
-                    raise req.error
-                yield {"done": True, "tokens": req.tokens}
-                return
-            yield {"done": False, "rows": item}
+        try:
+            while True:
+                try:
+                    item = req.stream_q.get(
+                        timeout=max(0.0, hard - time.time()))
+                except queue.Empty:
+                    raise TimeoutError("generation did not finish in time")
+                if item is None:  # terminal: tokens ready or error
+                    if req.error is not None:
+                        raise req.error
+                    yield {"done": True, "tokens": req.tokens}
+                    return
+                yield {"done": False, "rows": item}
+        finally:
+            # Consumer abandoned the stream (generator .close() on client
+            # disconnect, or an exception in the consumer): expire the
+            # request NOW so the loop reaps its queue entry / admission /
+            # slots next iteration, instead of decoding the rest of the
+            # budget for nobody.
+            if req.tokens is None and req.error is None:
+                req.deadline = 0.0
 
     def close(self) -> None:
         self._closed = True
@@ -480,8 +495,16 @@ class GenerateEngine:
     # --- loop internals (single thread; owns all slot state) ------------
 
     def _free_slots(self) -> "list[int]":
+        # A row that finished EARLY (eos) while its multi-row request is
+        # still decoding stays owned: its collected tokens feed
+        # _maybe_complete, so handing the slot to a new request would
+        # clobber them (the stranger's tokens would surface in the
+        # finished request's result, and the completion bookkeeping of
+        # whichever finishes second corrupts the other's). Owner clears
+        # at completion/failure — only then is the slot reusable.
         return [i for i in range(self.slots)
-                if not self._active[i] and not self._reserved[i]]
+                if not self._active[i] and not self._reserved[i]
+                and self._owner[i] is None]
 
     def _drain_queue(self, block: bool) -> bool:
         """Move queued requests into pending. Returns False on shutdown."""
@@ -530,12 +553,15 @@ class GenerateEngine:
             prompt = pkey = pentry = None
             if self.prompt_cache > 0 and n == 1:
                 prompt = req.ptuple()
-                pkey, pentry = self._pcache_lookup(prompt)
-                if pkey is not None and len(pkey) < len(prompt):
-                    g = _pow2_at_least(len(prompt) - len(pkey))
-                    if (len(pkey) + g > self.max_seq
-                            or (c is not None and g > c)):
-                        pkey = pentry = None  # suffix too big: plain path
+                if req.probe is None:
+                    pkey, pentry = self._pcache_lookup(prompt)
+                    if pkey is not None and len(pkey) < len(prompt):
+                        g = _pow2_at_least(len(prompt) - len(pkey))
+                        if (len(pkey) + g > self.max_seq
+                                or (c is not None and g > c)):
+                            pkey = pentry = None  # suffix too big
+                    req.probe = (pkey, pentry)
+                pkey, pentry = req.probe
             chunked = c is not None and width > c and pkey is None
             if chunked and not allow_chunked:
                 i += 1  # long prompts wait for the in-flight one
@@ -638,9 +664,10 @@ class GenerateEngine:
             cache, last = self._decode_logits(self.params, cache,
                                               jnp.asarray(last_toks))
             if self.prompt_cache > 0 and a["block"].shape[0] == 1:
-                self._pcache_insert(
-                    tuple(int(t) for t in a["block"][0, :int(lens[0])]),
-                    cache, last)
+                # a["block"] row 0 == req.block row 0 by construction
+                # (both admission paths copy it verbatim), so the
+                # memoized key is THE key.
+                self._pcache_insert(a["req"].ptuple(), cache, last)
             if req.samples > 1:
                 cache, last = self._broadcast_rows(cache, last,
                                                    len(a["rows"]))
@@ -820,7 +847,7 @@ class GenerateEngine:
                     if self._left[r] <= 0 or (self._eos[r] >= 0
                                               and tok == self._eos[r]):
                         self._finish_row(r)
-                        done_reqs.add(self._owner[r])
+                        done_reqs.add(owner)
             # Deltas flush BEFORE completion: the terminal marker from
             # signal() must be the stream's last item.
             for req, d in deltas.items():

@@ -568,6 +568,26 @@ class InferenceServer:
         if self._engine is not None:
             self._engine.close()
 
+    def _validate_gen(self, prompts, max_new_tokens, num_samples):
+        """Shared eager validation for generate_tokens/generate_stream —
+        ONE copy, so a new rule (or a changed bound) applies to the
+        streaming and non-streaming routes alike. Returns the coerced
+        (max_new_tokens, num_samples)."""
+        if not self.model_name.startswith(("transformer", "moe")):
+            raise ValueError(f"{self.model_name} is not a generative LM")
+        if not prompts or any(len(p) == 0 for p in prompts):
+            raise ValueError("prompts must be non-empty token lists")
+        max_new_tokens = int(max_new_tokens)
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        num_samples = int(num_samples)
+        if num_samples < 1:
+            raise ValueError("num_samples must be >= 1")
+        # EVERY route honors the served maximum — the engine would happily
+        # chunk an unbounded request into hours of work otherwise.
+        served_batch(len(prompts) * num_samples)
+        return max_new_tokens, num_samples
+
     def _sanitize_gen(self, lens: "list[int]", max_new_tokens: int,
                       temperature: float, top_k: "int | None",
                       top_p: "float | None", eos_id: "int | None"):
@@ -627,19 +647,8 @@ class InferenceServer:
 
         from k3stpu.models.generate import generate
 
-        if not self.model_name.startswith(("transformer", "moe")):
-            raise ValueError(f"{self.model_name} is not a generative LM")
-        if not prompts or any(len(p) == 0 for p in prompts):
-            raise ValueError("prompts must be non-empty token lists")
-        max_new_tokens = int(max_new_tokens)
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        num_samples = int(num_samples)
-        if num_samples < 1:
-            raise ValueError("num_samples must be >= 1")
-        # EVERY route honors the served maximum — the engine would happily
-        # chunk an unbounded request into hours of work otherwise.
-        served_batch(len(prompts) * num_samples)
+        max_new_tokens, num_samples = self._validate_gen(
+            prompts, max_new_tokens, num_samples)
         if num_samples > 1:
             if len(prompts) != 1:
                 raise ValueError(
@@ -801,17 +810,8 @@ class InferenceServer:
         Validation runs EAGERLY (this is not a generator function), so
         bad arguments raise here and become a clean 400; only transport
         of an already-admitted request can fail mid-stream."""
-        if not self.model_name.startswith(("transformer", "moe")):
-            raise ValueError(f"{self.model_name} is not a generative LM")
-        if not prompts or any(len(p) == 0 for p in prompts):
-            raise ValueError("prompts must be non-empty token lists")
-        max_new_tokens = int(max_new_tokens)
-        if max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1")
-        num_samples = int(num_samples)
-        if num_samples < 1:
-            raise ValueError("num_samples must be >= 1")
-        served_batch(len(prompts) * num_samples)
+        max_new_tokens, num_samples = self._validate_gen(
+            prompts, max_new_tokens, num_samples)
         lens = [len(p) for p in prompts]
         (width, gen_budget, temperature, top_k, top_p,
          eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
@@ -840,22 +840,31 @@ class InferenceServer:
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
-            for ev in self._engine.submit_stream(
-                    chunk, max_new_tokens=gen_budget,
-                    temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_id=eos_id):
-                if ev["done"]:
-                    out.extend(row[:max_new_tokens]
-                               for row in ev["tokens"])
-                    continue
-                rows = {}
-                for j, toks in ev["rows"].items():
-                    take = toks[:max_new_tokens - emitted[j]]
-                    if take:
-                        emitted[j] += len(take)
-                        rows[ofs + j] = take
-                if rows:
-                    yield {"done": False, "rows": rows}
+            events = self._engine.submit_stream(
+                chunk, max_new_tokens=gen_budget,
+                temperature=temperature, top_k=top_k, top_p=top_p,
+                eos_id=eos_id)
+            try:
+                for ev in events:
+                    if ev["done"]:
+                        out.extend(row[:max_new_tokens]
+                                   for row in ev["tokens"])
+                        continue
+                    rows = {}
+                    for j, toks in ev["rows"].items():
+                        take = toks[:max_new_tokens - emitted[j]]
+                        if take:
+                            emitted[j] += len(take)
+                            rows[ofs + j] = take
+                    if rows:
+                        yield {"done": False, "rows": rows}
+            finally:
+                # Deterministic teardown: if THIS generator is closed
+                # (client disconnect) or errors, closing the engine
+                # stream fires its cancel path — the engine expires the
+                # request instead of decoding on for nobody. No-op when
+                # the stream ran to completion.
+                events.close()
         dt = time.perf_counter() - t0
         with self._stats_lock:
             self._stats["gen_requests"] += 1
@@ -1022,8 +1031,14 @@ def make_app(server: InferenceServer):
                         b"data: " + json.dumps(ev).encode() + b"\n\n")
                     self.wfile.flush()
             except (BrokenPipeError, ConnectionResetError):
-                pass  # client went away; the engine's deadline reaps it
+                # Client went away mid-stream: close the event generator,
+                # which cancels the underlying engine request (its slots
+                # free next loop iteration) instead of letting it decode
+                # its whole budget for nobody. (The no-engine fallback
+                # returns a plain list iterator — nothing to close.)
+                getattr(events, "close", lambda: None)()
             except Exception as e:  # noqa: BLE001 — headers already sent
+                getattr(events, "close", lambda: None)()
                 try:
                     self.wfile.write(
                         b"data: "

@@ -10,6 +10,7 @@ decode budget. CPU-JAX stand-in per SURVEY.md §4.
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import ThreadingHTTPServer
 
@@ -147,6 +148,36 @@ def test_stream_sampled_rows_complete(stream_engine):
         [[2, 3, 4]], max_new_tokens=6, temperature=1.0, top_k=8))
     assert len(final) == 1 and len(final[0]) == 6
     assert rows[0] == final[0][:len(rows[0])]
+
+
+def test_stream_abandoned_cancels_request():
+    """Closing the stream iterator (what the server does on client
+    disconnect) must cancel the in-flight request: its slots free within
+    an expiry cycle instead of decoding the rest of the budget for
+    nobody, and the engine keeps serving exactly."""
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm the programs
+        real = engine._decode_step
+
+        def slow_step(*args, **kwargs):  # make the 40-token decode long
+            time.sleep(0.02)
+            return real(*args, **kwargs)
+
+        engine._decode_step = slow_step
+        it = engine.submit_stream([[5, 6, 7]], max_new_tokens=40)
+        assert next(it)["done"] is False  # admitted and producing
+        it.close()  # consumer walks away mid-stream
+        deadline = time.time() + 30
+        while len(engine._free_slots()) != engine.slots:
+            assert time.time() < deadline, "abandoned stream never reaped"
+            time.sleep(0.05)
+        engine._decode_step = real
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
 
 
 # --- HTTP/SSE route ----------------------------------------------------
