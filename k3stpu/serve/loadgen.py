@@ -31,6 +31,16 @@ import numpy as np
 _MAX_ERRORS_PER_CLIENT = 10
 
 
+def _gen_prompt(rows: int) -> "list[int]":
+    """THE generate-load prompt — deterministic and shared by the warmup
+    and the measured load, so the warmed prefill program (and, with
+    --prompt-cache, the cached row) is exactly the one the load hits:
+    the measured window then shows the steady state, not one stray
+    compile/miss."""
+    rng = np.random.default_rng(0)
+    return rng.integers(1, 1000, size=(max(4, rows),)).tolist()
+
+
 def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                  latencies: list, lock: "threading.Lock", errors: list,
                  route: str = "/v1/predict", ttfts: "list | None" = None):
@@ -92,9 +102,8 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     rng = np.random.default_rng(0)
     ttfts: "list[float] | None" = None
     if generate_tokens > 0:
-        body = {"prompt_tokens": [rng.integers(
-            1, 1000, size=(max(4, rows),)).tolist()],
-            "max_new_tokens": generate_tokens}
+        body = {"prompt_tokens": [_gen_prompt(rows)],
+                "max_new_tokens": generate_tokens}
         if stream:
             body["stream"] = True
             ttfts = []
@@ -193,6 +202,13 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--decode-block", type=int, default=4,
                     help="engine tokens per device dispatch when "
                          "--continuous-batching (see server --decode-block)")
+    ap.add_argument("--prompt-cache", type=int, default=0,
+                    help="with --continuous-batching: self-hosted server "
+                         "caches this many prefilled prompt KV rows. The "
+                         "load uses ONE fixed prompt (--rows sets its "
+                         "length), so every request after the first is an "
+                         "exact hit — the measured delta vs --prompt-cache "
+                         "0 is the prefill-skip win")
     args = ap.parse_args(argv)
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
@@ -216,14 +232,23 @@ def main(argv: "list[str] | None" = None) -> int:
             seq_len=args.seq_len, batch_window_ms=args.batch_window_ms,
             continuous_batching=args.continuous_batching,
             decode_block=args.decode_block,
+            prompt_cache=args.prompt_cache,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=1 if args.continuous_batching else None)
         if args.generate_tokens > 0:
             # Compile prefill+decode (and engine programs) BEFORE the
             # measured window — first-request JIT would otherwise land in
-            # the committed before/after numbers.
+            # the committed before/after numbers. Width-matched: the
+            # warmup prompt pads to the SAME pow2 bucket as the load's
+            # (--rows-long) prompt, so the real prefill program is the
+            # one compiled here, not mid-measurement.
             print("warming up (generate path)...", flush=True)
-            server.generate_tokens([[1]], max_new_tokens=2)
+            server.generate_tokens([_gen_prompt(args.rows)],
+                                   max_new_tokens=2)
+            # Warmup dispatches are compile-dominated: without the reset
+            # they poison the committed device tokens/s (same reason
+            # server.warmup() resets for the predict path).
+            server.reset_stats()
         else:
             print("warming up...", flush=True)
             # Warm only the batch sizes this load can dispatch (largest

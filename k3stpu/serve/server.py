@@ -473,6 +473,13 @@ class InferenceServer:
             self.predict(np.zeros((b, *self.input_shape()), self.input_dtype()))
         if self.model_name.startswith(("transformer", "moe")):
             self.generate_tokens([[1]], max_new_tokens=2)
+        self.reset_stats()
+
+    def reset_stats(self) -> None:
+        """Zero every throughput counter (server, engine, spec). Callers
+        that warm compile paths themselves (loadgen's generate warmup)
+        must reset too, or the compile-dominated dispatches poison the
+        committed tokens/s."""
         if self._engine is not None:
             self._engine.reset_stats()
         with self._stats_lock:
@@ -926,6 +933,18 @@ class InferenceServer:
                 "# TYPE k3stpu_engine_busy_seconds_total counter",
                 f"k3stpu_engine_busy_seconds_total {e['busy_s']:.6f}",
             ]
+            if self._engine.prompt_cache > 0:
+                lines += [
+                    "# TYPE k3stpu_pcache_hits_total counter",
+                    f"k3stpu_pcache_hits_total {e['pcache_hits']}",
+                    "# TYPE k3stpu_pcache_prefix_hits_total counter",
+                    f"k3stpu_pcache_prefix_hits_total "
+                    f"{e['pcache_prefix_hits']}",
+                    "# TYPE k3stpu_pcache_misses_total counter",
+                    f"k3stpu_pcache_misses_total {e['pcache_misses']}",
+                    "# TYPE k3stpu_pcache_bytes gauge",
+                    f"k3stpu_pcache_bytes {e['pcache_bytes']}",
+                ]
         if self._draft is not None:
             with self._stats_lock:
                 sp = dict(self._spec_stats)

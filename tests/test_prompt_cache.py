@@ -194,6 +194,35 @@ def test_stream_from_cached_prompt(cached_engine):
     assert first["rows"] == {0: [want[0][0]]}
 
 
+def test_server_flag_and_prometheus_counters():
+    """--prompt-cache wiring end-to-end: the server's engine caches, and
+    the scrape surface exports the hit/miss/bytes series (only when the
+    cache is enabled — a disabled cache must not emit dead series)."""
+    from k3stpu.serve.server import InferenceServer
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                             batch_window_ms=0.0, continuous_batching=True,
+                             engine_slots=2, prompt_cache=2,
+                             shard_devices=1)
+    try:
+        first = server.generate_tokens([[1, 2, 3]], max_new_tokens=3)
+        assert server.generate_tokens([[1, 2, 3]], max_new_tokens=3) \
+            == first
+        text = server.prometheus_metrics()
+        assert "k3stpu_pcache_hits_total 1" in text
+        assert "k3stpu_pcache_misses_total 1" in text
+        assert "k3stpu_pcache_bytes" in text
+    finally:
+        server.close()
+    plain = InferenceServer(model_name="transformer-tiny", seq_len=32,
+                            batch_window_ms=0.0, continuous_batching=True,
+                            engine_slots=2, shard_devices=1)
+    try:
+        assert "k3stpu_pcache" not in plain.prometheus_metrics()
+    finally:
+        plain.close()
+
+
 def test_reset_stats_preserves_pcache_bytes(cached_engine):
     _, _, engine = cached_engine
     assert engine.stats()["pcache_bytes"] > 0

@@ -196,6 +196,18 @@ def stage_serve(log):
              "transformer", "--clients", "8", "--seconds", "20",
              "--generate-tokens", "64", *extra], 1800, log)
         ok = ok and rc == 0 and "LOADGEN_JSON" in out
+    # Prompt-cache win: ONE fixed 256-token prompt (loadgen's generate
+    # load always reuses its prompt), so with the cache on every request
+    # after the first skips its prefill — the latency/ttft delta vs the
+    # cache-off run is the committed prefill-skip number.
+    for extra in ((), ("--prompt-cache", "4")):
+        rc, out = _run_bounded(
+            [sys.executable, "-m", "k3stpu.serve.loadgen", "--model",
+             "transformer", "--seq-len", "512", "--rows", "256",
+             "--clients", "4", "--seconds", "12", "--generate-tokens",
+             "32", "--continuous-batching", "--stream", *extra],
+            1800, log)
+        ok = ok and rc == 0 and "LOADGEN_JSON" in out
     # tpu-info's live columns, fed by the telemetry the serving runs just
     # dropped — rendered IMMEDIATELY so the drop file is inside the
     # tool's 120 s freshness window.
