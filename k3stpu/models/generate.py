@@ -89,7 +89,8 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
              max_new_tokens: int, *, rng: jax.Array | None = None,
              temperature: float = 0.0, top_k: "int | None" = None,
              top_p: "float | None" = None,
-             eos_id: "jax.Array | int | None" = None) -> jax.Array:
+             eos_id: "jax.Array | int | None" = None,
+             adapter_ids: "jax.Array | None" = None) -> jax.Array:
     """Generate ``max_new_tokens`` continuations for a padded prompt block.
 
     ``prompt``: (B, P) int32, right-padded; ``prompt_lens``: (B,) true
@@ -113,10 +114,14 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
     if rng is None:
         rng = jax.random.key(0)
 
+    # adapter_ids (multi-LoRA serving, models/lora.py MultiLoraDense):
+    # forwarded only when present so models without the kwarg (MoE) keep
+    # their exact apply signature.
+    akw = {} if adapter_ids is None else {"adapter_ids": adapter_ids}
     cache = init_cache(model, b)
     logits, mut = model.apply({"params": params, "cache": cache}, prompt,
                               mode="prefill", seq_lens=prompt_lens,
-                              mutable=["cache"])
+                              mutable=["cache"], **akw)
     cache = mut["cache"]
     # Each row's next-token logits come from its last REAL position.
     last = jnp.take_along_axis(
@@ -132,7 +137,7 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
         rng, k = jax.random.split(rng)
         logits, mut = model.apply({"params": params, "cache": cache},
                                   tok[:, None], mode="decode",
-                                  mutable=["cache"])
+                                  mutable=["cache"], **akw)
         nxt = _sample(logits[:, -1], k, temperature=temperature,
                       top_k=top_k, top_p=top_p)
         if eos_id is not None:

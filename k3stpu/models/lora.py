@@ -66,6 +66,57 @@ class LoraDense(nn.Module):
         return y + delta * (self.alpha / self.rank)
 
 
+class MultiLoraDense(nn.Module):
+    """Bias-free Dense with N low-rank deltas selected PER ROW — the
+    multi-tenant serving form (S-LoRA pattern): one base model serves
+    many fine-tunes, and requests with different adapters coexist in one
+    batch/engine slot block.
+
+    ``y[r] = x[r] W + (x[r] A[aid[r]]) B[aid[r]] * (alpha / rank)``
+
+    TPU-first shape choices: the adapter stacks live as two tensors
+    ``(n_adapters, in, r)`` / ``(n_adapters, r, out)`` and rows GATHER
+    their adapter — ids are traced data, so one compiled program serves
+    every adapter mix (no recompile per tenant). The gather moves
+    ``B * in * r`` adapter elements per projection — at serving batch
+    sizes that is noise next to the ``in * out`` base-kernel read.
+    ``adapter_ids`` index 0 is the base convention: ``lora_b``
+    zero-initializes, so slot 0 computes exactly the base model unless
+    a loader deliberately writes it.
+    """
+
+    features: int
+    rank: int
+    n_adapters: int
+    dtype: object = jnp.bfloat16
+    alpha: float = LORA_ALPHA
+
+    @nn.compact
+    def __call__(self, x, adapter_ids=None):
+        in_features = x.shape[-1]
+        w = self.param("kernel", nn.initializers.lecun_normal(),
+                       (in_features, self.features), jnp.float32)
+        a = self.param("lora_a", nn.initializers.lecun_normal(),
+                       (self.n_adapters, in_features, self.rank),
+                       jnp.float32)
+        bm = self.param("lora_b", nn.initializers.zeros,
+                        (self.n_adapters, self.rank, self.features),
+                        jnp.float32)
+        y = jnp.dot(x.astype(self.dtype), w.astype(self.dtype))
+        if adapter_ids is None:
+            return y  # base-only call (training/init paths)
+        aid = jnp.clip(jnp.asarray(adapter_ids, jnp.int32), 0,
+                       self.n_adapters - 1)
+        xa = x.astype(self.dtype)
+        # (B, S, in) x (B, in, r) -> (B, S, r) -> x (B, r, out): two
+        # skinny batched matmuls; per-row adapter slices via gather.
+        ar = jnp.einsum("b...i,bir->b...r", xa,
+                        a[aid].astype(self.dtype))
+        delta = jnp.einsum("b...r,bro->b...o", ar,
+                           bm[aid].astype(self.dtype))
+        return y + delta * (self.alpha / self.rank)
+
+
 def lora_label_tree(params) -> dict:
     """'train' on adapter leaves, 'freeze' everywhere else — the
     param_labels tree for optax.multi_transform."""
@@ -85,6 +136,34 @@ def lora_optimizer(inner: "optax.GradientTransformation"
     return optax.multi_transform(
         {"train": inner, "freeze": optax.set_to_zero()},
         param_labels=lora_label_tree)
+
+
+def build_multi_lora_params(base_params: dict,
+                            adapters: "list[dict]") -> dict:
+    """Assemble a MultiLoraDense tree from a served base tree plus N
+    single-adapter LoRA trees (train_job --lora-rank checkpoints):
+    non-adapter leaves come from ``base_params`` verbatim; each adapter's
+    ``lora_a``/``lora_b`` lands in stack slot ``i + 1``. Slot 0 stays
+    zero — the base convention (MultiLoraDense docstring). Adapters must
+    share one rank and be trained from the served base (their own frozen
+    kernels are NOT read — the base tree is the single source)."""
+
+    def walk(base, ads):
+        out = {k: (walk(v, [a[k] for a in ads]) if isinstance(v, dict)
+                   else v)
+               for k, v in base.items()}
+        if ads and isinstance(ads[0], dict) and "lora_a" in ads[0]:
+            # One stack build per leaf (an eager .at[].set() loop would
+            # copy the whole stack once per adapter).
+            for leaf in ("lora_a", "lora_b"):
+                zero = jnp.zeros_like(
+                    jnp.asarray(ads[0][leaf], jnp.float32))
+                out[leaf] = jnp.stack(
+                    [zero] + [jnp.asarray(ad[leaf], jnp.float32)
+                              for ad in ads])
+        return out
+
+    return walk(base_params, adapters)
 
 
 def merge_lora_params(params: dict, *,

@@ -68,6 +68,14 @@ class TransformerConfig:
     # B initializes to zero, so a fresh LoRA model computes exactly its
     # base model until the adapters train.
     lora_rank: "int | None" = None
+    # None | int: multi-adapter serving (S-LoRA pattern). With
+    # ``multi_lora = N`` every projection carries N stacked rank-
+    # ``lora_rank`` adapter pairs and each batch row selects its own via
+    # the ``adapter_ids`` call argument (traced data — one compiled
+    # program serves every adapter mix). Id 0 is the base convention
+    # (lora_b zero-init). The server loads trained adapter checkpoints
+    # into slots 1..N-1 (serve/server.py --lora-adapters).
+    multi_lora: "int | None" = None
     # "einsum" | "flash" | "auto". Auto picks the Pallas flash kernel
     # (ops/attention.py) on TPU: single-device always; under a multi-device
     # mesh too for MHA, where the kernel's custom_partitioning rule lets
@@ -98,11 +106,12 @@ def _resolve_attn_impl(impl: str, mha: bool = False) -> str:
 
 def _proj(cfg: TransformerConfig, features: int, name: str):
     """Projection Dense — float by default, int8 weight-only under
-    cfg.quant, low-rank-adapted under cfg.lora_rank (same module path;
-    models/quant.py and models/lora.py convert between the trees)."""
+    cfg.quant, low-rank-adapted under cfg.lora_rank, N-adapter
+    row-routed under cfg.multi_lora (same module path; models/quant.py
+    and models/lora.py convert between the trees)."""
     if cfg.quant in ("int8", "int8-dynamic"):
-        if cfg.lora_rank is not None:
-            raise ValueError("quant and lora_rank are exclusive: merge "
+        if cfg.lora_rank is not None or cfg.multi_lora is not None:
+            raise ValueError("quant and lora are exclusive: merge "
                              "the adapters first (models/lora.py), then "
                              "quantize the merged tree")
         from k3stpu.models.quant import QuantDense
@@ -111,6 +120,15 @@ def _proj(cfg: TransformerConfig, features: int, name: str):
                           dynamic_act=cfg.quant == "int8-dynamic")
     if cfg.quant is not None:
         raise ValueError(f"unknown quant mode {cfg.quant!r}")
+    if cfg.multi_lora is not None:
+        from k3stpu.models.lora import MultiLoraDense
+
+        if cfg.lora_rank is None:
+            raise ValueError("multi_lora needs lora_rank (the shared "
+                             "adapter rank)")
+        return MultiLoraDense(features, rank=cfg.lora_rank,
+                              n_adapters=cfg.multi_lora, dtype=cfg.dtype,
+                              name=name)
     if cfg.lora_rank is not None:
         from k3stpu.models.lora import LoraDense
 
@@ -118,6 +136,16 @@ def _proj(cfg: TransformerConfig, features: int, name: str):
                          name=name)
     return nn.Dense(features, use_bias=False, dtype=cfg.dtype,
                     param_dtype=jnp.float32, name=name)
+
+
+def _apply_proj(cfg: TransformerConfig, features: int, name: str, x,
+                adapter_ids=None):
+    """Apply the projection; only the multi-LoRA module takes the
+    per-row adapter ids (every other projection type ignores them)."""
+    m = _proj(cfg, features, name)
+    if cfg.multi_lora is not None:
+        return m(x, adapter_ids)
+    return m(x)
 
 
 def rope_frequencies(head_dim: int, max_seq_len: int) -> np.ndarray:
@@ -166,7 +194,8 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, *, mode: str = "full", seq_lens=None):
+    def __call__(self, x, *, mode: str = "full", seq_lens=None,
+                 adapter_ids=None):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
@@ -193,7 +222,8 @@ class Attention(nn.Module):
 
         # One fused projection; with GQA the K/V slices are simply narrower
         # (the parameter is (d_model, d_model + 2*kv_dim)).
-        qkv = _proj(cfg, cfg.d_model + 2 * kv_dim, "qkv")(x)
+        qkv = _apply_proj(cfg, cfg.d_model + 2 * kv_dim, "qkv", x,
+                          adapter_ids)
         q = qkv[..., :cfg.d_model].reshape(b, s, cfg.n_heads, head_dim)
         k = qkv[..., cfg.d_model:cfg.d_model + kv_dim].reshape(
             b, s, kv_heads, head_dim)
@@ -341,23 +371,25 @@ class Attention(nn.Module):
                                       k=-cfg.sliding_window)
                 out = grouped_attention(q, k, v, mask[None])
         out = out.reshape(b, s, cfg.d_model)
-        return _proj(cfg, cfg.d_model, "proj")(out)
+        return _apply_proj(cfg, cfg.d_model, "proj", out, adapter_ids)
 
 
 class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, mode: str = "full", seq_lens=None):
+    def __call__(self, x, mode: str = "full", seq_lens=None,
+                 adapter_ids=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
-        x = x + Attention(cfg, name="attn")(h, mode=mode, seq_lens=seq_lens)
+        x = x + Attention(cfg, name="attn")(h, mode=mode, seq_lens=seq_lens,
+                                            adapter_ids=adapter_ids)
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
-        h = _proj(cfg, cfg.d_ff, "mlp_in")(h)
+        h = _apply_proj(cfg, cfg.d_ff, "mlp_in", h, adapter_ids)
         h = nn.gelu(h)
-        h = _proj(cfg, cfg.d_model, "mlp_out")(h)
+        h = _apply_proj(cfg, cfg.d_model, "mlp_out", h, adapter_ids)
         return x + h
 
 
@@ -366,7 +398,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, mode: str = "full",
-                 seq_lens=None):
+                 seq_lens=None, adapter_ids=None):
         del train  # no dropout: inference-first; training uses weight decay
         cfg = self.config
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
@@ -379,7 +411,8 @@ class TransformerLM(nn.Module):
         block_cls = (nn.remat(Block, static_argnums=(2,)) if cfg.remat
                      else Block)
         for i in range(cfg.n_layers):
-            x = block_cls(cfg, name=f"block{i}")(x, mode, seq_lens)
+            x = block_cls(cfg, name=f"block{i}")(x, mode, seq_lens,
+                                                 adapter_ids)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # Weight-tied head; logits cast to fp32 for a stable softmax/loss.

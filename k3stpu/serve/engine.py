@@ -86,10 +86,10 @@ def _sample_rows(logits, temps, topks, topps, key):
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
-                 "deadline", "stream_q", "_ptuple", "probe")
+                 "deadline", "stream_q", "_ptuple", "probe", "adapter")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
-                 top_p=None):
+                 top_p=None, adapter=0):
         self.block = block          # (n, P) int32, right-padded
         self.lens = lens            # (n,) true lengths
         self.budget = budget        # max new tokens (shared by the rows)
@@ -98,6 +98,7 @@ class _Request:
         self.top_p = top_p          # float | None (None == 1.0, no cut)
         self.eos = eos              # int | None
         self.samples = samples      # >1: one prompt, n sampled rows
+        self.adapter = adapter      # multi-LoRA slot (0 = base)
         self.event = threading.Event()
         self.tokens: "list[list[int]] | None" = None
         self.error: "Exception | None" = None
@@ -190,6 +191,12 @@ class GenerateEngine:
         cfg = getattr(model.config, "base", model.config)
         self.max_seq = cfg.max_seq_len
         self.vocab = cfg.vocab_size
+        # Multi-LoRA serving (models/lora.py MultiLoraDense): per-slot
+        # adapter ids travel as a traced (B,) array, so requests on
+        # DIFFERENT fine-tunes share the one decode program/batch. None
+        # when the model has no adapter stacks — every core is then
+        # called exactly as before (no recompile, no behavior change).
+        self.n_adapters = getattr(cfg, "multi_lora", None)
 
         self._cache = init_cache(model, slots)
         self._base_key = jax.random.key(seed)
@@ -204,6 +211,7 @@ class GenerateEngine:
         self._topks = np.full((slots,), 1, np.int32)
         self._topps = np.ones((slots,), np.float32)
         self._eos = np.full((slots,), -1, np.int32)
+        self._aids = np.zeros((slots,), np.int32)  # multi-LoRA slots
         self._owner: "list[_Request | None]" = [None] * slots
         self._collected: "list[list[int]]" = [[] for _ in range(slots)]
 
@@ -235,14 +243,16 @@ class GenerateEngine:
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _decode_step(self, params, cache, toks, temps, topks, topps,
-                     step, base_key):
-        cache, logits = decode_core(self.model, params, cache, toks)
+                     step, base_key, aids=None):
+        cache, logits = decode_core(self.model, params, cache, toks,
+                                    adapter_ids=aids)
         key = jax.random.fold_in(base_key, step)
         return cache, _sample_rows(logits, temps, topks, topps, key)
 
     @functools.partial(jax.jit, static_argnums=(0, 9))
     def _decode_block_step(self, params, cache, toks, temps, topks,
-                           topps, step, base_key, k_tokens: int):
+                           topps, step, base_key, k_tokens: int,
+                           aids=None):
         """K decode steps in ONE dispatch: ``lax.scan`` over the
         single-token core, sampling on-device each step. Returns the
         (K, B) token block; greedy rows are exactly K steps of argmax,
@@ -255,7 +265,8 @@ class GenerateEngine:
 
         def body(carry, i):
             cache, tok = carry
-            cache, logits = decode_core(self.model, params, cache, tok)
+            cache, logits = decode_core(self.model, params, cache, tok,
+                                        adapter_ids=aids)
             key = jax.random.fold_in(block_key, i)
             nxt = _sample_rows(logits, temps, topks, topps, key)
             return (cache, nxt), nxt
@@ -265,20 +276,23 @@ class GenerateEngine:
         return cache, out
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, params, block, lens):
-        return prefill_core(self.model, params, block, lens)
+    def _prefill(self, params, block, lens, aids=None):
+        return prefill_core(self.model, params, block, lens,
+                            adapter_ids=aids)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _scatter(self, big, small, slot_ids):
         return jax.tree.map(lambda b, s: b.at[slot_ids].set(s), big, small)
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _extend_chunk(self, params, cache, chunk):
-        return extend_core(self.model, params, cache, chunk)[0]
+    def _extend_chunk(self, params, cache, chunk, aids=None):
+        return extend_core(self.model, params, cache, chunk,
+                           adapter_ids=aids)[0]
 
     @functools.partial(jax.jit, static_argnums=(0,))
-    def _decode_logits(self, params, cache, toks):
-        return decode_core(self.model, params, cache, toks)
+    def _decode_logits(self, params, cache, toks, aids=None):
+        return decode_core(self.model, params, cache, toks,
+                           adapter_ids=aids)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _first_sample(self, last_logits, temps, topks, topps, step,
@@ -298,26 +312,31 @@ class GenerateEngine:
     #     arrays, so a cached row survives the decodes of whatever slot
     #     its copy was scattered into) ------------------------------------
 
-    def _pcache_lookup(self, prompt: tuple):
+    def _pcache_lookup(self, prompt: tuple, adapter: int = 0):
         """Longest cached entry equal to ``prompt`` or a proper prefix of
-        it; a hit refreshes its LRU position."""
+        it, UNDER THE SAME ADAPTER (a row prefilled through adapter i's
+        deltas is a different computation — cross-adapter reuse would be
+        silently wrong); a hit refreshes its LRU position. Returns the
+        PROMPT part of the key."""
         best = None
-        for key in self._pcache:
-            if (len(key) <= len(prompt) and prompt[:len(key)] == key
+        for aid, key in self._pcache:
+            if (aid == adapter and len(key) <= len(prompt)
+                    and prompt[:len(key)] == key
                     and (best is None or len(key) > len(best))):
                 best = key
         if best is None:
             return None, None
-        entry = self._pcache.pop(best)  # re-insert at MRU position
-        self._pcache[best] = entry
+        entry = self._pcache.pop((adapter, best))  # re-insert at MRU
+        self._pcache[(adapter, best)] = entry
         return best, entry
 
-    def _pcache_insert(self, prompt: tuple, cache1, last1) -> None:
+    def _pcache_insert(self, prompt: tuple, cache1, last1,
+                       adapter: int = 0) -> None:
         if self.prompt_cache <= 0:
             return
-        old = self._pcache.pop(prompt, None)
+        old = self._pcache.pop((adapter, prompt), None)
         nbytes = sum(x.nbytes for x in jax.tree.leaves((cache1, last1)))
-        self._pcache[prompt] = (cache1, last1, nbytes)
+        self._pcache[(adapter, prompt)] = (cache1, last1, nbytes)
         delta = nbytes - (old[2] if old else 0)
         while len(self._pcache) > self.prompt_cache:
             evicted = self._pcache.pop(next(iter(self._pcache)))
@@ -326,7 +345,8 @@ class GenerateEngine:
             self._stats["pcache_bytes"] = (
                 self._stats.get("pcache_bytes", 0) + delta)
 
-    def _pcache_extend(self, cache1, prompt: tuple, p0: int):
+    def _pcache_extend(self, cache1, prompt: tuple, p0: int,
+                       adapter: int = 0):
         """Append ``prompt[p0:]`` to a restored 1-row cache (row index sits
         at p0). Returns (cache, last_logits) in EXACTLY the post-prefill
         state: the suffix pads to a pow2 chunk, the index rolls back to
@@ -337,18 +357,37 @@ class GenerateEngine:
         g = _pow2_at_least(extra.shape[1])
         pad = np.zeros((1, g), np.int32)
         pad[:, :extra.shape[1]] = extra
-        cache = self._extend_chunk(self.params, cache1, jnp.asarray(pad))
+        aids = self._aid_arg(1, adapter)
+        cache = self._extend_chunk(self.params, cache1, jnp.asarray(pad),
+                                   aids)
         cache = set_cache_index(
             cache, jnp.asarray([len(prompt) - 1], jnp.int32))
         return self._decode_logits(
-            self.params, cache, jnp.asarray([prompt[-1]], jnp.int32))
+            self.params, cache, jnp.asarray([prompt[-1]], jnp.int32), aids)
+
+    def _aid_arg(self, n: int, adapter: int):
+        """(n,)-row adapter-id array for a single request's device call —
+        None when the model carries no adapter stacks (exact pre-multi-
+        LoRA program signatures)."""
+        if self.n_adapters is None:
+            return None
+        return jnp.full((n,), adapter, jnp.int32)
 
     # --- client API -----------------------------------------------------
 
     def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
-                        eos_id, samples=1, top_p=None) -> "_Request":
+                        eos_id, samples=1, top_p=None,
+                        adapter_id=0) -> "_Request":
         """Shared validation + packing for both entry points: right-pad to
         a pow2 width bucket and bound against the cache."""
+        adapter_id = int(adapter_id)
+        if adapter_id != 0 and self.n_adapters is None:
+            raise ValueError("this engine's model has no adapter stacks "
+                             "(multi_lora is off); adapter_id must be 0")
+        if self.n_adapters is not None \
+                and not 0 <= adapter_id < self.n_adapters:
+            raise ValueError(f"adapter_id {adapter_id} outside "
+                             f"[0, {self.n_adapters})")
         lens = [len(p) for p in prompts]
         if min(lens) == 0:
             raise ValueError("prompts must be non-empty")
@@ -362,7 +401,7 @@ class GenerateEngine:
             block[i, :len(p)] = p
         return _Request(block, np.asarray(lens, np.int32), max_new_tokens,
                         float(temperature), top_k, eos_id, samples=samples,
-                        top_p=top_p)
+                        top_p=top_p, adapter=adapter_id)
 
     def _enqueue_and_wait(self, req: "_Request",
                           timeout_s: float) -> "list[list[int]]":
@@ -380,7 +419,7 @@ class GenerateEngine:
     def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
                temperature: float = 0.0, top_k: "int | None" = None,
                top_p: "float | None" = None,
-               eos_id: "int | None" = None,
+               eos_id: "int | None" = None, adapter_id: int = 0,
                timeout_s: float = 600.0) -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists."""
         if self._closed:
@@ -389,14 +428,15 @@ class GenerateEngine:
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
         req = self._packed_request(prompts, max_new_tokens, temperature,
-                                   top_k, eos_id, top_p=top_p)
+                                   top_k, eos_id, top_p=top_p,
+                                   adapter_id=adapter_id)
         return self._enqueue_and_wait(req, timeout_s)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
                        max_new_tokens: int, temperature: float = 1.0,
                        top_k: "int | None" = None,
                        top_p: "float | None" = None,
-                       eos_id: "int | None" = None,
+                       eos_id: "int | None" = None, adapter_id: int = 0,
                        timeout_s: float = 600.0) -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
         prefill: the prefilled cache row broadcasts across n slots and the
@@ -407,14 +447,15 @@ class GenerateEngine:
         if not 1 <= n <= self.slots:
             raise ValueError(f"need 1..{self.slots} samples, got {n}")
         req = self._packed_request([prompt], max_new_tokens, temperature,
-                                   top_k, eos_id, samples=n, top_p=top_p)
+                                   top_k, eos_id, samples=n, top_p=top_p,
+                                   adapter_id=adapter_id)
         return self._enqueue_and_wait(req, timeout_s)
 
     def submit_stream(self, prompts: "list[list[int]]", *,
                       max_new_tokens: int, temperature: float = 0.0,
                       top_k: "int | None" = None,
                       top_p: "float | None" = None,
-                      eos_id: "int | None" = None,
+                      eos_id: "int | None" = None, adapter_id: int = 0,
                       timeout_s: float = 600.0):
         """Streaming submit(): returns an iterator of events.
 
@@ -434,7 +475,8 @@ class GenerateEngine:
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
         req = self._packed_request(prompts, max_new_tokens, temperature,
-                                   top_k, eos_id, top_p=top_p)
+                                   top_k, eos_id, top_p=top_p,
+                                   adapter_id=adapter_id)
         req.stream_q = queue.SimpleQueue()
         return self._stream_events(req, timeout_s)
 
@@ -554,7 +596,7 @@ class GenerateEngine:
             if self.prompt_cache > 0 and n == 1:
                 prompt = req.ptuple()
                 if req.probe is None:
-                    pkey, pentry = self._pcache_lookup(prompt)
+                    pkey, pentry = self._pcache_lookup(prompt, req.adapter)
                     if pkey is not None and len(pkey) < len(prompt):
                         g = _pow2_at_least(len(prompt) - len(pkey))
                         if (len(pkey) + g > self.max_seq
@@ -581,8 +623,9 @@ class GenerateEngine:
                         small, last = pentry[0], pentry[1]
                     else:
                         small, last = self._pcache_extend(
-                            pentry[0], prompt, len(pkey))
-                        self._pcache_insert(prompt, small, last)
+                            pentry[0], prompt, len(pkey), req.adapter)
+                        self._pcache_insert(prompt, small, last,
+                                            req.adapter)
                     if req.samples > 1:
                         small, last = self._broadcast_rows(small, last, nb)
                     self._activate(req, free[:nb], n_rows, small, last)
@@ -610,7 +653,8 @@ class GenerateEngine:
                 try:
                     small, _ = self._prefill(
                         self.params, jnp.asarray(block[:, :c]),
-                        jnp.full((block.shape[0],), c, jnp.int32))
+                        jnp.full((block.shape[0],), c, jnp.int32),
+                        self._aid_arg(block.shape[0], req.adapter))
                 except Exception as e:  # noqa: BLE001
                     req.error = e
                     req.signal()
@@ -624,10 +668,11 @@ class GenerateEngine:
                     self._stats["adm_chunks"] += 1
                 return
             try:
-                small, last = self._prefill(self.params, jnp.asarray(block),
-                                            jnp.asarray(lens))
+                small, last = self._prefill(
+                    self.params, jnp.asarray(block), jnp.asarray(lens),
+                    self._aid_arg(block.shape[0], req.adapter))
                 if prompt is not None:  # 1-row, pre-broadcast state
-                    self._pcache_insert(prompt, small, last)
+                    self._pcache_insert(prompt, small, last, req.adapter)
                 if req.samples > 1:
                     small, last = self._broadcast_rows(small, last, nb)
                 self._activate(req, all_rows, n_rows, small, last)
@@ -646,7 +691,8 @@ class GenerateEngine:
                 end = min(a["pos"] + c, width)
                 a["cache"] = self._extend_chunk(
                     self.params, a["cache"],
-                    jnp.asarray(a["block"][:, a["pos"]:end]))
+                    jnp.asarray(a["block"][:, a["pos"]:end]),
+                    self._aid_arg(a["block"].shape[0], req.adapter))
                 a["pos"] = end
                 with self._lock:
                     self._stats["adm_chunks"] += 1
@@ -661,13 +707,15 @@ class GenerateEngine:
             cache = set_cache_index(a["cache"],
                                     jnp.asarray(lens - 1, jnp.int32))
             last_toks = a["block"][np.arange(len(lens)), lens - 1]
-            cache, last = self._decode_logits(self.params, cache,
-                                              jnp.asarray(last_toks))
+            cache, last = self._decode_logits(
+                self.params, cache, jnp.asarray(last_toks),
+                self._aid_arg(len(lens), req.adapter))
             if self.prompt_cache > 0 and a["block"].shape[0] == 1:
                 # a["block"] row 0 == req.block row 0 by construction
                 # (both admission paths copy it verbatim), so the
                 # memoized key is THE key.
-                self._pcache_insert(a["req"].ptuple(), cache, last)
+                self._pcache_insert(a["req"].ptuple(), cache, last,
+                                    req.adapter)
             if req.samples > 1:
                 cache, last = self._broadcast_rows(cache, last,
                                                    len(a["rows"]))
@@ -711,6 +759,7 @@ class GenerateEngine:
         for j, r in enumerate(rows):
             self._active[r] = True
             self._owner[r] = req
+            self._aids[r] = req.adapter
             self._last_tok[r] = int(first[j])
             self._left[r] = req.budget - 1
             self._temps[r] = req.temp
@@ -799,6 +848,8 @@ class GenerateEngine:
             t0 = time.perf_counter()
             self._step_counter += 1
             k_tok = self.decode_block
+            aids = (jnp.asarray(self._aids)
+                    if self.n_adapters is not None else None)
             try:
                 if k_tok == 1:
                     self._cache, nxt = self._decode_step(
@@ -807,7 +858,7 @@ class GenerateEngine:
                         jnp.asarray(self._temps),
                         jnp.asarray(self._topks),
                         jnp.asarray(self._topps),
-                        self._step_counter, self._base_key)
+                        self._step_counter, self._base_key, aids)
                     block = np.asarray(nxt)[None]          # (1, B)
                 else:
                     self._cache, nxt = self._decode_block_step(
@@ -816,7 +867,7 @@ class GenerateEngine:
                         jnp.asarray(self._temps),
                         jnp.asarray(self._topks),
                         jnp.asarray(self._topps),
-                        self._step_counter, self._base_key, k_tok)
+                        self._step_counter, self._base_key, k_tok, aids)
                     block = np.asarray(nxt)                # (K, B)
             except Exception as e:  # noqa: BLE001 — fail every live request
                 for req in {self._owner[r] for r in range(self.slots)

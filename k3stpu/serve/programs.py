@@ -26,31 +26,38 @@ def prompt_width_bucket(max_len: int, max_seq: int, floor: int = 8) -> int:
     return min(max(width, floor), max_seq)
 
 
-def prefill_core(model, params, block, lens):
+def _akw(adapter_ids):
+    # Multi-LoRA per-row adapter ids: forwarded only when present, so
+    # models without the kwarg (MoE) keep their exact apply signature.
+    return {} if adapter_ids is None else {"adapter_ids": adapter_ids}
+
+
+def prefill_core(model, params, block, lens, adapter_ids=None):
     """Prefill the prompt block: returns ``(cache, last_logits)`` where
     ``last_logits[r]`` is row r's distribution at its last REAL position
     (fp32) — the first-token source for every scheduler."""
     cache = init_cache(model, block.shape[0])
     logits, mut = model.apply({"params": params, "cache": cache}, block,
                               mode="prefill", seq_lens=lens,
-                              mutable=["cache"])
+                              mutable=["cache"], **_akw(adapter_ids))
     last = jnp.take_along_axis(logits, (lens - 1)[:, None, None],
                                axis=1)[:, 0]
     return mut["cache"], last.astype(jnp.float32)
 
 
-def decode_core(model, params, cache, toks):
+def decode_core(model, params, cache, toks, adapter_ids=None):
     """One decode step for (B,) tokens: ``(cache, logits (B, V) fp32)``."""
     logits, mut = model.apply({"params": params, "cache": cache},
                               toks[:, None], mode="decode",
-                              mutable=["cache"])
+                              mutable=["cache"], **_akw(adapter_ids))
     return mut["cache"], logits[:, -1].astype(jnp.float32)
 
 
-def extend_core(model, params, cache, chunk):
+def extend_core(model, params, cache, chunk, adapter_ids=None):
     """Chunk-append (B, G) tokens at per-row offsets:
     ``(cache, logits (B, G, V) fp32)`` — logits[:, j] scores the next
     token after chunk[:, :j+1]."""
     logits, mut = model.apply({"params": params, "cache": cache}, chunk,
-                              mode="extend", mutable=["cache"])
+                              mode="extend", mutable=["cache"],
+                              **_akw(adapter_ids))
     return mut["cache"], logits.astype(jnp.float32)

@@ -187,6 +187,7 @@ class InferenceServer:
                  prefill_chunk: "int | None" = None,
                  decode_block: int = 4,
                  prompt_cache: int = 0,
+                 lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
                  spec_gamma: int = 4):
@@ -328,6 +329,96 @@ class InferenceServer:
             self._variables = merged
             self.loaded_step = step
 
+        # Multi-LoRA serving (S-LoRA pattern, models/lora.py
+        # MultiLoraDense): load N trained adapter checkpoints into
+        # stacked per-projection deltas, each request routing to its
+        # adapter by name — one base model, one decode batch, many
+        # fine-tunes. Runs AFTER base-checkpoint adoption (the stacks
+        # attach to the weights actually served) and BEFORE quant
+        # (exclusive) / sharding (gated).
+        self.adapter_names: "list[str] | None" = None
+        if lora_adapters:
+            if not model_name.startswith("transformer"):
+                raise ValueError("--lora-adapters supports the dense "
+                                 "transformer family")
+            if quant is not None:
+                raise ValueError("--lora-adapters and --quant are "
+                                 "exclusive: adapters stay low-rank float")
+            import dataclasses
+
+            import jax.numpy as jnp
+
+            from k3stpu.models.lora import build_multi_lora_params
+            from k3stpu.utils import checkpoint as ckpt
+
+            pairs = []
+            for spec in lora_adapters.split(","):
+                if "=" not in spec:
+                    raise ValueError(
+                        f"--lora-adapters entry {spec!r}: want name=dir")
+                name, d = (t.strip() for t in spec.split("=", 1))
+                pairs.append((name, d))
+            names = [n for n, _ in pairs]
+            if len(set(names)) != len(names) or "base" in names:
+                raise ValueError("adapter names must be unique and not "
+                                 "'base' (reserved for adapter slot 0)")
+            rank = None
+            steps = []
+            for name, d in pairs:
+                astep = ckpt.latest_step(d)
+                if astep is None:
+                    raise ValueError(f"adapter {name}: no finalized "
+                                     f"checkpoint under {d}")
+                r = self._lora_rank_in(ckpt.tree_metadata(d, astep))
+                if r is None:
+                    raise ValueError(f"adapter {name}: checkpoint under "
+                                     f"{d} carries no lora_a/lora_b "
+                                     f"leaves (not a --lora-rank run?)")
+                if rank is None:
+                    rank = r
+                elif r != rank:
+                    raise ValueError(
+                        f"adapter {name} has rank {r}, first adapter has "
+                        f"{rank} — one shared rank per serving process")
+                steps.append(astep)
+            # ONE restore template for every adapter (ranks are equal by
+            # the check above), and shape-only — eval_shape materializes
+            # no weights for a tree that exists just to type the restore.
+            lmodel = type(self.model)(dataclasses.replace(
+                self.model.config, lora_rank=rank))
+            lvars = jax.eval_shape(
+                lambda: lmodel.init(jax.random.key(0), example[:1],
+                                    train=False))
+            adapters = [
+                ckpt.restore_collections(d, astep,
+                                         {"params": lvars["params"]})
+                ["params"]
+                for (name, d), astep in zip(pairs, steps)]
+            self.model = type(self.model)(dataclasses.replace(
+                self.model.config, lora_rank=rank,
+                multi_lora=len(pairs) + 1))
+            mlvars = self.model.init(jax.random.key(0), example[:1],
+                                     train=False)
+            built = build_multi_lora_params(self._variables["params"],
+                                            adapters)
+
+            def adopt_ml(init, new):
+                new = jnp.asarray(new, init.dtype)
+                if new.shape != init.shape:
+                    raise ValueError(
+                        f"adapter leaf shape {new.shape} != model's "
+                        f"{init.shape} — adapters must be trained from "
+                        f"this base architecture")
+                return new
+
+            self._variables = {
+                **self._variables,
+                "params": jax.tree.map(adopt_ml, mlvars["params"], built),
+            }
+            self.adapter_names = names
+            print(f"loaded {len(names)} rank-{rank} LoRA adapter(s): "
+                  f"{', '.join(names)}", flush=True)
+
         # Weight-only int8 (models/quant.py): swap the float projection
         # kernels for int8+scale AFTER checkpoint adoption (quantize what
         # will actually be served) and rebuild the model in its quant
@@ -383,6 +474,11 @@ class InferenceServer:
         if shard_devices is None:
             shard_devices = n_local if n_local > 1 else 1
         self._mesh = None
+        if shard_devices > 1 and self.adapter_names is not None:
+            raise ValueError(
+                "--lora-adapters with tensor-parallel --shard-devices is "
+                "not supported yet: the (n_adapters, in, r) stacks need "
+                "their own partitioning rules")
         if shard_devices > 1:
             from k3stpu.parallel.mesh import make_mesh
             from k3stpu.parallel.sharding import replicated, shard_params
@@ -575,6 +671,23 @@ class InferenceServer:
         if self._engine is not None:
             self._engine.close()
 
+    def _adapter_id(self, adapter: "str | None") -> int:
+        """Adapter name -> MultiLoraDense slot. None/'base' is slot 0
+        (the base model, valid whether or not adapters are loaded);
+        anything else must name a loaded adapter."""
+        if adapter is None or adapter == "base":
+            return 0
+        if self.adapter_names is None:
+            raise ValueError(
+                f"adapter {adapter!r} requested but no adapters are "
+                f"loaded (--lora-adapters)")
+        try:
+            return self.adapter_names.index(adapter) + 1
+        except ValueError:
+            raise ValueError(
+                f"unknown adapter {adapter!r}; available: "
+                f"{['base'] + self.adapter_names}")
+
     def _validate_gen(self, prompts, max_new_tokens, num_samples):
         """Shared eager validation for generate_tokens/generate_stream —
         ONE copy, so a new rule (or a changed bound) applies to the
@@ -637,7 +750,8 @@ class InferenceServer:
                         top_k: "int | None" = None,
                         top_p: "float | None" = None,
                         eos_id: "int | None" = None,
-                        num_samples: int = 1) -> "list[list[int]]":
+                        num_samples: int = 1,
+                        adapter: "str | None" = None) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
@@ -656,6 +770,7 @@ class InferenceServer:
 
         max_new_tokens, num_samples = self._validate_gen(
             prompts, max_new_tokens, num_samples)
+        aid = self._adapter_id(adapter)
         if num_samples > 1:
             if len(prompts) != 1:
                 raise ValueError(
@@ -681,7 +796,7 @@ class InferenceServer:
                 out.extend(self._engine.submit_samples(
                     prompts[0], k, max_new_tokens=gen_budget,
                     temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_id=eos_id))
+                    eos_id=eos_id, adapter_id=aid))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -692,8 +807,9 @@ class InferenceServer:
             return out
 
         # Spec decode needs a gamma-token margin in the cache; requests
-        # without it (or sampled ones) take the plain path instead.
-        if self._spec_eligible(width, gen_budget, temperature):
+        # without it (or sampled / adapter-routed ones — the draft model
+        # has no adapter stacks to draft with) take the plain path.
+        if aid == 0 and self._spec_eligible(width, gen_budget, temperature):
             from k3stpu.serve.speculative import speculative_generate
 
             # Same bounded-compile-cache discipline as every other route:
@@ -746,7 +862,8 @@ class InferenceServer:
                 out.extend(self._engine.submit(
                     prompts[ofs:ofs + self._engine.slots],
                     max_new_tokens=gen_budget, temperature=temperature,
-                    top_k=top_k, top_p=top_p, eos_id=eos_id))
+                    top_k=top_k, top_p=top_p, eos_id=eos_id,
+                    adapter_id=aid))
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -775,11 +892,14 @@ class InferenceServer:
             # for a given request ordinal.
             self._gen_counter += 1
             rng = jax.random.key(self._gen_counter)
+            akw = ({"adapter_ids": jnp.full((batch,), aid, jnp.int32)}
+                   if getattr(self.model.config, "multi_lora", None)
+                   else {})
             out = np.asarray(generate(
                 self.model, self._variables["params"], jnp.asarray(block),
                 jnp.asarray(plens), gen_budget, rng=rng,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id))
+                eos_id=eos_id, **akw))
         dt = time.perf_counter() - t0
         out = out[:n, :max_new_tokens]
         with self._stats_lock:
@@ -804,7 +924,8 @@ class InferenceServer:
                         top_k: "int | None" = None,
                         top_p: "float | None" = None,
                         eos_id: "int | None" = None,
-                        num_samples: int = 1):
+                        num_samples: int = 1,
+                        adapter: "str | None" = None):
         """Streaming generate: an iterator of JSON-able events for the
         SSE route. Engine-backed requests yield per-decode-block deltas
         ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
@@ -819,24 +940,25 @@ class InferenceServer:
         of an already-admitted request can fail mid-stream."""
         max_new_tokens, num_samples = self._validate_gen(
             prompts, max_new_tokens, num_samples)
+        aid = self._adapter_id(adapter)
         lens = [len(p) for p in prompts]
         (width, gen_budget, temperature, top_k, top_p,
          eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
                                       top_k, top_p, eos_id)
-        spec_route = (num_samples == 1 and
+        spec_route = (num_samples == 1 and aid == 0 and
                       self._spec_eligible(width, gen_budget, temperature))
         if self._engine is None or num_samples > 1 or spec_route:
             tokens = self.generate_tokens(
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, num_samples=num_samples)
+                eos_id=eos_id, num_samples=num_samples, adapter=adapter)
             return iter([{"done": True, "tokens": tokens}])
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
-            top_p, eos_id)
+            top_p, eos_id, aid)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
-                              temperature, top_k, top_p, eos_id):
+                              temperature, top_k, top_p, eos_id, aid=0):
         """Engine-backed streaming (args pre-sanitized). Requests wider
         than the slot block stream chunk by chunk with global row
         indices; deltas clip at max_new_tokens per row (the engine
@@ -850,7 +972,7 @@ class InferenceServer:
             events = self._engine.submit_stream(
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id)
+                eos_id=eos_id, adapter_id=aid)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -1006,6 +1128,8 @@ class InferenceServer:
             "batching": {"window_ms": (self._batcher._window_s * 1e3
                                        if self._batcher else 0.0)},
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
+            "adapters": (["base"] + self.adapter_names
+                         if self.adapter_names else None),
             "quant": self._quant_card(),
             "engine": (self._engine.stats() if self._engine else None),
             "speculative": self._spec_card(),
@@ -1108,7 +1232,8 @@ def make_app(server: InferenceServer):
                         top_k=req.get("top_k"),
                         top_p=req.get("top_p"),
                         eos_id=req.get("eos_id"),
-                        num_samples=req.get("num_samples", 1))
+                        num_samples=req.get("num_samples", 1),
+                        adapter=req.get("adapter"))
                     if req.get("stream"):
                         events = server.generate_stream(
                             req["prompt_tokens"], **kwargs)
@@ -1232,6 +1357,12 @@ def main(argv=None) -> int:
                          "through a relayed backend costs ~8 ms flat, so "
                          "K>1 amortizes the floor K-fold; new requests "
                          "join on block boundaries (K-token granularity)")
+    ap.add_argument("--lora-adapters", default=None,
+                    help="comma list name=ckpt_dir: serve N LoRA "
+                         "fine-tunes of one base (S-LoRA). Requests pick "
+                         "theirs via {\"adapter\": name}; omitted = base. "
+                         "Adapters must share one rank and be trained "
+                         "from the served base (train_job --lora-rank)")
     ap.add_argument("--prompt-cache", type=int, default=0,
                     help="with --continuous-batching: LRU-cache this many "
                          "prefilled prompt KV rows — a repeat prompt skips "
@@ -1282,6 +1413,7 @@ def main(argv=None) -> int:
                              prefill_chunk=args.prefill_chunk,
                              decode_block=args.decode_block,
                              prompt_cache=args.prompt_cache,
+                             lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
                              spec_gamma=args.spec_gamma)

@@ -1,0 +1,292 @@
+"""Multi-LoRA serving: N fine-tunes of one base, routed per request.
+
+Three exactness bars:
+- model math: the row-routed delta path must agree with independently
+  FOLDING each adapter into the kernels (merge_lora_params) to bf16
+  tolerance — two different float paths computing the same function;
+- routing: the engine/server output for adapter k must be EXACTLY
+  ``generate()`` with ``adapter_ids = k`` (same model, so bit-equal);
+- isolation: adapter id 0 is exactly the base model, and requests on
+  different adapters interleaved in one slot batch stay exact.
+CPU-JAX stand-in per SURVEY.md §4.
+"""
+
+import threading
+import zlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.generate import generate
+from k3stpu.models.lora import (
+    build_multi_lora_params,
+    merge_lora_params,
+)
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine
+
+SEQ = 32
+RANK = 4
+
+
+def _adapter_tree(seed: int) -> dict:
+    """A rank-RANK single-adapter LoRA tree with deterministic nonzero
+    deltas (as if trained) — lora_b must be nonzero or the adapter IS
+    the base."""
+    lmodel = transformer_lm_tiny(max_seq_len=SEQ, lora_rank=RANK)
+    lvars = lmodel.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)
+
+    def perturb(path, x):
+        if getattr(path[-1], "key", None) in ("lora_a", "lora_b"):
+            # crc32, not hash(): str hashing is PYTHONHASHSEED-salted, and
+            # per-process adapter weights would make the tolerance-based
+            # fold-oracle comparison unreproducible.
+            k = jax.random.fold_in(jax.random.key(seed),
+                                   zlib.crc32(str(path).encode()))
+            return 0.3 * jax.random.normal(k, x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map_with_path(perturb, lvars["params"])
+
+
+def _multi_lora_setup(n_adapters=2):
+    base = transformer_lm_tiny(max_seq_len=SEQ)
+    bvars = base.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                      train=False)
+    adapters = [_adapter_tree(seed) for seed in range(1, n_adapters + 1)]
+    ml = transformer_lm_tiny(max_seq_len=SEQ, lora_rank=RANK,
+                             multi_lora=n_adapters + 1)
+    params = build_multi_lora_params(bvars["params"], adapters)
+    return base, bvars["params"], adapters, ml, params
+
+
+def _solo(model, params, prompt, budget, aid=None):
+    kw = ({} if aid is None
+          else {"adapter_ids": jnp.array([aid], jnp.int32)})
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0, **kw)
+    return np.asarray(out)[0].tolist()
+
+
+def test_row_routed_delta_matches_folded_adapter():
+    """Per-row delta vs merge_lora_params fold: same FUNCTION, two float
+    paths. Compared in fp32 compute — in bf16 the synthetic deltas
+    (deliberately large so adapters visibly diverge) amplify rounding
+    through layernorm/gelu and the comparison would measure precision,
+    not logic."""
+    _, bparams, adapters, _, mlparams = _multi_lora_setup()
+    base32 = transformer_lm_tiny(max_seq_len=SEQ, dtype=jnp.float32)
+    ml32 = transformer_lm_tiny(max_seq_len=SEQ, dtype=jnp.float32,
+                               lora_rank=RANK,
+                               multi_lora=len(adapters) + 1)
+    toks = jnp.asarray(np.arange(24).reshape(2, 12) % 500)
+
+    def graft_base(ad, b):
+        # The fold oracle uses the SAME base the stacks were built on
+        # (structures differ: only the adapter tree has lora leaves).
+        return {k: (graft_base(v, b[k]) if isinstance(v, dict)
+                    else (v if k in ("lora_a", "lora_b") else b[k]))
+                for k, v in ad.items()}
+
+    for i, ad in enumerate(adapters):
+        folded = graft_base(ad, bparams)
+        want = base32.apply({"params": merge_lora_params(folded)}, toks,
+                            train=False)
+        got = ml32.apply({"params": mlparams}, toks, train=False,
+                         adapter_ids=jnp.full((2,), i + 1, jnp.int32))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_adapter_zero_is_exactly_base():
+    base, bparams, _, ml, mlparams = _multi_lora_setup()
+    toks = jnp.asarray(np.arange(16).reshape(2, 8) % 500)
+    want = base.apply({"params": bparams}, toks, train=False)
+    got = ml.apply({"params": mlparams}, toks, train=False,
+                   adapter_ids=jnp.zeros((2,), jnp.int32))
+    # BIT-exact (the documented guarantee): slot 0's lora_b is zero, so
+    # the delta is exactly 0.0 and y + 0.0 is bitwise y.
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_mixed_rows_match_single_adapter_rows():
+    """One batch, three rows on three different adapters == each row run
+    alone under its adapter (bit-level: same program, gathered weights)."""
+    _, _, _, ml, mlparams = _multi_lora_setup()
+    toks = jnp.asarray(np.arange(30).reshape(3, 10) % 500)
+    mixed = ml.apply({"params": mlparams}, toks, train=False,
+                     adapter_ids=jnp.array([0, 1, 2], jnp.int32))
+    for r in range(3):
+        solo = ml.apply({"params": mlparams}, toks[r:r + 1], train=False,
+                        adapter_ids=jnp.array([r], jnp.int32))
+        np.testing.assert_allclose(np.asarray(mixed[r:r + 1]),
+                                   np.asarray(solo), atol=1e-5)
+
+
+@pytest.fixture(scope="module")
+def ml_engine():
+    _, _, _, ml, mlparams = _multi_lora_setup()
+    engine = GenerateEngine(ml, mlparams, slots=4, decode_block=3,
+                            prompt_cache=4)
+    yield ml, mlparams, engine
+    engine.close()
+
+
+def test_engine_routes_adapters_exactly(ml_engine):
+    ml, mlparams, engine = ml_engine
+    prompt = [5, 6, 7]
+    outs = {}
+    for aid in (0, 1, 2):
+        outs[aid] = engine.submit([prompt], max_new_tokens=6,
+                                  adapter_id=aid)
+        assert outs[aid] == [_solo(ml, mlparams, prompt, 6, aid)]
+    assert len({tuple(outs[a][0]) for a in outs}) >= 2, \
+        "adapters must actually change the continuation"
+
+
+def test_engine_interleaves_mixed_adapters(ml_engine):
+    ml, mlparams, engine = ml_engine
+    res = {}
+
+    def run(aid):
+        res[aid] = engine.submit([[10 + aid, 11, 12]], max_new_tokens=8,
+                                 adapter_id=aid)
+
+    threads = [threading.Thread(target=run, args=(a,)) for a in (0, 1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for aid in (0, 1, 2):
+        assert res[aid] == [_solo(ml, mlparams, [10 + aid, 11, 12], 8,
+                                  aid)], f"adapter {aid}"
+
+
+def test_prompt_cache_is_adapter_namespaced(ml_engine):
+    ml, mlparams, engine = ml_engine
+    prompt = [30, 31, 32]
+    h0 = engine.stats()["pcache_hits"]
+    r1 = engine.submit([prompt], max_new_tokens=4, adapter_id=1)
+    r2 = engine.submit([prompt], max_new_tokens=4, adapter_id=2)
+    assert engine.stats()["pcache_hits"] == h0, "cross-adapter hit!"
+    assert r1 == [_solo(ml, mlparams, prompt, 4, 1)]
+    assert r2 == [_solo(ml, mlparams, prompt, 4, 2)]
+    assert engine.submit([prompt], max_new_tokens=4, adapter_id=1) == r1
+    assert engine.stats()["pcache_hits"] == h0 + 1  # same-adapter hit
+
+
+def test_engine_rejects_bad_adapter_ids(ml_engine):
+    _, _, engine = ml_engine
+    with pytest.raises(ValueError, match="adapter_id"):
+        engine.submit([[1, 2]], max_new_tokens=2, adapter_id=3)
+    model, params = (transformer_lm_tiny(max_seq_len=SEQ),)[0], None
+    # engine without adapter stacks rejects nonzero ids
+    bvars = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                       train=False)
+    plain = GenerateEngine(model, bvars["params"], slots=2)
+    try:
+        with pytest.raises(ValueError, match="multi_lora is off"):
+            plain.submit([[1, 2]], max_new_tokens=2, adapter_id=1)
+    finally:
+        plain.close()
+
+
+# --- server boot + HTTP routing ----------------------------------------
+
+
+@pytest.fixture(scope="module")
+def adapter_server(tmp_path_factory):
+    """Server booted with two fabricated adapter checkpoints."""
+    from k3stpu.serve.server import InferenceServer
+    from k3stpu.utils import checkpoint as ckpt
+
+    root = tmp_path_factory.mktemp("adapters")
+    dirs = {}
+    for name, seed in (("alice", 1), ("bob", 2)):
+        d = root / name
+        ckpt.save_train_state(d, 1, {"params": _adapter_tree(seed)})
+        dirs[name] = str(d)
+    server = InferenceServer(
+        model_name="transformer-tiny", seq_len=SEQ, batch_window_ms=0.0,
+        continuous_batching=True, engine_slots=4, shard_devices=1,
+        lora_adapters=f"alice={dirs['alice']},bob={dirs['bob']}")
+    yield server
+    server.close()
+
+
+def test_server_loads_and_routes_adapters(adapter_server):
+    server = adapter_server
+    assert server.model_card()["adapters"] == ["base", "alice", "bob"]
+    prompt = [[3, 4, 5]]
+    outs = {name: server.generate_tokens(prompt, max_new_tokens=6,
+                                         adapter=name)
+            for name in (None, "alice", "bob")}
+    # Routing exactness: each == generate() under that adapter slot.
+    for aid, name in ((0, None), (1, "alice"), (2, "bob")):
+        want = [_solo(server.model, server._variables["params"],
+                      prompt[0], 6, aid)]
+        assert outs[name] == want, f"adapter {name}"
+    assert outs["alice"] != outs[None] or outs["bob"] != outs[None]
+
+
+def test_server_rejects_unknown_adapter(adapter_server):
+    with pytest.raises(ValueError, match="unknown adapter"):
+        adapter_server.generate_tokens([[1, 2]], max_new_tokens=2,
+                                       adapter="carol")
+
+
+def test_http_adapter_routing_and_stream(adapter_server):
+    import json
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    from k3stpu.serve.server import make_app
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(adapter_server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                url + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    if r.headers.get("Content-Type") == "text/event-stream":
+                        return r.status, [json.loads(l[6:]) for l in r
+                                          if l.startswith(b"data: ")]
+                    return r.status, json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                return e.code, json.loads(e.read())
+
+        body = {"prompt_tokens": [[7, 8, 9]], "max_new_tokens": 5}
+        _, base = post(body)
+        _, alice = post(dict(body, adapter="alice"))
+        st, frames = post(dict(body, adapter="alice", stream=True))
+        assert st == 200
+        assert frames[-1]["tokens"] == alice["tokens"]
+        code, err = post(dict(body, adapter="carol"))
+        assert code == 400 and "unknown adapter" in err["error"]
+    finally:
+        httpd.shutdown()
+
+
+def test_server_mixed_rank_adapters_rejected(tmp_path):
+    from k3stpu.serve.server import InferenceServer
+    from k3stpu.utils import checkpoint as ckpt
+
+    lm8 = transformer_lm_tiny(max_seq_len=SEQ, lora_rank=8)
+    v8 = lm8.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                  train=False)
+    ckpt.save_train_state(tmp_path / "a", 1,
+                          {"params": _adapter_tree(1)})
+    ckpt.save_train_state(tmp_path / "b", 1, {"params": v8["params"]})
+    with pytest.raises(ValueError, match="rank"):
+        InferenceServer(model_name="transformer-tiny", seq_len=SEQ,
+                        batch_window_ms=0.0, shard_devices=1,
+                        lora_adapters=f"a={tmp_path}/a,b={tmp_path}/b")
