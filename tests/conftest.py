@@ -18,6 +18,32 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
 
+# Persistent compilation cache for the suite: the full run compiles
+# hundreds of programs, and XLA:CPU's concurrent LLVM codegen (an engine
+# loop thread compiling while the test's main thread compiles) has
+# segfaulted under that volume — twice, both times mid-compile at ~80%.
+# Cache hits skip codegen entirely on re-runs, cutting both wall time
+# and the window for that race to essentially zero after one warm run.
+import getpass
+import time as _time
+
+_cache_dir = os.environ.get(
+    "K3STPU_TEST_CACHE",
+    f"/tmp/k3stpu-test-compile-cache-{getpass.getuser()}")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+# No eviction policy in jax for this cache: prune stale entries at
+# session start so weeks of iteration can't fill a tmpfs-backed /tmp.
+try:
+    _cutoff = _time.time() - 14 * 86400
+    with os.scandir(_cache_dir) as it:
+        for _e in it:
+            if _e.is_file() and _e.stat().st_mtime < _cutoff:
+                os.unlink(_e.path)
+except OSError:
+    pass  # first run (no dir yet) or shared-dir permissions
+
 import pathlib
 import sys
 
