@@ -24,22 +24,29 @@ jax.config.update("jax_num_cpu_devices", 8)
 # segfaulted under that volume — twice, both times mid-compile at ~80%.
 # Cache hits skip codegen entirely on re-runs, cutting both wall time
 # and the window for that race to essentially zero after one warm run.
-import getpass
-import time as _time
+try:
+    import getpass
 
+    _user = getpass.getuser()
+except (KeyError, OSError):  # scrubbed env + uid without a passwd entry
+    _user = str(os.getuid())
 _cache_dir = os.environ.get(
-    "K3STPU_TEST_CACHE",
-    f"/tmp/k3stpu-test-compile-cache-{getpass.getuser()}")
+    "K3STPU_TEST_CACHE", f"/tmp/k3stpu-test-compile-cache-{_user}")
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 # No eviction policy in jax for this cache: prune stale entries at
 # session start so weeks of iteration can't fill a tmpfs-backed /tmp.
+# Staleness = max(atime, mtime): cache HITS read without rewriting, so
+# mtime alone would evict the oldest, most-reused entries first.
+import time as _time
+
 try:
     _cutoff = _time.time() - 14 * 86400
     with os.scandir(_cache_dir) as it:
         for _e in it:
-            if _e.is_file() and _e.stat().st_mtime < _cutoff:
+            _st = _e.stat()
+            if _e.is_file() and max(_st.st_atime, _st.st_mtime) < _cutoff:
                 os.unlink(_e.path)
 except OSError:
     pass  # first run (no dir yet) or shared-dir permissions
