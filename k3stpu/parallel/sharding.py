@@ -27,6 +27,20 @@ def infer_param_sharding(path: tuple, value, mesh: Mesh) -> NamedSharding:
     names = [getattr(k, "key", getattr(k, "name", str(k))) for k in path]
     is_model_axis_ok = lambda dim: dim % mesh.shape["model"] == 0
 
+    # LoRA adapters (models/lora.py, single (in,r)/(r,out) or stacked
+    # (N,in,r)/(N,r,out)): A replicates — splitting the tiny rank axis
+    # would buy nothing and force a psum on the r-contraction — while B
+    # shards its OUTPUT axis exactly like the kernel it rides beside, so
+    # the delta comes out sharded like y and XLA needs no extra
+    # collective before the residual add.
+    if "lora_a" in names:
+        return NamedSharding(mesh, P())
+    if "lora_b" in names:
+        if is_model_axis_ok(value.shape[-1]):
+            return NamedSharding(
+                mesh, P(*(None,) * (value.ndim - 1), "model"))
+        return NamedSharding(mesh, P())
+
     if value.ndim == 4 and is_model_axis_ok(value.shape[3]):
         return NamedSharding(mesh, P(None, None, None, "model"))
     if value.ndim == 3 and is_model_axis_ok(value.shape[0]):

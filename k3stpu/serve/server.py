@@ -335,7 +335,8 @@ class InferenceServer:
         # adapter by name — one base model, one decode batch, many
         # fine-tunes. Runs AFTER base-checkpoint adoption (the stacks
         # attach to the weights actually served) and BEFORE quant
-        # (exclusive) / sharding (gated).
+        # (exclusive) / sharding (lora_a replicates, lora_b shards its
+        # output axis — parallel/sharding.py).
         self.adapter_names: "list[str] | None" = None
         if lora_adapters:
             if not model_name.startswith("transformer"):
@@ -474,11 +475,6 @@ class InferenceServer:
         if shard_devices is None:
             shard_devices = n_local if n_local > 1 else 1
         self._mesh = None
-        if shard_devices > 1 and self.adapter_names is not None:
-            raise ValueError(
-                "--lora-adapters with tensor-parallel --shard-devices is "
-                "not supported yet: the (n_adapters, in, r) stacks need "
-                "their own partitioning rules")
         if shard_devices > 1:
             from k3stpu.parallel.mesh import make_mesh
             from k3stpu.parallel.sharding import replicated, shard_params

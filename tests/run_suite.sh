@@ -24,13 +24,21 @@ esac
 # Split point chosen to balance wall time (model/parallel files are the
 # heavy half) and to keep each process well under the observed failure
 # horizon.
-HALF_A=$(ls tests/test_[a-o]*.py)
-HALF_B=$(ls tests/test_[p-z]*.py)
+HALF_A=(tests/test_[a-o]*.py)
+HALF_B=(tests/test_[p-z]*.py)
+# An empty glob would hand pytest NO paths and it would collect all of
+# tests/ — the single-process run this script exists to avoid.
+[ -e "${HALF_A[0]}" ] || { echo "run_suite: half A glob empty"; exit 2; }
+[ -e "${HALF_B[0]}" ] || { echo "run_suite: half B glob empty"; exit 2; }
 
-python -m pytest $HALF_A -q "$@"; rc_a=$?
-python -m pytest $HALF_B -q "$@"; rc_b=$?
+python -m pytest "${HALF_A[@]}" -q "$@"; rc_a=$?
+python -m pytest "${HALF_B[@]}" -q "$@"; rc_b=$?
 echo "run_suite: half A rc=$rc_a, half B rc=$rc_b"
-# rc 5 = NO_TESTS_COLLECTED: a -k filter whose matches all live in the
-# other half must not fail the gate.
+# rc 5 = NO_TESTS_COLLECTED is fine for ONE half (a -k filter whose
+# matches live in the other half) — but both halves collecting nothing
+# means a typo'd filter, and a gate must not pass green on zero tests.
+if [ "$rc_a" -eq 5 ] && [ "$rc_b" -eq 5 ]; then
+  echo "run_suite: no tests collected in either half"; exit 5
+fi
 ok() { [ "$1" -eq 0 ] || [ "$1" -eq 5 ]; }
 ok "$rc_a" && ok "$rc_b"

@@ -276,6 +276,41 @@ def test_http_adapter_routing_and_stream(adapter_server):
         httpd.shutdown()
 
 
+def test_sharded_multi_lora_matches_single_device(tmp_path):
+    """Tensor-parallel multi-LoRA: the 2-device sharded server (lora_b
+    stacks split on their output axis, lora_a replicated —
+    parallel/sharding.py) must produce the single-device outputs for
+    every adapter. Plain generate path: continuous batching stays
+    single-device by the server's existing engine/TP exclusivity.
+
+    2 devices, deliberately: wider TP reorders bf16 reductions by about
+    one ulp (measured 0.03 on these logits), and a greedy chain whose
+    top-1/top-2 gap dips under that noise flips a token and diverges —
+    numerics, not routing (the first-token argmax stays equal at 4-way
+    and the base/alice chains match end-to-end there)."""
+    from k3stpu.serve.server import InferenceServer
+    from k3stpu.utils import checkpoint as ckpt
+
+    for name, seed in (("alice", 1), ("bob", 2)):
+        ckpt.save_train_state(tmp_path / name, 1,
+                              {"params": _adapter_tree(seed)})
+    spec = f"alice={tmp_path}/alice,bob={tmp_path}/bob"
+    kw = dict(model_name="transformer-tiny", seq_len=SEQ,
+              batch_window_ms=0.0, lora_adapters=spec)
+    single = InferenceServer(shard_devices=1, **kw)
+    sharded = InferenceServer(shard_devices=2, **kw)
+    try:
+        for adapter in (None, "alice", "bob"):
+            want = single.generate_tokens([[3, 4, 5]], max_new_tokens=6,
+                                          adapter=adapter)
+            got = sharded.generate_tokens([[3, 4, 5]], max_new_tokens=6,
+                                          adapter=adapter)
+            assert got == want, f"adapter {adapter}"
+    finally:
+        single.close()
+        sharded.close()
+
+
 def test_server_mixed_rank_adapters_rejected(tmp_path):
     from k3stpu.serve.server import InferenceServer
     from k3stpu.utils import checkpoint as ckpt
