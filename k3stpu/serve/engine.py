@@ -146,7 +146,8 @@ class GenerateEngine:
 
     def __init__(self, model, params, *, slots: int = 8,
                  seed: int = 0, chunk_prefill: "int | None" = None,
-                 decode_block: int = 1, prompt_cache: int = 0):
+                 decode_block: int = 1, prompt_cache: int = 0,
+                 mesh=None):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -173,7 +174,20 @@ class GenerateEngine:
         be corrupted by the decodes of the slot it was scattered into),
         and the suffix-append reuses the chunked-admission finalize
         invariant (junk K/V beyond a row's index is invisible to the
-        position mask and gets overwritten slot-by-slot). 0 disables."""
+        position mask and gets overwritten slot-by-slot). 0 disables.
+
+        ``mesh``: tensor-parallel serving over a jax Mesh with a
+        'model' axis (parallel/mesh.make_mesh's convention — required).
+        The params arrive sharded over that axis
+        (parallel/sharding.py); the KV cache must live on the SAME
+        devices or jit refuses the mixed placement, so it goes up
+        sharded on its kv-head axis where divisible (attention splits
+        by head under TP) and replicated otherwise. Host-side numpy
+        inputs stay uncommitted — jit places them. None =
+        single-device (programs unchanged)."""
+        if mesh is not None and "model" not in mesh.shape:
+            raise ValueError(
+                f"engine mesh needs a 'model' axis, got {mesh.shape}")
         if chunk_prefill is not None and chunk_prefill < 1:
             raise ValueError(f"chunk_prefill must be >= 1, got "
                              f"{chunk_prefill}")
@@ -199,6 +213,21 @@ class GenerateEngine:
         self.n_adapters = getattr(cfg, "multi_lora", None)
 
         self._cache = init_cache(model, slots)
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            def _cache_sharding(x):
+                # (B, S, H, D) K/V and (B, S, H) scale leaves shard on
+                # the head axis; (B,) index and anything indivisible
+                # replicate.
+                if x.ndim >= 3 and x.shape[2] % mesh.shape["model"] == 0:
+                    return NamedSharding(mesh, P(None, None, "model"))
+                return NamedSharding(mesh, P())
+
+            self._cache = jax.tree.map(
+                lambda x: jax.device_put(x, _cache_sharding(x)),
+                self._cache)
         self._base_key = jax.random.key(seed)
         self._step_counter = 0
 

@@ -234,7 +234,9 @@ def main(argv: "list[str] | None" = None) -> int:
             decode_block=args.decode_block,
             prompt_cache=args.prompt_cache,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
-            shard_devices=1 if args.continuous_batching else None)
+            shard_devices=None)  # None = all local devices; the engine
+        # runs tensor-parallel now (mesh-sharded KV cache), so the old
+        # single-device pin would just hide the pod's other chips.
         if args.generate_tokens > 0:
             # Compile prefill+decode (and engine programs) BEFORE the
             # measured window — first-request JIT would otherwise land in

@@ -513,17 +513,12 @@ class InferenceServer:
                 raise ValueError(
                     "--continuous-batching applies to LM families, not "
                     f"{model_name!r}")
-            if self._mesh is not None:
-                raise ValueError(
-                    "--continuous-batching with tensor-parallel serving is "
-                    "not supported yet (engine cache is single-device); "
-                    "pass --shard-devices 1")
             from k3stpu.serve.engine import GenerateEngine
 
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
-                prompt_cache=prompt_cache)
+                prompt_cache=prompt_cache, mesh=self._mesh)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal

@@ -108,6 +108,44 @@ def test_eos_stops_a_slot_early(engine_setup):
     assert all(t == eos for t in got[3:]), "eos must repeat once emitted"
 
 
+def test_engine_on_tensor_parallel_mesh_matches_single_device():
+    """Continuous batching over a 2-device 'model' mesh: params sharded
+    by parallel/sharding.py, the engine's KV cache head-sharded on the
+    same mesh — greedy output, the prompt cache, and streaming must all
+    match the single-device engine exactly (2 devices: see the TP
+    numerics note in tests/test_multi_lora.py)."""
+    from k3stpu.parallel.mesh import make_mesh
+    from k3stpu.parallel.sharding import shard_params
+
+    model, params = _model_and_params()
+    mesh = make_mesh(2, model_parallelism=2)
+    sharded, _ = shard_params(params, mesh)
+    solo_eng = GenerateEngine(model, params, slots=4, decode_block=3,
+                              prompt_cache=2)
+    tp_eng = GenerateEngine(model, sharded, slots=4, decode_block=3,
+                            prompt_cache=2, mesh=mesh)
+    try:
+        prompt = [5, 6, 7]
+        want = solo_eng.submit([prompt], max_new_tokens=8)
+        assert tp_eng.submit([prompt], max_new_tokens=8) == want
+        # Prompt-cache hit on the sharded engine stays exact.
+        assert tp_eng.submit([prompt], max_new_tokens=8) == want
+        assert tp_eng.stats()["pcache_hits"] == 1
+        # Streaming over the mesh: deltas concatenate to the final.
+        rows: "dict[int, list[int]]" = {}
+        final = None
+        for ev in tp_eng.submit_stream([prompt], max_new_tokens=8):
+            if ev["done"]:
+                final = ev["tokens"]
+            else:
+                for r, toks in ev["rows"].items():
+                    rows.setdefault(r, []).extend(toks)
+        assert final == want and rows[0] == want[0]
+    finally:
+        solo_eng.close()
+        tp_eng.close()
+
+
 def test_early_finished_row_not_reused_until_request_completes():
     """A row that hits eos while its sibling row keeps decoding must NOT
     be handed to a queued request: its owner/collected state feeds the

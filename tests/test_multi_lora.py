@@ -31,10 +31,12 @@ SEQ = 32
 RANK = 4
 
 
-def _adapter_tree(seed: int) -> dict:
+def _adapter_tree(seed: int, scale: float = 0.3) -> dict:
     """A rank-RANK single-adapter LoRA tree with deterministic nonzero
     deltas (as if trained) — lora_b must be nonzero or the adapter IS
-    the base."""
+    the base. ``scale`` sets the delta magnitude: 0.3 makes adapters
+    visibly diverge from the base (routing tests); a small scale keeps
+    greedy chains clear of sub-ulp argmax ties (TP-equality tests)."""
     lmodel = transformer_lm_tiny(max_seq_len=SEQ, lora_rank=RANK)
     lvars = lmodel.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
                         train=False)
@@ -46,7 +48,7 @@ def _adapter_tree(seed: int) -> dict:
             # fold-oracle comparison unreproducible.
             k = jax.random.fold_in(jax.random.key(seed),
                                    zlib.crc32(str(path).encode()))
-            return 0.3 * jax.random.normal(k, x.shape, x.dtype)
+            return scale * jax.random.normal(k, x.shape, x.dtype)
         return x
 
     return jax.tree_util.tree_map_with_path(perturb, lvars["params"])
@@ -277,11 +279,11 @@ def test_http_adapter_routing_and_stream(adapter_server):
 
 
 def test_sharded_multi_lora_matches_single_device(tmp_path):
-    """Tensor-parallel multi-LoRA: the 2-device sharded server (lora_b
-    stacks split on their output axis, lora_a replicated —
-    parallel/sharding.py) must produce the single-device outputs for
-    every adapter. Plain generate path: continuous batching stays
-    single-device by the server's existing engine/TP exclusivity.
+    """Tensor-parallel multi-LoRA through the ENGINE: the 2-device
+    sharded server (lora_b stacks split on their output axis, lora_a
+    replicated — parallel/sharding.py; engine KV cache head-sharded on
+    the same mesh) must produce the single-device outputs for every
+    adapter.
 
     2 devices, deliberately: wider TP reorders bf16 reductions by about
     one ulp (measured 0.03 on these logits), and a greedy chain whose
@@ -291,21 +293,57 @@ def test_sharded_multi_lora_matches_single_device(tmp_path):
     from k3stpu.serve.server import InferenceServer
     from k3stpu.utils import checkpoint as ckpt
 
-    for name, seed in (("alice", 1), ("bob", 2)):
+    for name, seed, scale in (("alice", 1, 0.3), ("bob", 2, 0.3),
+                              ("carol", 3, 0.1)):
         ckpt.save_train_state(tmp_path / name, 1,
-                              {"params": _adapter_tree(seed)})
-    spec = f"alice={tmp_path}/alice,bob={tmp_path}/bob"
+                              {"params": _adapter_tree(seed, scale)})
+    spec = (f"alice={tmp_path}/alice,bob={tmp_path}/bob,"
+            f"carol={tmp_path}/carol")
     kw = dict(model_name="transformer-tiny", seq_len=SEQ,
-              batch_window_ms=0.0, lora_adapters=spec)
+              batch_window_ms=0.0, continuous_batching=True,
+              engine_slots=2, lora_adapters=spec)
     single = InferenceServer(shard_devices=1, **kw)
     sharded = InferenceServer(shard_devices=2, **kw)
     try:
-        for adapter in (None, "alice", "bob"):
-            want = single.generate_tokens([[3, 4, 5]], max_new_tokens=6,
-                                          adapter=adapter)
-            got = sharded.generate_tokens([[3, 4, 5]], max_new_tokens=6,
-                                          adapter=adapter)
-            assert got == want, f"adapter {adapter}"
+        # Base chain: stable under the reordering (no adapter delta), so
+        # the full greedy chain must match token for token.
+        want = single.generate_tokens([[3, 4, 5]], max_new_tokens=6)
+        assert sharded.generate_tokens([[3, 4, 5]], max_new_tokens=6) \
+            == want
+        # Adapter chains: the synthetic deltas are deliberately large,
+        # so a greedy chain may hit a sub-ulp top-2 tie and legitimately
+        # fork after a few tokens (the docstring numerics). The sharding
+        # invariants that CAN'T legitimately drift: logits agree to ~one
+        # bf16 ulp and the first generated token matches.
+        toks = jnp.asarray(np.array([[3, 4, 5]], np.int32))
+        for aid, adapter in ((1, "alice"), (2, "bob")):
+            ids = jnp.full((1,), aid, jnp.int32)
+            l1 = np.asarray(single.model.apply(
+                {"params": single._variables["params"]}, toks,
+                train=False, adapter_ids=ids))
+            l2 = np.asarray(sharded.model.apply(
+                {"params": sharded._variables["params"]}, toks,
+                train=False, adapter_ids=ids))
+            # atol: one bf16 ulp at the largest logit magnitudes here
+            # (ulp(8) = 0.0625) — anything beyond that is a real
+            # sharding defect, not reduction reordering.
+            np.testing.assert_allclose(l2, l1, rtol=0.02, atol=0.08,
+                                       err_msg=f"adapter {adapter}")
+            s_tok = single.generate_tokens([[3, 4, 5]], max_new_tokens=1,
+                                           adapter=adapter)
+            d_tok = sharded.generate_tokens([[3, 4, 5]], max_new_tokens=1,
+                                            adapter=adapter)
+            assert s_tok == d_tok, f"adapter {adapter} first token"
+        # carol's SMALL deltas keep the greedy chain clear of sub-ulp
+        # ties, so her full chain exercises adapter-routed DECODE steps
+        # reading back the head-sharded cache — and must match exactly
+        # (and differ from the base, or the adapter did nothing).
+        want = single.generate_tokens([[3, 4, 5]], max_new_tokens=6,
+                                      adapter="carol")
+        assert sharded.generate_tokens([[3, 4, 5]], max_new_tokens=6,
+                                       adapter="carol") == want
+        assert want != single.generate_tokens([[3, 4, 5]],
+                                              max_new_tokens=6)
     finally:
         single.close()
         sharded.close()
