@@ -115,8 +115,9 @@ def generate(model, params, prompt: jax.Array, prompt_lens: jax.Array,
         rng = jax.random.key(0)
 
     # adapter_ids (multi-LoRA serving, models/lora.py MultiLoraDense):
-    # forwarded only when present so models without the kwarg (MoE) keep
-    # their exact apply signature.
+    # both LM families accept the kwarg; conditional forwarding just
+    # keeps non-adapter call signatures (and compiled-program keys)
+    # byte-identical to the pre-multi-LoRA ones.
     akw = {} if adapter_ids is None else {"adapter_ids": adapter_ids}
     cache = init_cache(model, b)
     logits, mut = model.apply({"params": params, "cache": cache}, prompt,

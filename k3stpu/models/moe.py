@@ -157,14 +157,19 @@ class MoeBlock(nn.Module):
     config: MoeConfig
 
     @nn.compact
-    def __call__(self, x, *, mode: str = "full", seq_lens=None):
+    def __call__(self, x, *, mode: str = "full", seq_lens=None,
+                 adapter_ids=None):
         base = self.config.base
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
         x = x + Attention(base, name="attn")(h, mode=mode,
-                                              seq_lens=seq_lens)
+                                              seq_lens=seq_lens,
+                                              adapter_ids=adapter_ids)
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
+        # Adapters ride the attention/dense projections only: the routed
+        # expert weights stay base (per-row adapter deltas on an (E,d,f)
+        # expert bank would multiply the stack by E for marginal gain).
         return x + MoeMlp(self.config, name="moe")(h)
 
 
@@ -175,7 +180,7 @@ class MoeTransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, mode: str = "full",
-                 seq_lens=None):
+                 seq_lens=None, adapter_ids=None):
         del train
         cfg, base = self.config, self.config.base
         embed = nn.Embed(base.vocab_size, base.d_model,
@@ -186,9 +191,11 @@ class MoeTransformerLM(nn.Module):
             use_moe = (i % cfg.every_n_blocks) == cfg.every_n_blocks - 1
             if use_moe:
                 x = MoeBlock(cfg, name=f"block{i}")(x, mode=mode,
-                                                    seq_lens=seq_lens)
+                                                    seq_lens=seq_lens,
+                                                    adapter_ids=adapter_ids)
             else:  # identical param tree to the dense LM's blocks
-                x = Block(base, name=f"block{i}")(x, mode, seq_lens)
+                x = Block(base, name=f"block{i}")(x, mode, seq_lens,
+                                                  adapter_ids)
         x = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         return embed.attend(x).astype(jnp.float32)

@@ -27,8 +27,9 @@ def prompt_width_bucket(max_len: int, max_seq: int, floor: int = 8) -> int:
 
 
 def _akw(adapter_ids):
-    # Multi-LoRA per-row adapter ids: forwarded only when present, so
-    # models without the kwarg (MoE) keep their exact apply signature.
+    # Multi-LoRA per-row adapter ids: forwarded only when present —
+    # both LM families accept the kwarg; this keeps non-adapter call
+    # signatures identical to the pre-multi-LoRA ones.
     return {} if adapter_ids is None else {"adapter_ids": adapter_ids}
 
 

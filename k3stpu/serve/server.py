@@ -51,6 +51,26 @@ import numpy as np
 BATCH_SIZES = (1, 8, 32)
 
 
+def lm_base_cfg(cfg):
+    """The TransformerConfig that actually carries the LM knobs: MoE
+    nests it under .base, the dense family IS it. The single read-side
+    helper — reading a knob off a MoeConfig directly returns the
+    default and silently mis-serves (the multi_lora lookup did exactly
+    that)."""
+    return getattr(cfg, "base", cfg)
+
+
+def lm_cfg_replace(model_name: str, cfg, **kw):
+    """dataclasses.replace on the LM knobs, nesting under .base for the
+    MoE family — the single write-side helper for the same pattern."""
+    import dataclasses
+
+    if model_name.startswith("moe"):
+        return dataclasses.replace(
+            cfg, base=dataclasses.replace(cfg.base, **kw))
+    return dataclasses.replace(cfg, **kw)
+
+
 def served_batch(n: int) -> int:
     """Smallest served (pre-compilable) batch size >= n — the padding
     policy for every dispatch path; public so tools (loadgen) can warm
@@ -339,9 +359,9 @@ class InferenceServer:
         # output axis — parallel/sharding.py).
         self.adapter_names: "list[str] | None" = None
         if lora_adapters:
-            if not model_name.startswith("transformer"):
-                raise ValueError("--lora-adapters supports the dense "
-                                 "transformer family")
+            if not model_name.startswith(("transformer", "moe")):
+                raise ValueError("--lora-adapters supports the LM "
+                                 "families (dense transformer and MoE)")
             if quant is not None:
                 raise ValueError("--lora-adapters and --quant are "
                                  "exclusive: adapters stay low-rank float")
@@ -385,8 +405,8 @@ class InferenceServer:
             # ONE restore template for every adapter (ranks are equal by
             # the check above), and shape-only — eval_shape materializes
             # no weights for a tree that exists just to type the restore.
-            lmodel = type(self.model)(dataclasses.replace(
-                self.model.config, lora_rank=rank))
+            lmodel = type(self.model)(lm_cfg_replace(
+                model_name, self.model.config, lora_rank=rank))
             lvars = jax.eval_shape(
                 lambda: lmodel.init(jax.random.key(0), example[:1],
                                     train=False))
@@ -395,8 +415,8 @@ class InferenceServer:
                                          {"params": lvars["params"]})
                 ["params"]
                 for (name, d), astep in zip(pairs, steps)]
-            self.model = type(self.model)(dataclasses.replace(
-                self.model.config, lora_rank=rank,
+            self.model = type(self.model)(lm_cfg_replace(
+                model_name, self.model.config, lora_rank=rank,
                 multi_lora=len(pairs) + 1))
             mlvars = self.model.init(jax.random.key(0), example[:1],
                                      train=False)
@@ -441,13 +461,8 @@ class InferenceServer:
                 **self._variables,
                 "params": quantize_lm_params(self._variables["params"]),
             }
-            if model_name.startswith("moe"):
-                cfg = self.model.config
-                self.model = type(self.model)(dataclasses.replace(
-                    cfg, base=dataclasses.replace(cfg.base, quant=quant)))
-            else:
-                self.model = type(self.model)(
-                    dataclasses.replace(self.model.config, quant=quant))
+            self.model = type(self.model)(
+                lm_cfg_replace(model_name, self.model.config, quant=quant))
 
         # int8 KV cache (no param change — the cache collection is built
         # per generate call from the live config): halves the HBM the
@@ -457,19 +472,13 @@ class InferenceServer:
         if kv_cache_dtype is not None:
             import dataclasses
 
-            if model_name.startswith("transformer"):
-                self.model = type(self.model)(dataclasses.replace(
-                    self.model.config, kv_cache_dtype=kv_cache_dtype))
-            elif model_name.startswith("moe"):
-                self.model = type(self.model)(dataclasses.replace(
-                    self.model.config,
-                    base=dataclasses.replace(
-                        self.model.config.base,
-                        kv_cache_dtype=kv_cache_dtype)))
-            else:
+            if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
                     f"--kv-cache-dtype applies to LM families, not "
                     f"{model_name!r}")
+            self.model = type(self.model)(lm_cfg_replace(
+                model_name, self.model.config,
+                kv_cache_dtype=kv_cache_dtype))
 
         n_local = len(jax.local_devices())
         if shard_devices is None:
@@ -884,7 +893,8 @@ class InferenceServer:
             self._gen_counter += 1
             rng = jax.random.key(self._gen_counter)
             akw = ({"adapter_ids": jnp.full((batch,), aid, jnp.int32)}
-                   if getattr(self.model.config, "multi_lora", None)
+                   if getattr(lm_base_cfg(self.model.config),
+                              "multi_lora", None)
                    else {})
             out = np.asarray(generate(
                 self.model, self._variables["params"], jnp.asarray(block),
