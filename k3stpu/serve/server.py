@@ -309,18 +309,10 @@ class InferenceServer:
             lora_rank = self._lora_rank_in(
                 ckpt.tree_metadata(ckpt_dir, step))
             if lora_rank is not None:
-                import dataclasses
-
                 from k3stpu.models.lora import merge_lora_params
 
-                cfg = self.model.config
-                lcfg = (dataclasses.replace(
-                            cfg, base=dataclasses.replace(
-                                cfg.base, lora_rank=lora_rank))
-                        if model_name.startswith("moe")
-                        else dataclasses.replace(cfg,
-                                                 lora_rank=lora_rank))
-                lmodel = type(self.model)(lcfg)
+                lmodel = type(self.model)(lm_cfg_replace(
+                    model_name, self.model.config, lora_rank=lora_rank))
                 lvars = lmodel.init(jax.random.key(0), example[:1],
                                     train=False)
                 want = dict(want, params=lvars["params"])
@@ -365,8 +357,6 @@ class InferenceServer:
             if quant is not None:
                 raise ValueError("--lora-adapters and --quant are "
                                  "exclusive: adapters stay low-rank float")
-            import dataclasses
-
             import jax.numpy as jnp
 
             from k3stpu.models.lora import build_multi_lora_params
@@ -452,8 +442,6 @@ class InferenceServer:
                 raise ValueError(
                     f"--quant int8 supports the LM families; "
                     f"{model_name!r} stays float")
-            import dataclasses
-
             from k3stpu.models.quant import param_bytes, quantize_lm_params
 
             self.float_param_bytes = param_bytes(self._variables["params"])
@@ -470,8 +458,6 @@ class InferenceServer:
         # length x batch ceiling. Orthogonal to --quant.
         self.kv_cache_dtype = kv_cache_dtype
         if kv_cache_dtype is not None:
-            import dataclasses
-
             if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
                     f"--kv-cache-dtype applies to LM families, not "
@@ -730,8 +716,7 @@ class InferenceServer:
                 f"exceeds the KV cache ({self.seq_len}); lower one of them")
         gen_budget = 1 << (max_new_tokens - 1).bit_length()  # pow2 bucket
         gen_budget = min(gen_budget, self.seq_len - width)
-        vocab = getattr(self.model.config, "base",
-                        self.model.config).vocab_size
+        vocab = lm_base_cfg(self.model.config).vocab_size
         temperature = round(max(0.0, min(float(temperature), 4.0)), 1)
         if top_p is not None:  # 0.1 bucket: top_p is STATIC in generate()
             top_p = round(max(0.05, min(float(top_p), 1.0)), 1)

@@ -180,6 +180,90 @@ def test_stream_abandoned_cancels_request():
         engine.close()
 
 
+def test_soak_streaming_pcache_adapters_under_chaos(monkeypatch):
+    """The round-4 surfaces under randomized chaos TOGETHER — streaming
+    consumers that vanish mid-stream, repeat prompts riding the prompt
+    cache, mixed adapters in one slot batch, tiny deadlines, injected
+    decode faults. Invariants at the end: no slot/reserved-row leak, the
+    cache respects its capacity, and the engine still serves exact
+    greedy output per adapter."""
+    import random
+
+    # pytest's prepend import mode already has tests/ on sys.path.
+    from test_multi_lora import _multi_lora_setup, _solo
+
+    _, _, _, ml, mlparams = _multi_lora_setup()
+    engine = GenerateEngine(ml, mlparams, slots=4, decode_block=3,
+                            chunk_prefill=8, prompt_cache=3)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm
+        real = engine._decode_block_step
+        calls = {"n": 0}
+
+        def flaky(*args, **kwargs):
+            calls["n"] += 1
+            if calls["n"] % 17 == 0:
+                raise RuntimeError("injected decode fault")
+            return real(*args, **kwargs)
+
+        monkeypatch.setattr(engine, "_decode_block_step", flaky)
+        pool = [[5, 6, 7], [5, 6, 7, 8], [9, 10], list(range(1, 14))]
+        stop = time.time() + 15.0
+
+        def client(seed):
+            rng = random.Random(seed)
+            while time.time() < stop:
+                prompt = rng.choice(pool)
+                aid = rng.randrange(3)
+                budget = rng.randint(1, 10)
+                try:
+                    if rng.random() < 0.4:
+                        it = engine.submit_stream(
+                            [prompt], max_new_tokens=budget,
+                            adapter_id=aid,
+                            timeout_s=rng.choice([0.05, 5.0, 30.0]))
+                        if rng.random() < 0.4:
+                            next(it, None)
+                            it.close()  # consumer walks away
+                        else:
+                            for _ in it:
+                                pass
+                    else:
+                        engine.submit(
+                            [prompt], max_new_tokens=budget,
+                            adapter_id=aid,
+                            temperature=rng.choice([0.0, 0.8]),
+                            timeout_s=rng.choice([0.05, 5.0, 30.0]))
+                except (TimeoutError, RuntimeError, StopIteration):
+                    pass  # chaos is the point; invariants checked below
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "stuck client"
+
+        deadline = time.time() + 30
+        while len(engine._free_slots()) != engine.slots:
+            assert time.time() < deadline, (
+                f"slot leak: {engine._free_slots()} free; "
+                f"active={engine._active}, owner={engine._owner}")
+            time.sleep(0.05)
+        assert not engine._reserved.any(), "reserved-row leak"
+        s = engine.stats()
+        assert s["pcache_entries"] <= 3 and s["pcache_bytes"] > 0
+        monkeypatch.setattr(engine, "_decode_block_step", real)
+        for aid in (0, 1, 2):
+            assert engine.submit([[5, 6, 7]], max_new_tokens=5,
+                                 adapter_id=aid) \
+                == [_solo(ml, mlparams, [5, 6, 7], 5, aid)], \
+                f"post-soak exactness, adapter {aid}"
+    finally:
+        engine.close()
+
+
 # --- HTTP/SSE route ----------------------------------------------------
 
 
