@@ -46,6 +46,13 @@ from k3stpu.serve.programs import (
 _NEG_INF = -1e30
 
 
+class EngineOverloaded(RuntimeError):
+    """Raised by submit paths when max_pending requests are already in
+    flight — the backpressure signal the HTTP layer turns into a 503
+    (shed load at the door; queueing unboundedly just converts overload
+    into client timeouts plus held memory)."""
+
+
 def _pow2_at_least(n: int, lo: int = 1) -> int:
     p = lo
     while p < n:
@@ -147,7 +154,7 @@ class GenerateEngine:
     def __init__(self, model, params, *, slots: int = 8,
                  seed: int = 0, chunk_prefill: "int | None" = None,
                  decode_block: int = 1, prompt_cache: int = 0,
-                 mesh=None):
+                 mesh=None, max_pending: "int | None" = None):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -185,6 +192,8 @@ class GenerateEngine:
         by head under TP) and replicated otherwise. Host-side numpy
         inputs stay uncommitted — jit places them. None =
         single-device (programs unchanged)."""
+        if max_pending is not None and max_pending < 1:
+            raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
             raise ValueError(
                 f"engine mesh needs a 'model' axis, got {mesh.shape}")
@@ -244,6 +253,10 @@ class GenerateEngine:
         self._owner: "list[_Request | None]" = [None] * slots
         self._collected: "list[list[int]]" = [[] for _ in range(slots)]
 
+        # Admission bound: requests in flight (queued, admitting, or
+        # decoding — counted from enqueue until the consumer returns).
+        self.max_pending = max_pending
+        self._inflight = 0  # guarded by _lock
         self._q: "queue.SimpleQueue[_Request | None]" = queue.SimpleQueue()
         self._pending: "list[_Request]" = []
         self._adm: "dict | None" = None  # in-flight chunked admission
@@ -432,25 +445,61 @@ class GenerateEngine:
                         float(temperature), top_k, eos_id, samples=samples,
                         top_p=top_p, adapter=adapter_id)
 
-    def _enqueue_and_wait(self, req: "_Request",
-                          timeout_s: float) -> "list[list[int]]":
+    def take_admission_token(self) -> None:
+        """Claim one unit of max_pending or raise EngineOverloaded.
+        Callers that split ONE logical request into several chunk
+        submits (the server's wider-than-slots path) take ONE token for
+        the whole request and pass ``admitted=True`` to the submits —
+        re-gating per chunk would reject an already-admitted request
+        mid-flight after burning its earlier chunks' decode work."""
+        with self._lock:
+            if (self.max_pending is not None
+                    and self._inflight >= self.max_pending):
+                raise EngineOverloaded(
+                    f"engine at capacity: {self._inflight} requests in "
+                    f"flight (max_pending={self.max_pending})")
+            self._inflight += 1
+
+    def release_admission_token(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def at_capacity(self) -> bool:
+        """Advisory (racy by nature): lets the HTTP layer 503 BEFORE
+        committing response headers; the authoritative check is the
+        token take in the submit paths."""
+        with self._lock:
+            return (self.max_pending is not None
+                    and self._inflight >= self.max_pending)
+
+    def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
+                          admitted: bool = False) -> "list[list[int]]":
         # The loop thread enforces the same deadline: a request whose
         # client gave up is dropped from the queue / its slots freed,
         # instead of decoding its full budget for nobody.
-        req.deadline = time.time() + timeout_s
-        self._q.put(req)
-        if not req.event.wait(timeout_s + 1.0):
-            raise TimeoutError("generation did not finish in time")
-        if req.error is not None:
-            raise req.error
-        return req.tokens
+        if not admitted:
+            self.take_admission_token()
+        try:
+            req.deadline = time.time() + timeout_s
+            self._q.put(req)
+            if not req.event.wait(timeout_s + 1.0):
+                raise TimeoutError("generation did not finish in time")
+            if req.error is not None:
+                raise req.error
+            return req.tokens
+        finally:
+            if not admitted:
+                self.release_admission_token()
 
     def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
                temperature: float = 0.0, top_k: "int | None" = None,
                top_p: "float | None" = None,
                eos_id: "int | None" = None, adapter_id: int = 0,
-               timeout_s: float = 600.0) -> "list[list[int]]":
-        """Blocking: returns (n, max_new_tokens) token lists."""
+               timeout_s: float = 600.0,
+               admitted: bool = False) -> "list[list[int]]":
+        """Blocking: returns (n, max_new_tokens) token lists.
+        ``admitted``: the caller already holds an admission token
+        covering this submit (see take_admission_token)."""
         if self._closed:
             raise RuntimeError("engine is closed")
         n = len(prompts)
@@ -459,14 +508,15 @@ class GenerateEngine:
         req = self._packed_request(prompts, max_new_tokens, temperature,
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
-        return self._enqueue_and_wait(req, timeout_s)
+        return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
                        max_new_tokens: int, temperature: float = 1.0,
                        top_k: "int | None" = None,
                        top_p: "float | None" = None,
                        eos_id: "int | None" = None, adapter_id: int = 0,
-                       timeout_s: float = 600.0) -> "list[list[int]]":
+                       timeout_s: float = 600.0,
+                       admitted: bool = False) -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
         prefill: the prefilled cache row broadcasts across n slots and the
         rows diverge through per-row sampling noise. (With temperature 0
@@ -478,14 +528,14 @@ class GenerateEngine:
         req = self._packed_request([prompt], max_new_tokens, temperature,
                                    top_k, eos_id, samples=n, top_p=top_p,
                                    adapter_id=adapter_id)
-        return self._enqueue_and_wait(req, timeout_s)
+        return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_stream(self, prompts: "list[list[int]]", *,
                       max_new_tokens: int, temperature: float = 0.0,
                       top_k: "int | None" = None,
                       top_p: "float | None" = None,
                       eos_id: "int | None" = None, adapter_id: int = 0,
-                      timeout_s: float = 600.0):
+                      timeout_s: float = 600.0, admitted: bool = False):
         """Streaming submit(): returns an iterator of events.
 
         Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
@@ -507,12 +557,24 @@ class GenerateEngine:
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
         req.stream_q = queue.SimpleQueue()
-        return self._stream_events(req, timeout_s)
+        return self._stream_events(req, timeout_s, admitted)
 
-    def _stream_events(self, req: "_Request", timeout_s: float):
+    def _stream_events(self, req: "_Request", timeout_s: float,
+                       admitted: bool = False):
         # Same deadline contract as _enqueue_and_wait: the loop thread
         # drops expired requests; this consumer gets the terminal marker
-        # and raises the TimeoutError the loop recorded.
+        # and raises the TimeoutError the loop recorded. The admission
+        # token spans the generator's life — taken at first next() (no
+        # iteration, no enqueue, no token), released in the finally.
+        if not admitted:
+            self.take_admission_token()
+        try:
+            yield from self._stream_events_inner(req, timeout_s)
+        finally:
+            if not admitted:
+                self.release_admission_token()
+
+    def _stream_events_inner(self, req: "_Request", timeout_s: float):
         req.deadline = time.time() + timeout_s
         self._q.put(req)
         hard = req.deadline + 1.0

@@ -207,6 +207,7 @@ class InferenceServer:
                  prefill_chunk: "int | None" = None,
                  decode_block: int = 4,
                  prompt_cache: int = 0,
+                 max_pending: "int | None" = None,
                  lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
@@ -513,7 +514,8 @@ class InferenceServer:
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
-                prompt_cache=prompt_cache, mesh=self._mesh)
+                prompt_cache=prompt_cache, mesh=self._mesh,
+                max_pending=max_pending)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -776,12 +778,19 @@ class InferenceServer:
         if num_samples > 1:  # engine-backed shared-prefix sampling
             t0 = time.perf_counter()
             out = []
-            for ofs in range(0, num_samples, self._engine.slots):
-                k = min(self._engine.slots, num_samples - ofs)
-                out.extend(self._engine.submit_samples(
-                    prompts[0], k, max_new_tokens=gen_budget,
-                    temperature=temperature, top_k=top_k, top_p=top_p,
-                    eos_id=eos_id, adapter_id=aid))
+            # ONE admission token for the whole request: re-gating each
+            # slot-sized chunk would reject an admitted request mid-
+            # flight after burning its earlier chunks' decode work.
+            self._engine.take_admission_token()
+            try:
+                for ofs in range(0, num_samples, self._engine.slots):
+                    k = min(self._engine.slots, num_samples - ofs)
+                    out.extend(self._engine.submit_samples(
+                        prompts[0], k, max_new_tokens=gen_budget,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        eos_id=eos_id, adapter_id=aid, admitted=True))
+            finally:
+                self._engine.release_admission_token()
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -843,12 +852,17 @@ class InferenceServer:
             # served maximum either way).
             t0 = time.perf_counter()
             out = []
-            for ofs in range(0, len(prompts), self._engine.slots):
-                out.extend(self._engine.submit(
-                    prompts[ofs:ofs + self._engine.slots],
-                    max_new_tokens=gen_budget, temperature=temperature,
-                    top_k=top_k, top_p=top_p, eos_id=eos_id,
-                    adapter_id=aid))
+            # ONE admission token per HTTP request (see the samples path).
+            self._engine.take_admission_token()
+            try:
+                for ofs in range(0, len(prompts), self._engine.slots):
+                    out.extend(self._engine.submit(
+                        prompts[ofs:ofs + self._engine.slots],
+                        max_new_tokens=gen_budget, temperature=temperature,
+                        top_k=top_k, top_p=top_p, eos_id=eos_id,
+                        adapter_id=aid, admitted=True))
+            finally:
+                self._engine.release_admission_token()
             dt = time.perf_counter() - t0
             out = [row[:max_new_tokens] for row in out]
             with self._stats_lock:
@@ -939,26 +953,50 @@ class InferenceServer:
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, num_samples=num_samples, adapter=adapter)
             return iter([{"done": True, "tokens": tokens}])
+        # Engine route only, AFTER the routing decisions (a spec/fallback
+        # request never touches the admission counter, so it must not be
+        # shed by it): take the request's ONE token here, eagerly — an
+        # overload raises before the SSE headers go out and becomes a
+        # clean 503. The generator releases it.
+        self._engine.take_admission_token()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
             top_p, eos_id, aid)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid=0):
-        """Engine-backed streaming (args pre-sanitized). Requests wider
-        than the slot block stream chunk by chunk with global row
-        indices; deltas clip at max_new_tokens per row (the engine
-        decodes the pow2 gen_budget — surplus never reaches the
+        """Engine-backed streaming (args pre-sanitized; the CALLER took
+        this request's admission token — released here in the finally).
+        Requests wider than the slot block stream chunk by chunk with
+        global row indices; deltas clip at max_new_tokens per row (the
+        engine decodes the pow2 gen_budget — surplus never reaches the
         client, matching the non-streaming truncation)."""
         t0 = time.perf_counter()
         out: "list[list[int]]" = []
+        try:
+            yield from self._stream_engine_chunks(
+                prompts, max_new_tokens, gen_budget, temperature, top_k,
+                top_p, eos_id, aid, out)
+        finally:
+            self._engine.release_admission_token()
+        dt = time.perf_counter() - t0
+        with self._stats_lock:
+            self._stats["gen_requests"] += 1
+            self._stats["gen_examples"] += len(prompts)
+            self._stats["tokens"] += sum(len(r) for r in out)
+            self._stats["gen_seconds"] += dt
+        yield {"done": True, "tokens": out}
+
+    def _stream_engine_chunks(self, prompts, max_new_tokens, gen_budget,
+                              temperature, top_k, top_p, eos_id, aid,
+                              out):
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
             events = self._engine.submit_stream(
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, adapter_id=aid)
+                eos_id=eos_id, adapter_id=aid, admitted=True)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -980,13 +1018,6 @@ class InferenceServer:
                 # request instead of decoding on for nobody. No-op when
                 # the stream ran to completion.
                 events.close()
-        dt = time.perf_counter() - t0
-        with self._stats_lock:
-            self._stats["gen_requests"] += 1
-            self._stats["gen_examples"] += len(prompts)
-            self._stats["tokens"] += sum(len(r) for r in out)
-            self._stats["gen_seconds"] += dt
-        yield {"done": True, "tokens": out}
 
     def busy_seconds(self) -> float:
         with self._stats_lock:
@@ -1128,13 +1159,17 @@ class InferenceServer:
 
 def make_app(server: InferenceServer):
     """Returns the BaseHTTPRequestHandler class bound to `server`."""
+    from k3stpu.serve.engine import EngineOverloaded
 
     class Handler(BaseHTTPRequestHandler):
-        def _send(self, code: int, payload: dict):
+        def _send(self, code: int, payload: dict,
+                  headers: "dict | None" = None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -1235,6 +1270,11 @@ def make_app(server: InferenceServer):
                     # Engine queue backlog exceeded the wait budget: a
                     # clean 503 beats an http.server traceback + reset.
                     self._send(503, {"error": str(e)})
+                except EngineOverloaded as e:
+                    # Admission bound hit (--max-pending): shed load with
+                    # an explicit retryable status.
+                    self._send(503, {"error": str(e)},
+                               headers={"Retry-After": "1"})
                 return
             if self.path != "/v1/predict":
                 self._send(404, {"error": f"no route {self.path}"})
@@ -1349,6 +1389,12 @@ def main(argv=None) -> int:
                          "theirs via {\"adapter\": name}; omitted = base. "
                          "Adapters must share one rank and be trained "
                          "from the served base (train_job --lora-rank)")
+    ap.add_argument("--max-pending", type=int, default=None,
+                    help="with --continuous-batching: reject new generate "
+                         "requests with 503 once this many are in flight "
+                         "(queued or decoding) — bounded admission beats "
+                         "unbounded queueing under overload. Default: "
+                         "unbounded")
     ap.add_argument("--prompt-cache", type=int, default=0,
                     help="with --continuous-batching: LRU-cache this many "
                          "prefilled prompt KV rows — a repeat prompt skips "
@@ -1399,6 +1445,7 @@ def main(argv=None) -> int:
                              prefill_chunk=args.prefill_chunk,
                              decode_block=args.decode_block,
                              prompt_cache=args.prompt_cache,
+                             max_pending=args.max_pending,
                              lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,

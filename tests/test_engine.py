@@ -108,6 +108,59 @@ def test_eos_stops_a_slot_early(engine_setup):
     assert all(t == eos for t in got[3:]), "eos must repeat once emitted"
 
 
+def test_max_pending_sheds_load_and_recovers():
+    """Bounded admission: with max_pending in-flight requests, the next
+    submit raises EngineOverloaded immediately (no queueing, no
+    timeout-wait); tokens release on every exit path, so the engine
+    serves normally once load drains."""
+    from k3stpu.serve.engine import EngineOverloaded
+
+    model, params = _model_and_params()
+    engine = GenerateEngine(model, params, slots=2, max_pending=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm
+        real = engine._decode_step
+
+        def slow_step(*args, **kwargs):
+            time.sleep(0.02)
+            return real(*args, **kwargs)
+
+        engine._decode_step = slow_step
+        started = threading.Barrier(3)
+        results = {}
+
+        def hold(i):
+            started.wait()
+            results[i] = engine.submit([[5 + i, 6]], max_new_tokens=30)
+
+        holders = [threading.Thread(target=hold, args=(i,))
+                   for i in range(2)]
+        for t in holders:
+            t.start()
+        started.wait()
+        time.sleep(0.2)  # both in flight (decoding slowly)
+        t0 = time.time()
+        with pytest.raises(EngineOverloaded):
+            engine.submit([[9, 9]], max_new_tokens=2)
+        assert time.time() - t0 < 1.0, "overload must reject, not queue"
+        # A streaming attempt sheds too — and its token releases.
+        it = engine.submit_stream([[9, 9]], max_new_tokens=2)
+        with pytest.raises(EngineOverloaded):
+            next(it)
+        for t in holders:
+            t.join(timeout=120)
+        engine._decode_step = real
+        # Both holders must have SUCCEEDED (a spurious rejection at the
+        # bound would die silently in its thread otherwise).
+        assert len(results) == 2 and all(len(r) == 1 for r in
+                                         results.values())
+        assert engine._inflight == 0
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
+
+
 def test_engine_on_tensor_parallel_mesh_matches_single_device():
     """Continuous batching over a 2-device 'model' mesh: params sharded
     by parallel/sharding.py, the engine's KV cache head-sharded on the

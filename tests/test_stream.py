@@ -350,6 +350,59 @@ def test_sse_fallback_without_engine():
         server.close()
 
 
+def test_http_503_when_engine_at_capacity():
+    """--max-pending over HTTP: the overloaded generate route answers a
+    retryable 503 (Retry-After) instead of queueing, for both the plain
+    and streaming forms, and serves again after the load drains."""
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                             batch_window_ms=0.0, continuous_batching=True,
+                             engine_slots=2, max_pending=1,
+                             shard_devices=1)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        server.generate_tokens([[1, 2]], max_new_tokens=2)  # warm
+        eng = server._engine
+        real = eng._decode_step
+
+        def slow_step(*args, **kwargs):
+            time.sleep(0.05)
+            return real(*args, **kwargs)
+
+        eng._decode_step = slow_step
+        # Budget 48 x 50 ms per (4-token) dispatch ~ 600 ms of held
+        # capacity — the probe requests below must land inside it even
+        # on a loaded CI box.
+        hold = threading.Thread(
+            target=lambda: _post_json(
+                url + "/v1/generate",
+                {"prompt_tokens": [[5, 6]], "max_new_tokens": 48}))
+        hold.start()
+        deadline = time.time() + 10
+        while not eng.at_capacity():
+            assert time.time() < deadline, "holder never admitted"
+            time.sleep(0.02)
+        status, body = _post_json(
+            url + "/v1/generate",
+            {"prompt_tokens": [[7, 8]], "max_new_tokens": 2})
+        assert status == 503 and "capacity" in body["error"]
+        st2, body2 = _post_json(
+            url + "/v1/generate",
+            {"prompt_tokens": [[7, 8]], "max_new_tokens": 2,
+             "stream": True})
+        assert st2 == 503 and "capacity" in body2["error"]
+        hold.join(timeout=120)
+        eng._decode_step = real
+        status, body = _post_json(
+            url + "/v1/generate",
+            {"prompt_tokens": [[7, 8]], "max_new_tokens": 2})
+        assert status == 200 and len(body["tokens"][0]) == 2
+    finally:
+        httpd.shutdown()
+        server.close()
+
+
 def test_stream_stats_counted(engine_server):
     url, server = engine_server
     before = server.model_card()["stats"]["gen_requests"]
