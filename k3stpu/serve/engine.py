@@ -266,7 +266,8 @@ class GenerateEngine:
                        "busy_s": 0.0, "requests": 0,
                        "slot_occupancy_sum": 0.0, "adm_chunks": 0,
                        "pcache_hits": 0, "pcache_prefix_hits": 0,
-                       "pcache_misses": 0, "pcache_bytes": 0}
+                       "pcache_misses": 0, "pcache_bytes": 0,
+                       "rejected": 0}
         # Prompt cache: tuple(prompt tokens) -> (cache_1row, last_1row),
         # insertion-ordered dict as LRU (loop thread only).
         self.prompt_cache = prompt_cache
@@ -455,6 +456,7 @@ class GenerateEngine:
         with self._lock:
             if (self.max_pending is not None
                     and self._inflight >= self.max_pending):
+                self._stats["rejected"] += 1
                 raise EngineOverloaded(
                     f"engine at capacity: {self._inflight} requests in "
                     f"flight (max_pending={self.max_pending})")

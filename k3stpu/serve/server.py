@@ -1072,6 +1072,11 @@ class InferenceServer:
                 "# TYPE k3stpu_engine_busy_seconds_total counter",
                 f"k3stpu_engine_busy_seconds_total {e['busy_s']:.6f}",
             ]
+            if self._engine.max_pending is not None:
+                lines += [
+                    "# TYPE k3stpu_engine_rejected_total counter",
+                    f"k3stpu_engine_rejected_total {e['rejected']}",
+                ]
             if self._engine.prompt_cache > 0:
                 lines += [
                     "# TYPE k3stpu_pcache_hits_total counter",

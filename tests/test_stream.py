@@ -387,6 +387,8 @@ def test_http_503_when_engine_at_capacity():
             url + "/v1/generate",
             {"prompt_tokens": [[7, 8]], "max_new_tokens": 2})
         assert status == 503 and "capacity" in body["error"]
+        assert "k3stpu_engine_rejected_total 1" \
+            in server.prometheus_metrics()
         st2, body2 = _post_json(
             url + "/v1/generate",
             {"prompt_tokens": [[7, 8]], "max_new_tokens": 2,
