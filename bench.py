@@ -130,20 +130,33 @@ def _worker() -> int:
             errors[dim] = f"{type(e).__name__}: {e}"[:300]
     if not results:
         raise RuntimeError(f"every shape failed: {errors}")
-    res = results.get(headline_dim) or max(results.values(),
-                                           key=lambda r: r.tflops)
-
-    _emit({
+    res = results.get(headline_dim)
+    # A surviving non-headline shape must NOT be promoted into the
+    # headline metric: larger shapes run at higher MFU, so substitution
+    # would break the apples-to-apples trend the pin exists for. The
+    # headline reads failed (value 0.0 + error/stage/detail, the same
+    # schema as every other failure line) and the surviving shapes stay
+    # visible under all_shapes.
+    doc = {
         "metric": "pjit_matmul_bf16_tflops_per_chip",
-        "value": round(res.tflops, 2),
-        "unit": "TFLOP/s/chip",
-        "vs_baseline": round(res.tflops / BASELINE_TFLOPS, 4),
-        "detail": res.to_dict(),
         "all_shapes": [r.to_dict() for r in results.values()],
         "shape_errors": errors or None,
         "device_kind": getattr(devices[0], "device_kind", "unknown"),
         "n_devices": len(devices),
-    })
+    }
+    if res is not None:
+        doc.update(value=round(res.tflops, 2), unit="TFLOP/s/chip",
+                   vs_baseline=round(res.tflops / BASELINE_TFLOPS, 4),
+                   detail=res.to_dict())
+    else:
+        # Full failure schema (value 0.0 + error/stage/detail), matching
+        # _fail's lines so consumers need one failure shape only — NOT
+        # the surviving shape promoted into the headline.
+        doc.update(value=0.0, unit="TFLOP/s/chip", vs_baseline=0.0,
+                   error=f"headline shape {headline_dim}^3 failed",
+                   stage="headline_shape",
+                   detail=errors.get(headline_dim, "unknown"))
+    _emit(doc)
     return 0
 
 

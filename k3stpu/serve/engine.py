@@ -446,6 +446,16 @@ class GenerateEngine:
                         float(temperature), top_k, eos_id, samples=samples,
                         top_p=top_p, adapter=adapter_id)
 
+    def _reject_if_full_locked(self) -> None:
+        """Caller holds self._lock. Raises EngineOverloaded (counted in
+        the rejected stat) when max_pending is exhausted."""
+        if (self.max_pending is not None
+                and self._inflight >= self.max_pending):
+            self._stats["rejected"] += 1
+            raise EngineOverloaded(
+                f"engine at capacity: {self._inflight} requests in "
+                f"flight (max_pending={self.max_pending})")
+
     def take_admission_token(self) -> None:
         """Claim one unit of max_pending or raise EngineOverloaded.
         Callers that split ONE logical request into several chunk
@@ -454,12 +464,7 @@ class GenerateEngine:
         re-gating per chunk would reject an already-admitted request
         mid-flight after burning its earlier chunks' decode work."""
         with self._lock:
-            if (self.max_pending is not None
-                    and self._inflight >= self.max_pending):
-                self._stats["rejected"] += 1
-                raise EngineOverloaded(
-                    f"engine at capacity: {self._inflight} requests in "
-                    f"flight (max_pending={self.max_pending})")
+            self._reject_if_full_locked()
             self._inflight += 1
 
     def release_admission_token(self) -> None:
@@ -473,6 +478,15 @@ class GenerateEngine:
         with self._lock:
             return (self.max_pending is not None
                     and self._inflight >= self.max_pending)
+
+    def reject_if_at_capacity(self) -> None:
+        """Advisory shed WITHOUT claiming a token: raises
+        EngineOverloaded (counted in the rejected stat, same as an
+        authoritative take failure) when at capacity. For callers that
+        must 503 before response headers but defer the real token take
+        until their generator actually starts."""
+        with self._lock:
+            self._reject_if_full_locked()
 
     def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
                           admitted: bool = False) -> "list[list[int]]":

@@ -955,24 +955,33 @@ class InferenceServer:
             return iter([{"done": True, "tokens": tokens}])
         # Engine route only, AFTER the routing decisions (a spec/fallback
         # request never touches the admission counter, so it must not be
-        # shed by it): take the request's ONE token here, eagerly — an
-        # overload raises before the SSE headers go out and becomes a
-        # clean 503. The generator releases it.
-        self._engine.take_admission_token()
+        # shed by it). The advisory check turns an overload into a clean
+        # pre-header 503; the AUTHORITATIVE token take happens inside
+        # the generator on first next() — taking it here would leak the
+        # max_pending slot whenever the generator is never started
+        # (close() on a never-started generator skips its finally, e.g.
+        # after a header-write failure in _send_sse). The advisory/take
+        # race window means a take can still fail mid-stream, which
+        # degrades to an SSE error frame rather than a 503.
+        self._engine.reject_if_at_capacity()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
             top_p, eos_id, aid)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid=0):
-        """Engine-backed streaming (args pre-sanitized; the CALLER took
-        this request's admission token — released here in the finally).
-        Requests wider than the slot block stream chunk by chunk with
-        global row indices; deltas clip at max_new_tokens per row (the
-        engine decodes the pow2 gen_budget — surplus never reaches the
-        client, matching the non-streaming truncation)."""
+        """Engine-backed streaming (args pre-sanitized). The admission
+        token is taken HERE, on the generator's first next(), so a
+        generator that is created but never iterated cannot leak the
+        slot; the matching release is in the finally, which is
+        guaranteed to run once the generator has started. Requests wider
+        than the slot block stream chunk by chunk with global row
+        indices; deltas clip at max_new_tokens per row (the engine
+        decodes the pow2 gen_budget — surplus never reaches the client,
+        matching the non-streaming truncation)."""
         t0 = time.perf_counter()
         out: "list[list[int]]" = []
+        self._engine.take_admission_token()
         try:
             yield from self._stream_engine_chunks(
                 prompts, max_new_tokens, gen_budget, temperature, top_k,
@@ -1464,6 +1473,15 @@ def main(argv=None) -> int:
 
     start_telemetry_thread(server)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_app(server))
+    # ThreadingHTTPServer defaults daemon_threads=True, and socketserver
+    # does not TRACK daemon handler threads — server_close() would then
+    # return while handlers are mid-request and server.close() below
+    # would yank the engine out from under them. Non-daemon threads are
+    # tracked and joined by server_close() (block_on_close), which is
+    # exactly the "in-flight requests finish" the drain promises; the
+    # k8s grace period bounds the join, and the second-signal escape
+    # hatch above covers a wedged handler.
+    httpd.daemon_threads = False
 
     # Graceful pod termination (the Recreate-strategy restart path,
     # reference jellyfin.yaml:13-14): on SIGTERM/SIGINT stop accepting,

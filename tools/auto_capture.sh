@@ -25,10 +25,13 @@ DEADLINE_EPOCH="${3:-0}"   # 0 = no deadline; else stop polling after this
 case "$DEADLINE_EPOCH" in
   ''|*[!0-9]*) echo "DEADLINE_EPOCH must be a unix timestamp (or 0)"; exit 2;;
 esac
-REPO="${K3STPU_REPO:-/root/repo}"
+# K3STPU_REPO override exists for running a SNAPSHOT COPY of this script
+# (editing the repo copy while a watcher executes it corrupts the running
+# bash); the default works from any clone location.
+REPO="${K3STPU_REPO:-$(cd "$(dirname "$0")/.." && pwd)}"
 MARKER="/tmp/auto_capture_done_r${ROUND}"
 cd "$REPO" || exit 1
-POLL_LOG="artifacts/tunnel_poll_r$(printf '%02d' "$ROUND").jsonl"
+POLL_LOG="artifacts/tunnel_poll_r$(printf '%02d' "$((10#$ROUND))").jsonl"
 mkdir -p artifacts
 
 log_poll() {  # $1=status $2=probe_seconds $3=poll_index
@@ -37,10 +40,14 @@ log_poll() {  # $1=status $2=probe_seconds $3=poll_index
 }
 
 commit_artifacts() {  # $1 = commit subject; retries around index-lock races
+  # Benign no-op when artifacts/ has no changes (e.g. watcher launched
+  # past its deadline) — the retry loop is for index-lock races only.
+  [ -z "$(git status --porcelain -- artifacts/)" ] && return 0
   for _ in 1 2 3; do
     git add artifacts/ && \
       git commit -q -m "$1" \
         -m "No-Verification-Needed: artifact capture logs only, no source change" \
+        -- artifacts/ \
       && { echo "$(date -u +%H:%M:%S) committed: $1"; return 0; }
     sleep 5
   done
