@@ -16,6 +16,16 @@ Supported template constructs (all the chart uses, nothing more):
   false/empty)
 - whitespace chomping ``{{-`` / ``-}}``
 
+ANY construct outside this subset raises ValueError at render time —
+the keywords ``range``/``with``/``include``/``template``/``define``/
+``block``/``else``, compound ``if`` conditions (``and``/``or``/``not``/
+``eq``/...), and unknown pipeline functions (``default``, ``printf``,
+...) — even inside a disabled ``if`` branch, where tags are
+structurally validated without being evaluated. Silent mis-rendering of
+production manifests is the one failure mode a bespoke renderer must
+not have: the first chart contributor to use a named template must get
+a hard error, not a subtly wrong DaemonSet.
+
 Run: python -m k3stpu.utils.helm_lite CHART_DIR [--set a.b=c ...] \
          [--namespace NS] | kubectl apply -f -
 """
@@ -30,6 +40,76 @@ from pathlib import Path
 import yaml
 
 _TAG = re.compile(r"\{\{-?\s*(.*?)\s*-?\}\}")
+
+# Go-template keywords this renderer does NOT implement. Checked on every
+# tag — including tags inside a disabled {{ if }} branch, where "skip it"
+# would be structurally wrong: a skipped {{ else }} silently drops the
+# else-body, and a skipped {{ range }}'s {{ end }} would pop the wrong
+# block off the if-stack.
+_UNSUPPORTED = ("range", "with", "include", "template", "define", "block",
+                "else")
+
+
+# Supported pipeline functions -> required argument count.
+_PIPE_FNS = {"toYaml": 0, "indent": 1, "nindent": 1, "quote": 0}
+
+
+def _reject_unsupported(expr: str) -> None:
+    head = expr.split()[0] if expr.split() else expr
+    if head in _UNSUPPORTED:
+        raise ValueError(
+            f"unsupported template construct: {{{{ {expr} }}}} — helm-lite "
+            f"renders only .Values/.Release/.Chart refs, toYaml/indent/"
+            f"nindent/quote pipelines, and {{{{ if <ref> }}}}/{{{{ end }}}} "
+            f"blocks ('{head}' needs real helm; see module docstring)")
+
+
+def _if_ref(expr: str) -> str:
+    """The condition of `if <ref>` — a single bare .Ref only. Compound
+    conditions (and/or/not/eq/...) would otherwise _lookup the whole
+    string, find nothing, and silently render the branch EMPTY."""
+    ref = expr[3:].strip()
+    if len(ref.split()) != 1 or not ref.startswith("."):
+        raise ValueError(
+            f"unsupported template construct: {{{{ {expr} }}}} — if takes "
+            f"a single bare .Ref (and/or/not/eq/... need real helm)")
+    return ref
+
+
+def _parse_expr(expr: str) -> "tuple[str, list[str]]":
+    """Structurally validate a value expression; return (ref, pipeline).
+    Raises on anything outside the subset WITHOUT evaluating — so it can
+    also vet expressions in branches the current values disable."""
+    pipes = [p.strip() for p in expr.split("|")]
+    head, pipeline = pipes[0], pipes[1:]
+    tokens = head.split()
+    if len(tokens) == 2 and tokens[0] in ("toYaml", "quote"):
+        ref = tokens[1]
+        pipeline = [tokens[0], *pipeline]
+    elif len(tokens) == 1:
+        ref = tokens[0]
+    else:
+        raise ValueError(f"unsupported template expr: {expr}")
+    if not ref.startswith("."):
+        raise ValueError(f"unsupported template expr: {expr}")
+    for pipe in pipeline:
+        parts = pipe.split()
+        if parts[0] not in _PIPE_FNS or len(parts) - 1 != _PIPE_FNS[parts[0]]:
+            raise ValueError(
+                f"unsupported pipeline function: {pipe!r} in "
+                f"{{{{ {expr} }}}} (supported: {sorted(_PIPE_FNS)})")
+    return ref, pipeline
+
+
+def _validate_tag(expr: str) -> None:
+    """Full structural check of one tag, used for tags whose VALUE is
+    never needed (disabled branches): a template is either fully inside
+    the subset or rejected, independent of today's values."""
+    _reject_unsupported(expr)
+    if expr.startswith("if "):
+        _if_ref(expr)
+    elif expr != "end":
+        _parse_expr(expr)
 
 
 def _lookup(ctx: dict, dotted: str):
@@ -69,18 +149,7 @@ def _truthy(v) -> bool:
 
 def _eval_expr(expr: str, ctx: dict):
     """Evaluate `.Ref | pipe ...` or the function-call form `func .Ref | ...`."""
-    pipes = [p.strip() for p in expr.split("|")]
-    head, pipeline = pipes[0], pipes[1:]
-    tokens = head.split()
-    if len(tokens) == 2 and tokens[0] in ("toYaml", "quote"):
-        ref = tokens[1]
-        pipeline = [tokens[0], *pipeline]
-    elif len(tokens) == 1:
-        ref = tokens[0]
-    else:
-        raise ValueError(f"unsupported template expr: {expr}")
-    if not ref.startswith("."):
-        raise ValueError(f"unsupported template expr: {expr}")
+    ref, pipeline = _parse_expr(expr)
     value = _lookup(ctx, ref)
     if value is None:
         raise ValueError(f"undefined reference: {ref}")
@@ -103,9 +172,9 @@ def render_template(text: str, ctx: dict) -> str:
         m = _TAG.fullmatch(stripped) if stripped.startswith("{{") else None
         if m:
             expr = m.group(1)
+            _reject_unsupported(expr)
             if expr.startswith("if "):
-                ref = expr[3:].strip()
-                stack.append(_truthy(_lookup(ctx, ref)))
+                stack.append(_truthy(_lookup(ctx, _if_ref(expr))))
                 continue
             if expr == "end":
                 if not stack:
@@ -119,11 +188,20 @@ def render_template(text: str, ctx: dict) -> str:
                 value = _eval_expr(expr, ctx)
                 s = str(value)
                 out.append(s[1:] if s.startswith("\n") else s)
+            else:
+                _validate_tag(expr)
             continue
         if not emitting():
+            # The line's CONTENT is rightly skipped, but its tags must
+            # still be STRUCTURALLY inside the subset: a template is
+            # either fully renderable or rejected, independent of which
+            # values happen to disable its branches today.
+            for match in _TAG.finditer(line):
+                _validate_tag(match.group(1))
             continue
 
         def sub(match: "re.Match[str]") -> str:
+            _reject_unsupported(match.group(1))
             value = _eval_expr(match.group(1), ctx)
             if isinstance(value, bool):
                 return "true" if value else "false"

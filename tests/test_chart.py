@@ -205,3 +205,42 @@ def test_golden_matches_real_helm(name):
         golden_objs = objects(f.read())
     helm_objs = objects(out)
     assert helm_objs == golden_objs
+
+
+@pytest.mark.parametrize("snippet", [
+    "{{- range .Values.items }}\nx: 1\n{{- end }}",
+    "{{ include \"k3s-tpu.labels\" . }}",
+    "{{- with .Values.nodeSelector }}\nnodeSelector: {{ . }}\n{{- end }}",
+    "{{ define \"helper\" }}x{{ end }}",
+    "{{ template \"helper\" }}",
+    "{{ block \"b\" . }}{{ end }}",
+    "{{- if .Values.missing }}\na: 1\n{{- else }}\nb: 2\n{{- end }}",
+    "{{- if and .Values.a .Values.b }}\nx: 1\n{{- end }}",
+    "{{- if not .Values.a }}\nx: 1\n{{- end }}",
+    "x: {{ .Values.n | default 3 }}",
+])
+def test_renderer_rejects_constructs_outside_subset(snippet):
+    """helm-lite must HARD-FAIL on any Go-template construct it does not
+    implement — block keywords (range/with/include/template/define/
+    block/else), compound if conditions (and/not/eq/...), and unknown
+    pipeline functions (default/printf/...) — instead of silently
+    mis-rendering: a skipped {{ else }} would drop the else-body, a
+    compound if would _lookup nothing and render the branch empty, and
+    a skipped {{ range }}'s {{ end }} would corrupt the if-stack. The
+    guard fires even when the construct sits inside a disabled
+    {{ if }} branch: subset membership must not depend on which values
+    are set today."""
+    from k3stpu.utils.helm_lite import render_template
+    with pytest.raises(ValueError, match="unsupported"):
+        render_template(snippet, {"Values": {}})
+    # Same construct nested in a branch the current values DISABLE:
+    wrapped = "{{- if .Values.off }}\n" + snippet + "\n{{- end }}"
+    with pytest.raises(ValueError, match="unsupported"):
+        render_template(wrapped, {"Values": {"off": False}})
+
+
+def test_renderer_rejects_inline_unsupported_constructs():
+    from k3stpu.utils.helm_lite import render_template
+    with pytest.raises(ValueError, match="unsupported template construct"):
+        render_template("name: {{ include \"x\" . }}-suffix",
+                        {"Values": {}})

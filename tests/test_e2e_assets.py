@@ -1,0 +1,121 @@
+"""Lint the real-cluster e2e assets (deploy/e2e/, tools/e2e_cluster.sh).
+
+The e2e script itself can only run on a machine with docker+k3d
+(docs/E2E_CLUSTER.md), but everything it applies to the cluster is
+committed YAML that CAN be validated here — with the same kubeval-lite
+discipline as the chart lint (tests/test_chart_lint.py): skeletons,
+names, and — the drift-prone part — that the strategic-merge patches
+only touch volumes the chart actually renders, so a chart refactor that
+renames a volume fails CI instead of silently un-faking the e2e.
+"""
+
+import glob
+import os
+import re
+import subprocess
+
+import yaml
+
+from k3stpu.utils.helm_lite import render_chart
+from tests.test_chart import CHART
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+E2E_DIR = os.path.join(REPO, "deploy", "e2e")
+SCRIPT = os.path.join(REPO, "tools", "e2e_cluster.sh")
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+
+
+def _load(name):
+    with open(os.path.join(E2E_DIR, name)) as f:
+        return yaml.safe_load(f)
+
+
+def _chart_daemonsets():
+    docs = yaml.safe_load_all(render_chart(CHART, namespace="tpu-system"))
+    return {d["metadata"]["name"]: d for d in docs
+            if d and d["kind"] == "DaemonSet"}
+
+
+def test_script_lints():
+    subprocess.run(["bash", "-n", SCRIPT], check=True)
+    assert os.access(SCRIPT, os.X_OK), "e2e script must be executable"
+
+
+def test_all_e2e_yamls_parse():
+    files = glob.glob(os.path.join(E2E_DIR, "*.yaml"))
+    assert len(files) >= 3
+    for path in files:
+        with open(path) as f:
+            assert yaml.safe_load(f) is not None, path
+
+
+def test_probe_pod_skeleton_and_parity():
+    doc = _load("e2e-probe.yaml")
+    assert set(doc) >= {"apiVersion", "kind", "metadata", "spec"}
+    assert doc["kind"] == "Pod"
+    assert _DNS1123.match(doc["metadata"]["name"])
+    spec = doc["spec"]
+    # The stack-parity triple every probe in this repo shares
+    # (deploy/manifests/tpu-probe.yaml, reference nvidia-smi.yaml:8-16):
+    assert spec["runtimeClassName"] == "tpu"
+    assert spec["restartPolicy"] == "Never"
+    [c] = spec["containers"]
+    assert c["resources"]["limits"]["google.com/tpu"] == "1"
+    # e2e-specific: label-gated scheduling (the LIVE form of the
+    # reference's commented selector) + local image only + the log
+    # oracle the script greps for, exiting nonzero when injection is
+    # missing so pod phase is the assertion.
+    assert spec["nodeSelector"]["google.com/tpu.present"] == "true"
+    assert c["imagePullPolicy"] == "Never"
+    body = c["command"][-1]
+    assert "E2E_PROBE_JSON" in body and "TPU_VISIBLE_CHIPS" in body
+    assert "sys.exit" in body
+
+
+def test_patches_touch_only_rendered_volumes():
+    """Every volume a fakeroot patch overrides must exist (by name) in
+    the chart-rendered DaemonSet it patches, and must repoint under
+    /fake-tpu-root — the tree tools/e2e_cluster.sh seeds."""
+    ds = _chart_daemonsets()
+    for patch_name, ds_name in (
+            ("plugin-fakeroot-patch.yaml", "k3s-tpu-device-plugin"),
+            ("tfd-fakeroot-patch.yaml", "k3s-tpu-feature-discovery")):
+        patch = _load(patch_name)
+        patch_vols = patch["spec"]["template"]["spec"]["volumes"]
+        rendered = {v["name"]: v for v in
+                    ds[ds_name]["spec"]["template"]["spec"]["volumes"]}
+        assert patch_vols, patch_name
+        for v in patch_vols:
+            assert v["name"] in rendered, (
+                f"{patch_name}: volume {v['name']!r} not in the rendered "
+                f"{ds_name} — chart and e2e patch have drifted")
+            path = v["hostPath"]["path"]
+            assert path.startswith("/fake-tpu-root/"), path
+            # The repoint must mirror the real source's basename so the
+            # container-side mount semantics stay identical.
+            real = rendered[v["name"]]["hostPath"]["path"]
+            assert path == "/fake-tpu-root" + real, (patch_name, path, real)
+
+
+def test_script_references_exist():
+    """Paths the script mounts/applies must exist in the repo, and its
+    assertions must match what the assets emit."""
+    with open(SCRIPT) as f:
+        text = f.read()
+    for rel in ("deploy/containerd/config-v3.toml.tmpl",
+                "deploy/containerd/config.toml.tmpl",
+                "deploy/charts/k3s-tpu",
+                "deploy/e2e/tfd-fakeroot-patch.yaml",
+                "deploy/e2e/plugin-fakeroot-patch.yaml",
+                "deploy/e2e/e2e-probe.yaml",
+                "docker/k3s-tpu.Dockerfile"):
+        assert rel in text, f"script no longer uses {rel}?"
+        assert os.path.exists(os.path.join(REPO, rel)), rel
+    # the capacity assertion must agree with the chart's replicas knob
+    values = yaml.safe_load(
+        open(os.path.join(REPO, "deploy/charts/k3s-tpu/values.yaml")))
+    replicas = values["config"]["sharing"]["timeSlicing"]["resources"][0][
+        "replicas"]
+    assert f"grep -qx {replicas}" in text, (
+        "script's capacity assertion drifted from the chart default")

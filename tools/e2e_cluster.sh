@@ -1,0 +1,131 @@
+#!/bin/bash
+# Real-cluster e2e: stand up a k3d (k3s-in-docker) cluster, install the
+# WHOLE stack — runtime shim via containerd template, chart via helm-lite,
+# discovery labeling a fake TPU, device plugin advertising google.com/tpu
+# x4 — and assert a probe pod schedules and sees the injected env, device
+# node, and libtpu mount. The zero-work counterpart to
+# docs/HELM_VALIDATION.md: this box has no docker, so the script is wired
+# to pass on the FIRST machine that does (see docs/E2E_CLUSTER.md).
+#
+# Usage: tools/e2e_cluster.sh [--keep]
+#   --keep   leave the cluster running for inspection (default: delete)
+#
+# Requires: docker, k3d (https://k3d.io), kubectl. kind is deliberately
+# NOT supported: the containerd-template install path under test is
+# K3S's mechanism (deploy/install-runtime.sh), which kind's plain
+# containerd does not implement.
+set -euo pipefail
+
+CLUSTER="${K3STPU_E2E_CLUSTER:-k3stpu-e2e}"
+NS=tpu-system
+IMAGE=ghcr.io/k3s-tpu/k3s-tpu:latest
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+KEEP=0
+[ "${1:-}" = "--keep" ] && KEEP=1
+
+say()  { printf '\n== %s\n' "$*"; }
+need() { command -v "$1" >/dev/null 2>&1 || { echo "e2e: missing required tool: $1" >&2; exit 3; }; }
+need docker; need k3d; need kubectl
+
+WORK="$(mktemp -d /tmp/k3stpu-e2e.XXXXXX)"
+cleanup() {
+  rc=$?
+  if [ "$KEEP" = 1 ]; then
+    echo "e2e: --keep: cluster '$CLUSTER' left running (k3d cluster delete $CLUSTER)"
+  else
+    k3d cluster delete "$CLUSTER" >/dev/null 2>&1 || true
+  fi
+  rm -rf "$WORK"
+  exit "$rc"
+}
+trap cleanup EXIT
+
+say "build control-plane image + extract runtime shim"
+docker build -q -f "$REPO/docker/k3s-tpu.Dockerfile" -t "$IMAGE" "$REPO"
+docker build -q -f "$REPO/docker/k3s-tpu.Dockerfile" --target build \
+  -t k3s-tpu-build "$REPO"
+CID="$(docker create k3s-tpu-build)"
+docker cp "$CID:/src/native/build/tpu-container-runtime" \
+  "$WORK/tpu-container-runtime"
+docker rm "$CID" >/dev/null
+
+say "seed fake TPU host tree (1 v5e chip, same fixture shape as tests/test_chips.py)"
+FAKE="$WORK/fake-tpu-root"
+BDF="$FAKE/sys/bus/pci/devices/0000:00:04.0"
+mkdir -p "$BDF" "$FAKE/dev" "$FAKE/usr/lib" "$FAKE/lib"
+echo 0x1ae0 > "$BDF/vendor"      # Google vendor id (SURVEY.md §1 L3)
+echo 0x0062 > "$BDF/device"      # v5e
+touch "$FAKE/dev/accel0"         # upgraded to a char node inside the node below
+echo "fake libtpu for injection-path testing" > "$FAKE/usr/lib/libtpu.so"
+
+say "create k3d cluster with shim + containerd template + fake root mounted"
+# The three --volume mounts ARE the per-node install step
+# (deploy/install-runtime.sh) done declaratively: binary in place,
+# K3S containerd template registering handler 'tpu', and the fake host
+# tree for discovery/plugin/Allocate. /usr/lib/libtpu.so is mounted at
+# its REAL host path too because Allocate returns host-absolute mount
+# sources (the /host prefix is only the plugin's scan window).
+k3d cluster create "$CLUSTER" --no-lb --timeout 180s \
+  --volume "$WORK/tpu-container-runtime:/usr/local/bin/tpu-container-runtime" \
+  --volume "$REPO/deploy/containerd/config-v3.toml.tmpl:/var/lib/rancher/k3s/agent/etc/containerd/config-v3.toml.tmpl" \
+  --volume "$REPO/deploy/containerd/config.toml.tmpl:/var/lib/rancher/k3s/agent/etc/containerd/config.toml.tmpl" \
+  --volume "$FAKE:/fake-tpu-root" \
+  --volume "$FAKE/usr/lib/libtpu.so:/usr/lib/libtpu.so"
+
+NODE="k3d-$CLUSTER-server-0"
+
+say "node prep: char device nodes + shim runc path"
+# Real char devices (k3d nodes run privileged): kubelet/containerd stat
+# the host node to mknod the container copy, so a plain file won't do.
+docker exec "$NODE" sh -c '
+  rm -f /dev/accel0 /fake-tpu-root/dev/accel0
+  mknod /dev/accel0 c 120 0
+  mknod /fake-tpu-root/dev/accel0 c 120 0
+  mkdir -p /etc/tpu-container-runtime
+  printf "{\"runc_path\": \"%s\"}\n" \
+    "$(ls /var/lib/rancher/k3s/data/*/bin/runc 2>/dev/null | head -1)" \
+    > /etc/tpu-container-runtime/config.json
+  cat /etc/tpu-container-runtime/config.json'
+
+say "import image + install the chart (helm-lite render, no helm needed)"
+k3d image import -c "$CLUSTER" "$IMAGE"
+kubectl create namespace "$NS"
+python -m k3stpu.utils.helm_lite "$REPO/deploy/charts/k3s-tpu" \
+  --namespace "$NS" | kubectl apply -f -
+
+say "repoint both DaemonSets at the fake host tree"
+kubectl -n "$NS" patch daemonset k3s-tpu-feature-discovery \
+  --patch-file "$REPO/deploy/e2e/tfd-fakeroot-patch.yaml"
+kubectl -n "$NS" patch daemonset k3s-tpu-device-plugin \
+  --patch-file "$REPO/deploy/e2e/plugin-fakeroot-patch.yaml"
+
+wait_for() {  # $1 = description, $2 = timeout_s, $3 = command that must succeed
+  local t=0
+  until eval "$3" >/dev/null 2>&1; do
+    t=$((t + 5))
+    [ "$t" -ge "$2" ] && { echo "e2e: TIMEOUT waiting for $1" >&2
+      kubectl -n "$NS" get pods -o wide || true
+      kubectl -n "$NS" describe daemonsets || true; return 1; }
+    sleep 5
+  done
+  echo "ok: $1"
+}
+
+say "assert: discovery labels the node (google.com/tpu.present=true)"
+wait_for "tfd label" 180 \
+  "kubectl get node $NODE -o jsonpath='{.metadata.labels.google\.com/tpu\.present}' | grep -qx true"
+
+say "assert: plugin advertises google.com/tpu: 4 (1 fake chip x replicas:4 — reference values.yaml:18)"
+wait_for "extended resource capacity 4" 180 \
+  "kubectl get node $NODE -o jsonpath='{.status.capacity.google\.com/tpu}' | grep -qx 4"
+
+say "assert: probe pod schedules, runs under RuntimeClass tpu, sees injection"
+kubectl apply -f "$REPO/deploy/e2e/e2e-probe.yaml"
+wait_for "probe pod Succeeded" 180 \
+  "kubectl get pod tpu-e2e-probe -o jsonpath='{.status.phase}' | grep -qx Succeeded"
+LOGS="$(kubectl logs tpu-e2e-probe)"
+echo "$LOGS"
+echo "$LOGS" | grep -q 'E2E_PROBE_JSON.*TPU_VISIBLE_CHIPS' \
+  || { echo "e2e: probe logs missing injected TPU env" >&2; exit 1; }
+
+say "PASS: discovery -> plugin -> scheduler -> runtime injection all verified on a real kubelet"
