@@ -56,6 +56,9 @@ class AttnResult:
         d["tflops"] = round(d["tflops"], 2)
         if d["mfu"] is not None:
             d["mfu"] = round(d["mfu"], 4)
+        # One ATTN_JSON schema everywhere (probe + CLI): per-iteration
+        # time is what every consumer derives anyway.
+        d["ms_per_iter"] = round(self.seconds / self.iters * 1e3, 3)
         return d
 
 
@@ -219,3 +222,37 @@ def check_attention(
     err["ok"] = all(err[f"{n}_max_err"] < 5e-2
                     for n in ("fwd", "dq", "dk", "dv"))
     return err
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    """Tiny CLI for targeted one-shape runs (the per-iteration-overhead
+    diagnostic in tools/capture_artifacts.py stage_tune: same ms/iter at
+    --iters 10 and 50 = the overhead is per loop iteration, not per
+    dispatch — see docs/ATTN_ROOFLINE.md round-5 section)."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(description="one-shape attention bench")
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--fwd-only", action="store_true")
+    ap.add_argument("--flash-only", action="store_true")
+    ap.add_argument("--interpret", action="store_true")
+    args = ap.parse_args(argv)
+    for r in measure_attention(
+            seq=args.seq, batch=args.batch, heads=args.heads,
+            head_dim=args.head_dim, iters=args.iters,
+            backward=not args.fwd_only,
+            include_einsum=False if args.flash_only else None,
+            interpret=args.interpret):
+        print("ATTN_JSON " + json.dumps(r.to_dict()), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys as _sys
+
+    _sys.exit(main())
