@@ -1351,6 +1351,15 @@ def main(argv=None) -> int:
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)
     ap.add_argument("--no-warmup", action="store_true")
+    ap.add_argument("--warmup-only", action="store_true",
+                    help="build the server, run the warmup compiles, and "
+                         "exit 0 without serving. With --compilation-cache "
+                         "this incrementally populates the persistent "
+                         "cache: each finished program is saved even if a "
+                         "later compile dies, so flaky-backend operators "
+                         "(and the capture harness) can retry cheap "
+                         "bounded pre-warms until the real server boots "
+                         "into an all-hits warmup")
     ap.add_argument("--batch-window-ms", type=float, default=5.0,
                     help="coalescing window for concurrent /v1/predict "
                          "requests (0 disables cross-request batching)")
@@ -1470,6 +1479,17 @@ def main(argv=None) -> int:
     if not args.no_warmup:
         print("warming up (pre-compiling batch sizes)...", flush=True)
         server.warmup()
+    if args.warmup_only:
+        if args.no_warmup:
+            # A silent rc=0 here would tell retry loops the cache is
+            # populated when nothing compiled.
+            print("--warmup-only with --no-warmup compiles nothing",
+                  flush=True)
+            server.close()
+            return 2
+        print("warmup complete (--warmup-only); exiting", flush=True)
+        server.close()
+        return 0
 
     start_telemetry_thread(server)
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port), make_app(server))
