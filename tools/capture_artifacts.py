@@ -338,12 +338,23 @@ def stage_tune(log):
     if ok:
         # Diagnostic only when the deliverable landed (i.e. the backend
         # is answering): ~1 min warm each, 300 s bound so a mid-stage
-        # wedge costs minutes, not the window.
+        # wedge costs minutes, not the window. The matmul pair isolates
+        # the backend: a small PURE-XLA chain showing the same flat
+        # ms/iter at 10 vs 50 iters proves the overhead has nothing to
+        # do with attention or Pallas at all.
         for iters in ("10", "50"):
             _run_bounded(
                 [sys.executable, "-m", "k3stpu.ops.attn_bench", "--seq",
                  "1024", "--batch", "8", "--fwd-only", "--flash-only",
                  "--iters", iters], 300, log)
+            _run_bounded(
+                [sys.executable, "-c",
+                 "import json; from k3stpu.ops.matmul import measure_matmul"
+                 f"; r = measure_matmul(m=1024, n=1024, k=1024, "
+                 f"iters={iters}); d = r.to_dict()"
+                 "; d['ms_per_iter'] = round(r.seconds / r.iters * 1e3, 3)"
+                 "; print('MATMUL_DIAG_JSON', json.dumps(d))"],
+                300, log)
     return ok
 
 
