@@ -2,7 +2,9 @@
 
 Prints exactly ONE JSON line in every outcome:
   success: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
-  failure: same keys with value 0.0 plus {"error", "stage", "detail"}
+  failure: same keys with value 0.0 plus {"error", "stage", "detail",
+  "last_good_artifact"} — the last field an informational pointer to the
+  newest committed probe measurement (never a substitute value)
 
 Baseline (BASELINE.md): the reference publishes no numbers, so the target is
 BASELINE.json's north star — >=50% MFU on v5e => 98.5 bf16 TFLOP/s per chip.
@@ -60,6 +62,30 @@ def _emit(payload: dict) -> None:
     print(json.dumps(payload), flush=True)
 
 
+def _last_good_artifact() -> "str | None":
+    """Pointer to the newest committed probe artifact with a BENCH_JSON
+    line — informational context for a failure line ONLY (value stays
+    0.0: a wedged live run is a wedged live run; the pointer just tells
+    the reader where the last real measurement lives)."""
+    import glob
+    import re
+
+    for path in sorted(glob.glob(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "artifacts", "probe_r*.log")), reverse=True):
+        try:
+            with open(path) as f:
+                m = re.search(r'BENCH_JSON ({.*})', f.read())
+            if m:
+                d = json.loads(m.group(1))
+                return (f"{os.path.basename(path)}: {d.get('tflops')} "
+                        f"TF/s (mfu {d.get('mfu')}) at "
+                        f"{d.get('m')}^3 {d.get('dtype')}")
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+    return None
+
+
 def _fail(stage: str, detail: str) -> int:
     _emit({
         "metric": "pjit_matmul_bf16_tflops_per_chip",
@@ -69,6 +95,7 @@ def _fail(stage: str, detail: str) -> int:
         "error": f"benchmark failed at stage '{stage}'",
         "stage": stage,
         "detail": detail[-2000:],
+        "last_good_artifact": _last_good_artifact(),
     })
     return 0  # structured failure IS the output; don't turn it into an rc
 
@@ -149,13 +176,15 @@ def _worker() -> int:
                    vs_baseline=round(res.tflops / BASELINE_TFLOPS, 4),
                    detail=res.to_dict())
     else:
-        # Full failure schema (value 0.0 + error/stage/detail), matching
-        # _fail's lines so consumers need one failure shape only — NOT
-        # the surviving shape promoted into the headline.
+        # Full failure schema (value 0.0 + error/stage/detail/
+        # last_good_artifact), matching _fail's lines so consumers need
+        # one failure shape only — NOT the surviving shape promoted into
+        # the headline.
         doc.update(value=0.0, unit="TFLOP/s/chip", vs_baseline=0.0,
                    error=f"headline shape {headline_dim}^3 failed",
                    stage="headline_shape",
-                   detail=errors.get(headline_dim, "unknown"))
+                   detail=errors.get(headline_dim, "unknown"),
+                   last_good_artifact=_last_good_artifact())
     _emit(doc)
     return 0
 

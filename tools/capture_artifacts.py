@@ -347,14 +347,11 @@ def stage_tune(log):
                 [sys.executable, "-m", "k3stpu.ops.attn_bench", "--seq",
                  "1024", "--batch", "8", "--fwd-only", "--flash-only",
                  "--iters", iters], 300, log)
+            # Same measurement core as the headline bench, via the probe
+            # CLI (BENCH_JSON carries seconds+iters; ms/iter derives).
             _run_bounded(
-                [sys.executable, "-c",
-                 "import json; from k3stpu.ops.matmul import measure_matmul"
-                 f"; r = measure_matmul(m=1024, n=1024, k=1024, "
-                 f"iters={iters}); d = r.to_dict()"
-                 "; d['ms_per_iter'] = round(r.seconds / r.iters * 1e3, 3)"
-                 "; print('MATMUL_DIAG_JSON', json.dumps(d))"],
-                300, log)
+                [sys.executable, "-m", "k3stpu.probe", "--m", "1024",
+                 "--iters", iters], 300, log)
     return ok
 
 
