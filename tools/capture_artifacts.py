@@ -342,7 +342,11 @@ def stage_tune(log):
         # the backend: a small PURE-XLA chain showing the same flat
         # ms/iter at 10 vs 50 iters proves the overhead has nothing to
         # do with attention or Pallas at all.
-        for iters in ("10", "50"):
+        # Three points, not two: flat ms/iter across 10/50/200 = a cost
+        # per LOOP ITERATION (would also explain the matmul headline's
+        # ~2 ms/iter gap to its walls); ms/iter falling ~linearly with
+        # iters = a per-DISPATCH cost the 10-iter probe under-amortized.
+        for iters in ("10", "50", "200"):
             _run_bounded(
                 [sys.executable, "-m", "k3stpu.ops.attn_bench", "--seq",
                  "1024", "--batch", "8", "--fwd-only", "--flash-only",
