@@ -165,18 +165,29 @@ def _clamped_q_index_map(block_q: int, block_k: int, nq: int, offset: int,
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
-                  causal: bool, block_q: int, block_k: int,
+                  scale: float, causal: bool, block_q: int, block_k: int,
                   offset: int, window: "int | None", with_lse: bool):
     if with_lse:
-        lse_ref, m_ref, l_ref, acc_ref = rest
+        lse_ref, qs_ref, m_ref, l_ref, acc_ref = rest
     else:
-        lse_ref, (m_ref, l_ref, acc_ref) = None, rest
+        lse_ref, (qs_ref, m_ref, l_ref, acc_ref) = None, rest
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(ki == 0)
     def _init():
+        # Fold softmax scale AND log2(e) into the RESIDENT q tile, once
+        # per k sweep: same total multiplies as pre-scaling q in the
+        # caller, but no O(S d) HBM round-trip materializing a scaled
+        # copy outside the kernel (and one op fewer per call — the
+        # kernel receives the caller's q untouched). s then arrives in
+        # the log2 domain with no per-tile multiply owed. bf16 rounding
+        # of the scaled tile is ~0.4% relative — inside the kernel's
+        # bf16 IO tolerance (and bit-identical to what the caller-side
+        # scaling produced).
+        qs_ref[:] = (q_ref[0].astype(jnp.float32)
+                     * (scale * _LOG2E)).astype(qs_ref.dtype)
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
@@ -188,12 +199,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0]                      # (block_q, d) bf16
+        q = qs_ref[:]                     # (block_q, d) scaled, log2 domain
         k = k_ref[0]                      # (block_k, d) bf16
         v = v_ref[0]                      # (block_k, d) bf16
 
-        # The caller folded scale * log2(e) into q — s arrives in the
-        # log2 domain with no per-tile multiply owed here.
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -274,16 +283,9 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
             f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
             f"({block_q}, {block_k})")
 
-    # Fold the softmax scale AND log2(e) into q up front: one multiply
-    # over O(S d) instead of a VPU pass over every O(S^2) logits tile
-    # (the scaled q is reused across the whole k sweep), and the log2
-    # domain turns every in-kernel exp into a raw exp2. bf16 rounding of
-    # scaled q is ~0.4% relative — inside the kernel's bf16 IO tolerance.
-    q = (q.astype(jnp.float32) * (scale * _LOG2E)).astype(q.dtype)
-
     grid = (bh, s_q // block_q, s_kv // block_k)
     kernel = functools.partial(
-        _flash_kernel, causal=causal,
+        _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
         window=window, with_lse=with_lse)
 
@@ -306,6 +308,7 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=[
+            pltpu.VMEM((block_q, d), q.dtype),            # scaled q tile
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
             pltpu.VMEM((block_q, d), jnp.float32),        # output accum
