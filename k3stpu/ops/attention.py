@@ -9,6 +9,10 @@ formulation mapped onto the TPU memory hierarchy:
   innermost ("arbitrary") axis so the fp32 accumulators for one q block live
   in VMEM scratch across the whole k sweep — O(S) HBM traffic instead of the
   O(S^2) logits matrix a naive softmax writes.
+- the inference/no-lse forward reads ``(B, S, H, D)`` tensors DIRECTLY
+  (4D block specs, the head dim sliced per grid cell) — zero layout
+  transposes on the serving hot path; only the training forward folds to
+  ``(B*H, S, D)`` for the lse-residual kernels.
 - both matmuls (q@k^T and p@v) run on the MXU with fp32 accumulation
   (``preferred_element_type``); everything streamed from HBM is bf16.
 - running max/denominator are kept in (block_q, 128) fp32 scratch — the
@@ -166,11 +170,25 @@ def _clamped_q_index_map(block_q: int, block_k: int, nq: int, offset: int,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  offset: int, window: "int | None", with_lse: bool):
+                  offset: int, window: "int | None", with_lse: bool,
+                  bshd: bool = False):
     if with_lse:
         lse_ref, qs_ref, m_ref, l_ref, acc_ref = rest
     else:
         lse_ref, (qs_ref, m_ref, l_ref, acc_ref) = None, rest
+    # Layouts: "fold" blocks are (1, block, d) — read/write via [0];
+    # "bshd" blocks are (1, block, 1, d) straight off the (B, S, H, D)
+    # tensors — the singleton batch AND head dims slice away.
+    if bshd:
+        rd = lambda ref: ref[0, :, 0]
+
+        def wr(ref, val):
+            ref[0, :, 0] = val
+    else:
+        rd = lambda ref: ref[0]
+
+        def wr(ref, val):
+            ref[0] = val
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -186,7 +204,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         # of the scaled tile is ~0.4% relative — inside the kernel's
         # bf16 IO tolerance (and bit-identical to what the caller-side
         # scaling produced).
-        qs_ref[:] = (q_ref[0].astype(jnp.float32)
+        qs_ref[:] = (rd(q_ref).astype(jnp.float32)
                      * (scale * _LOG2E)).astype(qs_ref.dtype)
         m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[:] = jnp.zeros_like(l_ref)
@@ -200,8 +218,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
     @pl.when(live)
     def _update():
         q = qs_ref[:]                     # (block_q, d) scaled, log2 domain
-        k = k_ref[0]                      # (block_k, d) bf16
-        v = v_ref[0]                      # (block_k, d) bf16
+        k = rd(k_ref)                     # (block_k, d) bf16
+        v = rd(v_ref)                     # (block_k, d) bf16
 
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
@@ -245,7 +263,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
         m = m_ref[:, :1]
         l = l_ref[:, :1]
         denom = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+        wr(o_ref, (acc_ref[:] / denom).astype(o_ref.dtype))
         if with_lse:
             # m is log2-domain; convert so the emitted lse stays NATURAL
             # log (the residual layout every consumer — the backward,
@@ -266,6 +284,38 @@ def _group_of(q, k) -> int:
     return bh_q // bh_kv
 
 
+def _clamp_blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
+    """Shared block clamp + divisibility check for both forward layouts
+    (the grids floor-divide, so a non-divisor block would silently skip
+    tail rows/cols and return garbage)."""
+    block_q = min(block_q, s_q)
+    block_k = min(block_k, s_kv)
+    if s_q % block_q or s_kv % block_k:
+        raise ValueError(
+            f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
+            f"({block_q}, {block_k})")
+    return block_q, block_k
+
+
+def _fwd_scratch(block_q: int, d: int, dtype):
+    """VMEM scratch shared by both forward layouts."""
+    return [
+        pltpu.VMEM((block_q, d), dtype),              # scaled q tile
+        pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
+        pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
+        pltpu.VMEM((block_q, d), jnp.float32),        # output accum
+    ]
+
+
+def _fwd_cost(bh: int, s_q: int, s_kv: int, d: int) -> pl.CostEstimate:
+    """Scheduling cost model shared by both forward layouts."""
+    return pl.CostEstimate(
+        flops=4 * bh * s_q * s_kv * d,
+        bytes_accessed=2 * bh * (s_q + 2 * s_kv) * d,
+        transcendentals=bh * s_q * s_kv,
+    )
+
+
 def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
                    with_lse, window=None,
                    vmem_limit_bytes=32 * 1024 * 1024):
@@ -276,12 +326,7 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
     bh, s_q, d = q.shape
     s_kv = k.shape[1]
     group = _group_of(q, k)
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_kv)
-    if s_q % block_q or s_kv % block_k:
-        raise ValueError(
-            f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
-            f"({block_q}, {block_k})")
+    block_q, block_k = _clamp_blocks(s_q, s_kv, block_q, block_k)
 
     grid = (bh, s_q // block_q, s_kv // block_k)
     kernel = functools.partial(
@@ -307,21 +352,67 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
         ],
         out_specs=(o_spec, lse_spec) if with_lse else o_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
-        scratch_shapes=[
-            pltpu.VMEM((block_q, d), q.dtype),            # scaled q tile
-            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
-            pltpu.VMEM((block_q, _LANES), jnp.float32),   # running denom
-            pltpu.VMEM((block_q, d), jnp.float32),        # output accum
-        ],
+        scratch_shapes=_fwd_scratch(block_q, d, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes,
         ),
-        cost_estimate=pl.CostEstimate(
-            flops=4 * bh * s_q * s_kv * d,
-            bytes_accessed=2 * bh * (s_q + 2 * s_kv) * d,
-            transcendentals=bh * s_q * s_kv,
+        cost_estimate=_fwd_cost(bh, s_q, s_kv, d),
+        interpret=interpret,
+    )(q, k, v)
+
+
+def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
+                        interpret, window=None,
+                        vmem_limit_bytes=32 * 1024 * 1024):
+    """No-lse forward STRAIGHT off (B, S, H, D) tensors — zero layout
+    transposes. The folded path pays 4 full O(S d) HBM round-trips per
+    call (q/k/v in, o out) just rearranging memory, plus the extra ops
+    those fusions cost through the relay (docs/ATTN_ROOFLINE.md round-5:
+    measured per-op overhead is a first-order term at small S). Here the
+    grid cell (b*h, i, j) reads blocks (1, block, 1, d) directly — the
+    DMA gathers block rows of d contiguous elements strided by H*D,
+    a standard 2D strided copy. Inference/bench hot path only: the
+    training fwd needs the lse residual and keeps the folded layout."""
+    b, s_q, h, d = q.shape
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    if h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})")
+    group = h // h_kv
+    block_q, block_k = _clamp_blocks(s_q, s_kv, block_q, block_k)
+
+    grid = (b * h, s_q // block_q, s_kv // block_k)
+    kernel = functools.partial(
+        _flash_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, offset=s_kv - s_q,
+        window=window, with_lse=False, bshd=True)
+
+    q_spec = pl.BlockSpec((1, block_q, 1, d),
+                          lambda g, i, j: (g // h, i, g % h, 0))
+    # The causal/window clamp renames dead k-sweep indices exactly as in
+    # the folded path; only the (batch, head) split of the leading grid
+    # dim is layout-specific.
+    clamp = _clamped_kv_index_map(1, block_q, block_k, s_kv // block_k,
+                                  s_kv - s_q, window, causal)
+
+    def kv_map(g, i, j):
+        _, jc, _ = clamp(0, i, j)
+        return (g // h, jc, (g % h) // group, 0)
+
+    kv_spec = pl.BlockSpec((1, block_k, 1, d), kv_map)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((b, s_q, h, d), q.dtype),
+        scratch_shapes=_fwd_scratch(block_q, d, q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_bytes,
         ),
+        cost_estimate=_fwd_cost(b * h, s_q, s_kv, d),
         interpret=interpret,
     )(q, k, v)
 
@@ -628,56 +719,84 @@ _flash_bwd_spmd.def_partition(
 
 
 @functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6, 7, 8))
-def _flash_fwd_nolse_spmd(q, k, v, scale, causal, block_q, block_k,
-                          interpret, window):
-    return _flash_forward(q, k, v, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret, with_lse=False, window=window)
+def _flash_fwd_nolse_bshd_spmd(q, k, v, scale, causal, block_q, block_k,
+                               interpret, window):
+    return _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret, window=window)
 
 
-_flash_fwd_nolse_spmd.def_partition(
+_flash_fwd_nolse_bshd_spmd.def_partition(
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v:
-        _flash_forward(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                       block_k=block_k, interpret=interpret, with_lse=False,
-                       window=window)),
-    sharding_rule="b s d, b t d, b t d -> b s d",
+        _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, window=window)),
+    # batch AND heads may shard (every grid cell is independent per
+    # (b, h)); s/t/d stay whole. MHA-only on this wrapper, so q and k/v
+    # share the h factor. Factor order follows first appearance
+    # (b,s,h,d,t) — Shardy requires the special-factor indices sorted.
+    sharding_rule="b s h d, b t h d, b t h d -> b s h d",
     need_replication_factors=("s", "d", "t"),
 )
 
 
+def _fold_heads(x):
+    """(B, S, H, D) -> (B*H, S, D) — the training/backward layout."""
+    b, s, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+
+
+def _unfold_heads(x, b, h):
+    """(B*H, S, D) -> (B, S, H, D)."""
+    _, s, d = x.shape
+    return x.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    if q.shape[0] == k.shape[0]:  # MHA: the SPMD-partitionable path
-        return _flash_fwd_nolse_spmd(q, k, v, scale, causal, block_q,
-                                     block_k, interpret, window)
-    return _flash_forward(q, k, v, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret, with_lse=False,
-                          window=window)
+    """Primal = the BSHD no-lse kernel: the inference/serving hot path
+    runs with ZERO layout transposes and no lse HBM write. Under
+    jax.grad the fwd/bwd rules below run instead — they fold to the
+    (B*H, S, D) layout the lse-residual kernels use (training pays the
+    transposes; its wall is the O(S^2 d) backward kernels anyway)."""
+    if q.shape[2] == k.shape[2]:  # MHA: the SPMD-partitionable path
+        return _flash_fwd_nolse_bshd_spmd(q, k, v, scale, causal, block_q,
+                                          block_k, interpret, window)
+    return _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret, window=window)
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    if q.shape[0] == k.shape[0]:  # MHA: the SPMD-partitionable path
-        out, lse = _flash_fwd_spmd(q, k, v, scale, causal, block_q,
+    b, _, h, _ = q.shape
+    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    if h == k.shape[2]:  # MHA: the SPMD-partitionable path
+        out, lse = _flash_fwd_spmd(qf, kf, vf, scale, causal, block_q,
                                    block_k, interpret, window)
     else:
-        out, lse = _flash_forward(q, k, v, scale=scale, causal=causal,
+        out, lse = _flash_forward(qf, kf, vf, scale=scale, causal=causal,
                                   block_q=block_q, block_k=block_k,
                                   interpret=interpret, with_lse=True,
                                   window=window)
-    return out, (q, k, v, out, lse)
+    return _unfold_heads(out, b, h), (qf, kf, vf, out, lse, b, h)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
-    q, k, v, o, lse = res
-    if q.shape[0] == k.shape[0]:
-        return _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal, block_q,
-                               block_k, interpret, window)
-    return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k,
-                           interpret=interpret, window=window)
+    qf, kf, vf, o, lse, b, h = res
+    gf = _fold_heads(g)
+    if qf.shape[0] == kf.shape[0]:
+        dq, dk, dv = _flash_bwd_spmd(qf, kf, vf, o, lse, gf, scale, causal,
+                                     block_q, block_k, interpret, window)
+    else:
+        dq, dk, dv = _flash_backward(qf, kf, vf, o, lse, gf, scale=scale,
+                                     causal=causal, block_q=block_q,
+                                     block_k=block_k, interpret=interpret,
+                                     window=window)
+    h_kv = kf.shape[0] // b
+    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h_kv),
+            _unfold_heads(dv, b, h_kv))
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -708,18 +827,15 @@ def flash_attention(
     smaller tensors — nothing head-repeated is ever materialized, in either
     direction.
     """
-    b, s_q, h, d = q.shape
-    s_kv = k.shape[1]
     if scale is None:
-        scale = d ** -0.5
+        scale = q.shape[-1] ** -0.5
 
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
-        b * x.shape[2], x.shape[1], d)
-    out = _flash(fold(q), fold(k), fold(v), scale, causal,
-                 block_q, block_k, interpret, window)
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    # BSHD straight through: the inference primal never transposes (see
+    # _flash); the training rules fold internally for the lse kernels.
+    return _flash(q, k, v, scale, causal, block_q, block_k, interpret,
+                  window)
 
 
 def flash_attention_fwd_lse(
@@ -743,15 +859,13 @@ def flash_attention_fwd_lse(
     (the training path is :func:`flash_attention`).
     """
     b, s_q, h, d = q.shape
-    s_kv = k.shape[1]
     if scale is None:
         scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
-        b * x.shape[2], x.shape[1], d)
     out, lse = _flash_forward(
-        fold(q), fold(k), fold(v), scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, interpret=interpret, with_lse=True)
-    out = out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+        _fold_heads(q), _fold_heads(k), _fold_heads(v), scale=scale,
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret, with_lse=True)
+    out = _unfold_heads(out, b, h)
     lse = lse[..., 0].reshape(b, h, s_q).transpose(0, 2, 1)
     return out, lse
 
@@ -783,17 +897,14 @@ def flash_attention_bwd_shard(
     b, s_q, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(
-        b * x.shape[2], x.shape[1], d)
     lse_f = jnp.broadcast_to(
         lse.transpose(0, 2, 1).reshape(b * h, s_q, 1), (b * h, s_q, _LANES))
     dq, dk, dv = _flash_backward(
-        fold(q), fold(k), fold(v), fold(out), lse_f, fold(g),
-        scale=scale, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret)
-    unfold = lambda x, heads: x.reshape(
-        b, heads, x.shape[1], d).transpose(0, 2, 1, 3)
-    return (unfold(dq, h), unfold(dk, k.shape[2]), unfold(dv, v.shape[2]))
+        _fold_heads(q), _fold_heads(k), _fold_heads(v), _fold_heads(out),
+        lse_f, _fold_heads(g), scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, interpret=interpret)
+    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, k.shape[2]),
+            _unfold_heads(dv, b, v.shape[2]))
 
 
 def reference_attention(q, k, v, *, causal: bool = True,
@@ -809,7 +920,7 @@ def reference_attention(q, k, v, *, causal: bool = True,
         v = jnp.repeat(v, rep, axis=2)
     if scale is None:
         scale = d ** -0.5
-    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], d)
-    out = _reference_attention(fold(q), fold(k), fold(v),
-                               scale=scale, causal=causal, window=window)
-    return out.reshape(b, h, s_q, d).transpose(0, 2, 1, 3)
+    out = _reference_attention(_fold_heads(q), _fold_heads(k),
+                               _fold_heads(v), scale=scale, causal=causal,
+                               window=window)
+    return _unfold_heads(out, b, h)
