@@ -342,3 +342,15 @@ def test_spmd_flash_check_on_mesh():
                            head_dim=32)
     assert out["ok"], out
     assert out["mesh"].startswith("data:")
+
+
+def test_inference_forward_has_no_layout_transposes():
+    """The BSHD no-lse primal consumes (B, S, H, D) directly — the whole
+    point is zero layout transposes on the serving hot path (each one was
+    a full O(S d) HBM round-trip plus a fused op through the relay). A
+    regression reintroducing a fold would show up as a transpose
+    primitive in the inference jaxpr."""
+    q = k = v = jnp.zeros((2, 256, 4, 128), jnp.bfloat16)
+    jaxpr = jax.make_jaxpr(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, interpret=True))(q, k, v)
+    assert "transpose" not in str(jaxpr)
