@@ -354,3 +354,30 @@ def test_inference_forward_has_no_layout_transposes():
     jaxpr = jax.make_jaxpr(lambda q, k, v: flash_attention(
         q, k, v, causal=True, interpret=True))(q, k, v)
     assert "transpose" not in str(jaxpr)
+
+
+@pytest.mark.parametrize("kv_heads", [1, 2])
+def test_flash_gqa_with_sliding_window(kv_heads):
+    """GQA/MQA composed with a sliding window — the grouped kv index map
+    and the window's live/mask clamps interact in the BSHD forward, so
+    cover them together, fwd and grads."""
+    ks = jax.random.split(jax.random.key(23), 3)
+    q = jax.random.normal(ks[0], (1, 256, 4, 32), jnp.float32)
+    k = jax.random.normal(ks[1], (1, 256, kv_heads, 32), jnp.float32)
+    v = jax.random.normal(ks[2], (1, 256, kv_heads, 32), jnp.float32)
+
+    out = flash_attention(q, k, v, causal=True, window=96, block_q=64,
+                          block_k=64, interpret=True)
+    ref = reference_attention(q, k, v, causal=True, window=96)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+    g = jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, window=96, block_q=64, block_k=64,
+        interpret=True) ** 2), argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(
+        reference_attention(q, k, v, causal=True, window=96) ** 2),
+        argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-4, rtol=2e-4)
