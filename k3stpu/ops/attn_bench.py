@@ -27,7 +27,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
-from k3stpu.ops.attention import flash_attention, reference_attention
+from k3stpu.ops.attention import (DEFAULT_BLOCK, flash_attention,
+                                  reference_attention)
 from k3stpu.ops.matmul import _abs_sum, peak_tflops_for
 
 # The einsum reference materializes the (b*h, s, s) fp32 logits (plus softmax
@@ -112,8 +113,10 @@ def measure_attention(
     iters: int = 10,
     backward: bool = True,
     include_einsum: bool | None = None,
-    block_q: int = 512,
-    block_k: int = 512,
+    # Bench what production runs: the kernel's DEFAULT_BLOCK (the
+    # tune sweep calibrates it; committed numbers must track it).
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> list[AttnResult]:
     """Benchmark flash (and optionally einsum) attention at one S.
@@ -184,8 +187,10 @@ def check_attention(
     heads: int = 4,
     head_dim: int = 128,
     causal: bool = True,
-    block_q: int = 512,
-    block_k: int = 512,
+    # Bench what production runs: the kernel's DEFAULT_BLOCK (the
+    # tune sweep calibrates it; committed numbers must track it).
+    block_q: int = DEFAULT_BLOCK,
+    block_k: int = DEFAULT_BLOCK,
     interpret: bool = False,
 ) -> dict:
     """Compiled-flash vs einsum-oracle correctness, fwd and grads.
