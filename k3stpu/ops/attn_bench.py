@@ -50,6 +50,12 @@ class AttnResult:
     seconds: float       # median wall time for `iters` chained calls
     tflops: float        # achieved, from the causal-aware flop count
     mfu: float | None
+    # Self-describing measurement config: block sizes move (tune sweep
+    # calibrates DEFAULT_BLOCK), so every committed line must say what
+    # it ran at — harness deltas must never masquerade as kernel deltas
+    # (probe_r05 and earlier ran block 512; einsum rows carry None).
+    block_q: "int | None" = None
+    block_k: "int | None" = None
 
     def to_dict(self) -> dict:
         d = self.__dict__.copy()
@@ -177,6 +183,8 @@ def measure_attention(
                 impl=name, direction=dname, batch=batch, seq=seq,
                 heads=heads, head_dim=head_dim, causal=causal, iters=iters,
                 seconds=elapsed, tflops=tflops,
+                block_q=bq if name == "flash" else None,
+                block_k=bk if name == "flash" else None,
                 mfu=(tflops / peak) if peak else None))
     return results
 
