@@ -271,7 +271,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             # noise next to the O(S^2) passes the domain change removed.
             lse = jnp.where(l > 0.0,
                             (m + jnp.log2(denom)) * _LN2, _NEG_INF)
-            lse_ref[0] = jnp.broadcast_to(lse, lse_ref.shape[1:])
+            wr(lse_ref, jnp.broadcast_to(lse, (block_q, _LANES)))
 
 
 def _group_of(q, k) -> int:
@@ -363,17 +363,19 @@ def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
 
 
 def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
-                        interpret, window=None,
+                        interpret, with_lse=False, window=None,
                         vmem_limit_bytes=32 * 1024 * 1024):
-    """No-lse forward STRAIGHT off (B, S, H, D) tensors — zero layout
+    """Forward STRAIGHT off (B, S, H, D) tensors — zero layout
     transposes. The folded path pays 4 full O(S d) HBM round-trips per
     call (q/k/v in, o out) just rearranging memory, plus the extra ops
     those fusions cost through the relay (docs/ATTN_ROOFLINE.md round-5:
     measured per-op overhead is a first-order term at small S). Here the
     grid cell (b*h, i, j) reads blocks (1, block, 1, d) directly — the
     DMA gathers block rows of d contiguous elements strided by H*D,
-    a standard 2D strided copy. Inference/bench hot path only: the
-    training fwd needs the lse residual and keeps the folded layout."""
+    a standard 2D strided copy. Serves the inference/bench hot path
+    (no lse) and the ring/context-parallel per-shard forward (with_lse:
+    lse lands as (B, S, H, LANES) fp32, lane-replicated). The TRAINING
+    forward (custom-vjp residuals) keeps the folded layout."""
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     if h % h_kv:
@@ -386,10 +388,14 @@ def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
-        window=window, with_lse=False, bshd=True)
+        window=window, with_lse=with_lse, bshd=True)
 
     q_spec = pl.BlockSpec((1, block_q, 1, d),
                           lambda g, i, j: (g // h, i, g % h, 0))
+    o_shape = jax.ShapeDtypeStruct((b, s_q, h, d), q.dtype)
+    lse_spec = pl.BlockSpec((1, block_q, 1, _LANES),
+                            lambda g, i, j: (g // h, i, g % h, 0))
+    lse_shape = jax.ShapeDtypeStruct((b, s_q, h, _LANES), jnp.float32)
     # The causal/window clamp renames dead k-sweep indices exactly as in
     # the folded path; only the (batch, head) split of the leading grid
     # dim is layout-specific.
@@ -405,8 +411,8 @@ def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
         kernel,
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
-        out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((b, s_q, h, d), q.dtype),
+        out_specs=(q_spec, lse_spec) if with_lse else q_spec,
+        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=_fwd_scratch(block_q, d, q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
@@ -858,16 +864,15 @@ def flash_attention_fwd_lse(
     treats as a no-contribution. Forward-only — no custom VJP on this path
     (the training path is :func:`flash_attention`).
     """
-    b, s_q, h, d = q.shape
     if scale is None:
-        scale = d ** -0.5
-    out, lse = _flash_forward(
-        _fold_heads(q), _fold_heads(k), _fold_heads(v), scale=scale,
-        causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret, with_lse=True)
-    out = _unfold_heads(out, b, h)
-    lse = lse[..., 0].reshape(b, h, s_q).transpose(0, 2, 1)
-    return out, lse
+        scale = q.shape[-1] ** -0.5
+    # BSHD straight through — a ring step calls this once per K/V shard,
+    # so the four layout transposes the folded path cost are saved N
+    # times per layer per ring pass.
+    out, lse = _flash_forward_bshd(
+        q, k, v, scale=scale, causal=causal, block_q=block_q,
+        block_k=block_k, interpret=interpret, with_lse=True)
+    return out, lse[..., 0]
 
 
 def flash_attention_bwd_shard(
