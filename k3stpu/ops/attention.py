@@ -9,10 +9,11 @@ formulation mapped onto the TPU memory hierarchy:
   innermost ("arbitrary") axis so the fp32 accumulators for one q block live
   in VMEM scratch across the whole k sweep — O(S) HBM traffic instead of the
   O(S^2) logits matrix a naive softmax writes.
-- the inference/no-lse forward reads ``(B, S, H, D)`` tensors DIRECTLY
-  (4D block specs, the head dim sliced per grid cell) — zero layout
-  transposes on the serving hot path; only the training forward folds to
-  ``(B*H, S, D)`` for the lse-residual kernels.
+- EVERY kernel path reads ``(B, S, H, D)`` tensors DIRECTLY (4D block
+  specs, the head dim sliced per grid cell) — zero layout transposes
+  anywhere: inference forward, training forward+backward (natural-layout
+  residuals, lane-replicated lse), and the ring-attention per-shard
+  building blocks.
 - both matmuls (q@k^T and p@v) run on the MXU with fp32 accumulation
   (``preferred_element_type``); everything streamed from HBM is bf16.
 - running max/denominator are kept in (block_q, 128) fp32 scratch — the
@@ -28,9 +29,9 @@ formulation mapped onto the TPU memory hierarchy:
   shape is unchanged).
 
 The backward pass is also Pallas (FlashAttention-2 style): the forward
-additionally emits the per-row logsumexp (lane-replicated (bh, S, 128) fp32,
-the standard TPU residual layout), and two backward kernels recompute the
-probability tiles from (q, k, lse) — one sweeping q tiles innermost to
+additionally emits the per-row logsumexp (lane-replicated (B, S, H, 128)
+fp32, the standard TPU residual layout), and two backward kernels recompute
+the probability tiles from (q, k, lse) — one sweeping q tiles innermost to
 accumulate dK/dV per k tile, one sweeping k tiles innermost to accumulate dQ
 per q tile. Nothing O(S^2) is ever materialized in HBM in either direction;
 the einsum attention below remains as the gradient oracle for tests.
@@ -170,25 +171,17 @@ def _clamped_q_index_map(block_q: int, block_k: int, nq: int, offset: int,
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
                   scale: float, causal: bool, block_q: int, block_k: int,
-                  offset: int, window: "int | None", with_lse: bool,
-                  bshd: bool = False):
+                  offset: int, window: "int | None", with_lse: bool):
     if with_lse:
         lse_ref, qs_ref, m_ref, l_ref, acc_ref = rest
     else:
         lse_ref, (qs_ref, m_ref, l_ref, acc_ref) = None, rest
-    # Layouts: "fold" blocks are (1, block, d) — read/write via [0];
-    # "bshd" blocks are (1, block, 1, d) straight off the (B, S, H, D)
-    # tensors — the singleton batch AND head dims slice away.
-    if bshd:
-        rd = lambda ref: ref[0, :, 0]
+    # Blocks are (1, block, 1, d) straight off the (B, S, H, D) tensors —
+    # the singleton batch AND head dims slice away.
+    rd = lambda ref: ref[0, :, 0]
 
-        def wr(ref, val):
-            ref[0, :, 0] = val
-    else:
-        rd = lambda ref: ref[0]
-
-        def wr(ref, val):
-            ref[0] = val
+    def wr(ref, val):
+        ref[0, :, 0] = val
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -274,20 +267,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest,
             wr(lse_ref, jnp.broadcast_to(lse, (block_q, _LANES)))
 
 
-def _group_of(q, k) -> int:
-    """Query-heads-per-KV-head ratio from the FOLDED (b*h, s, d) shapes —
-    GQA/MQA share one K/V head across `group` consecutive query heads."""
-    bh_q, bh_kv = q.shape[0], k.shape[0]
-    if bh_q % bh_kv:
-        raise ValueError(
-            f"query heads ({bh_q}) must be a multiple of kv heads ({bh_kv})")
-    return bh_q // bh_kv
-
-
 def _clamp_blocks(s_q: int, s_kv: int, block_q: int, block_k: int):
-    """Shared block clamp + divisibility check for both forward layouts
-    (the grids floor-divide, so a non-divisor block would silently skip
-    tail rows/cols and return garbage)."""
+    """Shared block clamp + divisibility check (the grids floor-divide,
+    so a non-divisor block would silently skip tail rows/cols and
+    return garbage)."""
     block_q = min(block_q, s_q)
     block_k = min(block_k, s_kv)
     if s_q % block_q or s_kv % block_k:
@@ -316,52 +299,6 @@ def _fwd_cost(bh: int, s_q: int, s_kv: int, d: int) -> pl.CostEstimate:
     )
 
 
-def _flash_forward(q, k, v, *, scale, causal, block_q, block_k, interpret,
-                   with_lse, window=None,
-                   vmem_limit_bytes=32 * 1024 * 1024):
-    """Returns (o, lse) when with_lse (the training path needs the residual)
-    else just o — the inference hot path skips the lse HBM write entirely.
-    GQA: k/v may carry fewer folded heads; grid cell b reads kv block
-    b // group (no repeat is ever materialized)."""
-    bh, s_q, d = q.shape
-    s_kv = k.shape[1]
-    group = _group_of(q, k)
-    block_q, block_k = _clamp_blocks(s_q, s_kv, block_q, block_k)
-
-    grid = (bh, s_q // block_q, s_kv // block_k)
-    kernel = functools.partial(
-        _flash_kernel, scale=scale, causal=causal,
-        block_q=block_q, block_k=block_k, offset=s_kv - s_q,
-        window=window, with_lse=with_lse)
-
-    o_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    o_shape = jax.ShapeDtypeStruct((bh, s_q, d), q.dtype)
-    lse_spec = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
-    lse_shape = jax.ShapeDtypeStruct((bh, s_q, _LANES), jnp.float32)
-
-    kv_map = _clamped_kv_index_map(group, block_q, block_k,
-                                   s_kv // block_k, s_kv - s_q, window,
-                                   causal)
-    return pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), kv_map),
-            pl.BlockSpec((1, block_k, d), kv_map),
-        ],
-        out_specs=(o_spec, lse_spec) if with_lse else o_spec,
-        out_shape=(o_shape, lse_shape) if with_lse else o_shape,
-        scratch_shapes=_fwd_scratch(block_q, d, q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-            vmem_limit_bytes=vmem_limit_bytes,
-        ),
-        cost_estimate=_fwd_cost(bh, s_q, s_kv, d),
-        interpret=interpret,
-    )(q, k, v)
-
-
 def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
                         interpret, with_lse=False, window=None,
                         vmem_limit_bytes=32 * 1024 * 1024):
@@ -374,8 +311,8 @@ def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
     DMA gathers block rows of d contiguous elements strided by H*D,
     a standard 2D strided copy. Serves the inference/bench hot path
     (no lse) and the ring/context-parallel per-shard forward (with_lse:
-    lse lands as (B, S, H, LANES) fp32, lane-replicated). The TRAINING
-    forward (custom-vjp residuals) keeps the folded layout."""
+    lse lands as (B, S, H, LANES) fp32, lane-replicated — the residual
+    layout the training rules and the BSHD backward share)."""
     b, s_q, h, d = q.shape
     s_kv, h_kv = k.shape[1], k.shape[2]
     if h % h_kv:
@@ -388,7 +325,7 @@ def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
     kernel = functools.partial(
         _flash_kernel, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, offset=s_kv - s_q,
-        window=window, with_lse=with_lse, bshd=True)
+        window=window, with_lse=with_lse)
 
     q_spec = pl.BlockSpec((1, block_q, 1, d),
                           lambda g, i, j: (g // h, i, g % h, 0))
@@ -449,6 +386,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                     *, scale: float, causal: bool, block_q: int,
                     block_k: int, offset: int, window: "int | None"):
     """Accumulate dK/dV for one k tile across the q sweep (innermost)."""
+    rd = lambda ref: ref[0, :, 0]
+
+    def wr(ref, val):
+        ref[0, :, 0] = val
     ki = pl.program_id(1)
     qi = pl.program_id(2)
     nq = pl.num_programs(2)
@@ -464,15 +405,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0]                       # (block_q, d)
-        k = k_ref[0]                       # (block_k, d)
-        v = v_ref[0]                       # (block_k, d)
-        do = do_ref[0]                     # (block_q, d)
+        q = rd(q_ref)                      # (block_q, d)
+        k = rd(k_ref)                      # (block_k, d)
+        v = rd(v_ref)                      # (block_k, d)
+        do = rd(do_ref)                    # (block_q, d)
         # Fully-masked rows carry -inf lse; substitute 0 so the (already
         # -inf-masked) logits still produce p == 0, not nan.
-        lse = lse_ref[0][:, :1]            # (block_q, 1) fp32
+        lse = rd(lse_ref)[:, :1]           # (block_q, 1) fp32
         lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
-        di = di_ref[0][:, :1]              # (block_q, 1) fp32
+        di = rd(di_ref)[:, :1]             # (block_q, 1) fp32
 
         # Log2-domain recompute: the s multiply is paid either way, so
         # scale carries log2(e) too and p comes from a raw exp2 against
@@ -502,8 +443,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(qi == nq - 1)
     def _finalize():
-        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
-        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+        wr(dk_ref, dk_acc[:].astype(dk_ref.dtype))
+        wr(dv_ref, dv_acc[:].astype(dv_ref.dtype))
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
@@ -511,6 +452,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
                    *, scale: float, causal: bool, block_q: int,
                    block_k: int, offset: int, window: "int | None"):
     """Accumulate dQ for one q tile across the k sweep (innermost)."""
+    rd = lambda ref: ref[0, :, 0]
     qi = pl.program_id(1)
     ki = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -525,13 +467,13 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(live)
     def _update():
-        q = q_ref[0]
-        k = k_ref[0]
-        v = v_ref[0]
-        do = do_ref[0]
-        lse = lse_ref[0][:, :1]
+        q = rd(q_ref)
+        k = rd(k_ref)
+        v = rd(v_ref)
+        do = rd(do_ref)
+        lse = rd(lse_ref)[:, :1]
         lse = jnp.where(lse > _NEG_INF / 2, lse, 0.0)
-        di = di_ref[0][:, :1]
+        di = rd(di_ref)[:, :1]
 
         # Same log2-domain recompute as the dK/dV kernel.
         s = jax.lax.dot_general(
@@ -553,56 +495,61 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, di_ref,
 
     @pl.when(ki == nk - 1)
     def _finalize():
-        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+        dq_ref[0, :, 0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
-                    interpret, window=None,
-                    vmem_limit_bytes=32 * 1024 * 1024):
-    bh, s_q, d = q.shape
-    s_kv = k.shape[1]
-    group = _group_of(q, k)
-    block_q = min(block_q, s_q)
-    block_k = min(block_k, s_kv)
-    if s_q % block_q or s_kv % block_k:
-        # Same check as the forward: the grids floor-divide, so a
-        # non-divisor block would silently skip the tail rows/cols and
-        # return garbage gradients instead of an error.
+def _flash_backward_bshd(q, k, v, o, lse, g, *, scale, causal, block_q,
+                         block_k, interpret, window=None,
+                         vmem_limit_bytes=32 * 1024 * 1024):
+    """Backward STRAIGHT off (B, S, H, D) tensors — the BSHD counterpart
+    of the folded backward, same two kernels through 4D block specs.
+    ``lse``: natural-log, lane-replicated (B, S_q, H, LANES) fp32 (the
+    with_lse forward's output). GQA: dK/dV accumulate per QUERY head (no
+    cross-cell write races on a shared kv head) and fold onto the kv
+    heads after — consecutive ``group`` q heads share kv head
+    ``h // group``, so the fold is a reshape-sum on the H axis."""
+    b, s_q, h, d = q.shape
+    s_kv, h_kv = k.shape[1], k.shape[2]
+    if h % h_kv:
         raise ValueError(
-            f"seq lengths ({s_q}, {s_kv}) must divide block sizes "
-            f"({block_q}, {block_k})")
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})")
+    group = h // h_kv
+    block_q, block_k = _clamp_blocks(s_q, s_kv, block_q, block_k)
     offset = s_kv - s_q
 
-    # di = rowsum(dO * O) — O(S d) elementwise; XLA fuses it. Replicated to
-    # the standard 128-lane residual layout.
+    # di = rowsum(dO * O) — O(S d) elementwise in the natural layout; XLA
+    # fuses it. Lane-replicated like the lse residual.
     di = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
-    di = jnp.broadcast_to(di[..., None], (bh, s_q, _LANES))
-
-    # The natural-log residual converts to the kernels' log2 domain ONCE
-    # here (O(S) elementwise) so every O(S^2) p-recompute is a raw exp2.
-    # -inf rows scale to a bigger -inf: the kernels' fully-masked guard
-    # (lse > _NEG_INF/2) still catches them.
+    di = jnp.broadcast_to(di[..., None], (b, s_q, h, _LANES))
+    # ``lse`` arrives natural-log, lane-replicated (B, S_q, H, LANES) —
+    # exactly what the with_lse forward emits, so training residuals
+    # pass through untouched. Convert to the kernels' log2 domain once.
     lse = lse * _LOG2E
 
-    # Dead q iterations for a k tile (tiles above the diagonal sweep first)
-    # are clamped onto the first live q tile so their DMAs are elided.
-    q_map = _clamped_q_index_map(block_q, block_k, s_q // block_q, offset,
-                                 window, causal)
-    q_spec = pl.BlockSpec((1, block_q, d), q_map)
-    r_spec = pl.BlockSpec((1, block_q, _LANES), q_map)
-    kv_spec = pl.BlockSpec((1, block_k, d),
-                           lambda b, i, j: (b // group, i, 0))
-    # GQA: each grid cell owns ONE query head, so dK/dV land per-q-head
-    # (no cross-cell write races on the shared kv head) and the group-sum
-    # below folds them onto the kv heads.
-    dkv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))
-    dkv_shape = (bh, s_kv, d)
     common = dict(scale=scale, causal=causal, block_q=block_q,
                   block_k=block_k, offset=offset, window=window)
 
+    # dK/dV: k-resident, q sweep innermost; dead q iterations clamp onto
+    # the first live q tile so their DMAs are elided.
+    q_clamp = _clamped_q_index_map(block_q, block_k, s_q // block_q,
+                                   offset, window, causal)
+
+    def q_map(gi, i, j):
+        _, jc, _ = q_clamp(0, i, j)
+        return (gi // h, jc, gi % h, 0)
+
+    q_spec = pl.BlockSpec((1, block_q, 1, d), q_map)
+    r_spec = pl.BlockSpec((1, block_q, 1, _LANES), q_map)
+    kv_spec = pl.BlockSpec((1, block_k, 1, d),
+                           lambda gi, i, j: (gi // h, i, (gi % h) // group,
+                                             0))
+    dkv_spec = pl.BlockSpec((1, block_k, 1, d),
+                            lambda gi, i, j: (gi // h, i, gi % h, 0))
+    dkv_shape = (b, s_kv, h, d)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
-        grid=(bh, s_kv // block_k, s_q // block_q),
+        grid=(b * h, s_kv // block_k, s_q // block_q),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, r_spec, r_spec],
         out_specs=(dkv_spec, dkv_spec),
         out_shape=(jax.ShapeDtypeStruct(dkv_shape, k.dtype),
@@ -613,29 +560,35 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes),
         cost_estimate=pl.CostEstimate(
-            flops=8 * bh * s_q * s_kv * d,
-            bytes_accessed=2 * bh * (2 * s_q + 2 * s_kv) * d,
-            transcendentals=bh * s_q * s_kv),
+            flops=8 * b * h * s_q * s_kv * d,
+            bytes_accessed=2 * b * h * (2 * s_q + 2 * s_kv) * d,
+            transcendentals=b * h * s_q * s_kv),
         interpret=interpret,
     )(q, k, v, g, lse, di)
     if group > 1:
-        # Fold the per-q-head partials onto shared kv heads: consecutive
-        # `group` q heads read kv head bh // group, so the reduction is a
-        # contiguous reshape-sum (fp32 accumulation).
-        fold_g = lambda x: x.reshape(bh // group, group, s_kv, d).astype(
-            jnp.float32).sum(axis=1)
+        fold_g = lambda x: x.reshape(b, s_kv, h_kv, group, d).astype(
+            jnp.float32).sum(axis=3)
         dk = fold_g(dk).astype(k.dtype)
         dv = fold_g(dv).astype(v.dtype)
 
-    q_spec2 = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
-    r_spec2 = pl.BlockSpec((1, block_q, _LANES), lambda b, i, j: (b, i, 0))
-    kv_map2 = _clamped_kv_index_map(group, block_q, block_k,
-                                    s_kv // block_k, offset, window, causal)
-    kv_spec2 = pl.BlockSpec((1, block_k, d), kv_map2)
+    # dQ: q-resident, k sweep innermost; dead k iterations clamp like
+    # the forward.
+    q_spec2 = pl.BlockSpec((1, block_q, 1, d),
+                           lambda gi, i, j: (gi // h, i, gi % h, 0))
+    r_spec2 = pl.BlockSpec((1, block_q, 1, _LANES),
+                           lambda gi, i, j: (gi // h, i, gi % h, 0))
+    kv_clamp = _clamped_kv_index_map(1, block_q, block_k, s_kv // block_k,
+                                     offset, window, causal)
+
+    def kv_map2(gi, i, j):
+        _, jc, _ = kv_clamp(0, i, j)
+        return (gi // h, jc, (gi % h) // group, 0)
+
+    kv_spec2 = pl.BlockSpec((1, block_k, 1, d), kv_map2)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
-        grid=(bh, s_q // block_q, s_kv // block_k),
+        grid=(b * h, s_q // block_q, s_kv // block_k),
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, r_spec2, r_spec2],
         out_specs=q_spec2,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
@@ -644,9 +597,9 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes),
         cost_estimate=pl.CostEstimate(
-            flops=4 * bh * s_q * s_kv * d,
-            bytes_accessed=2 * bh * (2 * s_q + 2 * s_kv) * d,
-            transcendentals=bh * s_q * s_kv),
+            flops=4 * b * h * s_q * s_kv * d,
+            bytes_accessed=2 * b * h * (2 * s_q + 2 * s_kv) * d,
+            transcendentals=b * h * s_q * s_kv),
         interpret=interpret,
     )(q, k, v, g, lse, di)
 
@@ -657,13 +610,14 @@ def _flash_backward(q, k, v, o, lse, g, *, scale, causal, block_q, block_k,
 #
 # The Mosaic custom call has no built-in GSPMD rule, so under pjit a bare
 # pallas_call forces replication (or an error). custom_partitioning teaches
-# XLA the rule the math implies: the folded (b*h, s, d) tensors may split
-# on dim 0 (batch x heads — data/tensor parallelism; each grid cell is
-# already independent per b*h), while s/t/d must stay whole (splitting the
-# sequence is ring attention's job — parallel/context.py — not a local
-# kernel's). The per-shard body is the same single-device kernel on the
-# shard's shapes. MHA-only (q and k/v share dim-0 size, one Shardy factor);
-# GQA under a mesh keeps the einsum path (models/transformer.py gates).
+# XLA the rule the math implies: the (B, S, H, D) tensors may split on
+# batch AND heads INDEPENDENTLY (data/tensor parallelism — every grid cell
+# is already independent per (b, h)), while s/t/d (and the lse lane dim)
+# must stay whole (splitting the sequence is ring attention's job —
+# parallel/context.py — not a local kernel's). The per-shard body is the
+# same single-device kernel on the shard's shapes. MHA-only (q and k/v
+# share the h factor); GQA under a mesh keeps the einsum path
+# (models/transformer.py gates).
 
 
 def _cp_partition(make_lower):
@@ -685,19 +639,21 @@ def _cp_partition(make_lower):
 @functools.partial(custom_partitioning, static_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_fwd_spmd(q, k, v, scale, causal, block_q, block_k, interpret,
                     window):
-    return _flash_forward(q, k, v, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k,
-                          interpret=interpret, with_lse=True, window=window)
+    return _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               interpret=interpret, with_lse=True,
+                               window=window)
 
 
 _flash_fwd_spmd.def_partition(
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v:
-        _flash_forward(q, k, v, scale=scale, causal=causal, block_q=block_q,
-                       block_k=block_k, interpret=interpret, with_lse=True,
-                       window=window)),
-    sharding_rule="b s d, b t d, b t d -> b s d, b s l",
+        _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                            block_q=block_q, block_k=block_k,
+                            interpret=interpret, with_lse=True,
+                            window=window)),
+    sharding_rule="b s h d, b t h d, b t h d -> b s h d, b s h l",
     need_replication_factors=("s", "d", "t", "l"),
 )
 
@@ -706,20 +662,22 @@ _flash_fwd_spmd.def_partition(
                    static_argnums=(6, 7, 8, 9, 10, 11))
 def _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                     interpret, window):
-    return _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
-                           block_q=block_q, block_k=block_k,
-                           interpret=interpret, window=window)
+    return _flash_backward_bshd(q, k, v, o, lse, g, scale=scale,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k, interpret=interpret,
+                                window=window)
 
 
 _flash_bwd_spmd.def_partition(
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v, o, lse, g:
-        _flash_backward(q, k, v, o, lse, g, scale=scale, causal=causal,
-                        block_q=block_q, block_k=block_k,
-                        interpret=interpret, window=window)),
-    sharding_rule=("b s d, b t d, b t d, b s d, b s l, b s d "
-                   "-> b s d, b t d, b t d"),
+        _flash_backward_bshd(q, k, v, o, lse, g, scale=scale,
+                             causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret,
+                             window=window)),
+    sharding_rule=("b s h d, b t h d, b t h d, b s h d, b s h l, b s h d "
+                   "-> b s h d, b t h d, b t h d"),
     need_replication_factors=("s", "d", "t", "l"),
 )
 
@@ -764,9 +722,9 @@ def _unfold_heads(x, b, h):
 def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
     """Primal = the BSHD no-lse kernel: the inference/serving hot path
     runs with ZERO layout transposes and no lse HBM write. Under
-    jax.grad the fwd/bwd rules below run instead — they fold to the
-    (B*H, S, D) layout the lse-residual kernels use (training pays the
-    transposes; its wall is the O(S^2 d) backward kernels anyway)."""
+    jax.grad the fwd/bwd rules below run instead — also BSHD end to end
+    (natural-layout residuals, lane-replicated lse), so training pays no
+    layout transposes either."""
     if q.shape[2] == k.shape[2]:  # MHA: the SPMD-partitionable path
         return _flash_fwd_nolse_bshd_spmd(q, k, v, scale, causal, block_q,
                                           block_k, interpret, window)
@@ -776,33 +734,26 @@ def _flash(q, k, v, scale, causal, block_q, block_k, interpret, window):
 
 
 def _flash_fwd(q, k, v, scale, causal, block_q, block_k, interpret, window):
-    b, _, h, _ = q.shape
-    qf, kf, vf = _fold_heads(q), _fold_heads(k), _fold_heads(v)
-    if h == k.shape[2]:  # MHA: the SPMD-partitionable path
-        out, lse = _flash_fwd_spmd(qf, kf, vf, scale, causal, block_q,
+    if q.shape[2] == k.shape[2]:  # MHA: the SPMD-partitionable path
+        out, lse = _flash_fwd_spmd(q, k, v, scale, causal, block_q,
                                    block_k, interpret, window)
     else:
-        out, lse = _flash_forward(qf, kf, vf, scale=scale, causal=causal,
-                                  block_q=block_q, block_k=block_k,
-                                  interpret=interpret, with_lse=True,
-                                  window=window)
-    return _unfold_heads(out, b, h), (qf, kf, vf, out, lse, b, h)
+        out, lse = _flash_forward_bshd(
+            q, k, v, scale=scale, causal=causal, block_q=block_q,
+            block_k=block_k, interpret=interpret, with_lse=True,
+            window=window)
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd(scale, causal, block_q, block_k, interpret, window, res, g):
-    qf, kf, vf, o, lse, b, h = res
-    gf = _fold_heads(g)
-    if qf.shape[0] == kf.shape[0]:
-        dq, dk, dv = _flash_bwd_spmd(qf, kf, vf, o, lse, gf, scale, causal,
-                                     block_q, block_k, interpret, window)
-    else:
-        dq, dk, dv = _flash_backward(qf, kf, vf, o, lse, gf, scale=scale,
-                                     causal=causal, block_q=block_q,
-                                     block_k=block_k, interpret=interpret,
-                                     window=window)
-    h_kv = kf.shape[0] // b
-    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, h_kv),
-            _unfold_heads(dv, b, h_kv))
+    q, k, v, o, lse = res
+    if q.shape[2] == k.shape[2]:
+        return _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal,
+                               block_q, block_k, interpret, window)
+    return _flash_backward_bshd(q, k, v, o, lse, g, scale=scale,
+                                causal=causal, block_q=block_q,
+                                block_k=block_k, interpret=interpret,
+                                window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -838,8 +789,8 @@ def flash_attention(
 
     if window is not None and not causal:
         raise ValueError("window requires causal=True")
-    # BSHD straight through: the inference primal never transposes (see
-    # _flash); the training rules fold internally for the lse kernels.
+    # BSHD straight through: no flash path transposes — inference
+    # primal, training fwd/bwd, all on 4D block specs (see _flash).
     return _flash(q, k, v, scale, causal, block_q, block_k, interpret,
                   window)
 
@@ -902,14 +853,12 @@ def flash_attention_bwd_shard(
     b, s_q, h, d = q.shape
     if scale is None:
         scale = d ** -0.5
-    lse_f = jnp.broadcast_to(
-        lse.transpose(0, 2, 1).reshape(b * h, s_q, 1), (b * h, s_q, _LANES))
-    dq, dk, dv = _flash_backward(
-        _fold_heads(q), _fold_heads(k), _fold_heads(v), _fold_heads(out),
-        lse_f, _fold_heads(g), scale=scale, causal=causal,
+    # The ring merge hands (B, S_q, H); replicate to the lane layout the
+    # BSHD backward shares with the training residuals.
+    lse_f = jnp.broadcast_to(lse[..., None], (b, s_q, h, _LANES))
+    return _flash_backward_bshd(
+        q, k, v, out, lse_f, g, scale=scale, causal=causal,
         block_q=block_q, block_k=block_k, interpret=interpret)
-    return (_unfold_heads(dq, b, h), _unfold_heads(dk, b, k.shape[2]),
-            _unfold_heads(dv, b, v.shape[2]))
 
 
 def reference_attention(q, k, v, *, causal: bool = True,

@@ -344,16 +344,20 @@ def test_spmd_flash_check_on_mesh():
     assert out["mesh"].startswith("data:")
 
 
-def test_inference_forward_has_no_layout_transposes():
-    """The BSHD no-lse primal consumes (B, S, H, D) directly — the whole
-    point is zero layout transposes on the serving hot path (each one was
-    a full O(S d) HBM round-trip plus a fused op through the relay). A
-    regression reintroducing a fold would show up as a transpose
-    primitive in the inference jaxpr."""
+def test_flash_has_no_layout_transposes():
+    """Every flash path consumes (B, S, H, D) directly — zero layout
+    transposes (each one was a full O(S d) HBM round-trip plus a fused
+    op through the relay): the no-lse inference primal AND the training
+    forward+backward (natural-layout residuals). A regression
+    reintroducing a fold shows up as a transpose primitive."""
     q = k = v = jnp.zeros((2, 256, 4, 128), jnp.bfloat16)
     jaxpr = jax.make_jaxpr(lambda q, k, v: flash_attention(
         q, k, v, causal=True, interpret=True))(q, k, v)
     assert "transpose" not in str(jaxpr)
+    gj = jax.make_jaxpr(jax.grad(lambda q, k, v: jnp.sum(flash_attention(
+        q, k, v, causal=True, interpret=True).astype(jnp.float32) ** 2),
+        argnums=(0, 1, 2)))(q, k, v)
+    assert "transpose" not in str(gj)
 
 
 @pytest.mark.parametrize("kv_heads", [1, 2])
