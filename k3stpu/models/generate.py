@@ -35,6 +35,25 @@ def init_cache(model, batch: int):
                         shapes["cache"])
 
 
+def paged_model(model, *, num_pages: int, page_size: int):
+    """The same LM with its decode/extend cache re-homed into a paged
+    pool (cfg.kv_pages doc in models/transformer.py). Params are
+    untouched — page geometry only changes the cache collection — so one
+    trained tree serves both the dense and the paged engine. Handles the
+    MoE config's ``.base`` nesting."""
+    import dataclasses
+
+    cfg = model.config
+    if hasattr(cfg, "base"):
+        new_cfg = dataclasses.replace(
+            cfg, base=dataclasses.replace(
+                cfg.base, kv_pages=num_pages, kv_page_size=page_size))
+    else:
+        new_cfg = dataclasses.replace(cfg, kv_pages=num_pages,
+                                      kv_page_size=page_size)
+    return type(model)(new_cfg)
+
+
 def set_cache_index(cache, new_idx: jax.Array):
     """Rewrite every layer's per-row cache index (B,) — rollback/advance.
 

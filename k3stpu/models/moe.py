@@ -158,13 +158,14 @@ class MoeBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, *, mode: str = "full", seq_lens=None,
-                 adapter_ids=None):
+                 adapter_ids=None, block_tables=None):
         base = self.config.base
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
         x = x + Attention(base, name="attn")(h, mode=mode,
                                               seq_lens=seq_lens,
-                                              adapter_ids=adapter_ids)
+                                              adapter_ids=adapter_ids,
+                                              block_tables=block_tables)
         h = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         # Adapters ride the attention/dense projections only: the routed
@@ -180,7 +181,7 @@ class MoeTransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, mode: str = "full",
-                 seq_lens=None, adapter_ids=None):
+                 seq_lens=None, adapter_ids=None, block_tables=None):
         del train
         cfg, base = self.config, self.config.base
         embed = nn.Embed(base.vocab_size, base.d_model,
@@ -192,10 +193,11 @@ class MoeTransformerLM(nn.Module):
             if use_moe:
                 x = MoeBlock(cfg, name=f"block{i}")(x, mode=mode,
                                                     seq_lens=seq_lens,
-                                                    adapter_ids=adapter_ids)
+                                                    adapter_ids=adapter_ids,
+                                                    block_tables=block_tables)
             else:  # identical param tree to the dense LM's blocks
                 x = Block(base, name=f"block{i}")(x, mode, seq_lens,
-                                                  adapter_ids)
+                                                  adapter_ids, block_tables)
         x = nn.LayerNorm(dtype=base.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         return embed.attend(x).astype(jnp.float32)

@@ -62,6 +62,19 @@ class TransformerConfig:
     # context length x batch a serving chip can hold; the dequant fuses
     # into the decode attention's operand read. Orthogonal to `quant`.
     kv_cache_dtype: "str | None" = None
+    # None | int: PAGED KV cache (the vLLM/PagedAttention layout). With
+    # ``kv_pages = N`` every layer's decode/extend cache is one shared
+    # pool of N fixed-size pages, (N, kv_page_size, kv_heads, head_dim),
+    # instead of per-row (B, max_seq_len, ...) strips; each batch row
+    # addresses its pages through the ``block_tables`` call argument,
+    # (B, max_seq_len // kv_page_size) int32 of page ids — traced data,
+    # so one compiled program serves every page assignment. Page 0 is
+    # the reserved sink: rows with nothing at a table slot point it at 0,
+    # and the position mask keeps whatever lands there invisible.
+    # Decode/extend only — prefill stays dense (the serving engine
+    # prefills into a small dense cache and packs pages host-side).
+    kv_pages: "int | None" = None
+    kv_page_size: int = 16
     # None | int: LoRA rank. Adds trainable low-rank adapters (lora_a,
     # lora_b) beside every projection kernel; models/lora.py provides the
     # frozen-base optimizer mask and the merge-for-serving transform.
@@ -189,13 +202,22 @@ class Attention(nn.Module):
       window with a position mask. TPU-first: the cache is a static-shape
       (B, max_seq_len, H, D) buffer updated with ``dynamic_update_slice``,
       so the whole decode step is one fixed XLA program for lax.scan.
+
+    Under ``cfg.kv_pages`` the decode/extend cache is PAGED: one
+    (kv_pages, kv_page_size, H, D) pool per layer, addressed through the
+    ``block_tables`` argument — (B, max_seq_len // kv_page_size) int32
+    page ids, traced data. Writes scatter into ``block_tables[r,
+    pos // page_size]`` at slot ``pos % page_size``; reads gather the
+    row's pages back into the (B, max_seq_len, H, D) view the dense path
+    attends over, so the masked-softmax arithmetic — and therefore every
+    sampled token — is bit-identical to the dense cache's.
     """
 
     config: TransformerConfig
 
     @nn.compact
     def __call__(self, x, *, mode: str = "full", seq_lens=None,
-                 adapter_ids=None):
+                 adapter_ids=None, block_tables=None):
         cfg = self.config
         b, s, _ = x.shape
         head_dim = cfg.d_model // cfg.n_heads
@@ -251,23 +273,54 @@ class Attention(nn.Module):
 
             return dequantize_absmax(x8, s, axis=-1).astype(cfg.dtype)
 
+        paged = cfg.kv_pages is not None
+        if paged:
+            if cfg.kv_page_size < 1 \
+                    or cfg.max_seq_len % cfg.kv_page_size:
+                raise ValueError(
+                    f"kv_page_size {cfg.kv_page_size} must divide "
+                    f"max_seq_len {cfg.max_seq_len}")
+            if cfg.kv_pages < 2:
+                raise ValueError(f"kv_pages {cfg.kv_pages} needs the sink "
+                                 f"page 0 plus at least one usable page")
+
         if mode in ("prefill", "decode", "extend"):
             # GQA shrinks the cache by n_heads/kv_heads — the whole point;
             # int8 storage halves it again (scales are D/4x smaller still).
             store_dtype = jnp.int8 if kv_int8 else cfg.dtype
-            cache_k = self.variable(
-                "cache", "key", jnp.zeros,
-                (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
-            cache_v = self.variable(
-                "cache", "value", jnp.zeros,
-                (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
-            if kv_int8:
-                scale_k = self.variable(
-                    "cache", "key_scale", jnp.zeros,
-                    (b, cfg.max_seq_len, kv_heads), jnp.float32)
-                scale_v = self.variable(
-                    "cache", "value_scale", jnp.zeros,
-                    (b, cfg.max_seq_len, kv_heads), jnp.float32)
+            if paged:
+                if mode == "prefill":
+                    raise ValueError(
+                        "paged cache has no prefill path — prefill into a "
+                        "dense cache and pack pages (serve/engine.py)")
+                ps = cfg.kv_page_size
+                cache_k = self.variable(
+                    "cache", "key_pages", jnp.zeros,
+                    (cfg.kv_pages, ps, kv_heads, head_dim), store_dtype)
+                cache_v = self.variable(
+                    "cache", "value_pages", jnp.zeros,
+                    (cfg.kv_pages, ps, kv_heads, head_dim), store_dtype)
+                if kv_int8:
+                    scale_k = self.variable(
+                        "cache", "key_scale_pages", jnp.zeros,
+                        (cfg.kv_pages, ps, kv_heads), jnp.float32)
+                    scale_v = self.variable(
+                        "cache", "value_scale_pages", jnp.zeros,
+                        (cfg.kv_pages, ps, kv_heads), jnp.float32)
+            else:
+                cache_k = self.variable(
+                    "cache", "key", jnp.zeros,
+                    (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
+                cache_v = self.variable(
+                    "cache", "value", jnp.zeros,
+                    (b, cfg.max_seq_len, kv_heads, head_dim), store_dtype)
+                if kv_int8:
+                    scale_k = self.variable(
+                        "cache", "key_scale", jnp.zeros,
+                        (b, cfg.max_seq_len, kv_heads), jnp.float32)
+                    scale_v = self.variable(
+                        "cache", "value_scale", jnp.zeros,
+                        (b, cfg.max_seq_len, kv_heads), jnp.float32)
             cache_idx = self.variable(
                 "cache", "index", lambda: jnp.zeros((b,), jnp.int32))
 
@@ -293,7 +346,39 @@ class Attention(nn.Module):
             pos_angles = angles[woffs]                      # (b, s, d/2)
             q = apply_rope_rows(q, pos_angles)
             k = apply_rope_rows(k, pos_angles)
-            if kv_int8:
+            if paged:
+                # Page-id scatter/gather around the SAME rope/mask/einsum
+                # arithmetic as the dense branch. A row with no page at a
+                # table slot points at the sink page 0; whatever lands
+                # there is junk at masked positions — never visible.
+                ps = cfg.kv_page_size
+                n_bt = cfg.max_seq_len // ps
+                if block_tables is None:  # init / eval_shape path only
+                    block_tables = jnp.zeros((b, n_bt), jnp.int32)
+                bt = jnp.asarray(block_tables, jnp.int32)
+                pid = jnp.take_along_axis(bt, woffs // ps, axis=1)  # (b,s)
+                sip = woffs % ps                           # slot in page
+                gshape = (b, cfg.max_seq_len, kv_heads, head_dim)
+                if kv_int8:
+                    k8, ks = kv_quant(k)
+                    v8, vs = kv_quant(v)
+                    ck8 = cache_k.value.at[pid, sip].set(k8)
+                    cv8 = cache_v.value.at[pid, sip].set(v8)
+                    ksc = scale_k.value.at[pid, sip].set(ks)
+                    vsc = scale_v.value.at[pid, sip].set(vs)
+                    cache_k.value, cache_v.value = ck8, cv8
+                    scale_k.value, scale_v.value = ksc, vsc
+                    ck = kv_dequant(ck8[bt].reshape(gshape),
+                                    ksc[bt].reshape(gshape[:3]))
+                    cv = kv_dequant(cv8[bt].reshape(gshape),
+                                    vsc[bt].reshape(gshape[:3]))
+                else:
+                    pk = cache_k.value.at[pid, sip].set(k.astype(cfg.dtype))
+                    pv = cache_v.value.at[pid, sip].set(v.astype(cfg.dtype))
+                    cache_k.value, cache_v.value = pk, pv
+                    ck = pk[bt].reshape(gshape)
+                    cv = pv[bt].reshape(gshape)
+            elif kv_int8:
                 k8, ks = kv_quant(k)
                 v8, vs = kv_quant(v)
                 ck8 = cache_k.value.at[rows, woffs].set(k8)
@@ -379,12 +464,13 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(self, x, mode: str = "full", seq_lens=None,
-                 adapter_ids=None):
+                 adapter_ids=None, block_tables=None):
         cfg = self.config
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_attn")(x)
         x = x + Attention(cfg, name="attn")(h, mode=mode, seq_lens=seq_lens,
-                                            adapter_ids=adapter_ids)
+                                            adapter_ids=adapter_ids,
+                                            block_tables=block_tables)
         h = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_mlp")(x)
         h = _apply_proj(cfg, cfg.d_ff, "mlp_in", h, adapter_ids)
@@ -398,7 +484,7 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False, mode: str = "full",
-                 seq_lens=None, adapter_ids=None):
+                 seq_lens=None, adapter_ids=None, block_tables=None):
         del train  # no dropout: inference-first; training uses weight decay
         cfg = self.config
         embed = nn.Embed(cfg.vocab_size, cfg.d_model,
@@ -412,7 +498,7 @@ class TransformerLM(nn.Module):
                      else Block)
         for i in range(cfg.n_layers):
             x = block_cls(cfg, name=f"block{i}")(x, mode, seq_lens,
-                                                 adapter_ids)
+                                                 adapter_ids, block_tables)
         x = nn.LayerNorm(dtype=cfg.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
         # Weight-tied head; logits cast to fp32 for a stable softmax/loss.

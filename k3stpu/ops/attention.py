@@ -620,6 +620,19 @@ def _flash_backward_bshd(q, k, v, o, lse, g, *, scale, causal, block_q,
 # (models/transformer.py gates).
 
 
+def _cp_def_partition(cp, plain, **kw):
+    """Register the Shardy sharding_rule (jax >= 0.5). Older jax has no
+    ``sharding_rule`` kwarg on def_partition; there the SPMD wrapper is
+    dropped entirely and callers get the plain kernel back (single-device
+    semantics — pjit replicates instead of splitting on batch x heads).
+    Returns the function callers should use."""
+    try:
+        cp.def_partition(**kw)
+        return cp
+    except TypeError:
+        return plain
+
+
 def _cp_partition(make_lower):
     """def_partition 'partition' callback: per-shard shapes run the plain
     kernel; shardings pass through as Shardy already propagated them (the
@@ -645,7 +658,12 @@ def _flash_fwd_spmd(q, k, v, scale, causal, block_q, block_k, interpret,
                                window=window)
 
 
-_flash_fwd_spmd.def_partition(
+_flash_fwd_spmd = _cp_def_partition(
+    _flash_fwd_spmd,
+    lambda q, k, v, scale, causal, block_q, block_k, interpret, window:
+    _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret, with_lse=True, window=window),
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v:
@@ -668,7 +686,13 @@ def _flash_bwd_spmd(q, k, v, o, lse, g, scale, causal, block_q, block_k,
                                 window=window)
 
 
-_flash_bwd_spmd.def_partition(
+_flash_bwd_spmd = _cp_def_partition(
+    _flash_bwd_spmd,
+    lambda q, k, v, o, lse, g, scale, causal, block_q, block_k, interpret,
+    window:
+    _flash_backward_bshd(q, k, v, o, lse, g, scale=scale, causal=causal,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret, window=window),
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v, o, lse, g:
@@ -690,7 +714,12 @@ def _flash_fwd_nolse_bshd_spmd(q, k, v, scale, causal, block_q, block_k,
                                interpret=interpret, window=window)
 
 
-_flash_fwd_nolse_bshd_spmd.def_partition(
+_flash_fwd_nolse_bshd_spmd = _cp_def_partition(
+    _flash_fwd_nolse_bshd_spmd,
+    lambda q, k, v, scale, causal, block_q, block_k, interpret, window:
+    _flash_forward_bshd(q, k, v, scale=scale, causal=causal,
+                        block_q=block_q, block_k=block_k,
+                        interpret=interpret, window=window),
     partition=_cp_partition(
         lambda scale, causal, block_q, block_k, interpret, window:
         lambda q, k, v:

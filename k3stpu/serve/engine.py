@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k3stpu.models.generate import init_cache, set_cache_index
+from k3stpu.models.generate import init_cache, paged_model, set_cache_index
 from k3stpu.serve.programs import (
     decode_core,
     extend_core,
@@ -58,6 +58,52 @@ def _pow2_at_least(n: int, lo: int = 1) -> int:
     while p < n:
         p *= 2
     return p
+
+
+class _PageAllocator:
+    """Host-side page bookkeeping for the paged KV cache (loop thread
+    only). Page 0 is the reserved sink — pad rows and neutralized batch
+    rows write there — so it is never handed out. Sharing (prompt-cache
+    pins, sampled fan-outs) is refcounted: a page returns to the free
+    list only when its last reference drops."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._rc = np.zeros((num_pages,), np.int32)
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() hands out 1 first
+
+    @property
+    def total(self) -> int:
+        return self.num_pages - 1  # the sink page is not allocatable
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._rc[page])
+
+    def alloc(self, n: int) -> "list[int] | None":
+        """n fresh pages at refcount 1, or None (all-or-nothing)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._rc[pages] = 1
+        return pages
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            if self._rc[p] <= 0:
+                raise RuntimeError(f"incref on free page {p}")
+            self._rc[p] += 1
+
+    def decref(self, pages) -> None:
+        for p in pages:
+            if self._rc[p] <= 0:
+                raise RuntimeError(f"double free of page {p}")
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
 
 
 def _sample_rows(logits, temps, topks, topps, key):
@@ -154,7 +200,9 @@ class GenerateEngine:
     def __init__(self, model, params, *, slots: int = 8,
                  seed: int = 0, chunk_prefill: "int | None" = None,
                  decode_block: int = 1, prompt_cache: int = 0,
-                 mesh=None, max_pending: "int | None" = None):
+                 mesh=None, max_pending: "int | None" = None,
+                 page_size: "int | None" = None,
+                 num_pages: "int | None" = None):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -191,7 +239,22 @@ class GenerateEngine:
         sharded on its kv-head axis where divisible (attention splits
         by head under TP) and replicated otherwise. Host-side numpy
         inputs stay uncommitted — jit places them. None =
-        single-device (programs unchanged)."""
+        single-device (programs unchanged).
+
+        ``page_size`` / ``num_pages``: PAGED KV cache. The decode cache
+        becomes one pool of ``num_pages`` fixed pages per layer instead
+        of ``slots`` monolithic ``max_seq``-deep rows; each slot holds a
+        chain of just ``ceil((len + budget) / page_size)`` pages,
+        addressed through a traced block table — so admission is bounded
+        by FREE PAGES, not free rows, and the same HBM serves far more
+        concurrent short requests (``stats()['paged_density_ratio']``).
+        ``num_pages`` defaults to the dense footprint + the sink page;
+        set it LOWER to realize the density win. The prompt cache
+        upgrades to zero-copy prefix sharing: entries pin their pages
+        (refcounted, read-only) into admitted rows' tables instead of
+        copying whole cache rows; only a partial tail page is copied
+        (the row writes into it). Token streams stay bit-identical to
+        the dense engine's. None = dense cache (everything unchanged)."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
@@ -221,7 +284,42 @@ class GenerateEngine:
         # called exactly as before (no recompile, no behavior change).
         self.n_adapters = getattr(cfg, "multi_lora", None)
 
-        self._cache = init_cache(model, slots)
+        # Paged KV cache state (cfg doc in models/transformer.py; the
+        # serving semantics live in this class's docstring above).
+        if num_pages is not None and page_size is None:
+            raise ValueError("num_pages needs page_size")
+        self.paged = page_size is not None
+        if self.paged:
+            if page_size < 1 or self.max_seq % page_size:
+                raise ValueError(f"page_size {page_size} must divide "
+                                 f"max_seq_len {self.max_seq}")
+            self.page_size = page_size
+            self.n_bt = self.max_seq // page_size  # block-table width
+            if num_pages is None:
+                num_pages = 1 + slots * self.n_bt  # dense parity + sink
+            if num_pages < 2:
+                raise ValueError(f"num_pages must be >= 2, got "
+                                 f"{num_pages}")
+            self.num_pages = num_pages
+            self.pmodel = paged_model(model, num_pages=num_pages,
+                                      page_size=page_size)
+            self._alloc = _PageAllocator(num_pages)
+            self._tables = np.zeros((slots, self.n_bt), np.int32)
+            # Host mirror of every row's cache index — the injected
+            # truth: each paged dispatch stamps it into the cache first,
+            # making the device-side index disposable state.
+            self._indices = np.zeros((slots,), np.int32)
+            self._chains: "list[list[int]]" = [[] for _ in range(slots)]
+            self._pinned: "dict[int, int]" = {}  # page -> #pcache pins
+
+        self._cache = init_cache(self.pmodel if self.paged else model,
+                                 slots)
+        if self.paged:
+            # Per-page HBM (all layers: K/V pools + int8 scale pools) —
+            # the unit of the pcache byte accounting.
+            self._page_bytes = sum(
+                x.nbytes // num_pages
+                for x in jax.tree.leaves(self._cache) if x.ndim >= 3)
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -264,7 +362,8 @@ class GenerateEngine:
         self._lock = threading.Lock()
         self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
                        "busy_s": 0.0, "requests": 0,
-                       "slot_occupancy_sum": 0.0, "adm_chunks": 0,
+                       "slot_occupancy_sum": 0.0, "peak_active_slots": 0,
+                       "adm_chunks": 0,
                        "pcache_hits": 0, "pcache_prefix_hits": 0,
                        "pcache_misses": 0, "pcache_bytes": 0,
                        "rejected": 0}
@@ -351,6 +450,92 @@ class GenerateEngine:
             lambda x: jnp.broadcast_to(x[:1], (n, *x.shape[1:])), cache)
         return rep, jnp.broadcast_to(last[:1], (n, *last.shape[1:]))
 
+    # --- paged-cache programs (block tables + host-injected indices) ----
+
+    # Every paged program takes the host's (slots,) index mirror and
+    # stamps it into the cache before the core runs: device-side index
+    # state is disposable, so a batch-wide call that advances OTHER
+    # rows' indices (the prefix-hit extension neutralizes those rows
+    # onto the sink page) is corrected for free at the next dispatch.
+    # Block tables are traced int32 data — one compiled program serves
+    # every page assignment, zero steady-state recompiles.
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_decode_step(self, params, cache, idx, bts, toks, temps,
+                           topks, topps, step, base_key, aids=None):
+        cache = set_cache_index(cache, idx)
+        cache, logits = decode_core(self.pmodel, params, cache, toks,
+                                    adapter_ids=aids, block_tables=bts)
+        key = jax.random.fold_in(base_key, step)
+        return cache, _sample_rows(logits, temps, topks, topps, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 11))
+    def _paged_decode_block_step(self, params, cache, idx, bts, toks,
+                                 temps, topks, topps, step, base_key,
+                                 k_tokens: int, aids=None):
+        cache = set_cache_index(cache, idx)
+        block_key = jax.random.fold_in(base_key, step)
+
+        def body(carry, i):
+            cache, tok = carry
+            cache, logits = decode_core(self.pmodel, params, cache, tok,
+                                        adapter_ids=aids,
+                                        block_tables=bts)
+            key = jax.random.fold_in(block_key, i)
+            nxt = _sample_rows(logits, temps, topks, topps, key)
+            return (cache, nxt), nxt
+
+        (cache, _), out = jax.lax.scan(
+            body, (cache, toks), jnp.arange(k_tokens))
+        return cache, out
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_extend(self, params, cache, idx, bts, chunk, aids=None):
+        cache = set_cache_index(cache, idx)
+        return extend_core(self.pmodel, params, cache, chunk,
+                           adapter_ids=aids, block_tables=bts)[0]
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_decode_logits(self, params, cache, idx, bts, toks,
+                             aids=None):
+        cache = set_cache_index(cache, idx)
+        return decode_core(self.pmodel, params, cache, toks,
+                           adapter_ids=aids, block_tables=bts)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _pack_pages(self, pool, small, page_map):
+        """Scatter a dense-prefilled admission cache into the page pool:
+        row j's (max_seq,) K/V reshapes into (n_bt, page_size) pages and
+        lands at pages ``page_map[j]`` (pad rows map to the sink). One
+        compile per admitted-rows bucket; 'index' leaves pass through —
+        they are host-injected at every dispatch."""
+        dense = {tuple(k.key for k in p): v for p, v
+                 in jax.tree_util.tree_flatten_with_path(small)[0]}
+
+        def pack(path, leaf):
+            name = path[-1].key
+            if not name.endswith("_pages"):
+                return leaf
+            src = dense[tuple(k.key for k in path[:-1])
+                        + (name[:-len("_pages")],)]
+            r = src.reshape(src.shape[0], -1, self.page_size,
+                            *src.shape[2:])
+            return leaf.at[page_map].set(r)
+
+        return jax.tree_util.tree_map_with_path(pack, pool)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _copy_page(self, pool, src, dst):
+        """Duplicate ONE page across every layer's pool — the
+        copy-on-write behind prefix sharing (a partial tail page gets
+        written by its row, so sharers take a private copy). src/dst
+        trace: every copy reuses one compiled program."""
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: (x.at[dst].set(x[src])
+                          if str(getattr(p[-1], "key", "")
+                                 ).endswith("_pages") else x),
+            pool)
+
     # --- prompt cache (loop thread only; entries are immutable jax
     #     arrays, so a cached row survives the decodes of whatever slot
     #     its copy was scattered into) ------------------------------------
@@ -382,8 +567,7 @@ class GenerateEngine:
         self._pcache[(adapter, prompt)] = (cache1, last1, nbytes)
         delta = nbytes - (old[2] if old else 0)
         while len(self._pcache) > self.prompt_cache:
-            evicted = self._pcache.pop(next(iter(self._pcache)))
-            delta -= evicted[2]
+            delta -= self._pcache_evict_lru()
         with self._lock:
             self._stats["pcache_bytes"] = (
                 self._stats.get("pcache_bytes", 0) + delta)
@@ -407,6 +591,136 @@ class GenerateEngine:
             cache, jnp.asarray([len(prompt) - 1], jnp.int32))
         return self._decode_logits(
             self.params, cache, jnp.asarray([prompt[-1]], jnp.int32), aids)
+
+    # --- page-chain bookkeeping (paged mode; loop thread only) ----------
+
+    def _pages_for(self, length: int, budget: int) -> int:
+        return -(-(length + budget) // self.page_size)  # ceil div
+
+    def _set_row(self, r: int, chain, index: int) -> None:
+        self._chains[r] = list(chain)
+        self._tables[r, :] = 0
+        self._tables[r, :len(chain)] = chain
+        self._indices[r] = index
+
+    def _release_slot_pages(self, r: int) -> None:
+        if self._chains[r]:
+            self._alloc.decref(self._chains[r])
+        self._chains[r] = []
+        self._tables[r, :] = 0
+
+    def _free_chains(self, chains) -> None:
+        for c in chains or []:
+            if c:
+                self._alloc.decref(c)
+
+    def _pages_needed(self, req: "_Request", pkey) -> int:
+        """Worst-case fresh pages this admission will allocate — the fit
+        check, run BEFORE any device work or allocation. Mirrors the
+        alloc paths exactly: cache hits only pay for non-shared pages."""
+        ps, B = self.page_size, req.budget
+        n = req.samples if req.samples > 1 else req.block.shape[0]
+        # +1: a single-prompt admission pins a COW tail copy into the
+        # prompt cache (the insert skips gracefully when the pool is
+        # dry, but reserving it keeps the pin from stealing a page a
+        # sibling row's chain already counted on).
+        ins = 1 if (self.prompt_cache > 0
+                    and req.block.shape[0] == 1) else 0
+        if pkey is not None:
+            L = len(req.ptuple())
+            total = self._pages_for(L, B)
+            if len(pkey) == L:  # exact hit: no insert afterwards
+                return n * (total - len(pkey) // ps)
+            # prefix: row 0 shares the entry, siblings share row 0
+            return (total - len(pkey) // ps
+                    + (n - 1) * (total - L // ps) + ins)
+        if req.samples > 1:
+            L = int(req.lens[0])
+            total = self._pages_for(L, B)
+            return total + (n - 1) * (total - L // ps) + ins
+        return sum(self._pages_for(int(l), B)
+                   for l in req.lens) + (ins if n == 1 else 0)
+
+    def _alloc_request_chains(self, req: "_Request", nb: int, n: int,
+                              lens) -> "list[list[int]]":
+        """Fresh page chains for a dense-prefilled admission, one list
+        per real row (pad rows get []). samples>1 allocates the full
+        chain for row 0 only — siblings get just their non-shared pages
+        (install increfs the shared prefix into their chains)."""
+        B = req.budget
+        if req.samples > 1:
+            L = int(lens[0])
+            total = self._pages_for(L, B)
+            want = [total] + [total - L // self.page_size] * (n - 1)
+        else:
+            want = [self._pages_for(int(lens[j]), B) for j in range(n)]
+        chains = []
+        for w in want:
+            c = self._alloc.alloc(w)
+            if c is None:  # can't happen after the fit check; roll back
+                self._free_chains(chains)
+                raise RuntimeError("page pool exhausted mid-admission")
+            chains.append(c)
+        return chains + [[] for _ in range(nb - n)]
+
+    def _pin_pages(self, chain) -> None:
+        for p in chain:
+            self._pinned[p] = self._pinned.get(p, 0) + 1
+
+    def _unpin_pages(self, chain) -> None:
+        for p in chain:
+            left = self._pinned[p] - 1
+            if left:
+                self._pinned[p] = left
+            else:
+                del self._pinned[p]
+
+    def _pcache_evict_lru(self) -> int:
+        """Drop the LRU prompt-cache entry (paged entries release their
+        page pins); returns its byte size. Caller adjusts the stat."""
+        entry = self._pcache.pop(next(iter(self._pcache)))
+        if self.paged:
+            self._unpin_pages(entry[0])
+            self._alloc.decref(entry[0])
+        return entry[-1]
+
+    def _pcache_insert_paged(self, prompt: tuple, src_chain, last1,
+                             adapter: int = 0) -> None:
+        """Pin ``prompt``'s pages into the prompt cache WITHOUT copying
+        the prompt K/V: the entry shares the source row's full pages by
+        incref — safe read-only, since a row only ever writes positions
+        >= its admitted length, which live past its full prompt pages —
+        and copies only the partial tail page (the row's next decode
+        DOES write into that one). Skipped when the pool can't spare
+        the tail copy."""
+        if self.prompt_cache <= 0:
+            return
+        ps = self.page_size
+        full = len(prompt) // ps
+        chain = list(src_chain[:full])
+        self._alloc.incref(chain)
+        if len(prompt) % ps:
+            tail = self._alloc.alloc(1)
+            if tail is None:
+                self._alloc.decref(chain)
+                return  # pool too tight to pin a copy — skip caching
+            self._cache = self._copy_page(self._cache, src_chain[full],
+                                          tail[0])
+            chain.append(tail[0])
+        old = self._pcache.pop((adapter, prompt), None)
+        if old is not None:
+            self._unpin_pages(old[0])
+            self._alloc.decref(old[0])
+        self._pin_pages(chain)
+        nbytes = len(chain) * self._page_bytes \
+            + sum(x.nbytes for x in jax.tree.leaves(last1))
+        self._pcache[(adapter, prompt)] = (tuple(chain), len(prompt),
+                                           last1, nbytes)
+        delta = nbytes - (old[-1] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] += delta
 
     def _aid_arg(self, n: int, adapter: int):
         """(n,)-row adapter-id array for a single request's device call —
@@ -439,6 +753,23 @@ class GenerateEngine:
             raise ValueError(
                 f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
                 f"cache ({self.max_seq})")
+        if self.paged:
+            # A request whose WORST-CASE page need (no cache sharing)
+            # exceeds the pool would wait in the queue forever — reject
+            # at the door instead of deadlocking admission.
+            ps = self.page_size
+            if samples > 1:
+                total = self._pages_for(lens[0], max_new_tokens)
+                worst = total + (samples - 1) * (total - lens[0] // ps)
+            else:
+                worst = sum(self._pages_for(l, max_new_tokens)
+                            for l in lens)
+            ins = 1 if (self.prompt_cache > 0 and len(prompts) == 1) else 0
+            if worst + ins > self._alloc.total:
+                raise ValueError(
+                    f"request needs up to {worst + ins} pages but the "
+                    f"pool has {self._alloc.total} usable — raise "
+                    f"num_pages or shrink prompt/budget")
         block = np.zeros((len(prompts), width), np.int32)
         for i, p in enumerate(prompts):
             block[i, :len(p)] = p
@@ -639,6 +970,24 @@ class GenerateEngine:
         s["avg_active_slots"] = (round(s["slot_occupancy_sum"] / s["steps"],
                                        2) if s["steps"] else None)
         s["pcache_entries"] = len(self._pcache)
+        if self.paged:
+            total, free = self._alloc.total, self._alloc.free
+            s["pages_total"] = total
+            s["pages_free"] = free
+            s["pages_pinned"] = len(self._pinned)
+            s["page_utilization"] = round((total - free) / total, 4)
+            # Pinned pages with >1 reference ARE the zero-copy sharing:
+            # mapped read-only into a live row's table, or claimed by
+            # several cache entries (an extended prompt shares its
+            # ancestor's full pages).
+            s["pcache_shared_pages"] = sum(
+                1 for p in list(self._pinned)
+                if self._alloc.refcount(p) > 1)
+            # Token-slots a dense cache needs for this many slots vs
+            # what the pool actually holds — the measured density
+            # multiplier (> 1: same slot count in less HBM).
+            s["paged_density_ratio"] = round(
+                self.slots * self.max_seq / (total * self.page_size), 2)
         return s
 
     # --- loop internals (single thread; owns all slot state) ------------
@@ -718,6 +1067,24 @@ class GenerateEngine:
             free = self._free_slots()
             if len(free) < nb:
                 return  # strict FIFO on capacity: big requests don't starve
+            if self.paged:
+                need = self._pages_needed(req, pkey)
+                # Pinned prompt-cache pages are reclaimable HBM: evict
+                # idle entries (LRU) until the request fits — but never
+                # the entry THIS request is about to share (evicting it
+                # would cost more fresh pages than it frees).
+                while need > self._alloc.free and self._pcache:
+                    lru = next(iter(self._pcache))
+                    if pkey is not None and lru == (req.adapter, pkey):
+                        if len(self._pcache) == 1:
+                            break
+                        self._pcache[lru] = self._pcache.pop(lru)  # MRU
+                        continue
+                    freed = self._pcache_evict_lru()
+                    with self._lock:
+                        self._stats["pcache_bytes"] -= freed
+                if need > self._alloc.free:
+                    return  # strict FIFO: decodes must free pages first
             self._pending.pop(i)
             admitted += 1
             if pkey is not None:
@@ -726,6 +1093,10 @@ class GenerateEngine:
                     self._stats["pcache_hits" if exact
                                 else "pcache_prefix_hits"] += 1
                 try:
+                    if self.paged:
+                        self._admit_hit_paged(req, free[:nb], n_rows,
+                                              prompt, pkey, pentry)
+                        continue
                     if exact:
                         small, last = pentry[0], pentry[1]
                     else:
@@ -754,15 +1125,22 @@ class GenerateEngine:
                     [req.lens, np.ones((nb - n,), np.int32)])
             all_rows = free[:nb]
             if chunked:
-                # Start a chunked admission: reserve the slots, run the
-                # first chunk, and let subsequent loop iterations (with
-                # decode steps in between) carry the rest.
+                # Start a chunked admission: reserve the slots (and, in
+                # paged mode, the page chains — a later admission must
+                # not steal pages this one's finalize counts on), run
+                # the first chunk, and let subsequent loop iterations
+                # (with decode steps in between) carry the rest.
+                chains = None
                 try:
+                    if self.paged:
+                        chains = self._alloc_request_chains(
+                            req, nb, n_rows, lens)
                     small, _ = self._prefill(
                         self.params, jnp.asarray(block[:, :c]),
                         jnp.full((block.shape[0],), c, jnp.int32),
                         self._aid_arg(block.shape[0], req.adapter))
                 except Exception as e:  # noqa: BLE001
+                    self._free_chains(chains)
                     req.error = e
                     req.signal()
                     continue
@@ -770,20 +1148,32 @@ class GenerateEngine:
                     self._reserved[r] = True
                 self._adm = {"req": req, "cache": small, "block": block,
                              "lens": lens, "pos": c, "rows": all_rows,
-                             "n": n_rows}
+                             "n": n_rows, "chains": chains}
                 with self._lock:
                     self._stats["adm_chunks"] += 1
                 return
+            chains = None
+            handed = False
             try:
+                if self.paged:
+                    chains = self._alloc_request_chains(req, nb, n_rows,
+                                                        lens)
                 small, last = self._prefill(
                     self.params, jnp.asarray(block), jnp.asarray(lens),
                     self._aid_arg(block.shape[0], req.adapter))
-                if prompt is not None:  # 1-row, pre-broadcast state
+                if prompt is not None and not self.paged:
+                    # 1-row, pre-broadcast state; the paged engine
+                    # inserts AFTER packing (zero-copy page pins).
                     self._pcache_insert(prompt, small, last, req.adapter)
-                if req.samples > 1:
+                if req.samples > 1 and not self.paged:
                     small, last = self._broadcast_rows(small, last, nb)
-                self._activate(req, all_rows, n_rows, small, last)
+                handed = True
+                self._activate(req, all_rows, n_rows, small, last,
+                               chains=chains,
+                               pinsert=prompt if self.paged else None)
             except Exception as e:  # noqa: BLE001 — fail the one request
+                if not handed:
+                    self._free_chains(chains)
                 req.error = e
                 req.signal()
                 continue
@@ -817,19 +1207,27 @@ class GenerateEngine:
             cache, last = self._decode_logits(
                 self.params, cache, jnp.asarray(last_toks),
                 self._aid_arg(len(lens), req.adapter))
+            pinsert = None
             if self.prompt_cache > 0 and a["block"].shape[0] == 1:
                 # a["block"] row 0 == req.block row 0 by construction
                 # (both admission paths copy it verbatim), so the
                 # memoized key is THE key.
-                self._pcache_insert(a["req"].ptuple(), cache, last,
-                                    req.adapter)
-            if req.samples > 1:
+                if self.paged:
+                    pinsert = a["req"].ptuple()
+                else:
+                    self._pcache_insert(a["req"].ptuple(), cache, last,
+                                        req.adapter)
+            if req.samples > 1 and not self.paged:
                 cache, last = self._broadcast_rows(cache, last,
                                                    len(a["rows"]))
             for r in a["rows"]:
                 self._reserved[r] = False
+            # Chain ownership hands to _activate here: an abort after
+            # this point must not double-free what the rows now hold.
+            chains, a["chains"] = a.get("chains"), None
             self._adm = None
-            self._activate(req, a["rows"], a["n"], cache, last)
+            self._activate(req, a["rows"], a["n"], cache, last,
+                           chains=chains, pinsert=pinsert)
         except Exception as e:  # noqa: BLE001 — fail the one request
             self._abort_admission(a, e)
 
@@ -841,17 +1239,165 @@ class GenerateEngine:
         branch nulls self._adm before _activate, so an _activate failure
         must still reach the record it was admitting."""
         self._adm = None
+        if self.paged:
+            self._free_chains(a.get("chains"))
+            a["chains"] = None
         for r in a["rows"]:
             self._reserved[r] = False
         a["req"].error = err
         a["req"].signal()
 
-    def _activate(self, req, all_rows, n, small_cache, last_logits) -> None:
-        """Scatter an admitted small cache into the slot block and light
-        up the rows (shared tail of both admission paths)."""
+    def _activate(self, req, all_rows, n, small_cache, last_logits,
+                  chains=None, pinsert=None) -> None:
+        """Install an admitted small cache into the slot block and light
+        up the rows (shared tail of both admission paths). Dense engines
+        scatter into the monolithic cache; paged engines pack the rows
+        into their preallocated page ``chains`` and, when ``pinsert``
+        names a prompt, pin the packed pages into the prompt cache
+        (zero-copy: full pages shared by incref, tail page copied)."""
+        if self.paged:
+            last_logits = self._install_paged(req, all_rows, n,
+                                              small_cache, last_logits,
+                                              chains, pinsert)
+        else:
+            self._cache = self._scatter(
+                self._cache, small_cache, jnp.asarray(all_rows, np.int32))
+        self._light_up(req, all_rows, n, last_logits)
+
+    def _install_paged(self, req, all_rows, n, small_cache, last_logits,
+                       chains, pinsert):
+        """Pack a dense-prefilled admission cache into the rows' page
+        chains. samples>1 packs the ONE prompt row and fans it out
+        zero-copy: siblings share row 0's full prompt pages (incref) +
+        a COW'd tail + their own fresh budget pages — no n-way prompt
+        replication in HBM. Returns the (possibly fanned-out)
+        first-token logits."""
+        ps = self.page_size
+        nb = len(all_rows)
+        if req.samples > 1:
+            L = int(req.lens[0])
+            chain0 = chains[0]
+            pm = np.zeros((1, self.n_bt), np.int32)
+            pm[0, :len(chain0)] = chain0
+            self._cache = self._pack_pages(self._cache, small_cache,
+                                           jnp.asarray(pm))
+            full = L // ps
+            row_chains = [chain0]
+            for j in range(1, n):
+                fresh = chains[j]
+                self._alloc.incref(chain0[:full])
+                if L % ps:
+                    self._cache = self._copy_page(self._cache,
+                                                  chain0[full], fresh[0])
+                row_chains.append(chain0[:full] + fresh)
+            row_lens = [L] * n
+        else:
+            pm = np.zeros((nb, self.n_bt), np.int32)
+            for j in range(n):
+                pm[j, :len(chains[j])] = chains[j]
+            self._cache = self._pack_pages(self._cache, small_cache,
+                                           jnp.asarray(pm))
+            row_chains = chains[:n]
+            row_lens = [int(x) for x in req.lens]
+        if pinsert is not None:
+            # Pin row 0's prompt pages before its first decode write
+            # lands in the tail page (device ordering follows the
+            # self._cache data flow — the COW copy reads the packed,
+            # pre-decode state).
+            self._pcache_insert_paged(pinsert, row_chains[0],
+                                      last_logits[:1], req.adapter)
+        for j, r in enumerate(all_rows):
+            if j < n:
+                self._set_row(r, row_chains[j], row_lens[j])
+            else:  # pad rows: sink-page table, dense pad index of 1
+                self._set_row(r, [], 1)
+        if req.samples > 1:
+            last_logits = jnp.broadcast_to(
+                last_logits[:1], (nb, *last_logits.shape[1:]))
+        return last_logits
+
+    def _admit_hit_paged(self, req, all_rows, n, prompt, pkey,
+                         pentry) -> None:
+        """Prompt-cache admission without copying the cached prompt K/V:
+        every admitted row maps the entry's full pages read-only into
+        its block table (incref), copies the partial tail page (the row
+        WILL write into it: position L lives there), and takes fresh
+        pages for the rest. An exact hit does zero device attention
+        work. A prefix hit first materializes row 0 and appends the
+        uncached suffix batch-wide with every OTHER row's table pointed
+        at the sink page — live rows' pages can't be touched, and their
+        device indices are re-injected from the host mirror at the next
+        dispatch — then re-decodes the last real token for the exact
+        post-prefill logits and shares row 0 into the siblings."""
+        ps = self.page_size
+        chain0, l0, last0 = pentry[0], pentry[1], pentry[2]
+        L, B = len(prompt), req.budget
+        total = self._pages_for(L, B)
+
+        def build_row(src_chain, src_len):
+            sf = src_len // ps
+            fresh = self._alloc.alloc(total - sf)
+            if fresh is None:  # fit-checked; defensive
+                raise RuntimeError("page pool exhausted mid-admission")
+            self._alloc.incref(src_chain[:sf])
+            if src_len % ps:
+                self._cache = self._copy_page(self._cache,
+                                              src_chain[sf], fresh[0])
+            return list(src_chain[:sf]) + fresh
+
+        if l0 == L:  # exact hit: host bookkeeping + stored logits only
+            row_chains = [build_row(chain0, L) for _ in range(n)]
+            last = last0
+        else:
+            r0 = all_rows[0]
+            c0 = build_row(chain0, l0)
+            self._set_row(r0, c0, l0)
+            bts = np.zeros((self.slots, self.n_bt), np.int32)
+            bts[r0] = self._tables[r0]
+            idx = self._indices.copy()
+            extra = np.asarray(prompt[l0:], np.int32)
+            g = _pow2_at_least(len(extra))
+            chunk = np.zeros((self.slots, g), np.int32)
+            chunk[r0, :len(extra)] = extra
+            aids = self._hit_aids(r0, req.adapter)
+            self._cache = self._paged_extend(
+                self.params, self._cache, jnp.asarray(idx),
+                jnp.asarray(bts), jnp.asarray(chunk), aids)
+            # Roll back over the suffix pad junk and re-decode the last
+            # real token in place (the dense _pcache_extend invariant).
+            idx[r0] = L - 1
+            toks = np.zeros((self.slots,), np.int32)
+            toks[r0] = prompt[-1]
+            self._cache, logits = self._paged_decode_logits(
+                self.params, self._cache, jnp.asarray(idx),
+                jnp.asarray(bts), jnp.asarray(toks), aids)
+            last = logits[r0:r0 + 1]
+            self._pcache_insert_paged(prompt, c0, last, req.adapter)
+            row_chains = [c0] + [build_row(c0, L) for _ in range(1, n)]
+        nb = len(all_rows)
+        for j, r in enumerate(all_rows):
+            if j < n:
+                self._set_row(r, row_chains[j], L)
+            else:
+                self._set_row(r, [], 1)
+        if nb > 1:
+            last = jnp.broadcast_to(last[:1], (nb, *last.shape[1:]))
+        self._light_up(req, all_rows, n, last)
+
+    def _hit_aids(self, r0: int, adapter: int):
+        """(slots,) adapter ids for a batch-wide hit-admission call:
+        row r0 uses the request's adapter, other rows keep their live
+        values (their output is discarded and their writes are sinked,
+        so any valid id works)."""
+        if self.n_adapters is None:
+            return None
+        a = self._aids.copy()
+        a[r0] = adapter
+        return jnp.asarray(a)
+
+    def _light_up(self, req, all_rows, n, last_logits) -> None:
+        """Shared activation tail: first-token sample + slot state."""
         rows = all_rows[:n]
-        self._cache = self._scatter(
-            self._cache, small_cache, jnp.asarray(all_rows, np.int32))
         nb = len(all_rows)
         temps = np.full((nb,), req.temp, np.float32)
         topks = np.full(
@@ -897,6 +1443,12 @@ class GenerateEngine:
         # lax.cond fast path in _sample_rows for every later step until
         # the slot is reused.
         self._temps[r] = 0.0
+        if self.paged:
+            # Free the row's pages NOW, not at request completion: the
+            # zeroed table row sinks the slot's continued decode writes,
+            # and shared prompt pages just drop a refcount — so a long
+            # sibling can't hold a finished row's HBM hostage.
+            self._release_slot_pages(r)
 
     def _fail_request(self, req: "_Request", err: Exception) -> None:
         for r in req.slot_rows:
@@ -904,6 +1456,8 @@ class GenerateEngine:
             self._temps[r] = 0.0  # keep the all-greedy fast path alive
             self._owner[r] = None
             self._collected[r] = []
+            if self.paged:
+                self._release_slot_pages(r)
         req.error = err
         req.signal()
 
@@ -938,6 +1492,8 @@ class GenerateEngine:
             out.append(toks)
             self._owner[r] = None
             self._collected[r] = []
+            if self.paged:
+                self._release_slot_pages(r)  # no-op after _finish_row
         req.tokens = out
         req.signal()
 
@@ -958,23 +1514,36 @@ class GenerateEngine:
             aids = (jnp.asarray(self._aids)
                     if self.n_adapters is not None else None)
             try:
-                if k_tok == 1:
+                targs = (jnp.asarray(self._last_tok),
+                         jnp.asarray(self._temps),
+                         jnp.asarray(self._topks),
+                         jnp.asarray(self._topps),
+                         self._step_counter, self._base_key)
+                if self.paged:
+                    pargs = (jnp.asarray(self._indices),
+                             jnp.asarray(self._tables))
+                    if k_tok == 1:
+                        self._cache, nxt = self._paged_decode_step(
+                            self.params, self._cache, *pargs, *targs,
+                            aids)
+                        block = np.asarray(nxt)[None]      # (1, B)
+                    else:
+                        self._cache, nxt = self._paged_decode_block_step(
+                            self.params, self._cache, *pargs, *targs,
+                            k_tok, aids)
+                        block = np.asarray(nxt)            # (K, B)
+                    # The dispatch advanced EVERY row's device index by
+                    # k_tok; the host mirror (the injected truth) must
+                    # track it, active or not — exactly like the dense
+                    # cache's own index leaves.
+                    self._indices += k_tok
+                elif k_tok == 1:
                     self._cache, nxt = self._decode_step(
-                        self.params, self._cache,
-                        jnp.asarray(self._last_tok),
-                        jnp.asarray(self._temps),
-                        jnp.asarray(self._topks),
-                        jnp.asarray(self._topps),
-                        self._step_counter, self._base_key, aids)
+                        self.params, self._cache, *targs, aids)
                     block = np.asarray(nxt)[None]          # (1, B)
                 else:
                     self._cache, nxt = self._decode_block_step(
-                        self.params, self._cache,
-                        jnp.asarray(self._last_tok),
-                        jnp.asarray(self._temps),
-                        jnp.asarray(self._topks),
-                        jnp.asarray(self._topps),
-                        self._step_counter, self._base_key, k_tok, aids)
+                        self.params, self._cache, *targs, k_tok, aids)
                     block = np.asarray(nxt)                # (K, B)
             except Exception as e:  # noqa: BLE001 — fail every live request
                 for req in {self._owner[r] for r in range(self.slots)
@@ -983,6 +1552,9 @@ class GenerateEngine:
                     req.signal()
                 self._active[:] = False
                 self._owner = [None] * self.slots
+                if self.paged:
+                    for r in range(self.slots):
+                        self._release_slot_pages(r)
                 continue
             dt = time.perf_counter() - t0
             n_active = int(self._active.sum())
@@ -1021,6 +1593,8 @@ class GenerateEngine:
                 self._stats["busy_s"] += dt
                 self._stats["slot_occupancy_sum"] += (n_active
                                                       * block.shape[0])
+                self._stats["peak_active_slots"] = max(
+                    self._stats["peak_active_slots"], n_active)
             for req in done_reqs:
                 self._maybe_complete(req)
         # Shutdown: fail anything still waiting — INCLUDING requests a

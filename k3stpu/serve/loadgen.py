@@ -209,6 +209,14 @@ def main(argv: "list[str] | None" = None) -> int:
                          "length), so every request after the first is an "
                          "exact hit — the measured delta vs --prompt-cache "
                          "0 is the prefill-skip win")
+    ap.add_argument("--kv-page-size", type=int, default=None,
+                    help="with --continuous-batching: paged KV cache with "
+                         "this page size (see server --kv-page-size); the "
+                         "engine stats in LOADGEN_JSON then carry the "
+                         "page-pool gauges")
+    ap.add_argument("--kv-pages", type=int, default=None,
+                    help="pool size for --kv-page-size (default: full "
+                         "dense capacity)")
     args = ap.parse_args(argv)
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
@@ -233,6 +241,7 @@ def main(argv: "list[str] | None" = None) -> int:
             continuous_batching=args.continuous_batching,
             decode_block=args.decode_block,
             prompt_cache=args.prompt_cache,
+            kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=None)  # None = all local devices; the engine
         # runs tensor-parallel now (mesh-sharded KV cache), so the old

@@ -26,11 +26,15 @@ def prompt_width_bucket(max_len: int, max_seq: int, floor: int = 8) -> int:
     return min(max(width, floor), max_seq)
 
 
-def _akw(adapter_ids):
-    # Multi-LoRA per-row adapter ids: forwarded only when present —
-    # both LM families accept the kwarg; this keeps non-adapter call
-    # signatures identical to the pre-multi-LoRA ones.
-    return {} if adapter_ids is None else {"adapter_ids": adapter_ids}
+def _akw(adapter_ids, block_tables=None):
+    # Multi-LoRA per-row adapter ids and paged-cache block tables:
+    # forwarded only when present — both LM families accept the kwargs;
+    # this keeps non-adapter, non-paged call signatures identical to the
+    # original ones.
+    kw = {} if adapter_ids is None else {"adapter_ids": adapter_ids}
+    if block_tables is not None:
+        kw["block_tables"] = block_tables
+    return kw
 
 
 def prefill_core(model, params, block, lens, adapter_ids=None):
@@ -46,19 +50,23 @@ def prefill_core(model, params, block, lens, adapter_ids=None):
     return mut["cache"], last.astype(jnp.float32)
 
 
-def decode_core(model, params, cache, toks, adapter_ids=None):
-    """One decode step for (B,) tokens: ``(cache, logits (B, V) fp32)``."""
+def decode_core(model, params, cache, toks, adapter_ids=None,
+                block_tables=None):
+    """One decode step for (B,) tokens: ``(cache, logits (B, V) fp32)``.
+    ``block_tables``: page-id map for a paged-cache model (traced)."""
     logits, mut = model.apply({"params": params, "cache": cache},
                               toks[:, None], mode="decode",
-                              mutable=["cache"], **_akw(adapter_ids))
+                              mutable=["cache"],
+                              **_akw(adapter_ids, block_tables))
     return mut["cache"], logits[:, -1].astype(jnp.float32)
 
 
-def extend_core(model, params, cache, chunk, adapter_ids=None):
+def extend_core(model, params, cache, chunk, adapter_ids=None,
+                block_tables=None):
     """Chunk-append (B, G) tokens at per-row offsets:
     ``(cache, logits (B, G, V) fp32)`` — logits[:, j] scores the next
     token after chunk[:, :j+1]."""
     logits, mut = model.apply({"params": params, "cache": cache}, chunk,
                               mode="extend", mutable=["cache"],
-                              **_akw(adapter_ids))
+                              **_akw(adapter_ids, block_tables))
     return mut["cache"], logits.astype(jnp.float32)

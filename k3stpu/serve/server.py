@@ -208,6 +208,8 @@ class InferenceServer:
                  decode_block: int = 4,
                  prompt_cache: int = 0,
                  max_pending: "int | None" = None,
+                 kv_page_size: "int | None" = None,
+                 kv_pages: "int | None" = None,
                  lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
@@ -504,6 +506,11 @@ class InferenceServer:
         # requests share one slot-based decode loop — a new request joins
         # mid-flight instead of queueing behind a long generation.
         self._engine = None
+        if kv_page_size is not None and not continuous_batching:
+            # The page pool lives inside the engine; without it the flag
+            # would silently do nothing.
+            raise ValueError(
+                "--kv-page-size requires --continuous-batching")
         if continuous_batching:
             if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
@@ -515,7 +522,8 @@ class InferenceServer:
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
-                max_pending=max_pending)
+                max_pending=max_pending, page_size=kv_page_size,
+                num_pages=kv_pages)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -1098,6 +1106,23 @@ class InferenceServer:
                     "# TYPE k3stpu_pcache_bytes gauge",
                     f"k3stpu_pcache_bytes {e['pcache_bytes']}",
                 ]
+            if self._engine.paged:
+                lines += [
+                    "# TYPE k3stpu_pages_total gauge",
+                    f"k3stpu_pages_total {e['pages_total']}",
+                    "# TYPE k3stpu_pages_free gauge",
+                    f"k3stpu_pages_free {e['pages_free']}",
+                    "# TYPE k3stpu_pages_pinned gauge",
+                    f"k3stpu_pages_pinned {e['pages_pinned']}",
+                    "# TYPE k3stpu_page_utilization gauge",
+                    f"k3stpu_page_utilization {e['page_utilization']}",
+                    "# TYPE k3stpu_pcache_shared_pages gauge",
+                    f"k3stpu_pcache_shared_pages "
+                    f"{e['pcache_shared_pages']}",
+                    "# TYPE k3stpu_paged_density_ratio gauge",
+                    f"k3stpu_paged_density_ratio "
+                    f"{e['paged_density_ratio']}",
+                ]
         if self._draft is not None:
             with self._stats_lock:
                 sp = dict(self._spec_stats)
@@ -1424,6 +1449,18 @@ def main(argv=None) -> int:
                          "its prefill, a prompt extending a cached one "
                          "prefills only the suffix (chat/system-prompt "
                          "reuse). Costs one cache row of HBM per entry")
+    ap.add_argument("--kv-page-size", type=int, default=None,
+                    help="with --continuous-batching: PAGED KV cache — "
+                         "slots hold chains of this-many-token pages from "
+                         "a shared pool instead of monolithic max-seq "
+                         "rows; admission is bounded by free pages, and "
+                         "the prompt cache shares pages zero-copy. "
+                         "Must divide --seq-len")
+    ap.add_argument("--kv-pages", type=int, default=None,
+                    help="page-pool size (incl. the reserved sink page "
+                         "0); default = dense parity (slots * seq_len / "
+                         "page_size + 1) — set LOWER to spend less HBM "
+                         "than dense for the same slot count")
     ap.add_argument("--draft-model", default=None,
                     choices=["transformer", "transformer-tiny"],
                     help="speculative decoding draft for greedy "
@@ -1469,6 +1506,8 @@ def main(argv=None) -> int:
                              decode_block=args.decode_block,
                              prompt_cache=args.prompt_cache,
                              max_pending=args.max_pending,
+                             kv_page_size=args.kv_page_size,
+                             kv_pages=args.kv_pages,
                              lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,

@@ -16,7 +16,10 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above already forces 8
 
 # Persistent compilation cache for the suite: the full run compiles
 # hundreds of programs, and XLA:CPU's concurrent LLVM codegen (an engine
@@ -66,6 +69,13 @@ import time
 import pytest
 
 NATIVE_BUILD_DIR = REPO_ROOT / "native" / "build"
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long soaks/benches — deselected in run_suite.sh --smoke "
+        "via -m 'not slow', run by the full suite")
 
 
 @pytest.fixture(scope="session")

@@ -20,7 +20,9 @@
 #            two driver-critical JAX files (bench JSON contract, graft
 #            entry + 8-device dryrun). The full two-process suite stays
 #            the round gate; smoke exists so intermediate commits keep a
-#            fast green signal as the suite's wall time grows.
+#            fast green signal as the suite's wall time grows. Paged-KV
+#            exactness rides along minus its @slow soak/bench tests
+#            (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -37,7 +39,8 @@ if [ "${1:-}" = "--smoke" ]; then
     tests/test_plugin_config.py tests/test_chips.py tests/test_discovery.py \
     tests/test_container_runtime.py tests/test_device_plugin.py \
     tests/test_e2e_assets.py \
-    tests/test_bench.py tests/test_graft_entry.py "$@"
+    tests/test_bench.py tests/test_graft_entry.py \
+    tests/test_paged.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

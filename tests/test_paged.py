@@ -1,0 +1,457 @@
+"""Paged KV cache (k3stpu/serve/engine.py + models/transformer.py).
+
+The correctness bar is BIT-EXACTNESS: an engine with a paged pool +
+block tables must emit exactly the tokens the dense per-slot engine
+emits — greedy, sampled (same seed), chunked prefill, and every prompt
+cache path (miss / exact hit / prefix hit). The capacity win must come
+from the allocator alone, never from numerics.
+
+The safety bar is the allocator: random admit/finish/cancel storms may
+never leak a page, double-free one, or alias one across slot chains
+without a matching refcount; prompt-cache-pinned pages must survive
+pool pressure while referenced. CPU-JAX stand-in per SURVEY.md §4.
+"""
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.generate import generate
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine, _PageAllocator
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+def _pair(model, params, *, page_size=8, **kw):
+    """A dense engine and a paged engine with identical scheduling
+    parameters (same seed => identical sampling-key folds)."""
+    dense = GenerateEngine(model, params, seed=0, **kw)
+    paged = GenerateEngine(model, params, seed=0, page_size=page_size,
+                           **kw)
+    return dense, paged
+
+
+def _assert_page_invariants(engine):
+    """Idle-engine allocator accounting, checked exactly: every page's
+    refcount equals its appearances across live slot chains plus the
+    prompt-cache pins holding it. Equality is simultaneously the leak
+    proof (rc>0 but unowned fails), the alias proof (a page in two
+    chains without two refs fails), and the pin proof (a cached entry's
+    pages count toward rc, so reclaim-while-referenced fails)."""
+    alloc = engine._alloc
+    expect = {}
+    for chain in engine._chains:
+        for p in chain:
+            expect[p] = expect.get(p, 0) + 1
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            expect[p] = expect.get(p, 0) + 1
+    for p in range(1, alloc.num_pages):
+        assert alloc.refcount(p) == expect.get(p, 0), (
+            f"page {p}: rc={alloc.refcount(p)} but "
+            f"{expect.get(p, 0)} live references")
+    assert alloc.free == alloc.total - sum(1 for v in expect.values()
+                                           if v > 0)
+    pinned = {}
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            pinned[p] = pinned.get(p, 0) + 1
+    assert engine._pinned == pinned
+
+
+# --- bit-exactness: paged == dense on every serving path ----------------
+
+
+def test_paged_matches_dense_greedy(mp):
+    model, params = mp
+    dense, paged = _pair(model, params, slots=4)
+    try:
+        cases = [
+            [[5, 6, 7]],
+            [[3, 4], [9, 10, 11, 12, 13]],               # ragged batch
+            [list(range(1, 20)), [40], [7, 8, 9]],        # 3 rows
+        ]
+        for prompts in cases:
+            want = dense.submit(prompts, max_new_tokens=6)
+            assert paged.submit(prompts, max_new_tokens=6) == want
+            # dense itself is pinned to solo generate() — anchor the
+            # chain so a shared bug in both engines can't hide.
+            for w, p in zip(want, prompts):
+                assert w == _solo(model, params, p, 6)
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_paged_matches_dense_sampled(mp):
+    """Same seed, same fold sequence => sampled tokens must be
+    IDENTICAL, not merely plausible."""
+    model, params = mp
+    dense, paged = _pair(model, params, slots=4)
+    try:
+        for kw in ({"temperature": 0.9, "top_k": 20},
+                   {"temperature": 1.0, "top_p": 0.9},
+                   {"temperature": 0.7, "top_k": 16, "top_p": 0.95}):
+            want = dense.submit([[9, 10, 11], [4, 5]], max_new_tokens=8,
+                                **kw)
+            assert paged.submit([[9, 10, 11], [4, 5]], max_new_tokens=8,
+                                **kw) == want
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_paged_matches_dense_chunked_prefill(mp):
+    model, params = mp
+    dense, paged = _pair(model, params, slots=4, chunk_prefill=8,
+                         decode_block=3)
+    try:
+        cases = [
+            [list(range(1, 20))],                 # 19 tokens: 3 chunks
+            [list(range(30, 41)), [7, 8]],        # ragged across chunks
+            [list(range(1, 24))],
+        ]
+        for prompts in cases:
+            want = dense.submit(prompts, max_new_tokens=7)
+            assert paged.submit(prompts, max_new_tokens=7) == want
+        assert paged.stats()["adm_chunks"] >= 2
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_paged_matches_dense_prompt_cache_paths(mp):
+    """Miss, exact hit, and prefix hit must all be bit-exact AND take
+    the same cache path as dense (counters compared, not just tokens) —
+    a paged engine silently downgrading hits to misses would pass a
+    tokens-only check while giving up the zero-copy win."""
+    model, params = mp
+    dense, paged = _pair(model, params, slots=4, prompt_cache=4)
+    try:
+        prompt = [5, 6, 7, 8, 9, 10, 11, 12, 13]    # 9 toks: partial tail
+        # miss -> insert
+        want = dense.submit([prompt], max_new_tokens=6)
+        assert paged.submit([prompt], max_new_tokens=6) == want
+        # exact hit: same prompt again
+        want = dense.submit([prompt], max_new_tokens=6)
+        assert paged.submit([prompt], max_new_tokens=6) == want
+        # prefix hit: cached prompt + a new tail
+        ext = prompt + [20, 21, 22]
+        want = dense.submit([ext], max_new_tokens=6)
+        assert paged.submit([ext], max_new_tokens=6) == want
+        ds, ps = dense.stats(), paged.stats()
+        for k in ("pcache_hits", "pcache_prefix_hits", "pcache_misses"):
+            assert ps[k] == ds[k], (k, ps[k], ds[k])
+        assert ps["pcache_hits"] >= 1 and ps["pcache_prefix_hits"] >= 1
+        assert ps["pcache_shared_pages"] >= 1, (
+            "a prefix hit must actually share pages zero-copy")
+        _assert_page_invariants(paged)
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_paged_matches_dense_submit_samples(mp):
+    model, params = mp
+    dense, paged = _pair(model, params, slots=4, prompt_cache=2)
+    try:
+        sol = _solo(model, params, [5, 6, 7], 6)
+        # Mirror every request on BOTH engines: the sampling key folds
+        # on the step counter, so an asymmetric history would desync
+        # the fold sequence and void the bit-exactness comparison.
+        for eng in (dense, paged):
+            assert eng.submit_samples([5, 6, 7], 3, max_new_tokens=6,
+                                      temperature=0.0) == [sol] * 3
+        want = dense.submit_samples([9, 10, 11], 4, max_new_tokens=10,
+                                    temperature=1.0, top_k=12)
+        got = paged.submit_samples([9, 10, 11], 4, max_new_tokens=10,
+                                   temperature=1.0, top_k=12)
+        assert got == want
+        _assert_page_invariants(paged)
+    finally:
+        dense.close()
+        paged.close()
+
+
+def test_paged_engine_on_mesh_matches_dense(mp):
+    """Paged pool sharded on its kv-head axis over the 8-device CPU
+    mesh (data=2 x model=4): greedy output and the prompt-cache hit
+    must match the single-device dense engine exactly."""
+    from k3stpu.parallel.mesh import make_mesh
+    from k3stpu.parallel.sharding import shard_params
+
+    model, params = mp
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-virtual-device CPU backend")
+    mesh = make_mesh(8, model_parallelism=4)
+    sharded, _ = shard_params(params, mesh)
+    dense = GenerateEngine(model, params, slots=4, seed=0, prompt_cache=2)
+    paged = GenerateEngine(model, sharded, slots=4, seed=0, prompt_cache=2,
+                           page_size=8, mesh=mesh)
+    try:
+        prompt = [5, 6, 7, 8, 9]
+        want = dense.submit([prompt], max_new_tokens=8)
+        assert paged.submit([prompt], max_new_tokens=8) == want
+        # hit path over the mesh stays exact
+        assert paged.submit([prompt], max_new_tokens=8) == want
+        assert paged.stats()["pcache_hits"] == 1
+    finally:
+        dense.close()
+        paged.close()
+
+
+# --- static shapes: zero steady-state recompiles ------------------------
+
+
+def _jit_cache_total():
+    return sum(f._cache_size() for f in vars(GenerateEngine).values()
+               if hasattr(f, "_cache_size"))
+
+
+def test_zero_steady_state_recompiles(mp):
+    """Page assignments ride in as TRACED arrays, so after one warmup
+    pass over each program shape, further traffic — different tokens,
+    different page layouts, cache hits, evictions — must hit the jit
+    cache every time. Growth here is the paged design's failure mode
+    (a shape leak recompiles per request and erases the win)."""
+    model, params = mp
+    engine = GenerateEngine(model, params, slots=4, seed=0,
+                            prompt_cache=4, page_size=8)
+    try:
+        def traffic(base):
+            # One structural pass: single row, ragged pair, fan-out,
+            # exact hit, prefix hit — same SHAPES each round, different
+            # token values and page placements.
+            p = [base + i for i in range(9)]
+            engine.submit([p], max_new_tokens=6)
+            engine.submit([p], max_new_tokens=6)              # exact hit
+            engine.submit([p + [base + 40, base + 41, base + 42]],
+                          max_new_tokens=6)                    # prefix hit
+            engine.submit([[base, base + 1],
+                           [base + 2, base + 3, base + 4]],
+                          max_new_tokens=5)
+            engine.submit_samples([base + 7, base + 8], 3,
+                                  max_new_tokens=6, temperature=0.9)
+
+        traffic(5)                       # warmup: compiles everything
+        before = _jit_cache_total()
+        for base in (60, 120, 180):      # steady state: 3 more rounds
+            traffic(base)
+        assert _jit_cache_total() == before, (
+            "steady-state traffic recompiled a paged program")
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+# --- allocator safety ---------------------------------------------------
+
+
+def test_allocator_random_storm():
+    """Model-checked random alloc/incref/decref storm: the allocator's
+    visible state (free count, per-page refcount) must track a shadow
+    model exactly at every step; fresh pages are never aliased, the
+    sink page is never handed out, and a full drain restores the pool."""
+    rng = random.Random(0)
+    alloc = _PageAllocator(48)
+    shadow = {}                  # page -> expected refcount
+    held = []                    # chains we owe a decref for
+
+    for _ in range(3000):
+        roll = rng.random()
+        if roll < 0.45:
+            n = rng.randint(1, 6)
+            pages = alloc.alloc(n)
+            if pages is None:
+                assert n > alloc.free, "refused an alloc that fits"
+            else:
+                assert len(set(pages)) == n and 0 not in pages
+                for p in pages:
+                    assert shadow.get(p, 0) == 0, f"aliased page {p}"
+                    shadow[p] = 1
+                held.append(list(pages))
+        elif roll < 0.70 and held:
+            chain = rng.choice(held)
+            alloc.incref(chain)
+            for p in chain:
+                shadow[p] += 1
+            held.append(list(chain))
+        elif held:
+            chain = held.pop(rng.randrange(len(held)))
+            alloc.decref(chain)
+            for p in chain:
+                shadow[p] -= 1
+        live = sum(1 for v in shadow.values() if v > 0)
+        assert alloc.free == alloc.total - live
+        for p, v in shadow.items():
+            assert alloc.refcount(p) == v
+
+    for chain in held:
+        alloc.decref(chain)
+    assert alloc.free == alloc.total
+
+    with pytest.raises(RuntimeError, match="double free"):
+        alloc.decref([1])
+    with pytest.raises(RuntimeError, match="incref on free"):
+        alloc.incref([1])
+
+
+def test_pinned_pages_survive_pool_pressure(mp):
+    """Pool pressure may evict LRU prompt-cache entries, but a pinned
+    page backing a SURVIVING entry must never be reclaimed — the proof
+    is that a hit on the survivor still returns bit-exact tokens after
+    the pressure (reclaimed-and-rewritten pages would corrupt it)."""
+    model, params = mp
+    # 11 usable pages, 2 slots: big requests must squeeze the pcache.
+    engine = GenerateEngine(model, params, slots=2, seed=0,
+                            prompt_cache=8, page_size=8, num_pages=12)
+    try:
+        keep = [5, 6, 7]
+        want = engine.submit([keep], max_new_tokens=4)   # miss + pin
+        engine.submit([[30, 31, 32]], max_new_tokens=4)  # second entry
+        # Pressure: needs most of the pool; forces LRU eviction.
+        engine.submit([list(range(40, 57))], max_new_tokens=8)
+        for entry in engine._pcache.values():
+            for p in entry[0]:
+                assert engine._alloc.refcount(p) >= 1, (
+                    "pinned page reclaimed while referenced")
+        hits0 = engine.stats()["pcache_hits"]
+        assert engine.submit([keep], max_new_tokens=4) == want
+        assert engine.stats()["pcache_hits"] == hits0 + 1
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+def test_oversized_request_rejected_not_deadlocked(mp):
+    model, params = mp
+    engine = GenerateEngine(model, params, slots=2, seed=0,
+                            page_size=8, num_pages=5)  # 4 usable pages
+    try:
+        with pytest.raises(ValueError, match="pages"):
+            engine.submit([list(range(1, 30))], max_new_tokens=20)
+        # ...and the rejection leaked nothing.
+        assert engine._alloc.free == engine._alloc.total
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
+
+
+@pytest.mark.slow
+def test_paged_engine_storm_soak(mp):
+    """Randomized concurrent admit/finish/cancel storm on a TIGHT pool:
+    mixed submit/submit_samples, random eos (early row finishes -> early
+    page release), tiny random deadlines (mid-decode cancellation), and
+    prompt-cache churn. Afterwards: every slot chain released, exact
+    refcount accounting (no leak, no alias, pins intact), and the
+    engine still serves exact greedy output."""
+    model, params = mp
+    engine = GenerateEngine(model, params, slots=4, seed=0,
+                            prompt_cache=4, page_size=8, num_pages=25,
+                            decode_block=2)
+    try:
+        engine.submit([[1, 2]], max_new_tokens=2)  # warm the programs
+        outcomes = {"done": 0, "timeout": 0, "rejected": 0}
+        lock = threading.Lock()
+        stop = time.time() + 12.0
+
+        def client(seed):
+            rng = random.Random(seed)
+            while time.time() < stop:
+                budget = rng.randint(1, 10)
+                try:
+                    if rng.random() < 0.3:
+                        engine.submit_samples(
+                            [rng.randint(1, 40), rng.randint(1, 40)],
+                            rng.randint(1, 3), max_new_tokens=budget,
+                            temperature=1.0,
+                            timeout_s=rng.choice([0.02, 5.0, 30.0]))
+                    else:
+                        prompts = [
+                            [rng.randint(1, 40)
+                             for _ in range(rng.randint(1, 14))]
+                            for _ in range(rng.randint(1, 2))]
+                        engine.submit(
+                            prompts, max_new_tokens=budget,
+                            temperature=rng.choice([0.0, 0.8]),
+                            eos_id=rng.choice([None, 3]),
+                            timeout_s=rng.choice([0.02, 5.0, 30.0]))
+                    key = "done"
+                except TimeoutError:
+                    key = "timeout"
+                except ValueError:
+                    key = "rejected"   # oversized for the tight pool
+                with lock:
+                    outcomes[key] += 1
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in threads), "stuck client"
+        assert outcomes["done"] > 0, outcomes
+
+        deadline = time.time() + 30
+        while len(engine._free_slots()) != engine.slots:
+            assert time.time() < deadline, "slot leak after the storm"
+            time.sleep(0.05)
+        assert all(not c for c in engine._chains), (
+            "slot chain survived its request")
+        _assert_page_invariants(engine)
+        got = engine.submit([[5, 6, 7]], max_new_tokens=4)
+        assert got == [_solo(model, params, [5, 6, 7], 4)]
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+# --- bench mode ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_paged_bench_capacity():
+    """bench.py --serve-paged: one JSON line; >=2x concurrent slots at
+    the fixed HBM budget with decode tokens/s within 10% of dense."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve-paged"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"must print exactly one line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serve_paged_capacity_ratio"
+    assert rec["value"] >= 2.0, rec
+    assert rec["detail"]["decode_tps_ratio"] >= 0.9, rec["detail"]
