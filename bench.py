@@ -10,6 +10,10 @@ Prints exactly ONE JSON line in every outcome:
 microbench instead (same one-JSON-line contract): peak concurrent slots
 and decode tokens/s at a fixed simulated HBM budget.
 
+``--serve-obs`` measures the observability layer's decode overhead
+(same contract): decode tokens/s with tracing+histograms on vs off;
+the <5% budget from ISSUE 2, vs_baseline = overhead/5.
+
 Baseline (BASELINE.md): the reference publishes no numbers, so the target is
 BASELINE.json's north star — >=50% MFU on v5e => 98.5 bf16 TFLOP/s per chip.
 ``vs_baseline`` is achieved/98.5 (so 1.0 == the 50%-MFU target; 2.0 == peak).
@@ -307,6 +311,128 @@ def _serve_paged_worker() -> int:
     return 0
 
 
+def _serve_obs_worker() -> int:
+    """Observability overhead microbench (bounded subprocess).
+
+    The obs layer's budget is <5% on decode throughput (ISSUE 2): run
+    the SAME CPU decode microbench as --serve-paged's drive (16
+    concurrent requests, tiny model) with tracing/histograms OFF
+    (engine obs=None — the exact pre-obs code path) and ON, and compare
+    busy-time-normalized tokens/s. Best-of-3 per arm: the quantity is a
+    ceiling on per-dispatch bookkeeping cost, and min-noise beats
+    mean-of-noise for that."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+
+    import numpy as np
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+    from k3stpu.obs import ServeObs
+    from k3stpu.serve.engine import GenerateEngine
+
+    max_seq, slots = 128, 8
+    n_reqs, prompt_len, new_tokens = 16, 8, 24
+
+    model = transformer_lm_tiny(max_seq_len=max_seq)
+    params = model.init(jax.random.key(0),
+                        np.zeros((1, 1), np.int32))["params"]
+
+    def drive(engine):
+        engine.submit([[1, 2, 3]], max_new_tokens=4)  # warm compiles
+        engine.reset_stats()
+        results = [None] * n_reqs
+
+        def go(i):
+            prompt = [((i * 7 + j) % 97) + 1 for j in range(prompt_len)]
+            results[i] = engine.submit([prompt],
+                                       max_new_tokens=new_tokens)
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(n_reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not all(r is not None and len(r[0]) == new_tokens
+                   for r in results):
+            raise RuntimeError("a request failed or came back short")
+        return engine.stats()
+
+    def best_tps(obs) -> float:
+        engine = GenerateEngine(model, params, slots=slots, seed=0,
+                                obs=obs)
+        try:
+            best = 0.0
+            for _ in range(3):
+                s = drive(engine)
+                best = max(best, s["tokens_per_s"] or 0.0)
+            return best
+        finally:
+            engine.close()
+
+    off = best_tps(None)
+    on = best_tps(ServeObs())
+    overhead = (1.0 - on / off) * 100.0 if off else 0.0
+    doc = {
+        # Headline: decode tokens/s lost to tracing+histograms, in
+        # percent. The bar is 5%; vs_baseline = value/5 so <=1.0 means
+        # within budget (negative just means run-to-run noise exceeded
+        # the true overhead).
+        "metric": "serve_obs_overhead_pct",
+        "value": round(overhead, 2),
+        "unit": "pct_decode_tokens_per_s",
+        "vs_baseline": round(overhead / 5.0, 4),
+        "detail": {
+            "budget_pct": 5.0,
+            "tokens_per_s_obs_off": off,
+            "tokens_per_s_obs_on": on,
+            "runs_per_arm": 3,
+            "requests_per_run": n_reqs,
+            "new_tokens_per_request": new_tokens,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_obs_main() -> int:
+    """Bounded-subprocess wrapper for --serve-obs (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__), "--serve-obs-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False)
+    skw = {"metric": "serve_obs_overhead_pct",
+           "unit": "pct_decode_tokens_per_s"}
+    if not ok:
+        why = (f"obs bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_obs", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_paged_main() -> int:
     """Bounded-subprocess wrapper for --serve-paged (same wedge-proof
     discipline as the matmul path: the parent never imports jax)."""
@@ -393,4 +519,8 @@ if __name__ == "__main__":
         sys.exit(_serve_paged_worker())
     if "--serve-paged" in sys.argv[1:]:
         sys.exit(_serve_paged_main())
+    if "--serve-obs-worker" in sys.argv[1:]:
+        sys.exit(_serve_obs_worker())
+    if "--serve-obs" in sys.argv[1:]:
+        sys.exit(_serve_obs_main())
     sys.exit(main())

@@ -1,0 +1,132 @@
+"""Observability for the serving stack: request tracing + latency
+histograms behind one facade.
+
+``ServeObs`` is the single object server.py and engine.py share. It
+owns the latency histograms (TTFT / time-per-output-token / end-to-end
+/ queue wait / batch occupancy), the loop-sampled gauges (queue depth,
+pages free), and the bounded request-trace ring. The engine calls the
+``on_*`` hooks from its loop thread; the HTTP threads read via
+``render_prometheus`` / ``timelines`` / ``chrome_trace``. Everything
+here is zero-dep and cheap enough for the hot path — hooks are a
+handful of appends and bisects, and ``enabled=False`` turns every hook
+into an early-return no-op (the overhead microbench's baseline).
+"""
+
+from __future__ import annotations
+
+from .hist import (  # noqa: F401  (re-exported for tests/loadgen)
+    LATENCY_BUCKETS_S,
+    TPOT_BUCKETS_S,
+    Gauge,
+    Histogram,
+    parse_prometheus_histograms,
+    quantile_from_buckets,
+)
+from .trace import MAX_EVENTS_PER_TRACE, ReqTrace, TraceBuffer  # noqa: F401
+
+# Batch-occupancy-at-dispatch: active rows per decode dispatch. Slots
+# today cap at small powers of two; 64 headroom for pod configs.
+OCCUPANCY_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0)
+
+
+class ServeObs:
+    """All serving observability state, shareable between an
+    InferenceServer and its GenerateEngine."""
+
+    def __init__(self, trace_capacity: int = 256, enabled: bool = True):
+        self.enabled = enabled
+        self.traces = TraceBuffer(capacity=trace_capacity)
+        self.ttft = Histogram(
+            "k3stpu_request_ttft_seconds",
+            "Time from request enqueue to first sampled token.")
+        self.tpot = Histogram(
+            "k3stpu_request_tpot_seconds",
+            "Mean time per output token after the first (decode rate).",
+            bounds=TPOT_BUCKETS_S)
+        self.e2e = Histogram(
+            "k3stpu_request_e2e_seconds",
+            "End-to-end request latency, enqueue to completion.")
+        self.queue_wait = Histogram(
+            "k3stpu_request_queue_wait_seconds",
+            "Time a request waited in the pending queue before admission.")
+        self.batch_occupancy = Histogram(
+            "k3stpu_engine_batch_occupancy",
+            "Active decode rows at each engine dispatch.",
+            bounds=OCCUPANCY_BUCKETS)
+        self.queue_depth = Gauge(
+            "k3stpu_engine_queue_depth",
+            "Pending (not yet admitted) requests, sampled by the loop.")
+        self.pages_free = Gauge(
+            "k3stpu_engine_pages_free",
+            "Free KV pages in the paged allocator, sampled by the loop.",
+            value=-1)  # -1 = engine not running in paged mode
+
+    # -- engine hooks (loop / submitter threads) ---------------------------
+
+    def start_trace(self, **meta) -> "ReqTrace | None":
+        if not self.enabled:
+            return None
+        return self.traces.start(**meta)
+
+    def on_admit(self, tr: "ReqTrace | None", queue_wait_s: float,
+                 **attrs) -> None:
+        if not self.enabled:
+            return
+        self.queue_wait.observe(queue_wait_s)
+        if tr is not None:
+            tr.t_admit = tr.event("admit", attrs or None)
+
+    def on_first_token(self, tr: "ReqTrace | None", ttft_s: float) -> None:
+        if not self.enabled:
+            return
+        self.ttft.observe(ttft_s)
+        if tr is not None:
+            tr.t_first = tr.event("first_token")
+
+    def on_dispatch(self, n_active: int, queue_depth: int,
+                    pages_free: "int | None" = None) -> None:
+        if not self.enabled:
+            return
+        self.batch_occupancy.observe(float(n_active))
+        self.queue_depth.set(float(queue_depth))
+        if pages_free is not None:
+            self.pages_free.set(float(pages_free))
+
+    def on_complete(self, tr: "ReqTrace | None", e2e_s: float,
+                    tpot_s: "float | None") -> None:
+        if not self.enabled:
+            return
+        self.e2e.observe(e2e_s)
+        if tpot_s is not None:
+            self.tpot.observe(tpot_s)
+        if tr is not None:
+            tr.finish("ok")
+
+    def on_fail(self, tr: "ReqTrace | None", error: str) -> None:
+        if not self.enabled or tr is None:
+            return
+        tr.finish("error", error)
+
+    # -- read side (HTTP threads) ------------------------------------------
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.ttft, self.tpot, self.e2e, self.queue_wait,
+                self.batch_occupancy)
+
+    def render_prometheus(self) -> str:
+        parts = [h.render() for h in self.histograms()]
+        parts.append(self.queue_depth.render())
+        parts.append(self.pages_free.render())
+        return "\n".join(parts)
+
+    def timelines(self, n: "int | None" = None) -> "list[dict]":
+        return self.traces.timelines(n)
+
+    def chrome_trace(self) -> dict:
+        return self.traces.chrome_trace()
+
+    def reset(self) -> None:
+        for h in self.histograms():
+            h.reset()
+        self.queue_depth.set(0.0)
+        self.traces.reset()

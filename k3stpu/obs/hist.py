@@ -1,0 +1,187 @@
+"""Fixed-bucket Prometheus histograms + gauges — no client library.
+
+The serving stack exports counters as hand-rendered exposition text
+(server.py ``prometheus_metrics``); this module extends that zero-dep
+discipline to the latency distributions a continuous-batching server
+lives and dies by (TTFT, time-per-output-token, end-to-end, queue wait,
+batch occupancy — the Orca/vLLM first-class signals). Buckets are FIXED
+at construction: ``observe()`` is a bisect + two increments under one
+lock, cheap enough for the engine loop's hot path, and the exposition
+is the standard ``_bucket``/``_sum``/``_count`` triple any Prometheus
+scraper understands.
+
+``quantile()`` / ``quantile_from_buckets()`` mirror PromQL's
+``histogram_quantile`` (linear interpolation inside the winning
+bucket), so a client-side load generator can print its measured
+percentiles NEXT TO the server's own histogram estimates and make
+client/server skew visible (loadgen.py does exactly that).
+``parse_prometheus_histograms()`` is the read side: it lifts the
+``_bucket`` triples back out of exposition text.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+# Shared default bucket ladders (seconds). Wide on purpose: one ladder
+# serves a CPU-backend test (ms decode steps) and a TPU pod (µs-ms);
+# fixed buckets cost 8 bytes a cell, so generosity is free.
+LATENCY_BUCKETS_S = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0,
+)
+# Per-output-token time: decode steps are orders faster than requests.
+TPOT_BUCKETS_S = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+_NAME_HELP_TYPE = "# HELP {n} {h}\n# TYPE {n} {t}"
+
+
+class Gauge:
+    """A last-written-value metric. ``set()`` is a single attribute
+    store (atomic under the GIL) — the engine loop samples queue depth
+    and pages_free every iteration, so even a lock would be waste."""
+
+    __slots__ = ("name", "help", "value")
+
+    def __init__(self, name: str, help_text: str, value: float = 0.0):
+        self.name = name
+        self.help = help_text
+        self.value = value
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def render(self) -> str:
+        head = _NAME_HELP_TYPE.format(n=self.name, h=self.help, t="gauge")
+        return f"{head}\n{self.name} {_fmt(self.value)}"
+
+
+class Histogram:
+    """Fixed-bucket histogram with Prometheus exposition.
+
+    ``bounds`` are the bucket upper edges (le values); an implicit +Inf
+    bucket catches the tail. Counts are stored NON-cumulative and summed
+    at render — observe() then touches exactly one cell, not a prefix.
+    """
+
+    __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_lock")
+
+    def __init__(self, name: str, help_text: str,
+                 bounds: "tuple[float, ...]" = LATENCY_BUCKETS_S):
+        if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
+            raise ValueError(f"bucket bounds must be strictly increasing: "
+                             f"{bounds}")
+        self.name = name
+        self.help = help_text
+        self.bounds = tuple(float(b) for b in bounds)
+        self._counts = [0] * (len(bounds) + 1)  # [+Inf] is the last cell
+        self._sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += value
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * len(self._counts)
+            self._sum = 0.0
+
+    def snapshot(self) -> "tuple[list[int], float, int]":
+        """(cumulative bucket counts incl. +Inf, sum, count) — one lock
+        acquisition, so a render/quantile never sees a torn triple."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+        cum, running = [], 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        return cum, total_sum, running
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    def quantile(self, q: float) -> "float | None":
+        cum, _, total = self.snapshot()
+        return quantile_from_buckets(self.bounds, cum, total, q)
+
+    def render(self) -> str:
+        cum, total_sum, total = self.snapshot()
+        lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
+                                        t="histogram")]
+        for bound, c in zip(self.bounds, cum):
+            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {c}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
+        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count {total}")
+        return "\n".join(lines)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-friendly numbers: integers bare, floats without
+    trailing-zero noise (0.025 not 0.025000)."""
+    if float(v) == int(v):
+        return str(int(v))
+    return repr(float(v))
+
+
+def quantile_from_buckets(bounds, cumulative, total: int,
+                          q: float) -> "float | None":
+    """histogram_quantile()-style estimate: find the bucket where the
+    cumulative count crosses q*total and interpolate linearly inside it.
+    ``cumulative`` includes the +Inf cell (len == len(bounds)+1).
+    Returns None on an empty histogram; a quantile landing in +Inf
+    clamps to the highest finite bound (PromQL does the same)."""
+    if total <= 0:
+        return None
+    rank = q * total
+    for i, c in enumerate(cumulative):
+        if c >= rank:
+            if i >= len(bounds):  # +Inf bucket
+                return float(bounds[-1])
+            lo = bounds[i - 1] if i > 0 else 0.0
+            prev = cumulative[i - 1] if i > 0 else 0
+            in_bucket = c - prev
+            frac = (rank - prev) / in_bucket if in_bucket else 1.0
+            return lo + (bounds[i] - lo) * frac
+    return float(bounds[-1])
+
+
+def parse_prometheus_histograms(text: str) -> "dict[str, dict]":
+    """Lift histogram triples out of exposition text: name ->
+    {"bounds": [...], "cumulative": [...], "sum": float, "count": int}.
+    The read side of render(); loadgen uses it to compute server-side
+    quantiles from a live /metrics scrape (and the exposition lint test
+    uses it to check triple consistency)."""
+    out: "dict[str, dict]" = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+        except ValueError:
+            continue
+        if "_bucket{le=" in key:
+            name = key[:key.index("_bucket{le=")]
+            le = key[key.index('le="') + 4:key.rindex('"')]
+            h = out.setdefault(name, {"bounds": [], "cumulative": [],
+                                      "sum": 0.0, "count": 0})
+            if le == "+Inf":
+                h["cumulative"].append(int(float(val)))
+            else:
+                h["bounds"].append(float(le))
+                h["cumulative"].append(int(float(val)))
+        elif key.endswith("_sum") and key[:-4] in out:
+            out[key[:-4]]["sum"] = float(val)
+        elif key.endswith("_count") and key[:-6] in out:
+            out[key[:-6]]["count"] = int(float(val))
+    return out

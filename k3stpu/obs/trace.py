@@ -1,0 +1,173 @@
+"""Per-request lifecycle tracing for the serving engine.
+
+Every generate request gets an ID at ingress and a timeline of
+timestamped events as it moves through the engine loop — enqueue,
+admit, prefill chunks, prompt-cache hit/miss, first token, decode
+dispatches, completion/failure. Timelines live in a bounded ring
+(``deque(maxlen)``): fixed memory, O(1) append, and recording NEVER
+blocks the loop thread — the buffer lock is held only for the O(1)
+start/finish moves, and per-event appends are plain ``list.append``
+(safe under the GIL; readers snapshot under the lock).
+
+Two read surfaces (server.py wires them to ``GET /debug/requests`` and
+``GET /debug/trace``):
+
+- ``timelines(n)``: the last n request timelines as plain dicts —
+  the "where did this slow request spend its time" answer.
+- ``chrome_trace()``: the same data in Chrome trace-event JSON
+  (``ph: X`` spans for queue/prefill/decode, ``ph: i`` instants for the
+  raw events, one trace tid per request), so ``ui.perfetto.dev`` opens
+  a timeline of the whole engine directly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+# Per-trace event cap: a 4096-token decode at block size 1 would log
+# thousands of decode events; past this the trace notes the drop count
+# instead (the SHAPE of a timeline needs the first few hundred events,
+# not every one).
+MAX_EVENTS_PER_TRACE = 512
+
+
+class ReqTrace:
+    """One request's timeline. Mutated only by the owning request's
+    threads (submitter at enqueue, loop thread after); read by HTTP
+    threads via TraceBuffer snapshots."""
+
+    __slots__ = ("rid", "meta", "events", "dropped", "status", "error",
+                 "t_enqueue", "t_admit", "t_first", "t_done", "_buf")
+
+    def __init__(self, rid: int, meta: dict, buf: "TraceBuffer"):
+        self.rid = rid
+        self.meta = meta
+        self.events: "list[tuple[float, str, dict | None]]" = []
+        self.dropped = 0
+        self.status = "live"
+        self.error: "str | None" = None
+        self.t_enqueue: "float | None" = None
+        self.t_admit: "float | None" = None
+        self.t_first: "float | None" = None
+        self.t_done: "float | None" = None
+        self._buf = buf
+
+    def event(self, name: str, attrs: "dict | None" = None,
+              t: "float | None" = None) -> float:
+        t = time.perf_counter() if t is None else t
+        if len(self.events) < MAX_EVENTS_PER_TRACE:
+            self.events.append((t, name, attrs))
+        else:
+            self.dropped += 1
+        return t
+
+    def finish(self, status: str, error: "str | None" = None) -> None:
+        """Terminal: record the closing event and retire into the ring.
+        Idempotent — signal() is every request's single terminal path,
+        but a shutdown racing a completion must not double-retire."""
+        if self.status != "live":
+            return
+        self.t_done = self.event("complete" if status == "ok" else "fail",
+                                 {"error": error} if error else None)
+        self.status = status
+        self.error = error
+        self._buf.retire(self)
+
+    def to_dict(self) -> dict:
+        base = self._buf.wall_anchor()
+        return {
+            "rid": self.rid,
+            "status": self.status,
+            "error": self.error,
+            **self.meta,
+            "dropped_events": self.dropped,
+            "events": [
+                {"t_ms": round((t - base[0]) * 1e3 + base[1] * 1e3, 3),
+                 "name": name, **(attrs or {})}
+                for t, name, attrs in list(self.events)
+            ],
+        }
+
+
+class TraceBuffer:
+    """Bounded store of request timelines: a dict of live traces plus a
+    completed ring. ``capacity`` bounds the ring; live traces are
+    bounded by the engine's own admission limits."""
+
+    def __init__(self, capacity: int = 256):
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._live: "dict[int, ReqTrace]" = {}
+        self._done: "deque[ReqTrace]" = deque(maxlen=capacity)
+        self._next_rid = 0
+        # Anchor perf_counter to the wall clock once, so exported
+        # timestamps are absolute (Perfetto displays them as-is).
+        self._t0_perf = time.perf_counter()
+        self._t0_wall = time.time()
+
+    def wall_anchor(self) -> "tuple[float, float]":
+        return self._t0_perf, 0.0  # timelines report ms since buffer start
+
+    def start(self, **meta) -> ReqTrace:
+        with self._lock:
+            rid = self._next_rid
+            self._next_rid += 1
+            tr = ReqTrace(rid, meta, self)
+            self._live[rid] = tr
+        tr.t_enqueue = tr.event("enqueue")
+        return tr
+
+    def retire(self, tr: ReqTrace) -> None:
+        with self._lock:
+            self._live.pop(tr.rid, None)
+            self._done.append(tr)
+
+    def snapshot(self, n: "int | None" = None) -> "list[ReqTrace]":
+        """Most-recent-last list of completed + live traces."""
+        with self._lock:
+            traces = list(self._done) + sorted(
+                self._live.values(), key=lambda t: t.rid)
+        if n is not None:
+            traces = traces[-n:]
+        return traces
+
+    def timelines(self, n: "int | None" = None) -> "list[dict]":
+        return [t.to_dict() for t in self.snapshot(n)]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._done.clear()
+            # live traces stay — their requests are still in flight.
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event format (the JSON Perfetto/chrome://tracing
+        open directly): per request one tid carrying X-phase spans for
+        the queue/prefill/decode phases and i-phase instants for every
+        raw event. ts/dur are microseconds since buffer start."""
+        t0 = self._t0_perf
+        us = lambda t: round((t - t0) * 1e6, 1)
+        ev = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+               "args": {"name": "k3stpu-serve"}}]
+        for tr in self.snapshot():
+            tid = tr.rid + 1  # tid 0 is the metadata row
+            ev.append({"ph": "M", "pid": 1, "tid": tid,
+                       "name": "thread_name",
+                       "args": {"name": f"req {tr.rid}"}})
+            spans = (
+                ("queue_wait", tr.t_enqueue, tr.t_admit),
+                ("prefill", tr.t_admit, tr.t_first),
+                ("decode", tr.t_first, tr.t_done),
+            )
+            for name, a, b in spans:
+                if a is not None and b is not None and b >= a:
+                    ev.append({"ph": "X", "pid": 1, "tid": tid,
+                               "name": name, "cat": "request",
+                               "ts": us(a), "dur": round((b - a) * 1e6, 1),
+                               "args": {"rid": tr.rid}})
+            for t, name, attrs in list(tr.events):
+                ev.append({"ph": "i", "pid": 1, "tid": tid, "name": name,
+                           "cat": "event", "s": "t", "ts": us(t),
+                           "args": {**(attrs or {}), "rid": tr.rid}})
+        return {"traceEvents": ev, "displayTimeUnit": "ms"}

@@ -139,7 +139,8 @@ def _sample_rows(logits, temps, topks, topps, key):
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
-                 "deadline", "stream_q", "_ptuple", "probe", "adapter")
+                 "deadline", "stream_q", "_ptuple", "probe", "adapter",
+                 "trace")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None, adapter=0):
@@ -162,6 +163,9 @@ class _Request:
         # None. Non-streaming requests leave it None (zero overhead).
         self.stream_q: "queue.SimpleQueue | None" = None
         self._ptuple: "tuple | None" = None  # memoized prompt key
+        # Lifecycle trace (k3stpu.obs.ReqTrace), set at enqueue when the
+        # engine carries a ServeObs; None costs nothing on any path.
+        self.trace = None
         # Memoized prompt-cache probe result (pkey, pentry) — the probe
         # re-runs every loop iteration while the request waits for free
         # slots, and re-scanning the cache each time is pure engine-
@@ -183,7 +187,14 @@ class _Request:
         """Wake the submitter on EVERY terminal path (tokens ready, error,
         expiry, shutdown): terminal stream marker first, THEN the event —
         a streaming consumer must never wait on a queue nobody will feed
-        again."""
+        again. Being the single terminal funnel, this is also where the
+        lifecycle trace retires (finish() is idempotent — the success
+        path already closed it with completion timings)."""
+        if self.trace is not None:
+            if self.error is not None:
+                self.trace.finish("error", repr(self.error))
+            else:
+                self.trace.finish("ok")
         if self.stream_q is not None:
             self.stream_q.put(None)
         self.event.set()
@@ -202,7 +213,7 @@ class GenerateEngine:
                  decode_block: int = 1, prompt_cache: int = 0,
                  mesh=None, max_pending: "int | None" = None,
                  page_size: "int | None" = None,
-                 num_pages: "int | None" = None):
+                 num_pages: "int | None" = None, obs=None):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -254,7 +265,12 @@ class GenerateEngine:
         (refcounted, read-only) into admitted rows' tables instead of
         copying whole cache rows; only a partial tail page is copied
         (the row writes into it). Token streams stay bit-identical to
-        the dense engine's. None = dense cache (everything unchanged)."""
+        the dense engine's. None = dense cache (everything unchanged).
+
+        ``obs``: a ``k3stpu.obs.ServeObs`` to record per-request
+        lifecycle traces and latency histograms into (the server shares
+        one instance so /metrics and /debug/* see engine traffic).
+        None = no recording, zero overhead on every path."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
@@ -360,6 +376,7 @@ class GenerateEngine:
         self._adm: "dict | None" = None  # in-flight chunked admission
         self._closed = False
         self._lock = threading.Lock()
+        self._obs = obs
         self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
                        "busy_s": 0.0, "requests": 0,
                        "slot_occupancy_sum": 0.0, "peak_active_slots": 0,
@@ -819,6 +836,17 @@ class GenerateEngine:
         with self._lock:
             self._reject_if_full_locked()
 
+    def _trace_enqueue(self, req: "_Request", stream: bool = False) -> None:
+        """Open the request's lifecycle trace at ingress (submitter
+        thread, just before the queue put — so queue wait is measured
+        from the moment the loop COULD have seen the request)."""
+        if self._obs is not None:
+            req.trace = self._obs.start_trace(
+                rows=int(req.samples if req.samples > 1
+                         else req.block.shape[0]),
+                prompt_len=int(max(req.lens)), budget=int(req.budget),
+                stream=stream, adapter=int(req.adapter))
+
     def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
                           admitted: bool = False) -> "list[list[int]]":
         # The loop thread enforces the same deadline: a request whose
@@ -828,6 +856,7 @@ class GenerateEngine:
             self.take_admission_token()
         try:
             req.deadline = time.time() + timeout_s
+            self._trace_enqueue(req)
             self._q.put(req)
             if not req.event.wait(timeout_s + 1.0):
                 raise TimeoutError("generation did not finish in time")
@@ -923,6 +952,7 @@ class GenerateEngine:
 
     def _stream_events_inner(self, req: "_Request", timeout_s: float):
         req.deadline = time.time() + timeout_s
+        self._trace_enqueue(req, stream=True)
         self._q.put(req)
         hard = req.deadline + 1.0
         try:
@@ -961,6 +991,8 @@ class GenerateEngine:
             for k in self._stats:
                 self._stats[k] = type(self._stats[k])()
             self._stats["pcache_bytes"] = keep
+        if self._obs is not None:
+            self._obs.reset()
 
     def stats(self) -> dict:
         with self._lock:
@@ -1087,11 +1119,20 @@ class GenerateEngine:
                     return  # strict FIFO: decodes must free pages first
             self._pending.pop(i)
             admitted += 1
+            tr = req.trace
+            if self._obs is not None:
+                wait = (time.perf_counter() - tr.t_enqueue
+                        if tr is not None and tr.t_enqueue is not None
+                        else 0.0)
+                self._obs.on_admit(tr, wait, slots=nb)
             if pkey is not None:
                 exact = len(pkey) == len(prompt)
                 with self._lock:
                     self._stats["pcache_hits" if exact
                                 else "pcache_prefix_hits"] += 1
+                if tr is not None:
+                    tr.event("pcache_hit" if exact else "pcache_prefix_hit",
+                             {"cached_len": len(pkey)})
                 try:
                     if self.paged:
                         self._admit_hit_paged(req, free[:nb], n_rows,
@@ -1114,6 +1155,8 @@ class GenerateEngine:
             if prompt is not None:
                 with self._lock:
                     self._stats["pcache_misses"] += 1
+                if tr is not None:
+                    tr.event("pcache_miss")
             if req.samples > 1:
                 # Shared-prefix fan-out: prefill the ONE prompt row; the
                 # broadcast to nb rows happens at activation/finalize.
@@ -1151,6 +1194,8 @@ class GenerateEngine:
                              "n": n_rows, "chains": chains}
                 with self._lock:
                     self._stats["adm_chunks"] += 1
+                if tr is not None:
+                    tr.event("prefill_chunk", {"pos": c, "of": width})
                 return
             chains = None
             handed = False
@@ -1193,6 +1238,9 @@ class GenerateEngine:
                 a["pos"] = end
                 with self._lock:
                     self._stats["adm_chunks"] += 1
+                if req.trace is not None:
+                    req.trace.event("prefill_chunk",
+                                    {"pos": end, "of": width})
                 return
             # Finalize: every row consumed the padded width (short rows
             # carry junk K/V beyond their length). Reset each row's index
@@ -1423,6 +1471,13 @@ class GenerateEngine:
         with self._lock:
             self._stats["requests"] += 1
             self._stats["tokens"] += len(rows)  # first sampled tokens
+        if self._obs is not None and req.trace is not None:
+            tr = req.trace
+            # TTFT from ENQUEUE (the client-visible clock: queue wait +
+            # prefill), not from admission.
+            t0 = tr.t_enqueue
+            ttft = time.perf_counter() - t0 if t0 is not None else 0.0
+            self._obs.on_first_token(tr, ttft)
         if req.stream_q is not None:
             # First token per row streams immediately — it came from the
             # prefill's own logits, before any decode dispatch, so TTFT
@@ -1485,6 +1540,19 @@ class GenerateEngine:
         if any(self._active[r] for r in req.slot_rows):
             return
         pad_to = req.budget
+        if self._obs is not None and req.trace is not None:
+            tr = req.trace
+            now = time.perf_counter()
+            e2e = now - tr.t_enqueue if tr.t_enqueue is not None else 0.0
+            # Mean time per output token after the first, over the
+            # longest row (rows decode in lockstep, so the longest row's
+            # clock is the request's decode clock). Computed BEFORE the
+            # loop below clears the collected lists.
+            ntok = min(max((len(self._collected[r])
+                            for r in req.slot_rows), default=0), pad_to)
+            tpot = ((now - tr.t_first) / (ntok - 1)
+                    if tr.t_first is not None and ntok > 1 else None)
+            self._obs.on_complete(tr, e2e, tpot)
         out = []
         for r in req.slot_rows:
             toks = self._collected[r][:pad_to]
@@ -1595,6 +1663,24 @@ class GenerateEngine:
                                                       * block.shape[0])
                 self._stats["peak_active_slots"] = max(
                     self._stats["peak_active_slots"], n_active)
+            if self._obs is not None:
+                self._obs.on_dispatch(
+                    n_active, len(self._pending),
+                    self._alloc.free if self.paged else None)
+                if self._obs.enabled:
+                    # One "decode" event per request per dispatch (not
+                    # per token): slots is small, so this scan is noise
+                    # next to the device round-trip above.
+                    seen = set()
+                    attrs = {"k": block.shape[0], "active": n_active,
+                             "dt_ms": round(dt * 1e3, 3)}
+                    for r in range(self.slots):
+                        o = self._owner[r]
+                        if (o is None or o.trace is None
+                                or id(o) in seen):
+                            continue
+                        seen.add(id(o))
+                        o.trace.event("decode", attrs)
             for req in done_reqs:
                 self._maybe_complete(req)
         # Shutdown: fail anything still waiting — INCLUDING requests a

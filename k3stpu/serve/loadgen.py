@@ -154,6 +154,7 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         "examples_per_s": round(len(lat_ms) * rows / wall, 2),
         "p50_ms": round(pick(0.50), 2),
         "p95_ms": round(pick(0.95), 2),
+        "p99_ms": round(pick(0.99), 2),
     }
     if generate_tokens > 0:
         out["gen_tokens_per_request"] = generate_tokens
@@ -163,7 +164,59 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         tt = sorted(1e3 * t for t in ttfts)
         out["ttft_p50_ms"] = round(pct(tt, 0.50), 2)
         out["ttft_p95_ms"] = round(pct(tt, 0.95), 2)
+        out["ttft_p99_ms"] = round(pct(tt, 0.99), 2)
     return out
+
+
+def server_histogram_quantiles(metrics_text: str) -> dict:
+    """Server-side latency quantiles estimated from a /metrics scrape's
+    histograms (k3stpu/obs) — the numbers a Prometheus
+    histogram_quantile() over the same scrape would report. Printed next
+    to the client-measured percentiles: client >> server means time
+    spent OUTSIDE the engine (HTTP, JSON, client queueing); server >>
+    client means the estimate's bucket resolution, not a real gap."""
+    from k3stpu.obs import (
+        parse_prometheus_histograms,
+        quantile_from_buckets,
+    )
+
+    hists = parse_prometheus_histograms(metrics_text)
+    out: dict = {}
+    for short, name in (("ttft", "k3stpu_request_ttft_seconds"),
+                        ("e2e", "k3stpu_request_e2e_seconds"),
+                        ("queue_wait",
+                         "k3stpu_request_queue_wait_seconds")):
+        h = hists.get(name)
+        if not h or not h["count"]:
+            continue
+        for q in (0.50, 0.95, 0.99):
+            v = quantile_from_buckets(h["bounds"], h["cumulative"],
+                                      h["count"], q)
+            if v is not None:
+                out[f"server_{short}_p{int(q * 100)}_ms"] = round(v * 1e3,
+                                                                  2)
+    return out
+
+
+def _print_quantile_skew(result: dict) -> None:
+    """Client percentiles next to the server's histogram estimates —
+    the at-a-glance skew check (see server_histogram_quantiles)."""
+    rows = [("e2e", "{}_ms", "server_e2e_{}_ms"),
+            ("ttft", "ttft_{}_ms", "server_ttft_{}_ms")]
+    lines = []
+    for label, cfmt, sfmt in rows:
+        cells = []
+        for p in ("p50", "p95", "p99"):
+            c, s = result.get(cfmt.format(p)), result.get(sfmt.format(p))
+            if c is not None and s is not None:
+                cells.append(f"{p} {c} / {s}")
+        if cells:
+            lines.append(f"  {label:5s} {'   '.join(cells)}")
+    if lines:
+        print("latency quantiles, client-measured / server-histogram "
+              "(ms):", flush=True)
+        for ln in lines:
+            print(ln, flush=True)
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -291,6 +344,14 @@ def main(argv: "list[str] | None" = None) -> int:
         input_dtype=card["input_dtype"],
         generate_tokens=args.generate_tokens, stream=args.stream)
 
+    # Server-side histogram quantiles from the same run (best-effort:
+    # an older server without the obs layer just yields none).
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
+            result.update(server_histogram_quantiles(r.read().decode()))
+    except Exception as e:  # noqa: BLE001 — the load numbers still stand
+        print(f"(/metrics scrape failed: {e})", flush=True)
+
     with urllib.request.urlopen(card_url, timeout=60) as r:
         card = json.loads(r.read())
     result.update({
@@ -303,6 +364,7 @@ def main(argv: "list[str] | None" = None) -> int:
         "engine": card.get("engine"),
         "devices": card["devices"][:1],
     })
+    _print_quantile_skew(result)
     print("LOADGEN_JSON " + json.dumps(result), flush=True)
     return 0
 

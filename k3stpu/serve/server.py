@@ -48,6 +48,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from urllib.parse import parse_qs, urlparse
+
+from k3stpu.obs import ServeObs
+
 BATCH_SIZES = (1, 8, 32)
 
 
@@ -237,6 +241,11 @@ class InferenceServer:
                        "seconds": 0.0, "gen_requests": 0, "gen_examples": 0,
                        "tokens": 0, "gen_seconds": 0.0}
         self._gen_counter = 0  # per-request sampling key ordinal
+        # Request-lifecycle traces + latency histograms (k3stpu/obs).
+        # ONE instance feeds /metrics, /debug/requests, /debug/trace —
+        # and the engine loop's hooks when continuous batching is on.
+        self._obs = ServeObs()
+        self._profile_lock = threading.Lock()  # one /debug/profile at a time
 
         if model_name == "resnet50":
             from k3stpu.models.resnet import resnet50
@@ -523,7 +532,7 @@ class InferenceServer:
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
                 max_pending=max_pending, page_size=kv_page_size,
-                num_pages=kv_pages)
+                num_pages=kv_pages, obs=self._obs)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -573,7 +582,9 @@ class InferenceServer:
         must reset too, or the compile-dominated dispatches poison the
         committed tokens/s."""
         if self._engine is not None:
-            self._engine.reset_stats()
+            self._engine.reset_stats()  # resets the shared obs too
+        else:
+            self._obs.reset()
         with self._stats_lock:
             for k in self._stats:
                 self._stats[k] = type(self._stats[k])()
@@ -850,6 +861,9 @@ class InferenceServer:
                 self._spec_stats["requests"] += 1
                 self._spec_stats["proposed"] += spec["proposed"]
                 self._spec_stats["accepted"] += spec["accepted"]
+            # Engine-less path: the server IS the request lifecycle, so
+            # e2e is observed here (engine paths record inside the loop).
+            self._obs.e2e.observe(dt)
             return out.tolist()
 
         if self._engine is not None:
@@ -915,6 +929,7 @@ class InferenceServer:
             self._stats["gen_examples"] += n
             self._stats["tokens"] += int(out.size)
             self._stats["gen_seconds"] += dt
+        self._obs.e2e.observe(dt)  # engine-less: see the spec path note
         return out.tolist()
 
     def _spec_eligible(self, width: int, gen_budget: int,
@@ -1037,8 +1052,17 @@ class InferenceServer:
                 events.close()
 
     def busy_seconds(self) -> float:
+        """Cumulative device-busy time — the duty-cycle numerator the
+        telemetry thread differentiates. With an engine, generate busy
+        time is the LOOP's measured dispatch time (gen_seconds is
+        per-request wall time there: concurrent requests overlap on the
+        one chip and would double-count the same busy second)."""
         with self._stats_lock:
-            return self._stats["seconds"] + self._stats["gen_seconds"]
+            seconds = self._stats["seconds"]
+            gen = self._stats["gen_seconds"]
+        if self._engine is not None:
+            gen = self._engine.stats()["busy_s"]
+        return seconds + gen
 
     @staticmethod
     def _lora_rank_in(meta_tree) -> "int | None":
@@ -1054,85 +1078,135 @@ class InferenceServer:
                     return r
         return None
 
+    @staticmethod
+    def _emit(lines: list, name: str, mtype: str, help_text: str,
+              value) -> None:
+        lines += [f"# HELP {name} {help_text}",
+                  f"# TYPE {name} {mtype}",
+                  f"{name} {value}"]
+
     def prometheus_metrics(self) -> str:
-        """Prometheus text exposition of the live counters — the
-        K8s-native scrape surface (a ServiceMonitor against the Service
-        port replaces reading /v1/models by hand). Counters only; rates
-        are the scraper's job."""
+        """Prometheus text exposition of the live counters plus the obs
+        layer's latency histograms/gauges — the K8s-native scrape
+        surface (a ServiceMonitor against the Service port replaces
+        reading /v1/models by hand). Counters and distributions only;
+        rates and quantiles are the scraper's job."""
         with self._stats_lock:
             s = dict(self._stats)
-        lines = [
-            "# TYPE k3stpu_predict_requests_total counter",
-            f"k3stpu_predict_requests_total {s['requests']}",
-            "# TYPE k3stpu_predict_examples_total counter",
-            f"k3stpu_predict_examples_total {s['examples']}",
-            "# TYPE k3stpu_predict_dispatches_total counter",
-            f"k3stpu_predict_dispatches_total {s['dispatches']}",
-            "# TYPE k3stpu_predict_device_seconds_total counter",
-            f"k3stpu_predict_device_seconds_total {s['seconds']:.6f}",
-            "# TYPE k3stpu_generate_requests_total counter",
-            f"k3stpu_generate_requests_total {s['gen_requests']}",
-            "# TYPE k3stpu_generate_tokens_total counter",
-            f"k3stpu_generate_tokens_total {s['tokens']}",
-            "# TYPE k3stpu_generate_device_seconds_total counter",
-            f"k3stpu_generate_device_seconds_total {s['gen_seconds']:.6f}",
-        ]
+        lines: "list[str]" = []
+        emit = self._emit
+        emit(lines, "k3stpu_predict_requests_total", "counter",
+             "Predict requests served.", s["requests"])
+        emit(lines, "k3stpu_predict_examples_total", "counter",
+             "Predict examples (rows) served.", s["examples"])
+        emit(lines, "k3stpu_predict_dispatches_total", "counter",
+             "Device dispatches for predict (coalesced batches).",
+             s["dispatches"])
+        emit(lines, "k3stpu_predict_device_seconds_total", "counter",
+             "Device-busy seconds spent on predict.",
+             f"{s['seconds']:.6f}")
+        emit(lines, "k3stpu_generate_requests_total", "counter",
+             "Generate requests served.", s["gen_requests"])
+        emit(lines, "k3stpu_generate_tokens_total", "counter",
+             "Tokens produced by generate.", s["tokens"])
+        emit(lines, "k3stpu_generate_device_seconds_total", "counter",
+             "Wall seconds spent in generate calls.",
+             f"{s['gen_seconds']:.6f}")
         if self._engine is not None:
             e = self._engine.stats()
-            lines += [
-                "# TYPE k3stpu_engine_decode_steps_total counter",
-                f"k3stpu_engine_decode_steps_total {e['steps']}",
-                "# TYPE k3stpu_engine_dispatches_total counter",
-                f"k3stpu_engine_dispatches_total {e['dispatches']}",
-                "# TYPE k3stpu_engine_tokens_total counter",
-                f"k3stpu_engine_tokens_total {e['tokens']}",
-                "# TYPE k3stpu_engine_busy_seconds_total counter",
-                f"k3stpu_engine_busy_seconds_total {e['busy_s']:.6f}",
-            ]
+            emit(lines, "k3stpu_engine_decode_steps_total", "counter",
+                 "Engine decode steps (one token per active row).",
+                 e["steps"])
+            emit(lines, "k3stpu_engine_dispatches_total", "counter",
+                 "Engine device round-trips (decode_block steps each).",
+                 e["dispatches"])
+            emit(lines, "k3stpu_engine_tokens_total", "counter",
+                 "Tokens produced by the engine.", e["tokens"])
+            emit(lines, "k3stpu_engine_busy_seconds_total", "counter",
+                 "Engine loop device-busy seconds.",
+                 f"{e['busy_s']:.6f}")
             if self._engine.max_pending is not None:
-                lines += [
-                    "# TYPE k3stpu_engine_rejected_total counter",
-                    f"k3stpu_engine_rejected_total {e['rejected']}",
-                ]
+                emit(lines, "k3stpu_engine_rejected_total", "counter",
+                     "Requests shed at admission (backpressure 503s).",
+                     e["rejected"])
             if self._engine.prompt_cache > 0:
-                lines += [
-                    "# TYPE k3stpu_pcache_hits_total counter",
-                    f"k3stpu_pcache_hits_total {e['pcache_hits']}",
-                    "# TYPE k3stpu_pcache_prefix_hits_total counter",
-                    f"k3stpu_pcache_prefix_hits_total "
-                    f"{e['pcache_prefix_hits']}",
-                    "# TYPE k3stpu_pcache_misses_total counter",
-                    f"k3stpu_pcache_misses_total {e['pcache_misses']}",
-                    "# TYPE k3stpu_pcache_bytes gauge",
-                    f"k3stpu_pcache_bytes {e['pcache_bytes']}",
-                ]
+                emit(lines, "k3stpu_pcache_hits_total", "counter",
+                     "Prompt-cache exact hits (prefill skipped).",
+                     e["pcache_hits"])
+                emit(lines, "k3stpu_pcache_prefix_hits_total", "counter",
+                     "Prompt-cache prefix hits (suffix-only prefill).",
+                     e["pcache_prefix_hits"])
+                emit(lines, "k3stpu_pcache_misses_total", "counter",
+                     "Prompt-cache misses (full prefill).",
+                     e["pcache_misses"])
+                emit(lines, "k3stpu_pcache_bytes", "gauge",
+                     "HBM held by prompt-cache entries.",
+                     e["pcache_bytes"])
             if self._engine.paged:
-                lines += [
-                    "# TYPE k3stpu_pages_total gauge",
-                    f"k3stpu_pages_total {e['pages_total']}",
-                    "# TYPE k3stpu_pages_free gauge",
-                    f"k3stpu_pages_free {e['pages_free']}",
-                    "# TYPE k3stpu_pages_pinned gauge",
-                    f"k3stpu_pages_pinned {e['pages_pinned']}",
-                    "# TYPE k3stpu_page_utilization gauge",
-                    f"k3stpu_page_utilization {e['page_utilization']}",
-                    "# TYPE k3stpu_pcache_shared_pages gauge",
-                    f"k3stpu_pcache_shared_pages "
-                    f"{e['pcache_shared_pages']}",
-                    "# TYPE k3stpu_paged_density_ratio gauge",
-                    f"k3stpu_paged_density_ratio "
-                    f"{e['paged_density_ratio']}",
-                ]
+                emit(lines, "k3stpu_pages_total", "gauge",
+                     "Allocatable KV pages in the pool.",
+                     e["pages_total"])
+                emit(lines, "k3stpu_pages_free", "gauge",
+                     "KV pages currently free.", e["pages_free"])
+                emit(lines, "k3stpu_pages_pinned", "gauge",
+                     "KV pages pinned by prompt-cache entries.",
+                     e["pages_pinned"])
+                emit(lines, "k3stpu_page_utilization", "gauge",
+                     "Fraction of the page pool in use.",
+                     e["page_utilization"])
+                emit(lines, "k3stpu_pcache_shared_pages", "gauge",
+                     "Pinned pages with more than one reference.",
+                     e["pcache_shared_pages"])
+                emit(lines, "k3stpu_paged_density_ratio", "gauge",
+                     "Dense token-slots per actual pooled token-slot.",
+                     e["paged_density_ratio"])
         if self._draft is not None:
             with self._stats_lock:
                 sp = dict(self._spec_stats)
-            lines += [
-                "# TYPE k3stpu_spec_proposed_total counter",
-                f"k3stpu_spec_proposed_total {sp['proposed']}",
-                "# TYPE k3stpu_spec_accepted_total counter",
-                f"k3stpu_spec_accepted_total {sp['accepted']}",
-            ]
-        return "\n".join(lines) + "\n"
+            emit(lines, "k3stpu_spec_proposed_total", "counter",
+                 "Draft tokens proposed by speculative decode.",
+                 sp["proposed"])
+            emit(lines, "k3stpu_spec_accepted_total", "counter",
+                 "Draft tokens accepted by the target model.",
+                 sp["accepted"])
+        return "\n".join(lines) + "\n" + self._obs.render_prometheus() \
+            + "\n"
+
+    def debug_timelines(self, n: int = 50) -> dict:
+        """Last n request timelines (completed ring + live), newest
+        last — the GET /debug/requests payload."""
+        return {"requests": self._obs.timelines(n)}
+
+    def debug_trace(self) -> dict:
+        """Chrome-trace-format export of the request ring — the GET
+        /debug/trace payload; save as .json and open in
+        ui.perfetto.dev or chrome://tracing."""
+        return self._obs.chrome_trace()
+
+    def debug_profile(self, seconds: float) -> str:
+        """On-demand jax.profiler capture around whatever the process is
+        dispatching (the engine loop keeps running — that's the point:
+        the capture sees live decode steps, not a synthetic workload).
+        Returns the trace directory; open it with tensorboard's profile
+        plugin or xprof. One capture at a time; seconds is clamped so a
+        fat-fingered request can't pin the handler thread for minutes."""
+        import tempfile
+
+        import jax
+
+        seconds = min(max(float(seconds), 0.1), 60.0)
+        if not self._profile_lock.acquire(blocking=False):
+            raise RuntimeError("a profile capture is already running")
+        try:
+            out = tempfile.mkdtemp(prefix="k3stpu-profile-")
+            jax.profiler.start_trace(out)
+            try:
+                time.sleep(seconds)
+            finally:
+                jax.profiler.stop_trace()
+            return out
+        finally:
+            self._profile_lock.release()
 
     def _spec_card(self) -> "dict | None":
         if self._draft is None:
@@ -1265,10 +1339,35 @@ def make_app(server: InferenceServer):
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
+            elif self.path.startswith("/debug/requests"):
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    n = int(q.get("n", ["50"])[0])
+                except ValueError:
+                    self._send(400, {"error": "n must be an integer"})
+                    return
+                self._send(200, server.debug_timelines(n))
+            elif self.path.startswith("/debug/trace"):
+                self._send(200, server.debug_trace())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path.startswith("/debug/profile"):
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    seconds = float(q.get("seconds", ["3"])[0])
+                except ValueError:
+                    self._send(400,
+                               {"error": "seconds must be a number"})
+                    return
+                try:
+                    path = server.debug_profile(seconds)
+                except RuntimeError as e:  # capture already in flight
+                    self._send(409, {"error": str(e)})
+                    return
+                self._send(200, {"artifact": path})
+                return
             if self.path == "/v1/score":
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
@@ -1355,9 +1454,11 @@ def start_telemetry_thread(server: InferenceServer,
         while True:
             time.sleep(interval)
             busy, now = server.busy_seconds(), time.monotonic()
-            duty = int(min(100.0,
+            # Clamp below at 0: a reset_stats() between drops (warmup,
+            # loadgen) makes the busy counter go backwards once.
+            duty = int(min(100.0, max(0.0,
                            100.0 * (busy - last_busy)
-                           / max(now - last_t, 1e-9)))
+                           / max(now - last_t, 1e-9))))
             write_metrics(duty_cycle_pct=duty)
             last_busy, last_t = busy, now
 

@@ -37,8 +37,6 @@ HBM_BYTES = {
 
 
 def _hbm_limit_for(device) -> int:
-    import os
-
     kind = getattr(device, "device_kind", "").lower()
     for key, hbm in HBM_BYTES.items():
         if key in kind:

@@ -21,8 +21,9 @@
 #            entry + 8-device dryrun). The full two-process suite stays
 #            the round gate; smoke exists so intermediate commits keep a
 #            fast green signal as the suite's wall time grows. Paged-KV
-#            exactness rides along minus its @slow soak/bench tests
-#            (the full suite runs those).
+#            exactness and the serving observability layer (histograms,
+#            request traces, /debug endpoints) ride along minus their
+#            @slow soak/bench tests (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -40,7 +41,7 @@ if [ "${1:-}" = "--smoke" ]; then
     tests/test_container_runtime.py tests/test_device_plugin.py \
     tests/test_e2e_assets.py \
     tests/test_bench.py tests/test_graft_entry.py \
-    tests/test_paged.py -m "not slow" "$@"
+    tests/test_paged.py tests/test_obs.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

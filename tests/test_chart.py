@@ -33,6 +33,32 @@ def test_default_render_objects():
     assert ("DaemonSet", "k3s-tpu-feature-discovery") in objs
 
 
+def test_inference_disabled_by_default():
+    # The chart installs infrastructure; the serving workload is opt-in,
+    # and the default golden renderings must stay byte-stable.
+    objs = render()
+    assert ("Deployment", "tpu-inference") not in objs
+    assert ("Service", "tpu-inference") not in objs
+
+
+def test_inference_enabled_carries_scrape_annotations():
+    objs = render({"inference.enabled": "true"}, namespace="serve-ns")
+    dep = objs[("Deployment", "tpu-inference")]
+    assert dep["metadata"]["namespace"] == "serve-ns"
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    svc = objs[("Service", "tpu-inference")]
+    (port,) = svc["spec"]["ports"]
+    # The scrape port must agree with the Service port, values-driven.
+    assert ann["prometheus.io/port"] == str(port["port"]) == "8096"
+    pod = dep["spec"]["template"]["spec"]
+    assert pod["runtimeClassName"] == "tpu"
+    (ctr,) = pod["containers"]
+    assert ctr["resources"]["limits"]["google.com/tpu"] == "1"
+    assert ctr["readinessProbe"]["httpGet"]["port"] == port["port"]
+
+
 def test_runtimeclass_and_namespace():
     objs = render(namespace="custom-ns")
     rc = objs[("RuntimeClass", "tpu")]

@@ -77,6 +77,19 @@ def test_inference_deployment_parity():
     assert spec["selector"]["matchLabels"] == {"app": "tpu-inference"}
 
 
+def test_inference_pod_scrape_annotations():
+    # The serving pod advertises its /metrics endpoint the standard way,
+    # and the port annotation must agree with the Service port.
+    docs = load_all("tpu-inference.yaml")
+    (dep,) = by_kind(docs, "Deployment")
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    (svc,) = by_kind(docs, "Service")
+    (port,) = svc["spec"]["ports"]
+    assert ann["prometheus.io/port"] == str(port["port"])
+
+
 def test_pjit_job_rendezvous_wiring():
     # SURVEY.md §3.5: indexed pods + headless Service rendezvous.
     docs = load_all("tpu-pjit-job.yaml")

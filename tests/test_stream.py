@@ -364,13 +364,16 @@ def test_http_503_when_engine_at_capacity():
     try:
         server.generate_tokens([[1, 2]], max_new_tokens=2)  # warm
         eng = server._engine
-        real = eng._decode_step
+        # The server's engine dispatches through the k>1 block path
+        # (decode_block=4 default) — slow THAT one; _decode_step is the
+        # k==1 path and never runs here, so patching it holds nothing.
+        real = eng._decode_block_step
 
         def slow_step(*args, **kwargs):
             time.sleep(0.05)
             return real(*args, **kwargs)
 
-        eng._decode_step = slow_step
+        eng._decode_block_step = slow_step
         # Budget 48 x 50 ms per (4-token) dispatch ~ 600 ms of held
         # capacity — the probe requests below must land inside it even
         # on a loaded CI box.
@@ -395,7 +398,7 @@ def test_http_503_when_engine_at_capacity():
              "stream": True})
         assert st2 == 503 and "capacity" in body2["error"]
         hold.join(timeout=120)
-        eng._decode_step = real
+        eng._decode_block_step = real
         status, body = _post_json(
             url + "/v1/generate",
             {"prompt_tokens": [[7, 8]], "max_new_tokens": 2})
