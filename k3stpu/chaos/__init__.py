@@ -1,0 +1,140 @@
+"""Deterministic fault injection for the serving stack (docs/RESILIENCE.md).
+
+The containment layer (watchdog, circuit breaker, crash-only reset — see
+``k3stpu/serve/containment.py``) is only trustworthy if every failure
+mode it claims to contain is exercised on purpose. This package is that
+trigger: a tiny injector the engine and HTTP server consult at their
+fault boundaries, armed explicitly by tests (or, for subprocess tests,
+via the ``K3STPU_CHAOS`` environment variable).
+
+Design constraints, in order:
+
+- **Off by default, zero overhead when off.** Components hold
+  ``self._chaos = None`` and every hook is a single ``is not None``
+  check; nothing here runs in production paths.
+- **Deterministic.** A fault fires exactly ``times`` times after
+  ``skip`` skips, in program order at a named point — no probabilities,
+  no clocks. Chaos tests assert invariants, so the fault schedule must
+  be exact.
+- **Observable.** ``fired()`` counts let tests assert the fault actually
+  triggered (a chaos test whose fault never fired is vacuously green).
+
+Fault points wired in this repo:
+
+====================  =====================================================
+point                 boundary
+====================  =====================================================
+``engine_loop``       top of the engine loop body, *outside* the dispatch
+                      try — a raised fault kills the loop thread
+                      (watchdog revival path)
+``decode_dispatch``   inside the dispatch try — ``exc`` exercises the
+                      crash-only reset, ``stall_s`` the watchdog trip
+``page_alloc``        page-chain allocation during admission —
+                      exercises pool-exhaustion rollback
+``sse_write``         per-event SSE write in the HTTP handler — a raised
+                      ``BrokenPipeError`` simulates a client disconnect
+                      mid-stream
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class InjectedFault(RuntimeError):
+    """Default exception raised by an armed fault (stands in for an XLA
+    backend error escaping a device dispatch)."""
+
+
+class FaultInjector:
+    """Registry of armed faults, consulted via ``fire(point)``."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._faults: dict[str, dict] = {}
+        self._fired: dict[str, int] = {}
+
+    def arm(self, point: str, *, times: int = 1, skip: int = 0,
+            exc: "BaseException | type | None" = None,
+            stall_s: "float | None" = None) -> None:
+        """Arm ``point`` to fire ``times`` times (after ``skip`` silent
+        passes). Each firing sleeps ``stall_s`` if set, then raises
+        ``exc`` if set (an instance, or a type to instantiate)."""
+        if times < 1:
+            raise ValueError("times must be >= 1")
+        if exc is None and stall_s is None:
+            exc = InjectedFault(f"chaos: injected fault at {point!r}")
+        with self._lock:
+            self._faults[point] = {
+                "times": int(times), "skip": int(skip),
+                "exc": exc, "stall_s": stall_s,
+            }
+
+    def disarm(self, point: "str | None" = None) -> None:
+        with self._lock:
+            if point is None:
+                self._faults.clear()
+            else:
+                self._faults.pop(point, None)
+
+    def fired(self, point: str) -> int:
+        """How many times ``point`` has actually fired."""
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    def fire(self, point: str) -> None:
+        """Called by instrumented components at a fault boundary."""
+        if not self._faults:          # fast path: nothing armed anywhere
+            return
+        with self._lock:
+            f = self._faults.get(point)
+            if f is None:
+                return
+            if f["skip"] > 0:
+                f["skip"] -= 1
+                return
+            f["times"] -= 1
+            if f["times"] <= 0:
+                del self._faults[point]
+            self._fired[point] = self._fired.get(point, 0) + 1
+            exc, stall_s = f["exc"], f["stall_s"]
+        if stall_s is not None:
+            time.sleep(stall_s)
+        if exc is not None:
+            raise exc() if isinstance(exc, type) else exc
+
+    @classmethod
+    def from_env(cls, spec: str) -> "FaultInjector":
+        """Build an injector from a ``K3STPU_CHAOS`` spec string, so
+        subprocess tests (SIGTERM drain) can inject faults into a real
+        server process.
+
+        Spec: semicolon-separated faults, each ``point:key=value:...``
+        with keys ``times``, ``skip``, ``stall_s``, ``exc`` (message for
+        an ``InjectedFault``). Example::
+
+            K3STPU_CHAOS="decode_dispatch:stall_s=2.5:times=1"
+        """
+        inj = cls()
+        for part in spec.split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            fields = part.split(":")
+            point, kw = fields[0], {}
+            for field in fields[1:]:
+                key, _, val = field.partition("=")
+                if key == "times":
+                    kw["times"] = int(val)
+                elif key == "skip":
+                    kw["skip"] = int(val)
+                elif key == "stall_s":
+                    kw["stall_s"] = float(val)
+                elif key == "exc":
+                    kw["exc"] = InjectedFault(val or f"chaos at {point!r}")
+                else:
+                    raise ValueError(f"unknown chaos field {key!r} in {part!r}")
+            inj.arm(point, **kw)
+        return inj

@@ -1,0 +1,171 @@
+"""Failure containment primitives for the serving stack (docs/RESILIENCE.md).
+
+Three small pieces the engine and HTTP layer share:
+
+- ``EngineStalled`` / ``CircuitOpen``: the retryable error types the
+  containment layer raises instead of letting clients hang. Both map to
+  HTTP 503 + ``Retry-After`` in the server, so a well-behaved client (or
+  ``loadgen``'s backoff loop) retries against a replica that is healthy.
+
+- ``CircuitBreaker``: classic closed -> open -> half-open breaker over
+  *backend* failures (device dispatch / prefill exceptions — never client
+  errors or deadline expiries). While open, admission rejects instantly
+  and ``/healthz`` reports not-ready, so Kubernetes stops routing to the
+  pod; after ``cooldown_s`` one probe request is let through (half-open)
+  and its outcome decides whether the breaker closes or re-opens.
+
+The breaker is deliberately time-function injectable and lock-cheap: the
+``record_success`` fast path on a healthy engine is one attribute read.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+class EngineStalled(RuntimeError):
+    """The engine loop stopped making progress (watchdog trip or loop
+    death). The request failed cleanly and is safe to retry."""
+
+
+class CircuitOpen(RuntimeError):
+    """Admission rejected because the circuit breaker is open after
+    repeated backend failures. Retry after ``retry_after_s``."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+# Gauge encoding for /metrics (k3stpu_breaker_state).
+_STATE_VALUE = {"closed": 0, "half_open": 1, "open": 2}
+
+
+class CircuitBreaker:
+    """Circuit breaker over consecutive backend failures.
+
+    States:
+      closed    — all traffic flows; ``threshold`` *consecutive* backend
+                  failures trip it open.
+      open      — admission rejects with ``CircuitOpen``; ``/healthz``
+                  reports not-ready. After ``cooldown_s`` the next
+                  ``allow()`` caller becomes the half-open probe.
+      half_open — exactly one probe request in flight; success closes the
+                  breaker, failure re-opens it. A probe lease older than
+                  ``cooldown_s`` is considered lost (the probe's client
+                  died without the request reaching a terminal record_*)
+                  and a new probe is granted, so the breaker cannot wedge
+                  itself half-open forever.
+    """
+
+    def __init__(self, threshold: int = 5, cooldown_s: float = 5.0,
+                 time_fn=time.monotonic):
+        if threshold < 1:
+            raise ValueError("threshold must be >= 1")
+        self.threshold = int(threshold)
+        self.cooldown_s = float(cooldown_s)
+        self._now = time_fn
+        self._lock = threading.Lock()
+        self._state = "closed"
+        self._consecutive = 0          # consecutive backend failures
+        self._opened_at = 0.0          # when the breaker last opened
+        self._probe_at: float | None = None   # outstanding probe lease
+        self.trips = 0                 # total closed/half_open -> open
+
+    # -- state ---------------------------------------------------------
+
+    def _state_locked(self) -> str:
+        """Current state with the time-based open -> half_open edge
+        applied on read (so /healthz turns ready the moment a probe may
+        flow, without waiting for a request to call allow())."""
+        if (self._state == "open"
+                and self._now() - self._opened_at >= self.cooldown_s):
+            return "half_open"
+        return self._state
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def state_value(self) -> int:
+        return _STATE_VALUE[self.state()]
+
+    def retry_after_s(self) -> float:
+        """Seconds until a retry has a chance of being admitted."""
+        with self._lock:
+            if self._state != "open":
+                return 1.0
+            return max(0.1, self.cooldown_s - (self._now() - self._opened_at))
+
+    # -- transitions ---------------------------------------------------
+
+    def allow(self) -> "tuple[bool, bool]":
+        """Admission gate. Returns ``(admitted, is_probe)``.
+
+        Closed: ``(True, False)``. Open before cooldown: ``(False,
+        False)``. At/after cooldown the caller is granted the half-open
+        probe lease ``(True, True)`` — at most one outstanding lease per
+        ``cooldown_s`` window.
+        """
+        with self._lock:
+            if self._state == "closed":
+                return True, False
+            now = self._now()
+            if self._state == "open":
+                if now - self._opened_at < self.cooldown_s:
+                    return False, False
+                self._state = "half_open"
+                self._probe_at = now
+                return True, True
+            # half_open: one probe at a time, but a lease older than
+            # cooldown_s is presumed lost and replaced.
+            if self._probe_at is not None and now - self._probe_at < self.cooldown_s:
+                return False, False
+            self._probe_at = now
+            return True, True
+
+    def probe_aborted(self) -> None:
+        """The half-open probe never reached the backend (e.g. it lost
+        the capacity race and got EngineOverloaded) — return the lease so
+        the next caller can probe immediately."""
+        with self._lock:
+            if self._state == "half_open":
+                self._probe_at = None
+
+    def record_success(self) -> None:
+        """A backend dispatch completed. Closes the breaker."""
+        # Lock-free fast path for the healthy steady state.
+        if self._state == "closed" and self._consecutive == 0:
+            return
+        with self._lock:
+            self._consecutive = 0
+            self._state = "closed"
+            self._probe_at = None
+
+    def record_failure(self) -> None:
+        """A backend dispatch (or prefill/admission device call) failed."""
+        with self._lock:
+            self._consecutive += 1
+            # The time-based edge may have moved open -> half_open without
+            # any allow() call; honor it so a failure while probing
+            # restarts the cooldown window.
+            state = self._state_locked()
+            if state == "half_open" or (
+                    state == "closed" and self._consecutive >= self.threshold):
+                self._trip_locked()
+
+    def trip_open(self) -> None:
+        """Force the breaker open (watchdog-detected stall)."""
+        with self._lock:
+            if self._state_locked() != "open":
+                self._trip_locked()
+            else:
+                # Already open: restart the cooldown clock.
+                self._opened_at = self._now()
+
+    def _trip_locked(self) -> None:
+        self._state = "open"
+        self._opened_at = self._now()
+        self._probe_at = None
+        self.trips += 1

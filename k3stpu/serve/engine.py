@@ -36,6 +36,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from k3stpu.models.generate import init_cache, paged_model, set_cache_index
+from k3stpu.serve.containment import CircuitOpen, EngineStalled
 from k3stpu.serve.programs import (
     decode_core,
     extend_core,
@@ -213,7 +214,9 @@ class GenerateEngine:
                  decode_block: int = 1, prompt_cache: int = 0,
                  mesh=None, max_pending: "int | None" = None,
                  page_size: "int | None" = None,
-                 num_pages: "int | None" = None, obs=None):
+                 num_pages: "int | None" = None, obs=None,
+                 breaker=None, watchdog_s: "float | None" = None,
+                 chaos=None):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -270,7 +273,23 @@ class GenerateEngine:
         ``obs``: a ``k3stpu.obs.ServeObs`` to record per-request
         lifecycle traces and latency histograms into (the server shares
         one instance so /metrics and /debug/* see engine traffic).
-        None = no recording, zero overhead on every path."""
+        None = no recording, zero overhead on every path.
+
+        ``breaker``: a ``containment.CircuitBreaker``. Backend dispatch
+        failures feed it; while open, admission raises ``CircuitOpen``
+        (HTTP 503 + Retry-After, ``/healthz`` not-ready) until a
+        half-open probe request succeeds. None = no breaker.
+
+        ``watchdog_s``: start a watchdog thread that fails in-flight
+        requests with retryable ``EngineStalled`` errors when the loop
+        makes no progress for this many seconds (a wedged backend
+        dispatch), and revives the loop thread if it dies. Must exceed
+        the worst-case single dispatch (including cold compiles). None =
+        no watchdog (the library default; the HTTP server turns it on).
+
+        ``chaos``: a ``k3stpu.chaos.FaultInjector`` consulted at the
+        loop/dispatch/allocator fault boundaries. None (the default) =
+        no injection, zero overhead — production paths never arm this."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
@@ -285,6 +304,8 @@ class GenerateEngine:
         if prompt_cache < 0:
             raise ValueError(f"prompt_cache must be >= 0, got "
                              f"{prompt_cache}")
+        if watchdog_s is not None and watchdog_s <= 0:
+            raise ValueError(f"watchdog_s must be > 0, got {watchdog_s}")
         self.model = model
         self.params = params
         self.slots = slots
@@ -383,15 +404,36 @@ class GenerateEngine:
                        "adm_chunks": 0,
                        "pcache_hits": 0, "pcache_prefix_hits": 0,
                        "pcache_misses": 0, "pcache_bytes": 0,
-                       "rejected": 0}
+                       "rejected": 0,
+                       # Containment counters (docs/RESILIENCE.md).
+                       "deadline_expired": 0, "watchdog_trips": 0,
+                       "loop_crashes": 0, "loop_restarts": 0,
+                       "breaker_rejected": 0}
         # Prompt cache: tuple(prompt tokens) -> (cache_1row, last_1row),
         # insertion-ordered dict as LRU (loop thread only).
         self.prompt_cache = prompt_cache
         self._pcache: "dict[tuple, tuple]" = {}
 
-        self._thread = threading.Thread(target=self._loop, daemon=True,
+        # Containment state (docs/RESILIENCE.md). _waiters is every
+        # client thread currently blocked on a request's event — the set
+        # the watchdog fails with retryable errors when the loop stalls.
+        self.breaker = breaker
+        self._chaos = chaos
+        self.watchdog_s = watchdog_s
+        self._waiters: "set[_Request]" = set()  # guarded by _lock
+        self._heartbeat = time.monotonic()  # stamped each loop iteration
+        self._loop_exc: "BaseException | None" = None
+
+        self._thread = threading.Thread(target=self._loop_main, daemon=True,
                                         name="generate-engine")
         self._thread.start()
+        self._watchdog: "threading.Thread | None" = None
+        self._wd_stop = threading.Event()
+        if watchdog_s is not None:
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, daemon=True,
+                name="engine-watchdog")
+            self._watchdog.start()
 
     # --- jitted device programs (compiled once per static bucket) -------
 
@@ -665,6 +707,8 @@ class GenerateEngine:
         chain for row 0 only — siblings get just their non-shared pages
         (install increfs the shared prefix into their chains)."""
         B = req.budget
+        if self._chaos is not None:
+            self._chaos.fire("page_alloc")
         if req.samples > 1:
             L = int(lens[0])
             total = self._pages_for(L, B)
@@ -804,6 +848,23 @@ class GenerateEngine:
                 f"engine at capacity: {self._inflight} requests in "
                 f"flight (max_pending={self.max_pending})")
 
+    def _breaker_gate(self) -> bool:
+        """Circuit-breaker admission gate. Returns True when this caller
+        holds the half-open probe lease; raises CircuitOpen (counted in
+        breaker_rejected) when the breaker refuses traffic."""
+        br = self.breaker
+        if br is None:
+            return False
+        admitted, probe = br.allow()
+        if not admitted:
+            retry = br.retry_after_s()
+            with self._lock:
+                self._stats["breaker_rejected"] += 1
+            raise CircuitOpen(
+                f"circuit breaker open after repeated backend failures; "
+                f"retry in {retry:.1f}s", retry_after_s=retry)
+        return probe
+
     def take_admission_token(self) -> None:
         """Claim one unit of max_pending or raise EngineOverloaded.
         Callers that split ONE logical request into several chunk
@@ -811,9 +872,18 @@ class GenerateEngine:
         the whole request and pass ``admitted=True`` to the submits —
         re-gating per chunk would reject an already-admitted request
         mid-flight after burning its earlier chunks' decode work."""
-        with self._lock:
-            self._reject_if_full_locked()
-            self._inflight += 1
+        probe = self._breaker_gate()
+        try:
+            with self._lock:
+                self._reject_if_full_locked()
+                self._inflight += 1
+        except EngineOverloaded:
+            if probe:
+                # The half-open probe lost the capacity race before
+                # reaching the backend — return the lease so the next
+                # arrival can probe instead of waiting out the window.
+                self.breaker.probe_aborted()
+            raise
 
     def release_admission_token(self) -> None:
         with self._lock:
@@ -833,6 +903,14 @@ class GenerateEngine:
         authoritative take failure) when at capacity. For callers that
         must 503 before response headers but defer the real token take
         until their generator actually starts."""
+        br = self.breaker
+        if br is not None and br.state() == "open":
+            retry = br.retry_after_s()
+            with self._lock:
+                self._stats["breaker_rejected"] += 1
+            raise CircuitOpen(
+                f"circuit breaker open after repeated backend failures; "
+                f"retry in {retry:.1f}s", retry_after_s=retry)
         with self._lock:
             self._reject_if_full_locked()
 
@@ -857,12 +935,21 @@ class GenerateEngine:
         try:
             req.deadline = time.time() + timeout_s
             self._trace_enqueue(req)
-            self._q.put(req)
-            if not req.event.wait(timeout_s + 1.0):
-                raise TimeoutError("generation did not finish in time")
-            if req.error is not None:
-                raise req.error
-            return req.tokens
+            # Waiter registry: the watchdog fails everyone in this set
+            # with a retryable error when the loop stalls or dies, so a
+            # client blocks for at most ~watchdog_s, never timeout_s.
+            with self._lock:
+                self._waiters.add(req)
+            try:
+                self._q.put(req)
+                if not req.event.wait(timeout_s + 1.0):
+                    raise TimeoutError("generation did not finish in time")
+                if req.error is not None:
+                    raise req.error
+                return req.tokens
+            finally:
+                with self._lock:
+                    self._waiters.discard(req)
         finally:
             if not admitted:
                 self.release_admission_token()
@@ -953,6 +1040,8 @@ class GenerateEngine:
     def _stream_events_inner(self, req: "_Request", timeout_s: float):
         req.deadline = time.time() + timeout_s
         self._trace_enqueue(req, stream=True)
+        with self._lock:
+            self._waiters.add(req)
         self._q.put(req)
         hard = req.deadline + 1.0
         try:
@@ -969,6 +1058,8 @@ class GenerateEngine:
                     return
                 yield {"done": False, "rows": item}
         finally:
+            with self._lock:
+                self._waiters.discard(req)
             # Consumer abandoned the stream (generator .close() on client
             # disconnect, or an exception in the consumer): expire the
             # request NOW so the loop reaps its queue entry / admission /
@@ -979,8 +1070,17 @@ class GenerateEngine:
 
     def close(self) -> None:
         self._closed = True
+        self._wd_stop.set()
         self._q.put(None)
         self._thread.join(timeout=60)
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=5)
+
+    def loop_alive(self) -> bool:
+        """Liveness of the engine loop thread (the server's /healthz
+        consults this; the watchdog revives a dead loop, so not-alive is
+        a transient not-ready, not a terminal state)."""
+        return self._thread.is_alive()
 
     def reset_stats(self) -> None:
         """Zero the counters (post-warmup: compile-dominated dispatches
@@ -1002,6 +1102,9 @@ class GenerateEngine:
         s["avg_active_slots"] = (round(s["slot_occupancy_sum"] / s["steps"],
                                        2) if s["steps"] else None)
         s["pcache_entries"] = len(self._pcache)
+        if self.breaker is not None:
+            s["breaker_state"] = self.breaker.state()
+            s["breaker_trips"] = self.breaker.trips
         if self.paged:
             total, free = self._alloc.total, self._alloc.free
             s["pages_total"] = total
@@ -1149,6 +1252,7 @@ class GenerateEngine:
                         small, last = self._broadcast_rows(small, last, nb)
                     self._activate(req, free[:nb], n_rows, small, last)
                 except Exception as e:  # noqa: BLE001 — fail the one request
+                    self._record_backend_failure()
                     req.error = e
                     req.signal()
                 continue
@@ -1183,6 +1287,7 @@ class GenerateEngine:
                         jnp.full((block.shape[0],), c, jnp.int32),
                         self._aid_arg(block.shape[0], req.adapter))
                 except Exception as e:  # noqa: BLE001
+                    self._record_backend_failure()
                     self._free_chains(chains)
                     req.error = e
                     req.signal()
@@ -1217,6 +1322,7 @@ class GenerateEngine:
                                chains=chains,
                                pinsert=prompt if self.paged else None)
             except Exception as e:  # noqa: BLE001 — fail the one request
+                self._record_backend_failure()
                 if not handed:
                     self._free_chains(chains)
                 req.error = e
@@ -1277,6 +1383,7 @@ class GenerateEngine:
             self._activate(req, a["rows"], a["n"], cache, last,
                            chains=chains, pinsert=pinsert)
         except Exception as e:  # noqa: BLE001 — fail the one request
+            self._record_backend_failure()
             self._abort_admission(a, e)
 
     def _abort_admission(self, a: dict, err: Exception) -> None:
@@ -1519,22 +1626,29 @@ class GenerateEngine:
     def _expire_deadlines(self) -> None:
         """Free resources of requests whose client stopped waiting."""
         now = time.time()
+        n_expired = 0
         expired = [r for r in self._pending if now > r.deadline]
         for req in expired:
             self._pending.remove(req)
             req.error = TimeoutError("expired while queued")
             req.signal()
+            n_expired += 1
         # The in-flight chunked admission too: its client may have given
         # up mid-prefill, and without this check the remaining chunks (and
         # the whole decode budget) would still run for nobody.
         if self._adm is not None and now > self._adm["req"].deadline:
             self._abort_admission(self._adm,
                                   TimeoutError("expired during admission"))
+            n_expired += 1
         for req in {self._owner[r] for r in range(self.slots)
                     if self._owner[r] is not None}:
             if now > req.deadline:
                 self._fail_request(
                     req, TimeoutError("expired while decoding"))
+                n_expired += 1
+        if n_expired:
+            with self._lock:
+                self._stats["deadline_expired"] += n_expired
 
     def _maybe_complete(self, req: "_Request") -> None:
         if any(self._active[r] for r in req.slot_rows):
@@ -1565,8 +1679,121 @@ class GenerateEngine:
         req.tokens = out
         req.signal()
 
+    def _record_backend_failure(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()
+
+    def _crash_reset(self, err: Exception) -> None:
+        """Crash-only containment after an unexpected dispatch failure
+        (or a dead loop thread being revived): fail everything holding
+        device state CLEANLY, then rebuild the host-side cache
+        bookkeeping to a verified-empty baseline. The KV pool arrays
+        themselves need no scrubbing — rows/pages are fully overwritten
+        at admission, and junk beyond a row's index is invisible to the
+        position mask — but the prompt cache and page chains may
+        reference state the failed dispatch left unknown, so both are
+        dropped wholesale. Queued/pending requests survive: they hold no
+        device state and the resumed loop serves them."""
+        for req in {o for o in self._owner if o is not None}:
+            req.error = err
+            req.signal()
+        if self._adm is not None:
+            a, self._adm = self._adm, None
+            a["req"].error = err
+            a["req"].signal()
+        self._active[:] = False
+        self._reserved[:] = False
+        self._owner = [None] * self.slots
+        self._collected = [[] for _ in range(self.slots)]
+        self._temps[:] = 0.0  # keep the all-greedy fast path alive
+        self._pcache.clear()
+        with self._lock:
+            self._stats["pcache_bytes"] = 0
+            self._stats["loop_crashes"] += 1
+        if self.paged:
+            self._alloc = _PageAllocator(self.num_pages)
+            self._pinned = {}
+            self._chains = [[] for _ in range(self.slots)]
+            self._tables[:] = 0
+            self._indices[:] = 0
+            if self._alloc.free != self._alloc.total:  # verified-empty
+                raise RuntimeError(
+                    f"allocator reset left {self._alloc.total - self._alloc.free} "
+                    f"pages unaccounted")
+
+    def _watchdog_loop(self) -> None:
+        """Detects (a) a dead loop thread — revives it after a crash
+        reset — and (b) a stalled loop (a wedged device dispatch: the
+        heartbeat, stamped once per iteration, goes stale; a HEALTHY
+        idle loop wakes every 0.2 s via _drain_queue's timeout). A stall
+        fails every blocked client with a retryable EngineStalled
+        instead of letting them hang to their full timeout, and trips
+        the breaker so /healthz pulls the pod from rotation."""
+        poll = max(0.01, min(self.watchdog_s / 4.0, 1.0))
+        while not self._wd_stop.wait(poll):
+            if self._closed:
+                return
+            if not self._thread.is_alive():
+                self._revive_loop()
+                continue
+            if time.monotonic() - self._heartbeat < self.watchdog_s:
+                continue
+            with self._lock:
+                waiters = list(self._waiters)
+            if not waiters:
+                continue  # nobody is blocked on the stalled loop
+            with self._lock:
+                self._stats["watchdog_trips"] += 1
+            if self.breaker is not None:
+                self.breaker.trip_open()
+            err = EngineStalled(
+                f"engine loop made no dispatch progress for "
+                f">= {self.watchdog_s:.1f}s; request failed cleanly, retry")
+            for req in waiters:
+                # deadline 0 makes the loop reap the rows/queue entry via
+                # _expire_deadlines whenever it resumes; the waiter is
+                # released NOW.
+                req.deadline = 0.0
+                req.error = err
+                req.signal()
+            # A trip consumes the stale window: the next trip requires
+            # another full watchdog_s of no progress. Without this, a
+            # request arriving while the loop is still wedged is failed on
+            # the very next poll tick instead of getting its own grace
+            # period to see the loop recover.
+            self._heartbeat = time.monotonic()
+
+    def _revive_loop(self) -> None:
+        """The loop thread died (an exception escaped _loop — e.g. an
+        injected engine_loop fault). Crash-reset its state and start a
+        fresh thread; this runs on the watchdog thread, which is safe
+        only BECAUSE the loop thread is dead."""
+        if self._closed:
+            return
+        exc, self._loop_exc = self._loop_exc, None
+        err = EngineStalled(
+            f"engine loop thread died ({exc!r}); state reset, retry")
+        self._record_backend_failure()
+        self._crash_reset(err)
+        with self._lock:
+            self._stats["loop_restarts"] += 1
+        self._thread = threading.Thread(target=self._loop_main, daemon=True,
+                                        name="generate-engine")
+        self._thread.start()
+
+    def _loop_main(self) -> None:
+        try:
+            self._loop()
+        except Exception as e:  # noqa: BLE001 — crash-only: watchdog revives
+            self._loop_exc = e
+
     def _loop(self) -> None:
         while True:
+            self._heartbeat = time.monotonic()
+            if self._chaos is not None:
+                # Outside the dispatch try on purpose: a raised fault
+                # here kills the loop thread (the watchdog-revival path).
+                self._chaos.fire("engine_loop")
             any_active = bool(self._active.any())
             if not self._drain_queue(block=not any_active
                                      and not self._pending
@@ -1582,6 +1809,8 @@ class GenerateEngine:
             aids = (jnp.asarray(self._aids)
                     if self.n_adapters is not None else None)
             try:
+                if self._chaos is not None:
+                    self._chaos.fire("decode_dispatch")
                 targs = (jnp.asarray(self._last_tok),
                          jnp.asarray(self._temps),
                          jnp.asarray(self._topks),
@@ -1613,17 +1842,12 @@ class GenerateEngine:
                     self._cache, nxt = self._decode_block_step(
                         self.params, self._cache, *targs, k_tok, aids)
                     block = np.asarray(nxt)                # (K, B)
-            except Exception as e:  # noqa: BLE001 — fail every live request
-                for req in {self._owner[r] for r in range(self.slots)
-                            if self._owner[r] is not None}:
-                    req.error = e
-                    req.signal()
-                self._active[:] = False
-                self._owner = [None] * self.slots
-                if self.paged:
-                    for r in range(self.slots):
-                        self._release_slot_pages(r)
+            except Exception as e:  # noqa: BLE001 — crash-only reset
+                self._record_backend_failure()
+                self._crash_reset(e)
                 continue
+            if self.breaker is not None:
+                self.breaker.record_success()
             dt = time.perf_counter() - t0
             n_active = int(self._active.sum())
             done_reqs = set()

@@ -21,14 +21,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
 import threading
 import time
+import urllib.error
 
 import numpy as np
 
 
 _MAX_ERRORS_PER_CLIENT = 10
+
+# 503 retry policy (the server's containment layer — breaker open, drain,
+# watchdog trip — answers 503 + Retry-After; see docs/RESILIENCE.md).
+# Backoff honors Retry-After, else exponential from _BACKOFF_BASE_S,
+# capped at _BACKOFF_CAP_S, always jittered to avoid client lockstep.
+_MAX_RETRIES_503 = 8
+_BACKOFF_BASE_S = 0.05
+_BACKOFF_CAP_S = 2.0
 
 
 def _gen_prompt(rows: int) -> "list[int]":
@@ -43,14 +53,23 @@ def _gen_prompt(rows: int) -> "list[int]":
 
 def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                  latencies: list, lock: "threading.Lock", errors: list,
-                 route: str = "/v1/predict", ttfts: "list | None" = None):
+                 route: str = "/v1/predict", ttfts: "list | None" = None,
+                 retry_stats: "dict | None" = None, seed: int = 0):
     """``ttfts`` non-None switches to SSE consumption: the request body
     carries ``"stream": true`` and the client records time-to-first-token
     (first ``data:`` frame) alongside the full-response latency — the
     pair is the streaming story: TTFT ~ prefill latency while total
-    stays the full decode."""
+    stays the full decode.
+
+    ``retry_stats`` non-None ({"retries": 0, "gave_up": 0}, shared under
+    ``lock``) turns on 503 retries: backoff honoring Retry-After, capped
+    exponential otherwise, jittered by a per-client ``seed`` RNG so the
+    retry schedule is deterministic per client but never in lockstep
+    across clients."""
     import urllib.request
 
+    rng = random.Random(seed)
+    attempt = 0  # consecutive 503s on the CURRENT request
     my_errors = 0
     while not stop.is_set():
         req = urllib.request.Request(
@@ -79,12 +98,33 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                         raise RuntimeError(
                             f"stream ended badly: {last}")
         except Exception as e:  # noqa: BLE001 — record, don't kill the run
+            if (retry_stats is not None
+                    and isinstance(e, urllib.error.HTTPError)
+                    and e.code == 503):
+                attempt += 1
+                if attempt <= _MAX_RETRIES_503:
+                    try:
+                        ra = float(e.headers.get("Retry-After"))
+                    except (TypeError, ValueError):
+                        ra = 0.0
+                    sleep = min(_BACKOFF_CAP_S,
+                                max(ra, _BACKOFF_BASE_S * 2 ** attempt))
+                    with lock:
+                        retry_stats["retries"] += 1
+                    stop.wait(sleep * (0.5 + rng.random()))
+                    continue  # does NOT count toward _MAX_ERRORS_PER_CLIENT
+                with lock:
+                    retry_stats["gave_up"] += 1
+                e = RuntimeError(
+                    f"503 persisted through {_MAX_RETRIES_503} retries: {e}")
+            attempt = 0
             with lock:
                 errors.append(str(e))
             my_errors += 1
             if my_errors >= _MAX_ERRORS_PER_CLIENT:
                 return  # persistently failing client stops; others continue
             continue
+        attempt = 0
         my_errors = 0  # consecutive-failure counter: success resets it
         with lock:
             latencies.append(time.perf_counter() - t0)
@@ -121,12 +161,14 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
 
     latencies: list[float] = []
     errors: list[str] = []
+    retry_stats = {"retries": 0, "gave_up": 0}
     lock = threading.Lock()
     stop = threading.Event()
     threads = [threading.Thread(
         target=_client_loop, args=(url, payload, stop, latencies, lock,
-                                   errors, route, ttfts), daemon=True)
-        for _ in range(clients)]
+                                   errors, route, ttfts, retry_stats, i),
+        daemon=True)
+        for i in range(clients)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
@@ -150,6 +192,8 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         "wall_s": round(wall, 2),
         "requests": len(lat_ms),
         "errors": len(errors),  # transient failures don't void the run
+        "retries_503": retry_stats["retries"],
+        "gave_up_503": retry_stats["gave_up"],
         "examples": len(lat_ms) * rows,
         "examples_per_s": round(len(lat_ms) * rows / wall, 2),
         "p50_ms": round(pick(0.50), 2),
@@ -365,6 +409,10 @@ def main(argv: "list[str] | None" = None) -> int:
         "devices": card["devices"][:1],
     })
     _print_quantile_skew(result)
+    if result["retries_503"] or result["gave_up_503"]:
+        print(f"503 backoff: {result['retries_503']} retried, "
+              f"{result['gave_up_503']} gave up "
+              f"(cap {_MAX_RETRIES_503} retries/request)", flush=True)
     print("LOADGEN_JSON " + json.dumps(result), flush=True)
     return 0
 

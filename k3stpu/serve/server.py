@@ -22,7 +22,9 @@ first serving choices:
   time) plus the dispatch count, so the coalescing win is observable.
 
 Endpoints:
-  GET  /healthz         -> {"ok": true, "devices": [...]}   (readiness)
+  GET  /healthz         -> {"ok": true, "devices": [...]}   (readiness:
+                           503 while draining / breaker open / loop dead)
+  GET  /livez           -> {"ok": true}                      (liveness)
   GET  /v1/models       -> model card
   GET  /metrics         -> Prometheus counters (scrape surface)
   POST /v1/predict      -> {"inputs": [...]} -> logits/top-k
@@ -41,6 +43,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import queue
 import threading
 import time
@@ -105,6 +108,7 @@ class MicroBatcher:
         self._q: "queue.SimpleQueue[dict | None]" = queue.SimpleQueue()
         self._carry: dict | None = None
         self._closed = False
+        self._dead: "BaseException | None" = None
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="microbatcher")
         self._thread.start()
@@ -122,12 +126,24 @@ class MicroBatcher:
         item = {"inputs": inputs, "event": threading.Event(),
                 "result": None, "error": None}
         self._q.put(item)
-        # A bounded wait + closed re-check: a submit racing close() can land
-        # its item behind the shutdown sentinel, after which no dispatcher
-        # will ever set the event — an unbounded wait would strand this
-        # thread forever. On close, grant one grace period so a request the
-        # dispatcher already picked up can still deliver its result.
-        while not item["event"].wait(timeout=1.0):
+        # A bounded wait + liveness re-check: a submit racing close() can
+        # land its item behind the shutdown sentinel, and a dispatcher
+        # that DIED (an exception escaping _run, not just a group
+        # failure) will never set the event — an unbounded wait would
+        # strand this thread forever. Death propagates immediately; on a
+        # clean close, grant one grace period so a request the dispatcher
+        # already picked up can still deliver its result.
+        while not item["event"].wait(timeout=0.2):
+            dead = self._dead
+            if dead is not None or not self._thread.is_alive():
+                if item["event"].is_set():  # died AFTER serving this item
+                    break
+                if dead is None and self._closed:
+                    raise RuntimeError(
+                        "MicroBatcher closed with request in flight")
+                raise RuntimeError(
+                    f"MicroBatcher dispatcher thread died: {dead!r}"
+                ) from dead
             if self._closed:
                 if item["event"].wait(timeout=30.0):
                     break
@@ -164,6 +180,28 @@ class MicroBatcher:
         return items
 
     def _loop(self) -> None:
+        try:
+            self._run()
+        except BaseException as e:  # noqa: BLE001 — propagate death to waiters
+            # Set _dead BEFORE draining: an item enqueued after the drain
+            # still sees _dead on its submit()'s next wait tick, so there
+            # is no window where a waiter can strand.
+            self._dead = e
+            err = RuntimeError(f"MicroBatcher dispatcher thread died: {e!r}")
+            items = [self._carry] if self._carry is not None else []
+            self._carry = None
+            try:
+                while True:
+                    it = self._q.get(block=False)
+                    if it is not None:
+                        items.append(it)
+            except queue.Empty:
+                pass
+            for it in items:
+                it["error"] = err
+                it["event"].set()
+
+    def _run(self) -> None:
         while True:
             items = self._gather()
             if items is None:
@@ -191,8 +229,15 @@ class MicroBatcher:
                     for it in group:
                         it["error"] = e
                 finally:
+                    # Release only waiters that reached a terminal state.
+                    # A BaseException escaping the group (dispatcher
+                    # death) must NOT set bare events here — that would
+                    # hand those callers a silent None result; they are
+                    # failed by the _loop death handler / the _dead
+                    # check in submit() instead.
                     for it in group:
-                        it["event"].set()
+                        if it["result"] is not None or it["error"] is not None:
+                            it["event"].set()
 
 
 class InferenceServer:
@@ -217,7 +262,11 @@ class InferenceServer:
                  lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
-                 spec_gamma: int = 4):
+                 spec_gamma: int = 4,
+                 watchdog_s: "float | None" = 120.0,
+                 breaker_threshold: "int | None" = 5,
+                 breaker_cooldown_s: float = 5.0,
+                 chaos=None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -246,6 +295,19 @@ class InferenceServer:
         # and the engine loop's hooks when continuous batching is on.
         self._obs = ServeObs()
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
+        # Failure containment (docs/RESILIENCE.md): the engine-facing
+        # knobs default ON here (the HTTP server is the production
+        # surface) and OFF in GenerateEngine itself (library/bench use).
+        self._breaker = None
+        self._chaos = chaos  # k3stpu.chaos.FaultInjector | None
+        self._watchdog_s = watchdog_s
+        self._breaker_threshold = breaker_threshold
+        self._breaker_cooldown_s = breaker_cooldown_s
+        # Graceful drain: begin_drain() flips /healthz not-ready and 503s
+        # new /v1 work; _active_http tracks in-flight handler threads so
+        # main()'s drainer knows when the last response has gone out.
+        self._draining = False
+        self._active_http = 0  # guarded by _stats_lock
 
         if model_name == "resnet50":
             from k3stpu.models.resnet import resnet50
@@ -525,14 +587,21 @@ class InferenceServer:
                 raise ValueError(
                     "--continuous-batching applies to LM families, not "
                     f"{model_name!r}")
+            from k3stpu.serve.containment import CircuitBreaker
             from k3stpu.serve.engine import GenerateEngine
 
+            if breaker_threshold is not None:
+                self._breaker = CircuitBreaker(
+                    threshold=breaker_threshold,
+                    cooldown_s=breaker_cooldown_s)
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
                 max_pending=max_pending, page_size=kv_page_size,
-                num_pages=kv_pages, obs=self._obs)
+                num_pages=kv_pages, obs=self._obs,
+                breaker=self._breaker, watchdog_s=watchdog_s,
+                chaos=chaos)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -677,6 +746,43 @@ class InferenceServer:
             self._batcher.close()
         if self._engine is not None:
             self._engine.close()
+
+    # --- failure containment (docs/RESILIENCE.md) -----------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        """SIGTERM path: /healthz goes not-ready (endpoint removal) and
+        new /v1 work gets 503 + Retry-After; in-flight requests finish."""
+        self._draining = True
+
+    def health(self) -> "tuple[bool, str]":
+        """Readiness (NOT liveness — that's /livez): False pulls the pod
+        from Service rotation. Half-open is reported READY on purpose:
+        the breaker's probe request has to arrive through the Service,
+        so the pod must rejoin rotation the moment a probe may flow."""
+        if self._draining:
+            return False, "draining"
+        if self._engine is not None:
+            if not self._engine.loop_alive():
+                return False, "engine loop dead (watchdog reviving)"
+            if self._breaker is not None and self._breaker.state() == "open":
+                return False, "circuit breaker open"
+        return True, "ok"
+
+    def http_begin(self) -> None:
+        with self._stats_lock:
+            self._active_http += 1
+
+    def http_end(self) -> None:
+        with self._stats_lock:
+            self._active_http -= 1
+
+    def active_http_requests(self) -> int:
+        with self._stats_lock:
+            return self._active_http
 
     def _adapter_id(self, adapter: "str | None") -> int:
         """Adapter name -> MultiLoraDense slot. None/'base' is slot 0
@@ -1160,6 +1266,31 @@ class InferenceServer:
                 emit(lines, "k3stpu_paged_density_ratio", "gauge",
                      "Dense token-slots per actual pooled token-slot.",
                      e["paged_density_ratio"])
+            # Containment counters (docs/RESILIENCE.md).
+            emit(lines, "k3stpu_engine_deadline_expired_total", "counter",
+                 "Requests reaped by the deadline machinery (client "
+                 "timeout, disconnect, or watchdog expiry).",
+                 e["deadline_expired"])
+            emit(lines, "k3stpu_engine_watchdog_trips_total", "counter",
+                 "Watchdog trips: engine-loop stalls that failed blocked "
+                 "clients with retryable errors.",
+                 e["watchdog_trips"])
+            emit(lines, "k3stpu_engine_loop_crashes_total", "counter",
+                 "Crash-only engine resets after an unexpected dispatch "
+                 "failure.", e["loop_crashes"])
+            emit(lines, "k3stpu_engine_loop_restarts_total", "counter",
+                 "Engine loop threads revived by the watchdog after "
+                 "dying.", e["loop_restarts"])
+            if self._breaker is not None:
+                emit(lines, "k3stpu_breaker_state", "gauge",
+                     "Circuit breaker state: 0 closed, 1 half-open, "
+                     "2 open.", self._breaker.state_value())
+                emit(lines, "k3stpu_breaker_trips_total", "counter",
+                     "Circuit breaker transitions to open.",
+                     self._breaker.trips)
+                emit(lines, "k3stpu_breaker_rejected_total", "counter",
+                     "Requests rejected at admission while the breaker "
+                     "was open.", e["breaker_rejected"])
         if self._draft is not None:
             with self._stats_lock:
                 sp = dict(self._spec_stats)
@@ -1272,6 +1403,7 @@ class InferenceServer:
 
 def make_app(server: InferenceServer):
     """Returns the BaseHTTPRequestHandler class bound to `server`."""
+    from k3stpu.serve.containment import CircuitOpen, EngineStalled
     from k3stpu.serve.engine import EngineOverloaded
 
     class Handler(BaseHTTPRequestHandler):
@@ -1302,8 +1434,13 @@ def make_app(server: InferenceServer):
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
             self.end_headers()
+            chaos = server._chaos
             try:
                 for ev in events:
+                    if chaos is not None:
+                        # "sse_write" raising BrokenPipeError simulates a
+                        # client disconnect mid-stream (chaos suite).
+                        chaos.fire("sse_write")
                     self.wfile.write(
                         b"data: " + json.dumps(ev).encode() + b"\n\n")
                     self.wfile.flush()
@@ -1325,10 +1462,24 @@ def make_app(server: InferenceServer):
 
         def do_GET(self):
             if self.path == "/healthz":
+                # READINESS: not-ready while draining, while the engine
+                # loop is dead, or while the circuit breaker is open —
+                # K8s pulls the pod from Service rotation until it
+                # recovers (docs/RESILIENCE.md).
+                ok, reason = server.health()
+                if not ok:
+                    self._send(503, {"ok": False, "reason": reason},
+                               headers={"Retry-After": "1"})
+                    return
                 import jax
 
                 self._send(200, {"ok": True,
                                  "devices": [str(d) for d in jax.devices()]})
+            elif self.path == "/livez":
+                # LIVENESS: process-up only. Deliberately breaker-blind —
+                # restarting a pod because its backend trips the breaker
+                # would turn a containable fault into a crash loop.
+                self._send(200, {"ok": True})
             elif self.path == "/v1/models":
                 self._send(200, server.model_card())
             elif self.path == "/metrics":
@@ -1353,6 +1504,25 @@ def make_app(server: InferenceServer):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            if self.path.startswith("/v1/"):
+                if server.draining:
+                    # Drain window: in-flight requests finish, new work is
+                    # shed with an explicit retryable status so clients
+                    # fail over to a live replica.
+                    self._send(503, {"error": "server draining"},
+                               headers={"Retry-After": "1"})
+                    return
+                # In-flight accounting: main()'s SIGTERM drainer waits for
+                # this to hit zero before stopping the listener.
+                server.http_begin()
+                try:
+                    self._route_post()
+                finally:
+                    server.http_end()
+                return
+            self._route_post()
+
+        def _route_post(self):
             if self.path.startswith("/debug/profile"):
                 q = parse_qs(urlparse(self.path).query)
                 try:
@@ -1408,11 +1578,20 @@ def make_app(server: InferenceServer):
                     # Engine queue backlog exceeded the wait budget: a
                     # clean 503 beats an http.server traceback + reset.
                     self._send(503, {"error": str(e)})
-                except EngineOverloaded as e:
-                    # Admission bound hit (--max-pending): shed load with
+                except (EngineOverloaded, EngineStalled) as e:
+                    # Admission bound hit (--max-pending) or a watchdog
+                    # trip failed the request mid-flight: shed load with
                     # an explicit retryable status.
                     self._send(503, {"error": str(e)},
                                headers={"Retry-After": "1"})
+                except CircuitOpen as e:
+                    self._send(503, {"error": str(e)}, headers={
+                        "Retry-After": str(max(1, round(e.retry_after_s)))})
+                except Exception as e:  # noqa: BLE001 — backend failure
+                    # Crash-only containment turned a backend failure into
+                    # a per-request error; surface it as a JSON 500, not
+                    # an http.server traceback + connection reset.
+                    self._send(500, {"error": str(e)})
                 return
             if self.path != "/v1/predict":
                 self._send(404, {"error": f"no route {self.path}"})
@@ -1465,6 +1644,19 @@ def start_telemetry_thread(server: InferenceServer,
     t = threading.Thread(target=loop, daemon=True, name="telemetry")
     t.start()
     return t
+
+
+def _chaos_from_env():
+    """Fault injection for subprocess tests (K3STPU_CHAOS spec string —
+    see k3stpu.chaos.FaultInjector.from_env). Unset (the only production
+    state) returns None: zero hooks armed, zero overhead."""
+    spec = os.environ.get("K3STPU_CHAOS")
+    if not spec:
+        return None
+    from k3stpu.chaos import FaultInjector
+
+    print(f"CHAOS ARMED: {spec}", flush=True)
+    return FaultInjector.from_env(spec)
 
 
 def main(argv=None) -> int:
@@ -1572,6 +1764,27 @@ def main(argv=None) -> int:
     ap.add_argument("--draft-ckpt-dir", default=None,
                     help="checkpoint dir for the draft model's weights")
     ap.add_argument("--spec-gamma", type=int, default=4)
+    ap.add_argument("--watchdog-s", type=float, default=120.0,
+                    help="with --continuous-batching: fail blocked "
+                         "clients with retryable 503s when the engine "
+                         "loop makes no progress for this long, and "
+                         "revive a dead loop thread. Must exceed the "
+                         "worst single dispatch incl. cold compiles. "
+                         "0 disables")
+    ap.add_argument("--breaker-threshold", type=int, default=5,
+                    help="with --continuous-batching: consecutive "
+                         "backend failures that open the circuit "
+                         "breaker (/healthz goes not-ready until a "
+                         "half-open probe succeeds). 0 disables")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                    help="seconds the breaker stays open before "
+                         "admitting a half-open probe request")
+    ap.add_argument("--drain-deadline-s", type=float, default=25.0,
+                    help="on SIGTERM: wait at most this long for "
+                         "in-flight requests before stopping the "
+                         "listener. Keep it BELOW the pod's "
+                         "terminationGracePeriodSeconds or the kubelet "
+                         "SIGKILLs mid-drain")
     ap.add_argument("--compilation-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache (volume mount): "
                          "a restarted pod reuses compiled programs instead "
@@ -1612,7 +1825,12 @@ def main(argv=None) -> int:
                              lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
-                             spec_gamma=args.spec_gamma)
+                             spec_gamma=args.spec_gamma,
+                             watchdog_s=args.watchdog_s or None,
+                             breaker_threshold=(args.breaker_threshold
+                                                or None),
+                             breaker_cooldown_s=args.breaker_cooldown_s,
+                             chaos=_chaos_from_env())
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)
@@ -1664,11 +1882,28 @@ def main(argv=None) -> int:
             signal.signal(signum, signal.SIG_DFL)
             return
         draining["on"] = True
+        # New /v1 work gets 503 + Retry-After and /healthz goes
+        # not-ready immediately (endpoint removal starts NOW, not when
+        # the listener dies) — only then is the listener stopped, once
+        # in-flight requests finish or the drain deadline passes.
+        server.begin_drain()
         print(f"signal {signum}: draining (no new connections; "
               "in-flight requests finish)...", flush=True)
-        # shutdown() blocks until serve_forever exits; run it off the
-        # signal frame so the handler returns immediately.
-        threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+        def _drainer():
+            deadline = time.monotonic() + args.drain_deadline_s
+            while (server.active_http_requests() > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if server.active_http_requests() > 0:
+                print(f"drain deadline ({args.drain_deadline_s:.0f}s) "
+                      f"passed with requests in flight; stopping anyway",
+                      flush=True)
+            # shutdown() blocks until serve_forever exits; this thread is
+            # already off the signal frame.
+            httpd.shutdown()
+
+        threading.Thread(target=_drainer, daemon=True).start()
 
     signal.signal(signal.SIGTERM, _drain)
     signal.signal(signal.SIGINT, _drain)

@@ -21,9 +21,11 @@
 #            entry + 8-device dryrun). The full two-process suite stays
 #            the round gate; smoke exists so intermediate commits keep a
 #            fast green signal as the suite's wall time grows. Paged-KV
-#            exactness and the serving observability layer (histograms,
-#            request traces, /debug endpoints) ride along minus their
-#            @slow soak/bench tests (the full suite runs those).
+#            exactness, the serving observability layer (histograms,
+#            request traces, /debug endpoints), and the chaos/containment
+#            suite (fault injection + recovery invariants) ride along
+#            minus their @slow soak/bench tests (the full suite runs
+#            those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -33,15 +35,23 @@ case "${XLA_FLAGS:-}" in
   *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8";;
 esac
 
+# Wedge forensics: if any single test exceeds this, pytest's builtin
+# faulthandler dumps EVERY thread's stack before the outer timeout kills
+# the process silently. The BENCH_r03..r05 wedges (device-tunnel hangs
+# with zero diagnostics) are exactly the failure this pays for; the
+# chaos suite (stalls, loop death) makes an accidental hang likelier.
+FAULTHANDLER="-o faulthandler_timeout=${FAULTHANDLER_TIMEOUT:-600}"
+
 if [ "${1:-}" = "--smoke" ]; then
   shift
-  exec python -m pytest -q \
+  exec python -m pytest -q $FAULTHANDLER \
     tests/test_chart.py tests/test_chart_lint.py tests/test_manifests.py \
     tests/test_plugin_config.py tests/test_chips.py tests/test_discovery.py \
     tests/test_container_runtime.py tests/test_device_plugin.py \
     tests/test_e2e_assets.py \
     tests/test_bench.py tests/test_graft_entry.py \
-    tests/test_paged.py tests/test_obs.py -m "not slow" "$@"
+    tests/test_paged.py tests/test_obs.py \
+    tests/test_chaos.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the
@@ -54,8 +64,8 @@ HALF_B=(tests/test_[p-z]*.py)
 [ -e "${HALF_A[0]}" ] || { echo "run_suite: half A glob empty"; exit 2; }
 [ -e "${HALF_B[0]}" ] || { echo "run_suite: half B glob empty"; exit 2; }
 
-python -m pytest "${HALF_A[@]}" -q "$@"; rc_a=$?
-python -m pytest "${HALF_B[@]}" -q "$@"; rc_b=$?
+python -m pytest "${HALF_A[@]}" -q $FAULTHANDLER "$@"; rc_a=$?
+python -m pytest "${HALF_B[@]}" -q $FAULTHANDLER "$@"; rc_b=$?
 echo "run_suite: half A rc=$rc_a, half B rc=$rc_b"
 # rc 5 = NO_TESTS_COLLECTED is fine for ONE half (a -k filter whose
 # matches live in the other half) — but both halves collecting nothing

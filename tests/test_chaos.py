@@ -1,0 +1,608 @@
+"""Chaos suite: fault injection + containment invariants (ISSUE 3).
+
+Every test injects one named fault class through k3stpu.chaos and then
+asserts the SAME recovery contract: the engine accepts and completes new
+work, the page allocator's free count returns to its pre-fault baseline,
+no client thread stays blocked past its deadline, and the containment
+counters moved. docs/RESILIENCE.md is the prose version of this file.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.chaos import FaultInjector, InjectedFault
+from k3stpu.serve.containment import (
+    CircuitBreaker,
+    CircuitOpen,
+    EngineStalled,
+)
+from k3stpu.serve.engine import GenerateEngine
+
+
+@pytest.fixture(scope="module")
+def mp():
+    from k3stpu.models.transformer import transformer_lm_tiny
+
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _engine(mp, **kw):
+    model, params = mp
+    kw.setdefault("slots", 4)
+    return GenerateEngine(model, params, **kw)
+
+
+def _submit_until_healthy(eng, deadline_s=30.0):
+    """Retry-loop client: submits until the engine serves a request —
+    the 'engine accepts new work again' half of the recovery contract.
+    EngineStalled/CircuitOpen are exactly the retryable errors the
+    containment layer promises, so retrying them IS the contract."""
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            return eng.submit([[7, 8, 9]], max_new_tokens=2, timeout_s=30.0)
+        except (EngineStalled, CircuitOpen):
+            assert time.monotonic() < deadline, \
+                "engine never recovered within the deadline"
+            time.sleep(0.25)
+
+
+# --- fault class: raised backend error mid-decode -----------------------
+
+
+def test_dispatch_error_crash_resets_paged_state(mp):
+    chaos = FaultInjector()
+    eng = _engine(mp, page_size=16, chaos=chaos)
+    try:
+        baseline = eng.stats()["pages_free"]
+        eng.submit([[1, 2, 3]], max_new_tokens=4)  # healthy warm pass
+        assert eng.stats()["pages_free"] == baseline
+        chaos.arm("decode_dispatch", exc=InjectedFault("injected XLA error"))
+        with pytest.raises(InjectedFault):
+            eng.submit([[4, 5, 6]], max_new_tokens=4, timeout_s=30.0)
+        assert chaos.fired("decode_dispatch") == 1
+        # Recovery invariants: verified-empty pool, fresh work completes.
+        out = eng.submit([[7, 8, 9]], max_new_tokens=4, timeout_s=30.0)
+        assert len(out) == 1 and len(out[0]) == 4
+        s = eng.stats()
+        assert s["pages_free"] == baseline
+        assert s["loop_crashes"] == 1
+    finally:
+        eng.close()
+
+
+def test_dispatch_error_fails_every_inflight_request_cleanly(mp):
+    """Two concurrent requests share the crash: both submitters get the
+    error (not a hang), and both slots come back."""
+    chaos = FaultInjector()
+    eng = _engine(mp, page_size=16, chaos=chaos)
+    try:
+        baseline = eng.stats()["pages_free"]
+        eng.submit([[1, 2]], max_new_tokens=2)  # warm compiles first
+        chaos.arm("decode_dispatch", exc=InjectedFault("boom"), skip=0)
+        results = []
+
+        def client(tok):
+            try:
+                eng.submit([[tok, tok + 1]], max_new_tokens=8, timeout_s=30.0)
+                results.append("ok")
+            except InjectedFault:
+                results.append("fault")
+            except Exception as e:  # noqa: BLE001
+                results.append(repr(e))
+
+        threads = [threading.Thread(target=client, args=(10 + i,))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive(), "client thread stuck past deadline"
+        # At least one rode the crashed dispatch; any sibling that was
+        # still queued is served by the recovered loop.
+        assert "fault" in results, results
+        assert all(r in ("ok", "fault") for r in results), results
+        assert eng.stats()["pages_free"] == baseline
+        _submit_until_healthy(eng)
+    finally:
+        eng.close()
+
+
+# --- fault class: page-pool exhaustion ----------------------------------
+
+
+def test_page_pool_exhaustion_contained(mp):
+    chaos = FaultInjector()
+    eng = _engine(mp, page_size=16, chaos=chaos)
+    try:
+        baseline = eng.stats()["pages_free"]
+        chaos.arm("page_alloc",
+                  exc=RuntimeError("chaos: page pool exhausted"))
+        with pytest.raises(RuntimeError, match="exhausted"):
+            eng.submit([[1, 2, 3]], max_new_tokens=4, timeout_s=30.0)
+        assert eng.stats()["pages_free"] == baseline
+        out = eng.submit([[1, 2, 3]], max_new_tokens=4, timeout_s=30.0)
+        assert len(out[0]) == 4
+        assert eng.stats()["pages_free"] == baseline
+    finally:
+        eng.close()
+
+
+# --- fault class: loop-thread death -------------------------------------
+
+
+def test_loop_thread_death_revived_by_watchdog(mp):
+    chaos = FaultInjector()
+    eng = _engine(mp, chaos=chaos, watchdog_s=5.0)
+    try:
+        eng.submit([[1, 2]], max_new_tokens=2)  # warm
+        chaos.arm("engine_loop", exc=InjectedFault("injected loop death"))
+        # The idle loop ticks every <=0.2s, so the fault kills it almost
+        # immediately; the watchdog polls ~1s and revives it.
+        deadline = time.monotonic() + 20
+        while (eng.stats()["loop_restarts"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert eng.stats()["loop_restarts"] == 1
+        assert chaos.fired("engine_loop") == 1
+        out = eng.submit([[3, 4]], max_new_tokens=2, timeout_s=30.0)
+        assert len(out[0]) == 2
+        assert eng.loop_alive()
+    finally:
+        eng.close()
+
+
+# --- fault class: stalled dispatch (watchdog) ----------------------------
+
+
+def test_watchdog_fails_stalled_clients_with_retryable_error(mp):
+    chaos = FaultInjector()
+    breaker = CircuitBreaker(threshold=3, cooldown_s=0.5)
+    # Warm the persistent compile cache with a throwaway engine first, so
+    # the watchdog engine's own compiles stay far below watchdog_s (a
+    # compile IS a dispatch stall as far as the heartbeat can tell).
+    warm = _engine(mp)
+    warm.submit([[1, 2]], max_new_tokens=4)
+    warm.close()
+    eng = _engine(mp, chaos=chaos, watchdog_s=2.0, breaker=breaker)
+    try:
+        eng.submit([[1, 2]], max_new_tokens=4)  # cache-hit compiles
+        chaos.arm("decode_dispatch", stall_s=6.0)
+        t0 = time.monotonic()
+        with pytest.raises(EngineStalled):
+            eng.submit([[3, 4]], max_new_tokens=4, timeout_s=60.0)
+        elapsed = time.monotonic() - t0
+        # The whole point: the client fails in ~watchdog_s, NOT after
+        # riding out the stall (6s) or its own timeout (60s).
+        assert elapsed < 5.5, elapsed
+        s = eng.stats()
+        assert s["watchdog_trips"] >= 1
+        # The stall also tripped the breaker -> /healthz would be 503.
+        assert breaker.state() in ("open", "half_open")
+        _submit_until_healthy(eng)
+        assert breaker.state() == "closed"
+    finally:
+        eng.close()
+
+
+# --- fault class: client disconnect mid-stream ---------------------------
+
+
+def test_client_disconnect_mid_stream_frees_pages(mp):
+    eng = _engine(mp, page_size=16)
+    try:
+        baseline = eng.stats()["pages_free"]
+        events = eng.submit_stream([[1, 2, 3]], max_new_tokens=32,
+                                   timeout_s=30.0)
+        first = next(events)
+        assert not first["done"]
+        events.close()  # the client went away mid-stream
+        deadline = time.monotonic() + 10
+        while (eng.stats()["pages_free"] != baseline
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert eng.stats()["pages_free"] == baseline, "page leak"
+        out = eng.submit([[1, 2, 3]], max_new_tokens=4, timeout_s=30.0)
+        assert len(out[0]) == 4
+        assert eng.stats()["pages_free"] == baseline
+    finally:
+        eng.close()
+
+
+# --- deadlines ----------------------------------------------------------
+
+
+def test_deadline_expiry_is_counted(mp):
+    eng = _engine(mp)
+    try:
+        with pytest.raises(TimeoutError):
+            eng.submit([[1, 2]], max_new_tokens=2, timeout_s=0.0)
+        deadline = time.monotonic() + 10
+        while (eng.stats()["deadline_expired"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert eng.stats()["deadline_expired"] >= 1
+        out = eng.submit([[1, 2]], max_new_tokens=2, timeout_s=30.0)
+        assert len(out[0]) == 2
+    finally:
+        eng.close()
+
+
+# --- circuit breaker (engine level) --------------------------------------
+
+
+def test_breaker_opens_after_repeated_failures_and_half_open_recovers(mp):
+    chaos = FaultInjector()
+    breaker = CircuitBreaker(threshold=2, cooldown_s=0.4)
+    eng = _engine(mp, chaos=chaos, breaker=breaker)
+    try:
+        eng.submit([[1, 2]], max_new_tokens=2)  # healthy: stays closed
+        assert breaker.state() == "closed"
+        chaos.arm("decode_dispatch", times=2, exc=InjectedFault("boom"))
+        for _ in range(2):
+            with pytest.raises(InjectedFault):
+                eng.submit([[3, 4]], max_new_tokens=4, timeout_s=30.0)
+        assert breaker.state() == "open"
+        with pytest.raises(CircuitOpen):
+            eng.submit([[5, 6]], max_new_tokens=2, timeout_s=30.0)
+        assert eng.stats()["breaker_rejected"] >= 1
+        time.sleep(0.5)  # cooldown -> the next submit is the probe
+        out = eng.submit([[5, 6]], max_new_tokens=2, timeout_s=30.0)
+        assert len(out[0]) == 2
+        assert breaker.state() == "closed"
+        assert eng.stats()["breaker_trips"] >= 1
+    finally:
+        eng.close()
+
+
+# --- stats/obs consistency across faults ---------------------------------
+
+
+def test_stats_and_obs_stay_consistent_after_faults(mp):
+    from k3stpu.obs import ServeObs
+
+    chaos = FaultInjector()
+    obs = ServeObs()
+    eng = _engine(mp, page_size=16, chaos=chaos, obs=obs)
+    try:
+        eng.submit([[1, 2]], max_new_tokens=2)
+        chaos.arm("decode_dispatch", exc=InjectedFault("boom"))
+        with pytest.raises(InjectedFault):
+            eng.submit([[3, 4]], max_new_tokens=4, timeout_s=30.0)
+        eng.submit([[5, 6]], max_new_tokens=2, timeout_s=30.0)
+        # The obs surface still renders (no wedged trace state) and the
+        # engine's own counters reflect exactly one crash.
+        text = obs.render_prometheus()
+        assert "k3stpu_request_ttft_seconds" in text
+        s = eng.stats()
+        assert s["loop_crashes"] == 1
+        assert s["requests"] >= 2
+        assert s["pages_free"] == s["pages_total"]
+    finally:
+        eng.close()
+
+
+# --- MicroBatcher loop death (satellite fix) -----------------------------
+
+
+def test_microbatcher_loop_death_fails_waiters_immediately():
+    from k3stpu.serve.server import MicroBatcher
+
+    mb = MicroBatcher(lambda batch, n: batch, window_s=0.01)
+    try:
+        ones = np.ones((1, 2), np.float32)
+        assert np.array_equal(mb.submit(ones), ones)
+        # An item the dispatcher cannot even gather kills the loop thread
+        # OUTSIDE its per-group try (the bug: submit then re-waited 30s
+        # on a thread that no longer exists).
+        mb._q.put({"bad": True})
+        deadline = time.monotonic() + 5
+        while mb._thread.is_alive() and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert not mb._thread.is_alive()
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="died"):
+            mb.submit(ones)
+        assert time.monotonic() - t0 < 5.0, "waiter not failed promptly"
+    finally:
+        mb.close()
+
+
+def test_microbatcher_death_propagates_to_already_blocked_waiter():
+    from k3stpu.serve.server import MicroBatcher
+
+    started = threading.Event()
+
+    def run(batch, n):
+        started.set()
+        time.sleep(0.2)
+        raise KeyboardInterrupt("dispatcher dies mid-batch")
+
+    mb = MicroBatcher(run, window_s=0.01)
+    try:
+        errors = []
+
+        def client():
+            try:
+                mb.submit(np.ones((1, 2), np.float32))
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        t = threading.Thread(target=client)
+        t.start()
+        assert started.wait(timeout=5)
+        t.join(timeout=10)
+        assert not t.is_alive(), "client thread stuck on dead dispatcher"
+        assert errors and "died" in str(errors[0])
+    finally:
+        mb.close()
+
+
+# --- loadgen 503 retry (satellite) ---------------------------------------
+
+
+class _FlakyHandler(BaseHTTPRequestHandler):
+    """Replies 503 + Retry-After for the first `fails_left` POSTs, then
+    200 forever."""
+    state = {"fails_left": 0, "seen": 0}
+
+    def do_POST(self):
+        self.rfile.read(int(self.headers.get("Content-Length", "0")))
+        self.state["seen"] += 1
+        if self.state["fails_left"] > 0:
+            self.state["fails_left"] -= 1
+            body = json.dumps({"error": "overloaded"}).encode()
+            self.send_response(503)
+            self.send_header("Retry-After", "0.01")
+        else:
+            body = json.dumps({"ok": True}).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def _flaky_server(fails):
+    _FlakyHandler.state["fails_left"] = fails
+    _FlakyHandler.state["seen"] = 0
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _FlakyHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_loadgen_retries_503_honoring_retry_after():
+    from k3stpu.serve.loadgen import _client_loop
+
+    httpd, url = _flaky_server(fails=2)
+    try:
+        stop = threading.Event()
+        latencies, errors = [], []
+        retry_stats = {"retries": 0, "gave_up": 0}
+        lock = threading.Lock()
+        t = threading.Thread(
+            target=_client_loop,
+            args=(url, b"{}", stop, latencies, lock, errors),
+            kwargs={"retry_stats": retry_stats, "seed": 0}, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 20
+        while not latencies and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=10)
+        assert latencies, f"no success; errors={errors}"
+        assert retry_stats["retries"] >= 2
+        assert retry_stats["gave_up"] == 0
+        assert not errors, errors
+    finally:
+        httpd.shutdown()
+
+
+def test_loadgen_gives_up_after_capped_retries(monkeypatch):
+    from k3stpu.serve import loadgen
+
+    monkeypatch.setattr(loadgen, "_MAX_RETRIES_503", 2)
+    monkeypatch.setattr(loadgen, "_BACKOFF_CAP_S", 0.05)
+    httpd, url = _flaky_server(fails=10 ** 6)  # always 503
+    try:
+        stop = threading.Event()
+        latencies, errors = [], []
+        retry_stats = {"retries": 0, "gave_up": 0}
+        lock = threading.Lock()
+        t = threading.Thread(
+            target=loadgen._client_loop,
+            args=(url, b"{}", stop, latencies, lock, errors),
+            kwargs={"retry_stats": retry_stats, "seed": 1}, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 20
+        while retry_stats["gave_up"] == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        stop.set()
+        t.join(timeout=10)
+        assert retry_stats["gave_up"] >= 1
+        assert retry_stats["retries"] >= 2
+        assert not latencies
+    finally:
+        httpd.shutdown()
+
+
+# --- HTTP integration: breaker flips /healthz (acceptance criterion) -----
+
+
+def _get(url, timeout=30):
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def _post(url, body, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def test_http_breaker_flips_healthz_and_recovers():
+    """End-to-end acceptance path: repeated injected backend failures ->
+    /v1/generate 500s -> breaker opens -> /healthz 503 (K8s pulls the
+    pod) + admission 503 with Retry-After -> cooldown -> half-open probe
+    through the HTTP surface closes the breaker -> /healthz 200."""
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    chaos = FaultInjector()
+    server = InferenceServer(
+        model_name="transformer-tiny", seq_len=64,
+        continuous_batching=True, breaker_threshold=2,
+        breaker_cooldown_s=0.6, watchdog_s=120.0, chaos=chaos)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    gen = {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 2}
+    try:
+        code, _, _ = _post(url + "/v1/generate", gen)  # warm; closed
+        assert code == 200
+        assert _get(url + "/healthz")[0] == 200
+
+        chaos.arm("decode_dispatch", times=2,
+                  exc=InjectedFault("injected backend failure"))
+        for _ in range(2):
+            code, _, body = _post(url + "/v1/generate", gen)
+            # Crash-only containment: the backend failure surfaces as a
+            # JSON 500, never a hung connection.
+            assert code == 500, body
+        assert chaos.fired("decode_dispatch") == 2
+
+        code, _, body = _get(url + "/healthz")
+        assert code == 503
+        assert b"circuit breaker open" in body
+        code, headers, _ = _post(url + "/v1/generate", gen)
+        assert code == 503
+        assert float(headers["Retry-After"]) >= 1
+        # Liveness stays green: an open breaker must NOT crash-loop the
+        # pod (restart would not fix a poisoned backend faster).
+        assert _get(url + "/livez")[0] == 200
+        metrics = _get(url + "/metrics")[2].decode()
+        assert "k3stpu_breaker_state 2" in metrics
+        assert "k3stpu_breaker_trips_total 1" in metrics
+
+        time.sleep(0.7)  # cooldown -> half-open reads as READY
+        assert _get(url + "/healthz")[0] == 200
+        code, _, _ = _post(url + "/v1/generate", gen)  # the probe
+        assert code == 200
+        metrics = _get(url + "/metrics")[2].decode()
+        assert "k3stpu_breaker_state 0" in metrics
+    finally:
+        httpd.shutdown()
+        server.close()
+
+
+# --- SIGTERM drain under chaos (satellite: graceful-drain coverage) ------
+
+
+def test_sigterm_drain_finishes_inflight_rejects_new_exits_in_deadline():
+    """SIGTERM lands while a streamed generate is mid-flight (an injected
+    2.5s dispatch stall holds it open): the stream still finishes, new
+    /v1 work and /healthz answer 503 during the drain, and the process
+    exits 0 within --drain-deadline-s."""
+    import os
+    import signal
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    # Deliberately REPLACE PYTHONPATH (see test_serve.py's SIGTERM test:
+    # the dev box's sitecustomize would re-register the TPU tunnel).
+    env["PYTHONPATH"] = repo_root
+    env["JAX_PLATFORMS"] = "cpu"
+    env["K3STPU_CHAOS"] = "decode_dispatch:stall_s=2.5:times=1"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "k3stpu.serve.server", "--model",
+         "transformer-tiny", "--seq-len", "32", "--port", str(port),
+         "--no-warmup", "--continuous-batching",
+         "--drain-deadline-s", "20"],
+        env=env, text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    stream_result = {}
+    try:
+        deadline = time.time() + 120
+        while True:
+            if proc.poll() is not None:
+                out, _ = proc.communicate()
+                raise AssertionError(
+                    f"server exited rc={proc.returncode}: {out[-2000:]}")
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5):
+                    break
+            except Exception:
+                assert time.time() < deadline, "server never came up"
+                time.sleep(0.3)
+
+        def stream_client():
+            body = json.dumps({"prompt_tokens": [[1, 2, 3]],
+                               "max_new_tokens": 4,
+                               "stream": True}).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                last = None
+                with urllib.request.urlopen(req, timeout=180) as r:
+                    for line in r:
+                        if line.startswith(b"data: "):
+                            last = json.loads(line[6:])
+                stream_result["last"] = last
+            except Exception as e:  # noqa: BLE001
+                stream_result["error"] = repr(e)
+
+        t = threading.Thread(target=stream_client, daemon=True)
+        t.start()
+        # Give the request time to enter the server (the injected stall
+        # then holds its first decode dispatch open ~2.5s; on a cold
+        # compile the window is even wider — either way it is in flight).
+        time.sleep(1.0)
+        proc.send_signal(signal.SIGTERM)
+        time.sleep(0.3)  # let the drain flag land
+        # New work is rejected while the stream drains...
+        code, _, body = _post(f"http://127.0.0.1:{port}/v1/generate",
+                              {"prompt_tokens": [[4, 5]],
+                               "max_new_tokens": 2}, timeout=30)
+        assert code == 503, body
+        # ...and readiness drops so the endpoint leaves the Service.
+        assert _get(f"http://127.0.0.1:{port}/healthz")[0] == 503
+        t.join(timeout=120)
+        assert not t.is_alive(), "stream client stuck through drain"
+        out, _ = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert proc.returncode == 0, out[-2000:]
+    assert "draining" in out and "drained; bye" in out
+    # The in-flight stream finished cleanly mid-drain.
+    assert stream_result.get("last", {}).get("done") is True, stream_result

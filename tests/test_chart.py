@@ -59,6 +59,22 @@ def test_inference_enabled_carries_scrape_annotations():
     assert ctr["readinessProbe"]["httpGet"]["port"] == port["port"]
 
 
+def test_inference_probes_and_drain_wiring():
+    # Containment wiring (docs/RESILIENCE.md): readiness -> /healthz (the
+    # breaker/drain hook), liveness -> /livez (breaker-blind), and the
+    # SIGTERM grace period strictly above the server's drain deadline so
+    # the kubelet never SIGKILLs mid-drain.
+    objs = render({"inference.enabled": "true"})
+    pod = objs[("Deployment", "tpu-inference")]["spec"]["template"]["spec"]
+    (ctr,) = pod["containers"]
+    assert ctr["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert ctr["livenessProbe"]["httpGet"]["path"] == "/livez"
+    assert ctr["livenessProbe"]["httpGet"]["port"] == 8096
+    cmd = ctr["command"]
+    drain_s = float(cmd[cmd.index("--drain-deadline-s") + 1])
+    assert pod["terminationGracePeriodSeconds"] > drain_s
+
+
 def test_runtimeclass_and_namespace():
     objs = render(namespace="custom-ns")
     rc = objs[("RuntimeClass", "tpu")]
@@ -165,10 +181,17 @@ def _golden_case(name):
     return {
         "default.yaml": {},
         "core-8way.yaml": CORE_8WAY_OVERRIDES,
+        # The opt-in serving workload, probes + drain wiring included —
+        # inference is off in the default golden, so this is the only
+        # reviewable rendering of the Deployment/Service pair.
+        "inference.yaml": {"inference.enabled": "true"},
     }[name]
 
 
-@pytest.mark.parametrize("name", ["default.yaml", "core-8way.yaml"])
+GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml"]
+
+
+@pytest.mark.parametrize("name", GOLDEN_NAMES)
 def test_golden_rendering(name):
     from k3stpu.utils.helm_lite import render_chart
 
@@ -196,7 +219,7 @@ def test_core_8way_golden_semantics():
     assert settings["granularity"] == "core"
 
 
-@pytest.mark.parametrize("name", ["default.yaml", "core-8way.yaml"])
+@pytest.mark.parametrize("name", GOLDEN_NAMES)
 def test_golden_matches_real_helm(name):
     """Object-for-object equality between the golden and `helm template`.
 
