@@ -42,15 +42,26 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 from k3stpu.utils.subproc import kill_active_groups, run_bounded  # noqa: E402
 
 BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
-PROBE_TIMEOUT_S = 120   # backend init: first tunnel contact + device list
+# Probe bounds are env-overridable so a wedged-tunnel failure (BENCH_r05
+# died at backend_init) can be triaged — longer timeout, more attempts —
+# without editing code.
+PROBE_TIMEOUT_S = int(os.environ.get(
+    "K3STPU_BENCH_PROBE_TIMEOUT_S", "120"))  # first tunnel contact
+PROBE_ATTEMPTS = max(1, int(os.environ.get(
+    "K3STPU_BENCH_PROBE_ATTEMPTS", "2")))
 MEASURE_TIMEOUT_S = 480  # compile (~20-40s first time) + timed loop
 RETRY_WAIT_S = 10
 RETRY_FAST_S = 60       # only failures faster than this are worth retrying
-# Worst case: probe 2x120 + 10, then measure 480 (a timeout is never
-# retried — a wedge that ate the full budget will eat the retry too — and
-# an rc!=0 failure is retried only if it failed fast, < RETRY_FAST_S, so
-# the retry leg adds at most 60 + 10 + 480) ~= 800s. Callers must wrap
-# with a timeout ABOVE that (see verify skill: 900s).
+# Worst case (defaults): probe 2x120 + 10, then measure 480 (a timeout is
+# never retried — a wedge that ate the full budget will eat the retry too —
+# and an rc!=0 failure is retried only if it failed fast, < RETRY_FAST_S,
+# so the retry leg adds at most 60 + 10 + 480) ~= 800s. Callers must wrap
+# with a timeout ABOVE that (see verify skill: 900s); raising the probe
+# env knobs raises the worst case accordingly.
+
+# Per-stage wall-times, recorded as each stage ends: a failure line says
+# WHERE the budget went (e.g. backend_init ate 2x120s) — _fail attaches it.
+_stage_s: "dict[str, float]" = {}
 
 def _on_term(signum, frame):
     # If the bench itself is killed (e.g. an outer `timeout`), take the
@@ -115,27 +126,35 @@ def _fail(stage: str, detail: str, *,
         "error": f"benchmark failed at stage '{stage}'",
         "stage": stage,
         "detail": detail[-2000:],
+        "stage_s": {k: round(v, 2) for k, v in _stage_s.items()} or None,
         "last_good_artifact": _last_good_artifact(),
     })
     return 0  # structured failure IS the output; don't turn it into an rc
 
 
 def _run_with_retry(cmd: list[str], timeout_s: int, *,
-                    retry_on_timeout: bool):
-    """One bounded attempt, plus one retry on failure. A timeout is only
-    retried when asked (it already consumed the full budget), and an rc!=0
-    failure only when it failed fast — a slow crash retried would blow the
-    documented worst-case budget. Returns (ok, rc, out, err)."""
+                    retry_on_timeout: bool, attempts: int = 2,
+                    stage: "str | None" = None):
+    """Up to ``attempts`` bounded tries. A timeout is only retried when
+    asked (it already consumed the full budget), and an rc!=0 failure only
+    when it failed fast — a slow crash retried would blow the documented
+    worst-case budget. The stage's cumulative wall-time (waits included)
+    lands in ``_stage_s`` for failure-line triage.
+    Returns (ok, rc, out, err)."""
     t0 = time.monotonic()
-    rc, out, err = run_bounded(cmd, timeout_s)
-    elapsed = time.monotonic() - t0
-    retry = (retry_on_timeout if rc is None
-             else rc != 0 and elapsed < RETRY_FAST_S)
-    if rc == 0 or not retry:
-        return rc == 0, rc, out, err
-    time.sleep(RETRY_WAIT_S)
-    rc, out, err = run_bounded(cmd, timeout_s)
-    return rc == 0, rc, out, err
+    try:
+        for attempt in range(1, attempts + 1):
+            ta = time.monotonic()
+            rc, out, err = run_bounded(cmd, timeout_s)
+            elapsed = time.monotonic() - ta
+            retry = (retry_on_timeout if rc is None
+                     else rc != 0 and elapsed < RETRY_FAST_S)
+            if rc == 0 or not retry or attempt == attempts:
+                return rc == 0, rc, out, err
+            time.sleep(RETRY_WAIT_S)
+    finally:
+        if stage is not None:
+            _stage_s[stage] = time.monotonic() - t0
 
 
 def _worker() -> int:
@@ -414,7 +433,7 @@ def _serve_obs_main() -> int:
                           "0.5")
     ok, rc, out, err = _run_with_retry(
         [sys.executable, os.path.abspath(__file__), "--serve-obs-worker"],
-        MEASURE_TIMEOUT_S, retry_on_timeout=False)
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_obs")
     skw = {"metric": "serve_obs_overhead_pct",
            "unit": "pct_decode_tokens_per_s"}
     if not ok:
@@ -447,7 +466,7 @@ def _serve_paged_main() -> int:
                           "0.5")
     ok, rc, out, err = _run_with_retry(
         [sys.executable, os.path.abspath(__file__), "--serve-paged-worker"],
-        MEASURE_TIMEOUT_S, retry_on_timeout=False)
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_paged")
     skw = {"metric": "serve_paged_capacity_ratio",
            "unit": "x_concurrent_slots_at_fixed_hbm"}
     if not ok:
@@ -484,17 +503,18 @@ def main() -> int:
     # Stage 1 — backend init probe: is the chip (or any backend) reachable?
     ok, rc, out, err = _run_with_retry(
         [sys.executable, "-c", _PROBE_SRC], PROBE_TIMEOUT_S,
-        retry_on_timeout=True)
+        retry_on_timeout=True, attempts=PROBE_ATTEMPTS,
+        stage="backend_init")
     if not ok:
-        why = ("backend init did not return within "
-               f"{PROBE_TIMEOUT_S}s (x2 attempts) — device tunnel wedged?"
+        why = (f"backend init did not return within {PROBE_TIMEOUT_S}s "
+               f"(x{PROBE_ATTEMPTS} attempts) — device tunnel wedged?"
                if rc is None else f"probe exited rc={rc}")
         return _fail("backend_init", f"{why}; stderr: {err.strip()}")
 
     # Stage 2 — the measurement, bounded; retried only on fast failure.
     ok, rc, out, err = _run_with_retry(
         [sys.executable, os.path.abspath(__file__), "--worker"],
-        MEASURE_TIMEOUT_S, retry_on_timeout=False)
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="measure")
     if not ok:
         why = (f"measurement did not finish within {MEASURE_TIMEOUT_S}s"
                if rc is None else f"worker exited rc={rc}")

@@ -34,13 +34,40 @@ point                 boundary
 ``sse_write``         per-event SSE write in the HTTP handler — a raised
                       ``BrokenPipeError`` simulates a client disconnect
                       mid-stream
+``ckpt_save``         top of ``utils/checkpoint.save_train_state`` —
+                      ``stall_s`` holds a save open (kill-mid-save
+                      scenarios), ``exc`` a failed persist
+``ckpt_restore``      top of ``utils/checkpoint.restore_train_state`` —
+                      a raised fault stands in for an unreadable
+                      checkpoint (quarantine/fallback path)
+``rdv_connect``       each ``jax.distributed.initialize`` attempt inside
+                      ``parallel/distributed.py``'s retry loop — a raised
+                      fault simulates coordinator DNS not yet resolvable
+``train_step``        top of the train_job step body — ``stall_s`` widens
+                      the SIGTERM-mid-step window, ``exc`` a mid-step
+                      crash (resume-from-checkpoint path)
 ====================  =====================================================
 """
 
 from __future__ import annotations
 
+import os
 import threading
 import time
+
+
+def chaos_from_env() -> "FaultInjector | None":
+    """Build an injector from the ``K3STPU_CHAOS`` environment variable.
+
+    The single entry point every subprocess workload (serve server, train
+    job, launch) uses to arm faults from a parent test. Unset — the only
+    production state — returns None: zero hooks armed, zero overhead.
+    """
+    spec = os.environ.get("K3STPU_CHAOS")
+    if not spec:
+        return None
+    print(f"CHAOS ARMED: {spec}", flush=True)
+    return FaultInjector.from_env(spec)
 
 
 class InjectedFault(RuntimeError):

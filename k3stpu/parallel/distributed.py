@@ -17,14 +17,33 @@ environment an Indexed Job provides (deploy/manifests/tpu-pjit-job.yaml):
 Everything is overridable via explicit env (K3STPU_COORDINATOR,
 K3STPU_PROCESS_ID) so the same code runs under bare `srun`-style launchers or
 tests with no cluster.
+
+Rendezvous is **bounded and retrying** (docs/RESILIENCE.md): when pod 0 is
+being rescheduled its headless-Service DNS entry does not resolve yet, and a
+bare ``jax.distributed.initialize`` hangs for minutes with zero diagnostics.
+Here every attempt gets a configurable timeout
+(``K3STPU_RDV_TIMEOUT_S``, per attempt), failures retry with capped
+exponential backoff (``K3STPU_RDV_ATTEMPTS`` / ``K3STPU_RDV_BACKOFF_S`` /
+``K3STPU_RDV_BACKOFF_CAP_S``), every attempt is a JSON log event, and
+exhaustion raises a diagnosable error naming the coordinator — fail fast
+and let the Job's backoffLimit restart beat an unbounded hang.
 """
 
 from __future__ import annotations
 
+import json
 import os
+import time
 from dataclasses import dataclass
 
 DEFAULT_PORT = 8476
+
+# Rendezvous bounds — env-overridable so a cluster with slow DNS
+# convergence can widen them without a rebuild.
+DEFAULT_TIMEOUT_S = 120.0
+DEFAULT_ATTEMPTS = 4
+DEFAULT_BACKOFF_S = 2.0
+DEFAULT_BACKOFF_CAP_S = 30.0
 
 
 @dataclass(frozen=True)
@@ -97,22 +116,121 @@ def rendezvous_from_env(env: "dict[str, str] | None" = None,
                       process_id=pid)
 
 
-def initialize(rdv: Rendezvous | None = None) -> Rendezvous:
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+class RendezvousError(RuntimeError):
+    """Rendezvous exhausted its attempt budget — the error names the
+    coordinator and every attempt's failure so `kubectl logs` diagnoses it
+    without a rebuild."""
+
+
+def connect_with_retries(connect, rdv: Rendezvous, *,
+                         timeout_s: float,
+                         attempts: int,
+                         backoff_s: float,
+                         backoff_cap_s: float,
+                         chaos=None,
+                         _sleep=time.sleep) -> None:
+    """Drive ``connect()`` (one bounded jax.distributed.initialize attempt)
+    through capped-exponential-backoff retries, one JSON log event per
+    attempt. Split out so tests drive the schedule with a fake connect."""
+    failures = []
+    for attempt in range(1, attempts + 1):
+        print(json.dumps({
+            "event": "rdv_attempt", "attempt": attempt,
+            "max_attempts": attempts, "timeout_s": timeout_s,
+            "coordinator": rdv.coordinator_address,
+            "process_id": rdv.process_id,
+            "num_processes": rdv.num_processes,
+        }), flush=True)
+        t0 = time.monotonic()
+        try:
+            if chaos is not None:
+                chaos.fire("rdv_connect")
+            connect()
+            print(json.dumps({
+                "event": "rdv_ok", "attempt": attempt,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+            }), flush=True)
+            return
+        except Exception as e:  # noqa: BLE001 — every failure is retried
+            detail = f"{type(e).__name__}: {e}"[:300]
+            failures.append(detail)
+            wait = min(backoff_s * (2 ** (attempt - 1)), backoff_cap_s)
+            print(json.dumps({
+                "event": "rdv_retry" if attempt < attempts else "rdv_failed",
+                "attempt": attempt,
+                "elapsed_s": round(time.monotonic() - t0, 3),
+                "error": detail,
+                "backoff_s": wait if attempt < attempts else None,
+            }), flush=True)
+            if attempt < attempts:
+                _sleep(wait)
+    raise RendezvousError(
+        f"rendezvous with {rdv.coordinator_address} failed after "
+        f"{attempts} attempts (process_id={rdv.process_id}, "
+        f"num_processes={rdv.num_processes}, timeout_s={timeout_s}): "
+        f"{failures}")
+
+
+def initialize(rdv: Rendezvous | None = None, *,
+               timeout_s: "float | None" = None,
+               attempts: "int | None" = None,
+               backoff_s: "float | None" = None,
+               backoff_cap_s: "float | None" = None,
+               chaos=None) -> Rendezvous:
     """Join the JAX process group (no-op for a single process).
 
     After this returns, jax.devices() is the GLOBAL device list across all
     Job pods and any jit/pjit over a mesh of those devices emits ICI/DCN
     collectives — the TPU-native replacement for the NCCL/MPI layer the
     reference never had (SURVEY.md §2d).
+
+    Each attempt is bounded (``timeout_s``/K3STPU_RDV_TIMEOUT_S feeds
+    jax's ``initialization_timeout``) and failures retry with capped
+    exponential backoff — see the module docstring and
+    :func:`connect_with_retries`.
     """
     if rdv is None:
         rdv = rendezvous_from_env()
-    if rdv.is_distributed:
-        import jax
+    if not rdv.is_distributed:
+        return rdv
+    if timeout_s is None:
+        timeout_s = _env_float("K3STPU_RDV_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+    if attempts is None:
+        attempts = int(os.environ.get("K3STPU_RDV_ATTEMPTS",
+                                      DEFAULT_ATTEMPTS))
+    if backoff_s is None:
+        backoff_s = _env_float("K3STPU_RDV_BACKOFF_S", DEFAULT_BACKOFF_S)
+    if backoff_cap_s is None:
+        backoff_cap_s = _env_float("K3STPU_RDV_BACKOFF_CAP_S",
+                                   DEFAULT_BACKOFF_CAP_S)
 
-        jax.distributed.initialize(
-            coordinator_address=rdv.coordinator_address,
-            num_processes=rdv.num_processes,
-            process_id=rdv.process_id,
-        )
+    import jax
+
+    def connect():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=rdv.coordinator_address,
+                num_processes=rdv.num_processes,
+                process_id=rdv.process_id,
+                initialization_timeout=max(1, int(timeout_s)),
+            )
+        except Exception:
+            # A failed attempt can leave a half-built client registered;
+            # tear it down so the retry starts from a clean slate.
+            try:
+                jax.distributed.shutdown()
+            except Exception:  # noqa: BLE001 — best-effort cleanup
+                pass
+            raise
+
+    connect_with_retries(connect, rdv, timeout_s=timeout_s,
+                         attempts=attempts, backoff_s=backoff_s,
+                         backoff_cap_s=backoff_cap_s, chaos=chaos)
     return rdv

@@ -33,9 +33,12 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--skip-allreduce", action="store_true")
     args = ap.parse_args(argv)
 
+    from k3stpu.chaos import chaos_from_env
     from k3stpu.parallel.distributed import initialize
 
-    rdv = initialize()
+    # K3STPU_CHAOS can arm rdv_connect here (docs/RESILIENCE.md): the
+    # resilience suite uses it to prove the bounded rendezvous retries.
+    rdv = initialize(chaos=chaos_from_env())
 
     import jax
 

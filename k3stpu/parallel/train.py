@@ -133,11 +133,23 @@ def make_train_bundle(
         p = optax.apply_updates(p, updates)
         return p, new_stats, opt_state, loss
 
+    # opt_state shardings must be EXPLICIT on both sides of the jit: it is
+    # donated, and leaving them to propagation lets XLA pick an output
+    # sharding different from the (possibly replicated) input leaf — a
+    # donation aliasing size mismatch that fails at dispatch with an
+    # INTERNAL error on multi-device meshes.
+    opt_sh = jax.tree.map(lambda x: x.sharding, opt_state)
+    # No donation on the CPU backend: an XLA:CPU executable restored from
+    # the persistent compilation cache loses its input/output aliasing
+    # metadata and segfaults on its second dispatch when arguments were
+    # donated. CPU is the test/dry-run backend where buffer reuse doesn't
+    # matter; accelerators keep the donation.
+    donate = () if jax.default_backend() == "cpu" else (0, 1, 2)
     step_fn = jax.jit(
         step,
-        in_shardings=(param_sh, stats_sh, None, data_sh, data_sh),
-        out_shardings=(param_sh, stats_sh, None, repl),
-        donate_argnums=(0, 1, 2),
+        in_shardings=(param_sh, stats_sh, opt_sh, data_sh, data_sh),
+        out_shardings=(param_sh, stats_sh, opt_sh, repl),
+        donate_argnums=donate,
     )
 
     def eval_loss(p, stats, inputs, labels):

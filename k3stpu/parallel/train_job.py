@@ -12,6 +12,17 @@ becomes elastic recovery instead of a restart.
 Observability stays log-based like the reference (`kubectl logs` — reference
 README.md:134-156): one JSON line per step with loss and tokens/s.
 
+Preemption tolerance (docs/RESILIENCE.md): SIGTERM/SIGINT set a stop flag
+checked every step; the loop then writes one final **emergency checkpoint**
+(blocking, finalized, manifest included), drains in-flight async saves, and
+exits with ``PREEMPTED_EXIT_CODE`` so the Job's backoffLimit restart resumes
+from that exact step instead of recomputing. The emergency path is bounded
+(``K3STPU_PREEMPT_SAVE_BOUND_S``) so it always finishes inside the pod's
+``terminationGracePeriodSeconds``. On boot, the chosen checkpoint is
+verified against its integrity manifest; a corrupt step is quarantined and
+the previous finalized step wins. ``--keep-last N`` garbage-collects older
+finalized steps so the PVC stays bounded over a long run.
+
 Run: python -m k3stpu.parallel.train_job --steps 100 --ckpt-dir /ckpt
 """
 
@@ -19,8 +30,23 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import signal
 import sys
+import threading
 import time
+
+# Distinct from a crash (nonzero) and success (0): the driver/operator can
+# tell "preempted mid-run, emergency checkpoint landed, restart will
+# resume" from `kubectl describe` alone.
+PREEMPTED_EXIT_CODE = 42
+
+# Hard bound on the emergency-save path (drain + blocking save), so SIGTERM
+# -> exit always fits inside terminationGracePeriodSeconds (the manifests
+# ship 90s grace against this 60s bound). On timeout the partial save is
+# abandoned — latest_step/verify skip it on resume — and we exit anyway:
+# a SIGKILL mid-save would leave exactly the same tree, minus the log line.
+DEFAULT_PREEMPT_SAVE_BOUND_S = 60.0
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -79,11 +105,35 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="persistent XLA compilation cache (volume "
                          "mount): a restarted/resumed Job pod skips "
                          "recompiling the train step")
+    ap.add_argument("--keep-last", type=int, default=0, metavar="N",
+                    help="retention GC: after each finalized save, delete "
+                         "all but the newest N finalized checkpoint steps "
+                         "(never partial or quarantined ones); 0 = keep "
+                         "everything")
     args = ap.parse_args(argv)
 
+    from k3stpu.chaos import chaos_from_env
     from k3stpu.parallel.distributed import initialize
 
-    rdv = initialize()
+    chaos = chaos_from_env()
+    rdv = initialize(chaos=chaos)
+
+    # Graceful preemption: K8s delivers SIGTERM at pod eviction; flip a
+    # flag the step loop checks instead of dying mid-step. Handlers are
+    # restored on exit because tests call main() in-process.
+    stop = threading.Event()
+    stop_signal = {}
+
+    def _on_stop(signum, frame):
+        stop_signal["name"] = signal.Signals(signum).name
+        stop.set()
+
+    prev_handlers = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev_handlers[sig] = signal.signal(sig, _on_stop)
+        except ValueError:
+            pass  # not the main thread (embedded use) — flag stays unset
 
     import jax
     import jax.numpy as jnp
@@ -107,6 +157,8 @@ def main(argv: "list[str] | None" = None) -> int:
     from k3stpu.parallel.mesh import make_hybrid_mesh
     from k3stpu.parallel.train import make_train_bundle, synth_token_batch
     from k3stpu.utils import checkpoint as ckpt
+
+    ckpt.set_chaos(chaos)
 
     devices = jax.devices()
     on_accel = devices[0].platform != "cpu"
@@ -160,13 +212,38 @@ def main(argv: "list[str] | None" = None) -> int:
         optimizer=optimizer,
     )
 
+    # Resume with integrity verification: the newest finalized step must
+    # match its manifest (and actually restore) before it is trusted; a
+    # step that fails either is quarantined — never deleted — and the
+    # previous finalized step wins. Crash-looping on one bad checkpoint is
+    # the failure mode this loop exists to remove.
     start_step = 0
     if args.ckpt_dir:
         last = ckpt.latest_step(args.ckpt_dir)
-        if last is not None:
-            ckpt.restore_bundle(args.ckpt_dir, last, bundle)
-            start_step = last
-            print(json.dumps({"event": "resume", "step": last}), flush=True)
+        while last is not None:
+            ok, why = ckpt.verify_step(args.ckpt_dir, last)
+            if ok:
+                try:
+                    ckpt.restore_bundle(args.ckpt_dir, last, bundle)
+                except Exception as e:  # noqa: BLE001 — fall back, not loop
+                    ok, why = False, f"restore failed: {e!r}"[:300]
+            if ok:
+                start_step = last
+                print(json.dumps({"event": "resume", "step": last,
+                                  "verify": why}), flush=True)
+                break
+            qdir = ckpt.quarantine_step(args.ckpt_dir, last)
+            print(json.dumps({"event": "ckpt_quarantined", "step": last,
+                              "reason": why, "quarantined_to": str(qdir)}),
+                  flush=True)
+            last = ckpt.latest_step(args.ckpt_dir)
+        if last is None:
+            partial = ckpt.partial_steps(args.ckpt_dir)
+            if partial:
+                # Boot found only unfinalized debris (a save the dying pod
+                # never committed) — starting fresh is correct, but say so.
+                print(json.dumps({"event": "resume_skipped_partial",
+                                  "partial": partial}), flush=True)
 
     if args.init_from and start_step == 0:
         # Warm start: restore the params ANOTHER run saved into the leaves
@@ -257,10 +334,33 @@ def main(argv: "list[str] | None" = None) -> int:
         # holdout (or bad split config) at startup, not at step N mid-run.
         eval_batches_fn()
 
+    def gc_now():
+        # Retention: only FINALIZED steps count, so an in-flight async
+        # save can never be deleted (it is tmp-named until commit, and
+        # once committed it is the newest). Partials and quarantined
+        # steps are never touched.
+        if args.keep_last > 0:
+            deleted = ckpt.gc_steps(args.ckpt_dir, args.keep_last)
+            if deleted:
+                print(json.dumps({"event": "ckpt_gc", "deleted": deleted,
+                                  "keep_last": args.keep_last}), flush=True)
+
+    def checkpoint_and_gc(step, *, blocking=False):
+        ckpt.save_bundle(args.ckpt_dir, step, bundle, blocking=blocking)
+        print(json.dumps({"event": "checkpoint", "step": step,
+                          "async": not blocking}), flush=True)
+        gc_now()
+
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
+    last_done = last_saved = start_step
+    preempted = False
     try:
         for step in range(start_step, args.steps):
+            if stop.is_set():
+                break
+            if chaos is not None:
+                chaos.fire("train_step")
             if prefetch is not None:
                 inputs, labels = next(batches)
             else:
@@ -277,6 +377,7 @@ def main(argv: "list[str] | None" = None) -> int:
                 "tflops_per_chip": round(tflops, 2),
                 "mfu": round(tflops / peak, 4) if peak else None,
             }), flush=True)
+            last_done = step + 1
             if args.eval_every and (step + 1) % args.eval_every == 0:
                 import math
 
@@ -292,26 +393,67 @@ def main(argv: "list[str] | None" = None) -> int:
             if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
                 # Async: the persist overlaps the next steps' compute; the
                 # next save (or the final wait) drains it.
-                ckpt.save_bundle(args.ckpt_dir, step + 1, bundle,
-                                 blocking=False)
-                print(json.dumps({"event": "checkpoint", "step": step + 1,
-                                  "async": True}), flush=True)
+                checkpoint_and_gc(step + 1)
+                last_saved = step + 1
 
-        # Final save, unless the periodic save already covered this step.
-        if (args.ckpt_dir and args.steps > start_step
+        preempted = stop.is_set()
+        if preempted:
+            # Graceful preemption: drain any in-flight async save, then one
+            # final emergency checkpoint of the last completed step —
+            # blocking (finalized + manifest before exit) but BOUNDED, so
+            # SIGTERM -> exit always fits inside the pod's termination
+            # grace period. An async save already covering last_done makes
+            # this a pure drain.
+            bound_s = float(os.environ.get(
+                "K3STPU_PREEMPT_SAVE_BOUND_S",
+                DEFAULT_PREEMPT_SAVE_BOUND_S))
+            ev = {"event": "preempted", "step": last_done,
+                  "signal": stop_signal.get("name", "SIGTERM"),
+                  "emergency_ckpt": False}
+            if args.ckpt_dir:
+                t0 = time.monotonic()
+                done = {}
+
+                def _save():
+                    try:
+                        ckpt.wait_for_saves()  # drain in-flight async save
+                        if last_done > last_saved:
+                            checkpoint_and_gc(last_done, blocking=True)
+                        done["ok"] = True
+                    except Exception as e:  # noqa: BLE001 — report + exit
+                        done["error"] = f"{type(e).__name__}: {e}"[:300]
+
+                saver = threading.Thread(target=_save, daemon=True)
+                saver.start()
+                saver.join(bound_s)
+                ev.update(
+                    emergency_ckpt=bool(done.get("ok")),
+                    save_s=round(time.monotonic() - t0, 3),
+                    save_bound_s=bound_s,
+                    save_error=("timed out" if saver.is_alive()
+                                else done.get("error")))
+            print(json.dumps(ev), flush=True)
+        elif (args.ckpt_dir and args.steps > start_step
                 and args.steps % args.ckpt_every != 0):
-            ckpt.save_bundle(args.ckpt_dir, args.steps, bundle,
-                             blocking=False)
-            print(json.dumps({"event": "checkpoint", "step": args.steps,
-                              "async": True}), flush=True)
+            # Final save, unless the periodic save already covered it.
+            checkpoint_and_gc(args.steps)
     finally:
         # A crashing loop must still land any in-flight async save — that
         # snapshot is already host-resident and is exactly the state the
-        # restarted pod should resume from.
+        # restarted pod should resume from. (The preempted path already
+        # drained under its bound; a second, UNBOUNDED wait here could
+        # blow the termination grace period, so it is skipped.)
         if prefetch is not None:
             prefetch.close()
-        ckpt.wait_for_saves()
-    return 0
+        if not preempted:
+            ckpt.wait_for_saves()
+            if args.ckpt_dir:
+                # The drain may have just finalized the newest step; one
+                # more retention pass leaves exactly --keep-last steps.
+                gc_now()
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
+    return PREEMPTED_EXIT_CODE if preempted else 0
 
 
 if __name__ == "__main__":

@@ -1648,15 +1648,11 @@ def start_telemetry_thread(server: InferenceServer,
 
 def _chaos_from_env():
     """Fault injection for subprocess tests (K3STPU_CHAOS spec string —
-    see k3stpu.chaos.FaultInjector.from_env). Unset (the only production
-    state) returns None: zero hooks armed, zero overhead."""
-    spec = os.environ.get("K3STPU_CHAOS")
-    if not spec:
-        return None
-    from k3stpu.chaos import FaultInjector
+    see k3stpu.chaos.chaos_from_env). Unset (the only production state)
+    returns None: zero hooks armed, zero overhead."""
+    from k3stpu.chaos import chaos_from_env
 
-    print(f"CHAOS ARMED: {spec}", flush=True)
-    return FaultInjector.from_env(spec)
+    return chaos_from_env()
 
 
 def main(argv=None) -> int:

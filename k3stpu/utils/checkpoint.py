@@ -11,14 +11,47 @@ without funnelling all parameters through one process.
 Layout: ``<dir>/<step>/`` per step, orbax-managed, plus ``latest_step()``
 for resume-on-boot. The K8s side needs nothing new: mount a volume, point
 ``--ckpt-dir`` at it, and the Deployment/Job self-heals into a resume.
+
+Integrity + retention (the preemption-tolerance layer, docs/RESILIENCE.md):
+every finalized save also gets a per-step **manifest**
+(``<dir>/manifests/<step>.json`` — leaf file paths, byte sizes, sha256) so
+resume can ``verify_step`` before trusting it; a step that fails its
+manifest is ``quarantine_step``-ed (moved under ``<dir>/quarantine/``,
+never deleted) and the previous finalized step wins. ``gc_steps`` keeps
+the PVC bounded over a long run: only *finalized* steps beyond the newest
+``keep_last`` are deleted — partial/tmp saves and quarantined steps are
+never GC'd (they are the evidence).
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
+import os
 import pathlib
+import shutil
 from typing import Any
 
 import jax
+
+MANIFEST_DIRNAME = "manifests"
+QUARANTINE_DIRNAME = "quarantine"
+
+# Fault injection (k3stpu.chaos): None in production — every hook is one
+# `is not None` check. Armed by train_job from K3STPU_CHAOS or by tests.
+_chaos = None
+
+
+def set_chaos(injector) -> None:
+    """Install a FaultInjector consulted at ``ckpt_save``/``ckpt_restore``
+    (None disarms)."""
+    global _chaos
+    _chaos = injector
+
+
+def _fire(point: str) -> None:
+    if _chaos is not None:
+        _chaos.fire(point)
 
 
 def _checkpointer():
@@ -28,6 +61,21 @@ def _checkpointer():
 
 
 _async_ckptr = None
+
+# Steps whose async save has been scheduled but whose manifest is not yet
+# written (the manifest must only describe FINALIZED bytes, so it is
+# written at the drain points: the next save, or wait_for_saves()).
+_pending_manifests: "list[tuple[pathlib.Path, int]]" = []
+
+
+def _flush_pending_manifests() -> None:
+    """Write manifests for async saves that have since finalized. Called
+    with no save in flight (right after wait_until_finished)."""
+    global _pending_manifests
+    pending, _pending_manifests = _pending_manifests, []
+    for root, step in pending:
+        if _is_finalized_step(root / str(step)):
+            write_manifest(root, step)
 
 
 def _async_checkpointer():
@@ -41,9 +89,11 @@ def _async_checkpointer():
 
 def wait_for_saves() -> None:
     """Block until every in-flight async save has committed (call before
-    process exit, or before reading back a just-written step)."""
+    process exit, or before reading back a just-written step). Also writes
+    the manifests those saves were waiting on."""
     if _async_ckptr is not None:
         _async_ckptr.wait_until_finished()
+    _flush_pending_manifests()
 
 
 def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
@@ -60,17 +110,22 @@ def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
     unfinalized steps, so an interrupted async save can never be resumed
     from.
     """
-    path = pathlib.Path(directory).resolve() / str(step)
+    _fire("ckpt_save")
+    root = pathlib.Path(directory).resolve()
+    path = root / str(step)
     if blocking:
         ckptr = _checkpointer()
         ckptr.save(path, state, force=force)
         ckptr.wait_until_finished()
+        write_manifest(root, step)
     else:
         import orbax.checkpoint as ocp
 
         ckptr = _async_checkpointer()
         ckptr.wait_until_finished()  # previous in-flight save must land
+        _flush_pending_manifests()
         ckptr.save(path, args=ocp.args.StandardSave(state), force=force)
+        _pending_manifests.append((root, step))
     return path
 
 
@@ -82,6 +137,7 @@ def restore_train_state(directory: str | pathlib.Path, step: int,
     with shardings attached — restoring to a sharded target places each
     shard directly on its device (no host-side gather).
     """
+    _fire("ckpt_restore")
     path = pathlib.Path(directory).resolve() / str(step)
     abstract = jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=getattr(
@@ -121,29 +177,162 @@ def tree_metadata(directory: str | pathlib.Path, step: int):
     return md.item_metadata.tree if hasattr(md, "item_metadata") else md.tree
 
 
+def _is_finalized_step(path: pathlib.Path) -> bool:
+    """True iff ``path`` is a finalized orbax step directory."""
+    if not (path.is_dir() and path.name.isdigit()):
+        return False
+    import orbax.checkpoint as ocp
+
+    try:
+        return bool(ocp.utils.is_checkpoint_finalized(path))
+    except (ValueError, OSError):
+        return False  # tmp/partial layout — not resumable
+
+
+def finalized_steps(directory: str | pathlib.Path) -> "list[int]":
+    """Sorted step numbers with finalized checkpoints under ``directory``."""
+    root = pathlib.Path(directory)
+    if not root.is_dir():
+        return []
+    return sorted(int(p.name) for p in root.iterdir()
+                  if _is_finalized_step(p))
+
+
 def latest_step(directory: str | pathlib.Path) -> int | None:
     """Highest step with a *finalized* checkpoint under ``directory``.
 
     A save interrupted by preemption leaves a partial step directory (on
-    object stores orbax marks completion with a commit file rather than an
-    atomic rename); resuming from it would crash-loop the job, so those are
-    skipped and the previous complete step wins.
+    local filesystems an ``<step>.orbax-checkpoint-tmp-<ts>`` dir awaiting
+    its atomic rename; on object stores a step dir missing the commit
+    file); resuming from it would crash-loop the job, so those are skipped
+    and the previous complete step wins.
     """
+    steps = finalized_steps(directory)
+    return steps[-1] if steps else None
+
+
+def partial_steps(directory: str | pathlib.Path) -> "list[str]":
+    """Names of step-like directories an interrupted save left behind:
+    orbax tmp dirs (``<step>.orbax-checkpoint-tmp-<ts>``) and digit dirs
+    that fail the finalization check. Diagnostic only — these are never
+    resumed from and never GC'd."""
     root = pathlib.Path(directory)
     if not root.is_dir():
-        return None
-    import orbax.checkpoint as ocp
-
-    steps = []
+        return []
+    out = []
     for p in root.iterdir():
-        if not (p.is_dir() and p.name.isdigit()):
+        if not p.is_dir():
             continue
-        try:
-            if ocp.utils.is_checkpoint_finalized(p):
-                steps.append(int(p.name))
-        except (ValueError, OSError):
-            continue  # tmp/partial layout — not resumable
-    return max(steps) if steps else None
+        if "orbax-checkpoint-tmp" in p.name:
+            out.append(p.name)
+        elif p.name.isdigit() and not _is_finalized_step(p):
+            out.append(p.name)
+    return sorted(out)
+
+
+# --- integrity manifests + quarantine + retention ------------------------
+
+
+def _manifest_path(root: pathlib.Path, step: int) -> pathlib.Path:
+    return root / MANIFEST_DIRNAME / f"{step}.json"
+
+
+def _file_digest(path: pathlib.Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def write_manifest(directory: str | pathlib.Path,
+                   step: int) -> pathlib.Path:
+    """Record every host-visible file of a FINALIZED step (relative path,
+    byte size, sha256) so a later boot can prove the bytes it is about to
+    resume from are the bytes that were committed. Written atomically
+    (tmp + rename): a manifest can never itself be half-written."""
+    root = pathlib.Path(directory).resolve()
+    step_dir = root / str(step)
+    files = []
+    for p in sorted(step_dir.rglob("*")):
+        if p.is_file():
+            files.append({"path": str(p.relative_to(step_dir)),
+                          "bytes": p.stat().st_size,
+                          "sha256": _file_digest(p)})
+    mpath = _manifest_path(root, step)
+    mpath.parent.mkdir(parents=True, exist_ok=True)
+    tmp = mpath.with_suffix(".json.tmp")
+    tmp.write_text(json.dumps({"step": step, "files": files}, indent=1))
+    os.replace(tmp, mpath)
+    return mpath
+
+
+def verify_step(directory: str | pathlib.Path,
+                step: int) -> "tuple[bool, str]":
+    """Check the step's on-disk files against its manifest.
+
+    Returns ``(ok, detail)``. A step without a manifest (written by an
+    older build, or whose process died between commit and manifest) passes
+    with detail ``"no-manifest"`` — integrity is an upgrade, not a
+    back-compat break; orbax's own finalization check still gates it."""
+    root = pathlib.Path(directory).resolve()
+    step_dir = root / str(step)
+    if not _is_finalized_step(step_dir):
+        return False, "not a finalized step"
+    mpath = _manifest_path(root, step)
+    if not mpath.is_file():
+        return True, "no-manifest"
+    try:
+        manifest = json.loads(mpath.read_text())
+    except (OSError, ValueError) as e:
+        return False, f"unreadable manifest: {e}"
+    for rec in manifest.get("files", []):
+        p = step_dir / rec["path"]
+        if not p.is_file():
+            return False, f"missing file: {rec['path']}"
+        if p.stat().st_size != rec["bytes"]:
+            return False, (f"size mismatch: {rec['path']} "
+                           f"{p.stat().st_size} != {rec['bytes']}")
+        if _file_digest(p) != rec["sha256"]:
+            return False, f"checksum mismatch: {rec['path']}"
+    return True, f"verified {len(manifest.get('files', []))} files"
+
+
+def quarantine_step(directory: str | pathlib.Path,
+                    step: int) -> pathlib.Path:
+    """Move a failed step (and its manifest) under ``<dir>/quarantine/``
+    so resume falls back to the previous finalized step WITHOUT destroying
+    the evidence. Never deletes; a name collision gets a ``-N`` suffix."""
+    root = pathlib.Path(directory).resolve()
+    qdir = root / QUARANTINE_DIRNAME
+    qdir.mkdir(parents=True, exist_ok=True)
+    dest = qdir / str(step)
+    n = 0
+    while dest.exists():
+        n += 1
+        dest = qdir / f"{step}-{n}"
+    shutil.move(str(root / str(step)), str(dest))
+    mpath = _manifest_path(root, step)
+    if mpath.is_file():
+        shutil.move(str(mpath), str(dest) + ".manifest.json")
+    return dest
+
+
+def gc_steps(directory: str | pathlib.Path, keep_last: int) -> "list[int]":
+    """Retention: delete finalized steps older than the newest
+    ``keep_last``, with their manifests. Partial/tmp saves and quarantined
+    steps are never touched — they are under inspection, not retention.
+    Returns the deleted step numbers."""
+    if keep_last < 1:
+        raise ValueError("keep_last must be >= 1")
+    root = pathlib.Path(directory).resolve()
+    doomed = finalized_steps(root)[:-keep_last]
+    for step in doomed:
+        shutil.rmtree(root / str(step))
+        mpath = _manifest_path(root, step)
+        if mpath.is_file():
+            mpath.unlink()
+    return doomed
 
 
 def save_bundle(directory: str | pathlib.Path, step: int, bundle,

@@ -22,10 +22,11 @@
 #            the round gate; smoke exists so intermediate commits keep a
 #            fast green signal as the suite's wall time grows. Paged-KV
 #            exactness, the serving observability layer (histograms,
-#            request traces, /debug endpoints), and the chaos/containment
-#            suite (fault injection + recovery invariants) ride along
-#            minus their @slow soak/bench tests (the full suite runs
-#            those).
+#            request traces, /debug endpoints), the chaos/containment
+#            suite (fault injection + recovery invariants), and the
+#            training-resilience suite (SIGTERM checkpointing, quarantine,
+#            retention, bounded rendezvous) ride along minus their @slow
+#            soak/bench tests (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -51,7 +52,7 @@ if [ "${1:-}" = "--smoke" ]; then
     tests/test_e2e_assets.py \
     tests/test_bench.py tests/test_graft_entry.py \
     tests/test_paged.py tests/test_obs.py \
-    tests/test_chaos.py -m "not slow" "$@"
+    tests/test_chaos.py tests/test_train_resilience.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

@@ -94,6 +94,47 @@ def test_wedged_probe_yields_structured_error_line(monkeypatch):
     assert rec["value"] == 0.0 and "error" in rec and "detail" in rec
 
 
+def test_probe_knobs_come_from_env():
+    """K3STPU_BENCH_PROBE_TIMEOUT_S / _ATTEMPTS tune the flaky first
+    tunnel contact without editing bench.py (read at import time)."""
+    env = dict(os.environ, PYTHONPATH="", JAX_PLATFORMS="cpu",
+               K3STPU_BENCH_PROBE_TIMEOUT_S="7",
+               K3STPU_BENCH_PROBE_ATTEMPTS="5")
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import bench; print(bench.PROBE_TIMEOUT_S, bench.PROBE_ATTEMPTS)"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert out.stdout.split() == ["7", "5"]
+    # attempts floor: a zero/negative override must not disable the probe
+    env["K3STPU_BENCH_PROBE_ATTEMPTS"] = "0"
+    out = subprocess.run(
+        [sys.executable, "-c", "import bench; print(bench.PROBE_ATTEMPTS)"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=60)
+    assert out.stdout.split() == ["1"]
+
+
+def test_failure_line_carries_per_stage_wall_times(monkeypatch):
+    """The error line must say where the time went: stage_s records each
+    stage's cumulative wall time (all attempts) for triage."""
+    monkeypatch.setattr(bench, "_PROBE_SRC", "import time; time.sleep(60)")
+    monkeypatch.setattr(bench, "PROBE_TIMEOUT_S", 1)
+    monkeypatch.setattr(bench, "PROBE_ATTEMPTS", 2)
+    monkeypatch.setattr(bench, "RETRY_WAIT_S", 0)
+    monkeypatch.setattr(bench, "_stage_s", {})
+    import io
+    from contextlib import redirect_stdout
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        assert bench.main() == 0
+    (line,) = [l for l in buf.getvalue().strip().splitlines() if l.strip()]
+    rec = json.loads(line)
+    assert rec["stage"] == "backend_init"
+    assert "x2 attempts" in rec["detail"]
+    # Two 1s-timeout attempts: cumulative stage time ~2s, rounded to 2dp.
+    assert rec["stage_s"]["backend_init"] >= 1.5
+
+
 def test_sigterm_parent_does_not_orphan_child():
     """Kill bench mid-probe (as an outer `timeout` would): the probe
     child — which on TPU would hold the chip claim — must die with it."""

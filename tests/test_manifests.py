@@ -115,3 +115,27 @@ def test_pjit_job_rendezvous_wiring():
     assert "k3stpu.parallel.launch" in ctr["command"]
     # Multi-chip pod (values.yaml:15 analogue): whole host's chips.
     assert int(ctr["resources"]["limits"]["google.com/tpu"]) >= 1
+    # Rendezvous teardown gets more than the 30s kubelet default.
+    assert pod["terminationGracePeriodSeconds"] >= 60
+
+
+def test_train_job_preemption_budget():
+    """SIGTERM -> bounded emergency checkpoint -> exit: the pod's grace
+    period must exceed the save bound (plus headroom) or kubelet SIGKILLs
+    mid-save and the restart recomputes up to --ckpt-every steps."""
+    docs = load_all("tpu-train-job.yaml")
+    (job,) = by_kind(docs, "Job")
+    spec = job["spec"]
+    # Restarts ARE the recovery mechanism for a preemptible training Job.
+    assert spec["backoffLimit"] >= 1
+    pod = spec["template"]["spec"]
+    grace = pod["terminationGracePeriodSeconds"]
+    (ctr,) = pod["containers"]
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    bound = float(env["K3STPU_PREEMPT_SAVE_BOUND_S"])
+    assert grace >= bound + 15, (
+        f"terminationGracePeriodSeconds={grace} must exceed the emergency-"
+        f"save bound {bound}s with headroom for drain + log flush")
+    # Long-running Job on a finite PVC: retention GC must be on.
+    cmd = ctr["command"]
+    assert "--keep-last" in cmd and int(cmd[cmd.index("--keep-last") + 1]) >= 2

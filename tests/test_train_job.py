@@ -30,7 +30,9 @@ def test_train_then_resume(tmp_path, capsys):
                            "--ckpt-dir", ckpt, "--ckpt-every", "2",
                            "--batch", "8", "--seq", "32"])
     kinds = [e["event"] for e in events]
-    assert {"event": "resume", "step": 4} in events
+    (resume,) = [e for e in events if e["event"] == "resume"]
+    assert resume["step"] == 4
+    assert resume["verify"].startswith("verified")  # manifest checked
     assert kinds.count("step") == 2
     steps = [e["step"] for e in events if e["event"] == "step"]
     assert steps == [5, 6]
