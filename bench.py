@@ -44,11 +44,19 @@ from k3stpu.utils.subproc import kill_active_groups, run_bounded  # noqa: E402
 BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
 # Probe bounds are env-overridable so a wedged-tunnel failure (BENCH_r05
 # died at backend_init) can be triaged — longer timeout, more attempts —
-# without editing code.
-PROBE_TIMEOUT_S = int(os.environ.get(
-    "K3STPU_BENCH_PROBE_TIMEOUT_S", "120"))  # first tunnel contact
-PROBE_ATTEMPTS = max(1, int(os.environ.get(
-    "K3STPU_BENCH_PROBE_ATTEMPTS", "2")))
+# without editing code. Malformed values fall back to the defaults (same
+# degrade-not-crash semantics as the K3STPU_RDV_* knobs).
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+PROBE_TIMEOUT_S = _env_int("K3STPU_BENCH_PROBE_TIMEOUT_S", 120)
+PROBE_ATTEMPTS = max(1, _env_int("K3STPU_BENCH_PROBE_ATTEMPTS", 2))
 MEASURE_TIMEOUT_S = 480  # compile (~20-40s first time) + timed loop
 RETRY_WAIT_S = 10
 RETRY_FAST_S = 60       # only failures faster than this are worth retrying

@@ -123,6 +123,15 @@ def _env_float(name: str, default: float) -> float:
         return default
 
 
+def _env_int(name: str, default: int) -> int:
+    # Same fallback-to-default semantics as _env_float: a typo'd env var
+    # must not crash the job before rendezvous even starts.
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
 class RendezvousError(RuntimeError):
     """Rendezvous exhausted its attempt budget — the error names the
     coordinator and every attempt's failure so `kubectl logs` diagnoses it
@@ -203,8 +212,7 @@ def initialize(rdv: Rendezvous | None = None, *,
     if timeout_s is None:
         timeout_s = _env_float("K3STPU_RDV_TIMEOUT_S", DEFAULT_TIMEOUT_S)
     if attempts is None:
-        attempts = int(os.environ.get("K3STPU_RDV_ATTEMPTS",
-                                      DEFAULT_ATTEMPTS))
+        attempts = _env_int("K3STPU_RDV_ATTEMPTS", DEFAULT_ATTEMPTS)
     if backoff_s is None:
         backoff_s = _env_float("K3STPU_RDV_BACKOFF_S", DEFAULT_BACKOFF_S)
     if backoff_cap_s is None:

@@ -30,7 +30,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import signal
 import sys
 import threading
@@ -47,6 +46,18 @@ PREEMPTED_EXIT_CODE = 42
 # abandoned — latest_step/verify skip it on resume — and we exit anyway:
 # a SIGKILL mid-save would leave exactly the same tree, minus the log line.
 DEFAULT_PREEMPT_SAVE_BOUND_S = 60.0
+
+# Quarantine budget per boot. One bad checkpoint (bitrot, torn write) is
+# the case quarantine exists for; a parade of failures across independent
+# steps is an ENVIRONMENTAL problem (device OOM, PVC hiccup) that
+# quarantining would escalate into silently training from step 0. Past
+# these caps the boot raises — exit nonzero, checkpoint tree intact — so
+# the Job's backoffLimit restart retries a likely-transient failure.
+MAX_QUARANTINES_PER_BOOT = 2
+# Restore failures are the ambiguous kind (verify_step already passed):
+# allow exactly one the benefit of the doubt, treat a second as
+# environmental.
+MAX_RESTORE_FAILURE_QUARANTINES = 1
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -113,10 +124,14 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
 
     from k3stpu.chaos import chaos_from_env
-    from k3stpu.parallel.distributed import initialize
+    from k3stpu.parallel.distributed import _env_float, initialize
 
     chaos = chaos_from_env()
     rdv = initialize(chaos=chaos)
+    # Parsed ONCE at startup (fallback on malformed values): the SIGTERM
+    # path must never die in a ValueError instead of saving.
+    preempt_bound_s = _env_float("K3STPU_PREEMPT_SAVE_BOUND_S",
+                                 DEFAULT_PREEMPT_SAVE_BOUND_S)
 
     # Graceful preemption: K8s delivers SIGTERM at pod eviction; flip a
     # flag the step loop checks instead of dying mid-step. Handlers are
@@ -134,6 +149,10 @@ def main(argv: "list[str] | None" = None) -> int:
             prev_handlers[sig] = signal.signal(sig, _on_stop)
         except ValueError:
             pass  # not the main thread (embedded use) — flag stays unset
+
+    def _restore_handlers():
+        for sig, handler in prev_handlers.items():
+            signal.signal(sig, handler)
 
     import jax
     import jax.numpy as jnp
@@ -216,23 +235,47 @@ def main(argv: "list[str] | None" = None) -> int:
     # match its manifest (and actually restore) before it is trusted; a
     # step that fails either is quarantined — never deleted — and the
     # previous finalized step wins. Crash-looping on one bad checkpoint is
-    # the failure mode this loop exists to remove.
+    # the failure mode this loop exists to remove — but quarantine is
+    # CAPPED per boot: a manifest mismatch is definitely bad data, while a
+    # restore exception may be environmental (device OOM, PVC hiccup), and
+    # cascade-quarantining healthy checkpoints into a silent fresh start
+    # would be worse than the crash-loop. Past the caps the boot raises
+    # (exit nonzero, tree intact) so the Job restart retries instead.
     start_step = 0
     if args.ckpt_dir:
+        quarantined = restore_failures = 0
         last = ckpt.latest_step(args.ckpt_dir)
         while last is not None:
             ok, why = ckpt.verify_step(args.ckpt_dir, last)
             if ok:
                 try:
                     ckpt.restore_bundle(args.ckpt_dir, last, bundle)
-                except Exception as e:  # noqa: BLE001 — fall back, not loop
+                except Exception as e:  # noqa: BLE001 — classified below
                     ok, why = False, f"restore failed: {e!r}"[:300]
+                    restore_failures += 1
+                    if restore_failures > MAX_RESTORE_FAILURE_QUARANTINES:
+                        _restore_handlers()
+                        raise RuntimeError(
+                            f"resume: {restore_failures} independent "
+                            f"checkpoints failed to restore after passing "
+                            f"integrity verification (step {last}: {why}) "
+                            f"— likely environmental, not corruption; "
+                            f"refusing to quarantine further. The Job "
+                            f"restart will retry.") from e
             if ok:
                 start_step = last
                 print(json.dumps({"event": "resume", "step": last,
                                   "verify": why}), flush=True)
                 break
+            if quarantined >= MAX_QUARANTINES_PER_BOOT:
+                _restore_handlers()
+                raise RuntimeError(
+                    f"resume: quarantine cap reached "
+                    f"({MAX_QUARANTINES_PER_BOOT} this boot) and step "
+                    f"{last} still fails ({why}) — refusing to consume "
+                    f"the checkpoint tree. The Job restart will retry.")
             qdir = ckpt.quarantine_step(args.ckpt_dir, last)
+            quarantined += 1
             print(json.dumps({"event": "ckpt_quarantined", "step": last,
                               "reason": why, "quarantined_to": str(qdir)}),
                   flush=True)
@@ -338,8 +381,10 @@ def main(argv: "list[str] | None" = None) -> int:
         # Retention: only FINALIZED steps count, so an in-flight async
         # save can never be deleted (it is tmp-named until commit, and
         # once committed it is the newest). Partials and quarantined
-        # steps are never touched.
-        if args.keep_last > 0:
+        # steps are never touched. Process 0 only: the pods share one
+        # RWX PVC and one deleter is enough (gc_steps is race-tolerant
+        # besides, but N pods GC-ing the same dirs is pure noise).
+        if args.keep_last > 0 and rdv.process_id == 0:
             deleted = ckpt.gc_steps(args.ckpt_dir, args.keep_last)
             if deleted:
                 print(json.dumps({"event": "ckpt_gc", "deleted": deleted,
@@ -404,9 +449,7 @@ def main(argv: "list[str] | None" = None) -> int:
             # SIGTERM -> exit always fits inside the pod's termination
             # grace period. An async save already covering last_done makes
             # this a pure drain.
-            bound_s = float(os.environ.get(
-                "K3STPU_PREEMPT_SAVE_BOUND_S",
-                DEFAULT_PREEMPT_SAVE_BOUND_S))
+            bound_s = preempt_bound_s
             ev = {"event": "preempted", "step": last_done,
                   "signal": stop_signal.get("name", "SIGTERM"),
                   "emergency_ckpt": False}
@@ -451,8 +494,7 @@ def main(argv: "list[str] | None" = None) -> int:
                 # The drain may have just finalized the newest step; one
                 # more retention pass leaves exactly --keep-last steps.
                 gc_now()
-        for sig, handler in prev_handlers.items():
-            signal.signal(sig, handler)
+        _restore_handlers()
     return PREEMPTED_EXIT_CODE if preempted else 0
 
 

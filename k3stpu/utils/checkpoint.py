@@ -21,6 +21,15 @@ never deleted) and the previous finalized step wins. ``gc_steps`` keeps
 the PVC bounded over a long run: only *finalized* steps beyond the newest
 ``keep_last`` are deleted — partial/tmp saves and quarantined steps are
 never GC'd (they are the evidence).
+
+Multi-process discipline: a multi-host Job mounts ONE RWX PVC from every
+pod, so the maintenance operations here must not race each other. Manifests
+are written by process 0 only (orbax's own commit barrier has already run
+by then, so the primary sees every host's finalized shards), through a
+per-process tmp name + atomic rename so even a stray concurrent writer can
+never publish a torn manifest. ``gc_steps`` and ``quarantine_step`` are
+race-tolerant besides: a peer deleting/moving the same directory first is
+treated as that work being done, not an error.
 """
 
 from __future__ import annotations
@@ -54,6 +63,16 @@ def _fire(point: str) -> None:
         _chaos.fire(point)
 
 
+def _is_primary() -> bool:
+    """True on the process that owns shared-tree maintenance (manifest
+    writes, retention GC). process 0 of the distributed job; trivially
+    true single-process."""
+    try:
+        return jax.process_index() == 0
+    except Exception:  # noqa: BLE001 — backend not initialized yet
+        return True
+
+
 def _checkpointer():
     import orbax.checkpoint as ocp
 
@@ -74,7 +93,7 @@ def _flush_pending_manifests() -> None:
     global _pending_manifests
     pending, _pending_manifests = _pending_manifests, []
     for root, step in pending:
-        if _is_finalized_step(root / str(step)):
+        if _is_primary() and _is_finalized_step(root / str(step)):
             write_manifest(root, step)
 
 
@@ -117,7 +136,8 @@ def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
         ckptr = _checkpointer()
         ckptr.save(path, state, force=force)
         ckptr.wait_until_finished()
-        write_manifest(root, step)
+        if _is_primary():  # orbax's commit barrier has run; one writer
+            write_manifest(root, step)
     else:
         import orbax.checkpoint as ocp
 
@@ -250,7 +270,10 @@ def write_manifest(directory: str | pathlib.Path,
     """Record every host-visible file of a FINALIZED step (relative path,
     byte size, sha256) so a later boot can prove the bytes it is about to
     resume from are the bytes that were committed. Written atomically
-    (tmp + rename): a manifest can never itself be half-written."""
+    (per-process tmp + rename): a manifest can never itself be
+    half-written, even if two pods on the same RWX PVC write it at
+    once — the rename publishes one complete manifest or the other,
+    never an interleaving."""
     root = pathlib.Path(directory).resolve()
     step_dir = root / str(step)
     files = []
@@ -261,7 +284,7 @@ def write_manifest(directory: str | pathlib.Path,
                           "sha256": _file_digest(p)})
     mpath = _manifest_path(root, step)
     mpath.parent.mkdir(parents=True, exist_ok=True)
-    tmp = mpath.with_suffix(".json.tmp")
+    tmp = mpath.parent / f".{step}.json.tmp.{os.getpid()}"
     tmp.write_text(json.dumps({"step": step, "files": files}, indent=1))
     os.replace(tmp, mpath)
     return mpath
@@ -302,7 +325,11 @@ def quarantine_step(directory: str | pathlib.Path,
                     step: int) -> pathlib.Path:
     """Move a failed step (and its manifest) under ``<dir>/quarantine/``
     so resume falls back to the previous finalized step WITHOUT destroying
-    the evidence. Never deletes; a name collision gets a ``-N`` suffix."""
+    the evidence. Never deletes; a name collision gets a ``-N`` suffix.
+
+    Race-tolerant: every process of a multi-host job walks the same
+    fallback loop over the same PVC, so a source that vanished means a
+    peer already quarantined it — that is success, not an error."""
     root = pathlib.Path(directory).resolve()
     qdir = root / QUARANTINE_DIRNAME
     qdir.mkdir(parents=True, exist_ok=True)
@@ -311,10 +338,15 @@ def quarantine_step(directory: str | pathlib.Path,
     while dest.exists():
         n += 1
         dest = qdir / f"{step}-{n}"
-    shutil.move(str(root / str(step)), str(dest))
-    mpath = _manifest_path(root, step)
-    if mpath.is_file():
-        shutil.move(str(mpath), str(dest) + ".manifest.json")
+    try:
+        shutil.move(str(root / str(step)), str(dest))
+    except FileNotFoundError:
+        pass  # a peer moved it first — same outcome
+    try:
+        shutil.move(str(_manifest_path(root, step)),
+                    str(dest) + ".manifest.json")
+    except FileNotFoundError:
+        pass  # no manifest, or a peer took it
     return dest
 
 
@@ -322,16 +354,17 @@ def gc_steps(directory: str | pathlib.Path, keep_last: int) -> "list[int]":
     """Retention: delete finalized steps older than the newest
     ``keep_last``, with their manifests. Partial/tmp saves and quarantined
     steps are never touched — they are under inspection, not retention.
-    Returns the deleted step numbers."""
+    Returns the deleted step numbers.
+
+    Race-tolerant (``ignore_errors``/``missing_ok``): a peer process
+    GC-ing the same tree concurrently just means less left to delete."""
     if keep_last < 1:
         raise ValueError("keep_last must be >= 1")
     root = pathlib.Path(directory).resolve()
     doomed = finalized_steps(root)[:-keep_last]
     for step in doomed:
-        shutil.rmtree(root / str(step))
-        mpath = _manifest_path(root, step)
-        if mpath.is_file():
-            mpath.unlink()
+        shutil.rmtree(root / str(step), ignore_errors=True)
+        _manifest_path(root, step).unlink(missing_ok=True)
     return doomed
 
 

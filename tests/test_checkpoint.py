@@ -158,6 +158,41 @@ def test_quarantine_moves_step_and_manifest(tmp_path):
     assert ckpt.quarantine_step(tmp_path, 2) == tmp_path / "quarantine" / "2-1"
 
 
+def test_quarantine_tolerates_a_peer_winning_the_race(tmp_path):
+    """Every process of a multi-host job walks the same fallback loop
+    over the same RWX PVC: the loser of the quarantine race must treat
+    'already gone' as done, not crash with FileNotFoundError."""
+    _save(tmp_path, 1)
+    _save(tmp_path, 2)
+    ckpt.quarantine_step(tmp_path, 2)  # the winning peer
+    dest = ckpt.quarantine_step(tmp_path, 2)  # the loser: no crash
+    assert not dest.exists()
+    assert (tmp_path / "quarantine" / "2").is_dir()
+    assert latest_step(tmp_path) == 1
+
+
+def test_manifest_rewrite_is_atomic_and_leaves_no_debris(tmp_path):
+    """Concurrent manifest writers (two pods on one PVC) each go through
+    a per-process tmp + atomic rename: re-writing an existing manifest
+    publishes a complete file and leaves no tmp litter behind."""
+    _save(tmp_path, 1)
+    ckpt.write_manifest(tmp_path, 1)  # as a racing peer would
+    assert [p.name for p in (tmp_path / "manifests").iterdir()] \
+        == ["1.json"]
+    ok, why = ckpt.verify_step(tmp_path, 1)
+    assert ok and why.startswith("verified")
+
+
+def test_gc_tolerates_a_peer_having_deleted_first(tmp_path):
+    """A manifest (or step dir) a concurrent GC already removed is just
+    less to delete — never an exception."""
+    for step in (1, 2, 3):
+        _save(tmp_path, step)
+    (tmp_path / "manifests" / "1.json").unlink()  # peer got there first
+    assert ckpt.gc_steps(tmp_path, 1) == [1, 2]
+    assert ckpt.finalized_steps(tmp_path) == [3]
+
+
 def test_gc_keeps_newest_and_spares_partials(tmp_path):
     for step in (1, 2, 3):
         _save(tmp_path, step, scale=float(step))

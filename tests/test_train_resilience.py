@@ -116,6 +116,51 @@ def test_restore_failure_quarantines_and_falls_back(
     assert _steps_of(events) == [3, 4, 5, 6]
 
 
+def test_repeated_restore_failures_exit_nonzero_with_tree_intact(
+        tmp_path, capsys, monkeypatch):
+    """TWO independent checkpoints failing to RESTORE (after passing
+    integrity) is environmental (device OOM, PVC hiccup), not bitrot:
+    the boot must exit nonzero with the remaining tree intact — so the
+    Job restart retries — instead of cascade-quarantining every step and
+    silently starting from step 0."""
+    cdir = tmp_path / "ckpt"
+    _run_inproc(capsys, BASE + ["--steps", "4", "--ckpt-dir", str(cdir),
+                                "--ckpt-every", "2"])
+    monkeypatch.setenv("K3STPU_CHAOS",
+                       "ckpt_restore:times=2:exc=device tunnel wedged")
+    with pytest.raises(RuntimeError, match="likely environmental"):
+        train_job.main(BASE + ["--steps", "6", "--ckpt-dir", str(cdir),
+                               "--ckpt-every", "2"])
+    events = _events(capsys.readouterr().out)
+    # Only the first failure got the benefit of the doubt; step 2 is
+    # still on disk for the restart to retry.
+    assert [e["step"] for e in events
+            if e["event"] == "ckpt_quarantined"] == [4]
+    assert ckpt.finalized_steps(cdir) == [2]
+
+
+def test_quarantine_cap_stops_a_corruption_cascade(tmp_path, capsys):
+    """A boot that keeps finding bad steps stops quarantining at the cap
+    and exits nonzero rather than consuming the whole checkpoint tree."""
+    cdir = tmp_path / "ckpt"
+    _run_inproc(capsys, BASE + ["--steps", "8", "--ckpt-dir", str(cdir),
+                                "--ckpt-every", "2"])
+    assert ckpt.finalized_steps(cdir) == [2, 4, 6, 8]
+    for step in (4, 6, 8):
+        _corrupt_largest_file(cdir / str(step))
+    with pytest.raises(RuntimeError, match="quarantine cap"):
+        train_job.main(BASE + ["--steps", "10", "--ckpt-dir", str(cdir),
+                               "--ckpt-every", "2"])
+    events = _events(capsys.readouterr().out)
+    assert [e["step"] for e in events
+            if e["event"] == "ckpt_quarantined"] == [8, 6]
+    # Steps 2 and 4 survive on disk (4 corrupt but preserved as-is), the
+    # quarantined evidence too.
+    assert ckpt.finalized_steps(cdir) == [2, 4]
+    assert (cdir / "quarantine" / "8").is_dir()
+    assert (cdir / "quarantine" / "6").is_dir()
+
+
 # --- retention GC + partial-save debris -----------------------------------
 
 
@@ -235,12 +280,31 @@ def test_rdv_chaos_point_drives_the_retry_loop(capsys):
 
 
 def test_rdv_env_knobs_parse_with_fallback(monkeypatch):
-    from k3stpu.parallel.distributed import _env_float
+    from k3stpu.parallel.distributed import _env_float, _env_int
 
     monkeypatch.setenv("K3STPU_RDV_TIMEOUT_S", "bogus")
     assert _env_float("K3STPU_RDV_TIMEOUT_S", 7.5) == 7.5
     monkeypatch.setenv("K3STPU_RDV_TIMEOUT_S", "3")
     assert _env_float("K3STPU_RDV_TIMEOUT_S", 7.5) == 3.0
+    # Int knobs degrade the same way — a typo'd K3STPU_RDV_ATTEMPTS must
+    # not crash the job before rendezvous even starts.
+    monkeypatch.setenv("K3STPU_RDV_ATTEMPTS", "four")
+    assert _env_int("K3STPU_RDV_ATTEMPTS", 4) == 4
+    monkeypatch.setenv("K3STPU_RDV_ATTEMPTS", "6")
+    assert _env_int("K3STPU_RDV_ATTEMPTS", 4) == 6
+
+
+def test_malformed_preempt_bound_env_does_not_crash(
+        tmp_path, capsys, monkeypatch):
+    """The save bound is parsed ONCE at startup with a fallback: a
+    malformed K3STPU_PREEMPT_SAVE_BOUND_S must never surface as a
+    ValueError in the SIGTERM path (which would skip the emergency
+    checkpoint and the 'preempted' event entirely)."""
+    monkeypatch.setenv("K3STPU_PREEMPT_SAVE_BOUND_S", "ninety")
+    cdir = tmp_path / "ckpt"
+    events = _run_inproc(capsys, BASE + ["--steps", "2", "--ckpt-dir",
+                                         str(cdir), "--ckpt-every", "2"])
+    assert _steps_of(events) == [1, 2]
 
 
 # --- SIGTERM mid-training: real subprocess, real signal -------------------
