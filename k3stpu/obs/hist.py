@@ -60,6 +60,72 @@ class Gauge:
         return f"{head}\n{self.name} {_fmt(self.value)}"
 
 
+class Counter:
+    """A monotonic counter family. ``inc()`` takes the lock because the
+    training loop and its telemetry/HTTP threads share these — unlike
+    the engine-loop gauges, a missed increment here is a lost event."""
+
+    __slots__ = ("name", "help", "_value", "_lock")
+
+    def __init__(self, name: str, help_text: str):
+        self.name = name
+        self.help = help_text
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, n: float = 1.0) -> None:
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def render(self) -> str:
+        head = _NAME_HELP_TYPE.format(n=self.name, h=self.help, t="counter")
+        return f"{head}\n{self.name} {_fmt(self.value)}"
+
+
+class LabeledCounter:
+    """A counter family with ONE label dimension, one time-series per
+    label value (``name{label="x"} v``) — the goodput accountant's
+    exposition shape. HELP/TYPE render once per family, per the
+    exposition format; series render in first-touch order so a scrape
+    diff stays readable."""
+
+    __slots__ = ("name", "help", "label", "_values", "_lock")
+
+    def __init__(self, name: str, help_text: str, label: str):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self._values: "dict[str, float]" = {}
+        self._lock = threading.Lock()
+
+    def add(self, label_value: str, n: float = 1.0) -> None:
+        with self._lock:
+            self._values[label_value] = self._values.get(label_value,
+                                                         0.0) + n
+
+    def set(self, label_value: str, value: float) -> None:
+        with self._lock:
+            self._values[label_value] = float(value)
+
+    def get(self, label_value: str) -> float:
+        with self._lock:
+            return self._values.get(label_value, 0.0)
+
+    def render(self) -> str:
+        with self._lock:
+            items = list(self._values.items())
+        lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
+                                        t="counter")]
+        for k, v in items:
+            lines.append(f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}')
+        return "\n".join(lines)
+
+
 class Histogram:
     """Fixed-bucket histogram with Prometheus exposition.
 

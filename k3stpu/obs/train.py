@@ -1,0 +1,408 @@
+"""Observability for training jobs: the event funnel, goodput
+accounting, and the training-side metrics/trace surfaces.
+
+``TrainObs`` is the training twin of ``ServeObs`` — one object owning
+every training signal, sharing the zero-dep primitives (``hist.py``
+histograms/gauges/counters, ``trace.py``'s bounded ring) instead of
+forking them. Where the serving stack instruments request lifecycles,
+this instruments the *job* lifecycle:
+
+- ``emit(event, **fields)`` is the single funnel every training event
+  goes through: it prints the JSON log line (the `kubectl logs`
+  contract — exactly the lines train_job.py always printed, asserted
+  by tests/test_train_resilience.py) AND updates the metrics derived
+  from it. One call site per event, one flush policy, no drift between
+  what the logs say and what /metrics says.
+- The **goodput accountant** attributes every second of wall-clock to
+  exactly ONE bucket — ``productive | init | rendezvous | checkpoint |
+  eval | recovery | preempted-drain`` — answering the operator's real
+  question ("what fraction of this job's life was training?") as
+  ``k3stpu_train_goodput_seconds_total{bucket=...}`` plus a derived
+  goodput-fraction gauge. Buckets are exclusive by construction: a
+  state machine over one monotonic clock, switched by ``phase()``.
+- Per-phase histograms (step time, data wait, eval, checkpoint
+  save/restore, rendezvous attempt latency) and counters (recompiles
+  via a jit-cache-miss probe, rdv retries, quarantines, GC deletions,
+  preemptions).
+- A step timeline in the shared ``TraceBuffer`` ring, exported as
+  Chrome trace-event JSON (``chrome_trace``) so ui.perfetto.dev shows
+  the step cadence with eval/checkpoint/rendezvous spans interleaved.
+
+Read surfaces: ``start_metrics_server`` serves ``GET /metrics``
+(Prometheus text exposition) and ``GET /debug/trace`` on a stdlib HTTP
+server (process 0 of a train job, ``--metrics-port``, off by default);
+``start_telemetry_thread`` feeds the busy-fraction into the
+/run/k3stpu drop file so host tpu-info sees a real ``duty_cycle_pct``
+from training pods (every process). ``enabled=False`` keeps the stdout
+contract (emit still prints) but turns every metric update into a
+no-op — the overhead microbench's baseline (``bench.py --train-obs``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from .hist import Counter, Gauge, Histogram, LabeledCounter
+from .trace import TraceBuffer
+
+# Every second of a training job's wall-clock lands in exactly one of
+# these (docs/OBSERVABILITY.md has the definitions):
+#   productive      the step loop: forward/backward/optimizer + data wait
+#   init            process start: model build, compile, warm start
+#   rendezvous      waiting in jax.distributed.initialize attempts
+#   checkpoint      save_bundle calls + draining async saves
+#   eval            held-out evaluation passes
+#   recovery        boot-time restore: verify/restore/quarantine loop
+#   preempted-drain SIGTERM to exit, outside the emergency save itself
+GOODPUT_BUCKETS = ("productive", "init", "rendezvous", "checkpoint",
+                   "eval", "recovery", "preempted-drain")
+
+# Step/eval/checkpoint durations span ms (tiny CPU) to minutes (medium
+# on-chip with remat); the serving ladder already covers that range.
+from .hist import LATENCY_BUCKETS_S  # noqa: E402  (re-used ladder)
+
+
+class GoodputAccountant:
+    """Exclusive wall-clock attribution: exactly one bucket accrues at
+    any instant. ``enter(bucket)`` closes the current bucket at `now`
+    and opens the next — a two-field update under one lock, cheap
+    enough to switch around every checkpoint/eval. ``totals()`` charges
+    the open bucket up to `now`, so the invariant ``sum(totals()) ==
+    elapsed()`` holds at every read, not just at phase edges."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._t0 = clock()
+        self._mark = self._t0
+        self._bucket = "init"
+        self._acc = {b: 0.0 for b in GOODPUT_BUCKETS}
+        self._lock = threading.Lock()
+
+    @property
+    def bucket(self) -> str:
+        return self._bucket
+
+    def enter(self, bucket: str) -> str:
+        """Switch the accruing bucket; returns the previous one (so
+        ``phase()`` can restore it on exit)."""
+        if bucket not in self._acc:
+            raise ValueError(f"unknown goodput bucket {bucket!r}; "
+                             f"expected one of {GOODPUT_BUCKETS}")
+        with self._lock:
+            now = self._clock()
+            self._acc[self._bucket] += now - self._mark
+            self._mark = now
+            prev, self._bucket = self._bucket, bucket
+        return prev
+
+    def totals(self) -> "dict[str, float]":
+        with self._lock:
+            out = dict(self._acc)
+            out[self._bucket] += self._clock() - self._mark
+        return out
+
+    def elapsed(self) -> float:
+        return self._clock() - self._t0
+
+    def fraction(self, bucket: str = "productive") -> float:
+        totals = self.totals()
+        total = sum(totals.values())
+        return totals.get(bucket, 0.0) / total if total > 0 else 0.0
+
+
+class TrainObs:
+    """All training observability state: the emit() funnel, goodput
+    accountant, histograms/counters, and the step-timeline ring."""
+
+    def __init__(self, process_id: int = 0, enabled: bool = True,
+                 trace_capacity: int = 512, clock=time.monotonic):
+        self.enabled = enabled
+        self.process_id = process_id
+        self._clock = clock
+        self.goodput = GoodputAccountant(clock=clock)
+        self.traces = TraceBuffer(capacity=trace_capacity)
+        self.step_s = Histogram(
+            "k3stpu_train_step_seconds",
+            "Wall time of one train step (device run, data wait "
+            "excluded).")
+        self.data_wait = Histogram(
+            "k3stpu_train_data_wait_seconds",
+            "Time the step loop waited on the input pipeline per batch.")
+        self.eval_s = Histogram(
+            "k3stpu_train_eval_seconds",
+            "Wall time of one held-out evaluation pass.")
+        self.ckpt_save = Histogram(
+            "k3stpu_train_ckpt_save_seconds",
+            "Checkpoint save_bundle call duration (enqueue time for "
+            "async saves, full persist for blocking ones).")
+        self.ckpt_restore = Histogram(
+            "k3stpu_train_ckpt_restore_seconds",
+            "Checkpoint restore duration at boot (resume or warm start).")
+        self.rdv_attempt = Histogram(
+            "k3stpu_train_rdv_attempt_seconds",
+            "Rendezvous attempt latency, success or failure.")
+        self.steps = Counter(
+            "k3stpu_train_steps_total", "Completed train steps.")
+        self.recompiles = Counter(
+            "k3stpu_train_recompiles_total",
+            "jit cache misses observed by the step-loop probe (the "
+            "first-step compile counts; steady state should add zero).")
+        self.rdv_retries = Counter(
+            "k3stpu_train_rdv_retries_total",
+            "Failed rendezvous attempts that were retried.")
+        self.quarantines = Counter(
+            "k3stpu_train_quarantines_total",
+            "Checkpoints quarantined at boot (integrity or restore "
+            "failure).")
+        self.gc_deleted = Counter(
+            "k3stpu_train_ckpt_gc_deleted_total",
+            "Checkpoint steps deleted by --keep-last retention GC.")
+        self.preemptions = Counter(
+            "k3stpu_train_preemptions_total",
+            "SIGTERM/SIGINT preemptions handled by the graceful path.")
+        self.goodput_seconds = LabeledCounter(
+            "k3stpu_train_goodput_seconds_total",
+            "Wall-clock seconds attributed to each goodput bucket; "
+            "buckets are exclusive and sum to elapsed time.",
+            "bucket")
+        self.goodput_fraction = Gauge(
+            "k3stpu_train_goodput_fraction",
+            "Fraction of elapsed wall-clock spent in the productive "
+            "bucket.")
+        # Device-busy seconds (steps + evals): the duty-cycle numerator
+        # the telemetry thread differentiates, same scheme as
+        # serve/server.py's busy_seconds. Single writer (the step
+        # loop); readers tolerate a stale float.
+        self._busy_s = 0.0
+        # jit-cache probe state: size 0 before the first dispatch, so
+        # the first compile is (honestly) counted as a miss.
+        self._jit_cache_size = 0
+
+    # -- the event funnel --------------------------------------------------
+
+    def emit(self, event: str, **fields) -> None:
+        """Print the JSON log line AND update the metrics derived from
+        it. The line is exactly ``{"event": event, **fields}`` —
+        emitting through the funnel must not change a byte of the
+        stdout contract (tests assert exact dicts for some events).
+        Always flushed: an event buffered at SIGKILL is an event lost.
+
+        Metrics update BEFORE the line prints: a consumer that reads
+        the stdout line and immediately scrapes /metrics must see the
+        event already counted (the integration test races exactly
+        that). The print sits in a finally so a recording bug can
+        never eat the log line.
+        """
+        try:
+            if self.enabled:
+                self._record(event, fields)
+        finally:
+            print(json.dumps({"event": event, **fields}), flush=True)
+
+    def _record(self, event: str, f: dict) -> None:
+        if event == "step":
+            self.steps.inc()
+            if f.get("step_s") is not None:
+                self.step_s.observe(f["step_s"])
+                self._busy_s += f["step_s"]
+        elif event in ("rdv_ok", "rdv_retry", "rdv_failed"):
+            if f.get("elapsed_s") is not None:
+                self.rdv_attempt.observe(f["elapsed_s"])
+            if event == "rdv_retry":
+                self.rdv_retries.inc()
+        elif event == "ckpt_quarantined":
+            self.quarantines.inc()
+        elif event == "ckpt_gc":
+            self.gc_deleted.inc(len(f.get("deleted") or ()))
+        elif event == "preempted":
+            self.preemptions.inc()
+
+    # -- write-side hooks (the train loop) ---------------------------------
+
+    @contextmanager
+    def phase(self, bucket: str, hist: "Histogram | None" = None,
+              kind: "str | None" = None, **meta):
+        """Goodput-bucket scope: accrue this block's wall time into
+        ``bucket``, restore the previous bucket on exit (so nesting —
+        a checkpoint inside the preempted drain — stays exclusive).
+        Optionally observes the block's duration into ``hist`` and
+        records a ``kind`` span on the step timeline."""
+        if not self.enabled:
+            yield
+            return
+        prev = self.goodput.enter(bucket)
+        tr = self.traces.start(kind=kind, **meta) if kind else None
+        t0 = self._clock()
+        try:
+            yield
+        finally:
+            if hist is not None:
+                hist.observe(self._clock() - t0)
+            if tr is not None:
+                tr.finish("ok")
+            self.goodput.enter(prev)
+
+    def span(self, kind: str, **meta):
+        """A timeline-only scope (no bucket switch): the per-step span
+        inside the ambient 'productive' bucket."""
+        return self._span_cm(kind, meta)
+
+    @contextmanager
+    def _span_cm(self, kind, meta):
+        if not self.enabled:
+            yield
+            return
+        tr = self.traces.start(kind=kind, **meta)
+        try:
+            yield
+        finally:
+            tr.finish("ok")
+
+    def observe_eval_busy(self, seconds: float) -> None:
+        if self.enabled:
+            self._busy_s += seconds
+
+    def probe_recompiles(self, cache_size: "int | None") -> None:
+        """Feed the jitted step_fn's ``_cache_size()`` after each step;
+        any growth is a cache miss = a recompile (shape drift, donation
+        loss, a config flag flipped mid-run)."""
+        if not self.enabled or cache_size is None:
+            return
+        if cache_size > self._jit_cache_size:
+            self.recompiles.inc(cache_size - self._jit_cache_size)
+        self._jit_cache_size = cache_size
+
+    def busy_seconds(self) -> float:
+        return self._busy_s
+
+    # -- read side (HTTP + telemetry threads) ------------------------------
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.step_s, self.data_wait, self.eval_s, self.ckpt_save,
+                self.ckpt_restore, self.rdv_attempt)
+
+    def counters(self) -> "tuple[Counter, ...]":
+        return (self.steps, self.recompiles, self.rdv_retries,
+                self.quarantines, self.gc_deleted, self.preemptions)
+
+    def render_prometheus(self) -> str:
+        totals = self.goodput.totals()
+        for b in GOODPUT_BUCKETS:
+            self.goodput_seconds.set(b, totals[b])
+        total = sum(totals.values())
+        self.goodput_fraction.set(
+            totals["productive"] / total if total > 0 else 0.0)
+        parts = [h.render() for h in self.histograms()]
+        parts += [c.render() for c in self.counters()]
+        parts.append(self.goodput_seconds.render())
+        parts.append(self.goodput_fraction.render())
+        return "\n".join(parts) + "\n"
+
+    def chrome_trace(self) -> dict:
+        """The step timeline in Chrome trace-event JSON: one
+        pseudo-thread per span kind (step / eval / checkpoint /
+        rendezvous / restore), one X-phase span per recorded scope —
+        the training analogue of the serving buffer's per-request rows,
+        built from the same ring."""
+        t0 = self.traces.wall_anchor()[0]
+        us = lambda t: round((t - t0) * 1e6, 1)  # noqa: E731
+        ev = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+               "args": {"name": f"k3stpu-train p{self.process_id}"}}]
+        tids: "dict[str, int]" = {}
+        for tr in self.traces.snapshot():
+            kind = tr.meta.get("kind") or "span"
+            tid = tids.get(kind)
+            if tid is None:
+                tid = tids[kind] = len(tids) + 1
+                ev.append({"ph": "M", "pid": 1, "tid": tid,
+                           "name": "thread_name", "args": {"name": kind}})
+            a, b = tr.t_enqueue, tr.t_done
+            if a is not None and b is not None and b >= a:
+                args = {k: v for k, v in tr.meta.items() if k != "kind"}
+                ev.append({"ph": "X", "pid": 1, "tid": tid, "name": kind,
+                           "cat": "train", "ts": us(a),
+                           "dur": round((b - a) * 1e6, 1), "args": args})
+        return {"traceEvents": ev, "displayTimeUnit": "ms"}
+
+
+def start_metrics_server(obs: TrainObs, port: int,
+                         host: str = "0.0.0.0"):
+    """Serve GET /metrics (Prometheus exposition) and GET /debug/trace
+    (Chrome trace JSON) on a stdlib threading HTTP server. Returns the
+    server; call ``.shutdown()`` at job exit. Process 0 only — the
+    scrape surface mirrors one pod per Job, like the Service-backed
+    serving endpoint."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):  # noqa: N802 — stdlib name
+            pass  # the job's stdout is a JSON-event stream; keep it so
+
+        def do_GET(self):  # noqa: N802 — stdlib name
+            if self.path == "/metrics":
+                body = obs.render_prometheus().encode()
+                ctype = "text/plain; version=0.0.4"
+            elif self.path.startswith("/debug/trace"):
+                body = json.dumps(obs.chrome_trace()).encode()
+                ctype = "application/json"
+            else:
+                body = json.dumps(
+                    {"error": f"no route {self.path}"}).encode()
+                self.send_response(404)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    httpd = ThreadingHTTPServer((host, port), Handler)
+    threading.Thread(target=httpd.serve_forever, daemon=True,
+                     name="train-metrics").start()
+    return httpd
+
+
+def start_telemetry_thread(obs: TrainObs,
+                           interval: "float | None" = None,
+                           path: "str | None" = None,
+                           stop: "threading.Event | None" = None
+                           ) -> threading.Thread:
+    """Periodic /run/k3stpu drop-file writer: duty cycle = this
+    process's device-busy fraction (step + eval seconds) since the last
+    drop — so host tpu-info's UTIL column shows real numbers from
+    training pods, not 'n/a'. Every process runs one (each pod owns its
+    chips; the drop file is per-host). ``stop`` ends the loop at job
+    exit so in-process callers (tests) don't leak writers."""
+    from k3stpu.utils.telemetry import DROP_PATH, write_metrics
+
+    if interval is None:
+        try:
+            interval = float(os.environ.get(
+                "K3STPU_TELEMETRY_INTERVAL_S", ""))
+        except ValueError:
+            interval = 10.0
+    if path is None:
+        path = os.environ.get("K3STPU_TELEMETRY_DROP", DROP_PATH)
+    stop = stop or threading.Event()
+
+    def loop() -> None:
+        last_busy, last_t = obs.busy_seconds(), time.monotonic()
+        while not stop.wait(interval):
+            busy, now = obs.busy_seconds(), time.monotonic()
+            duty = int(min(100.0, max(0.0, 100.0 * (busy - last_busy)
+                                      / max(now - last_t, 1e-9))))
+            write_metrics(path=path, duty_cycle_pct=duty)
+            last_busy, last_t = busy, now
+
+    t = threading.Thread(target=loop, daemon=True, name="train-telemetry")
+    t.stop_event = stop
+    t.start()
+    return t

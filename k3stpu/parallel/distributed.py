@@ -138,46 +138,47 @@ class RendezvousError(RuntimeError):
     without a rebuild."""
 
 
+def _print_event(event: str, **fields) -> None:
+    """Default event sink: the JSON-line stdout contract. train_job
+    passes its TrainObs.emit instead, which prints the SAME line and
+    additionally feeds the rdv histograms/counters."""
+    print(json.dumps({"event": event, **fields}), flush=True)
+
+
 def connect_with_retries(connect, rdv: Rendezvous, *,
                          timeout_s: float,
                          attempts: int,
                          backoff_s: float,
                          backoff_cap_s: float,
                          chaos=None,
+                         emit=None,
                          _sleep=time.sleep) -> None:
     """Drive ``connect()`` (one bounded jax.distributed.initialize attempt)
     through capped-exponential-backoff retries, one JSON log event per
     attempt. Split out so tests drive the schedule with a fake connect."""
+    emit = emit or _print_event
     failures = []
     for attempt in range(1, attempts + 1):
-        print(json.dumps({
-            "event": "rdv_attempt", "attempt": attempt,
-            "max_attempts": attempts, "timeout_s": timeout_s,
-            "coordinator": rdv.coordinator_address,
-            "process_id": rdv.process_id,
-            "num_processes": rdv.num_processes,
-        }), flush=True)
+        emit("rdv_attempt", attempt=attempt, max_attempts=attempts,
+             timeout_s=timeout_s, coordinator=rdv.coordinator_address,
+             process_id=rdv.process_id, num_processes=rdv.num_processes)
         t0 = time.monotonic()
         try:
             if chaos is not None:
                 chaos.fire("rdv_connect")
             connect()
-            print(json.dumps({
-                "event": "rdv_ok", "attempt": attempt,
-                "elapsed_s": round(time.monotonic() - t0, 3),
-            }), flush=True)
+            emit("rdv_ok", attempt=attempt,
+                 elapsed_s=round(time.monotonic() - t0, 3))
             return
         except Exception as e:  # noqa: BLE001 — every failure is retried
             detail = f"{type(e).__name__}: {e}"[:300]
             failures.append(detail)
             wait = min(backoff_s * (2 ** (attempt - 1)), backoff_cap_s)
-            print(json.dumps({
-                "event": "rdv_retry" if attempt < attempts else "rdv_failed",
-                "attempt": attempt,
-                "elapsed_s": round(time.monotonic() - t0, 3),
-                "error": detail,
-                "backoff_s": wait if attempt < attempts else None,
-            }), flush=True)
+            emit("rdv_retry" if attempt < attempts else "rdv_failed",
+                 attempt=attempt,
+                 elapsed_s=round(time.monotonic() - t0, 3),
+                 error=detail,
+                 backoff_s=wait if attempt < attempts else None)
             if attempt < attempts:
                 _sleep(wait)
     raise RendezvousError(
@@ -192,7 +193,8 @@ def initialize(rdv: Rendezvous | None = None, *,
                attempts: "int | None" = None,
                backoff_s: "float | None" = None,
                backoff_cap_s: "float | None" = None,
-               chaos=None) -> Rendezvous:
+               chaos=None,
+               emit=None) -> Rendezvous:
     """Join the JAX process group (no-op for a single process).
 
     After this returns, jax.devices() is the GLOBAL device list across all
@@ -240,5 +242,6 @@ def initialize(rdv: Rendezvous | None = None, *,
 
     connect_with_retries(connect, rdv, timeout_s=timeout_s,
                          attempts=attempts, backoff_s=backoff_s,
-                         backoff_cap_s=backoff_cap_s, chaos=chaos)
+                         backoff_cap_s=backoff_cap_s, chaos=chaos,
+                         emit=emit)
     return rdv

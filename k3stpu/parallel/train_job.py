@@ -9,8 +9,16 @@ the transformer LM with the sharded train step (train.py), checkpoints every
 checkpoint on boot** — so K8s-native self-healing (Deployment/Job restart)
 becomes elastic recovery instead of a restart.
 
-Observability stays log-based like the reference (`kubectl logs` — reference
-README.md:134-156): one JSON line per step with loss and tokens/s.
+Observability is log-based like the reference (`kubectl logs` — reference
+README.md:134-156): one JSON line per step with loss and tokens/s — but
+every line now flows through one funnel, ``TrainObs.emit`` (obs/train.py),
+which prints the identical JSON AND updates the training metrics behind it:
+per-phase histograms, a goodput accountant attributing every wall-clock
+second to one bucket, and (process 0, ``--metrics-port``) a Prometheus
+``/metrics`` + Chrome-trace ``/debug/trace`` HTTP surface. Every process
+feeds its device-busy fraction into the /run/k3stpu telemetry drop file so
+host tools see a real duty cycle from training pods. ``K3STPU_TRAIN_OBS=0``
+disables the metrics (events still print) — the bench baseline.
 
 Preemption tolerance (docs/RESILIENCE.md): SIGTERM/SIGINT set a stop flag
 checked every step; the loop then writes one final **emergency checkpoint**
@@ -29,7 +37,7 @@ Run: python -m k3stpu.parallel.train_job --steps 100 --ckpt-dir /ckpt
 from __future__ import annotations
 
 import argparse
-import json
+import os
 import signal
 import sys
 import threading
@@ -112,6 +120,10 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--holdout-fraction", type=float, default=0.05)
     ap.add_argument("--profile-port", type=int, default=0,
                     help="jax.profiler.start_server port (0 = off)")
+    ap.add_argument("--metrics-port", type=int, default=0,
+                    help="process 0 serves Prometheus /metrics and "
+                         "Chrome-trace /debug/trace on this port "
+                         "(0 = off)")
     ap.add_argument("--compilation-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache (volume "
                          "mount): a restarted/resumed Job pod skips "
@@ -124,10 +136,21 @@ def main(argv: "list[str] | None" = None) -> int:
     args = ap.parse_args(argv)
 
     from k3stpu.chaos import chaos_from_env
+    from k3stpu.obs.train import (
+        TrainObs,
+        start_metrics_server,
+        start_telemetry_thread,
+    )
     from k3stpu.parallel.distributed import _env_float, initialize
 
     chaos = chaos_from_env()
-    rdv = initialize(chaos=chaos)
+    # K3STPU_TRAIN_OBS=0 keeps the stdout contract (emit still prints
+    # every line) but turns the metric updates into no-ops — the
+    # baseline arm of `bench.py --train-obs`.
+    obs = TrainObs(enabled=os.environ.get("K3STPU_TRAIN_OBS", "1") != "0")
+    with obs.phase("rendezvous"):
+        rdv = initialize(chaos=chaos, emit=obs.emit)
+    obs.process_id = rdv.process_id
     # Parsed ONCE at startup (fallback on malformed values): the SIGTERM
     # path must never die in a ValueError instead of saving.
     preempt_bound_s = _env_float("K3STPU_PREEMPT_SAVE_BOUND_S",
@@ -197,11 +220,9 @@ def main(argv: "list[str] | None" = None) -> int:
                            * mesh.shape["data"])
     vocab = model.config.vocab_size
 
-    print(json.dumps({
-        "event": "train_start", "model": model_name, "seq": seq,
-        "batch": batch, "mesh": dict(mesh.shape),
-        "process_id": rdv.process_id, "num_processes": rdv.num_processes,
-    }), flush=True)
+    obs.emit("train_start", model=model_name, seq=seq, batch=batch,
+             mesh=dict(mesh.shape), process_id=rdv.process_id,
+             num_processes=rdv.num_processes)
 
     # LR schedule: optimizer updates tick once per --grad-accum
     # micro-steps (MultiSteps), so schedule horizons count UPDATES.
@@ -243,50 +264,51 @@ def main(argv: "list[str] | None" = None) -> int:
     # (exit nonzero, tree intact) so the Job restart retries instead.
     start_step = 0
     if args.ckpt_dir:
-        quarantined = restore_failures = 0
-        last = ckpt.latest_step(args.ckpt_dir)
-        while last is not None:
-            ok, why = ckpt.verify_step(args.ckpt_dir, last)
-            if ok:
-                try:
-                    ckpt.restore_bundle(args.ckpt_dir, last, bundle)
-                except Exception as e:  # noqa: BLE001 — classified below
-                    ok, why = False, f"restore failed: {e!r}"[:300]
-                    restore_failures += 1
-                    if restore_failures > MAX_RESTORE_FAILURE_QUARANTINES:
-                        _restore_handlers()
-                        raise RuntimeError(
-                            f"resume: {restore_failures} independent "
-                            f"checkpoints failed to restore after passing "
-                            f"integrity verification (step {last}: {why}) "
-                            f"— likely environmental, not corruption; "
-                            f"refusing to quarantine further. The Job "
-                            f"restart will retry.") from e
-            if ok:
-                start_step = last
-                print(json.dumps({"event": "resume", "step": last,
-                                  "verify": why}), flush=True)
-                break
-            if quarantined >= MAX_QUARANTINES_PER_BOOT:
-                _restore_handlers()
-                raise RuntimeError(
-                    f"resume: quarantine cap reached "
-                    f"({MAX_QUARANTINES_PER_BOOT} this boot) and step "
-                    f"{last} still fails ({why}) — refusing to consume "
-                    f"the checkpoint tree. The Job restart will retry.")
-            qdir = ckpt.quarantine_step(args.ckpt_dir, last)
-            quarantined += 1
-            print(json.dumps({"event": "ckpt_quarantined", "step": last,
-                              "reason": why, "quarantined_to": str(qdir)}),
-                  flush=True)
+        with obs.phase("recovery"):
+            quarantined = restore_failures = 0
             last = ckpt.latest_step(args.ckpt_dir)
-        if last is None:
-            partial = ckpt.partial_steps(args.ckpt_dir)
-            if partial:
-                # Boot found only unfinalized debris (a save the dying pod
-                # never committed) — starting fresh is correct, but say so.
-                print(json.dumps({"event": "resume_skipped_partial",
-                                  "partial": partial}), flush=True)
+            while last is not None:
+                ok, why = ckpt.verify_step(args.ckpt_dir, last)
+                if ok:
+                    try:
+                        t_r = time.perf_counter()
+                        ckpt.restore_bundle(args.ckpt_dir, last, bundle)
+                        if obs.enabled:
+                            obs.ckpt_restore.observe(time.perf_counter() - t_r)
+                    except Exception as e:  # noqa: BLE001 — classified below
+                        ok, why = False, f"restore failed: {e!r}"[:300]
+                        restore_failures += 1
+                        if restore_failures > MAX_RESTORE_FAILURE_QUARANTINES:
+                            _restore_handlers()
+                            raise RuntimeError(
+                                f"resume: {restore_failures} independent "
+                                f"checkpoints failed to restore after passing "
+                                f"integrity verification (step {last}: {why}) "
+                                f"— likely environmental, not corruption; "
+                                f"refusing to quarantine further. The Job "
+                                f"restart will retry.") from e
+                if ok:
+                    start_step = last
+                    obs.emit("resume", step=last, verify=why)
+                    break
+                if quarantined >= MAX_QUARANTINES_PER_BOOT:
+                    _restore_handlers()
+                    raise RuntimeError(
+                        f"resume: quarantine cap reached "
+                        f"({MAX_QUARANTINES_PER_BOOT} this boot) and step "
+                        f"{last} still fails ({why}) — refusing to consume "
+                        f"the checkpoint tree. The Job restart will retry.")
+                qdir = ckpt.quarantine_step(args.ckpt_dir, last)
+                quarantined += 1
+                obs.emit("ckpt_quarantined", step=last, reason=why,
+                         quarantined_to=str(qdir))
+                last = ckpt.latest_step(args.ckpt_dir)
+            if last is None:
+                partial = ckpt.partial_steps(args.ckpt_dir)
+                if partial:
+                    # Boot found only unfinalized debris (a save the dying pod
+                    # never committed) — starting fresh is correct, but say so.
+                    obs.emit("resume_skipped_partial", partial=partial)
 
     if args.init_from and start_step == 0:
         # Warm start: restore the params ANOTHER run saved into the leaves
@@ -317,8 +339,7 @@ def main(argv: "list[str] | None" = None) -> int:
                                   orig.sharding)
 
         bundle.params = graft(bundle.params, restored)
-        print(json.dumps({"event": "init_from", "path": args.init_from,
-                          "step": base_step}), flush=True)
+        obs.emit("init_from", path=args.init_from, step=base_step)
 
     # MFU from the standard 6*N*T training-flop estimate (fwd+bwd matmuls;
     # attention's O(S^2) term is <10% at these shapes) against the chip's
@@ -350,9 +371,8 @@ def main(argv: "list[str] | None" = None) -> int:
                            start_step=start_step),
             sharding=(sh, sh))
         batches = iter(prefetch)
-        print(json.dumps({"event": "data", "path": args.data,
-                          "corpus_tokens": len(corpus),
-                          "split": split}), flush=True)
+        obs.emit("data", path=args.data, corpus_tokens=len(corpus),
+                 split=split)
         if args.eval_every:
             eval_corpus = TokenCorpus(
                 args.data, vocab, split="eval",
@@ -387,54 +407,74 @@ def main(argv: "list[str] | None" = None) -> int:
         if args.keep_last > 0 and rdv.process_id == 0:
             deleted = ckpt.gc_steps(args.ckpt_dir, args.keep_last)
             if deleted:
-                print(json.dumps({"event": "ckpt_gc", "deleted": deleted,
-                                  "keep_last": args.keep_last}), flush=True)
+                obs.emit("ckpt_gc", deleted=deleted,
+                         keep_last=args.keep_last)
 
     def checkpoint_and_gc(step, *, blocking=False):
-        ckpt.save_bundle(args.ckpt_dir, step, bundle, blocking=blocking)
-        print(json.dumps({"event": "checkpoint", "step": step,
-                          "async": not blocking}), flush=True)
+        with obs.phase("checkpoint", hist=obs.ckpt_save, kind="checkpoint",
+                       step=step):
+            ckpt.save_bundle(args.ckpt_dir, step, bundle, blocking=blocking)
+        # NB: the emitted dict must stay exactly {event, step, async} —
+        # tests assert it field-for-field.
+        obs.emit("checkpoint", step=step, **{"async": not blocking})
         gc_now()
+
+    # Read surfaces start only once boot (rendezvous/recovery) is past the
+    # raise paths: process 0's /metrics + /debug/trace HTTP server, and —
+    # on every process — the telemetry-drop writer that turns step/eval
+    # busy-seconds into a real duty_cycle_pct for host tpu-info.
+    httpd = None
+    if args.metrics_port and rdv.process_id == 0:
+        httpd = start_metrics_server(obs, args.metrics_port)
+    tel = start_telemetry_thread(obs) if obs.enabled else None
 
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
     last_done = last_saved = start_step
     preempted = False
+    if obs.enabled:
+        obs.goodput.enter("productive")
     try:
         for step in range(start_step, args.steps):
             if stop.is_set():
                 break
             if chaos is not None:
                 chaos.fire("train_step")
+            t_w = time.perf_counter()
             if prefetch is not None:
                 inputs, labels = next(batches)
             else:
                 rng, k = jax.random.split(rng)
                 inputs, labels = synth_token_batch(k, batch, seq, vocab)
+            if obs.enabled:
+                obs.data_wait.observe(time.perf_counter() - t_w)
             t0 = time.perf_counter()
-            loss = bundle.run(inputs, labels)
+            with obs.span("step", step=step + 1):
+                loss = bundle.run(inputs, labels)
             dt = time.perf_counter() - t0
+            obs.probe_recompiles(
+                getattr(bundle.step_fn, "_cache_size", lambda: None)())
             tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
-            print(json.dumps({
-                "event": "step", "step": step + 1, "loss": round(loss, 4),
-                "step_s": round(dt, 4),
-                "tokens_per_s": round(tokens_per_step / dt, 1),
-                "tflops_per_chip": round(tflops, 2),
-                "mfu": round(tflops / peak, 4) if peak else None,
-            }), flush=True)
+            obs.emit(
+                "step", step=step + 1, loss=round(loss, 4),
+                step_s=round(dt, 4),
+                tokens_per_s=round(tokens_per_step / dt, 1),
+                tflops_per_chip=round(tflops, 2),
+                mfu=round(tflops / peak, 4) if peak else None)
             last_done = step + 1
             if args.eval_every and (step + 1) % args.eval_every == 0:
                 import math
 
-                losses = [bundle.evaluate(x, y)
-                          for x, y in eval_batches_fn()]
+                t_ev = time.perf_counter()
+                with obs.phase("eval", hist=obs.eval_s, kind="eval",
+                               step=step + 1):
+                    losses = [bundle.evaluate(x, y)
+                              for x, y in eval_batches_fn()]
+                obs.observe_eval_busy(time.perf_counter() - t_ev)
                 ev = sum(losses) / len(losses)
-                print(json.dumps({
-                    "event": "eval", "step": step + 1,
-                    "loss": round(ev, 4),
-                    "ppl": round(math.exp(min(ev, 30.0)), 2),
-                    "batches": len(losses),
-                }), flush=True)
+                obs.emit("eval", step=step + 1, loss=round(ev, 4),
+                         ppl=round(math.exp(min(ev, 30.0)), 2),
+                         batches=len(losses))
             if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
                 # Async: the persist overlaps the next steps' compute; the
                 # next save (or the final wait) drains it.
@@ -448,9 +488,13 @@ def main(argv: "list[str] | None" = None) -> int:
             # blocking (finalized + manifest before exit) but BOUNDED, so
             # SIGTERM -> exit always fits inside the pod's termination
             # grace period. An async save already covering last_done makes
-            # this a pure drain.
+            # this a pure drain. Goodput-wise this is the preempted-drain
+            # bucket; the emergency save itself switches to `checkpoint`
+            # from inside checkpoint_and_gc.
+            if obs.enabled:
+                obs.goodput.enter("preempted-drain")
             bound_s = preempt_bound_s
-            ev = {"event": "preempted", "step": last_done,
+            ev = {"step": last_done,
                   "signal": stop_signal.get("name", "SIGTERM"),
                   "emergency_ckpt": False}
             if args.ckpt_dir:
@@ -475,7 +519,7 @@ def main(argv: "list[str] | None" = None) -> int:
                     save_bound_s=bound_s,
                     save_error=("timed out" if saver.is_alive()
                                 else done.get("error")))
-            print(json.dumps(ev), flush=True)
+            obs.emit("preempted", **ev)
         elif (args.ckpt_dir and args.steps > start_step
                 and args.steps % args.ckpt_every != 0):
             # Final save, unless the periodic save already covered it.
@@ -489,12 +533,27 @@ def main(argv: "list[str] | None" = None) -> int:
         if prefetch is not None:
             prefetch.close()
         if not preempted:
-            ckpt.wait_for_saves()
+            with obs.phase("checkpoint"):
+                ckpt.wait_for_saves()
             if args.ckpt_dir:
                 # The drain may have just finalized the newest step; one
                 # more retention pass leaves exactly --keep-last steps.
                 gc_now()
         _restore_handlers()
+        if tel is not None:
+            tel.stop_event.set()
+        if httpd is not None:
+            httpd.shutdown()
+        if obs.enabled:
+            # One terminal accounting line: where the job's wall-clock
+            # went. `seconds` always carries every bucket; the sum equals
+            # elapsed_s up to rounding (the integration test holds it to
+            # 2%).
+            totals = obs.goodput.totals()
+            obs.emit("goodput",
+                     elapsed_s=round(obs.goodput.elapsed(), 3),
+                     seconds={b: round(v, 3) for b, v in totals.items()},
+                     fraction=round(obs.goodput.fraction(), 4))
     return PREEMPTED_EXIT_CODE if preempted else 0
 
 

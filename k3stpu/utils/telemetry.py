@@ -12,8 +12,11 @@ attributes. Pods get it onto the host via a hostPath mount of /run/k3stpu
 The file: ``{"ts": <unix>, "devices": [{"index", "bytes_in_use",
 "bytes_limit", "duty_cycle_pct"}]}``. ``bytes_*`` come from jax's
 ``device.memory_stats()`` (PJRT allocator truth); ``duty_cycle_pct`` is -1
-unless the caller supplies one (serving reports busy-fraction between
-writes). Fields whose source is unavailable are -1, rendered "n/a".
+unless the caller supplies one (serving and training both report their
+busy-fraction between writes — obs/train.py's telemetry thread covers the
+training side). Supplied values are clamped to [0, 100]; -1 stays the
+"no source" sentinel. Fields whose source is unavailable are -1,
+rendered "n/a".
 """
 
 from __future__ import annotations
@@ -64,6 +67,17 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
     """
     import jax
 
+    # Clamp a caller-supplied busy-fraction to a percentage: a scheduling
+    # hiccup between the caller's two clock reads can put the raw ratio
+    # slightly past 100, and a clock step can make it negative — neither
+    # belongs in a UTIL column. -1 (and anything below) stays the
+    # "no source" sentinel.
+    duty = int(duty_cycle_pct)
+    if duty >= 0:
+        duty = min(duty, 100)
+    else:
+        duty = -1
+
     devices = []
     per_dev_live: "dict | None" = None  # built once, on first fallback
     for d in jax.local_devices():
@@ -101,7 +115,7 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
             "index": d.id,
             "bytes_in_use": in_use,
             "bytes_limit": limit,
-            "duty_cycle_pct": int(duty_cycle_pct),
+            "duty_cycle_pct": duty,
             "source": source,
         })
     return {"ts": int(time.time()), "devices": devices}

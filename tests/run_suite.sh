@@ -52,7 +52,8 @@ if [ "${1:-}" = "--smoke" ]; then
     tests/test_e2e_assets.py \
     tests/test_bench.py tests/test_graft_entry.py \
     tests/test_paged.py tests/test_obs.py \
-    tests/test_chaos.py tests/test_train_resilience.py -m "not slow" "$@"
+    tests/test_chaos.py tests/test_train_resilience.py \
+    tests/test_train_obs.py tests/test_metrics_lint.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

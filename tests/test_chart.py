@@ -75,6 +75,48 @@ def test_inference_probes_and_drain_wiring():
     assert pod["terminationGracePeriodSeconds"] > drain_s
 
 
+def test_train_disabled_by_default():
+    # Same opt-in rule as inference: the chart installs infrastructure,
+    # workloads are explicit, and the default golden stays byte-stable.
+    objs = render()
+    assert ("Job", "tpu-train") not in objs
+    assert ("Service", "tpu-train") not in objs
+    assert ("PersistentVolumeClaim", "tpu-train-ckpt") not in objs
+
+
+def test_train_enabled_scrape_and_preemption_wiring():
+    objs = render({"train.enabled": "true"}, namespace="train-ns")
+    job = objs[("Job", "tpu-train")]
+    assert job["metadata"]["namespace"] == "train-ns"
+    spec = job["spec"]
+    assert spec["completionMode"] == "Indexed"
+    assert spec["completions"] == spec["parallelism"] == 2
+    ann = spec["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    pod = spec["template"]["spec"]
+    assert pod["runtimeClassName"] == "tpu"
+    (ctr,) = pod["containers"]
+    cmd = ctr["command"]
+    # The scrape annotation must agree with the port train_job actually
+    # serves on, values-driven, and stay off the coordinator port.
+    assert ann["prometheus.io/port"] == cmd[cmd.index("--metrics-port") + 1] == "8477"
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert env["K3STPU_COORDINATOR_PORT"] == "8476" != ann["prometheus.io/port"]
+    # Preemption budget ordering, same invariant as the raw manifest.
+    grace = pod["terminationGracePeriodSeconds"]
+    assert grace >= float(env["K3STPU_PREEMPT_SAVE_BOUND_S"]) + 15
+    # Headless coordinator Service + RWX checkpoint PVC come along.
+    svc = objs[("Service", "tpu-train")]
+    assert svc["spec"]["clusterIP"] == "None"           # headless
+    (port,) = svc["spec"]["ports"]
+    assert str(port["port"]) == env["K3STPU_COORDINATOR_PORT"]
+    pvc = objs[("PersistentVolumeClaim", "tpu-train-ckpt")]
+    assert pvc["spec"]["accessModes"] == ["ReadWriteMany"]
+    mounts = {m["name"]: m["mountPath"] for m in ctr["volumeMounts"]}
+    assert mounts["k3stpu-metrics"] == "/run/k3stpu"
+
+
 def test_runtimeclass_and_namespace():
     objs = render(namespace="custom-ns")
     rc = objs[("RuntimeClass", "tpu")]
@@ -185,10 +227,14 @@ def _golden_case(name):
         # inference is off in the default golden, so this is the only
         # reviewable rendering of the Deployment/Service pair.
         "inference.yaml": {"inference.enabled": "true"},
+        # Likewise for the opt-in training workload: the only reviewable
+        # rendering of the Service/PVC/Job triple with scrape annotations.
+        "train.yaml": {"train.enabled": "true"},
     }[name]
 
 
-GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml"]
+GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
+                "train.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

@@ -139,3 +139,28 @@ def test_train_job_preemption_budget():
     # Long-running Job on a finite PVC: retention GC must be on.
     cmd = ctr["command"]
     assert "--keep-last" in cmd and int(cmd[cmd.index("--keep-last") + 1]) >= 2
+
+
+def test_train_job_scrape_and_telemetry_wiring():
+    # Process 0 serves /metrics on --metrics-port (obs/train.py); the pod
+    # annotations must advertise exactly that port, and it must not
+    # collide with the rendezvous coordinator port. No Service port here:
+    # only rank 0 listens, so scraping goes straight to the pod.
+    docs = load_all("tpu-train-job.yaml")
+    (job,) = by_kind(docs, "Job")
+    ann = job["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    pod = job["spec"]["template"]["spec"]
+    (ctr,) = pod["containers"]
+    cmd = ctr["command"]
+    metrics_port = cmd[cmd.index("--metrics-port") + 1]
+    assert ann["prometheus.io/port"] == metrics_port
+    env = {e["name"]: e.get("value") for e in ctr["env"]}
+    assert metrics_port != env["K3STPU_COORDINATOR_PORT"]
+    # Telemetry drop file (utils/telemetry.py): every rank feeds its
+    # busy-fraction to host tpu-info via the shared /run/k3stpu mount.
+    mounts = {m["name"]: m["mountPath"] for m in ctr["volumeMounts"]}
+    assert mounts["k3stpu-metrics"] == "/run/k3stpu"
+    vols = {v["name"]: v for v in pod["volumes"]}
+    assert vols["k3stpu-metrics"]["hostPath"]["path"] == "/run/k3stpu"

@@ -1,0 +1,88 @@
+"""Metric-family hygiene gate: tools/metrics_lint.py runs in tier-1.
+
+The exposition layer is hand-rolled, so naming/HELP discipline is only
+as strong as this gate — a family added without a k3stpu_ prefix, HELP
+text, or the right unit suffix fails here, not in a dashboard review.
+The negative tests pin the lint's own rules so a refactor of the tool
+can't silently stop checking them.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import metrics_lint  # noqa: E402
+
+
+def test_repo_metric_families_are_clean():
+    problems = metrics_lint.lint()
+    assert problems == [], "\n".join(problems)
+
+
+def test_scan_actually_finds_families():
+    fams = (metrics_lint._families_from_obs()
+            + metrics_lint._families_from_server())
+    names = [n for n, _, _ in fams]
+    # Spot-check one family per source: the two facades and the
+    # server's hand-emitted counters all made it into the scan.
+    assert "k3stpu_request_ttft_seconds" in names
+    assert "k3stpu_train_goodput_seconds_total" in names
+    assert "k3stpu_predict_requests_total" in names
+    assert len(names) >= 20
+
+
+def _check(fams):
+    """Run the rule engine over a synthetic family list."""
+    real_obs = metrics_lint._families_from_obs
+    real_srv = metrics_lint._families_from_server
+    metrics_lint._families_from_obs = lambda: fams
+    metrics_lint._families_from_server = lambda: []
+    try:
+        return metrics_lint.lint()
+    finally:
+        metrics_lint._families_from_obs = real_obs
+        metrics_lint._families_from_server = real_srv
+
+
+def _pad(fams):
+    """Top up a synthetic list past the collector-sanity floor with
+    clean filler families."""
+    filler = [(f"k3stpu_filler_{i}_total", "counter", "Filler.")
+              for i in range(25)]
+    return fams + filler
+
+
+def test_lint_rejects_bad_families():
+    bad = _pad([
+        ("requests_total", "counter", "No prefix."),
+        ("k3stpu_UPPER", "gauge", "Bad grammar."),
+        ("k3stpu_things", "counter", "Counter without _total."),
+        ("k3stpu_x_total", "counter", ""),
+        ("k3stpu_lat_bucket", "histogram", "Reserved suffix."),
+        ("k3stpu_seconds_spent", "gauge", "Unit not a suffix."),
+    ])
+    problems = "\n".join(_check(bad))
+    assert "missing k3stpu_ prefix" in problems
+    assert "invalid Prometheus name" in problems
+    assert "must end in _total" in problems
+    assert "empty # HELP" in problems
+    assert "reserved suffix" in problems
+    assert "not suffixed _seconds" in problems
+
+
+def test_lint_accepts_unit_suffix_variants():
+    ok = _pad([
+        ("k3stpu_a_seconds", "histogram", "Plain unit suffix."),
+        ("k3stpu_b_seconds_total", "counter", "Counter over seconds."),
+        ("k3stpu_c_bytes", "gauge", "Byte gauge."),
+        ("k3stpu_pages_total2_total", "counter", "No unit at all."),
+    ])
+    assert _check(ok) == []
+
+
+def test_lint_fails_when_collectors_break():
+    # An empty scan is a broken scan — the gate must not pass vacuously.
+    assert any("collectors are broken" in p for p in _check([]))

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Static lint for the repo's Prometheus metric families.
+
+The exposition layer is hand-rolled (no client library — see
+obs/hist.py), which means nothing stops a new family from shipping
+without HELP text, with a bare un-prefixed name, or with a unit baked
+into the wrong place. This lint closes that gap and runs in tier-1
+(tests/test_metrics_lint.py), so drift fails CI instead of landing in a
+dashboard:
+
+- every family name carries the ``k3stpu_`` prefix and matches the
+  Prometheus name grammar;
+- every family has non-empty ``# HELP`` text;
+- counters end in ``_total``;
+- a name that mentions a unit uses it as the proper suffix
+  (``_seconds`` / ``_bytes``, with ``_seconds_total`` etc. for
+  counters) — no ``k3stpu_seconds_spent_x``;
+- histogram families never end in the reserved ``_bucket`` / ``_sum``
+  / ``_count`` / ``_total`` suffixes (render() appends those);
+- no two families share a name.
+
+Families are collected from the real objects where that is cheap
+(``ServeObs`` / ``TrainObs`` construct without jax), and from the
+``_emit(lines, "name", "type", "help", ...)`` call sites in
+serve/server.py by regex where instantiation would need a device.
+
+Run: python tools/metrics_lint.py   (exit 0 clean, 1 with findings)
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# Prometheus metric name grammar (exposition format spec).
+NAME_RE = re.compile(r"^[a-z_:][a-z0-9_:]*$")
+
+# `_emit(lines, "<name>", "<type>", "<help head>"...)` call sites —
+# multi-line, so the help string is whatever first literal follows the
+# type. The _emit helper always renders # HELP from it; lint only that
+# the literal is non-empty.
+EMIT_RE = re.compile(
+    r'emit\(\s*lines,\s*"([^"]+)",\s*"([a-z]+)",\s*\n?\s*"([^"]*)',
+    re.S)
+
+RESERVED_HIST_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
+UNITS = ("seconds", "bytes")
+
+
+def _families_from_obs() -> "list[tuple[str, str, str]]":
+    """(name, type, help) for every family object hanging off the two
+    facades — the constructors are the single source of truth, so a new
+    family is linted the moment it exists."""
+    from k3stpu.obs import ServeObs
+    from k3stpu.obs.hist import Counter, Gauge, Histogram, LabeledCounter
+    from k3stpu.obs.train import TrainObs
+
+    fams = []
+    for facade in (ServeObs(), TrainObs()):
+        for attr in vars(facade).values():
+            if isinstance(attr, Histogram):
+                fams.append((attr.name, "histogram", attr.help))
+            elif isinstance(attr, (Counter, LabeledCounter)):
+                fams.append((attr.name, "counter", attr.help))
+            elif isinstance(attr, Gauge):
+                fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
+def _families_from_server() -> "list[tuple[str, str, str]]":
+    src = open(os.path.join(REPO, "k3stpu", "serve", "server.py")).read()
+    return [(n, t, h) for n, t, h in EMIT_RE.findall(src)]
+
+
+def lint() -> "list[str]":
+    problems = []
+    fams = _families_from_obs() + _families_from_server()
+    if len(fams) < 20:
+        # The scan itself regressing (regex drift, facade rename) must
+        # fail loudly, not pass an empty list.
+        problems.append(f"scan found only {len(fams)} families — the "
+                        f"collectors are broken, not the metrics")
+    seen: "dict[str, str]" = {}
+    for name, mtype, help_text in fams:
+        where = f"{name} ({mtype})"
+        if name in seen:
+            problems.append(f"{where}: duplicate family (also {seen[name]})")
+        seen[name] = mtype
+        if not name.startswith("k3stpu_"):
+            problems.append(f"{where}: missing k3stpu_ prefix")
+        if not NAME_RE.match(name):
+            problems.append(f"{where}: invalid Prometheus name")
+        if not help_text.strip():
+            problems.append(f"{where}: empty # HELP text")
+        if mtype == "counter" and not name.endswith("_total"):
+            problems.append(f"{where}: counter must end in _total")
+        if mtype == "histogram":
+            for suf in RESERVED_HIST_SUFFIXES:
+                if name.endswith(suf):
+                    problems.append(f"{where}: histogram name ends in "
+                                    f"reserved suffix {suf}")
+        for unit in UNITS:
+            if unit in name.split("_"):
+                ok = (name.endswith(f"_{unit}")
+                      or name.endswith(f"_{unit}_total")
+                      # pages_total counts pages, not seconds/bytes —
+                      # only a unit mentioned mid-name is a misplacement.
+                      )
+                if not ok:
+                    problems.append(f"{where}: mentions unit '{unit}' "
+                                    f"but is not suffixed _{unit}")
+    return problems
+
+
+def main() -> int:
+    problems = lint()
+    if problems:
+        for p in problems:
+            print(f"metrics-lint: {p}")
+        return 1
+    fams = _families_from_obs() + _families_from_server()
+    print(f"metrics-lint: {len(fams)} families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
