@@ -18,6 +18,11 @@ the <5% budget from ISSUE 2, vs_baseline = overhead/5.
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
 
+``--node-obs`` gates the fleet tier (same contract, no jax at all):
+CPU cost of one node-exporter /metrics render over a synthetic 4-chip
+sysfs + 8 drop files, as percent of one core at a 1 Hz scrape; <=5%
+budget, vs_baseline = pct/5.
+
 Baseline (BASELINE.md): the reference publishes no numbers, so the target is
 BASELINE.json's north star — >=50% MFU on v5e => 98.5 bf16 TFLOP/s per chip.
 ``vs_baseline`` is achieved/98.5 (so 1.0 == the 50%-MFU target; 2.0 == peak).
@@ -584,6 +589,111 @@ def _train_obs_main() -> int:
                  **skw)
 
 
+def _node_obs_worker() -> int:
+    """Node-exporter scrape-cost microbench (bounded subprocess, no jax).
+
+    The fleet tier's budget: collecting one /metrics render — sysfs
+    chip walk + reading/merging 8 per-process drop files + rebuilding
+    every gauge family — must cost <=5% of one CPU core at a 1 Hz
+    scrape. Measured as process_time over 200 renders against a
+    synthetic 4-chip sysfs tree and 8 fresh drop files (4 devices
+    each), after one warm render; reported as percent of one core
+    consumed if Prometheus scraped once per second."""
+    import shutil
+    import tempfile
+
+    from k3stpu.obs.node_exporter import NodeCollector
+
+    root = tempfile.mkdtemp(prefix="k3stpu-node-obs-")
+    try:
+        # Synthetic host: 4 v5e chips in sysfs + matching /dev/accel*.
+        pci = os.path.join(root, "sys", "bus", "pci", "devices")
+        for i in range(4):
+            ddir = os.path.join(pci, f"0000:0{i}:00.0")
+            os.makedirs(ddir)
+            with open(os.path.join(ddir, "vendor"), "w") as f:
+                f.write("0x1ae0\n")
+            with open(os.path.join(ddir, "device"), "w") as f:
+                f.write("0x0062\n")
+        dev = os.path.join(root, "dev")
+        os.makedirs(dev)
+        for i in range(4):
+            open(os.path.join(dev, f"accel{i}"), "w").close()
+        # 8 per-process drops (8 workload pods on the node), 4 devices
+        # each, in the utils/telemetry.py payload shape.
+        drops = os.path.join(root, "run", "k3stpu")
+        os.makedirs(drops)
+        now = int(time.time())
+        for p in range(8):
+            payload = {"ts": now, "devices": [
+                {"index": i, "bytes_in_use": (p + 1) * 2**28,
+                 "bytes_limit": 16 * 2**30, "duty_cycle_pct": 50,
+                 "source": "pjrt"} for i in range(4)]}
+            with open(os.path.join(drops, f"metrics-pod{p}-1.json"),
+                      "w") as f:
+                json.dump(payload, f)
+
+        coll = NodeCollector(drop_dir=drops, host_root_path=root,
+                             expected_chips=4,
+                             stale_after_s=10**9, gc_after_s=10**9)
+        coll.render()  # warm: first-render allocations out of the timing
+        iters = 200
+        t0 = time.process_time()
+        for _ in range(iters):
+            coll.render()
+        cpu_s = (time.process_time() - t0) / iters
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+
+    pct = cpu_s * 100.0  # 1 Hz scrape: cpu_s per second of wall-clock
+    doc = {
+        # Headline: share of one CPU core the exporter costs at a 1 Hz
+        # scrape. The bar is 5%; vs_baseline = value/5 so <=1.0 means
+        # within budget.
+        "metric": "node_obs_scrape_cpu_pct",
+        "value": round(pct, 3),
+        "unit": "pct_of_one_core_at_1hz",
+        "vs_baseline": round(pct / 5.0, 4),
+        "detail": {
+            "budget_pct": 5.0,
+            "cpu_s_per_scrape": round(cpu_s, 6),
+            "renders_timed": iters,
+            "drop_files": 8,
+            "chips": 4,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _node_obs_main() -> int:
+    """Bounded-subprocess wrapper for --node-obs (same wedge-proof
+    discipline as the other CPU benches; the worker never imports jax
+    but the bounded-run + one-JSON-line contract is identical)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__), "--node-obs-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="node_obs")
+    skw = {"metric": "node_obs_scrape_cpu_pct",
+           "unit": "pct_of_one_core_at_1hz"}
+    if not ok:
+        why = (f"node obs bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("node_obs", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_paged_main() -> int:
     """Bounded-subprocess wrapper for --serve-paged (same wedge-proof
     discipline as the matmul path: the parent never imports jax)."""
@@ -679,4 +789,8 @@ if __name__ == "__main__":
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:
         sys.exit(_train_obs_main())
+    if "--node-obs-worker" in sys.argv[1:]:
+        sys.exit(_node_obs_worker())
+    if "--node-obs" in sys.argv[1:]:
+        sys.exit(_node_obs_main())
     sys.exit(main())

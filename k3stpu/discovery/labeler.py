@@ -12,7 +12,13 @@ Stdlib-only: the in-cluster Kubernetes API is plain HTTPS with the service
 account bearer token, so no client library is needed. `--dry-run` prints the
 patch instead of sending it (used by tests and for debugging).
 
-Run: python -m k3stpu.discovery.labeler [--once] [--dry-run] [--interval 30]
+With ``--health`` the patch also carries ``google.com/tpu.healthy`` from
+the node exporter's composite verdict (obs/node_exporter.py) — GFD's
+health-labeling analogue: degraded nodes get ``"false"`` to nodeSelector
+away from, recovery null-deletes the label.
+
+Run: python -m k3stpu.discovery.labeler [--once] [--dry-run]
+     [--interval 30] [--health]
 """
 
 from __future__ import annotations
@@ -25,7 +31,7 @@ import sys
 import time
 import urllib.request
 
-from k3stpu.utils.chips import TpuInventory, enumerate_chips
+from k3stpu.utils.chips import TpuInventory, enumerate_chips, host_root
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -52,6 +58,23 @@ def labels_for_inventory(inv: TpuInventory) -> dict[str, "str | None"]:
         "google.com/tpu.topology": inv.topology(),
         "feature.node.kubernetes.io/pci-1ae0.present": "true",
     }
+
+
+def health_labels(state: str) -> dict[str, "str | None"]:
+    """Pure health-label computation (the GFD health-labeling analogue).
+
+    Degraded states pin ``google.com/tpu.healthy: "false"`` so
+    workloads can nodeSelector away from sick chips; recovery returns
+    None values, which the strategic-merge PATCH turns into label
+    DELETES — a healthy node carries no health labels at all, so the
+    absence of the label is the steady state and a lingering "true"
+    can never go stale.
+    """
+    if state == "healthy":
+        return {"google.com/tpu.healthy": None,
+                "google.com/tpu.health.state": None}
+    return {"google.com/tpu.healthy": "false",
+            "google.com/tpu.health.state": state}
 
 
 class NodePatcher:
@@ -96,6 +119,19 @@ def main(argv: list[str] | None = None) -> int:
                     help="rescan/patch interval seconds")
     ap.add_argument("--host-root", default=None,
                     help="host filesystem root (default / or K3STPU_HOST_ROOT)")
+    ap.add_argument("--health", action="store_true",
+                    help="also label google.com/tpu.healthy from the "
+                         "node exporter's health verdict (drop files + "
+                         "inventory; obs/node_exporter.py)")
+    ap.add_argument("--drop-dir", default=None,
+                    help="telemetry drop directory for --health "
+                         "(default <host-root>/run/k3stpu)")
+    ap.add_argument("--expected-chips", type=int, default=0,
+                    help="--health: chips this node should have "
+                         "(0 trusts the inventory)")
+    ap.add_argument("--stale-after-s", type=float, default=120.0,
+                    help="--health: drop-file age that flags "
+                         "stale-telemetry")
     args = ap.parse_args(argv)
 
     patcher = None if args.dry_run else NodePatcher()
@@ -103,6 +139,20 @@ def main(argv: list[str] | None = None) -> int:
     while True:
         inv = enumerate_chips(root=args.host_root)
         labels = labels_for_inventory(inv)
+        if args.health:
+            # Same verdict the exporter scores — shared pure functions,
+            # so label and gauge can never disagree about a node.
+            from k3stpu.obs.node_exporter import (
+                health_verdict,
+                read_drop_files,
+            )
+
+            ddir = args.drop_dir or os.path.join(
+                host_root(args.host_root), "run", "k3stpu")
+            drops, _ = read_drop_files(ddir)
+            state, _reason = health_verdict(
+                inv.count, args.expected_chips, drops, args.stale_after_s)
+            labels.update(health_labels(state))
         if labels != last:
             if args.dry_run:
                 print("LABELS_JSON " + json.dumps(labels))

@@ -126,6 +126,45 @@ class LabeledCounter:
         return "\n".join(lines)
 
 
+class LabeledGauge:
+    """A gauge family with ONE label dimension, one time-series per
+    label value (``name{label="x"} v``) — the node exporter's per-chip
+    and per-drop-file shape. Unlike ``LabeledCounter`` it has
+    ``clear()``: the exporter rebuilds the family on every collect, so
+    a GC'd drop file's series disappears from the next scrape instead
+    of freezing at its last value."""
+
+    __slots__ = ("name", "help", "label", "_values", "_lock")
+
+    def __init__(self, name: str, help_text: str, label: str):
+        self.name = name
+        self.help = help_text
+        self.label = label
+        self._values: "dict[str, float]" = {}
+        self._lock = threading.Lock()
+
+    def set(self, label_value: str, value: float) -> None:
+        with self._lock:
+            self._values[label_value] = float(value)
+
+    def get(self, label_value: str) -> "float | None":
+        with self._lock:
+            return self._values.get(label_value)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+    def render(self) -> str:
+        with self._lock:
+            items = list(self._values.items())
+        lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
+                                        t="gauge")]
+        for k, v in items:
+            lines.append(f'{self.name}{{{self.label}="{k}"}} {_fmt(v)}')
+        return "\n".join(lines)
+
+
 class Histogram:
     """Fixed-bucket histogram with Prometheus exposition.
 

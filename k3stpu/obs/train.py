@@ -381,7 +381,7 @@ def start_telemetry_thread(obs: TrainObs,
     training pods, not 'n/a'. Every process runs one (each pod owns its
     chips; the drop file is per-host). ``stop`` ends the loop at job
     exit so in-process callers (tests) don't leak writers."""
-    from k3stpu.utils.telemetry import DROP_PATH, write_metrics
+    from k3stpu.utils.telemetry import write_metrics
 
     if interval is None:
         try:
@@ -390,7 +390,10 @@ def start_telemetry_thread(obs: TrainObs,
         except ValueError:
             interval = 10.0
     if path is None:
-        path = os.environ.get("K3STPU_TELEMETRY_DROP", DROP_PATH)
+        # None falls through to write_metrics' own resolution: the
+        # K3STPU_TELEMETRY_DROP override, else this process's
+        # per-process drop file (+ legacy mirror for C++ tpu-info).
+        path = os.environ.get("K3STPU_TELEMETRY_DROP") or None
     stop = stop or threading.Event()
 
     def loop() -> None:

@@ -17,15 +17,52 @@ busy-fraction between writes — obs/train.py's telemetry thread covers the
 training side). Supplied values are clamped to [0, 100]; -1 stays the
 "no source" sentinel. Fields whose source is unavailable are -1,
 rendered "n/a".
+
+Drop files are PER PROCESS (``metrics-<pod|host>-<pid>.json``): every
+process on a node used to write the single ``metrics.json``, so
+co-scheduled serving/training pods overwrote each other's telemetry and
+the node table showed whichever pod wrote last. The default write also
+mirrors the legacy single path so the C++ tpu-info reader
+(``native/common/chips.cpp:fill_telemetry``) keeps working unchanged;
+node-level readers (obs/node_exporter.py) merge the per-process files
+and fall back to the legacy path only when no per-process file exists.
+Stale per-process files (dead pods) are GC'd by the node exporter, not
+by writers.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import re
 import time
 
+DROP_DIR = "/run/k3stpu"
+# Legacy single-file path: still mirrored on default writes for the C++
+# tpu-info reader, still accepted by readers when nothing newer exists.
 DROP_PATH = "/run/k3stpu/metrics.json"
+DROP_DIR_ENV = "K3STPU_TELEMETRY_DROP_DIR"
+DROP_ENV = "K3STPU_TELEMETRY_DROP"
+
+
+def drop_dir() -> str:
+    """The node-shared drop directory (env-overridable for tests)."""
+    return os.environ.get(DROP_DIR_ENV) or DROP_DIR
+
+
+def process_drop_path(dirpath: "str | None" = None) -> str:
+    """This process's own drop file: ``metrics-<ident>-<pid>.json``.
+
+    ``ident`` is the pod name when the downward API provides one
+    (K3STPU_POD_NAME, else HOSTNAME which kubernetes sets to the pod
+    name) — the pid alone is ambiguous across pods sharing a node,
+    since each container's pid namespace restarts at 1.
+    """
+    ident = (os.environ.get("K3STPU_POD_NAME")
+             or os.environ.get("HOSTNAME") or "proc")
+    ident = re.sub(r"[^A-Za-z0-9._-]+", "-", ident)
+    base = dirpath if dirpath is not None else drop_dir()
+    return os.path.join(base, f"metrics-{ident}-{os.getpid()}.json")
 
 # Known HBM per chip by device_kind substring — the bytes_limit fallback
 # when the backend's memory_stats() is empty (observed through the relayed
@@ -121,14 +158,10 @@ def collect_device_metrics(duty_cycle_pct: int = -1) -> dict:
     return {"ts": int(time.time()), "devices": devices}
 
 
-def write_metrics(path: str = DROP_PATH, duty_cycle_pct: int = -1) -> dict:
-    """Atomically write the drop file; returns the payload.
-
-    Atomic (write + rename) so a concurrently-reading tpu-info never sees a
-    torn file; errors never propagate into the workload's hot path — the
-    caller's compute matters more than its observability.
-    """
-    payload = collect_device_metrics(duty_cycle_pct)
+def _atomic_write(path: str, payload: dict) -> None:
+    """Write + rename so a concurrent reader never sees a torn file;
+    errors never propagate into the workload's hot path — the caller's
+    compute matters more than its observability."""
     try:
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         tmp = f"{path}.tmp.{os.getpid()}"
@@ -137,4 +170,24 @@ def write_metrics(path: str = DROP_PATH, duty_cycle_pct: int = -1) -> dict:
         os.replace(tmp, path)
     except OSError:
         pass
+
+
+def write_metrics(path: "str | None" = None,
+                  duty_cycle_pct: int = -1) -> dict:
+    """Atomically write this process's drop file; returns the payload.
+
+    ``path=None`` (the default every workload uses) resolves to the
+    K3STPU_TELEMETRY_DROP env override when set (tests, bench), else the
+    per-process file plus a best-effort mirror of the legacy single path
+    for the C++ tpu-info reader (last-writer-wins there, exactly the old
+    behavior). An explicit ``path`` writes only that file.
+    """
+    payload = collect_device_metrics(duty_cycle_pct)
+    if path is None:
+        path = os.environ.get(DROP_ENV) or None
+    if path is not None:
+        _atomic_write(path, payload)
+    else:
+        _atomic_write(process_drop_path(), payload)
+        _atomic_write(os.path.join(drop_dir(), "metrics.json"), payload)
     return payload

@@ -25,8 +25,9 @@
 #            request traces, /debug endpoints), the chaos/containment
 #            suite (fault injection + recovery invariants), and the
 #            training-resilience suite (SIGTERM checkpointing, quarantine,
-#            retention, bounded rendezvous) ride along minus their @slow
-#            soak/bench tests (the full suite runs those).
+#            retention, bounded rendezvous), and the fleet tier (node
+#            exporter, health labeling, tpu_top) ride along minus their
+#            @slow soak/bench tests (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -53,7 +54,8 @@ if [ "${1:-}" = "--smoke" ]; then
     tests/test_bench.py tests/test_graft_entry.py \
     tests/test_paged.py tests/test_obs.py \
     tests/test_chaos.py tests/test_train_resilience.py \
-    tests/test_train_obs.py tests/test_metrics_lint.py -m "not slow" "$@"
+    tests/test_train_obs.py tests/test_metrics_lint.py \
+    tests/test_node_obs.py -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

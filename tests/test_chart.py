@@ -117,6 +117,76 @@ def test_train_enabled_scrape_and_preemption_wiring():
     assert mounts["k3stpu-metrics"] == "/run/k3stpu"
 
 
+def test_node_exporter_disabled_by_default():
+    # Default render must stay byte-stable: no exporter DaemonSet, no
+    # rules ConfigMap, and the tfd labeler runs WITHOUT --health.
+    objs = render()
+    assert ("DaemonSet", "k3s-tpu-node-exporter") not in objs
+    assert ("ConfigMap", "k3s-tpu-rules") not in objs
+    tfd = objs[("DaemonSet", "k3s-tpu-feature-discovery")]
+    (ctr,) = tfd["spec"]["template"]["spec"]["containers"]
+    assert "--health" not in ctr["command"]
+
+
+def test_node_exporter_enabled_wiring():
+    objs = render({"nodeExporter.enabled": "true"}, namespace="fleet-ns")
+    ds = objs[("DaemonSet", "k3s-tpu-node-exporter")]
+    assert ds["metadata"]["namespace"] == "fleet-ns"
+    tmpl = ds["spec"]["template"]
+    ann = tmpl["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    pod = tmpl["spec"]
+    # Exporter only lands where discovery found chips.
+    assert pod["nodeSelector"] == {"google.com/tpu.present": "true"}
+    (ctr,) = pod["containers"]
+    cmd = ctr["command"]
+    # Scrape annotation, containerPort, hostPort (tpu_top's sweep
+    # surface) and the --port flag must all agree, values-driven.
+    (port,) = ctr["ports"]
+    assert (ann["prometheus.io/port"] == cmd[cmd.index("--port") + 1]
+            == str(port["containerPort"]) == str(port["hostPort"])
+            == "8478")
+    # Drop dir rw (the exporter GCs), host sysfs/dev ro under /host.
+    mounts = {m["name"]: m for m in ctr["volumeMounts"]}
+    assert mounts["k3stpu-metrics"]["mountPath"] == "/run/k3stpu"
+    assert not mounts["k3stpu-metrics"].get("readOnly")
+    assert mounts["host-sys"]["readOnly"] and mounts["host-dev"]["readOnly"]
+    assert cmd[cmd.index("--host-root") + 1] == "/host"
+    vols = {v["name"]: v for v in pod["volumes"]}
+    assert vols["k3stpu-metrics"]["hostPath"]["type"] == "DirectoryOrCreate"
+    # And the tfd labeler switches on health labeling with a READ-ONLY
+    # view of the same drop dir, thresholds shared with the exporter.
+    tfd = objs[("DaemonSet", "k3s-tpu-feature-discovery")]
+    (tctr,) = tfd["spec"]["template"]["spec"]["containers"]
+    tcmd = tctr["command"]
+    assert "--health" in tcmd
+    assert tcmd[tcmd.index("--drop-dir") + 1] == "/host/run/k3stpu"
+    assert (tcmd[tcmd.index("--stale-after-s") + 1]
+            == cmd[cmd.index("--stale-after-s") + 1] == "120")
+    tmounts = {m["name"]: m for m in tctr["volumeMounts"]}
+    assert tmounts["k3stpu-metrics"]["readOnly"]
+
+
+def test_rules_configmap_thresholds_reach_exprs():
+    objs = render({"rules.enabled": "true",
+                   "rules.ttftP99SloSeconds": "1.5",
+                   "rules.goodputFractionMin": "0.9"})
+    cm = objs[("ConfigMap", "k3s-tpu-rules")]
+    recording = yaml.safe_load(cm["data"]["k3s-tpu-slo.rules.yaml"])
+    alerts = yaml.safe_load(cm["data"]["k3s-tpu-alerts.rules.yaml"])
+    recorded = {r["record"] for g in recording["groups"]
+                for r in g["rules"]}
+    assert "k3stpu:request_ttft_seconds:p99" in recorded
+    exprs = {r["alert"]: r["expr"] for g in alerts["groups"]
+             for r in g["rules"]}
+    # Values-driven thresholds land in the rendered expressions.
+    assert "> 1.5" in exprs["K3sTpuTtftSloBreach"]
+    assert "< 0.9" in exprs["K3sTpuGoodputLow"]
+    # Alerts on recorded series reference them by the recorded name.
+    assert "k3stpu:node_tpu_health:max" in exprs["K3sTpuNodeUnhealthy"]
+
+
 def test_runtimeclass_and_namespace():
     objs = render(namespace="custom-ns")
     rc = objs[("RuntimeClass", "tpu")]
@@ -230,11 +300,16 @@ def _golden_case(name):
         # Likewise for the opt-in training workload: the only reviewable
         # rendering of the Service/PVC/Job triple with scrape annotations.
         "train.yaml": {"train.enabled": "true"},
+        # Fleet observability tier: node-exporter DaemonSet + SLO rules
+        # ConfigMap + the tfd health-labeling wiring they switch on —
+        # all off in the default golden, which stays byte-unchanged.
+        "node-obs.yaml": {"nodeExporter.enabled": "true",
+                          "rules.enabled": "true"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
-                "train.yaml"]
+                "train.yaml", "node-obs.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

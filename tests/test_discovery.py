@@ -5,7 +5,8 @@ import os
 import subprocess
 import sys
 
-from k3stpu.discovery.labeler import labels_for_inventory
+from k3stpu.discovery import labeler
+from k3stpu.discovery.labeler import health_labels, labels_for_inventory
 from k3stpu.utils.chips import enumerate_chips
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -30,6 +31,75 @@ def test_labels_no_tpu(tmp_path):
     # Null values delete stale labels via strategic-merge-patch.
     assert labels["google.com/tpu.count"] is None
     assert labels["google.com/tpu.topology"] is None
+
+
+def test_health_labels_pure():
+    assert health_labels("stale-telemetry") == {
+        "google.com/tpu.healthy": "false",
+        "google.com/tpu.health.state": "stale-telemetry",
+    }
+    assert health_labels("wedged")["google.com/tpu.healthy"] == "false"
+    # Recovery: null values -> strategic-merge label DELETES, so a
+    # healthy node carries no health labels at all.
+    assert health_labels("healthy") == {
+        "google.com/tpu.healthy": None,
+        "google.com/tpu.health.state": None,
+    }
+
+
+def _health_dry_run(fake_host_root, drops, capsys):
+    rc = labeler.main([
+        "--once", "--dry-run", "--health",
+        "--host-root", str(fake_host_root), "--drop-dir", str(drops)])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("LABELS_JSON ")]
+    return json.loads(lines[-1].split(" ", 1)[1])
+
+
+def test_labeler_health_transition_patch_shapes(fake_host_root, tmp_path,
+                                                capsys):
+    """healthy -> unhealthy -> recovered: the dry-run patch pins "false"
+    while degraded and null-deletes both keys on recovery, with the
+    inventory labels untouched throughout."""
+    import time
+
+    drops = tmp_path / "drops"
+    drops.mkdir()
+
+    def write(ts):
+        with open(drops / "metrics-pod-1.json", "w") as f:
+            json.dump({"ts": ts, "devices": [
+                {"index": 0, "bytes_in_use": 1, "bytes_limit": 2,
+                 "duty_cycle_pct": 10}]}, f)
+
+    write(time.time())
+    labels = _health_dry_run(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.healthy"] is None
+    assert labels["google.com/tpu.health.state"] is None
+
+    write(time.time() - 10_000)  # telemetry goes stale
+    labels = _health_dry_run(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.healthy"] == "false"
+    assert labels["google.com/tpu.health.state"] == "stale-telemetry"
+    assert labels["google.com/tpu.present"] == "true"  # inventory intact
+    assert labels["google.com/tpu.count"] == "4"
+
+    write(time.time())  # recovered
+    labels = _health_dry_run(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.healthy"] is None
+    assert labels["google.com/tpu.health.state"] is None
+
+
+def test_labeler_without_health_flag_has_no_health_keys(fake_host_root,
+                                                        capsys):
+    rc = labeler.main(["--once", "--dry-run",
+                       "--host-root", str(fake_host_root)])
+    assert rc == 0
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("LABELS_JSON ")][0]
+    labels = json.loads(line.split(" ", 1)[1])
+    assert "google.com/tpu.healthy" not in labels
 
 
 def test_labeler_cli_dry_run(fake_host_root):

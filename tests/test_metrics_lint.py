@@ -86,3 +86,56 @@ def test_lint_accepts_unit_suffix_variants():
 def test_lint_fails_when_collectors_break():
     # An empty scan is a broken scan — the gate must not pass vacuously.
     assert any("collectors are broken" in p for p in _check([]))
+
+
+def test_scan_finds_node_exporter_families():
+    names = [n for n, _, _ in metrics_lint._families_from_node_exporter()]
+    assert "k3stpu_node_tpu_health" in names
+    assert "k3stpu_node_chip_hbm_used_bytes" in names
+    assert "k3stpu_node_drop_parse_errors_total" in names
+    assert len(names) >= 13
+
+
+def test_repo_rules_are_clean():
+    problems = metrics_lint.lint_rules()
+    assert problems == [], "\n".join(problems)
+
+
+def test_rules_lint_rejects_unknown_metric_and_bad_record_name():
+    fams = [("k3stpu_real_seconds", "histogram", "Real."),
+            ("k3stpu_up", "gauge", "Real gauge.")]
+    groups = [{"name": "g", "rules": [
+        # References a family that does not exist (a rename victim).
+        {"alert": "A", "expr": "k3stpu_gone_total > 1"},
+        # Histogram families are known via their _bucket series.
+        {"record": "k3stpu:real:p99",
+         "expr": "histogram_quantile(0.99, k3stpu_real_seconds_bucket)"},
+        # Recording rules must use the colon convention.
+        {"record": "k3stpu_flat", "expr": "k3stpu_up"},
+        {"alert": "B", "expr": "   "},
+        # A recorded rule's output IS a known metric for other rules.
+        {"alert": "C", "expr": "k3stpu:real:p99 > 2"},
+    ]}]
+    problems = "\n".join(metrics_lint.lint_rules(fams=fams, groups=groups))
+    assert "k3stpu_gone_total" in problems
+    assert "level:metric:operation" in problems
+    assert "empty expr" in problems
+    assert "k3stpu_real_seconds_bucket" not in problems
+    assert "'k3stpu:real:p99'" not in problems
+
+
+def test_rules_lint_fails_on_empty_render():
+    assert any("no rule groups" in p
+               for p in metrics_lint.lint_rules(groups=[]))
+
+
+def test_cli_gate_runs_clean():
+    import subprocess
+    import sys as _sys
+
+    out = subprocess.run(
+        [_sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                       "tools", "metrics_lint.py")],
+        capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "clean" in out.stdout

@@ -1,0 +1,369 @@
+"""Fleet tier: node exporter drop-file merge, health verdict, the
+health->label feedback into the labeler, and the tpu_top sweep.
+
+The load-bearing test is the ISSUE's acceptance E2E: two per-process
+drops + a fake sysfs render merged per-chip gauges; aging one drop past
+staleness flips k3stpu_node_tpu_health AND makes the labeler dry-run
+emit google.com/tpu.healthy "false"; freshening it emits the
+null-delete patch.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from k3stpu.discovery import labeler
+from k3stpu.obs.hist import LabeledGauge
+from k3stpu.obs import node_exporter
+from k3stpu.obs.node_exporter import (
+    HEALTH_STATES,
+    NodeCollector,
+    gc_stale_drops,
+    health_verdict,
+    merge_devices,
+    read_drop_files,
+    start_node_exporter_server,
+)
+
+pytestmark = pytest.mark.filterwarnings("ignore::DeprecationWarning")
+
+
+def _write_drop(dirpath, name, ts, devices):
+    os.makedirs(dirpath, exist_ok=True)
+    path = os.path.join(dirpath, name)
+    with open(path, "w") as f:
+        json.dump({"ts": ts, "devices": devices}, f)
+    return path
+
+
+def _dev(index, used=2**30, limit=16 * 2**30, duty=50):
+    return {"index": index, "bytes_in_use": used, "bytes_limit": limit,
+            "duty_cycle_pct": duty, "source": "pjrt"}
+
+
+# ---------------------------------------------------------------- drops
+
+
+def test_read_drop_files_merges_per_process(tmp_path):
+    now = 1000.0
+    _write_drop(tmp_path, "metrics-pod-a-7.json", 990, [_dev(0), _dev(1)])
+    _write_drop(tmp_path, "metrics-pod-b-7.json", 995, [_dev(2), _dev(3)])
+    drops, errors = read_drop_files(str(tmp_path), now=now)
+    assert errors == 0
+    assert [d["file"] for d in drops] == [
+        "metrics-pod-a-7.json", "metrics-pod-b-7.json"]
+    assert drops[0]["age_s"] == pytest.approx(10.0)
+    merged = merge_devices(drops)
+    assert sorted(merged) == [0, 1, 2, 3]
+    assert merged[2]["_file"] == "metrics-pod-b-7.json"
+
+
+def test_merge_freshest_report_wins_on_overlap(tmp_path):
+    _write_drop(tmp_path, "metrics-old-1.json", 900, [_dev(0, used=111)])
+    _write_drop(tmp_path, "metrics-new-2.json", 950, [_dev(0, used=222)])
+    drops, _ = read_drop_files(str(tmp_path), now=1000.0)
+    merged = merge_devices(drops)
+    assert merged[0]["bytes_in_use"] == 222
+
+
+def test_malformed_drop_counts_as_parse_error(tmp_path):
+    _write_drop(tmp_path, "metrics-ok-1.json", 990, [_dev(0)])
+    (tmp_path / "metrics-bad-2.json").write_text("{not json")
+    (tmp_path / "metrics-nots-3.json").write_text('{"devices": []}')
+    drops, errors = read_drop_files(str(tmp_path), now=1000.0)
+    assert errors == 2
+    assert [d["file"] for d in drops] == ["metrics-ok-1.json"]
+
+
+def test_legacy_single_file_is_compat_read_only(tmp_path):
+    # Old writers only: metrics.json is read when nothing newer exists…
+    _write_drop(tmp_path, "metrics.json", 990, [_dev(0, used=42)])
+    drops, _ = read_drop_files(str(tmp_path), now=1000.0)
+    assert [d["file"] for d in drops] == ["metrics.json"]
+    # …and skipped once a per-process file appears (the default writer
+    # MIRRORS into metrics.json — counting both would double-count).
+    _write_drop(tmp_path, "metrics-pod-1.json", 995, [_dev(0, used=99)])
+    drops, _ = read_drop_files(str(tmp_path), now=1000.0)
+    assert [d["file"] for d in drops] == ["metrics-pod-1.json"]
+    assert merge_devices(drops)[0]["bytes_in_use"] == 99
+
+
+def test_gc_removes_old_per_process_but_never_legacy(tmp_path):
+    old = _write_drop(tmp_path, "metrics-dead-1.json", 0, [_dev(0)])
+    fresh = _write_drop(tmp_path, "metrics-live-2.json", 0, [_dev(1)])
+    legacy = _write_drop(tmp_path, "metrics.json", 0, [_dev(0)])
+    past = time.time() - 10_000
+    os.utime(old, (past, past))
+    os.utime(legacy, (past, past))
+    removed = gc_stale_drops(str(tmp_path), gc_after_s=900)
+    assert removed == 1
+    assert not os.path.exists(old)
+    assert os.path.exists(fresh)
+    assert os.path.exists(legacy)  # old writers rewrite it in place
+
+
+def test_write_metrics_default_is_per_process_plus_legacy_mirror(
+        tmp_path, monkeypatch):
+    from k3stpu.utils import telemetry
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv(telemetry.DROP_DIR_ENV, str(tmp_path))
+    monkeypatch.delenv(telemetry.DROP_ENV, raising=False)
+    payload = telemetry.write_metrics()
+    own = telemetry.process_drop_path()
+    assert os.path.dirname(own) == str(tmp_path)
+    assert os.path.basename(own).startswith("metrics-")
+    assert os.path.basename(own).endswith(f"-{os.getpid()}.json")
+    with open(own) as f:
+        assert json.load(f) == payload
+    with open(tmp_path / "metrics.json") as f:  # the C++ tpu-info read
+        assert json.load(f) == payload
+    # An explicit path writes ONLY that file.
+    explicit = tmp_path / "sub" / "only.json"
+    telemetry.write_metrics(str(explicit))
+    assert explicit.exists()
+    assert not (tmp_path / "sub" / "metrics.json").exists()
+
+
+# -------------------------------------------------------------- verdict
+
+
+def test_health_verdict_transitions():
+    fresh = {"file": "metrics-a-1.json", "ts": 990, "age_s": 10.0,
+             "devices": [_dev(0)]}
+    stale = dict(fresh, file="metrics-b-2.json", age_s=500.0)
+    assert health_verdict(4, 0, [fresh], 120)[0] == "healthy"
+    # No drops at all is healthy-IDLE, not stale.
+    assert health_verdict(4, 0, [], 120)[0] == "healthy"
+    assert health_verdict(4, 0, [fresh, stale], 120)[0] == "stale-telemetry"
+    assert health_verdict(4, 8, [fresh], 120)[0] == "missing-chips"
+    # 0 expected chips trusts the inventory — never missing.
+    assert health_verdict(0, 0, [], 120)[0] == "healthy"
+
+
+def test_health_verdict_wedged_is_fresh_drop_with_no_device_data():
+    empty = {"file": "metrics-w-1.json", "ts": 990, "age_s": 10.0,
+             "devices": []}
+    sentinel = dict(empty, devices=[_dev(0, used=-1, duty=-1),
+                                    _dev(1, used=-1, duty=-1)])
+    assert health_verdict(4, 0, [empty], 120)[0] == "wedged"
+    assert health_verdict(4, 0, [sentinel], 120)[0] == "wedged"
+    # A STALE wedge signal is just stale telemetry (the process that
+    # wrote it may be long gone)…
+    old_wedge = dict(empty, age_s=500.0)
+    assert health_verdict(4, 0, [old_wedge], 120)[0] == "stale-telemetry"
+    # …and wedged outranks missing-chips outranks stale.
+    stale = {"file": "metrics-s-2.json", "ts": 1, "age_s": 500.0,
+             "devices": [_dev(2)]}
+    assert health_verdict(2, 8, [empty, stale], 120)[0] == "wedged"
+    assert health_verdict(2, 8, [stale], 120)[0] == "missing-chips"
+
+
+def test_labeled_gauge_clear_drops_series():
+    g = LabeledGauge("k3stpu_test_g", "help", "chip")
+    g.set("0", 1.5)
+    g.set("1", 2)
+    assert 'k3stpu_test_g{chip="0"} 1.5' in g.render()
+    g.clear()
+    assert g.get("0") is None
+    assert "{" not in g.render()  # only HELP/TYPE left
+
+
+# ------------------------------------------------------------ collector
+
+
+def test_collector_merges_drops_with_sysfs(fake_host_root, tmp_path):
+    drops = tmp_path / "drops"
+    now = time.time()
+    _write_drop(drops, "metrics-serve-1.json", now - 5,
+                [_dev(0, used=3 * 2**30), _dev(1, used=2**30)])
+    _write_drop(drops, "metrics-train-2.json", now - 9,
+                [_dev(2, used=4 * 2**30, duty=80), _dev(3, used=2**30)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root),
+                         expected_chips=4)
+    text = coll.render()
+    assert "k3stpu_node_chips 4" in text
+    assert "k3stpu_node_chips_expected 4" in text
+    assert 'k3stpu_node_chip_hbm_used_bytes{chip="0"} 3221225472' in text
+    assert 'k3stpu_node_chip_hbm_used_bytes{chip="2"} 4294967296' in text
+    assert 'k3stpu_node_chip_duty_cycle_pct{chip="2"} 80' in text
+    assert 'k3stpu_node_drop_file_stale{file="metrics-serve-1.json"} 0' \
+        in text
+    assert "k3stpu_node_drop_files 2" in text
+    assert "k3stpu_node_tpu_health 0" in text
+    assert 'k3stpu_node_tpu_health_state{state="healthy"} 1' in text
+
+
+def test_collector_no_expected_chips_reports_inventory(fake_host_root,
+                                                       tmp_path):
+    coll = NodeCollector(drop_dir=str(tmp_path / "none"),
+                         host_root_path=str(fake_host_root))
+    text = coll.render()
+    # Empty drop dir: healthy-idle, and expected falls back to sysfs.
+    assert "k3stpu_node_chips_expected 4" in text
+    assert "k3stpu_node_tpu_health 0" in text
+    assert "k3stpu_node_drop_files 0" in text
+
+
+def test_collector_gcd_series_disappear(fake_host_root, tmp_path):
+    drops = tmp_path / "drops"
+    now = time.time()
+    dead = _write_drop(drops, "metrics-dead-1.json", now,
+                       [_dev(0, used=7)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root),
+                         gc_after_s=900)
+    assert 'chip="0"' in coll.render()
+    past = now - 10_000
+    os.utime(dead, (past, past))
+    text = coll.render()
+    assert 'chip="0"' not in text  # clear()+rebuild, not a frozen series
+    assert "k3stpu_node_drop_files_gc_total 1" in text
+
+
+def test_http_metrics_and_healthz(fake_host_root, tmp_path):
+    drops = tmp_path / "drops"
+    _write_drop(drops, "metrics-a-1.json", time.time(), [_dev(0)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root))
+    httpd = start_node_exporter_server(coll, port=0, host="127.0.0.1")
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert r.status == 200
+        assert "k3stpu_node_tpu_health 0" in body
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            doc = json.loads(r.read())
+        # /healthz is a REPORT (always 200) — the verdict is the body.
+        assert doc == {"state": "healthy", "code": 0, "reason": ""}
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_main_once_prints_exposition(fake_host_root, tmp_path, capsys):
+    rc = node_exporter.main([
+        "--once", "--host-root", str(fake_host_root),
+        "--drop-dir", str(tmp_path / "drops"), "--expected-chips", "8"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "k3stpu_node_chips 4" in out
+    assert "k3stpu_node_tpu_health 2" in out  # missing-chips: 4 < 8
+
+
+# ----------------------------------------- acceptance E2E (ISSUE 6)
+
+
+def _dry_run_labels(fake_host_root, drops, capsys):
+    rc = labeler.main([
+        "--once", "--dry-run", "--health",
+        "--host-root", str(fake_host_root), "--drop-dir", str(drops)])
+    assert rc == 0
+    lines = [l for l in capsys.readouterr().out.splitlines()
+             if l.startswith("LABELS_JSON ")]
+    assert lines, "labeler emitted no LABELS_JSON"
+    return json.loads(lines[-1].split(" ", 1)[1])
+
+
+def test_fleet_e2e_stale_flips_health_and_label(fake_host_root, tmp_path,
+                                                capsys):
+    drops = tmp_path / "drops"
+    now = time.time()
+    _write_drop(drops, "metrics-serve-1.json", now,
+                [_dev(0), _dev(1)])
+    _write_drop(drops, "metrics-train-2.json", now,
+                [_dev(2), _dev(3)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root),
+                         expected_chips=4, stale_after_s=120)
+
+    # Phase 1: both drops fresh -> merged per-chip gauges, healthy,
+    # and the labeler dry-run carries NO health labels (null-delete).
+    text = coll.render()
+    for chip in range(4):
+        assert f'k3stpu_node_chip_hbm_used_bytes{{chip="{chip}"}}' in text
+    assert "k3stpu_node_tpu_health 0" in text
+    labels = _dry_run_labels(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.present"] == "true"
+    assert labels["google.com/tpu.healthy"] is None
+    assert labels["google.com/tpu.health.state"] is None
+
+    # Phase 2: age one drop past staleness -> health flips to
+    # stale-telemetry and the label goes "false".
+    _write_drop(drops, "metrics-train-2.json", now - 1000,
+                [_dev(2), _dev(3)])
+    text = coll.render()
+    assert ("k3stpu_node_tpu_health "
+            + str(HEALTH_STATES.index("stale-telemetry"))) in text
+    assert 'k3stpu_node_drop_file_stale{file="metrics-train-2.json"} 1' \
+        in text
+    labels = _dry_run_labels(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.healthy"] == "false"
+    assert labels["google.com/tpu.health.state"] == "stale-telemetry"
+
+    # Phase 3: the process reports again -> recovery null-deletes.
+    _write_drop(drops, "metrics-train-2.json", time.time(),
+                [_dev(2), _dev(3)])
+    assert "k3stpu_node_tpu_health 0" in coll.render()
+    labels = _dry_run_labels(fake_host_root, drops, capsys)
+    assert labels["google.com/tpu.healthy"] is None
+    assert labels["google.com/tpu.health.state"] is None
+
+
+# -------------------------------------------------------------- tpu_top
+
+
+def _load_tpu_top():
+    import importlib.util
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "tpu_top.py")
+    spec = importlib.util.spec_from_file_location("tpu_top", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_tpu_top_parses_rendered_exposition(fake_host_root, tmp_path):
+    top = _load_tpu_top()
+    drops = tmp_path / "drops"
+    _write_drop(drops, "metrics-a-1.json", time.time(),
+                [_dev(0, used=2**30, duty=75)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root),
+                         expected_chips=4)
+    fams = top.parse_families(coll.render())
+    row = top.node_row("http://node-a:8478", fams)
+    assert row["node"] == "node-a:8478"
+    assert row["health"] == "healthy"
+    assert row["chips"] == 4 and row["expected"] == 4
+    assert row["devices"] == [
+        {"chip": "0", "used": 2**30, "limit": 16 * 2**30, "duty": 75}]
+    table = top.render_table([row])
+    assert "node-a:8478" in table and "healthy" in table
+    assert "chip 0" in table and "1.0/16.0 GiB" in table
+
+
+def test_tpu_top_sweep_live_and_unreachable(fake_host_root, tmp_path):
+    top = _load_tpu_top()
+    drops = tmp_path / "drops"
+    _write_drop(drops, "metrics-a-1.json", time.time(), [_dev(0)])
+    coll = NodeCollector(drop_dir=str(drops),
+                         host_root_path=str(fake_host_root))
+    httpd = start_node_exporter_server(coll, port=0, host="127.0.0.1")
+    try:
+        live = f"http://127.0.0.1:{httpd.server_address[1]}"
+        # Port 1: reserved/unassigned — connection refused immediately.
+        rows = top.sweep([live, "http://127.0.0.1:1"], timeout=2.0)
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert rows[0]["health"] == "healthy"
+    assert rows[1]["health"] == "unreachable"
+    table = top.render_table(rows)
+    assert "unreachable" in table

@@ -20,9 +20,17 @@ dashboard:
 - no two families share a name.
 
 Families are collected from the real objects where that is cheap
-(``ServeObs`` / ``TrainObs`` construct without jax), and from the
-``_emit(lines, "name", "type", "help", ...)`` call sites in
-serve/server.py by regex where instantiation would need a device.
+(``ServeObs`` / ``TrainObs`` / the node exporter's ``NodeCollector``
+all construct without jax), and from the ``_emit(lines, "name",
+"type", "help", ...)`` call sites in serve/server.py by regex where
+instantiation would need a device.
+
+``lint_rules()`` extends the gate to the chart's Prometheus
+recording/alerting rules (templates/rules.yaml): every ``k3stpu_*``
+metric a rule expression references must exist in a linted family
+(histograms count via their ``_bucket``/``_sum``/``_count`` series),
+or be the output of another recording rule in the same bundle — so a
+metric rename fails the lint instead of silently blanking an alert.
 
 Run: python tools/metrics_lint.py   (exit 0 clean, 1 with findings)
 """
@@ -76,9 +84,38 @@ def _families_from_server() -> "list[tuple[str, str, str]]":
     return [(n, t, h) for n, t, h in EMIT_RE.findall(src)]
 
 
+def _families_from_node_exporter() -> "list[tuple[str, str, str]]":
+    """The node exporter's families, from a real NodeCollector — same
+    construct-and-scan discipline as the facades (the constructor never
+    touches the filesystem; only collect() does)."""
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        LabeledCounter,
+        LabeledGauge,
+    )
+    from k3stpu.obs.node_exporter import NodeCollector
+
+    fams = []
+    for attr in vars(NodeCollector(drop_dir="/nonexistent")).values():
+        if isinstance(attr, Histogram):
+            fams.append((attr.name, "histogram", attr.help))
+        elif isinstance(attr, (Counter, LabeledCounter)):
+            fams.append((attr.name, "counter", attr.help))
+        elif isinstance(attr, (Gauge, LabeledGauge)):
+            fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
+def _all_families() -> "list[tuple[str, str, str]]":
+    return (_families_from_obs() + _families_from_server()
+            + _families_from_node_exporter())
+
+
 def lint() -> "list[str]":
     problems = []
-    fams = _families_from_obs() + _families_from_server()
+    fams = _all_families()
     if len(fams) < 20:
         # The scan itself regressing (regex drift, facade rename) must
         # fail loudly, not pass an empty list.
@@ -116,14 +153,83 @@ def lint() -> "list[str]":
     return problems
 
 
+# Metric tokens in a rule expression: bare family names and the
+# colon-separated recording-rule convention (k3stpu:level:operation).
+RULE_METRIC_RE = re.compile(r"\bk3stpu[a-z0-9_:]*")
+
+
+def _rule_groups_from_chart() -> "list[dict]":
+    """Rule groups out of the chart's rendered rules ConfigMap, with
+    both the nodeExporter and rules components forced on — the lint
+    must see the rules even though the chart ships them opt-out."""
+    import yaml
+
+    from k3stpu.utils.helm_lite import render_chart
+
+    chart = os.path.join(REPO, "deploy", "charts", "k3s-tpu")
+    text = render_chart(chart, overrides={"nodeExporter.enabled": "true",
+                                          "rules.enabled": "true"})
+    groups = []
+    for doc in yaml.safe_load_all(text):
+        if not doc or doc.get("kind") != "ConfigMap":
+            continue
+        if "rules" not in doc["metadata"]["name"]:
+            continue
+        for body in doc.get("data", {}).values():
+            groups.extend(yaml.safe_load(body).get("groups", []))
+    return groups
+
+
+def lint_rules(fams: "list[tuple[str, str, str]] | None" = None,
+               groups: "list[dict] | None" = None) -> "list[str]":
+    """Recording/alerting rules vs the real families: every k3stpu_*
+    metric an expr references must be a linted family (histograms via
+    _bucket/_sum/_count) or another rule's recorded output."""
+    problems = []
+    fams = _all_families() if fams is None else fams
+    known = set()
+    for name, mtype, _ in fams:
+        if mtype == "histogram":
+            known.update(name + s for s in ("_bucket", "_sum", "_count"))
+        else:
+            known.add(name)
+    if groups is None:
+        groups = _rule_groups_from_chart()
+    if not groups:
+        return ["rules: chart rendered no rule groups — the rules "
+                "template or this lint's render drifted"]
+    recorded = {r["record"] for g in groups for r in g.get("rules", [])
+                if "record" in r}
+    for g in groups:
+        gname = g.get("name", "?")
+        for r in g.get("rules", []):
+            rname = r.get("record") or r.get("alert") or "?"
+            where = f"rule {gname}/{rname}"
+            expr = str(r.get("expr", ""))
+            if not expr.strip():
+                problems.append(f"{where}: empty expr")
+                continue
+            if "record" in r and ":" not in r["record"]:
+                problems.append(f"{where}: recording-rule name must use "
+                                f"the level:metric:operation convention")
+            for tok in set(RULE_METRIC_RE.findall(expr)):
+                if tok not in known and tok not in recorded:
+                    problems.append(
+                        f"{where}: references '{tok}' which is neither "
+                        f"a linted family nor a recorded rule")
+    return problems
+
+
 def main() -> int:
-    problems = lint()
+    problems = lint() + lint_rules()
     if problems:
         for p in problems:
             print(f"metrics-lint: {p}")
         return 1
-    fams = _families_from_obs() + _families_from_server()
-    print(f"metrics-lint: {len(fams)} families clean")
+    fams = _all_families()
+    groups = _rule_groups_from_chart()
+    rules = sum(len(g.get("rules", [])) for g in groups)
+    print(f"metrics-lint: {len(fams)} families, {rules} rules clean")
     return 0
 
 

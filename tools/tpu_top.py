@@ -1,0 +1,182 @@
+#!/usr/bin/env python
+"""tpu-top: one cluster-wide TPU table from N node-exporter endpoints.
+
+The reference README verifies a cluster by running `nvidia-smi` in a pod
+and eyeballing the table; the fleet-scale analogue here scrapes every
+node's k3stpu node exporter (obs/node_exporter.py, chart DaemonSet with
+a hostPort) and renders one table: node health, chip count vs expected,
+per-chip HBM/duty from the merged per-process telemetry, drop-file
+staleness. Stdlib only — it runs from a laptop with nothing but the
+node IPs.
+
+    python tools/tpu_top.py http://node-a:8478 http://node-b:8478
+    python tools/tpu_top.py --watch 5 $(kubectl get nodes -o \\
+        jsonpath='{range .items[*]}http://{.status.addresses[0].address}:8478 {end}')
+
+An unreachable endpoint renders as its own row (health `unreachable`)
+instead of killing the sweep — a down exporter is exactly the node you
+want visible. Exit code 0 when every node is healthy, 1 otherwise
+(scriptable: a cron wrapper can page on it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+SERIES_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$')
+LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+
+
+def parse_families(text: str) -> "dict[str, list[tuple[dict, float]]]":
+    """Exposition text -> name -> [(labels, value)]. Scalar parse only
+    (gauges/counters); the exporter's families are all scalar series.
+    The histogram read side lives in obs/hist.py — this is its untyped
+    sibling for gauge sweeps."""
+    out: "dict[str, list[tuple[dict, float]]]" = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        m = SERIES_RE.match(line.strip())
+        if not m:
+            continue
+        name, labels_raw, val = m.groups()
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        labels = dict(LABEL_RE.findall(labels_raw or ""))
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def fetch(endpoint: str, timeout: float = 5.0
+          ) -> "dict[str, list[tuple[dict, float]]] | None":
+    url = endpoint.rstrip("/") + "/metrics"
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return parse_families(r.read().decode())
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def _scalar(fams, name, default=None):
+    series = fams.get(name) or []
+    return series[0][1] if series else default
+
+
+def node_row(endpoint: str, fams) -> dict:
+    """One node's table row (pure — tests feed parsed text straight in).
+    ``fams=None`` (fetch failed) -> an `unreachable` placeholder row."""
+    name = re.sub(r"^https?://", "", endpoint).rstrip("/")
+    if fams is None:
+        return {"node": name, "health": "unreachable", "chips": None,
+                "expected": None, "drop_files": None, "max_age_s": None,
+                "stale_files": None, "devices": []}
+    health = "unknown"
+    for labels, v in fams.get("k3stpu_node_tpu_health_state", []):
+        if v:
+            health = labels.get("state", "unknown")
+    used = {d["chip"]: v for d, v in
+            fams.get("k3stpu_node_chip_hbm_used_bytes", [])}
+    limit = {d["chip"]: v for d, v in
+             fams.get("k3stpu_node_chip_hbm_limit_bytes", [])}
+    duty = {d["chip"]: v for d, v in
+            fams.get("k3stpu_node_chip_duty_cycle_pct", [])}
+    ages = [v for _, v in fams.get("k3stpu_node_drop_file_age_seconds", [])]
+    stale = sum(int(v) for _, v in
+                fams.get("k3stpu_node_drop_file_stale", []))
+    devices = []
+    for chip in sorted(set(used) | set(limit) | set(duty),
+                       key=lambda c: (len(c), c)):
+        devices.append({"chip": chip, "used": used.get(chip),
+                        "limit": limit.get(chip), "duty": duty.get(chip)})
+    return {
+        "node": name,
+        "health": health,
+        "chips": _scalar(fams, "k3stpu_node_chips"),
+        "expected": _scalar(fams, "k3stpu_node_chips_expected"),
+        "drop_files": _scalar(fams, "k3stpu_node_drop_files"),
+        "max_age_s": max(ages) if ages else None,
+        "stale_files": stale,
+        "devices": devices,
+    }
+
+
+def _gib(v) -> str:
+    return "n/a" if v is None else f"{v / 2**30:.1f}"
+
+
+def _pct(v) -> str:
+    return "n/a" if v is None else f"{int(v)}%"
+
+
+def render_table(rows: "list[dict]") -> str:
+    """The cluster table: one node line, then one line per chip the
+    node's workloads report on (a chip in sysfs with no telemetry is
+    visible as the CHIPS count exceeding the chip lines)."""
+    hdr = (f"{'NODE':<28} {'HEALTH':<16} {'CHIPS':>5} "
+           f"{'HBM GiB':>12} {'UTIL':>5} {'DROPS':>5} {'AGE s':>7}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        chips = ("n/a" if r["chips"] is None else
+                 f"{int(r['chips'])}/{int(r['expected'] or r['chips'])}")
+        tot_used = sum(d["used"] for d in r["devices"]
+                       if d["used"] is not None)
+        tot_limit = sum(d["limit"] for d in r["devices"]
+                        if d["limit"] is not None)
+        hbm = (f"{_gib(tot_used)}/{_gib(tot_limit)}"
+               if r["devices"] else "n/a")
+        duties = [d["duty"] for d in r["devices"] if d["duty"] is not None]
+        util = _pct(max(duties)) if duties else "n/a"
+        drops = ("n/a" if r["drop_files"] is None
+                 else str(int(r["drop_files"]))
+                 + (f"({r['stale_files']}!)" if r["stale_files"] else ""))
+        age = ("n/a" if r["max_age_s"] is None
+               else f"{r['max_age_s']:.1f}")
+        lines.append(f"{r['node']:<28} {r['health']:<16} {chips:>5} "
+                     f"{hbm:>12} {util:>5} {drops:>5} {age:>7}")
+        for d in r["devices"]:
+            lines.append(f"  chip {d['chip']:<4} "
+                         f"{_gib(d['used'])}/{_gib(d['limit'])} GiB"
+                         f"  util {_pct(d['duty'])}")
+    return "\n".join(lines)
+
+
+def sweep(endpoints: "list[str]", timeout: float = 5.0) -> "list[dict]":
+    return [node_row(ep, fetch(ep, timeout)) for ep in endpoints]
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Cluster-wide TPU table from k3stpu node exporters")
+    ap.add_argument("endpoints", nargs="+",
+                    help="node exporter base URLs (http://node:8478)")
+    ap.add_argument("--watch", type=float, default=0,
+                    help="refresh every N seconds (0 = render once)")
+    ap.add_argument("--timeout", type=float, default=5.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the rows as one JSON line instead of "
+                         "the table (machine consumers)")
+    args = ap.parse_args(argv)
+
+    while True:
+        rows = sweep(args.endpoints, args.timeout)
+        if args.json:
+            print(json.dumps(rows), flush=True)
+        else:
+            print(render_table(rows), flush=True)
+        if not args.watch:
+            break
+        time.sleep(args.watch)
+    return 0 if all(r["health"] == "healthy" for r in rows) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
