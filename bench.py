@@ -18,6 +18,12 @@ the <5% budget from ISSUE 2, vs_baseline = overhead/5.
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
 
+``--trace-obs`` gates the distributed-tracing layer (same contract):
+decode tokens/s with the full W3C edge path per request (traceparent
+parse, trace-id propagation into the engine, exemplar-bearing
+OpenMetrics scrape, echo mint) vs trace-id-free submits; <=5% budget
+on the paired-arms --train-obs idiom, vs_baseline = overhead/5.
+
 ``--node-obs`` gates the fleet tier (same contract, no jax at all):
 CPU cost of one node-exporter /metrics render over a synthetic 4-chip
 sysfs + 8 drop files, as percent of one core at a 1 Hz scrape; <=5%
@@ -589,6 +595,154 @@ def _train_obs_main() -> int:
                  **skw)
 
 
+def _trace_obs_worker() -> int:
+    """Trace-propagation + exemplar overhead microbench (bounded
+    subprocess).
+
+    ISSUE 7's budget: the W3C trace-context path must cost <=5% of
+    decode throughput. Both arms run the SAME engine with the SAME
+    ServeObs — the delta is ONLY the new tracing surface. The traced
+    arm pays, per request, exactly what a real edge request pays:
+    mint+parse an inbound traceparent, thread the id through
+    submit() into the engine's ReqTrace, exemplar stores on every
+    histogram observe, and an outbound echo mint; plus one
+    exemplar-bearing OpenMetrics render per run (a concurrent scrape).
+    The untraced arm submits id-free and renders the default
+    exposition. Paired rounds with a median-of-ratios headline (the
+    --train-obs idiom): host-load drift moves tokens/s far more than
+    the ~µs id cost, pairing arms back-to-back cancels drift slower
+    than a round, and the median survives a throttled round."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+
+    import numpy as np
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+    from k3stpu.obs import (
+        ServeObs,
+        format_traceparent,
+        new_span_id,
+        new_trace_id,
+        parse_traceparent,
+    )
+    from k3stpu.serve.engine import GenerateEngine
+
+    max_seq, slots = 128, 8
+    n_reqs, prompt_len, new_tokens = 16, 8, 24
+
+    model = transformer_lm_tiny(max_seq_len=max_seq)
+    params = model.init(jax.random.key(0),
+                        np.zeros((1, 1), np.int32))["params"]
+
+    obs = ServeObs()
+    engine = GenerateEngine(model, params, slots=slots, seed=0, obs=obs)
+
+    def drive(traced: bool) -> float:
+        engine.reset_stats()
+        results = [None] * n_reqs
+
+        def go(i):
+            prompt = [((i * 7 + j) % 97) + 1 for j in range(prompt_len)]
+            tid = None
+            if traced:
+                header = format_traceparent(new_trace_id(), new_span_id())
+                tid = parse_traceparent(header)[0]
+            results[i] = engine.submit([prompt],
+                                       max_new_tokens=new_tokens,
+                                       trace_id=tid)
+            if traced:
+                format_traceparent(tid, new_span_id())  # response echo
+
+        threads = [threading.Thread(target=go, args=(i,))
+                   for i in range(n_reqs)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if not all(r is not None and len(r[0]) == new_tokens
+                   for r in results):
+            raise RuntimeError("a request failed or came back short")
+        if traced:
+            obs.render_openmetrics()
+        else:
+            obs.render_prometheus()
+        return engine.stats()["tokens_per_s"] or 0.0
+
+    try:
+        engine.submit([[1, 2, 3]], max_new_tokens=4)  # warm compiles
+        drive(False)  # throwaway: steady-state warmup
+        rounds = 5
+        ratios, pairs = [], []
+        for _ in range(rounds):
+            off = drive(False)
+            on = drive(True)
+            ratios.append(on / off if off else 1.0)
+            pairs.append((round(off, 1), round(on, 1)))
+    finally:
+        engine.close()
+
+    overhead = (1.0 - sorted(ratios)[rounds // 2]) * 100.0
+    doc = {
+        # Headline: median decode tokens/s lost to trace propagation +
+        # exemplars, in percent. The bar is 5%; vs_baseline =
+        # overhead/5 so <=1.0 means within budget (negative just means
+        # run-to-run noise exceeded the true overhead).
+        "metric": "trace_obs_overhead_pct",
+        "value": round(overhead, 2),
+        "unit": "pct_decode_tokens_per_s",
+        "vs_baseline": round(overhead / 5.0, 4),
+        "detail": {
+            "budget_pct": 5.0,
+            "paired_tokens_per_s_off_on": pairs,
+            "per_round_overhead_pct":
+                [round((1.0 - r) * 100.0, 2) for r in ratios],
+            "rounds": rounds,
+            "requests_per_run": n_reqs,
+            "new_tokens_per_request": new_tokens,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _trace_obs_main() -> int:
+    """Bounded-subprocess wrapper for --trace-obs (same wedge-proof
+    discipline as the other CPU benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__), "--trace-obs-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="trace_obs")
+    skw = {"metric": "trace_obs_overhead_pct",
+           "unit": "pct_decode_tokens_per_s"}
+    if not ok:
+        why = (f"trace obs bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("trace_obs", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _node_obs_worker() -> int:
     """Node-exporter scrape-cost microbench (bounded subprocess, no jax).
 
@@ -789,6 +943,10 @@ if __name__ == "__main__":
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:
         sys.exit(_train_obs_main())
+    if "--trace-obs-worker" in sys.argv[1:]:
+        sys.exit(_trace_obs_worker())
+    if "--trace-obs" in sys.argv[1:]:
+        sys.exit(_trace_obs_main())
     if "--node-obs-worker" in sys.argv[1:]:
         sys.exit(_node_obs_worker())
     if "--node-obs" in sys.argv[1:]:

@@ -19,10 +19,21 @@ from .hist import (  # noqa: F401  (re-exported for tests/loadgen)
     TPOT_BUCKETS_S,
     Gauge,
     Histogram,
+    InfoGauge,
+    build_info_gauge,
     parse_prometheus_histograms,
+    prometheus_text_to_openmetrics,
     quantile_from_buckets,
 )
-from .trace import MAX_EVENTS_PER_TRACE, ReqTrace, TraceBuffer  # noqa: F401
+from .trace import (  # noqa: F401  (re-exported for server/loadgen)
+    MAX_EVENTS_PER_TRACE,
+    ReqTrace,
+    TraceBuffer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
 
 # Batch-occupancy-at-dispatch: active rows per decode dispatch. Slots
 # today cap at small powers of two; 64 headroom for pod configs.
@@ -60,26 +71,31 @@ class ServeObs:
             "k3stpu_engine_pages_free",
             "Free KV pages in the paged allocator, sampled by the loop.",
             value=-1)  # -1 = engine not running in paged mode
+        self.build_info = build_info_gauge("serve")
 
     # -- engine hooks (loop / submitter threads) ---------------------------
 
-    def start_trace(self, **meta) -> "ReqTrace | None":
+    def start_trace(self, trace_id: "str | None" = None,
+                    **meta) -> "ReqTrace | None":
         if not self.enabled:
             return None
-        return self.traces.start(**meta)
+        return self.traces.start(trace_id=trace_id, **meta)
 
     def on_admit(self, tr: "ReqTrace | None", queue_wait_s: float,
                  **attrs) -> None:
         if not self.enabled:
             return
-        self.queue_wait.observe(queue_wait_s)
+        # Exemplars only for requests that arrived with an edge-minted
+        # trace id — lazily minting one here would attach ids nothing
+        # else (client output, response headers) can join on.
+        self.queue_wait.observe(queue_wait_s, trace_id=_ex_id(tr))
         if tr is not None:
             tr.t_admit = tr.event("admit", attrs or None)
 
     def on_first_token(self, tr: "ReqTrace | None", ttft_s: float) -> None:
         if not self.enabled:
             return
-        self.ttft.observe(ttft_s)
+        self.ttft.observe(ttft_s, trace_id=_ex_id(tr))
         if tr is not None:
             tr.t_first = tr.event("first_token")
 
@@ -96,9 +112,10 @@ class ServeObs:
                     tpot_s: "float | None") -> None:
         if not self.enabled:
             return
-        self.e2e.observe(e2e_s)
+        ex = _ex_id(tr)
+        self.e2e.observe(e2e_s, trace_id=ex)
         if tpot_s is not None:
-            self.tpot.observe(tpot_s)
+            self.tpot.observe(tpot_s, trace_id=ex)
         if tr is not None:
             tr.finish("ok")
 
@@ -117,6 +134,17 @@ class ServeObs:
         parts = [h.render() for h in self.histograms()]
         parts.append(self.queue_depth.render())
         parts.append(self.pages_free.render())
+        parts.append(self.build_info.render())
+        return "\n".join(parts)
+
+    def render_openmetrics(self) -> str:
+        """Same families in OpenMetrics exposition, histogram buckets
+        carrying trace-id exemplars. No ``# EOF`` — the server appends
+        it once after concatenating all parts."""
+        parts = [h.render_openmetrics() for h in self.histograms()]
+        parts.append(self.queue_depth.render())
+        parts.append(self.pages_free.render())
+        parts.append(self.build_info.render())
         return "\n".join(parts)
 
     def timelines(self, n: "int | None" = None) -> "list[dict]":
@@ -130,3 +158,11 @@ class ServeObs:
             h.reset()
         self.queue_depth.set(0.0)
         self.traces.reset()
+
+
+def _ex_id(tr: "ReqTrace | None") -> "str | None":
+    """Trace id for an exemplar — only if the request already carries
+    one (edge-assigned); never force a lazy mint from the hot path."""
+    if tr is None:
+        return None
+    return tr._trace_id

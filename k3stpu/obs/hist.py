@@ -22,6 +22,7 @@ client/server skew visible (loadgen.py does exactly that).
 from __future__ import annotations
 
 import threading
+import time
 from bisect import bisect_left
 
 # Shared default bucket ladders (seconds). Wide on purpose: one ladder
@@ -38,6 +39,43 @@ TPOT_BUCKETS_S = (
 )
 
 _NAME_HELP_TYPE = "# HELP {n} {h}\n# TYPE {n} {t}"
+
+# OpenMetrics bounds an exemplar's label set (names + values) at 128
+# runes; ours is a single 32-hex trace id, but the renderer enforces the
+# spec limit anyway so a future label can't silently break scrapers.
+OPENMETRICS_EXEMPLAR_MAX_RUNES = 128
+
+
+class InfoGauge:
+    """A constant-1 gauge with a FIXED label set — the Prometheus
+    ``build_info`` convention (``k3stpu_build_info{version=...,
+    component=...} 1``). Labels are pinned at construction: the value
+    never changes and the cardinality is exactly one series, so joins
+    like ``foo * on() group_left(version) k3stpu_build_info`` stay
+    cheap."""
+
+    __slots__ = ("name", "help", "labels")
+
+    def __init__(self, name: str, help_text: str, labels: "dict[str, str]"):
+        self.name = name
+        self.help = help_text
+        self.labels = dict(labels)
+
+    def render(self) -> str:
+        head = _NAME_HELP_TYPE.format(n=self.name, h=self.help, t="gauge")
+        pairs = ",".join(f'{k}="{v}"' for k, v in sorted(self.labels.items()))
+        return f"{head}\n{self.name}{{{pairs}}} 1"
+
+
+def build_info_gauge(component: str) -> InfoGauge:
+    """The shared ``k3stpu_build_info`` family every metric server in
+    the stack (serve, train rank-0, node exporter) exposes, telling one
+    scrape apart from another by version and role."""
+    from k3stpu import __version__
+    return InfoGauge(
+        "k3stpu_build_info",
+        "Constant-1 build/version info gauge (standard convention)",
+        {"version": __version__, "component": component})
 
 
 class Gauge:
@@ -173,7 +211,8 @@ class Histogram:
     at render — observe() then touches exactly one cell, not a prefix.
     """
 
-    __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_lock")
+    __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_lock",
+                 "_exemplars")
 
     def __init__(self, name: str, help_text: str,
                  bounds: "tuple[float, ...]" = LATENCY_BUCKETS_S):
@@ -185,17 +224,30 @@ class Histogram:
         self.bounds = tuple(float(b) for b in bounds)
         self._counts = [0] * (len(bounds) + 1)  # [+Inf] is the last cell
         self._sum = 0.0
+        # Per-bucket last exemplar: (trace_id, value, wall ts) or None.
+        # Last-write-wins keeps it O(1) memory and lock-cheap; the point
+        # of an exemplar is "A recent trace that landed here", not all.
+        self._exemplars: "list[tuple[str, float, float] | None]" = \
+            [None] * (len(bounds) + 1)
         self._lock = threading.Lock()
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: "str | None" = None) -> None:
         i = bisect_left(self.bounds, value)
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += value
+        if trace_id is None:
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += value
+        else:
+            ex = (trace_id, value, time.time())
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += value
+                self._exemplars[i] = ex
 
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * len(self._counts)
+            self._exemplars = [None] * len(self._exemplars)
             self._sum = 0.0
 
     def snapshot(self) -> "tuple[list[int], float, int]":
@@ -229,6 +281,68 @@ class Histogram:
         lines.append(f"{self.name}_sum {_fmt(total_sum)}")
         lines.append(f"{self.name}_count {total}")
         return "\n".join(lines)
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics exposition of the same triple, with each bucket
+        line carrying the trace-id exemplar of a recent observation that
+        landed in that (non-cumulative) bucket — the Grafana "jump from
+        this latency spike straight to the trace" hook. Only ``_bucket``
+        lines get exemplars, per spec."""
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+            exemplars = list(self._exemplars)
+        cum, running = [], 0
+        for c in counts:
+            running += c
+            cum.append(running)
+        total = running
+        lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
+                                        t="histogram")]
+        edges = [_fmt(b) for b in self.bounds] + ["+Inf"]
+        for le, c, ex in zip(edges, cum, exemplars):
+            line = f'{self.name}_bucket{{le="{le}"}} {c}'
+            if ex is not None:
+                line += format_exemplar(*ex)
+            lines.append(line)
+        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count {total}")
+        return "\n".join(lines)
+
+
+def format_exemplar(trace_id: str, value: float, ts: float) -> str:
+    """The `` # {trace_id="..."} value timestamp`` suffix OpenMetrics
+    appends to a bucket line. Returns "" (drops the exemplar, keeps the
+    sample) if the label set would exceed the spec's 128-rune budget —
+    a malformed exemplar poisons the whole scrape, a missing one
+    doesn't."""
+    if len("trace_id") + len(trace_id) > OPENMETRICS_EXEMPLAR_MAX_RUNES:
+        return ""
+    return f' # {{trace_id="{trace_id}"}} {_fmt(value)} {_fmt_ts(ts)}'
+
+
+def _fmt_ts(ts: float) -> str:
+    return f"{ts:.3f}"
+
+
+def prometheus_text_to_openmetrics(text: str) -> str:
+    """Rewrite plain Prometheus exposition into OpenMetrics-valid text
+    (minus the trailing ``# EOF``, which the caller appends once per
+    exposition). The one systematic difference for our families:
+    OpenMetrics names a counter family WITHOUT the ``_total`` suffix in
+    HELP/TYPE lines while sample lines keep it; gauges and histograms
+    pass through unchanged."""
+    out = []
+    for line in text.splitlines():
+        for prefix in ("# HELP ", "# TYPE "):
+            if line.startswith(prefix):
+                rest = line[len(prefix):]
+                name, _, tail = rest.partition(" ")
+                if name.endswith("_total"):
+                    line = f"{prefix}{name[:-len('_total')]} {tail}"
+                break
+        out.append(line)
+    return "\n".join(out)
 
 
 def _fmt(v: float) -> str:

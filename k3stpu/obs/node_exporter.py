@@ -67,7 +67,7 @@ import sys
 import threading
 import time
 
-from k3stpu.obs.hist import Counter, Gauge, LabeledGauge
+from k3stpu.obs.hist import Counter, Gauge, LabeledGauge, build_info_gauge
 from k3stpu.utils import telemetry
 from k3stpu.utils.chips import enumerate_chips
 
@@ -270,6 +270,7 @@ class NodeCollector:
             "k3stpu_node_collect_seconds",
             "Wall seconds the last collect pass spent reading sysfs "
             "and drop files.")
+        self.build_info = build_info_gauge("node-exporter")
 
     def families(self) -> list:
         """Render order; also the lint's scan surface (metrics_lint
@@ -278,7 +279,7 @@ class NodeCollector:
                 self.chips_expected, self.hbm_used, self.hbm_limit,
                 self.duty, self.drop_files, self.drop_age,
                 self.drop_stale, self.drop_parse_errors, self.drop_gc,
-                self.collect_seconds]
+                self.collect_seconds, self.build_info]
 
     def collect(self, now: "float | None" = None) -> "tuple[str, str]":
         now = time.time() if now is None else now

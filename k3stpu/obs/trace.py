@@ -22,6 +22,8 @@ Two read surfaces (server.py wires them to ``GET /debug/requests`` and
 
 from __future__ import annotations
 
+import os
+import string
 import threading
 import time
 from collections import deque
@@ -32,6 +34,67 @@ from collections import deque
 # not every one).
 MAX_EVENTS_PER_TRACE = 512
 
+# --- W3C trace-context (traceparent) -------------------------------------
+#
+# 00-{32 lowercase hex trace-id}-{16 lowercase hex span-id}-{2 hex flags}
+#
+# The trace id is the cross-process join key: loadgen mints one per
+# request, the server echoes it on every response and threads it into
+# the engine's ReqTrace, histograms attach it to OpenMetrics exemplars,
+# and tools/trace_merge.py keys merged timelines on it. Parsing is
+# strict ALLOW-LIST validation — anything that fails comes back None and
+# the server mints a fresh identity, so attacker-controlled header bytes
+# can never reach the engine or the exposition.
+
+# Spec headroom for future versions is bounded: anything longer is
+# rejected unparsed (oversized-header hardening).
+TRACEPARENT_MAX_LEN = 128
+
+_HEX = set(string.hexdigits.lower())
+
+
+def new_trace_id() -> str:
+    """128-bit random trace id, 32 lowercase hex chars."""
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    """64-bit random span id, 16 lowercase hex chars."""
+    return os.urandom(8).hex()
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def _hexfield(s: str, width: int) -> bool:
+    return (len(s) == width and set(s) <= _HEX
+            and s != "0" * width)
+
+
+def parse_traceparent(header) -> "tuple[str, str] | None":
+    """Validate a traceparent header; return (trace_id, parent_span_id)
+    or None. Strict: version ff and all-zero ids are invalid per spec,
+    uppercase hex is rejected (the spec mandates lowercase on the wire),
+    and version 00 allows no extra fields. Only validated lowercase-hex
+    strings ever leave this function."""
+    if not isinstance(header, str) or len(header) > TRACEPARENT_MAX_LEN:
+        return None
+    parts = header.strip().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or set(version) - _HEX or version == "ff":
+        return None
+    if version == "00" and len(parts) != 4:
+        return None
+    if not _hexfield(trace_id, 32) or not _hexfield(span_id, 16):
+        return None
+    if len(flags) != 2 or set(flags) - _HEX:
+        return None
+    return trace_id, span_id
+
 
 class ReqTrace:
     """One request's timeline. Mutated only by the owning request's
@@ -39,11 +102,14 @@ class ReqTrace:
     threads via TraceBuffer snapshots."""
 
     __slots__ = ("rid", "meta", "events", "dropped", "status", "error",
-                 "t_enqueue", "t_admit", "t_first", "t_done", "_buf")
+                 "t_enqueue", "t_admit", "t_first", "t_done", "_buf",
+                 "_trace_id")
 
-    def __init__(self, rid: int, meta: dict, buf: "TraceBuffer"):
+    def __init__(self, rid: int, meta: dict, buf: "TraceBuffer",
+                 trace_id: "str | None" = None):
         self.rid = rid
         self.meta = meta
+        self._trace_id = trace_id
         self.events: "list[tuple[float, str, dict | None]]" = []
         self.dropped = 0
         self.status = "live"
@@ -53,6 +119,17 @@ class ReqTrace:
         self.t_first: "float | None" = None
         self.t_done: "float | None" = None
         self._buf = buf
+
+    @property
+    def trace_id(self) -> str:
+        """W3C trace id. Inbound requests carry one from the edge;
+        anything else (training spans, direct engine submits) mints
+        lazily on first read so the hot path never pays urandom for an
+        id nobody will join on."""
+        tid = self._trace_id
+        if tid is None:
+            tid = self._trace_id = new_trace_id()
+        return tid
 
     def event(self, name: str, attrs: "dict | None" = None,
               t: "float | None" = None) -> float:
@@ -79,6 +156,7 @@ class ReqTrace:
         base = self._buf.wall_anchor()
         return {
             "rid": self.rid,
+            "trace_id": self.trace_id,
             "status": self.status,
             "error": self.error,
             **self.meta,
@@ -96,8 +174,9 @@ class TraceBuffer:
     completed ring. ``capacity`` bounds the ring; live traces are
     bounded by the engine's own admission limits."""
 
-    def __init__(self, capacity: int = 256):
+    def __init__(self, capacity: int = 256, component: str = "serve"):
         self.capacity = capacity
+        self.component = component  # identity stamp in chrome_trace()
         self._lock = threading.Lock()
         self._live: "dict[int, ReqTrace]" = {}
         self._done: "deque[ReqTrace]" = deque(maxlen=capacity)
@@ -110,11 +189,18 @@ class TraceBuffer:
     def wall_anchor(self) -> "tuple[float, float]":
         return self._t0_perf, 0.0  # timelines report ms since buffer start
 
-    def start(self, **meta) -> ReqTrace:
+    @property
+    def wall_t0_s(self) -> float:
+        """Wall-clock time (time.time epoch seconds) of exported ts=0.
+        trace_merge.py re-bases each process's Chrome trace onto this so
+        N independent exports align on one absolute timeline."""
+        return self._t0_wall
+
+    def start(self, trace_id: "str | None" = None, **meta) -> ReqTrace:
         with self._lock:
             rid = self._next_rid
             self._next_rid += 1
-            tr = ReqTrace(rid, meta, self)
+            tr = ReqTrace(rid, meta, self, trace_id=trace_id)
             self._live[rid] = tr
         tr.t_enqueue = tr.event("enqueue")
         return tr
@@ -149,12 +235,14 @@ class TraceBuffer:
         t0 = self._t0_perf
         us = lambda t: round((t - t0) * 1e6, 1)
         ev = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-               "args": {"name": "k3stpu-serve"}}]
+               "args": {"name": f"k3stpu-{self.component}"}}]
         for tr in self.snapshot():
             tid = tr.rid + 1  # tid 0 is the metadata row
+            trace_id = tr.trace_id
             ev.append({"ph": "M", "pid": 1, "tid": tid,
                        "name": "thread_name",
-                       "args": {"name": f"req {tr.rid}"}})
+                       "args": {"name": f"req {tr.rid}",
+                                "trace_id": trace_id}})
             spans = (
                 ("queue_wait", tr.t_enqueue, tr.t_admit),
                 ("prefill", tr.t_admit, tr.t_first),
@@ -165,9 +253,15 @@ class TraceBuffer:
                     ev.append({"ph": "X", "pid": 1, "tid": tid,
                                "name": name, "cat": "request",
                                "ts": us(a), "dur": round((b - a) * 1e6, 1),
-                               "args": {"rid": tr.rid}})
+                               "args": {"rid": tr.rid,
+                                        "trace_id": trace_id}})
             for t, name, attrs in list(tr.events):
                 ev.append({"ph": "i", "pid": 1, "tid": tid, "name": name,
                            "cat": "event", "s": "t", "ts": us(t),
                            "args": {**(attrs or {}), "rid": tr.rid}})
-        return {"traceEvents": ev, "displayTimeUnit": "ms"}
+        return {"traceEvents": ev, "displayTimeUnit": "ms",
+                # Cross-process alignment + identity for trace_merge.py:
+                # wall_t0_s is the wall-clock second corresponding to
+                # exported ts=0 (Perfetto ignores unknown keys).
+                "metadata": {"component": self.component,
+                             "wall_t0_s": round(self._t0_wall, 6)}}

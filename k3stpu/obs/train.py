@@ -46,7 +46,8 @@ import threading
 import time
 from contextlib import contextmanager
 
-from .hist import Counter, Gauge, Histogram, LabeledCounter
+from .hist import (Counter, Gauge, Histogram, LabeledCounter,
+                   build_info_gauge)
 from .trace import TraceBuffer
 
 # Every second of a training job's wall-clock lands in exactly one of
@@ -124,7 +125,9 @@ class TrainObs:
         self.process_id = process_id
         self._clock = clock
         self.goodput = GoodputAccountant(clock=clock)
-        self.traces = TraceBuffer(capacity=trace_capacity)
+        self.traces = TraceBuffer(capacity=trace_capacity,
+                                  component="train")
+        self.build_info = build_info_gauge("train")
         self.step_s = Histogram(
             "k3stpu_train_step_seconds",
             "Wall time of one train step (device run, data wait "
@@ -300,6 +303,7 @@ class TrainObs:
         parts += [c.render() for c in self.counters()]
         parts.append(self.goodput_seconds.render())
         parts.append(self.goodput_fraction.render())
+        parts.append(self.build_info.render())
         return "\n".join(parts) + "\n"
 
     def chrome_trace(self) -> dict:
@@ -310,8 +314,10 @@ class TrainObs:
         built from the same ring."""
         t0 = self.traces.wall_anchor()[0]
         us = lambda t: round((t - t0) * 1e6, 1)  # noqa: E731
+        pod = os.environ.get("POD_NAME") or os.environ.get("HOSTNAME", "")
         ev = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
-               "args": {"name": f"k3stpu-train p{self.process_id}"}}]
+               "args": {"name": f"k3stpu-train p{self.process_id}",
+                        "rank": self.process_id, "pod": pod}}]
         tids: "dict[str, int]" = {}
         for tr in self.traces.snapshot():
             kind = tr.meta.get("kind") or "span"
@@ -326,7 +332,13 @@ class TrainObs:
                 ev.append({"ph": "X", "pid": 1, "tid": tid, "name": kind,
                            "cat": "train", "ts": us(a),
                            "dur": round((b - a) * 1e6, 1), "args": args})
-        return {"traceEvents": ev, "displayTimeUnit": "ms"}
+        return {"traceEvents": ev, "displayTimeUnit": "ms",
+                # Rank/pod identity + the buffer's wall anchor, so
+                # trace_merge.py can align N ranks' exports on one
+                # absolute timeline and label each row.
+                "metadata": {"component": "train",
+                             "rank": self.process_id, "pod": pod,
+                             "wall_t0_s": round(self.traces.wall_t0_s, 6)}}
 
 
 def start_metrics_server(obs: TrainObs, port: int,

@@ -141,7 +141,7 @@ class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
                  "deadline", "stream_q", "_ptuple", "probe", "adapter",
-                 "trace")
+                 "trace", "trace_id")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None, adapter=0):
@@ -167,6 +167,11 @@ class _Request:
         # Lifecycle trace (k3stpu.obs.ReqTrace), set at enqueue when the
         # engine carries a ServeObs; None costs nothing on any path.
         self.trace = None
+        # W3C trace id (32 validated lowercase-hex chars) assigned at
+        # the HTTP edge; None for direct submits. Only parse_traceparent
+        # output ever lands here — raw header bytes never reach the
+        # engine.
+        self.trace_id: "str | None" = None
         # Memoized prompt-cache probe result (pkey, pentry) — the probe
         # re-runs every loop iteration while the request waits for free
         # slots, and re-scanning the cache each time is pure engine-
@@ -920,6 +925,7 @@ class GenerateEngine:
         from the moment the loop COULD have seen the request)."""
         if self._obs is not None:
             req.trace = self._obs.start_trace(
+                trace_id=req.trace_id,
                 rows=int(req.samples if req.samples > 1
                          else req.block.shape[0]),
                 prompt_len=int(max(req.lens)), budget=int(req.budget),
@@ -958,11 +964,12 @@ class GenerateEngine:
                temperature: float = 0.0, top_k: "int | None" = None,
                top_p: "float | None" = None,
                eos_id: "int | None" = None, adapter_id: int = 0,
-               timeout_s: float = 600.0,
-               admitted: bool = False) -> "list[list[int]]":
+               timeout_s: float = 600.0, admitted: bool = False,
+               trace_id: "str | None" = None) -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists.
         ``admitted``: the caller already holds an admission token
-        covering this submit (see take_admission_token)."""
+        covering this submit (see take_admission_token).
+        ``trace_id``: validated W3C trace id for the lifecycle trace."""
         if self._closed:
             raise RuntimeError("engine is closed")
         n = len(prompts)
@@ -971,6 +978,7 @@ class GenerateEngine:
         req = self._packed_request(prompts, max_new_tokens, temperature,
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
+        req.trace_id = trace_id
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
@@ -978,8 +986,8 @@ class GenerateEngine:
                        top_k: "int | None" = None,
                        top_p: "float | None" = None,
                        eos_id: "int | None" = None, adapter_id: int = 0,
-                       timeout_s: float = 600.0,
-                       admitted: bool = False) -> "list[list[int]]":
+                       timeout_s: float = 600.0, admitted: bool = False,
+                       trace_id: "str | None" = None) -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
         prefill: the prefilled cache row broadcasts across n slots and the
         rows diverge through per-row sampling noise. (With temperature 0
@@ -991,6 +999,7 @@ class GenerateEngine:
         req = self._packed_request([prompt], max_new_tokens, temperature,
                                    top_k, eos_id, samples=n, top_p=top_p,
                                    adapter_id=adapter_id)
+        req.trace_id = trace_id
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_stream(self, prompts: "list[list[int]]", *,
@@ -998,7 +1007,8 @@ class GenerateEngine:
                       top_k: "int | None" = None,
                       top_p: "float | None" = None,
                       eos_id: "int | None" = None, adapter_id: int = 0,
-                      timeout_s: float = 600.0, admitted: bool = False):
+                      timeout_s: float = 600.0, admitted: bool = False,
+                      trace_id: "str | None" = None):
         """Streaming submit(): returns an iterator of events.
 
         Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
@@ -1019,6 +1029,7 @@ class GenerateEngine:
         req = self._packed_request(prompts, max_new_tokens, temperature,
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
+        req.trace_id = trace_id
         req.stream_q = queue.SimpleQueue()
         return self._stream_events(req, timeout_s, admitted)
 

@@ -29,6 +29,8 @@ import urllib.error
 
 import numpy as np
 
+from k3stpu.obs import (TraceBuffer, format_traceparent, new_span_id,
+                        new_trace_id)
 
 _MAX_ERRORS_PER_CLIENT = 10
 
@@ -39,6 +41,46 @@ _MAX_ERRORS_PER_CLIENT = 10
 _MAX_RETRIES_503 = 8
 _BACKOFF_BASE_S = 0.05
 _BACKOFF_CAP_S = 2.0
+
+
+class ClientTraces:
+    """Client-side half of the distributed trace, shared by all client
+    threads. Every logical request mints a W3C trace id (kept stable
+    across its 503 retries — the whole backoff chain correlates to ONE
+    id on the server), gets client-side spans in a ``TraceBuffer``
+    (exported as a Chrome trace for trace_merge.py), and leaves a
+    ``rid``↔trace-id record — failures marked — so a bad load-test
+    request can be looked up directly in the server's /debug/trace."""
+
+    def __init__(self, capacity: int = 4096):
+        self.buf = TraceBuffer(capacity=capacity, component="client")
+        self._records: "list[dict]" = []
+        self._lock = threading.Lock()
+
+    def start(self, trace_id: str):
+        return self.buf.start(trace_id=trace_id)
+
+    def finish(self, tr, ok: bool, latency_s: "float | None",
+               ttft_s: "float | None", attempts: int,
+               error: "str | None" = None) -> None:
+        rec = {"rid": tr.rid, "trace_id": tr.trace_id, "ok": ok,
+               "attempts": attempts}
+        if latency_s is not None:
+            rec["latency_ms"] = round(latency_s * 1e3, 3)
+        if ttft_s is not None:
+            rec["ttft_ms"] = round(ttft_s * 1e3, 3)
+        if error is not None:
+            rec["error"] = error
+        with self._lock:
+            self._records.append(rec)
+        tr.finish("ok" if ok else "error", error)
+
+    def records(self) -> "list[dict]":
+        with self._lock:
+            return list(self._records)
+
+    def chrome_trace(self) -> dict:
+        return self.buf.chrome_trace()
 
 
 def _gen_prompt(rows: int) -> "list[int]":
@@ -54,7 +96,8 @@ def _gen_prompt(rows: int) -> "list[int]":
 def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                  latencies: list, lock: "threading.Lock", errors: list,
                  route: str = "/v1/predict", ttfts: "list | None" = None,
-                 retry_stats: "dict | None" = None, seed: int = 0):
+                 retry_stats: "dict | None" = None, seed: int = 0,
+                 traces: "ClientTraces | None" = None):
     """``ttfts`` non-None switches to SSE consumption: the request body
     carries ``"stream": true`` and the client records time-to-first-token
     (first ``data:`` frame) alongside the full-response latency — the
@@ -65,19 +108,41 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
     ``lock``) turns on 503 retries: backoff honoring Retry-After, capped
     exponential otherwise, jittered by a per-client ``seed`` RNG so the
     retry schedule is deterministic per client but never in lockstep
-    across clients."""
+    across clients.
+
+    Every logical request carries a ``traceparent``: one trace id for
+    its whole life (503 retries INCLUDED — each retry is a new span id
+    under the same trace, so the server-side 503 echoes and the final
+    success all correlate), recorded in ``traces`` when given."""
     import urllib.request
 
     rng = random.Random(seed)
     attempt = 0  # consecutive 503s on the CURRENT request
     my_errors = 0
+    trace_id = None
+    tr = None
+    t_first_try = None
+
+    def _finish(ok, latency_s, ttft_s, error=None):
+        if tr is not None:
+            traces.finish(tr, ok, latency_s, ttft_s, attempt + 1,
+                          error=error)
+
     while not stop.is_set():
+        if trace_id is None:  # new logical request, not a 503 retry
+            trace_id = new_trace_id()
+            tr = traces.start(trace_id) if traces is not None else None
+            t_first_try = time.perf_counter()
         req = urllib.request.Request(
             url + route, data=payload,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": "application/json",
+                     "traceparent": format_traceparent(trace_id,
+                                                       new_span_id())})
         t0 = time.perf_counter()
         try:
             with urllib.request.urlopen(req, timeout=300) as r:
+                if tr is not None:
+                    tr.t_admit = tr.event("response_headers")
                 if ttfts is None:
                     json.loads(r.read())
                     ttft = None
@@ -89,6 +154,8 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                             continue
                         if ttft is None:
                             ttft = time.perf_counter() - t0
+                            if tr is not None:
+                                tr.t_first = tr.event("first_token")
                         last = json.loads(line[6:])
                     # A truncated stream (no done frame) is a failure
                     # too — counting it as success would understate
@@ -111,12 +178,18 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                                 max(ra, _BACKOFF_BASE_S * 2 ** attempt))
                     with lock:
                         retry_stats["retries"] += 1
+                    if tr is not None:
+                        tr.event("retry_503", {"attempt": attempt,
+                                               "backoff_s": round(sleep, 3)})
                     stop.wait(sleep * (0.5 + rng.random()))
                     continue  # does NOT count toward _MAX_ERRORS_PER_CLIENT
                 with lock:
                     retry_stats["gave_up"] += 1
                 e = RuntimeError(
                     f"503 persisted through {_MAX_RETRIES_503} retries: {e}")
+            _finish(False, time.perf_counter() - t_first_try, None,
+                    error=str(e))
+            trace_id = tr = None
             attempt = 0
             with lock:
                 errors.append(str(e))
@@ -124,17 +197,21 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
             if my_errors >= _MAX_ERRORS_PER_CLIENT:
                 return  # persistently failing client stops; others continue
             continue
+        latency = time.perf_counter() - t0
+        _finish(True, latency, ttft)
+        trace_id = tr = None
         attempt = 0
         my_errors = 0  # consecutive-failure counter: success resets it
         with lock:
-            latencies.append(time.perf_counter() - t0)
+            latencies.append(latency)
             if ttft is not None:
                 ttfts.append(ttft)
 
 
 def run_load(url: str, *, clients: int, seconds: float, rows: int,
              input_shape: "tuple[int, ...]", input_dtype: str,
-             generate_tokens: int = 0, stream: bool = False) -> dict:
+             generate_tokens: int = 0, stream: bool = False,
+             traces: "ClientTraces | None" = None) -> dict:
     """``generate_tokens > 0`` switches to /v1/generate load (each request
     one ragged prompt, ``generate_tokens`` new tokens) — the decode-loop
     workload the continuous-batching engine schedules. ``stream`` rides
@@ -166,7 +243,8 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     stop = threading.Event()
     threads = [threading.Thread(
         target=_client_loop, args=(url, payload, stop, latencies, lock,
-                                   errors, route, ttfts, retry_stats, i),
+                                   errors, route, ttfts, retry_stats, i,
+                                   traces),
         daemon=True)
         for i in range(clients)]
     t0 = time.perf_counter()
@@ -314,6 +392,15 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--kv-pages", type=int, default=None,
                     help="pool size for --kv-page-size (default: full "
                          "dense capacity)")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the full result plus a per-request "
+                         "rid<->trace-id table (failures marked) to this "
+                         "file; a failed request's trace_id can be looked "
+                         "up directly in the server's /debug/trace")
+    ap.add_argument("--trace-out", default=None, metavar="PATH",
+                    help="write the CLIENT-side Chrome trace (one tid per "
+                         "request, wall-anchored) to this file; merge with "
+                         "the server's /debug/trace via tools/trace_merge.py")
     args = ap.parse_args(argv)
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
@@ -382,11 +469,13 @@ def main(argv: "list[str] | None" = None) -> int:
     with urllib.request.urlopen(card_url, timeout=60) as r:
         card = json.loads(r.read())
 
+    traces = ClientTraces()
     result = run_load(
         url, clients=args.clients, seconds=args.seconds, rows=args.rows,
         input_shape=tuple(card["input_shape"]),
         input_dtype=card["input_dtype"],
-        generate_tokens=args.generate_tokens, stream=args.stream)
+        generate_tokens=args.generate_tokens, stream=args.stream,
+        traces=traces)
 
     # Server-side histogram quantiles from the same run (best-effort:
     # an older server without the obs layer just yields none).
@@ -408,6 +497,18 @@ def main(argv: "list[str] | None" = None) -> int:
         "engine": card.get("engine"),
         "devices": card["devices"][:1],
     })
+    if args.json:
+        records = traces.records()
+        with open(args.json, "w") as f:
+            json.dump({"summary": result, "requests": records}, f,
+                      indent=1)
+        failed = sum(1 for r in records if not r["ok"])
+        print(f"wrote {args.json}: {len(records)} requests "
+              f"({failed} failed)", flush=True)
+    if args.trace_out:
+        with open(args.trace_out, "w") as f:
+            json.dump(traces.chrome_trace(), f)
+        print(f"wrote client trace {args.trace_out}", flush=True)
     _print_quantile_skew(result)
     if result["retries_503"] or result["gave_up_503"]:
         print(f"503 backoff: {result['retries_503']} retried, "

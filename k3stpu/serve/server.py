@@ -53,7 +53,9 @@ import numpy as np
 
 from urllib.parse import parse_qs, urlparse
 
-from k3stpu.obs import ServeObs
+from k3stpu.obs import (ServeObs, format_traceparent, new_span_id,
+                        new_trace_id, parse_traceparent,
+                        prometheus_text_to_openmetrics)
 
 BATCH_SIZES = (1, 8, 32)
 
@@ -863,7 +865,8 @@ class InferenceServer:
                         top_p: "float | None" = None,
                         eos_id: "int | None" = None,
                         num_samples: int = 1,
-                        adapter: "str | None" = None) -> "list[list[int]]":
+                        adapter: "str | None" = None,
+                        trace_id: "str | None" = None) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
@@ -913,7 +916,8 @@ class InferenceServer:
                     out.extend(self._engine.submit_samples(
                         prompts[0], k, max_new_tokens=gen_budget,
                         temperature=temperature, top_k=top_k, top_p=top_p,
-                        eos_id=eos_id, adapter_id=aid, admitted=True))
+                        eos_id=eos_id, adapter_id=aid, admitted=True,
+                        trace_id=trace_id))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -969,7 +973,7 @@ class InferenceServer:
                 self._spec_stats["accepted"] += spec["accepted"]
             # Engine-less path: the server IS the request lifecycle, so
             # e2e is observed here (engine paths record inside the loop).
-            self._obs.e2e.observe(dt)
+            self._obs.e2e.observe(dt, trace_id=trace_id)
             return out.tolist()
 
         if self._engine is not None:
@@ -988,7 +992,7 @@ class InferenceServer:
                         prompts[ofs:ofs + self._engine.slots],
                         max_new_tokens=gen_budget, temperature=temperature,
                         top_k=top_k, top_p=top_p, eos_id=eos_id,
-                        adapter_id=aid, admitted=True))
+                        adapter_id=aid, admitted=True, trace_id=trace_id))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1035,7 +1039,8 @@ class InferenceServer:
             self._stats["gen_examples"] += n
             self._stats["tokens"] += int(out.size)
             self._stats["gen_seconds"] += dt
-        self._obs.e2e.observe(dt)  # engine-less: see the spec path note
+        # engine-less: see the spec path note
+        self._obs.e2e.observe(dt, trace_id=trace_id)
         return out.tolist()
 
     def _spec_eligible(self, width: int, gen_budget: int,
@@ -1054,7 +1059,8 @@ class InferenceServer:
                         top_p: "float | None" = None,
                         eos_id: "int | None" = None,
                         num_samples: int = 1,
-                        adapter: "str | None" = None):
+                        adapter: "str | None" = None,
+                        trace_id: "str | None" = None):
         """Streaming generate: an iterator of JSON-able events for the
         SSE route. Engine-backed requests yield per-decode-block deltas
         ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
@@ -1080,7 +1086,8 @@ class InferenceServer:
             tokens = self.generate_tokens(
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, num_samples=num_samples, adapter=adapter)
+                eos_id=eos_id, num_samples=num_samples, adapter=adapter,
+                trace_id=trace_id)
             return iter([{"done": True, "tokens": tokens}])
         # Engine route only, AFTER the routing decisions (a spec/fallback
         # request never touches the admission counter, so it must not be
@@ -1095,10 +1102,11 @@ class InferenceServer:
         self._engine.reject_if_at_capacity()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
-            top_p, eos_id, aid)
+            top_p, eos_id, aid, trace_id)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
-                              temperature, top_k, top_p, eos_id, aid=0):
+                              temperature, top_k, top_p, eos_id, aid=0,
+                              trace_id=None):
         """Engine-backed streaming (args pre-sanitized). The admission
         token is taken HERE, on the generator's first next(), so a
         generator that is created but never iterated cannot leak the
@@ -1114,7 +1122,7 @@ class InferenceServer:
         try:
             yield from self._stream_engine_chunks(
                 prompts, max_new_tokens, gen_budget, temperature, top_k,
-                top_p, eos_id, aid, out)
+                top_p, eos_id, aid, out, trace_id)
         finally:
             self._engine.release_admission_token()
         dt = time.perf_counter() - t0
@@ -1127,14 +1135,15 @@ class InferenceServer:
 
     def _stream_engine_chunks(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid,
-                              out):
+                              out, trace_id=None):
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
             events = self._engine.submit_stream(
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
-                eos_id=eos_id, adapter_id=aid, admitted=True)
+                eos_id=eos_id, adapter_id=aid, admitted=True,
+                trace_id=trace_id)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -1197,6 +1206,12 @@ class InferenceServer:
         surface (a ServiceMonitor against the Service port replaces
         reading /v1/models by hand). Counters and distributions only;
         rates and quantiles are the scraper's job."""
+        return (self._counter_exposition()
+                + self._obs.render_prometheus() + "\n")
+
+    def _counter_exposition(self) -> str:
+        """The hand-rendered (non-obs) counter/gauge families, shared by
+        the plain and OpenMetrics render paths."""
         with self._stats_lock:
             s = dict(self._stats)
         lines: "list[str]" = []
@@ -1300,8 +1315,18 @@ class InferenceServer:
             emit(lines, "k3stpu_spec_accepted_total", "counter",
                  "Draft tokens accepted by the target model.",
                  sp["accepted"])
-        return "\n".join(lines) + "\n" + self._obs.render_prometheus() \
-            + "\n"
+        return "\n".join(lines) + "\n"
+
+    def openmetrics(self) -> str:
+        """OpenMetrics exposition of the same families, served when the
+        scraper content-negotiates for it (Accept:
+        application/openmetrics-text). The extra value over the plain
+        format: histogram bucket lines carry trace-id exemplars, so a
+        latency spike links straight to its request trace. The default
+        (no Accept header) scrape keeps the plain text/plain format
+        byte-for-byte — old scrapers never see exemplar syntax."""
+        return (prometheus_text_to_openmetrics(self._counter_exposition())
+                + self._obs.render_openmetrics() + "\n# EOF\n")
 
     def debug_timelines(self, n: int = 50) -> dict:
         """Last n request timelines (completed ring + live), newest
@@ -1407,12 +1432,40 @@ def make_app(server: InferenceServer):
     from k3stpu.serve.engine import EngineOverloaded
 
     class Handler(BaseHTTPRequestHandler):
+        # W3C trace context for the CURRENT request: (trace_id,
+        # parent_span_id | None). Set per request at the top of do_POST;
+        # the class default keeps GET paths (which never set it) safe.
+        _trace_ctx: "tuple[str, str | None] | None" = None
+
+        def _begin_trace(self) -> None:
+            """Accept the inbound traceparent or mint a fresh identity.
+            parse_traceparent is a strict allow-list: malformed or
+            oversized headers yield None and the request proceeds under
+            a new id — raw header bytes never travel further than this
+            line."""
+            parsed = parse_traceparent(self.headers.get("traceparent"))
+            self._trace_ctx = parsed if parsed is not None \
+                else (new_trace_id(), None)
+
+        def _trace_id(self) -> "str | None":
+            return self._trace_ctx[0] if self._trace_ctx else None
+
+        def _trace_headers(self) -> None:
+            """Echo the request's trace id (with a server-minted span id)
+            on the in-flight response — EVERY response, 503s and
+            timeouts included, so a shed or failed request is still
+            joinable against /debug/trace and the client's own log."""
+            if self._trace_ctx is not None:
+                self.send_header("traceparent", format_traceparent(
+                    self._trace_ctx[0], new_span_id()))
+
         def _send(self, code: int, payload: dict,
                   headers: "dict | None" = None):
             body = json.dumps(payload).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            self._trace_headers()
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1433,6 +1486,7 @@ def make_app(server: InferenceServer):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
+            self._trace_headers()
             self.end_headers()
             chaos = server._chaos
             try:
@@ -1483,10 +1537,20 @@ def make_app(server: InferenceServer):
             elif self.path == "/v1/models":
                 self._send(200, server.model_card())
             elif self.path == "/metrics":
-                body = server.prometheus_metrics().encode()
+                # Content negotiation: exemplars are OpenMetrics-only
+                # syntax, so they appear ONLY when the scraper asks for
+                # that format. The default exposition stays byte-
+                # identical to the pre-exemplar format.
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    body = server.openmetrics().encode()
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    body = server.prometheus_metrics().encode()
+                    ctype = "text/plain; version=0.0.4"
                 self.send_response(200)
-                self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -1504,6 +1568,9 @@ def make_app(server: InferenceServer):
                 self._send(404, {"error": f"no route {self.path}"})
 
         def do_POST(self):
+            # Trace identity first: even a drain-window 503 must echo a
+            # traceparent so the client can correlate the retry chain.
+            self._begin_trace()
             if self.path.startswith("/v1/"):
                 if server.draining:
                     # Drain window: in-flight requests finish, new work is
@@ -1565,11 +1632,13 @@ def make_app(server: InferenceServer):
                         adapter=req.get("adapter"))
                     if req.get("stream"):
                         events = server.generate_stream(
-                            req["prompt_tokens"], **kwargs)
+                            req["prompt_tokens"],
+                            trace_id=self._trace_id(), **kwargs)
                         self._send_sse(events)
                         return
                     tokens = server.generate_tokens(
-                        req["prompt_tokens"], **kwargs)
+                        req["prompt_tokens"],
+                        trace_id=self._trace_id(), **kwargs)
                     self._send(200, {"tokens": tokens})
                 except (KeyError, ValueError, TypeError, OverflowError,
                         json.JSONDecodeError) as e:

@@ -22,12 +22,14 @@
 #            the round gate; smoke exists so intermediate commits keep a
 #            fast green signal as the suite's wall time grows. Paged-KV
 #            exactness, the serving observability layer (histograms,
-#            request traces, /debug endpoints), the chaos/containment
-#            suite (fault injection + recovery invariants), and the
-#            training-resilience suite (SIGTERM checkpointing, quarantine,
-#            retention, bounded rendezvous), and the fleet tier (node
-#            exporter, health labeling, tpu_top) ride along minus their
-#            @slow soak/bench tests (the full suite runs those).
+#            request traces, /debug endpoints), distributed tracing
+#            (traceparent propagation, exemplars, trace_merge), the
+#            chaos/containment suite (fault injection + recovery
+#            invariants), and the training-resilience suite (SIGTERM
+#            checkpointing, quarantine, retention, bounded rendezvous),
+#            and the fleet tier (node exporter, health labeling,
+#            tpu_top) ride along minus their @slow soak/bench tests
+#            (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -36,6 +38,68 @@ case "${XLA_FLAGS:-}" in
   *xla_force_host_platform_device_count*) ;;
   *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8";;
 esac
+
+# The smoke set, as an array so the registry guard below can check it.
+SMOKE=(
+  tests/test_chart.py tests/test_chart_lint.py tests/test_manifests.py
+  tests/test_plugin_config.py tests/test_chips.py tests/test_discovery.py
+  tests/test_container_runtime.py tests/test_device_plugin.py
+  tests/test_e2e_assets.py
+  tests/test_bench.py tests/test_graft_entry.py
+  tests/test_paged.py tests/test_obs.py tests/test_trace.py
+  tests/test_chaos.py tests/test_train_resilience.py
+  tests/test_train_obs.py tests/test_metrics_lint.py
+  tests/test_node_obs.py
+)
+
+# Full-suite-only files: every test file must be EITHER in SMOKE or
+# listed here with a reason — a new test_*.py that is in neither fails
+# the gate, so coverage can't silently rot out of the per-commit
+# signal. "Heavy" means XLA compiles or long soaks that would blow the
+# ~2 min smoke budget.
+FULL_ONLY=(
+  tests/test_attention.py        # heavy: XLA kernel compiles
+  tests/test_attn_roofline.py    # heavy: roofline sweep
+  tests/test_checkpoint.py       # heavy: orbax round-trips
+  tests/test_context.py          # heavy: long-context compiles
+  tests/test_data.py             # covered transitively by train tests
+  tests/test_distributed.py      # heavy: multi-process rendezvous
+  tests/test_engine.py           # heavy: engine loop compiles
+  tests/test_generate.py         # heavy: decode-path compiles
+  tests/test_integration.py      # heavy: end-to-end train+serve
+  tests/test_lora.py             # heavy: adapter training
+  tests/test_moe.py              # heavy: MoE compiles
+  tests/test_multi_lora.py       # heavy: multi-adapter serving
+  tests/test_parallel.py         # heavy: 8-device mesh programs
+  tests/test_pipeline.py         # heavy: pipeline-parallel compiles
+  tests/test_prompt_cache.py     # heavy: prefill compiles
+  tests/test_properties.py       # heavy: hypothesis sweeps
+  tests/test_quant.py            # heavy: quantized compiles
+  tests/test_resnet.py           # heavy: conv compiles
+  tests/test_sanitize.py         # covered by serve smoke surface
+  tests/test_serve.py            # heavy: server + model compiles
+  tests/test_share_proof.py      # heavy: sharing-proof compiles
+  tests/test_speculative.py      # heavy: draft+target compiles
+  tests/test_stream.py           # heavy: SSE + engine compiles
+  tests/test_tpu_info.py         # fleet tier, no fast assertions left out
+  tests/test_train_job.py        # heavy: train-loop compiles
+  tests/test_transformer.py      # heavy: model compiles
+)
+
+# Registry guard: refuse to run if any test file is unregistered.
+# (Runs for BOTH smoke and full invocations — the full suite globs
+# everything anyway, but the guard is about keeping the smoke registry
+# an explicit, reviewed decision rather than an omission.)
+for f in tests/test_*.py; do
+  registered=no
+  for s in "${SMOKE[@]}" "${FULL_ONLY[@]}"; do
+    [ "$s" = "$f" ] && registered=yes && break
+  done
+  if [ "$registered" = no ]; then
+    echo "run_suite: $f is neither in SMOKE nor FULL_ONLY — register it" >&2
+    exit 2
+  fi
+done
 
 # Wedge forensics: if any single test exceeds this, pytest's builtin
 # faulthandler dumps EVERY thread's stack before the outer timeout kills
@@ -46,16 +110,7 @@ FAULTHANDLER="-o faulthandler_timeout=${FAULTHANDLER_TIMEOUT:-600}"
 
 if [ "${1:-}" = "--smoke" ]; then
   shift
-  exec python -m pytest -q $FAULTHANDLER \
-    tests/test_chart.py tests/test_chart_lint.py tests/test_manifests.py \
-    tests/test_plugin_config.py tests/test_chips.py tests/test_discovery.py \
-    tests/test_container_runtime.py tests/test_device_plugin.py \
-    tests/test_e2e_assets.py \
-    tests/test_bench.py tests/test_graft_entry.py \
-    tests/test_paged.py tests/test_obs.py \
-    tests/test_chaos.py tests/test_train_resilience.py \
-    tests/test_train_obs.py tests/test_metrics_lint.py \
-    tests/test_node_obs.py -m "not slow" "$@"
+  exec python -m pytest -q $FAULTHANDLER "${SMOKE[@]}" -m "not slow" "$@"
 fi
 
 # Split point chosen to balance wall time (model/parallel files are the

@@ -452,10 +452,10 @@ def _get(url, timeout=30):
         return e.code, dict(e.headers), e.read()
 
 
-def _post(url, body, timeout=120):
+def _post(url, body, timeout=120, headers=None):
     req = urllib.request.Request(
         url, data=json.dumps(body).encode(),
-        headers={"Content-Type": "application/json"})
+        headers={"Content-Type": "application/json", **(headers or {})})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, dict(r.headers), json.loads(r.read())
@@ -499,6 +499,14 @@ def test_http_breaker_flips_healthz_and_recovers():
         code, headers, _ = _post(url + "/v1/generate", gen)
         assert code == 503
         assert float(headers["Retry-After"]) >= 1
+        # A rejected request still echoes its trace context — the
+        # client's retry chain stays correlated across the 503s.
+        tid = "ab" * 16
+        code, headers, _ = _post(
+            url + "/v1/generate", gen,
+            headers={"traceparent": f"00-{tid}-{'cd' * 8}-01"})
+        assert code == 503
+        assert headers["traceparent"].split("-")[1] == tid
         # Liveness stays green: an open breaker must NOT crash-loop the
         # pod (restart would not fix a poisoned backend faster).
         assert _get(url + "/livez")[0] == 200
@@ -589,11 +597,17 @@ def test_sigterm_drain_finishes_inflight_rejects_new_exits_in_deadline():
         time.sleep(1.0)
         proc.send_signal(signal.SIGTERM)
         time.sleep(0.3)  # let the drain flag land
-        # New work is rejected while the stream drains...
-        code, _, body = _post(f"http://127.0.0.1:{port}/v1/generate",
-                              {"prompt_tokens": [[4, 5]],
-                               "max_new_tokens": 2}, timeout=30)
+        # New work is rejected while the stream drains... (and the
+        # drain-503 still echoes the caller's trace id, so a retrying
+        # client correlates the rejection with its request)
+        drain_tid = "ef" * 16
+        code, headers, body = _post(
+            f"http://127.0.0.1:{port}/v1/generate",
+            {"prompt_tokens": [[4, 5]], "max_new_tokens": 2},
+            timeout=30,
+            headers={"traceparent": f"00-{drain_tid}-{'12' * 8}-01"})
         assert code == 503, body
+        assert headers["traceparent"].split("-")[1] == drain_tid
         # ...and readiness drops so the endpoint leaves the Service.
         assert _get(f"http://127.0.0.1:{port}/healthz")[0] == 503
         t.join(timeout=120)

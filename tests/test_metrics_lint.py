@@ -129,6 +129,65 @@ def test_rules_lint_fails_on_empty_render():
                for p in metrics_lint.lint_rules(groups=[]))
 
 
+def test_build_info_duplicate_is_exempt():
+    # Three metric servers each declare k3stpu_build_info (distinct
+    # component labels); the duplicate rule must not fire on it, but
+    # must still fire on any other repeated name.
+    fams = _pad([("k3stpu_build_info", "gauge", "Build info."),
+                 ("k3stpu_build_info", "gauge", "Build info."),
+                 ("k3stpu_twice_total", "counter", "Dup."),
+                 ("k3stpu_twice_total", "counter", "Dup.")])
+    problems = "\n".join(_check(fams))
+    assert "k3stpu_build_info (gauge): duplicate" not in problems
+    assert "k3stpu_twice_total (counter): duplicate" in problems
+
+
+def test_repo_label_keys_are_bounded():
+    problems = metrics_lint.lint_label_keys()
+    assert problems == [], "\n".join(problems)
+
+
+def test_label_key_lint_rejects_unbounded_key():
+    problems = "\n".join(metrics_lint.lint_label_keys(
+        [("k3stpu_ok", ("bucket",)),
+         ("k3stpu_bad", ("trace_id",))]))
+    assert "k3stpu_bad" in problems and "trace_id" in problems
+    assert "k3stpu_ok" not in problems
+    # And an empty scan fails loudly, same as the family lint.
+    assert any("no labeled families" in p
+               for p in metrics_lint.lint_label_keys([]))
+
+
+def test_repo_openmetrics_exposition_is_clean():
+    problems = metrics_lint.lint_openmetrics(
+        metrics_lint._live_openmetrics())
+    assert problems == [], "\n".join(problems)
+
+
+def test_openmetrics_lint_rejects_violations():
+    long_id = "a" * 140
+    bad = (
+        "# TYPE k3stpu_x_seconds histogram\n"
+        'k3stpu_x_seconds_sum 1.0 # {trace_id="abcd"} 1.0 1.000\n'
+        'k3stpu_x_seconds_bucket{le="+Inf"} 1 '
+        f'# {{trace_id="{long_id}"}} 1.0 1.000\n'
+    )  # also: no # EOF terminator
+    problems = "\n".join(metrics_lint.lint_openmetrics(bad))
+    assert "exemplar on a non-bucket/non-count sample line" in problems
+    assert "runes" in problems
+    assert "# EOF" in problems
+    # The same content made well-formed passes.
+    ok = (
+        "# TYPE k3stpu_x_seconds histogram\n"
+        'k3stpu_x_seconds_bucket{le="+Inf"} 1 '
+        '# {trace_id="abcd"} 1.0 1.000\n'
+        "k3stpu_x_seconds_sum 1.0\n"
+        "k3stpu_x_seconds_count 1\n"
+        "# EOF\n"
+    )
+    assert metrics_lint.lint_openmetrics(ok) == []
+
+
 def test_cli_gate_runs_clean():
     import subprocess
     import sys as _sys

@@ -1,0 +1,403 @@
+"""Distributed tracing: W3C trace-context propagation end to end.
+
+The contract under test (ISSUE 7): one trace id, minted at the edge
+(loadgen or the server itself), survives every hop — the traceparent
+echo on the HTTP response, the engine's /debug/trace timeline, the
+TTFT exemplar on the OpenMetrics scrape, and the client-side Chrome
+trace — and tools/trace_merge.py can stitch those exports into a
+single wall-clock-aligned Perfetto timeline. The default /metrics
+exposition stays byte-identical to the pre-exemplar format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from k3stpu.obs.trace import (
+    TRACEPARENT_MAX_LEN,
+    ReqTrace,
+    TraceBuffer,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import trace_merge  # noqa: E402
+
+
+# --- traceparent parse/format units --------------------------------------
+
+
+def test_traceparent_roundtrip():
+    tid, sid = new_trace_id(), new_span_id()
+    assert len(tid) == 32 and len(sid) == 16
+    header = format_traceparent(tid, sid)
+    assert header == f"00-{tid}-{sid}-01"
+    assert parse_traceparent(header) == (tid, sid)
+    assert format_traceparent(tid, sid, sampled=False).endswith("-00")
+
+
+def test_trace_ids_are_random():
+    assert new_trace_id() != new_trace_id()
+    assert new_span_id() != new_span_id()
+
+
+@pytest.mark.parametrize("header", [
+    "",
+    None,
+    123,
+    "00-abc-def-01",                                   # short fields
+    "00-" + "g" * 32 + "-" + "1" * 16 + "-01",         # non-hex
+    "00-" + "A" * 32 + "-" + "1" * 16 + "-01",         # uppercase
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",         # all-zero trace
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",         # all-zero span
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",         # version ff
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-01-extra",   # v00 extra field
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-0g",         # bad flags
+    "00-" + "1" * 32 + "-" + "2" * 16,                 # missing flags
+    "x" * (TRACEPARENT_MAX_LEN + 1),                   # oversized
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-01" + "-x" * 50,  # oversized v00
+])
+def test_traceparent_rejects_malformed(header):
+    assert parse_traceparent(header) is None
+
+
+def test_traceparent_accepts_future_version_with_extra_fields():
+    tid, sid = "1" * 32, "2" * 16
+    assert parse_traceparent(f"cc-{tid}-{sid}-01-future-stuff") \
+        == (tid, sid)
+
+
+# --- lazy minting + export identity --------------------------------------
+
+
+def test_reqtrace_mints_lazily_and_keeps_edge_id():
+    buf = TraceBuffer()
+    tr = buf.start()
+    assert tr._trace_id is None  # no urandom paid yet
+    tid = tr.trace_id
+    assert len(tid) == 32 and tr.trace_id == tid  # stable once minted
+
+    edge = new_trace_id()
+    tr2 = buf.start(trace_id=edge)
+    assert tr2._trace_id == edge and tr2.trace_id == edge
+    assert tr2.to_dict()["trace_id"] == edge
+
+
+def test_chrome_trace_carries_identity_and_wall_anchor():
+    buf = TraceBuffer(component="client")
+    tid = new_trace_id()
+    tr = buf.start(trace_id=tid)
+    tr.t_admit = tr.event("admit")
+    tr.t_first = tr.event("first")
+    tr.finish("ok")
+    doc = buf.chrome_trace()
+    md = doc["metadata"]
+    assert md["component"] == "client"
+    assert abs(md["wall_t0_s"] - buf.wall_t0_s) < 1e-3
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e.get("name") == "process_name"}
+    assert names == {"k3stpu-client"}
+    rows = [e for e in doc["traceEvents"] if e.get("name") == "thread_name"]
+    assert any(e["args"].get("trace_id") == tid for e in rows)
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert spans and all(e["args"]["trace_id"] == tid for e in spans)
+
+
+# --- exemplar rendering ---------------------------------------------------
+
+
+def test_histogram_exemplar_on_buckets_only():
+    from k3stpu.obs.hist import Histogram, format_exemplar
+
+    h = Histogram("k3stpu_t_seconds", "T.", (0.1, 1.0))
+    tid = new_trace_id()
+    h.observe(0.05, trace_id=tid)
+    h.observe(5.0)  # no trace id -> that bucket gets no exemplar
+    om = h.render_openmetrics()
+    ex_lines = [ln for ln in om.splitlines() if " # {" in ln]
+    assert ex_lines and all("_bucket{" in ln for ln in ex_lines)
+    assert all(f'trace_id="{tid}"' in ln for ln in ex_lines)
+    # The default exposition never grows exemplar syntax.
+    assert " # {" not in h.render()
+    # Over the spec's 128-rune label cap the exemplar is dropped whole.
+    assert format_exemplar("a" * 140, 1.0, 1.0) == ""
+
+
+def test_serveobs_exemplars_only_for_edge_assigned_ids():
+    from k3stpu.obs import ServeObs
+
+    obs = ServeObs()
+    edge = new_trace_id()
+    tr = obs.start_trace(trace_id=edge)
+    obs.on_first_token(tr, 0.01)
+    untraced = obs.start_trace()  # no edge id -> no exemplar, no mint
+    obs.on_first_token(untraced, 0.02)
+    assert untraced._trace_id is None
+    om = obs.render_openmetrics()
+    assert om.count(f'trace_id="{edge}"') >= 1
+
+
+# --- trace_merge ----------------------------------------------------------
+
+
+def _assert_chrome_trace(doc):
+    """The merged artifact must load as ONE valid Chrome trace."""
+    assert isinstance(doc, dict)
+    ev = doc["traceEvents"]
+    assert isinstance(ev, list) and ev
+    for e in ev:
+        assert e["ph"] in ("M", "X", "i")
+        assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+        assert isinstance(e["name"], str)
+        if e["ph"] != "M":
+            assert isinstance(e["ts"], (int, float))
+        if e["ph"] == "X":
+            assert e["dur"] >= 0
+    json.loads(json.dumps(doc))  # round-trips as a single document
+
+
+def _train_export(rank, skew_s):
+    buf = TraceBuffer(component="train")
+    tr = buf.start(op="train_step")
+    tr.t_admit = tr.event("step")
+    tr.finish("ok")
+    doc = buf.chrome_trace()
+    doc["metadata"].update(rank=rank, pod=f"pod-{rank}",
+                           wall_t0_s=doc["metadata"]["wall_t0_s"] + skew_s)
+    return doc
+
+
+def test_trace_merge_training_two_ranks(tmp_path):
+    paths = []
+    for rank in range(2):
+        p = tmp_path / f"rank{rank}.json"
+        p.write_text(json.dumps(_train_export(rank, skew_s=rank * 0.25)))
+        paths.append(str(p))
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main(["-o", out] + paths) == 0
+
+    merged = json.loads(open(out).read())
+    _assert_chrome_trace(merged)
+    assert merged["metadata"]["mode"] == "training"  # auto-sniffed
+    # One process row per rank, named with the rank/pod identity.
+    rows = {e["args"]["name"] for e in merged["traceEvents"]
+            if e.get("name") == "process_name"}
+    assert rows == {"train rank 0 (pod-0)", "train rank 1 (pod-1)"}
+    # Rank 1's anchor skew moved its events +250ms on the shared clock.
+    t = {pid: min(e["ts"] for e in merged["traceEvents"]
+                  if e["pid"] == pid and e["ph"] != "M")
+         for pid in (1, 2)}
+    assert 200_000 < t[2] - t[1] < 10_000_000
+
+
+def test_trace_merge_serving_joins_client_and_server(tmp_path):
+    tid = new_trace_id()
+    docs = []
+    for component in ("client", "serve"):
+        buf = TraceBuffer(component=component)
+        tr = buf.start(trace_id=tid)
+        tr.t_admit = tr.event("admit")
+        tr.t_first = tr.event("first")
+        tr.finish("ok")
+        docs.append(buf.chrome_trace())
+    paths = []
+    for i, doc in enumerate(docs):
+        p = tmp_path / f"src{i}.json"
+        p.write_text(json.dumps(doc))
+        paths.append(str(p))
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main(["-o", out] + paths) == 0
+
+    merged = json.loads(open(out).read())
+    _assert_chrome_trace(merged)
+    assert merged["metadata"]["mode"] == "serving"
+    assert merged["metadata"]["trace_rows"] == 1
+    # Both processes' spans landed on the single per-trace-id row,
+    # tagged with their source component.
+    spans = [e for e in merged["traceEvents"] if e["ph"] == "X"]
+    assert {e["tid"] for e in spans} == {1}
+    assert {e["args"]["src"] for e in spans} == {"client", "serve"}
+    rows = [e for e in merged["traceEvents"]
+            if e.get("name") == "thread_name"]
+    assert any(e["args"].get("trace_id") == tid for e in rows)
+
+
+def test_trace_merge_rejects_non_trace_input(tmp_path, capsys):
+    p = tmp_path / "bogus.json"
+    p.write_text(json.dumps({"not": "a trace"}))
+    assert trace_merge.main(
+        ["-o", str(tmp_path / "out.json"), str(p)]) == 1
+    assert "no traceEvents" in capsys.readouterr().err
+
+
+# --- live server: the E2E contract ---------------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_server():
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                             continuous_batching=True)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(server))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    yield f"http://127.0.0.1:{httpd.server_address[1]}"
+    httpd.shutdown()
+    server.close()
+
+
+def _get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+def _post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=120) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+GEN = {"prompt_tokens": [[1, 2, 3]], "max_new_tokens": 3}
+
+
+def test_e2e_one_trace_id_across_three_surfaces(engine_server):
+    """The acceptance path: the id a client mints shows up in (1) the
+    response echo, (2) the server's /debug/trace timeline, and (3) a
+    TTFT exemplar on the OpenMetrics scrape."""
+    tid, sid = new_trace_id(), new_span_id()
+    code, headers, _ = _post(engine_server + "/v1/generate", GEN,
+                             headers={"traceparent":
+                                      format_traceparent(tid, sid)})
+    assert code == 200
+
+    # (1) echo: same trace id, a FRESH server-side span id.
+    echo = parse_traceparent(headers["traceparent"])
+    assert echo is not None and echo[0] == tid and echo[1] != sid
+
+    # (2) the engine's timeline carries the edge id.
+    _, _, body = _get(engine_server + "/debug/trace")
+    trace = json.loads(body)
+    ids = {e["args"].get("trace_id") for e in trace["traceEvents"]
+           if e.get("name") == "thread_name"}
+    assert tid in ids
+
+    # (3) the TTFT exemplar on the negotiated OpenMetrics scrape.
+    _, h, om = _get(engine_server + "/metrics",
+                    headers={"Accept": "application/openmetrics-text"})
+    assert h["Content-Type"].startswith("application/openmetrics-text")
+    assert om.rstrip().endswith("# EOF")
+    ttft_ex = [ln for ln in om.splitlines()
+               if ln.startswith("k3stpu_request_ttft_seconds_bucket")
+               and f'trace_id="{tid}"' in ln]
+    assert ttft_ex, "TTFT exemplar with the edge trace id missing"
+
+
+def test_server_mints_when_no_header(engine_server):
+    code, headers, _ = _post(engine_server + "/v1/generate", GEN)
+    assert code == 200
+    echo = parse_traceparent(headers["traceparent"])
+    assert echo is not None  # fresh, valid identity
+
+
+@pytest.mark.parametrize("bad", [
+    "garbage",
+    "00-" + "Z" * 32 + "-" + "1" * 16 + "-01",
+    "00-" + "0" * 32 + "-" + "0" * 16 + "-01",
+    "y" * 300,  # oversized
+])
+def test_malformed_header_served_with_fresh_id(engine_server, bad):
+    """A bad traceparent is IGNORED: the request is served, a fresh id
+    is minted for the echo, and the raw header bytes never surface in
+    the debug timeline (they never reached the engine)."""
+    code, headers, _ = _post(engine_server + "/v1/generate", GEN,
+                             headers={"traceparent": bad})
+    assert code == 200
+    echo = parse_traceparent(headers["traceparent"])
+    assert echo is not None and echo[0] not in bad
+    _, _, body = _get(engine_server + "/debug/trace")
+    assert bad not in body
+
+
+def test_default_metrics_format_unchanged(engine_server):
+    """No Accept negotiation -> the pre-exemplar text format, byte
+    compatible: v0.0.4 content type, no exemplar syntax, no EOF."""
+    _post(engine_server + "/v1/generate", GEN,
+          headers={"traceparent":
+                   format_traceparent(new_trace_id(), new_span_id())})
+    _, h, text = _get(engine_server + "/metrics")
+    assert h["Content-Type"] == "text/plain; version=0.0.4"
+    assert " # {" not in text
+    assert "# EOF" not in text
+    assert "k3stpu_build_info{" in text  # new gauge, old syntax
+
+
+def test_loadgen_json_and_merged_timeline(engine_server, tmp_path):
+    """loadgen --json / --trace-out against a live server, then the
+    client trace merged with the live /debug/trace endpoint: every
+    surviving request's trace id appears in all three artifacts and the
+    merged file is one valid Chrome trace."""
+    from k3stpu.serve import loadgen
+
+    json_p = str(tmp_path / "load.json")
+    trace_p = str(tmp_path / "client.json")
+    rc = loadgen.main(["--url", engine_server, "--model",
+                       "transformer-tiny", "--clients", "2",
+                       "--seconds", "1.5", "--generate-tokens", "3",
+                       "--json", json_p, "--trace-out", trace_p])
+    assert rc == 0
+
+    doc = json.loads(open(json_p).read())
+    recs = doc["requests"]
+    assert recs and doc["summary"]["requests"] > 0
+    for r in recs:
+        assert set(r["trace_id"]) <= set("0123456789abcdef")
+        assert len(r["trace_id"]) == 32
+        assert isinstance(r["ok"], bool) and r["attempts"] >= 1
+    ok_ids = {r["trace_id"] for r in recs if r["ok"]}
+
+    # The same ids are on the server's timeline...
+    _, _, body = _get(engine_server + "/debug/trace")
+    server_ids = {e["args"].get("trace_id")
+                  for e in json.loads(body)["traceEvents"]
+                  if e.get("name") == "thread_name"}
+    # (the debug ring is bounded; every id the ring still holds from
+    # this run must be a loadgen id, and at least one must survive)
+    assert ok_ids & server_ids
+
+    # ...and in the client-side Chrome trace.
+    client = json.loads(open(trace_p).read())
+    assert client["metadata"]["component"] == "client"
+    client_ids = {e["args"].get("trace_id")
+                  for e in client["traceEvents"]
+                  if e.get("name") == "thread_name"}
+    assert ok_ids <= client_ids
+
+    # Merge the file with the LIVE endpoint: one valid Chrome trace,
+    # client and server spans joined on per-trace rows.
+    out = str(tmp_path / "merged.json")
+    assert trace_merge.main(
+        ["-o", out, trace_p, engine_server + "/debug/trace"]) == 0
+    merged = json.loads(open(out).read())
+    _assert_chrome_trace(merged)
+    assert merged["metadata"]["mode"] == "serving"
+    srcs = {e["args"]["src"] for e in merged["traceEvents"]
+            if e["ph"] == "X"}
+    assert srcs == {"client", "serve"}

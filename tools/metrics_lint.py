@@ -58,13 +58,38 @@ EMIT_RE = re.compile(
 RESERVED_HIST_SUFFIXES = ("_bucket", "_sum", "_count", "_total")
 UNITS = ("seconds", "bytes")
 
+# Families that legitimately appear in more than one exposition: every
+# metric server (serve, train rank-0, node exporter) declares its own
+# k3stpu_build_info with a distinct ``component`` label, so the same
+# name showing up three times in the scan is the design, not a clash.
+DUPLICATE_EXEMPT = {"k3stpu_build_info"}
+
+# Label keys whose value sets are bounded by construction: goodput
+# buckets and health states are fixed enums, chips/files are bounded by
+# the hardware inventory and live process count, version/component by
+# the build. A Labeled* family declaring any OTHER key (rid, trace_id,
+# pod, user...) is a cardinality bomb waiting for a dashboard, so the
+# lint rejects it until the key is reviewed and added here.
+BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
+                      "component", "version"}
+
+# OpenMetrics exemplar cap (spec): the combined length of the exemplar
+# label names and values must not exceed 128 UTF-8 characters.
+OPENMETRICS_EXEMPLAR_MAX_RUNES = 128
+
 
 def _families_from_obs() -> "list[tuple[str, str, str]]":
     """(name, type, help) for every family object hanging off the two
     facades — the constructors are the single source of truth, so a new
     family is linted the moment it exists."""
     from k3stpu.obs import ServeObs
-    from k3stpu.obs.hist import Counter, Gauge, Histogram, LabeledCounter
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+    )
     from k3stpu.obs.train import TrainObs
 
     fams = []
@@ -74,7 +99,7 @@ def _families_from_obs() -> "list[tuple[str, str, str]]":
                 fams.append((attr.name, "histogram", attr.help))
             elif isinstance(attr, (Counter, LabeledCounter)):
                 fams.append((attr.name, "counter", attr.help))
-            elif isinstance(attr, Gauge):
+            elif isinstance(attr, (Gauge, InfoGauge)):
                 fams.append((attr.name, "gauge", attr.help))
     return fams
 
@@ -92,6 +117,7 @@ def _families_from_node_exporter() -> "list[tuple[str, str, str]]":
         Counter,
         Gauge,
         Histogram,
+        InfoGauge,
         LabeledCounter,
         LabeledGauge,
     )
@@ -103,7 +129,7 @@ def _families_from_node_exporter() -> "list[tuple[str, str, str]]":
             fams.append((attr.name, "histogram", attr.help))
         elif isinstance(attr, (Counter, LabeledCounter)):
             fams.append((attr.name, "counter", attr.help))
-        elif isinstance(attr, (Gauge, LabeledGauge)):
+        elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
             fams.append((attr.name, "gauge", attr.help))
     return fams
 
@@ -124,7 +150,7 @@ def lint() -> "list[str]":
     seen: "dict[str, str]" = {}
     for name, mtype, help_text in fams:
         where = f"{name} ({mtype})"
-        if name in seen:
+        if name in seen and name not in DUPLICATE_EXEMPT:
             problems.append(f"{where}: duplicate family (also {seen[name]})")
         seen[name] = mtype
         if not name.startswith("k3stpu_"):
@@ -150,6 +176,88 @@ def lint() -> "list[str]":
                 if not ok:
                     problems.append(f"{where}: mentions unit '{unit}' "
                                     f"but is not suffixed _{unit}")
+    return problems
+
+
+def _labeled_families() -> "list[tuple[str, tuple]]":
+    """(family name, declared label keys) for every Labeled*/InfoGauge
+    family on the real facades — the cardinality lint's scan surface."""
+    from k3stpu.obs import ServeObs
+    from k3stpu.obs.hist import InfoGauge, LabeledCounter, LabeledGauge
+    from k3stpu.obs.node_exporter import NodeCollector
+    from k3stpu.obs.train import TrainObs
+
+    out = []
+    for owner in (ServeObs(), TrainObs(),
+                  NodeCollector(drop_dir="/nonexistent")):
+        for attr in vars(owner).values():
+            if isinstance(attr, (LabeledCounter, LabeledGauge)):
+                out.append((attr.name, (attr.label,)))
+            elif isinstance(attr, InfoGauge):
+                out.append((attr.name, tuple(sorted(attr.labels))))
+    return out
+
+
+def lint_label_keys(
+        labeled: "list[tuple[str, tuple]] | None" = None) -> "list[str]":
+    """Every labeled family must declare only label keys from the
+    bounded-cardinality allow-list."""
+    problems = []
+    labeled = _labeled_families() if labeled is None else labeled
+    if not labeled:
+        return ["label-keys: scan found no labeled families — the "
+                "collector drifted, not the metrics"]
+    for name, keys in labeled:
+        for key in keys:
+            if key not in BOUNDED_LABEL_KEYS:
+                problems.append(
+                    f"{name}: label key '{key}' is not in the "
+                    f"bounded-cardinality allow-list "
+                    f"({', '.join(sorted(BOUNDED_LABEL_KEYS))})")
+    return problems
+
+
+# One exposition sample line: name, optional {labels}, then the value
+# and optional timestamp/exemplar tail.
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(.*)$")
+# Exemplar tail: ` # {labelset} value [timestamp]`.
+_EXEMPLAR_RE = re.compile(r"\s#\s+(\{[^}]*\})\s+\S+(\s+\S+)?\s*$")
+
+
+def lint_openmetrics(text: str) -> "list[str]":
+    """Lint a rendered OpenMetrics exposition for exemplar-placement
+    and label-set-size violations:
+
+    - exemplars may only ride on ``_bucket`` / ``_count`` sample lines
+      (the spec allows histogram buckets and counters; gauges and
+      ``_sum`` lines never carry one);
+    - an exemplar label set stays within the spec's 128-rune cap
+      (combined length of label names and values);
+    - the exposition ends with the mandatory ``# EOF`` terminator.
+    """
+    problems = []
+    lines = text.splitlines()
+    if not lines or lines[-1].strip() != "# EOF":
+        problems.append("openmetrics: missing '# EOF' terminator")
+    for i, line in enumerate(lines, 1):
+        if not line or line.startswith("#"):
+            continue
+        ex = _EXEMPLAR_RE.search(line)
+        if not ex:
+            continue
+        m = _SAMPLE_RE.match(line)
+        name = m.group(1) if m else "?"
+        where = f"openmetrics line {i} ({name})"
+        if not (name.endswith("_bucket") or name.endswith("_count")):
+            problems.append(f"{where}: exemplar on a non-bucket/"
+                            f"non-count sample line")
+        labelset = ex.group(1)[1:-1]  # strip the braces
+        pairs = re.findall(r'([a-zA-Z0-9_]+)="((?:[^"\\]|\\.)*)"', labelset)
+        runes = sum(len(k) + len(v) for k, v in pairs)
+        if runes > OPENMETRICS_EXEMPLAR_MAX_RUNES:
+            problems.append(f"{where}: exemplar label set is {runes} "
+                            f"runes (cap "
+                            f"{OPENMETRICS_EXEMPLAR_MAX_RUNES})")
     return problems
 
 
@@ -220,16 +328,31 @@ def lint_rules(fams: "list[tuple[str, str, str]] | None" = None,
     return problems
 
 
+def _live_openmetrics() -> str:
+    """A real rendered OpenMetrics exposition (ServeObs, one observed
+    sample per histogram so exemplar lines exist to lint)."""
+    from k3stpu.obs import ServeObs, new_trace_id
+
+    obs = ServeObs()
+    tid = new_trace_id()
+    for h in (obs.ttft, obs.tpot, obs.e2e, obs.queue_wait):
+        h.observe(0.01, trace_id=tid)
+    return obs.render_openmetrics() + "\n# EOF\n"
+
+
 def main() -> int:
-    problems = lint() + lint_rules()
+    problems = (lint() + lint_label_keys()
+                + lint_openmetrics(_live_openmetrics()) + lint_rules())
     if problems:
         for p in problems:
             print(f"metrics-lint: {p}")
         return 1
     fams = _all_families()
+    labeled = _labeled_families()
     groups = _rule_groups_from_chart()
     rules = sum(len(g.get("rules", [])) for g in groups)
-    print(f"metrics-lint: {len(fams)} families, {rules} rules clean")
+    print(f"metrics-lint: {len(fams)} families ({len(labeled)} labeled), "
+          f"{rules} rules clean")
     return 0
 
 

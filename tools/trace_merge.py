@@ -1,0 +1,213 @@
+#!/usr/bin/env python
+"""Merge N Chrome-trace exports into one wall-clock-aligned timeline.
+
+Every k3stpu trace export (``TraceBuffer.chrome_trace()``,
+``TrainObs.chrome_trace()``, loadgen's ``--trace-out``) stamps a
+``metadata`` block with its identity (component, rank/pod for
+training) and ``wall_t0_s`` — the wall-clock second its exported
+``ts=0`` corresponds to. That anchor is what makes this tool possible:
+each source's timestamps are shifted by its offset from the earliest
+anchor, so spans from independent processes land where they actually
+happened relative to each other, and the merged file still opens in
+``ui.perfetto.dev`` as a single timeline.
+
+Two merge keys, picked per ``--mode`` (default ``auto`` sniffs the
+sources' metadata):
+
+- ``training``: one Perfetto process row per SOURCE, named by its
+  rank/pod identity — the "did rank 1's compile stall rank 0's
+  all-reduce" view across a 2..N-rank job.
+- ``serving``: one thread row per TRACE ID, client and server spans of
+  the same request interleaved on it (each event tagged with its
+  source component) — the "where did this request's latency actually
+  go, edge or engine" view.
+
+Sources are file paths or live ``http(s)://.../debug/trace`` URLs.
+
+Run:
+    python tools/trace_merge.py -o merged.json rank0.json rank1.json
+    python tools/trace_merge.py -o merged.json \\
+        client.json http://127.0.0.1:8000/debug/trace
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+
+
+def load_source(src: str, timeout_s: float = 10.0) -> dict:
+    """One Chrome-trace dict from a file path or live /debug/trace
+    URL. Raises ValueError on anything that isn't a trace export."""
+    if src.startswith(("http://", "https://")):
+        with urllib.request.urlopen(src, timeout=timeout_s) as resp:
+            doc = json.loads(resp.read().decode("utf-8"))
+    else:
+        with open(src) as f:
+            doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{src}: not a Chrome trace export "
+                         f"(no traceEvents)")
+    return doc
+
+
+def _meta(doc: dict) -> dict:
+    md = doc.get("metadata")
+    return md if isinstance(md, dict) else {}
+
+
+def _anchor(doc: dict) -> "float | None":
+    t = _meta(doc).get("wall_t0_s")
+    return float(t) if isinstance(t, (int, float)) else None
+
+
+def _shifts_us(docs: "list[dict]") -> "list[float]":
+    """Per-source µs offset onto the shared timeline. Sources without
+    an anchor (foreign traces) stay unshifted at offset 0 — visibly
+    wrong beats silently guessed."""
+    anchors = [_anchor(d) for d in docs]
+    known = [a for a in anchors if a is not None]
+    base = min(known) if known else 0.0
+    return [round((a - base) * 1e6, 1) if a is not None else 0.0
+            for a in anchors]
+
+
+def _source_label(doc: dict, src: str, idx: int) -> str:
+    md = _meta(doc)
+    component = md.get("component", f"src{idx}")
+    if "rank" in md:
+        label = f"{component} rank {md['rank']}"
+        if md.get("pod"):
+            label += f" ({md['pod']})"
+        return label
+    return f"{component} [{src}]"
+
+
+def sniff_mode(docs: "list[dict]") -> str:
+    """training iff every source identifies as a train export."""
+    comps = [_meta(d).get("component") for d in docs]
+    return "training" if comps and all(c == "train" for c in comps) \
+        else "serving"
+
+
+def merge_training(docs: "list[dict]", srcs: "list[str]") -> dict:
+    """One process row per source, events time-shifted onto the shared
+    wall clock; tids within a source are preserved."""
+    shifts = _shifts_us(docs)
+    ev = []
+    for idx, (doc, src) in enumerate(zip(docs, srcs)):
+        pid = idx + 1
+        label = _source_label(doc, src, idx)
+        ev.append({"ph": "M", "pid": pid, "tid": 0,
+                   "name": "process_name", "args": {"name": label}})
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "M" and e.get("name") == "process_name":
+                continue  # replaced by the identity row above
+            out = dict(e)
+            out["pid"] = pid
+            if "ts" in out:
+                out["ts"] = round(out["ts"] + shifts[idx], 1)
+            ev.append(out)
+    return {"traceEvents": ev, "displayTimeUnit": "ms",
+            "metadata": {"merged_from": srcs, "mode": "training"}}
+
+
+def merge_serving(docs: "list[dict]", srcs: "list[str]") -> dict:
+    """One thread row per trace id. Each source's tid->trace_id map
+    comes from its own thread_name metadata rows (TraceBuffer stamps
+    the id there); spans and instants follow their tid onto the shared
+    per-trace row, tagged with the source component so client and
+    server segments stay distinguishable."""
+    shifts = _shifts_us(docs)
+    rows: "dict[str, int]" = {}       # trace_id -> merged tid
+    untraced_tid = 0                   # lazily allocated catch-all row
+    ev = [{"ph": "M", "pid": 1, "tid": 0, "name": "process_name",
+           "args": {"name": "k3stpu merged (by trace id)"}}]
+
+    def row_for(trace_id: "str | None") -> int:
+        nonlocal untraced_tid
+        if trace_id is None:
+            if untraced_tid == 0:
+                untraced_tid = len(rows) + 10_000  # past any trace row
+                ev.append({"ph": "M", "pid": 1, "tid": untraced_tid,
+                           "name": "thread_name",
+                           "args": {"name": "(untraced)"}})
+            return untraced_tid
+        tid = rows.get(trace_id)
+        if tid is None:
+            tid = rows[trace_id] = len(rows) + 1
+            ev.append({"ph": "M", "pid": 1, "tid": tid,
+                       "name": "thread_name",
+                       "args": {"name": trace_id,
+                                "trace_id": trace_id}})
+        return tid
+
+    for idx, (doc, src) in enumerate(zip(docs, srcs)):
+        component = _meta(doc).get("component", f"src{idx}")
+        tid_to_trace: "dict[int, str]" = {}
+        for e in doc["traceEvents"]:
+            if (e.get("ph") == "M" and e.get("name") == "thread_name"
+                    and isinstance(e.get("args"), dict)
+                    and e["args"].get("trace_id")):
+                tid_to_trace[e.get("tid")] = e["args"]["trace_id"]
+        for e in doc["traceEvents"]:
+            if e.get("ph") == "M":
+                continue  # identity rows are re-emitted by row_for()
+            trace_id = (e.get("args") or {}).get("trace_id") \
+                or tid_to_trace.get(e.get("tid"))
+            out = dict(e)
+            out["pid"] = 1
+            out["tid"] = row_for(trace_id)
+            out["args"] = {**(e.get("args") or {}), "src": component}
+            if "ts" in out:
+                out["ts"] = round(out["ts"] + shifts[idx], 1)
+            ev.append(out)
+    return {"traceEvents": ev, "displayTimeUnit": "ms",
+            "metadata": {"merged_from": srcs, "mode": "serving",
+                         "trace_rows": len(rows)}}
+
+
+def merge(docs: "list[dict]", srcs: "list[str]",
+          mode: str = "auto") -> dict:
+    if mode == "auto":
+        mode = sniff_mode(docs)
+    if mode == "training":
+        return merge_training(docs, srcs)
+    return merge_serving(docs, srcs)
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Merge k3stpu Chrome-trace exports onto one "
+                    "wall-clock-aligned Perfetto timeline.")
+    ap.add_argument("sources", nargs="+",
+                    help="trace files or live /debug/trace URLs")
+    ap.add_argument("-o", "--out", required=True,
+                    help="merged Chrome-trace JSON output path")
+    ap.add_argument("--mode", choices=("auto", "serving", "training"),
+                    default="auto",
+                    help="merge key: per-rank rows (training) or "
+                         "per-trace-id rows (serving); auto sniffs "
+                         "the sources' metadata")
+    args = ap.parse_args(argv)
+
+    docs = []
+    for src in args.sources:
+        try:
+            docs.append(load_source(src))
+        except Exception as e:
+            print(f"trace-merge: {src}: {e}", file=sys.stderr)
+            return 1
+    merged = merge(docs, args.sources, mode=args.mode)
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    mode = merged["metadata"]["mode"]
+    print(f"trace-merge: {len(docs)} sources -> {args.out} "
+          f"({mode}, {len(merged['traceEvents'])} events)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
