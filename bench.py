@@ -54,21 +54,15 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 # Group-killed bounded subprocesses (shared wedge-proof discipline); pulls in
 # k3stpu/utils only — the parent still never imports jax.
+from k3stpu.utils.env import env_int as _env_int  # noqa: E402
 from k3stpu.utils.subproc import kill_active_groups, run_bounded  # noqa: E402
 
 BASELINE_TFLOPS = 98.5  # 50% MFU on v5e (197 bf16 peak) — BASELINE.md
 # Probe bounds are env-overridable so a wedged-tunnel failure (BENCH_r05
 # died at backend_init) can be triaged — longer timeout, more attempts —
 # without editing code. Malformed values fall back to the defaults (same
-# degrade-not-crash semantics as the K3STPU_RDV_* knobs).
-
-
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
+# degrade-not-crash semantics as the K3STPU_RDV_* knobs; parser shared in
+# k3stpu/utils/env.py).
 
 PROBE_TIMEOUT_S = _env_int("K3STPU_BENCH_PROBE_TIMEOUT_S", 120)
 PROBE_ATTEMPTS = max(1, _env_int("K3STPU_BENCH_PROBE_ATTEMPTS", 2))

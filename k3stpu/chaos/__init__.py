@@ -46,6 +46,16 @@ point                 boundary
 ``train_step``        top of the train_job step body — ``stall_s`` widens
                       the SIGTERM-mid-step window, ``exc`` a mid-step
                       crash (resume-from-checkpoint path)
+``rank_loss``         per-step in the elastic train loop, on EVERY rank —
+                      a firing rank hard-exits (``os._exit``, no SIGTERM
+                      drain, no emergency checkpoint: a kubelet-evicted
+                      or OOM-killed pod), exercising the survivors'
+                      ledger-timeout detection and elastic re-rendezvous
+``coordinator_loss``  same hard-exit, but consulted only on the CURRENT
+                      generation's primary (dense rank 0) — exercises
+                      coordinator takeover by the next-lowest survivor
+                      plus primary-duty handoff (checkpoint writes, GC,
+                      metrics port)
 ====================  =====================================================
 """
 

@@ -201,12 +201,27 @@ class TokenCorpus:
         return crops[:, :-1], crops[:, 1:]
 
     def batches(self, batch: int, seq: int, seed: int = 0,
-                start_step: int = 0):
+                start_step: int = 0, rank: int = 0, world_size: int = 1):
         """Infinite deterministic batch stream; resuming at ``start_step``
         reproduces the exact data order a fresh run would have seen there
-        (one child seed per step — no sequential RNG state to restore)."""
+        (one child seed per step — no sequential RNG state to restore).
+
+        ``rank``/``world_size`` partition the stream for elastic data
+        parallelism: every rank draws the SAME global ``batch`` rows for
+        a step (the stream is keyed by (seed, step) only, never by world
+        size) and keeps just its contiguous row block. Re-sharding from
+        world N to N-1 mid-stream therefore preserves the global sample
+        order exactly — the survivors re-slice the same rows at their new
+        dense ranks (span rule: parallel/sharding.py batch_row_span)."""
+        if world_size > 1 or rank != 0:
+            # Lazy import: keeps this module importable without jax.
+            from k3stpu.parallel.sharding import batch_row_span
+            lo, hi = batch_row_span(batch, rank, world_size)
+        else:
+            lo, hi = 0, batch
         step = start_step
         while True:
             rng = np.random.default_rng(np.random.SeedSequence((seed, step)))
-            yield self.sample_batch(rng, batch, seq)
+            inputs, labels = self.sample_batch(rng, batch, seq)
+            yield inputs[lo:hi], labels[lo:hi]
             step += 1

@@ -167,6 +167,17 @@ class TrainObs:
         self.preemptions = Counter(
             "k3stpu_train_preemptions_total",
             "SIGTERM/SIGINT preemptions handled by the graceful path.")
+        self.elastic_resyncs = Counter(
+            "k3stpu_train_elastic_resyncs_total",
+            "Elastic membership resyncs: the group re-formed at a new "
+            "generation without a Job restart.")
+        self.elastic_lost = Counter(
+            "k3stpu_train_elastic_lost_ranks_total",
+            "Ranks lost across all elastic membership changes.")
+        self.world_size = Gauge(
+            "k3stpu_train_world_size",
+            "Current number of participating ranks (elastic generation "
+            "world size; the boot world size when elastic is off).")
         self.goodput_seconds = LabeledCounter(
             "k3stpu_train_goodput_seconds_total",
             "Wall-clock seconds attributed to each goodput bucket; "
@@ -184,6 +195,10 @@ class TrainObs:
         # jit-cache probe state: size 0 before the first dispatch, so
         # the first compile is (honestly) counted as a miss.
         self._jit_cache_size = 0
+        # Bumped by begin_resync(): any phase() open at the bump must
+        # NOT restore its previous bucket on exit (the resync owns the
+        # accountant from the bump on). See phase()/begin_resync().
+        self._phase_epoch = 0
 
     # -- the event funnel --------------------------------------------------
 
@@ -223,6 +238,14 @@ class TrainObs:
             self.gc_deleted.inc(len(f.get("deleted") or ()))
         elif event == "preempted":
             self.preemptions.inc()
+        elif event == "train_start":
+            if f.get("num_processes"):
+                self.world_size.set(float(f["num_processes"]))
+        elif event == "elastic_resync":
+            self.elastic_resyncs.inc()
+            self.elastic_lost.inc(len(f.get("lost") or ()))
+            if f.get("world_size"):
+                self.world_size.set(float(f["world_size"]))
 
     # -- write-side hooks (the train loop) ---------------------------------
 
@@ -233,10 +256,18 @@ class TrainObs:
         ``bucket``, restore the previous bucket on exit (so nesting —
         a checkpoint inside the preempted drain — stays exclusive).
         Optionally observes the block's duration into ``hist`` and
-        records a ``kind`` span on the step timeline."""
+        records a ``kind`` span on the step timeline.
+
+        A phase open when :meth:`begin_resync` fires does NOT restore
+        its previous bucket on exit: the resync closed this bucket and
+        opened ``recovery``, and an unwinding ``checkpoint``/``eval``
+        scope blindly re-entering its captured ``prev`` would misattribute
+        the whole resync window to a stale bucket (the epoch check keeps
+        ``sum(totals()) == elapsed`` attribution honest)."""
         if not self.enabled:
             yield
             return
+        epoch = self._phase_epoch
         prev = self.goodput.enter(bucket)
         tr = self.traces.start(kind=kind, **meta) if kind else None
         t0 = self._clock()
@@ -247,7 +278,19 @@ class TrainObs:
                 hist.observe(self._clock() - t0)
             if tr is not None:
                 tr.finish("ok")
-            self.goodput.enter(prev)
+            if epoch == self._phase_epoch:
+                self.goodput.enter(prev)
+
+    def begin_resync(self) -> None:
+        """Elastic membership change detected: close whatever bucket is
+        accruing — even mid-``phase()`` — and open ``recovery``. Phases
+        already on the stack become no-ops on exit (epoch bump), so the
+        resync window is charged to ``recovery`` until the rebuilt loop
+        enters ``productive``."""
+        if not self.enabled:
+            return
+        self._phase_epoch += 1
+        self.goodput.enter("recovery")
 
     def span(self, kind: str, **meta):
         """A timeline-only scope (no bucket switch): the per-step span
@@ -290,7 +333,8 @@ class TrainObs:
 
     def counters(self) -> "tuple[Counter, ...]":
         return (self.steps, self.recompiles, self.rdv_retries,
-                self.quarantines, self.gc_deleted, self.preemptions)
+                self.quarantines, self.gc_deleted, self.preemptions,
+                self.elastic_resyncs, self.elastic_lost)
 
     def render_prometheus(self) -> str:
         totals = self.goodput.totals()
@@ -303,6 +347,7 @@ class TrainObs:
         parts += [c.render() for c in self.counters()]
         parts.append(self.goodput_seconds.render())
         parts.append(self.goodput_fraction.render())
+        parts.append(self.world_size.render())
         parts.append(self.build_info.render())
         return "\n".join(parts) + "\n"
 

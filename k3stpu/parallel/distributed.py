@@ -33,8 +33,12 @@ from __future__ import annotations
 
 import json
 import os
+import socket
+import threading
 import time
 from dataclasses import dataclass
+
+from k3stpu.utils.env import env_flag, env_float, env_int
 
 DEFAULT_PORT = 8476
 
@@ -116,20 +120,10 @@ def rendezvous_from_env(env: "dict[str, str] | None" = None,
                       process_id=pid)
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
-
-
-def _env_int(name: str, default: int) -> int:
-    # Same fallback-to-default semantics as _env_float: a typo'd env var
-    # must not crash the job before rendezvous even starts.
-    try:
-        return int(os.environ.get(name, ""))
-    except ValueError:
-        return default
+# Canonical env parsers live in k3stpu.utils.env; the underscored names
+# stay importable from here for existing callers (tests included).
+_env_float = env_float
+_env_int = env_int
 
 
 class RendezvousError(RuntimeError):
@@ -245,3 +239,483 @@ def initialize(rdv: Rendezvous | None = None, *,
                          backoff_cap_s=backoff_cap_s, chaos=chaos,
                          emit=emit)
     return rdv
+
+
+# ---------------------------------------------------------------------------
+# Elastic membership (docs/RESILIENCE.md "Elastic membership")
+#
+# When a rank dies mid-run the non-elastic path burns a full Job restart:
+# every survivor exits, kubelet reschedules, and the world pays process
+# boot + rendezvous + compile + restore again. The elastic layer instead
+# lets SURVIVORS re-form the group in-process:
+#
+#   detection        file-heartbeat ledger on the shared checkpoint volume
+#                    (each rank touches membership/rank-<r>.json every
+#                    K3STPU_ELASTIC_HEARTBEAT_S; a peer whose file goes
+#                    stale past K3STPU_ELASTIC_LOSS_TIMEOUT_S is lost)
+#   re-rendezvous    a generation-numbered TCP barrier: the surviving rank
+#                    with the lowest original id coordinates generation g
+#                    on port (advertised base + g) — fresh port per
+#                    generation so a half-closed socket from generation
+#                    g-1 can never be mistaken for the new group
+#   group manifest   {generation, ranks, world_size} — original rank ids
+#                    plus each survivor's dense index in the new world
+#
+# The barrier deliberately does NOT use the XLA coordination service: on
+# peer death that client aborts the process from a background thread
+# (PollForError -> LOG(QFATAL)), which is exactly the teardown elastic
+# training exists to avoid. The pure-socket barrier is dependency-free
+# and every attempt is driven through the same bounded-retry machinery
+# (K3STPU_RDV_* knobs, rdv_* events) as boot rendezvous.
+# ---------------------------------------------------------------------------
+
+# Base port for the elastic barrier; generation g listens on base+g.
+DEFAULT_ELASTIC_PORT = 8478
+DEFAULT_SETTLE_S = 2.0
+DEFAULT_HEARTBEAT_S = 2.0
+DEFAULT_LOSS_TIMEOUT_S = 10.0
+
+
+class MembershipChanged(RuntimeError):
+    """Raised inside the step loop when the ledger says a peer is gone."""
+
+    def __init__(self, lost, generation: int):
+        self.lost = sorted(lost)
+        self.generation = generation
+        super().__init__(
+            f"lost ranks {self.lost} in generation {generation}")
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """K3STPU_ELASTIC_* knobs (see docs/RESILIENCE.md knob table)."""
+
+    min_world: int            # refuse to form a group smaller than this
+    max_world: int            # 0 = initial world size is the cap
+    settle_s: float           # wait this long for stragglers before
+                              # finalizing a partial group
+    heartbeat_s: float        # ledger heartbeat period
+    loss_timeout_s: float     # heartbeat age after which a rank is lost
+    advertise_address: str    # host:port this rank's barrier listens on
+    ledger_dir: str           # shared directory for heartbeat files
+
+    @property
+    def advertise_host(self) -> str:
+        return self.advertise_address.rpartition(":")[0]
+
+    @property
+    def advertise_port(self) -> int:
+        return int(self.advertise_address.rpartition(":")[2])
+
+
+def elastic_config_from_env(*, ledger_root: "str | None" = None,
+                            hostname: "str | None" = None
+                            ) -> "ElasticConfig | None":
+    """Build the elastic config, or None when K3STPU_ELASTIC is off.
+
+    ``ledger_root`` is typically the checkpoint directory — the one volume
+    every rank already shares — and the ledger lives in its
+    ``membership/`` subdirectory unless K3STPU_ELASTIC_LEDGER_DIR says
+    otherwise.
+    """
+    if not env_flag("K3STPU_ELASTIC", False):
+        return None
+    adv = os.environ.get("K3STPU_ADVERTISE_ADDRESS")
+    if adv is None:
+        host = hostname or os.environ.get("HOSTNAME", os.uname().nodename)
+        adv = f"{host}:{env_int('K3STPU_ELASTIC_PORT', DEFAULT_ELASTIC_PORT)}"
+    ledger = os.environ.get("K3STPU_ELASTIC_LEDGER_DIR")
+    if ledger is None:
+        if ledger_root is None:
+            raise ValueError(
+                "K3STPU_ELASTIC=1 needs a shared ledger directory: pass "
+                "--ckpt-dir or set K3STPU_ELASTIC_LEDGER_DIR")
+        ledger = os.path.join(ledger_root, "membership")
+    return ElasticConfig(
+        min_world=max(1, env_int("K3STPU_ELASTIC_MIN_WORLD", 1)),
+        max_world=max(0, env_int("K3STPU_ELASTIC_MAX_WORLD", 0)),
+        settle_s=env_float("K3STPU_ELASTIC_SETTLE_S", DEFAULT_SETTLE_S),
+        heartbeat_s=env_float("K3STPU_ELASTIC_HEARTBEAT_S",
+                              DEFAULT_HEARTBEAT_S),
+        loss_timeout_s=env_float("K3STPU_ELASTIC_LOSS_TIMEOUT_S",
+                                 DEFAULT_LOSS_TIMEOUT_S),
+        advertise_address=adv,
+        ledger_dir=ledger,
+    )
+
+
+class MembershipLedger:
+    """File heartbeats on a shared volume: rank r owns ``rank-<r>.json``.
+
+    Liveness is the file's mtime — on a shared filesystem that is the
+    server's clock for every reader, so survivors agree on staleness
+    without a clock-sync protocol. Writes go through a per-process tmp +
+    ``os.replace`` so a reader never sees a torn heartbeat.
+    """
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+        self._generation = 0
+
+    def _path(self, rank: int) -> str:
+        return os.path.join(self.directory, f"rank-{rank}.json")
+
+    def write_heartbeat(self, rank: int, address: str,
+                        generation: "int | None" = None) -> None:
+        if generation is None:
+            generation = self._generation
+        tmp = self._path(rank) + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"rank": rank, "address": address,
+                       "generation": generation, "pid": os.getpid(),
+                       "ts": time.time()}, f)
+        os.replace(tmp, self._path(rank))
+
+    def set_generation(self, generation: int) -> None:
+        self._generation = generation
+
+    def start_heartbeat(self, rank: int, address: str,
+                        interval_s: float) -> None:
+        """Daemon thread: touch our heartbeat every ``interval_s``. A
+        SIGKILL'd rank simply stops touching its file — no unregister
+        protocol to miss."""
+        self.write_heartbeat(rank, address)
+
+        def _beat():
+            while not self._stop.wait(interval_s):
+                try:
+                    self.write_heartbeat(rank, address)
+                except OSError:
+                    pass  # volume blips are survivable; staleness decides
+        self._thread = threading.Thread(target=_beat, daemon=True,
+                                        name="k3stpu-elastic-heartbeat")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+    def read(self) -> "dict[int, dict]":
+        """All heartbeat records keyed by rank, with ``age_s`` attached."""
+        out: dict[int, dict] = {}
+        now = time.time()
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("rank-") and name.endswith(".json")):
+                continue
+            path = os.path.join(self.directory, name)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    rec = json.load(f)
+                rec["age_s"] = max(0.0, now - os.stat(path).st_mtime)
+                out[int(rec["rank"])] = rec
+            except (OSError, ValueError, KeyError):
+                continue  # torn/foreign file: ignore, mtime will decide
+        return out
+
+    def alive(self, timeout_s: float) -> "set[int]":
+        return {r for r, rec in self.read().items()
+                if rec["age_s"] < timeout_s}
+
+    def lost(self, expected, timeout_s: float) -> "set[int]":
+        """Members of ``expected`` whose heartbeat is stale or missing."""
+        return set(expected) - self.alive(timeout_s)
+
+
+@dataclass(frozen=True)
+class ElasticGroup:
+    """One finalized generation of the elastic group."""
+
+    generation: int
+    ranks: tuple[int, ...]     # surviving ORIGINAL rank ids, sorted
+    rank: int                  # this process's dense index into ranks
+    coordinator_address: str   # barrier address used for this generation
+
+    @property
+    def world_size(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def is_primary(self) -> bool:
+        # Dense rank 0 — NOT jax.process_index(), which is 0 on every
+        # rank when the group runs unwired (local-replica mode).
+        return self.rank == 0
+
+
+def _barrier_endpoint(address: str, generation: int) -> "tuple[str, int]":
+    host, _, port = address.rpartition(":")
+    return host, int(port) + generation
+
+
+def _recv_line(sock_file, what: str) -> dict:
+    line = sock_file.readline()
+    if not line:
+        raise ConnectionError(f"peer closed before sending {what}")
+    return json.loads(line.decode("utf-8"))
+
+
+def _send_line(sock, payload: dict) -> None:
+    sock.sendall(json.dumps(payload).encode("utf-8") + b"\n")
+
+
+def _run_coordinator(cfg: ElasticConfig, my_rank: int, generation: int,
+                     expected: "set[int] | None", ledger: MembershipLedger,
+                     timeout_s: float) -> ElasticGroup:
+    """Collect hellos on (advertise_host base + generation), finalize the
+    roster, broadcast the group manifest."""
+    port = cfg.advertise_port + generation
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    conns: dict[int, socket.socket] = {}
+    try:
+        srv.bind(("", port))
+        srv.listen(16)
+        srv.settimeout(0.1)
+        arrived = {my_rank}
+        start = time.monotonic()
+        deadline = start + timeout_s
+        cap = cfg.max_world or (len(expected) if expected else 0)
+        while time.monotonic() < deadline:
+            known_alive = ledger.alive(cfg.loss_timeout_s) | {my_rank}
+            want = set(expected) if expected is not None else known_alive
+            lower = {r for r in (want & known_alive) if r < my_rank}
+            if lower:
+                # Split-brain guard: we self-elected off a ledger view
+                # that predated a lower-ranked member's first heartbeat
+                # (cold boot), or that member came back. Coordination
+                # belongs to the lowest alive rank — abdicate so the
+                # retry re-derives and dials them as a member. (Our
+                # collected members' conns close in the finally, failing
+                # their attempts so they re-derive too.)
+                raise RendezvousError(
+                    f"elastic generation {generation}: rank {my_rank} "
+                    f"abdicating coordination to alive lower rank "
+                    f"{min(lower)}")
+            if cap and len(arrived) >= cap:
+                break  # roster capped: once full, stop waiting for more
+            if arrived >= want:
+                break
+            if (arrived >= (want & known_alive)
+                    and time.monotonic() - start >= cfg.settle_s
+                    and len(arrived) >= cfg.min_world):
+                # Everyone the ledger still believes in has arrived and
+                # the settle window has passed: finalize without the dead.
+                break
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            conn.settimeout(timeout_s)
+            try:
+                hello = _recv_line(conn.makefile("rb"), "hello")
+            except (ConnectionError, ValueError, socket.timeout):
+                conn.close()
+                continue
+            if hello.get("generation") != generation:
+                # A straggler from an older generation: tell it to go
+                # retry against the current state of the world.
+                _send_line(conn, {"error": "stale-generation",
+                                  "generation": generation})
+                conn.close()
+                continue
+            peer = int(hello["rank"])
+            if cap and len(arrived) >= cap and peer not in arrived:
+                _send_line(conn, {"error": "world-full",
+                                  "generation": generation})
+                conn.close()
+                continue
+            old = conns.pop(peer, None)
+            if old is not None:
+                old.close()
+            conns[peer] = conn
+            arrived.add(peer)
+        if len(arrived) < cfg.min_world:
+            raise RendezvousError(
+                f"elastic generation {generation}: only {sorted(arrived)} "
+                f"arrived, min_world={cfg.min_world}")
+        ranks = tuple(sorted(arrived))
+        manifest = {"generation": generation, "ranks": list(ranks),
+                    "world_size": len(ranks),
+                    "coordinator_address": cfg.advertise_address}
+        for peer, conn in conns.items():
+            try:
+                _send_line(conn, manifest)
+                conn.settimeout(5.0)
+                _recv_line(conn.makefile("rb"), "ack")
+            except (OSError, ConnectionError, ValueError):
+                pass  # member will fail its own attempt and retry/exit
+        return ElasticGroup(generation=generation, ranks=ranks,
+                            rank=ranks.index(my_rank),
+                            coordinator_address=cfg.advertise_address)
+    finally:
+        for conn in conns.values():
+            try:
+                conn.close()
+            except OSError:
+                pass
+        srv.close()
+
+
+def _run_member(cfg: ElasticConfig, my_rank: int, generation: int,
+                coord_address: str, timeout_s: float) -> ElasticGroup:
+    """Dial the coordinator for this generation, send hello, await the
+    group manifest."""
+    host, port = _barrier_endpoint(coord_address, generation)
+    deadline = time.monotonic() + timeout_s
+    sock = None
+    while sock is None:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise TimeoutError(
+                f"no elastic coordinator at {host}:{port} within "
+                f"{timeout_s:.1f}s")
+        try:
+            sock = socket.create_connection((host, port),
+                                            timeout=min(1.0, remaining))
+        except OSError:
+            time.sleep(0.05)  # coordinator binds a beat later; spin
+    try:
+        sock.settimeout(max(0.1, deadline - time.monotonic()))
+        _send_line(sock, {"rank": my_rank, "generation": generation,
+                          "address": cfg.advertise_address})
+        manifest = _recv_line(sock.makefile("rb"), "manifest")
+        if "error" in manifest:
+            raise ConnectionError(
+                f"coordinator rejected hello: {manifest['error']}")
+        ranks = tuple(int(r) for r in manifest["ranks"])
+        if my_rank not in ranks:
+            raise RendezvousError(
+                f"elastic generation {generation} finalized without rank "
+                f"{my_rank}: {ranks}")
+        _send_line(sock, {"ack": my_rank})
+        return ElasticGroup(generation=int(manifest["generation"]),
+                            ranks=ranks, rank=ranks.index(my_rank),
+                            coordinator_address=coord_address)
+    finally:
+        sock.close()
+
+
+def elastic_rendezvous(cfg: ElasticConfig, ledger: MembershipLedger,
+                       my_rank: int, generation: int, *,
+                       expected=None,
+                       timeout_s: "float | None" = None,
+                       attempts: "int | None" = None,
+                       backoff_s: "float | None" = None,
+                       backoff_cap_s: "float | None" = None,
+                       chaos=None, emit=None) -> ElasticGroup:
+    """Form (or re-form) the elastic group for ``generation``.
+
+    The coordinator for a generation is the surviving member with the
+    lowest ORIGINAL rank — re-derived from the ledger on every attempt,
+    so if the would-be coordinator dies between attempts the next-lowest
+    survivor takes over. ``expected`` pins the roster (boot: every rank
+    of the Indexed Job); ``None`` means "whoever the ledger says is
+    alive" (resync). Attempts are driven through the same
+    ``connect_with_retries`` machinery as boot rendezvous and emit the
+    same ``rdv_*`` events, tagged with the generation.
+    """
+    if timeout_s is None:
+        timeout_s = env_float("K3STPU_RDV_TIMEOUT_S", DEFAULT_TIMEOUT_S)
+    if attempts is None:
+        attempts = env_int("K3STPU_RDV_ATTEMPTS", DEFAULT_ATTEMPTS)
+    if backoff_s is None:
+        backoff_s = env_float("K3STPU_RDV_BACKOFF_S", DEFAULT_BACKOFF_S)
+    if backoff_cap_s is None:
+        backoff_cap_s = env_float("K3STPU_RDV_BACKOFF_CAP_S",
+                                  DEFAULT_BACKOFF_CAP_S)
+    expected_set = set(expected) if expected is not None else None
+    base_emit = emit or _print_event
+
+    def tagged_emit(event, **fields):
+        base_emit(event, generation=generation, **fields)
+
+    out: dict = {}
+
+    def attempt():
+        records = ledger.read()
+        alive = {r for r, rec in records.items()
+                 if rec["age_s"] < cfg.loss_timeout_s} | {my_rank}
+        candidates = sorted(expected_set & alive if expected_set is not None
+                            else alive)
+        if not candidates:
+            candidates = [my_rank]
+        coord_rank = candidates[0]
+        if coord_rank == my_rank:
+            out["group"] = _run_coordinator(cfg, my_rank, generation,
+                                            expected_set, ledger, timeout_s)
+        else:
+            rec = records.get(coord_rank)
+            if rec is None or "address" not in rec:
+                raise ConnectionError(
+                    f"no ledger address for coordinator rank {coord_rank}")
+            out["group"] = _run_member(cfg, my_rank, generation,
+                                       rec["address"], timeout_s)
+
+    # Events carry a best-guess coordinator (re-derived per attempt
+    # inside); the pseudo-Rendezvous only feeds event fields.
+    guess = Rendezvous(coordinator_address=cfg.advertise_address,
+                       num_processes=len(expected_set) if expected_set
+                       else max(1, len(ledger.alive(cfg.loss_timeout_s))),
+                       process_id=my_rank)
+    connect_with_retries(attempt, guess, timeout_s=timeout_s,
+                         attempts=attempts, backoff_s=backoff_s,
+                         backoff_cap_s=backoff_cap_s, chaos=chaos,
+                         emit=tagged_emit)
+    group = out["group"]
+    ledger.set_generation(group.generation)
+    ledger.write_heartbeat(my_rank, cfg.advertise_address)
+    return group
+
+
+def wire_jax_for_group(group: ElasticGroup, *, timeout_s: float = 60.0,
+                       emit=None) -> bool:
+    """Join jax.distributed at the group's topology (accelerator backends).
+
+    On CPU this returns False and the group runs UNWIRED (local-replica
+    mode): every rank computes the full global batch on its local mesh,
+    which makes all ranks' trajectories identical without collectives —
+    the mean-loss gradient over the full batch equals the psum-average
+    of shard gradients. On TPU/GPU the survivors re-initialize the XLA
+    distributed client at the new world size; the coordinator port is
+    offset per generation so a stale client from the old world can never
+    be dialed.
+    """
+    import jax
+    if jax.default_backend() == "cpu":
+        return False
+    host, port = _barrier_endpoint(group.coordinator_address,
+                                   group.generation)
+    jax.distributed.initialize(
+        coordinator_address=f"{host}:{port + 500}",
+        num_processes=group.world_size,
+        process_id=group.rank,
+        initialization_timeout=max(1, int(timeout_s)),
+    )
+    return True
+
+
+def unwire_jax(*, bound_s: float = 10.0) -> None:
+    """Best-effort bounded teardown of a jax.distributed client whose
+    peers may be dead (shutdown can hang waiting for them)."""
+    import jax
+
+    def _shutdown():
+        try:
+            jax.distributed.shutdown()
+        except Exception:  # noqa: BLE001 — dead-peer shutdown may throw
+            pass
+    t = threading.Thread(target=_shutdown, daemon=True)
+    t.start()
+    t.join(timeout=bound_s)
+    try:
+        import jax.extend.backend
+        jax.extend.backend.clear_backends()
+    except Exception:  # noqa: BLE001 — version-dependent API
+        pass

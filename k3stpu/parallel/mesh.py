@@ -84,6 +84,39 @@ def make_hybrid_mesh(
     return Mesh(grid, axis_names)
 
 
+def elastic_mesh(
+    model_parallelism: int | None = None,
+    world_size: int | None = None,
+    axis_names: tuple[str, str] = ("data", "model"),
+) -> Mesh:
+    """The mesh for one generation of an elastic group — the rebuild
+    entry point the resync path calls after every membership change.
+
+    Unwired (local-replica) mode — ``jax.process_count() == 1`` even
+    though the group has several members — builds the LOCAL mesh: every
+    rank computes the full global batch on its own devices, so the mesh
+    is identical at every world size and a resync only re-``jit``s.
+
+    Wired mode delegates to :func:`make_hybrid_mesh`, but first asserts
+    the JAX world actually matches the group's ``world_size``: a mesh
+    built from a stale distributed client (survivors that re-formed the
+    group but failed to re-initialize jax.distributed) would still span
+    the DEAD rank's devices, and every collective on it would hang. Fail
+    loudly at rebuild instead.
+    """
+    n_proc = jax.process_count()
+    if n_proc == 1:
+        return make_mesh(model_parallelism=model_parallelism,
+                         axis_names=axis_names)
+    if world_size is not None and n_proc != world_size:
+        raise RuntimeError(
+            f"elastic mesh rebuild: jax.process_count()={n_proc} but the "
+            f"group finalized world_size={world_size} — the distributed "
+            "client was not re-initialized at the new topology")
+    return make_hybrid_mesh(model_parallelism=model_parallelism,
+                            axis_names=axis_names)
+
+
 def mesh_shape_for(n: int) -> tuple[int, int]:
     """Near-square (data, model) factorization, used for topology labels."""
     m = int(math.sqrt(n))

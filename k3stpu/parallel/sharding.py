@@ -67,5 +67,30 @@ def batch_sharding(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P("data"))
 
 
+def batch_row_span(batch: int, rank: int, world_size: int) -> "tuple[int, int]":
+    """Rows [lo, hi) of the GLOBAL batch owned by dense rank ``rank``.
+
+    The single definition of the elastic data partition: the global batch
+    is fixed for the life of the run and dense rank r of a world of size
+    w owns the contiguous row block r*(batch//w):(r+1)*(batch//w). After
+    a membership change the survivors re-slice the SAME global stream at
+    their new dense ranks, so the union of rows trained per step is
+    identical at every world size — no sample double-trained or skipped
+    (corpus.batches applies this span; tests/test_data.py proves the
+    coverage invariant over a mid-stream re-shard).
+    """
+    if world_size < 1:
+        raise ValueError(f"world_size {world_size} < 1")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} outside [0, {world_size})")
+    if batch % world_size:
+        raise ValueError(
+            f"global batch {batch} not divisible by world_size "
+            f"{world_size}; pick a batch divisible by every world size "
+            "down to K3STPU_ELASTIC_MIN_WORLD")
+    per = batch // world_size
+    return rank * per, (rank + 1) * per
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())

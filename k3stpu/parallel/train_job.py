@@ -135,22 +135,51 @@ def main(argv: "list[str] | None" = None) -> int:
                          "everything")
     args = ap.parse_args(argv)
 
-    from k3stpu.chaos import chaos_from_env
+    from k3stpu.chaos import InjectedFault, chaos_from_env
     from k3stpu.obs.train import (
         TrainObs,
         start_metrics_server,
         start_telemetry_thread,
     )
-    from k3stpu.parallel.distributed import _env_float, initialize
+    from k3stpu.parallel import distributed as dist
+    from k3stpu.parallel.distributed import initialize
+    from k3stpu.utils.env import env_float as _env_float
 
     chaos = chaos_from_env()
     # K3STPU_TRAIN_OBS=0 keeps the stdout contract (emit still prints
     # every line) but turns the metric updates into no-ops — the
     # baseline arm of `bench.py --train-obs`.
     obs = TrainObs(enabled=os.environ.get("K3STPU_TRAIN_OBS", "1") != "0")
-    with obs.phase("rendezvous"):
-        rdv = initialize(chaos=chaos, emit=obs.emit)
+    # Elastic membership (K3STPU_ELASTIC=1, docs/RESILIENCE.md): the
+    # group is formed by the generation-numbered socket barrier instead
+    # of (only) jax.distributed, heartbeats go to the shared ledger, and
+    # a rank loss mid-run triggers an IN-PROCESS resync instead of a Job
+    # restart. On CPU the group runs UNWIRED (local-replica): every rank
+    # computes the full global batch on its local mesh, so jax.distributed
+    # is never initialized and rank death cannot abort the survivors.
+    elastic = dist.elastic_config_from_env(ledger_root=args.ckpt_dir)
+    group = ledger = None
+    wired = False
+    if elastic is not None:
+        rdv = dist.rendezvous_from_env()
+        ledger = dist.MembershipLedger(elastic.ledger_dir)
+        ledger.start_heartbeat(rdv.process_id, elastic.advertise_address,
+                               interval_s=elastic.heartbeat_s)
+        with obs.phase("rendezvous"):
+            group = dist.elastic_rendezvous(
+                elastic, ledger, rdv.process_id, 0,
+                expected=range(rdv.num_processes), chaos=chaos,
+                emit=obs.emit)
+            wired = dist.wire_jax_for_group(group)
+    else:
+        with obs.phase("rendezvous"):
+            rdv = initialize(chaos=chaos, emit=obs.emit)
     obs.process_id = rdv.process_id
+    # Primary-ness gates the shared-tree duties (checkpoint manifests,
+    # GC, the /metrics port). In unwired elastic mode every rank sees
+    # jax.process_index()==0, so the elastic group's dense rank 0 is the
+    # only valid election — and it can MOVE after a resync.
+    primary = group.is_primary if group is not None else rdv.process_id == 0
     # Parsed ONCE at startup (fallback on malformed values): the SIGTERM
     # path must never die in a ValueError instead of saving.
     preempt_bound_s = _env_float("K3STPU_PREEMPT_SAVE_BOUND_S",
@@ -196,7 +225,7 @@ def main(argv: "list[str] | None" = None) -> int:
         transformer_lm_small,
         transformer_lm_tiny,
     )
-    from k3stpu.parallel.mesh import make_hybrid_mesh
+    from k3stpu.parallel.mesh import elastic_mesh, make_hybrid_mesh
     from k3stpu.parallel.train import make_train_bundle, synth_token_batch
     from k3stpu.utils import checkpoint as ckpt
 
@@ -214,15 +243,31 @@ def main(argv: "list[str] | None" = None) -> int:
              else maker(max_seq_len=max(seq, 512), remat=args.remat,
                         **extra))
     # Hybrid layout across Job pods: 'model' stays on each pod's local ICI,
-    # 'data' (the gradient psum) spans pods over DCN.
-    mesh = make_hybrid_mesh(model_parallelism=args.model_parallelism)
+    # 'data' (the gradient psum) spans pods over DCN. Elastic groups go
+    # through elastic_mesh so a resync rebuilds at the CURRENT topology
+    # (and a stale distributed client fails loudly instead of hanging).
+    def build_mesh():
+        if group is not None:
+            return elastic_mesh(model_parallelism=args.model_parallelism,
+                                world_size=group.world_size if wired
+                                else None)
+        return make_hybrid_mesh(model_parallelism=args.model_parallelism)
+
+    mesh = build_mesh()
+    # The GLOBAL batch is fixed for the life of the run — an elastic
+    # resync re-partitions these same rows across the survivors, it never
+    # changes what a step trains on (data-order determinism).
     batch = args.batch or ((16 if model_name == "medium" else 8)
                            * mesh.shape["data"])
     vocab = model.config.vocab_size
 
+    start_fields = {}
+    if group is not None:
+        start_fields = {"generation": group.generation,
+                        "world_size": group.world_size, "elastic": True}
     obs.emit("train_start", model=model_name, seq=seq, batch=batch,
              mesh=dict(mesh.shape), process_id=rdv.process_id,
-             num_processes=rdv.num_processes)
+             num_processes=rdv.num_processes, **start_fields)
 
     # LR schedule: optimizer updates tick once per --grad-accum
     # micro-steps (MultiSteps), so schedule horizons count UPDATES.
@@ -247,10 +292,16 @@ def main(argv: "list[str] | None" = None) -> int:
         # batch-sized activation memory.
         optimizer = optax.MultiSteps(optimizer,
                                      every_k_schedule=args.grad_accum)
-    bundle = make_train_bundle(
-        model, mesh, example_input=jnp.zeros((1, seq), jnp.int32),
-        optimizer=optimizer,
-    )
+    def build_bundle(mesh):
+        # Fresh jit at the given mesh: the resync path calls this again
+        # after a membership change so the step function is re-traced at
+        # the new topology (restore then overwrites the fresh init).
+        return make_train_bundle(
+            model, mesh, example_input=jnp.zeros((1, seq), jnp.int32),
+            optimizer=optimizer,
+        )
+
+    bundle = build_bundle(mesh)
 
     # Resume with integrity verification: the newest finalized step must
     # match its manifest (and actually restore) before it is trusted; a
@@ -262,53 +313,64 @@ def main(argv: "list[str] | None" = None) -> int:
     # cascade-quarantining healthy checkpoints into a silent fresh start
     # would be worse than the crash-loop. Past the caps the boot raises
     # (exit nonzero, tree intact) so the Job restart retries instead.
+    def resume_from_checkpoint() -> int:
+        """Pick, verify and restore the newest trustworthy finalized step;
+        returns the resume step (0 = fresh start). Shared by boot and
+        elastic resync — the resync path restores into the REBUILT
+        bundle, whose fresh shardings retarget the restore at the new
+        topology (this is what makes restore-across-world-size-change
+        just work). Restores into whatever ``bundle`` currently is."""
+        start = 0
+        quarantined = restore_failures = 0
+        last = ckpt.latest_step(args.ckpt_dir)
+        while last is not None:
+            ok, why = ckpt.verify_step(args.ckpt_dir, last)
+            if ok:
+                try:
+                    t_r = time.perf_counter()
+                    ckpt.restore_bundle(args.ckpt_dir, last, bundle)
+                    if obs.enabled:
+                        obs.ckpt_restore.observe(time.perf_counter() - t_r)
+                except Exception as e:  # noqa: BLE001 — classified below
+                    ok, why = False, f"restore failed: {e!r}"[:300]
+                    restore_failures += 1
+                    if restore_failures > MAX_RESTORE_FAILURE_QUARANTINES:
+                        _restore_handlers()
+                        raise RuntimeError(
+                            f"resume: {restore_failures} independent "
+                            f"checkpoints failed to restore after passing "
+                            f"integrity verification (step {last}: {why}) "
+                            f"— likely environmental, not corruption; "
+                            f"refusing to quarantine further. The Job "
+                            f"restart will retry.") from e
+            if ok:
+                start = last
+                obs.emit("resume", step=last, verify=why)
+                break
+            if quarantined >= MAX_QUARANTINES_PER_BOOT:
+                _restore_handlers()
+                raise RuntimeError(
+                    f"resume: quarantine cap reached "
+                    f"({MAX_QUARANTINES_PER_BOOT} this boot) and step "
+                    f"{last} still fails ({why}) — refusing to consume "
+                    f"the checkpoint tree. The Job restart will retry.")
+            qdir = ckpt.quarantine_step(args.ckpt_dir, last)
+            quarantined += 1
+            obs.emit("ckpt_quarantined", step=last, reason=why,
+                     quarantined_to=str(qdir))
+            last = ckpt.latest_step(args.ckpt_dir)
+        if last is None:
+            partial = ckpt.partial_steps(args.ckpt_dir)
+            if partial:
+                # Boot found only unfinalized debris (a save the dying pod
+                # never committed) — starting fresh is correct, but say so.
+                obs.emit("resume_skipped_partial", partial=partial)
+        return start
+
     start_step = 0
     if args.ckpt_dir:
         with obs.phase("recovery"):
-            quarantined = restore_failures = 0
-            last = ckpt.latest_step(args.ckpt_dir)
-            while last is not None:
-                ok, why = ckpt.verify_step(args.ckpt_dir, last)
-                if ok:
-                    try:
-                        t_r = time.perf_counter()
-                        ckpt.restore_bundle(args.ckpt_dir, last, bundle)
-                        if obs.enabled:
-                            obs.ckpt_restore.observe(time.perf_counter() - t_r)
-                    except Exception as e:  # noqa: BLE001 — classified below
-                        ok, why = False, f"restore failed: {e!r}"[:300]
-                        restore_failures += 1
-                        if restore_failures > MAX_RESTORE_FAILURE_QUARANTINES:
-                            _restore_handlers()
-                            raise RuntimeError(
-                                f"resume: {restore_failures} independent "
-                                f"checkpoints failed to restore after passing "
-                                f"integrity verification (step {last}: {why}) "
-                                f"— likely environmental, not corruption; "
-                                f"refusing to quarantine further. The Job "
-                                f"restart will retry.") from e
-                if ok:
-                    start_step = last
-                    obs.emit("resume", step=last, verify=why)
-                    break
-                if quarantined >= MAX_QUARANTINES_PER_BOOT:
-                    _restore_handlers()
-                    raise RuntimeError(
-                        f"resume: quarantine cap reached "
-                        f"({MAX_QUARANTINES_PER_BOOT} this boot) and step "
-                        f"{last} still fails ({why}) — refusing to consume "
-                        f"the checkpoint tree. The Job restart will retry.")
-                qdir = ckpt.quarantine_step(args.ckpt_dir, last)
-                quarantined += 1
-                obs.emit("ckpt_quarantined", step=last, reason=why,
-                         quarantined_to=str(qdir))
-                last = ckpt.latest_step(args.ckpt_dir)
-            if last is None:
-                partial = ckpt.partial_steps(args.ckpt_dir)
-                if partial:
-                    # Boot found only unfinalized debris (a save the dying pod
-                    # never committed) — starting fresh is correct, but say so.
-                    obs.emit("resume_skipped_partial", partial=partial)
+            start_step = resume_from_checkpoint()
 
     if args.init_from and start_step == 0:
         # Warm start: restore the params ANOTHER run saved into the leaves
@@ -355,6 +417,7 @@ def main(argv: "list[str] | None" = None) -> int:
     # sampling means resume needs no iterator state — start_step IS the
     # data-order state. Synthetic fallback keeps the smoke path hermetic.
     prefetch = None
+    batches = None
     eval_batches_fn = None
     if args.data:
         from k3stpu.data import DevicePrefetcher, TokenCorpus
@@ -365,12 +428,24 @@ def main(argv: "list[str] | None" = None) -> int:
         split = "train" if args.eval_every else None
         corpus = TokenCorpus(args.data, vocab, split=split,
                              holdout_fraction=args.holdout_fraction)
-        sh = batch_sharding(mesh)
-        prefetch = DevicePrefetcher(
-            corpus.batches(batch, seq, seed=args.data_seed,
-                           start_step=start_step),
-            sharding=(sh, sh))
-        batches = iter(prefetch)
+
+        def open_stream(start):
+            # Each wired elastic rank streams its contiguous row span of
+            # the FIXED global batch (sharding.batch_row_span), so a
+            # resync at a new world size re-partitions the same
+            # (seed, step)-keyed rows — no sample double-trained or
+            # skipped. Unwired mode feeds every rank the full batch.
+            d_rank, d_world = ((group.rank, group.world_size)
+                               if (group is not None and wired) else (0, 1))
+            sh = batch_sharding(mesh)
+            p = DevicePrefetcher(
+                corpus.batches(batch, seq, seed=args.data_seed,
+                               start_step=start, rank=d_rank,
+                               world_size=d_world),
+                sharding=(sh, sh))
+            return p, iter(p)
+
+        prefetch, batches = open_stream(start_step)
         obs.emit("data", path=args.data, corpus_tokens=len(corpus),
                  split=split)
         if args.eval_every:
@@ -401,19 +476,29 @@ def main(argv: "list[str] | None" = None) -> int:
         # Retention: only FINALIZED steps count, so an in-flight async
         # save can never be deleted (it is tmp-named until commit, and
         # once committed it is the newest). Partials and quarantined
-        # steps are never touched. Process 0 only: the pods share one
+        # steps are never touched. Primary only: the pods share one
         # RWX PVC and one deleter is enough (gc_steps is race-tolerant
         # besides, but N pods GC-ing the same dirs is pure noise).
-        if args.keep_last > 0 and rdv.process_id == 0:
+        if args.keep_last > 0 and primary:
             deleted = ckpt.gc_steps(args.ckpt_dir, args.keep_last)
             if deleted:
                 obs.emit("ckpt_gc", deleted=deleted,
                          keep_last=args.keep_last)
 
     def checkpoint_and_gc(step, *, blocking=False):
+        if group is not None and not wired and not primary:
+            # Unwired local-replica mode: every rank holds the identical
+            # full state (lockstep trajectories), so only the primary
+            # writes — N ranks racing tmp-renames into one shared tree
+            # would corrupt nothing but waste everything.
+            return
         with obs.phase("checkpoint", hist=obs.ckpt_save, kind="checkpoint",
                        step=step):
-            ckpt.save_bundle(args.ckpt_dir, step, bundle, blocking=blocking)
+            ckpt.save_bundle(
+                args.ckpt_dir, step, bundle, blocking=blocking,
+                primary=primary if group is not None else None,
+                world_size=(group.world_size if group is not None
+                            else rdv.num_processes))
         # NB: the emitted dict must stay exactly {event, step, async} —
         # tests assert it field-for-field.
         obs.emit("checkpoint", step=step, **{"async": not blocking})
@@ -424,62 +509,172 @@ def main(argv: "list[str] | None" = None) -> int:
     # on every process — the telemetry-drop writer that turns step/eval
     # busy-seconds into a real duty_cycle_pct for host tpu-info.
     httpd = None
-    if args.metrics_port and rdv.process_id == 0:
-        httpd = start_metrics_server(obs, args.metrics_port)
+    if args.metrics_port and primary:
+        if group is None:
+            httpd = start_metrics_server(obs, args.metrics_port)
+        else:
+            # Elastic: a transient split-brain (two ranks briefly
+            # believing they are primary) must degrade to a missing
+            # metrics surface, not a dead training rank.
+            try:
+                httpd = start_metrics_server(obs, args.metrics_port)
+            except OSError as e:
+                obs.emit("metrics_port_unavailable",
+                         port=args.metrics_port, error=str(e))
     tel = start_telemetry_thread(obs) if obs.enabled else None
 
     rng = jax.random.key(1234 + start_step)
     tokens_per_step = batch * seq
     last_done = last_saved = start_step
     preempted = False
+    # Membership poll cadence: one cheap readdir+stat per interval, never
+    # per-step on fast steps.
+    membership_poll_s = (max(0.5, elastic.heartbeat_s)
+                         if elastic is not None else 0.0)
+    next_poll = time.monotonic()
+
+    def poll_membership():
+        # Throttled liveness check against the shared ledger: a rank
+        # whose heartbeat went stale past the loss timeout is declared
+        # lost, which the loop turns into an in-process resync instead
+        # of a collective hang followed by a full Job restart.
+        nonlocal next_poll
+        if ledger is None or time.monotonic() < next_poll:
+            return
+        next_poll = time.monotonic() + membership_poll_s
+        lost = ledger.lost(group.ranks, elastic.loss_timeout_s)
+        if lost:
+            raise dist.MembershipChanged(lost, group.generation)
+
+    def one_step(step):
+        nonlocal rng, last_done, last_saved
+        poll_membership()
+        if chaos is not None:
+            chaos.fire("train_step")
+            if group is not None:
+                try:
+                    chaos.fire("rank_loss")
+                    if primary:
+                        chaos.fire("coordinator_loss")
+                except InjectedFault:
+                    # A hard rank loss (kubelet eviction, OOM kill): no
+                    # SIGTERM drain, no emergency checkpoint — survivors
+                    # must notice via the ledger, not a goodbye message.
+                    obs.emit("chaos_rank_exit", rank=rdv.process_id,
+                             generation=group.generation, step=last_done)
+                    os._exit(1)
+        t_w = time.perf_counter()
+        if prefetch is not None:
+            inputs, labels = next(batches)
+        else:
+            rng, k = jax.random.split(rng)
+            inputs, labels = synth_token_batch(k, batch, seq, vocab)
+        if obs.enabled:
+            obs.data_wait.observe(time.perf_counter() - t_w)
+        t0 = time.perf_counter()
+        with obs.span("step", step=step + 1):
+            try:
+                loss = bundle.run(inputs, labels)
+            except Exception:
+                # A wired collective dying mid-step usually means a peer
+                # died under it: when the ledger agrees, resync instead
+                # of crashing the survivor.
+                if ledger is not None:
+                    lost = ledger.lost(group.ranks, elastic.loss_timeout_s)
+                    if lost:
+                        raise dist.MembershipChanged(
+                            lost, group.generation) from None
+                raise
+        dt = time.perf_counter() - t0
+        obs.probe_recompiles(
+            getattr(bundle.step_fn, "_cache_size", lambda: None)())
+        tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
+        obs.emit(
+            "step", step=step + 1, loss=round(loss, 4),
+            step_s=round(dt, 4),
+            tokens_per_s=round(tokens_per_step / dt, 1),
+            tflops_per_chip=round(tflops, 2),
+            mfu=round(tflops / peak, 4) if peak else None)
+        last_done = step + 1
+        if args.eval_every and (step + 1) % args.eval_every == 0:
+            import math
+
+            t_ev = time.perf_counter()
+            with obs.phase("eval", hist=obs.eval_s, kind="eval",
+                           step=step + 1):
+                losses = [bundle.evaluate(x, y)
+                          for x, y in eval_batches_fn()]
+            obs.observe_eval_busy(time.perf_counter() - t_ev)
+            ev = sum(losses) / len(losses)
+            obs.emit("eval", step=step + 1, loss=round(ev, 4),
+                     ppl=round(math.exp(min(ev, 30.0)), 2),
+                     batches=len(losses))
+        if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
+            # Async: the persist overlaps the next steps' compute; the
+            # next save (or the final wait) drains it.
+            checkpoint_and_gc(step + 1)
+            last_saved = step + 1
+
     if obs.enabled:
         obs.goodput.enter("productive")
     try:
-        for step in range(start_step, args.steps):
-            if stop.is_set():
+        while True:
+            try:
+                for step in range(start_step, args.steps):
+                    if stop.is_set():
+                        break
+                    one_step(step)
                 break
-            if chaos is not None:
-                chaos.fire("train_step")
-            t_w = time.perf_counter()
-            if prefetch is not None:
-                inputs, labels = next(batches)
-            else:
-                rng, k = jax.random.split(rng)
-                inputs, labels = synth_token_batch(k, batch, seq, vocab)
-            if obs.enabled:
-                obs.data_wait.observe(time.perf_counter() - t_w)
-            t0 = time.perf_counter()
-            with obs.span("step", step=step + 1):
-                loss = bundle.run(inputs, labels)
-            dt = time.perf_counter() - t0
-            obs.probe_recompiles(
-                getattr(bundle.step_fn, "_cache_size", lambda: None)())
-            tflops = 6.0 * n_params * tokens_per_step / dt / 1e12 / n_chips
-            obs.emit(
-                "step", step=step + 1, loss=round(loss, 4),
-                step_s=round(dt, 4),
-                tokens_per_s=round(tokens_per_step / dt, 1),
-                tflops_per_chip=round(tflops, 2),
-                mfu=round(tflops / peak, 4) if peak else None)
-            last_done = step + 1
-            if args.eval_every and (step + 1) % args.eval_every == 0:
-                import math
-
-                t_ev = time.perf_counter()
-                with obs.phase("eval", hist=obs.eval_s, kind="eval",
-                               step=step + 1):
-                    losses = [bundle.evaluate(x, y)
-                              for x, y in eval_batches_fn()]
-                obs.observe_eval_busy(time.perf_counter() - t_ev)
-                ev = sum(losses) / len(losses)
-                obs.emit("eval", step=step + 1, loss=round(ev, 4),
-                         ppl=round(math.exp(min(ev, 30.0)), 2),
-                         batches=len(losses))
-            if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
-                # Async: the persist overlaps the next steps' compute; the
-                # next save (or the final wait) drains it.
-                checkpoint_and_gc(step + 1)
-                last_saved = step + 1
+            except dist.MembershipChanged as mc:
+                if group is None:
+                    raise
+                # The tentpole path: survivors re-form at generation+1,
+                # rebuild mesh + jit at the new topology, restore the
+                # last finalized checkpoint, and re-partition the SAME
+                # deterministic data stream across the new world — no
+                # driver, no Job restart, no sample trained twice.
+                # RendezvousError here propagates: exit nonzero and fall
+                # back to the Job-restart recovery of PR 4.
+                t_rs = time.monotonic()
+                obs.begin_resync()
+                obs.emit("elastic_membership_lost", lost=list(mc.lost),
+                         generation=mc.generation, step=last_done)
+                if prefetch is not None:
+                    prefetch.close()
+                    prefetch = batches = None
+                ckpt.wait_for_saves()
+                if wired:
+                    dist.unwire_jax()
+                group = dist.elastic_rendezvous(
+                    elastic, ledger, rdv.process_id,
+                    group.generation + 1, chaos=chaos, emit=obs.emit)
+                wired = dist.wire_jax_for_group(group)
+                primary = group.is_primary
+                mesh = build_mesh()
+                bundle = build_bundle(mesh)
+                start_step = (resume_from_checkpoint()
+                              if args.ckpt_dir else 0)
+                rng = jax.random.key(1234 + start_step)
+                last_done = last_saved = start_step
+                if args.data:
+                    prefetch, batches = open_stream(start_step)
+                if primary and httpd is None and args.metrics_port:
+                    # Primary duty may have just moved here; the dead
+                    # primary took its /metrics port with it, so serve
+                    # from the new one (non-fatal if the port is held).
+                    try:
+                        httpd = start_metrics_server(
+                            obs, args.metrics_port)
+                    except OSError as e:
+                        obs.emit("metrics_port_unavailable",
+                                 port=args.metrics_port, error=str(e))
+                obs.emit("elastic_resync", generation=group.generation,
+                         world_size=group.world_size,
+                         ranks=list(group.ranks), lost=list(mc.lost),
+                         resume_step=start_step,
+                         recovery_s=round(time.monotonic() - t_rs, 3))
+                if obs.enabled:
+                    obs.goodput.enter("productive")
 
         preempted = stop.is_set()
         if preempted:
@@ -540,6 +735,10 @@ def main(argv: "list[str] | None" = None) -> int:
                 # more retention pass leaves exactly --keep-last steps.
                 gc_now()
         _restore_handlers()
+        if ledger is not None:
+            # Stop the heartbeat daemon so in-process callers (tests)
+            # don't leak a thread touching a possibly-deleted tmpdir.
+            ledger.stop()
         if tel is not None:
             tel.stop_event.set()
         if httpd is not None:

@@ -63,10 +63,18 @@ def _fire(point: str) -> None:
         _chaos.fire(point)
 
 
-def _is_primary() -> bool:
+def _is_primary(override: "bool | None" = None) -> bool:
     """True on the process that owns shared-tree maintenance (manifest
     writes, retention GC). process 0 of the distributed job; trivially
-    true single-process."""
+    true single-process.
+
+    ``override`` lets the elastic train loop substitute its own notion of
+    primary: in unwired (local-replica) elastic mode every rank has
+    ``jax.process_index() == 0``, so primary-ness must come from the
+    elastic group's dense rank 0 — and it can MOVE to a different process
+    after a membership change."""
+    if override is not None:
+        return override
     try:
         return jax.process_index() == 0
     except Exception:  # noqa: BLE001 — backend not initialized yet
@@ -84,7 +92,9 @@ _async_ckptr = None
 # Steps whose async save has been scheduled but whose manifest is not yet
 # written (the manifest must only describe FINALIZED bytes, so it is
 # written at the drain points: the next save, or wait_for_saves()).
-_pending_manifests: "list[tuple[pathlib.Path, int]]" = []
+# Each entry carries the primary override and world size the save was
+# made under — a resync may change both before the manifest drains.
+_pending_manifests: "list[tuple[pathlib.Path, int, bool | None, int | None]]" = []
 
 
 def _flush_pending_manifests() -> None:
@@ -92,9 +102,9 @@ def _flush_pending_manifests() -> None:
     with no save in flight (right after wait_until_finished)."""
     global _pending_manifests
     pending, _pending_manifests = _pending_manifests, []
-    for root, step in pending:
-        if _is_primary() and _is_finalized_step(root / str(step)):
-            write_manifest(root, step)
+    for root, step, primary, world_size in pending:
+        if _is_primary(primary) and _is_finalized_step(root / str(step)):
+            write_manifest(root, step, world_size=world_size)
 
 
 def _async_checkpointer():
@@ -117,7 +127,9 @@ def wait_for_saves() -> None:
 
 def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
                      *, force: bool = True,
-                     blocking: bool = True) -> pathlib.Path:
+                     blocking: bool = True,
+                     primary: "bool | None" = None,
+                     world_size: "int | None" = None) -> pathlib.Path:
     """Write ``state`` (any pytree of jax.Arrays, e.g. a dict of
     params/batch_stats/opt_state) under ``directory/step``.
 
@@ -128,6 +140,10 @@ def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
     (a new save first drains the previous); ``latest_step`` already skips
     unfinalized steps, so an interrupted async save can never be resumed
     from.
+
+    ``primary`` overrides manifest-writer election (see ``_is_primary``);
+    ``world_size`` is recorded in the manifest so a resume can tell what
+    world wrote the checkpoint it restores across a membership change.
     """
     _fire("ckpt_save")
     root = pathlib.Path(directory).resolve()
@@ -136,8 +152,8 @@ def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
         ckptr = _checkpointer()
         ckptr.save(path, state, force=force)
         ckptr.wait_until_finished()
-        if _is_primary():  # orbax's commit barrier has run; one writer
-            write_manifest(root, step)
+        if _is_primary(primary):  # orbax's commit barrier has run; one writer
+            write_manifest(root, step, world_size=world_size)
     else:
         import orbax.checkpoint as ocp
 
@@ -145,7 +161,7 @@ def save_train_state(directory: str | pathlib.Path, step: int, state: Any,
         ckptr.wait_until_finished()  # previous in-flight save must land
         _flush_pending_manifests()
         ckptr.save(path, args=ocp.args.StandardSave(state), force=force)
-        _pending_manifests.append((root, step))
+        _pending_manifests.append((root, step, primary, world_size))
     return path
 
 
@@ -265,8 +281,8 @@ def _file_digest(path: pathlib.Path) -> str:
     return h.hexdigest()
 
 
-def write_manifest(directory: str | pathlib.Path,
-                   step: int) -> pathlib.Path:
+def write_manifest(directory: str | pathlib.Path, step: int,
+                   *, world_size: "int | None" = None) -> pathlib.Path:
     """Record every host-visible file of a FINALIZED step (relative path,
     byte size, sha256) so a later boot can prove the bytes it is about to
     resume from are the bytes that were committed. Written atomically
@@ -284,10 +300,29 @@ def write_manifest(directory: str | pathlib.Path,
                           "sha256": _file_digest(p)})
     mpath = _manifest_path(root, step)
     mpath.parent.mkdir(parents=True, exist_ok=True)
+    record: "dict[str, Any]" = {"step": step, "files": files}
+    if world_size is not None:
+        # The world size that WROTE this step: restore across a
+        # membership change targets the new bundle's shardings, so this
+        # is diagnostic (which generation produced the bytes), not a
+        # restore precondition.
+        record["world_size"] = world_size
     tmp = mpath.parent / f".{step}.json.tmp.{os.getpid()}"
-    tmp.write_text(json.dumps({"step": step, "files": files}, indent=1))
+    tmp.write_text(json.dumps(record, indent=1))
     os.replace(tmp, mpath)
     return mpath
+
+
+def manifest_world_size(directory: str | pathlib.Path,
+                        step: int) -> "int | None":
+    """The ``world_size`` recorded in a step's manifest, if any (older
+    manifests and manifestless steps return None)."""
+    mpath = _manifest_path(pathlib.Path(directory).resolve(), step)
+    try:
+        ws = json.loads(mpath.read_text()).get("world_size")
+        return int(ws) if ws is not None else None
+    except (OSError, ValueError):
+        return None
 
 
 def verify_step(directory: str | pathlib.Path,
@@ -369,13 +404,14 @@ def gc_steps(directory: str | pathlib.Path, keep_last: int) -> "list[int]":
 
 
 def save_bundle(directory: str | pathlib.Path, step: int, bundle,
-                *, blocking: bool = True) -> pathlib.Path:
+                *, blocking: bool = True, primary: "bool | None" = None,
+                world_size: "int | None" = None) -> pathlib.Path:
     """Checkpoint a parallel.train.TrainBundle's mutable state."""
     return save_train_state(directory, step, {
         "params": bundle.params,
         "batch_stats": bundle.batch_stats,
         "opt_state": bundle.opt_state,
-    }, blocking=blocking)
+    }, blocking=blocking, primary=primary, world_size=world_size)
 
 
 def restore_bundle(directory: str | pathlib.Path, step: int, bundle) -> None:

@@ -1,0 +1,58 @@
+"""Environment-variable parsing with degrade-not-crash semantics.
+
+Operational knobs (timeouts, retry budgets, ports) arrive through the
+environment, usually typed by a human into a Job manifest.  A typo'd
+value must not crash a training job at boot — the knob silently falls
+back to its shipped default, which is always a safe value.  This module
+is the single home for that contract; ``parallel/distributed.py``,
+``parallel/train_job.py`` and ``bench.py`` previously each carried their
+own copy of these parsers.
+
+``parallel.distributed`` re-exports ``_env_float``/``_env_int`` for
+backwards compatibility with existing imports.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["env_float", "env_int", "env_flag"]
+
+
+def env_float(name: str, default: float) -> float:
+    """Parse ``name`` as a float; unset or malformed -> ``default``."""
+    try:
+        return float(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Parse ``name`` as an int; unset or malformed -> ``default``.
+
+    Note a float-looking value ("1.5") is malformed for an int knob and
+    falls back rather than truncating: a knob that silently means
+    something other than what was typed is worse than one that reverts
+    to a documented default.
+    """
+    try:
+        return int(os.environ.get(name, ""))
+    except ValueError:
+        return default
+
+
+def env_flag(name: str, default: bool = False) -> bool:
+    """Parse ``name`` as a boolean toggle.
+
+    "1"/"true"/"yes"/"on" (case-insensitive) -> True, "0"/"false"/
+    "no"/"off"/"" -> False, unset or anything else -> ``default``.
+    """
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    val = raw.strip().lower()
+    if val in ("1", "true", "yes", "on"):
+        return True
+    if val in ("0", "false", "no", "off", ""):
+        return False
+    return default

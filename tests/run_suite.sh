@@ -27,8 +27,10 @@
 #            chaos/containment suite (fault injection + recovery
 #            invariants), and the training-resilience suite (SIGTERM
 #            checkpointing, quarantine, retention, bounded rendezvous),
-#            and the fleet tier (node exporter, health labeling,
-#            tpu_top) ride along minus their @slow soak/bench tests
+#            the fleet tier (node exporter, health labeling, tpu_top),
+#            and the elastic-membership suite (env-knob parsing, ledger
+#            liveness, rank-loss detection -> re-rendezvous -> resume)
+#            ride along minus their @slow soak/bench tests
 #            (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
@@ -50,6 +52,7 @@ SMOKE=(
   tests/test_chaos.py tests/test_train_resilience.py
   tests/test_train_obs.py tests/test_metrics_lint.py
   tests/test_node_obs.py
+  tests/test_env.py tests/test_elastic.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -255,3 +255,74 @@ def test_sharded_corpus_ignores_stray_files(tmp_path):
         only_stray.mkdir()
         (only_stray / "README.md").write_text("x")
         TokenCorpus(only_stray, 128)
+
+
+# --- elastic re-sharding (ISSUE 8): world-size-invariant global order -----
+
+
+def test_batch_row_span_partitions_exactly():
+    from k3stpu.parallel.sharding import batch_row_span
+
+    for world in (1, 2, 3, 4, 6, 12):
+        spans = [batch_row_span(12, r, world) for r in range(world)]
+        # Contiguous, ordered, and an exact partition of [0, 12).
+        assert spans[0][0] == 0 and spans[-1][1] == 12
+        for (lo_a, hi_a), (lo_b, _) in zip(spans, spans[1:]):
+            assert hi_a == lo_b > lo_a
+
+
+def test_batch_row_span_rejects_bad_shapes():
+    from k3stpu.parallel.sharding import batch_row_span
+
+    with pytest.raises(ValueError, match="not divisible"):
+        batch_row_span(12, 0, 5)
+    with pytest.raises(ValueError, match="outside"):
+        batch_row_span(12, 4, 4)
+    with pytest.raises(ValueError, match="< 1"):
+        batch_row_span(12, 0, 0)
+
+
+def test_rank_slices_reassemble_the_global_batch(corpus):
+    """Every rank draws the same (seed, step)-keyed global rows and keeps
+    its contiguous block: stacking the per-rank slices must reproduce the
+    world-size-1 stream bit for bit."""
+    for world in (2, 3, 4):
+        whole = corpus.batches(batch=12, seq=16, seed=9)
+        parts = [corpus.batches(batch=12, seq=16, seed=9, rank=r,
+                                world_size=world) for r in range(world)]
+        for _ in range(4):
+            inputs, labels = next(whole)
+            got = [next(p) for p in parts]
+            np.testing.assert_array_equal(
+                inputs, np.concatenate([g[0] for g in got]))
+            np.testing.assert_array_equal(
+                labels, np.concatenate([g[1] for g in got]))
+
+
+def test_reshard_mid_stream_no_dup_no_gap(corpus):
+    """The elastic resync scenario: world 4 trains steps 0-2, rank 3
+    dies, the survivors re-shard to world 3 and resume at step 3 from
+    the checkpoint. The union of rows trained per step must equal the
+    global batch at EVERY step — nothing double-trained, nothing
+    skipped, before or after the membership change."""
+    batch, seq, seed = 12, 16, 11
+    reference = corpus.batches(batch, seq, seed=seed)
+    ref_steps = [next(reference) for _ in range(6)]
+
+    trained = []  # per step: list of (inputs, labels) rank slices
+    gen0 = [corpus.batches(batch, seq, seed=seed, rank=r, world_size=4)
+            for r in range(4)]
+    for _ in range(3):
+        trained.append([next(s) for s in gen0])
+    gen1 = [corpus.batches(batch, seq, seed=seed, start_step=3, rank=r,
+                           world_size=3) for r in range(3)]
+    for _ in range(3):
+        trained.append([next(s) for s in gen1])
+
+    for step, slices in enumerate(trained):
+        np.testing.assert_array_equal(
+            ref_steps[step][0], np.concatenate([s[0] for s in slices]),
+            err_msg=f"step {step}")
+        np.testing.assert_array_equal(
+            ref_steps[step][1], np.concatenate([s[1] for s in slices]),
+            err_msg=f"step {step}")

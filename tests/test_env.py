@@ -1,0 +1,68 @@
+"""k3stpu.utils.env: the one tolerant env-knob parser (ISSUE 8 satellite).
+
+Every K3STPU_* numeric knob is read on a path that must never die in a
+ValueError (SIGTERM handlers, rendezvous retries, elastic heartbeats), so
+the contract is: unset OR malformed -> default, never an exception.
+"""
+
+import pytest
+
+from k3stpu.utils.env import env_flag, env_float, env_int
+
+
+def test_env_float_unset_returns_default(monkeypatch):
+    monkeypatch.delenv("K3STPU_T_FLOAT", raising=False)
+    assert env_float("K3STPU_T_FLOAT", 2.5) == 2.5
+
+
+def test_env_float_parses(monkeypatch):
+    monkeypatch.setenv("K3STPU_T_FLOAT", "0.25")
+    assert env_float("K3STPU_T_FLOAT", 2.5) == 0.25
+
+
+def test_env_float_malformed_returns_default(monkeypatch):
+    monkeypatch.setenv("K3STPU_T_FLOAT", "ninety")
+    assert env_float("K3STPU_T_FLOAT", 2.5) == 2.5
+
+
+def test_env_int_unset_and_parse(monkeypatch):
+    monkeypatch.delenv("K3STPU_T_INT", raising=False)
+    assert env_int("K3STPU_T_INT", 7) == 7
+    monkeypatch.setenv("K3STPU_T_INT", "42")
+    assert env_int("K3STPU_T_INT", 7) == 42
+
+
+@pytest.mark.parametrize("bad", ["", "x", "1.5", " 3 3"])
+def test_env_int_malformed_returns_default(monkeypatch, bad):
+    # "1.5" is the important case: int("1.5") raises, and a knob
+    # documented as an int must not half-accept floats.
+    monkeypatch.setenv("K3STPU_T_INT", bad)
+    assert env_int("K3STPU_T_INT", 7) == 7
+
+
+@pytest.mark.parametrize("val,expect", [
+    ("1", True), ("true", True), ("TRUE", True), ("yes", True),
+    ("on", True), ("0", False), ("false", False), ("no", False),
+    ("off", False), ("", False),
+])
+def test_env_flag_spellings(monkeypatch, val, expect):
+    monkeypatch.setenv("K3STPU_T_FLAG", val)
+    assert env_flag("K3STPU_T_FLAG") is expect
+
+
+def test_env_flag_unset_and_unknown_use_default(monkeypatch):
+    monkeypatch.delenv("K3STPU_T_FLAG", raising=False)
+    assert env_flag("K3STPU_T_FLAG") is False
+    assert env_flag("K3STPU_T_FLAG", True) is True
+    monkeypatch.setenv("K3STPU_T_FLAG", "maybe")
+    assert env_flag("K3STPU_T_FLAG", True) is True
+
+
+def test_distributed_reexports_stay_importable():
+    # Pre-existing callers import the underscore names from
+    # distributed.py (tests/test_train_resilience.py does); the
+    # consolidation must keep that surface alive.
+    from k3stpu.parallel.distributed import _env_float, _env_int
+
+    assert _env_float is env_float
+    assert _env_int is env_int

@@ -141,6 +141,30 @@ def test_train_job_preemption_budget():
     assert "--keep-last" in cmd and int(cmd[cmd.index("--keep-last") + 1]) >= 2
 
 
+def test_train_job_ignores_clean_preemption_exits():
+    """A preemption exit (PREEMPTED_EXIT_CODE) means the pod checkpointed
+    and left on purpose. The Job must retry it WITHOUT spending
+    backoffLimit, or a flapping spot pool exhausts the budget with clean
+    departures and the run dies restartable-but-unrestarted."""
+    from k3stpu.parallel.train_job import PREEMPTED_EXIT_CODE
+
+    docs = load_all("tpu-train-job.yaml")
+    (job,) = by_kind(docs, "Job")
+    spec = job["spec"]
+    rules = spec["podFailurePolicy"]["rules"]
+    ignored = [
+        r for r in rules
+        if r["action"] == "Ignore"
+        and PREEMPTED_EXIT_CODE in r["onExitCodes"]["values"]
+    ]
+    (rule,) = ignored
+    # The rule must name the training container explicitly: a sidecar
+    # exiting 42 is not a preemption.
+    (ctr,) = spec["template"]["spec"]["containers"]
+    assert rule["onExitCodes"]["containerName"] == ctr["name"]
+    assert rule["onExitCodes"]["operator"] == "In"
+
+
 def test_train_job_scrape_and_telemetry_wiring():
     # Process 0 serves /metrics on --metrics-port (obs/train.py); the pod
     # annotations must advertise exactly that port, and it must not

@@ -407,3 +407,53 @@ def test_live_train_job_scrape_and_goodput_acceptance(tmp_path):
     data = json.loads(drop.read_text())
     for d in data["devices"]:
         assert 0 <= d["duty_cycle_pct"] <= 100
+
+
+# --- elastic resync accounting (ISSUE 8) ----------------------------------
+
+
+def test_begin_resync_inside_phase_keeps_recovery_bucket(capsys):
+    """The goodput fix: a membership change detected while a
+    checkpoint/eval phase is open must close that bucket and charge the
+    rest of the window to 'recovery' — the unwinding phase scope must
+    NOT blindly re-enter its captured previous bucket (which would bill
+    the whole resync to 'productive' and break sum==elapsed honesty)."""
+    clk = FakeClock()
+    obs = TrainObs(clock=clk)
+    obs.goodput.enter("productive")
+    clk.tick(5.0)
+    with obs.phase("checkpoint"):
+        clk.tick(2.0)
+        obs.begin_resync()
+        clk.tick(1.0)
+    assert obs.goodput.bucket == "recovery"  # phase exit did not restore
+    clk.tick(4.0)
+    totals = obs.goodput.totals()
+    assert totals["productive"] == 5.0
+    assert totals["checkpoint"] == 2.0
+    assert totals["recovery"] == 5.0
+    assert sum(totals.values()) == pytest.approx(obs.goodput.elapsed())
+    # The NEXT phase (fresh epoch) restores normally again.
+    with obs.phase("eval"):
+        clk.tick(1.0)
+    assert obs.goodput.bucket == "recovery"
+    obs.goodput.enter("productive")
+    with obs.phase("checkpoint"):
+        clk.tick(1.0)
+    assert obs.goodput.bucket == "productive"
+    capsys.readouterr()
+
+
+def test_elastic_resync_event_updates_counters_and_world_gauge(capsys):
+    obs = TrainObs()
+    obs.emit("train_start", model="tiny", num_processes=4)
+    assert obs.world_size.value == 4.0
+    obs.emit("elastic_resync", generation=1, world_size=3, ranks=[0, 1, 3],
+             lost=[2], resume_step=10, recovery_s=0.2)
+    assert obs.elastic_resyncs.value == 1
+    assert obs.elastic_lost.value == 1
+    assert obs.world_size.value == 3.0
+    text = obs.render_prometheus()
+    assert "k3stpu_train_world_size 3" in text
+    assert "k3stpu_train_elastic_resyncs_total 1" in text
+    capsys.readouterr()
