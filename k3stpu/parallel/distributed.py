@@ -259,7 +259,13 @@ def initialize(rdv: Rendezvous | None = None, *,
 #                    generation so a half-closed socket from generation
 #                    g-1 can never be mistaken for the new group
 #   group manifest   {generation, ranks, world_size} — original rank ids
-#                    plus each survivor's dense index in the new world
+#                    plus each survivor's dense index in the new world;
+#                    persisted to the ledger (group-<g>.json) so a pod
+#                    recreated by the Indexed Job boots at the CURRENT
+#                    generation (latest_group) and rejoins via the
+#                    survivors' joiner detection, instead of crash-
+#                    looping a gen-0 barrier until backoffLimit kills
+#                    the whole Job
 #
 # The barrier deliberately does NOT use the XLA coordination service: on
 # peer death that client aborts the process from a background thread
@@ -277,13 +283,18 @@ DEFAULT_LOSS_TIMEOUT_S = 10.0
 
 
 class MembershipChanged(RuntimeError):
-    """Raised inside the step loop when the ledger says a peer is gone."""
+    """Raised inside the step loop when the ledger says membership moved:
+    a peer died (``lost``) and/or a recreated pod is heartbeating outside
+    the current group, waiting to rejoin at the next generation
+    (``gained``)."""
 
-    def __init__(self, lost, generation: int):
+    def __init__(self, lost, generation: int, gained=()):
         self.lost = sorted(lost)
+        self.gained = sorted(gained)
         self.generation = generation
         super().__init__(
-            f"lost ranks {self.lost} in generation {generation}")
+            f"lost ranks {self.lost}, gained ranks {self.gained} "
+            f"in generation {generation}")
 
 
 @dataclass(frozen=True)
@@ -399,6 +410,63 @@ class MembershipLedger:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
 
+    def remove(self, rank: int) -> None:
+        """Best-effort heartbeat removal on clean exit (after ``stop``),
+        so survivors see the departure immediately instead of waiting
+        out the staleness timeout on a ghost file — and so a failed
+        rejoin attempt cannot poison a later coordinator election."""
+        try:
+            os.unlink(self._path(rank))
+        except OSError:
+            pass
+
+    def _group_path(self, generation: int) -> str:
+        return os.path.join(self.directory, f"group-{generation:08d}.json")
+
+    def write_group(self, group: "ElasticGroup") -> None:
+        """Persist the finalized group manifest, one append-only file per
+        generation. A recreated pod reads :meth:`latest_group` on boot to
+        learn where the run's membership actually is — assuming
+        generation 0 after a resync would leave it dialing ports nobody
+        listens on until it burns the Job's backoffLimit."""
+        payload = {"generation": group.generation,
+                   "ranks": list(group.ranks),
+                   "world_size": group.world_size,
+                   "coordinator_address": group.coordinator_address,
+                   "ts": time.time()}
+        path = self._group_path(group.generation)
+        tmp = path + f".tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        # Keep a short trailing history for debugging; prune the rest.
+        for g in range(max(0, group.generation - 8)):
+            try:
+                os.unlink(self._group_path(g))
+            except OSError:
+                pass
+
+    def latest_group(self) -> "dict | None":
+        """Newest persisted group manifest, or None on a cold ledger."""
+        best = None
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return None
+        for name in names:
+            if not (name.startswith("group-") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, name),
+                          encoding="utf-8") as f:
+                    rec = json.load(f)
+                rec["generation"] = int(rec["generation"])
+            except (OSError, ValueError, KeyError, TypeError):
+                continue  # torn/foreign file: ignore
+            if best is None or rec["generation"] > best["generation"]:
+                best = rec
+        return best
+
     def read(self) -> "dict[int, dict]":
         """All heartbeat records keyed by rank, with ``age_s`` attached."""
         out: dict[int, dict] = {}
@@ -427,6 +495,31 @@ class MembershipLedger:
     def lost(self, expected, timeout_s: float) -> "set[int]":
         """Members of ``expected`` whose heartbeat is stale or missing."""
         return set(expected) - self.alive(timeout_s)
+
+
+def membership_delta(ledger: MembershipLedger, ranks, generation: int,
+                     timeout_s: float) -> "tuple[set[int], set[int]]":
+    """``(lost, gained)`` of the group ``ranks`` finalized at
+    ``generation``, per the ledger.
+
+    ``lost``: members whose heartbeat is stale or missing — plus members
+    whose FRESH heartbeat carries a generation older than the group's:
+    that is a recreated pod heartbeating under a finalized member's rank
+    before the survivors noticed the death, so the process the group was
+    formed with is gone (its replacement counts as ``gained``).
+    ``gained``: live ranks outside the group — recreated pods waiting to
+    rejoin at the next generation."""
+    records = ledger.read()
+    alive = {r for r, rec in records.items() if rec["age_s"] < timeout_s}
+    current = set(ranks)
+    reborn = set()
+    for r in alive & current:
+        try:
+            if int(records[r].get("generation", 0)) < generation:
+                reborn.add(r)
+        except (TypeError, ValueError):
+            continue
+    return (current - alive) | reborn, (alive - current) | reborn
 
 
 @dataclass(frozen=True)
@@ -482,6 +575,7 @@ def _run_coordinator(cfg: ElasticConfig, my_rank: int, generation: int,
         start = time.monotonic()
         deadline = start + timeout_s
         cap = cfg.max_world or (len(expected) if expected else 0)
+        formed = False
         while time.monotonic() < deadline:
             known_alive = ledger.alive(cfg.loss_timeout_s) | {my_rank}
             want = set(expected) if expected is not None else known_alive
@@ -499,14 +593,29 @@ def _run_coordinator(cfg: ElasticConfig, my_rank: int, generation: int,
                     f"abdicating coordination to alive lower rank "
                     f"{min(lower)}")
             if cap and len(arrived) >= cap:
+                formed = True
                 break  # roster capped: once full, stop waiting for more
-            if arrived >= want:
-                break
-            if (arrived >= (want & known_alive)
+            if expected is not None:
+                # Pinned roster (cold boot): ONLY the full roster forms a
+                # group. A settle-break here would let the first pod up
+                # finalize a singleton while its peers are still pulling
+                # images — and a pinned-roster group has no way to grow,
+                # so the latecomers would crash-loop the Job to death.
+                # Missing ranks at the deadline -> raise, retry, and let
+                # backoffLimit restart the world.
+                if arrived >= want:
+                    formed = True
+                    break
+            elif (arrived >= known_alive
                     and time.monotonic() - start >= cfg.settle_s
                     and len(arrived) >= cfg.min_world):
-                # Everyone the ledger still believes in has arrived and
-                # the settle window has passed: finalize without the dead.
+                # Open roster (resync/rejoin): everyone the ledger still
+                # believes in has arrived and the settle window has
+                # passed — finalize without the dead. The settle delay
+                # gives a just-restarted peer time to land its first
+                # heartbeat before a lone early rank finalizes a
+                # singleton.
+                formed = True
                 break
             try:
                 conn, _ = srv.accept()
@@ -536,6 +645,19 @@ def _run_coordinator(cfg: ElasticConfig, my_rank: int, generation: int,
                 old.close()
             conns[peer] = conn
             arrived.add(peer)
+        if not formed:
+            # Deadline expired without meeting a formation condition.
+            # Finalizing whatever happened to arrive would split the
+            # brain (a rejoining rank timing out here must NOT start a
+            # second group beside the survivors it failed to meet) —
+            # raise instead, and let the retry/backoffLimit machinery
+            # decide.
+            raise RendezvousError(
+                f"elastic generation {generation}: timed out after "
+                f"{timeout_s:.1f}s with only {sorted(arrived)} arrived "
+                + (f"of expected {sorted(expected)} "
+                   if expected is not None else "")
+                + f"(min_world={cfg.min_world})")
         if len(arrived) < cfg.min_world:
             raise RendezvousError(
                 f"elastic generation {generation}: only {sorted(arrived)} "
@@ -671,6 +793,10 @@ def elastic_rendezvous(cfg: ElasticConfig, ledger: MembershipLedger,
     group = out["group"]
     ledger.set_generation(group.generation)
     ledger.write_heartbeat(my_rank, cfg.advertise_address)
+    # Persist the manifest: a pod recreated AFTER this generation reads
+    # it on boot and rejoins at generation+1 instead of crash-looping a
+    # gen-0 barrier nobody listens on any more.
+    ledger.write_group(group)
     return group
 
 

@@ -165,12 +165,45 @@ def main(argv: "list[str] | None" = None) -> int:
         ledger = dist.MembershipLedger(elastic.ledger_dir)
         ledger.start_heartbeat(rdv.process_id, elastic.advertise_address,
                                interval_s=elastic.heartbeat_s)
-        with obs.phase("rendezvous"):
-            group = dist.elastic_rendezvous(
-                elastic, ledger, rdv.process_id, 0,
-                expected=range(rdv.num_processes), chaos=chaos,
-                emit=obs.emit)
-            wired = dist.wire_jax_for_group(group)
+        if not args.ckpt_dir:
+            # Loud and early: without a checkpoint tree an elastic
+            # resync can only rebuild FRESH weights at step 0 — the
+            # processes survive a membership change, the training
+            # progress does not.
+            obs.emit("elastic_without_checkpoint",
+                     warning="no --ckpt-dir: an elastic resync restarts "
+                             "from freshly initialized weights at step 0")
+        # A recreated pod must NOT assume generation 0: the survivors
+        # may have resynced past it, and nobody listens on the gen-0
+        # barrier port any more. The ledger's persisted group manifest
+        # says where the run's membership actually is — join one
+        # generation past it with an OPEN roster and let the survivors'
+        # joiner detection pull them into the same rendezvous. A cold
+        # ledger (no manifest) is a first boot: the full Indexed-Job
+        # roster is pinned and required.
+        prior = ledger.latest_group()
+        boot_gen = 0 if prior is None else int(prior["generation"]) + 1
+        boot_expected = range(rdv.num_processes) if prior is None else None
+        try:
+            with obs.phase("rendezvous"):
+                group = dist.elastic_rendezvous(
+                    elastic, ledger, rdv.process_id, boot_gen,
+                    expected=boot_expected, chaos=chaos, emit=obs.emit)
+                wired = dist.wire_jax_for_group(group)
+        except dist.RendezvousError as e:
+            if prior is None:
+                raise
+            # An unjoinable replacement (survivors busy, world gone,
+            # min_world unmet) must not burn the Job's backoffLimit into
+            # whole-Job death while healthy ranks train on: exit with
+            # the code the podFailurePolicy ignores, drop our heartbeat
+            # so it cannot poison a later coordinator election, and let
+            # the recreated pod retry against a fresh ledger read.
+            obs.emit("elastic_rejoin_failed", generation=boot_gen,
+                     error=f"{type(e).__name__}: {e}"[:300])
+            ledger.stop()
+            ledger.remove(rdv.process_id)
+            return PREEMPTED_EXIT_CODE
     else:
         with obs.phase("rendezvous"):
             rdv = initialize(chaos=chaos, emit=obs.emit)
@@ -430,18 +463,20 @@ def main(argv: "list[str] | None" = None) -> int:
                              holdout_fraction=args.holdout_fraction)
 
         def open_stream(start):
-            # Each wired elastic rank streams its contiguous row span of
-            # the FIXED global batch (sharding.batch_row_span), so a
+            # Every rank streams the FULL global batch: in multi-process
+            # JAX, device_put against the cross-process 'data' sharding
+            # treats the host array as the GLOBAL value and transfers
+            # only the rows living on this process's devices — so a
             # resync at a new world size re-partitions the same
-            # (seed, step)-keyed rows — no sample double-trained or
-            # skipped. Unwired mode feeds every rank the full batch.
-            d_rank, d_world = ((group.rank, group.world_size)
-                               if (group is not None and wired) else (0, 1))
+            # (seed, step)-keyed rows with no sample double-trained or
+            # skipped. Feeding a per-rank slice here would silently
+            # SHRINK the global batch by world_size (the slice would be
+            # re-read as the whole batch); one_step asserts the global
+            # shape against that regression.
             sh = batch_sharding(mesh)
             p = DevicePrefetcher(
                 corpus.batches(batch, seq, seed=args.data_seed,
-                               start_step=start, rank=d_rank,
-                               world_size=d_world),
+                               start_step=start),
                 sharding=(sh, sh))
             return p, iter(p)
 
@@ -533,18 +568,41 @@ def main(argv: "list[str] | None" = None) -> int:
                          if elastic is not None else 0.0)
     next_poll = time.monotonic()
 
+    # Scale-up cap for joiner detection: a recreated pod can bring the
+    # world back up to the Job's size (or K3STPU_ELASTIC_MAX_WORLD).
+    world_cap = ((elastic.max_world or rdv.num_processes)
+                 if elastic is not None else 0)
+
     def poll_membership():
-        # Throttled liveness check against the shared ledger: a rank
-        # whose heartbeat went stale past the loss timeout is declared
-        # lost, which the loop turns into an in-process resync instead
-        # of a collective hang followed by a full Job restart.
+        # Throttled membership check against the shared ledger: a stale
+        # heartbeat (death) becomes an in-process resync instead of a
+        # collective hang followed by a full Job restart — and a FRESH
+        # heartbeat from outside the group (a pod the Indexed Job
+        # recreated, parked at generation+1 waiting for us) becomes a
+        # scale-up resync instead of a permanently shrunken world and a
+        # replacement crash-looping toward Job death.
         nonlocal next_poll
         if ledger is None or time.monotonic() < next_poll:
             return
         next_poll = time.monotonic() + membership_poll_s
-        lost = ledger.lost(group.ranks, elastic.loss_timeout_s)
+        lost, gained = dist.membership_delta(
+            ledger, group.ranks, group.generation, elastic.loss_timeout_s)
+        if gained and not lost and group.world_size >= world_cap:
+            gained = set()  # world already at cap: joiners must wait
+        if lost or gained:
+            raise dist.MembershipChanged(lost, group.generation,
+                                         gained=gained)
+
+    def raise_if_membership_changed():
+        # A wired collective (step, eval, checkpoint gather) dying
+        # usually means a peer died under it: when the ledger agrees,
+        # resync instead of crashing the survivor into a Job restart.
+        if ledger is None:
+            return
+        lost, _ = dist.membership_delta(
+            ledger, group.ranks, group.generation, elastic.loss_timeout_s)
         if lost:
-            raise dist.MembershipChanged(lost, group.generation)
+            raise dist.MembershipChanged(lost, group.generation) from None
 
     def one_step(step):
         nonlocal rng, last_done, last_saved
@@ -571,19 +629,17 @@ def main(argv: "list[str] | None" = None) -> int:
             inputs, labels = synth_token_batch(k, batch, seq, vocab)
         if obs.enabled:
             obs.data_wait.observe(time.perf_counter() - t_w)
+        # Elastic invariant: whatever the world size, bundle.run sees the
+        # full GLOBAL batch (wired mode shards its rows across processes
+        # via the 'data' sharding; a per-rank slice leaking in here would
+        # silently train on batch/world rows).
+        assert inputs.shape[0] == batch, (inputs.shape, batch)
         t0 = time.perf_counter()
         with obs.span("step", step=step + 1):
             try:
                 loss = bundle.run(inputs, labels)
             except Exception:
-                # A wired collective dying mid-step usually means a peer
-                # died under it: when the ledger agrees, resync instead
-                # of crashing the survivor.
-                if ledger is not None:
-                    lost = ledger.lost(group.ranks, elastic.loss_timeout_s)
-                    if lost:
-                        raise dist.MembershipChanged(
-                            lost, group.generation) from None
+                raise_if_membership_changed()
                 raise
         dt = time.perf_counter() - t0
         obs.probe_recompiles(
@@ -602,8 +658,14 @@ def main(argv: "list[str] | None" = None) -> int:
             t_ev = time.perf_counter()
             with obs.phase("eval", hist=obs.eval_s, kind="eval",
                            step=step + 1):
-                losses = [bundle.evaluate(x, y)
-                          for x, y in eval_batches_fn()]
+                try:
+                    losses = [bundle.evaluate(x, y)
+                              for x, y in eval_batches_fn()]
+                except Exception:
+                    # Same conversion as bundle.run: a peer dying under
+                    # a mid-eval collective is a resync, not a crash.
+                    raise_if_membership_changed()
+                    raise
             obs.observe_eval_busy(time.perf_counter() - t_ev)
             ev = sum(losses) / len(losses)
             obs.emit("eval", step=step + 1, loss=round(ev, 4),
@@ -611,8 +673,13 @@ def main(argv: "list[str] | None" = None) -> int:
                      batches=len(losses))
         if args.ckpt_dir and (step + 1) % args.ckpt_every == 0:
             # Async: the persist overlaps the next steps' compute; the
-            # next save (or the final wait) drains it.
-            checkpoint_and_gc(step + 1)
+            # next save (or the final wait) drains it. A wired save
+            # gathering from a just-dead peer converts to a resync too.
+            try:
+                checkpoint_and_gc(step + 1)
+            except Exception:
+                raise_if_membership_changed()
+                raise
             last_saved = step + 1
 
     if obs.enabled:
@@ -638,11 +705,19 @@ def main(argv: "list[str] | None" = None) -> int:
                 t_rs = time.monotonic()
                 obs.begin_resync()
                 obs.emit("elastic_membership_lost", lost=list(mc.lost),
+                         gained=list(mc.gained),
                          generation=mc.generation, step=last_done)
                 if prefetch is not None:
                     prefetch.close()
                     prefetch = batches = None
-                ckpt.wait_for_saves()
+                try:
+                    ckpt.wait_for_saves()
+                except Exception as e:  # noqa: BLE001 — drain is best-effort here
+                    # The in-flight save may itself have died with the
+                    # peer; the restore below falls back to the last
+                    # FINALIZED step regardless.
+                    obs.emit("ckpt_drain_failed",
+                             error=f"{type(e).__name__}: {e}"[:300])
                 if wired:
                     dist.unwire_jax()
                 group = dist.elastic_rendezvous(
@@ -652,8 +727,18 @@ def main(argv: "list[str] | None" = None) -> int:
                 primary = group.is_primary
                 mesh = build_mesh()
                 bundle = build_bundle(mesh)
-                start_step = (resume_from_checkpoint()
-                              if args.ckpt_dir else 0)
+                if args.ckpt_dir:
+                    start_step = resume_from_checkpoint()
+                else:
+                    start_step = 0
+                    # build_bundle just re-initialized every weight: say
+                    # so LOUDLY — this resync kept the processes alive
+                    # but threw the trained parameters away.
+                    obs.emit("elastic_resync_weights_reset",
+                             generation=group.generation,
+                             warning="no --ckpt-dir: training restarts "
+                                     "from freshly initialized weights "
+                                     "at step 0")
                 rng = jax.random.key(1234 + start_step)
                 last_done = last_saved = start_step
                 if args.data:
@@ -737,8 +822,12 @@ def main(argv: "list[str] | None" = None) -> int:
         _restore_handlers()
         if ledger is not None:
             # Stop the heartbeat daemon so in-process callers (tests)
-            # don't leak a thread touching a possibly-deleted tmpdir.
+            # don't leak a thread touching a possibly-deleted tmpdir —
+            # then take our heartbeat file with us, so survivors (or a
+            # rejoining replacement) see the departure immediately
+            # instead of waiting out the staleness timeout on a ghost.
             ledger.stop()
+            ledger.remove(rdv.process_id)
         if tel is not None:
             tel.stop_event.set()
         if httpd is not None:

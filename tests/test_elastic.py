@@ -121,6 +121,50 @@ def test_group_dense_rank_and_primary():
     assert not h.is_primary
 
 
+def test_ledger_group_manifest_roundtrip(tmp_path):
+    """The persisted group manifest is the rejoin map: a recreated pod
+    reads latest_group() to learn which generation the run is at."""
+    led = dist.MembershipLedger(str(tmp_path / "m"))
+    assert led.latest_group() is None  # cold ledger: first boot
+    led.write_group(dist.ElasticGroup(generation=0, ranks=(0, 1), rank=0,
+                                      coordinator_address="a:1"))
+    led.write_group(dist.ElasticGroup(generation=2, ranks=(0,), rank=0,
+                                      coordinator_address="a:1"))
+    rec = led.latest_group()
+    assert rec["generation"] == 2
+    assert rec["ranks"] == [0] and rec["world_size"] == 1
+    # A torn write (crash mid-manifest) must be skipped, not fatal.
+    with open(os.path.join(led.directory, "group-00000007.json"), "w") as f:
+        f.write('{"generation": 7, "ran')
+    assert led.latest_group()["generation"] == 2
+    # Clean exits take their heartbeat with them.
+    led.write_heartbeat(3, "c:1")
+    led.remove(3)
+    assert 3 not in led.read()
+    led.remove(3)  # idempotent
+
+
+def test_membership_delta_lost_gained_reborn(tmp_path):
+    led = dist.MembershipLedger(str(tmp_path / "m"))
+    # Group (0, 1) finalized at generation 1. Rank 0 heartbeats at the
+    # group's generation (healthy member); rank 1's heartbeat is stale
+    # (dead); rank 2 is a fresh non-member (a joiner).
+    led.write_heartbeat(0, "a:1", generation=1)
+    led.write_heartbeat(1, "b:1", generation=1)
+    led.write_heartbeat(2, "c:1", generation=0)
+    old = time.time() - 60
+    os.utime(os.path.join(led.directory, "rank-1.json"), (old, old))
+    lost, gained = dist.membership_delta(led, (0, 1), 1, timeout_s=5.0)
+    assert lost == {1} and gained == {2}
+    # Reborn: rank 0's file is now FRESH but carries generation 0 — a
+    # recreated pod heartbeating under a member's rank. The process the
+    # group wired is gone (lost) AND a new one wants in (gained).
+    led.write_heartbeat(0, "a:1", generation=0)
+    lost, gained = dist.membership_delta(led, (0, 1), 1, timeout_s=5.0)
+    assert 0 in lost and 0 in gained
+    assert lost == {0, 1} and gained == {0, 2}
+
+
 # --- socket barrier: formation and coordinator takeover, in threads -------
 
 
@@ -212,6 +256,61 @@ def test_rendezvous_below_min_world_raises(tmp_path):
                                 emit=lambda *a, **k: None)
 
 
+def test_pinned_roster_never_finalizes_partial(tmp_path):
+    """Boot pins the full Indexed-Job roster: with staggered pod
+    scheduling (image pulls routinely exceed settle_s) the first rank up
+    must NOT finalize a singleton gen-0 group that latecomers can never
+    join — it waits for everyone or raises."""
+    cfg = _cfg(tmp_path, _free_port(), settle_s=0.05)
+    ledger = dist.MembershipLedger(cfg.ledger_dir)
+    ledger.write_heartbeat(0, cfg.advertise_address)  # rank 1 not up yet
+    with pytest.raises(dist.RendezvousError, match="timed out"):
+        dist._run_coordinator(cfg, 0, 0, {0, 1}, ledger, timeout_s=0.8)
+
+
+def test_open_roster_waits_for_alive_late_member(tmp_path):
+    """Resync rosters are open, but the settle break still waits for
+    every ledger-alive rank: a member whose hello is slower than
+    settle_s joins the group instead of being locked out."""
+    base = _free_port()
+    cfgs = {r: _cfg(tmp_path, base + 50 * r) for r in range(2)}
+    ledger = dist.MembershipLedger(str(tmp_path / "membership"))
+    # Both ranks run the heartbeat daemon (as train_job does): rank 1 is
+    # ALIVE the whole time, just slow to say hello — 3x the settle
+    # window. Without the daemons either side's one-shot heartbeat would
+    # go stale and the other would correctly treat it as dead.
+    daemons = [dist.MembershipLedger(ledger.directory) for _ in range(2)]
+    for r in range(2):
+        daemons[r].start_heartbeat(r, cfgs[r].advertise_address,
+                                   interval_s=0.1)
+    results = {}
+
+    def join(rank, delay):
+        time.sleep(delay)
+        try:
+            results[rank] = dist.elastic_rendezvous(
+                cfgs[rank], dist.MembershipLedger(ledger.directory),
+                rank, 1, expected=None, timeout_s=10.0, attempts=2,
+                backoff_s=0.1, emit=lambda *a, **k: None)
+        except Exception as e:  # noqa: BLE001 — surfaced by assertions
+            results[rank] = e
+
+    threads = [threading.Thread(target=join, args=(0, 0.0)),
+               threading.Thread(target=join, args=(1, 0.6))]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+    finally:
+        for d in daemons:
+            d.stop()
+    for r in range(2):
+        g = results[r]
+        assert isinstance(g, dist.ElasticGroup), g
+        assert g.ranks == (0, 1) and g.generation == 1
+
+
 # --- integration: real subprocesses, real kills ---------------------------
 
 
@@ -240,13 +339,15 @@ def _sub_env(**extra):
 def _elastic_env(rank, port, **extra):
     # Tight elastic knobs so loss detection fits a test budget: 0.2s
     # heartbeats, a 1s loss timeout, and a short settle window.
-    return _sub_env(
+    knobs = dict(
         K3STPU_NUM_PROCESSES=2, K3STPU_PROCESS_ID=rank,
         K3STPU_COORDINATOR="127.0.0.1:29400",  # unused by the barrier
         K3STPU_ELASTIC=1, K3STPU_ADVERTISE_ADDRESS=f"127.0.0.1:{port}",
         K3STPU_ELASTIC_SETTLE_S=0.3, K3STPU_ELASTIC_HEARTBEAT_S=0.2,
         K3STPU_ELASTIC_LOSS_TIMEOUT_S=1.0, K3STPU_ELASTIC_MIN_WORLD=1,
-        K3STPU_RDV_TIMEOUT_S=60, **extra)
+        K3STPU_RDV_TIMEOUT_S=60)
+    knobs.update(extra)
+    return _sub_env(**knobs)
 
 
 def _scrape(port):
@@ -372,6 +473,133 @@ def test_rank_loss_resync_resume_and_twin_equivalence(tmp_path):
     assert twin.returncode == 0, twin.stdout[-2000:]
     twin_losses = _losses_by_step(_events(twin.stdout))
     mine = _losses_by_step(ev0)
+    assert set(twin_losses) == set(mine)
+    for step, loss in twin_losses.items():
+        assert mine[step] == pytest.approx(loss, rel=1e-4, abs=1e-4), step
+
+
+def test_replacement_boot_joins_at_ledger_generation(tmp_path):
+    """A recreated pod must NOT assume generation 0: it reads the
+    ledger's persisted group manifest and boots one generation past it
+    with an open roster. Here the manifest says the run is at gen 3, so
+    the replacement forms (and trains at) generation 4."""
+    ldir = tmp_path / "membership"
+    dist.MembershipLedger(str(ldir)).write_group(
+        dist.ElasticGroup(generation=3, ranks=(0,), rank=0,
+                          coordinator_address="127.0.0.1:1"))
+    proc = subprocess.run(
+        TRAIN_CMD + ["--steps", "3"],
+        env=_elastic_env(0, _free_port(),
+                         K3STPU_ELASTIC_LEDGER_DIR=str(ldir)),
+        text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=300)
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    events = _events(proc.stdout)
+    (start,) = [e for e in events if e["event"] == "train_start"]
+    assert start["elastic"] and start["generation"] == 4
+    assert start["world_size"] == 1
+    # No --ckpt-dir: the boot warned, loudly, that a resync would reset
+    # the weights.
+    assert any(e["event"] == "elastic_without_checkpoint" for e in events)
+
+
+def test_unjoinable_replacement_exits_preempted_code(tmp_path):
+    """A replacement that cannot re-form a group (here: min_world unmet,
+    nobody else alive) must exit with the podFailurePolicy-ignored code
+    instead of burning the Job's backoffLimit toward whole-Job death —
+    and take its heartbeat with it so it cannot poison a later
+    coordinator election."""
+    ldir = tmp_path / "membership"
+    dist.MembershipLedger(str(ldir)).write_group(
+        dist.ElasticGroup(generation=1, ranks=(0,), rank=0,
+                          coordinator_address="127.0.0.1:1"))
+    proc = subprocess.run(
+        TRAIN_CMD + ["--steps", "3"],
+        env=_elastic_env(1, _free_port(),
+                         K3STPU_ELASTIC_LEDGER_DIR=str(ldir),
+                         K3STPU_ELASTIC_MIN_WORLD=2,
+                         K3STPU_RDV_TIMEOUT_S=1, K3STPU_RDV_ATTEMPTS=1),
+        text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+        timeout=300)
+    assert proc.returncode == 42, proc.stdout[-2000:]
+    events = _events(proc.stdout)
+    (fail,) = [e for e in events if e["event"] == "elastic_rejoin_failed"]
+    assert fail["generation"] == 2  # manifest gen 1 -> tried to join at 2
+    assert not any(e["event"] == "train_start" for e in events)
+    assert not os.path.exists(ldir / "rank-1.json")  # heartbeat removed
+
+
+@pytest.mark.slow
+def test_recreated_rank_rejoins_and_world_regrows(tmp_path):
+    """The full Indexed-Job story: rank 1 dies hard, rank 0 resyncs to
+    world 1 and keeps training; the Job controller recreates index 1,
+    which boots at the ledger's generation; rank 0 detects the joiner
+    and re-rendezvouses, the world regrows to 2, and the replacement
+    resumes from the shared checkpoint tree — losses still equal an
+    uninterrupted twin's."""
+    corpus = tmp_path / "corpus.bin"
+    synthetic_corpus(corpus, vocab_size=256, n_tokens=1 << 15)
+    cdir = tmp_path / "ckpt"
+    base = _free_port()
+    args = ["--steps", "80", "--ckpt-every", "5", "--ckpt-dir", str(cdir),
+            "--data", str(corpus), "--data-seed", "7"]
+    # Rank 0 paced at ~0.3s/step: the kill at step 5, the ~1.5s loss
+    # detection, AND the replacement's full process boot (~10s of jax
+    # import + compile) all land well before step 80.
+    p0 = subprocess.Popen(
+        TRAIN_CMD + args,
+        env=_elastic_env(0, base,
+                         K3STPU_CHAOS="train_step:stall_s=0.3:times=1000"),
+        text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    p1 = subprocess.Popen(
+        TRAIN_CMD + args,
+        env=_elastic_env(1, base + 500,
+                         K3STPU_CHAOS="rank_loss:skip=5:times=1"),
+        text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    p1.communicate(timeout=300)
+    assert p1.returncode == 1
+    # Let rank 0 notice the death and finish its shrink-to-1 resync, so
+    # the replacement's manifest read sees the post-loss generation.
+    time.sleep(3.0)
+    p1b = subprocess.Popen(
+        TRAIN_CMD + args,
+        env=_elastic_env(1, base + 500),
+        text=True, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    out1b, _ = p1b.communicate(timeout=420)
+    rc0, ev0, _ = _stream_until_done(p0)
+    assert rc0 == 0, ev0[-10:]
+    assert p1b.returncode == 0, out1b[-2000:]
+    ev1b = _events(out1b)
+
+    # The replacement did not boot at generation 0 — it joined where the
+    # ledger said the run was, and resumed from the checkpoint tree.
+    (start1b,) = [e for e in ev1b if e["event"] == "train_start"]
+    assert start1b["generation"] >= 1
+    (resume1b,) = [e for e in ev1b if e["event"] == "resume"]
+    assert resume1b["step"] > 0
+
+    # Rank 0 shrank to world 1, then REGREW to 2 when the joiner showed
+    # up (and may shrink again when the unpaced replacement finishes
+    # first and departs cleanly).
+    resyncs = [e for e in ev0 if e["event"] == "elastic_resync"]
+    assert resyncs[0]["world_size"] == 1 and resyncs[0]["ranks"] == [0]
+    assert any(r["world_size"] == 2 and r["ranks"] == [0, 1]
+               for r in resyncs)
+    gained = [e for e in ev0 if e["event"] == "elastic_membership_lost"
+              and e.get("gained")]
+    assert any(g["gained"] == [1] for g in gained)
+
+    # Twin equivalence survives the whole shrink/regrow dance: the
+    # membership changed twice (or thrice), the data order never did.
+    twin = subprocess.run(
+        TRAIN_CMD + ["--steps", "80", "--data", str(corpus),
+                     "--data-seed", "7"],
+        env=_sub_env(), text=True, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, timeout=300)
+    assert twin.returncode == 0, twin.stdout[-2000:]
+    twin_losses = _losses_by_step(_events(twin.stdout))
+    mine = _losses_by_step(ev0)
+    assert max(mine) == 80
     assert set(twin_losses) == set(mine)
     for step, loss in twin_losses.items():
         assert mine[step] == pytest.approx(loss, rel=1e-4, abs=1e-4), step
