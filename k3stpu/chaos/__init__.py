@@ -31,6 +31,10 @@ point                 boundary
                       crash-only reset, ``stall_s`` the watchdog trip
 ``page_alloc``        page-chain allocation during admission —
                       exercises pool-exhaustion rollback
+``spec_verify``       the verify dispatch inside the engine's speculative
+                      path — a raised fault makes that batch fall back to
+                      plain decode (``spec_fallbacks`` counter), never
+                      wedging the loop or corrupting output
 ``sse_write``         per-event SSE write in the HTTP handler — a raised
                       ``BrokenPipeError`` simulates a client disconnect
                       mid-stream

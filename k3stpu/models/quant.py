@@ -148,3 +148,34 @@ def param_bytes(params: dict) -> int:
     not estimated)."""
     return sum(int(x.size) * x.dtype.itemsize
                for x in jax.tree.leaves(params))
+
+
+def kv_page_bytes(config, page_size: int) -> int:
+    """HBM bytes ONE paged-KV page costs across all layers: the K and V
+    pools plus, when ``kv_cache_dtype == "int8"``, the per-(token,
+    kv-head) fp32 absmax scale pools (transformer.py's paged layout).
+    Matches the engine's measured ``_page_bytes`` (summed from the live
+    cache leaves) by construction — this is the planning-side form that
+    needs no cache to exist yet.
+
+    The int8 win per (token, kv-head) row is ``head_dim * itemsize``
+    bytes down to ``head_dim + 4``: 4x vs an fp32 cache at large
+    head_dim, ~2x vs bf16 (the scale row costs 4 of the head_dim*2
+    bytes saved — e.g. 1.94x at head_dim 128, so "doubles capacity" is
+    exact for fp32 and a hair under for bf16; docs/SPECULATIVE.md)."""
+    cfg = getattr(config, "base", config)
+    kv_heads = cfg.n_kv_heads or cfg.n_heads
+    head_dim = cfg.d_model // cfg.n_heads
+    if cfg.kv_cache_dtype == "int8":
+        per_token = kv_heads * (head_dim + 4)  # int8 values + fp32 scale
+    else:
+        per_token = kv_heads * head_dim * jnp.dtype(cfg.dtype).itemsize
+    return 2 * cfg.n_layers * page_size * per_token
+
+
+def kv_pages_for_budget(budget_bytes: int, config, page_size: int) -> int:
+    """Pages a fixed HBM budget buys (sink page 0 included) — the
+    capacity side of the int8-paged-KV trade: same budget, same model,
+    ``kv_cache_dtype="int8"`` vs float is the pool-size multiplier the
+    bench records."""
+    return int(budget_bytes) // kv_page_bytes(config, page_size)

@@ -17,6 +17,7 @@ from __future__ import annotations
 from .hist import (  # noqa: F401  (re-exported for tests/loadgen)
     LATENCY_BUCKETS_S,
     TPOT_BUCKETS_S,
+    Counter,
     Gauge,
     Histogram,
     InfoGauge,
@@ -71,6 +72,32 @@ class ServeObs:
             "k3stpu_engine_pages_free",
             "Free KV pages in the paged allocator, sampled by the loop.",
             value=-1)  # -1 = engine not running in paged mode
+        # Speculative decoding (engine speculate=True). Acceptance is THE
+        # perf knob: accepted/proposed drives tokens-per-dispatch, and the
+        # draft/verify latency split shows which half a regression lives
+        # in. All stay at zero on a non-speculative engine.
+        self.spec_accept_ratio = Gauge(
+            "k3stpu_serve_spec_accept_ratio",
+            "Cumulative accepted/proposed draft-token ratio for "
+            "speculative decoding (0 until the first proposal).")
+        self.spec_accepted_tokens = Counter(
+            "k3stpu_serve_spec_accepted_tokens_total",
+            "Draft tokens accepted by speculative verify dispatches.")
+        self.spec_proposed_tokens = Counter(
+            "k3stpu_serve_spec_proposed_tokens_total",
+            "Draft tokens proposed to speculative verify dispatches.")
+        self.spec_dispatches = Counter(
+            "k3stpu_serve_spec_dispatches_total",
+            "Speculative verify dispatches; accepted_tokens_total over "
+            "this is accepted tokens per dispatch.")
+        self.spec_draft_seconds = Histogram(
+            "k3stpu_serve_spec_draft_seconds",
+            "Host-side n-gram drafting time per speculative dispatch.",
+            bounds=TPOT_BUCKETS_S)
+        self.spec_verify_seconds = Histogram(
+            "k3stpu_serve_spec_verify_seconds",
+            "Device verify-extend time per speculative dispatch.",
+            bounds=TPOT_BUCKETS_S)
         self.build_info = build_info_gauge("serve")
 
     # -- engine hooks (loop / submitter threads) ---------------------------
@@ -108,6 +135,25 @@ class ServeObs:
         if pages_free is not None:
             self.pages_free.set(float(pages_free))
 
+    def on_spec_dispatch(self, proposed: int, accepted: int, emitted: int,
+                         draft_s: float, verify_s: float) -> None:
+        """One speculative verify dispatch: ``proposed`` draft tokens
+        went in, ``accepted`` matched the target, ``emitted`` tokens
+        (accepted + one correction/bonus per row) came out — emitted
+        rides the engine's ordinary tokens counter, so only the
+        speculation-specific families update here."""
+        if not self.enabled:
+            return
+        self.spec_proposed_tokens.inc(proposed)
+        self.spec_accepted_tokens.inc(accepted)
+        self.spec_dispatches.inc()
+        total = self.spec_proposed_tokens.value
+        if total > 0:
+            self.spec_accept_ratio.set(
+                self.spec_accepted_tokens.value / total)
+        self.spec_draft_seconds.observe(draft_s)
+        self.spec_verify_seconds.observe(verify_s)
+
     def on_complete(self, tr: "ReqTrace | None", e2e_s: float,
                     tpot_s: "float | None") -> None:
         if not self.enabled:
@@ -128,12 +174,19 @@ class ServeObs:
 
     def histograms(self) -> "tuple[Histogram, ...]":
         return (self.ttft, self.tpot, self.e2e, self.queue_wait,
-                self.batch_occupancy)
+                self.batch_occupancy, self.spec_draft_seconds,
+                self.spec_verify_seconds)
+
+    def _counters(self) -> "tuple[Counter, ...]":
+        return (self.spec_accepted_tokens, self.spec_proposed_tokens,
+                self.spec_dispatches)
 
     def render_prometheus(self) -> str:
         parts = [h.render() for h in self.histograms()]
         parts.append(self.queue_depth.render())
         parts.append(self.pages_free.render())
+        parts.append(self.spec_accept_ratio.render())
+        parts.extend(c.render() for c in self._counters())
         parts.append(self.build_info.render())
         return "\n".join(parts)
 
@@ -144,6 +197,11 @@ class ServeObs:
         parts = [h.render_openmetrics() for h in self.histograms()]
         parts.append(self.queue_depth.render())
         parts.append(self.pages_free.render())
+        parts.append(self.spec_accept_ratio.render())
+        # Counters need the _total-stripped HELP/TYPE form OpenMetrics
+        # requires; the rewrite leaves gauges/histograms untouched.
+        parts.extend(prometheus_text_to_openmetrics(c.render())
+                     for c in self._counters())
         parts.append(self.build_info.render())
         return "\n".join(parts)
 
@@ -156,6 +214,9 @@ class ServeObs:
     def reset(self) -> None:
         for h in self.histograms():
             h.reset()
+        for c in self._counters():
+            c.reset()
+        self.spec_accept_ratio.set(0.0)
         self.queue_depth.set(0.0)
         self.traces.reset()
 

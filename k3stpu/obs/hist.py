@@ -120,6 +120,10 @@ class Counter:
         with self._lock:
             return self._value
 
+    def reset(self) -> None:
+        with self._lock:
+            self._value = 0.0
+
     def render(self) -> str:
         head = _NAME_HELP_TYPE.format(n=self.name, h=self.help, t="counter")
         return f"{head}\n{self.name} {_fmt(self.value)}"

@@ -219,7 +219,9 @@ class GenerateEngine:
                  decode_block: int = 1, prompt_cache: int = 0,
                  mesh=None, max_pending: "int | None" = None,
                  page_size: "int | None" = None,
-                 num_pages: "int | None" = None, obs=None,
+                 num_pages: "int | None" = None,
+                 speculate: bool = False, spec_gamma: int = 4,
+                 obs=None,
                  breaker=None, watchdog_s: "float | None" = None,
                  chaos=None):
         """``chunk_prefill``: admit long prompts in chunks of this many
@@ -275,6 +277,29 @@ class GenerateEngine:
         (the row writes into it). Token streams stay bit-identical to
         the dense engine's. None = dense cache (everything unchanged).
 
+        ``speculate`` / ``spec_gamma``: draft-then-verify speculative
+        decoding inside the slot loop (paged mode only — the host
+        index mirror is what makes per-row rollback free). Each
+        iteration an ``NgramDrafter`` (serve/speculative.py) proposes
+        up to ``spec_gamma`` continuation tokens per active row from
+        the row's own prompt+generated history; one batch-wide verify
+        dispatch (a static ``(slots, spec_gamma+1)`` extend — one
+        compile, zero steady-state recompiles) scores every proposal,
+        and each row emits its matched prefix plus the target's own
+        token at the first divergence — up to ``spec_gamma + 1``
+        tokens per dispatch instead of ``decode_block`` device steps'
+        worth. Greedy verification means output stays token-identical
+        to the non-speculative engine and to ``generate()``; rejected
+        proposals roll back for free through the host index mirror.
+        Per-slot speculation depth adapts to recent acceptance (full
+        accept grows it toward ``spec_gamma``, full reject shrinks it
+        toward 1) so rows whose continuation stopped repeating stop
+        paying draft+verify for doomed proposals. Iterations where no
+        row has a proposal — or any row samples (temperature > 0), or
+        a row sits within ``spec_gamma`` tokens of ``max_seq_len`` —
+        fall through to the plain decode path unchanged, which is why
+        non-repetitive traffic keeps plain-path throughput.
+
         ``obs``: a ``k3stpu.obs.ServeObs`` to record per-request
         lifecycle traces and latency histograms into (the server shares
         one instance so /metrics and /debug/* see engine traffic).
@@ -311,6 +336,12 @@ class GenerateEngine:
                              f"{prompt_cache}")
         if watchdog_s is not None and watchdog_s <= 0:
             raise ValueError(f"watchdog_s must be > 0, got {watchdog_s}")
+        if speculate and page_size is None:
+            raise ValueError(
+                "speculate=True requires page_size (speculative rollback "
+                "rides the paged cache's host-mirrored per-row index)")
+        if speculate and spec_gamma < 1:
+            raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
         self.model = model
         self.params = params
         self.slots = slots
@@ -353,6 +384,19 @@ class GenerateEngine:
             self._indices = np.zeros((slots,), np.int32)
             self._chains: "list[list[int]]" = [[] for _ in range(slots)]
             self._pinned: "dict[int, int]" = {}  # page -> #pcache pins
+
+        # Speculative decoding state (loop thread only). _spec_hist[r]
+        # is row r's prompt + every emitted token — the drafter's
+        # lookup corpus; _spec_depth[r] is the row's adaptive proposal
+        # budget in [1, spec_gamma].
+        self.speculate = speculate
+        self.spec_gamma = spec_gamma
+        if speculate:
+            from k3stpu.serve.speculative import NgramDrafter
+
+            self._drafter = NgramDrafter()
+            self._spec_hist: "list[list[int]]" = [[] for _ in range(slots)]
+            self._spec_depth = np.full((slots,), spec_gamma, np.int32)
 
         self._cache = init_cache(self.pmodel if self.paged else model,
                                  slots)
@@ -410,6 +454,14 @@ class GenerateEngine:
                        "pcache_hits": 0, "pcache_prefix_hits": 0,
                        "pcache_misses": 0, "pcache_bytes": 0,
                        "rejected": 0,
+                       # Speculative decoding (docs/SPECULATIVE.md):
+                       # proposed/accepted drafts, emitted tokens and
+                       # dispatches on the verify path, and iterations
+                       # where a verify failure fell back to plain
+                       # decode.
+                       "spec_dispatches": 0, "spec_proposed": 0,
+                       "spec_accepted": 0, "spec_emitted": 0,
+                       "spec_fallbacks": 0,
                        # Containment counters (docs/RESILIENCE.md).
                        "deadline_expired": 0, "watchdog_trips": 0,
                        "loop_crashes": 0, "loop_restarts": 0,
@@ -565,6 +617,23 @@ class GenerateEngine:
         cache = set_cache_index(cache, idx)
         return decode_core(self.pmodel, params, cache, toks,
                            adapter_ids=aids, block_tables=bts)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _spec_verify(self, params, cache, idx, bts, chunk, aids=None):
+        """Speculative verify: ONE extend over the static
+        ``(slots, spec_gamma+1)`` chunk ``[x0, d1..d_gamma]``.
+        ``logits[:, j]`` scores the token after ``chunk[:, :j+1]``, so
+        the row-wise argmax is the target's own greedy continuation at
+        every draft position — the host keeps each row's longest
+        matching prefix plus the token at the first divergence. The
+        argmax epilogue stays in-jit (shipping (slots, G, V) logits to
+        the host every dispatch would swamp the win) and is also what
+        pins ``speculate=True`` to greedy exactness: there is no
+        sampled verify."""
+        cache = set_cache_index(cache, idx)
+        cache, logits = extend_core(self.pmodel, params, cache, chunk,
+                                    adapter_ids=aids, block_tables=bts)
+        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _pack_pages(self, pool, small, page_map):
@@ -1134,6 +1203,13 @@ class GenerateEngine:
             # multiplier (> 1: same slot count in less HBM).
             s["paged_density_ratio"] = round(
                 self.slots * self.max_seq / (total * self.page_size), 2)
+        if self.speculate:
+            s["spec_accept_rate"] = (
+                round(s["spec_accepted"] / s["spec_proposed"], 4)
+                if s["spec_proposed"] else None)
+            s["spec_tokens_per_dispatch"] = (
+                round(s["spec_emitted"] / s["spec_dispatches"], 2)
+                if s["spec_dispatches"] else None)
         return s
 
     # --- loop internals (single thread; owns all slot state) ------------
@@ -1586,6 +1662,15 @@ class GenerateEngine:
             self._topps[r] = 1.0 if req.top_p is None else req.top_p
             self._eos[r] = -1 if req.eos is None else int(req.eos)
             self._collected[r] = [int(first[j])]
+            if self.speculate:
+                # Drafting corpus: the row's real prompt (samples>1
+                # shares the one prompt row) + the first token; every
+                # emitted token appends, whichever path emitted it.
+                src = 0 if req.samples > 1 else j
+                self._spec_hist[r] = (
+                    req.block[src, :int(req.lens[src])].tolist()
+                    + [int(first[j])])
+                self._spec_depth[r] = self.spec_gamma
         with self._lock:
             self._stats["requests"] += 1
             self._stats["tokens"] += len(rows)  # first sampled tokens
@@ -1616,6 +1701,8 @@ class GenerateEngine:
         # lax.cond fast path in _sample_rows for every later step until
         # the slot is reused.
         self._temps[r] = 0.0
+        if self.speculate:
+            self._spec_hist[r] = []  # corpus dies with the row
         if self.paged:
             # Free the row's pages NOW, not at request completion: the
             # zeroed table row sinks the slot's continued decode writes,
@@ -1717,6 +1804,9 @@ class GenerateEngine:
         self._owner = [None] * self.slots
         self._collected = [[] for _ in range(self.slots)]
         self._temps[:] = 0.0  # keep the all-greedy fast path alive
+        if self.speculate:
+            self._spec_hist = [[] for _ in range(self.slots)]
+            self._spec_depth[:] = self.spec_gamma
         self._pcache.clear()
         with self._lock:
             self._stats["pcache_bytes"] = 0
@@ -1792,6 +1882,160 @@ class GenerateEngine:
                                         name="generate-engine")
         self._thread.start()
 
+    def _spec_iteration(self, aids, t0: float) -> bool:
+        """One speculative decode iteration: draft per-row proposals,
+        verify them in ONE batch-wide extend, emit each row's accepted
+        prefix + the target's correction token. Returns True when it
+        handled the dispatch (all bookkeeping done, loop continues);
+        False falls through to the plain decode path — taken when no
+        row proposes anything, any row samples (verify is argmax-only),
+        any row sits too close to the cache end for the static verify
+        width, or the verify dispatch itself fails (chaos ``spec_verify``
+        or a real backend error: that batch decodes plainly instead of
+        wedging the loop).
+
+        Exactness: the verify extend over ``[x0, d1..d_gamma]`` is
+        computationally identical to the plain path decoding x0, d1,
+        ... in sequence — accepted positions get exactly the K/V the
+        plain path would have written, and the host index advances by
+        exactly the tokens consumed (m accepted drafts + x0), so the
+        correction token's K/V lands on the NEXT dispatch as that
+        chunk's position 0, same as plain decode. Rejected-draft writes
+        sit past the new index: invisible to the position mask and
+        overwritten before the index ever reaches them."""
+        W = self.spec_gamma + 1
+        if (self._temps > 0.0).any():
+            return False
+        # Static verify width vs cache end: a chunk always writes W
+        # positions, and a row within W of max_seq would clamp those
+        # writes back INTO its own last page (the plain path's harmless
+        # finished-row clamp is harmful here: extend's attention reads
+        # the corruption in the same call). Rare and transient — such
+        # rows are at most spec_gamma tokens from finishing.
+        if bool((self._indices[self._active] + W > self.max_seq).any()):
+            return False
+        t_draft = time.perf_counter()
+        props: "list[list[int]]" = [[] for _ in range(self.slots)]
+        any_prop = False
+        for r in range(self.slots):
+            if not self._active[r]:
+                continue
+            depth = int(min(self._spec_depth[r], self._left[r] - 1))
+            if depth <= 0:
+                continue
+            p = self._drafter.propose(self._spec_hist[r], depth)
+            if p:
+                props[r] = p
+                any_prop = True
+        if not any_prop:
+            return False
+        draft_s = time.perf_counter() - t_draft
+        chunk = np.zeros((self.slots, W), np.int32)
+        chunk[:, 0] = self._last_tok
+        for r in range(self.slots):
+            if props[r]:
+                chunk[r, 1:1 + len(props[r])] = props[r]
+        t_verify = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("spec_verify")
+            self._cache, tgt = self._spec_verify(
+                self.params, self._cache, jnp.asarray(self._indices),
+                jnp.asarray(self._tables), jnp.asarray(chunk), aids)
+            tgt = np.asarray(tgt)
+        except Exception:  # noqa: BLE001 — plain decode serves this batch
+            with self._lock:
+                self._stats["spec_fallbacks"] += 1
+            return False
+        verify_s = time.perf_counter() - t_verify
+        if self.breaker is not None:
+            self.breaker.record_success()
+        dt = time.perf_counter() - t0
+        n_active = int(self._active.sum())
+        done_reqs = set()
+        deltas: "dict[_Request, dict[int, list[int]]]" = {}
+        consumed = proposed = accepted = 0
+        for r in range(self.slots):
+            if not self._active[r]:
+                continue
+            plen = len(props[r])
+            m = 0
+            while m < plen and props[r][m] == int(tgt[r, m]):
+                m += 1
+            proposed += plen
+            accepted += m
+            if plen:
+                # Per-slot depth adaptation: full accept earns a deeper
+                # next proposal, full reject a shallower one. Depth only
+                # changes how much is PROPOSED — never what is emitted —
+                # so exactness is adaptation-blind.
+                if m == plen:
+                    self._spec_depth[r] = min(self._spec_depth[r] + 1,
+                                              self.spec_gamma)
+                elif m == 0:
+                    self._spec_depth[r] = max(1, self._spec_depth[r] - 1)
+            emitted = props[r][:m] + [int(tgt[r, m])]
+            owner = self._owner[r]
+            row_consumed = 0
+            for tok in emitted:
+                self._last_tok[r] = tok
+                self._collected[r].append(tok)
+                self._spec_hist[r].append(tok)
+                self._left[r] -= 1
+                row_consumed += 1
+                if owner is not None and owner.stream_q is not None:
+                    deltas.setdefault(owner, {}).setdefault(
+                        owner.slot_rows.index(r), []).append(tok)
+                if self._left[r] <= 0 or (self._eos[r] >= 0
+                                          and tok == self._eos[r]):
+                    self._finish_row(r)
+                    done_reqs.add(owner)
+                    break  # tokens past eos/budget are discarded
+            consumed += row_consumed
+            # Cache truth after this dispatch: positions index+1 ..
+            # index+row_consumed hold x0 + the accepted drafts' K/V
+            # (an eos-truncated row advances less, but it just finished
+            # — its next use rewrites index and table wholesale).
+            self._indices[r] += row_consumed
+        for req, d in deltas.items():
+            req.stream_q.put(d)
+        with self._lock:
+            # One extend over the batch ~= one device decode step of
+            # work, so "steps" (the per-step unit avg_active_slots
+            # divides by) advances by 1 while "tokens" advances by
+            # everything emitted — tokens/dispatches IS the speculation
+            # win, spec_accepted/spec_proposed the acceptance rate.
+            self._stats["steps"] += 1
+            self._stats["dispatches"] += 1
+            self._stats["tokens"] += consumed
+            self._stats["busy_s"] += dt
+            self._stats["slot_occupancy_sum"] += n_active
+            self._stats["peak_active_slots"] = max(
+                self._stats["peak_active_slots"], n_active)
+            self._stats["spec_dispatches"] += 1
+            self._stats["spec_proposed"] += proposed
+            self._stats["spec_accepted"] += accepted
+            self._stats["spec_emitted"] += consumed
+        if self._obs is not None:
+            self._obs.on_dispatch(n_active, len(self._pending),
+                                  self._alloc.free)
+            self._obs.on_spec_dispatch(proposed, accepted, consumed,
+                                       draft_s, verify_s)
+            if self._obs.enabled:
+                seen = set()
+                attrs = {"spec": True, "proposed": proposed,
+                         "accepted": accepted, "active": n_active,
+                         "dt_ms": round(dt * 1e3, 3)}
+                for r in range(self.slots):
+                    o = self._owner[r]
+                    if o is None or o.trace is None or id(o) in seen:
+                        continue
+                    seen.add(id(o))
+                    o.trace.event("decode", attrs)
+        for req in done_reqs:
+            self._maybe_complete(req)
+        return True
+
     def _loop_main(self) -> None:
         try:
             self._loop()
@@ -1819,6 +2063,8 @@ class GenerateEngine:
             k_tok = self.decode_block
             aids = (jnp.asarray(self._aids)
                     if self.n_adapters is not None else None)
+            if self.speculate and self._spec_iteration(aids, t0):
+                continue
             try:
                 if self._chaos is not None:
                     self._chaos.fire("decode_dispatch")
@@ -1871,6 +2117,8 @@ class GenerateEngine:
                     tok = int(block[j, r])
                     self._last_tok[r] = tok
                     self._collected[r].append(tok)
+                    if self.speculate:
+                        self._spec_hist[r].append(tok)
                     self._left[r] -= 1
                     consumed += 1
                     owner = self._owner[r]

@@ -320,6 +320,38 @@ def server_histogram_quantiles(metrics_text: str) -> dict:
     return out
 
 
+def spec_report(metrics_text: str) -> dict:
+    """Speculation counters lifted from a /metrics scrape — the A/B
+    column ``--report-spec`` prints next to the client percentiles.
+    Accepted-tokens/dispatch is the speedup knob: each speculative
+    dispatch costs ~one plain decode dispatch, so this number is the
+    realized tokens-per-round-trip multiplier (minus the +1 correction
+    token a plain dispatch also produces). Empty dict when the server
+    has no speculation families (not running --speculate, or an older
+    build)."""
+    vals: "dict[str, str]" = {}
+    for line in metrics_text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        try:
+            key, val = line.rsplit(None, 1)
+        except ValueError:
+            continue
+        vals[key] = val
+    try:
+        accepted = float(vals["k3stpu_serve_spec_accepted_tokens_total"])
+        dispatches = float(vals["k3stpu_serve_spec_dispatches_total"])
+        ratio = float(vals["k3stpu_serve_spec_accept_ratio"])
+    except (KeyError, ValueError):
+        return {}
+    return {
+        "spec_dispatches": int(dispatches),
+        "spec_accept_ratio": round(ratio, 4),
+        "spec_accepted_tokens_per_dispatch": (
+            round(accepted / dispatches, 2) if dispatches else None),
+    }
+
+
 def _print_quantile_skew(result: dict) -> None:
     """Client percentiles next to the server's histogram estimates —
     the at-a-glance skew check (see server_histogram_quantiles)."""
@@ -392,6 +424,18 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--kv-pages", type=int, default=None,
                     help="pool size for --kv-page-size (default: full "
                          "dense capacity)")
+    ap.add_argument("--speculate", action="store_true",
+                    help="self-hosted server decodes speculatively "
+                         "(n-gram drafter inside the engine; requires "
+                         "--continuous-batching and --kv-page-size)")
+    ap.add_argument("--spec-gamma", type=int, default=4,
+                    help="max draft tokens per slot per speculative "
+                         "dispatch (with --speculate)")
+    ap.add_argument("--report-spec", action="store_true",
+                    help="after the run, scrape the speculation counters "
+                         "from /metrics and print accepted-tokens/"
+                         "dispatch + accept ratio next to the client "
+                         "p50/p95/p99 (pairs with a --speculate server)")
     ap.add_argument("--json", default=None, metavar="PATH",
                     help="write the full result plus a per-request "
                          "rid<->trace-id table (failures marked) to this "
@@ -426,6 +470,7 @@ def main(argv: "list[str] | None" = None) -> int:
             decode_block=args.decode_block,
             prompt_cache=args.prompt_cache,
             kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
+            speculate=args.speculate, spec_gamma=args.spec_gamma,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
             shard_devices=None)  # None = all local devices; the engine
         # runs tensor-parallel now (mesh-sharded KV cache), so the old
@@ -479,11 +524,20 @@ def main(argv: "list[str] | None" = None) -> int:
 
     # Server-side histogram quantiles from the same run (best-effort:
     # an older server without the obs layer just yields none).
+    metrics_text = None
     try:
         with urllib.request.urlopen(url + "/metrics", timeout=60) as r:
-            result.update(server_histogram_quantiles(r.read().decode()))
+            metrics_text = r.read().decode()
+        result.update(server_histogram_quantiles(metrics_text))
     except Exception as e:  # noqa: BLE001 — the load numbers still stand
         print(f"(/metrics scrape failed: {e})", flush=True)
+    if args.report_spec:
+        spec = spec_report(metrics_text) if metrics_text else {}
+        if spec:
+            result.update(spec)
+        else:
+            print("(--report-spec: no speculation families in the "
+                  "/metrics scrape)", flush=True)
 
     with urllib.request.urlopen(card_url, timeout=60) as r:
         card = json.loads(r.read())
@@ -510,6 +564,12 @@ def main(argv: "list[str] | None" = None) -> int:
             json.dump(traces.chrome_trace(), f)
         print(f"wrote client trace {args.trace_out}", flush=True)
     _print_quantile_skew(result)
+    if result.get("spec_accepted_tokens_per_dispatch") is not None:
+        print(f"spec: {result['spec_accepted_tokens_per_dispatch']} "
+              f"accepted-tokens/dispatch over "
+              f"{result['spec_dispatches']} verify dispatches "
+              f"(accept ratio {result['spec_accept_ratio']})",
+              flush=True)
     if result["retries_503"] or result["gave_up_503"]:
         print(f"503 backoff: {result['retries_503']} retried, "
               f"{result['gave_up_503']} gave up "

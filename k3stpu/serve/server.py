@@ -264,6 +264,7 @@ class InferenceServer:
                  lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
+                 speculate: bool = False,
                  spec_gamma: int = 4,
                  watchdog_s: "float | None" = 120.0,
                  breaker_threshold: "int | None" = 5,
@@ -584,6 +585,17 @@ class InferenceServer:
             # would silently do nothing.
             raise ValueError(
                 "--kv-page-size requires --continuous-batching")
+        if speculate and not continuous_batching:
+            raise ValueError(
+                "--speculate is the engine's n-gram draft-then-verify "
+                "path; it requires --continuous-batching (and a paged "
+                "pool via --kv-page-size). For the two-model form use "
+                "--draft-model instead.")
+        if speculate and kv_page_size is None:
+            raise ValueError(
+                "--speculate requires --kv-page-size: speculative "
+                "rollback rides the paged cache's host-mirrored "
+                "per-row index")
         if continuous_batching:
             if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
@@ -601,7 +613,8 @@ class InferenceServer:
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
                 max_pending=max_pending, page_size=kv_page_size,
-                num_pages=kv_pages, obs=self._obs,
+                num_pages=kv_pages, speculate=speculate,
+                spec_gamma=spec_gamma, obs=self._obs,
                 breaker=self._breaker, watchdog_s=watchdog_s,
                 chaos=chaos)
 
@@ -1828,6 +1841,14 @@ def main(argv=None) -> int:
                          "target's greedy continuation")
     ap.add_argument("--draft-ckpt-dir", default=None,
                     help="checkpoint dir for the draft model's weights")
+    ap.add_argument("--speculate", action="store_true",
+                    help="model-free speculative decoding inside the "
+                         "continuous-batching engine: an n-gram prompt-"
+                         "lookup drafter proposes up to --spec-gamma "
+                         "tokens per slot, one batch-wide extend "
+                         "verifies them; greedy output is token-"
+                         "identical to the plain engine. Requires "
+                         "--continuous-batching and --kv-page-size")
     ap.add_argument("--spec-gamma", type=int, default=4)
     ap.add_argument("--watchdog-s", type=float, default=120.0,
                     help="with --continuous-batching: fail blocked "
@@ -1890,6 +1911,7 @@ def main(argv=None) -> int:
                              lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,
+                             speculate=args.speculate,
                              spec_gamma=args.spec_gamma,
                              watchdog_s=args.watchdog_s or None,
                              breaker_threshold=(args.breaker_threshold

@@ -18,6 +18,18 @@ paged bookkeeping.
 Per-row acceptance: each batch row keeps its own matched-prefix length
 every round, so ragged batches verify independently inside the shared
 static-shape programs.
+
+Two drafting strategies share the verify math:
+
+- ``speculative_generate`` below: a small draft MODEL proposes (the
+  standalone two-model form, one whole batch per call).
+- ``NgramDrafter``: model-free prompt-lookup drafting for the engine's
+  slot-scheduled loop (serve/engine.py ``speculate=True``) — proposals
+  come from matching the sequence's own recent suffix against its
+  earlier occurrences, so repetitive continuations (code, templated
+  text, degenerate greedy tails) verify several tokens per dispatch
+  with zero extra model weights. A wrong proposal costs nothing but
+  verify width: greedy verification keeps output exact regardless.
 """
 
 from __future__ import annotations
@@ -53,6 +65,67 @@ def _extend_argmax(model, params, cache, chunk):
     (B, G) — g[:, j] is the target's next token after chunk[:, :j+1]."""
     cache, logits = extend_core(model, params, cache, chunk)
     return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+class NgramDrafter:
+    """Prompt-lookup proposal source: continue the most recent earlier
+    occurrence of the sequence's current suffix.
+
+    For each n in ``max_ngram..min_ngram`` (longest suffix first — a
+    longer match is stronger evidence), scan backwards for the latest
+    earlier position where the last n tokens also occur, preferring a
+    match with a full ``depth`` tokens of continuation (a run of one
+    repeated token matches everywhere near the end, but only an earlier
+    occurrence has room to propose the whole depth). No match at any n
+    returns [] — the engine then runs its plain decode path for the
+    dispatch, so non-repetitive traffic never pays verify width for
+    doomed proposals.
+
+    Pure host-side and deterministic: same history, same proposals —
+    which keeps the engine's speculative output reproducible run to
+    run. ``window`` bounds the backward scan so per-dispatch drafting
+    stays O(window * max_ngram) however long the sequence grows.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 2,
+                 window: int = 256):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}..{max_ngram}")
+        if window < max_ngram + 1:
+            raise ValueError(f"window {window} too small for "
+                             f"max_ngram {max_ngram}")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.window = window
+
+    def propose(self, history: "list[int]", depth: int) -> "list[int]":
+        """Up to ``depth`` proposed continuation tokens of ``history``
+        (prompt + everything generated so far), or [] when no suffix
+        recurs."""
+        if depth <= 0:
+            return []
+        h = history[-self.window:]
+        n_h = len(h)
+        for n in range(self.max_ngram, self.min_ngram - 1, -1):
+            if n_h < n + 1:
+                continue
+            suffix = h[-n:]
+            partial = None
+            # Latest occurrence first; the first hit with a full-depth
+            # continuation wins, else the latest partial one.
+            for i in range(n_h - n - 1, -1, -1):
+                if h[i:i + n] != suffix:
+                    continue
+                cont = h[i + n:i + n + depth]
+                if len(cont) == depth:
+                    return list(cont)
+                if partial is None and cont:
+                    partial = list(cont)
+            if partial is not None:
+                return partial
+        return []
 
 
 def speculative_generate(

@@ -29,9 +29,11 @@
 #            checkpointing, quarantine, retention, bounded rendezvous),
 #            the fleet tier (node exporter, health labeling, tpu_top),
 #            and the elastic-membership suite (env-knob parsing, ledger
-#            liveness, rank-loss detection -> re-rendezvous -> resume)
-#            ride along minus their @slow soak/bench tests
-#            (the full suite runs those).
+#            liveness, rank-loss detection -> re-rendezvous -> resume),
+#            and the speculative-decoding suite (drafter units,
+#            exactness vs the plain engine, int8-paged-KV
+#            drift/capacity) ride along minus their @slow soak/bench
+#            tests (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -53,6 +55,7 @@ SMOKE=(
   tests/test_train_obs.py tests/test_metrics_lint.py
   tests/test_node_obs.py
   tests/test_env.py tests/test_elastic.py
+  tests/test_spec_engine.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -140,6 +140,41 @@ def test_page_pool_exhaustion_contained(mp):
         eng.close()
 
 
+# --- fault class: speculative verify dispatch failure -------------------
+
+
+def test_spec_verify_fault_falls_back_to_plain_decode(mp):
+    """A verify dispatch that raises must degrade that batch to plain
+    decode — counted in ``spec_fallbacks`` — with the OUTPUT still
+    bit-exact and the loop alive; speculation is an optimization and a
+    failing optimization may never cost correctness or availability."""
+    chaos = FaultInjector()
+    eng = _engine(mp, page_size=16, speculate=True, chaos=chaos)
+    try:
+        prompt = [5, 9] * 8                 # repetitive: drafter engages
+        # Warm pass doubles as the reference: greedy output is
+        # deterministic, so the post-fault submit must reproduce it
+        # (and test_spec_engine.py pins it to the plain engine).
+        want = eng.submit([prompt], max_new_tokens=8, timeout_s=30.0)
+        assert eng.stats()["spec_dispatches"] > 0, (
+            "speculation never engaged — the fault below would not be "
+            "exercised")
+        chaos.arm("spec_verify", exc=InjectedFault("injected verify error"))
+        out = eng.submit([prompt], max_new_tokens=8, timeout_s=30.0)
+        assert out == want, "fallback batch must stay bit-exact"
+        assert chaos.fired("spec_verify") == 1
+        s = eng.stats()
+        assert s["spec_fallbacks"] == 1
+        assert s["loop_crashes"] == 0, (
+            "a verify fault must be contained, not crash the loop")
+        assert eng.loop_alive()
+        # Speculation resumes once the fault is spent.
+        eng.submit([prompt], max_new_tokens=8, timeout_s=30.0)
+        assert eng.stats()["spec_dispatches"] > s["spec_dispatches"]
+    finally:
+        eng.close()
+
+
 # --- fault class: loop-thread death -------------------------------------
 
 

@@ -75,6 +75,24 @@ def test_inference_probes_and_drain_wiring():
     assert pod["terminationGracePeriodSeconds"] > drain_s
 
 
+def test_inference_speculate_flags_travel_together():
+    # docs/SPECULATIVE.md: --speculate is only valid on the paged
+    # continuous-batching engine, so the chart emits the three flags as
+    # one unit (the server validates the dependency at boot) — and none
+    # of them leak into the default render.
+    base = render({"inference.enabled": "true"})
+    cmd = base[("Deployment", "tpu-inference")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    for flag in ("--speculate", "--continuous-batching", "--kv-page-size"):
+        assert flag not in cmd
+    objs = render({"inference.enabled": "true",
+                   "inference.speculate": "true"})
+    cmd = objs[("Deployment", "tpu-inference")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert "--speculate" in cmd and "--continuous-batching" in cmd
+    assert cmd[cmd.index("--kv-page-size") + 1] == "64"
+
+
 def test_train_disabled_by_default():
     # Same opt-in rule as inference: the chart installs infrastructure,
     # workloads are explicit, and the default golden stays byte-stable.

@@ -1,0 +1,467 @@
+"""Speculative decoding in the continuous-batching engine + int8 paged KV
+(k3stpu/serve/engine.py `speculate=True`, k3stpu/serve/speculative.py
+NgramDrafter, models/transformer.py int8 paged pools).
+
+The correctness bar is the same BIT-EXACTNESS contract test_paged.py
+holds the paged pool to: an engine with `speculate=True` must emit
+exactly the tokens the plain engine (and solo `generate()`) emits —
+greedy, across ragged batches, every prompt-cache path, eos early
+release, and near the max_seq headroom gate. Speculation may only ever
+change HOW MANY dispatches produce those tokens, never which tokens.
+Each exactness test also asserts `spec_accepted > 0` (or the gate's
+`spec_dispatches == 0`) so a speculative path that silently never
+engages can't pass vacuously.
+
+The int8-paged-KV half: per-page absmax scales must make the paged
+int8 pool compute the same attention as the dense int8 cache, drift
+against the fp pool must stay inside the documented bound
+(docs/SPECULATIVE.md), and a fixed HBM budget must buy >= 2x the pages
+vs fp32 — checked against the engine's measured per-page bytes, not
+just the planning formula. CPU-JAX stand-in per SURVEY.md §4.
+
+Engine economy: each GenerateEngine compiles its own jitted programs
+(bound methods, self static), and the full suite already runs near the
+single-process XLA:CPU compile-state horizon run_suite.sh documents —
+so the exactness tests SHARE one module-scoped engine pair instead of
+building fresh engines per test. The shared pair makes two tests
+order-sensitive (noted inline): the sampled-gate test must see equal
+dispatch histories on both engines, so it runs before any greedy
+speculation desyncs the sampling-key folds.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.generate import (
+    generate,
+    init_cache,
+    paged_model,
+    set_cache_index,
+)
+from k3stpu.models.quant import kv_page_bytes, kv_pages_for_budget
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine
+from k3stpu.serve.programs import decode_core
+from k3stpu.serve.speculative import NgramDrafter
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    yield model, variables["params"]
+    # Drop this module's compiled executables once it finishes: the
+    # single-process full suite already runs near the XLA:CPU
+    # compile-state horizon run_suite.sh documents, and the ~10 engines
+    # this module builds are enough headroom to push a LATER module's
+    # compile over it (observed as a segfault inside the compilation-
+    # cache read in test_transformer). The persistent disk cache
+    # (tests/conftest.py) keeps any re-warm cheap.
+    jax.clear_caches()
+
+
+@pytest.fixture(scope="module")
+def pair(mp):
+    """ONE plain paged engine and ONE speculative paged engine with
+    identical scheduling parameters, shared by every exactness test
+    (compile economy — see the module docstring). Same seed => the
+    sampling-key folds match while dispatch histories match."""
+    model, params = mp
+    plain = GenerateEngine(model, params, seed=0, page_size=8, slots=4)
+    spec = GenerateEngine(model, params, seed=0, page_size=8, slots=4,
+                          speculate=True)
+    yield plain, spec
+    plain.close()
+    spec.close()
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+def _assert_page_invariants(engine):
+    # Same exact-accounting check as test_paged._assert_page_invariants
+    # (duplicated: test modules aren't importable from each other).
+    alloc = engine._alloc
+    expect = {}
+    for chain in engine._chains:
+        for p in chain:
+            expect[p] = expect.get(p, 0) + 1
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            expect[p] = expect.get(p, 0) + 1
+    for p in range(1, alloc.num_pages):
+        assert alloc.refcount(p) == expect.get(p, 0), (
+            f"page {p}: rc={alloc.refcount(p)} but "
+            f"{expect.get(p, 0)} live references")
+    assert alloc.free == alloc.total - sum(1 for v in expect.values()
+                                           if v > 0)
+
+
+# A prompt whose suffix recurs — the n-gram drafter proposes on these,
+# so speculation actually engages (asserted, never assumed).
+def _rep(a, b, reps=8):
+    return [a, b] * reps
+
+
+# --- NgramDrafter units (pure host, no jax) -----------------------------
+
+
+def test_drafter_validation():
+    with pytest.raises(ValueError):
+        NgramDrafter(max_ngram=2, min_ngram=3)
+    with pytest.raises(ValueError):
+        NgramDrafter(min_ngram=0)
+    with pytest.raises(ValueError):
+        NgramDrafter(max_ngram=3, window=3)   # window < max_ngram + 1
+
+
+def test_drafter_proposes_repeating_continuation():
+    d = NgramDrafter()
+    # suffix [1, 2] recurred; its earlier continuation is [3, 1, 2, 3...]
+    hist = [1, 2, 3, 1, 2, 3, 1, 2]
+    assert d.propose(hist, 3) == [3, 1, 2]
+    assert d.propose(hist, 1) == [3]
+
+
+def test_drafter_prefers_full_depth_continuation():
+    """A run of one repeated token matches right at the end with almost
+    no continuation room; an earlier occurrence with the full depth of
+    continuation must win over that nearer partial match."""
+    d = NgramDrafter(max_ngram=2, min_ngram=2)
+    hist = [7, 7, 7, 7, 7]
+    # suffix [7,7] at i=0 has depth-3 continuation [7,7,7]; the i=2
+    # match only offers [7]. Full depth preferred.
+    assert d.propose(hist, 3) == [7, 7, 7]
+
+
+def test_drafter_latest_full_match_wins():
+    d = NgramDrafter(max_ngram=2, min_ngram=2)
+    #       [5,6]->9 ....... [5,6]->4 ....... [5,6]?
+    hist = [5, 6, 9, 1, 1, 5, 6, 4, 1, 1, 5, 6]
+    assert d.propose(hist, 1) == [4], "latest earlier occurrence wins"
+
+
+def test_drafter_min_ngram_fallback():
+    d = NgramDrafter(max_ngram=3, min_ngram=2)
+    # No 3-gram recurs, but the 2-gram suffix [1, 2] does.
+    hist = [1, 2, 8, 9, 1, 2]
+    assert d.propose(hist, 1) == [8]
+
+
+def test_drafter_no_match_and_zero_depth():
+    d = NgramDrafter()
+    assert d.propose([1, 2, 3, 4, 5], 4) == []      # nothing recurs
+    assert d.propose([1, 2, 3, 1, 2], 0) == []      # no depth asked
+    assert d.propose([], 4) == []
+
+
+def test_drafter_window_bounds_the_scan():
+    d = NgramDrafter(max_ngram=2, min_ngram=2, window=8)
+    # The only recurrence of the suffix lies outside the last 8 tokens.
+    hist = [5, 6, 7] + [1, 2, 3, 4] * 3
+    assert hist[-8:].count(5) == 0
+    assert d.propose(hist, 2) == [1, 2]             # in-window match
+    hist2 = [5, 6, 9] + list(range(10, 19)) + [5, 6]
+    assert d.propose(hist2, 1) == []                # match aged out
+
+
+# --- constructor contract ----------------------------------------------
+
+
+def test_speculate_requires_paged_cache(mp):
+    model, params = mp
+    with pytest.raises(ValueError, match="page_size"):
+        GenerateEngine(model, params, speculate=True)
+    with pytest.raises(ValueError, match="spec_gamma"):
+        GenerateEngine(model, params, page_size=8, speculate=True,
+                       spec_gamma=0)
+
+
+# --- bit-exactness: speculative == plain == solo generate() -------------
+# (shared `pair` fixture: tests below run in file order by design)
+
+
+def test_spec_sampled_requests_take_plain_path(pair):
+    """Speculative verify is greedy-only; sampled traffic must take the
+    plain path and stay bit-identical to the plain engine. MUST run
+    before any greedy test on the shared pair: the comparison needs
+    equal dispatch histories (the sampling key folds on the dispatch
+    counter, which greedy speculation advances differently)."""
+    plain, spec = pair
+    for kw in ({"temperature": 0.9, "top_k": 20},
+               {"temperature": 1.0, "top_p": 0.9}):
+        want = plain.submit([_rep(9, 10), [4, 5]], max_new_tokens=8,
+                            **kw)
+        assert spec.submit([_rep(9, 10), [4, 5]], max_new_tokens=8,
+                           **kw) == want
+    assert spec.stats()["spec_dispatches"] == 0, (
+        "greedy-only gate must keep sampled batches off the "
+        "speculative path")
+
+
+def test_spec_matches_plain_greedy_repetitive(mp, pair):
+    model, params = mp
+    plain, spec = pair
+    cases = [
+        [_rep(5, 9)],
+        [_rep(3, 4, reps=6), _rep(11, 12, reps=9)],    # ragged batch
+        [_rep(7, 7, reps=5), [40] * 12, _rep(2, 8)],   # 3 rows
+    ]
+    for prompts in cases:
+        want = plain.submit(prompts, max_new_tokens=8)
+        assert spec.submit(prompts, max_new_tokens=8) == want
+        # plain itself is pinned to solo generate() — anchor the
+        # chain so a shared bug in both engines can't hide.
+        for w, p in zip(want, prompts):
+            assert w == _solo(model, params, p, 8)
+    s = spec.stats()
+    assert s["spec_dispatches"] > 0 and s["spec_accepted"] > 0, (
+        "speculation never engaged — exactness checked nothing")
+    assert s["spec_fallbacks"] == 0
+    assert plain.stats()["spec_dispatches"] == 0
+    # The perf claim at its weakest useful form: strictly fewer verify
+    # dispatches than tokens they emitted (accepted-tokens/dispatch>1).
+    assert s["spec_emitted"] > s["spec_dispatches"]
+    assert s["spec_tokens_per_dispatch"] > 1.0
+    assert 0.0 < s["spec_accept_rate"] <= 1.0
+    _assert_page_invariants(spec)
+
+
+def test_spec_eos_early_release_exact(mp, pair):
+    """A row finishing on eos mid-speculation must release exactly like
+    the plain engine: same (eos-padded) output, pages back to the pool,
+    ragged budgets across the batch."""
+    model, params = mp
+    plain, spec = pair
+    prompt = _rep(5, 9)
+    sol = _solo(model, params, prompt, 10)
+    eos = sol[4]                        # force a mid-generation stop
+    want = plain.submit([prompt], max_new_tokens=10, eos_id=eos)
+    assert spec.submit([prompt], max_new_tokens=10, eos_id=eos) == want
+    # Ragged budgets: one row stops on eos while its sibling runs.
+    free0 = spec.stats()["pages_free"]
+    accepted0 = spec.stats()["spec_accepted"]
+    want = plain.submit([prompt, _rep(11, 12)], max_new_tokens=9,
+                        eos_id=eos)
+    assert spec.submit([prompt, _rep(11, 12)], max_new_tokens=9,
+                       eos_id=eos) == want
+    assert spec.stats()["pages_free"] == free0, (
+        "early-released rows must return their pages")
+    assert spec.stats()["spec_accepted"] > accepted0
+    _assert_page_invariants(spec)
+
+
+def test_spec_max_seq_headroom_gate_exact(mp, pair):
+    """Rows whose verify chunk would cross max_seq must fall back to
+    plain decode for those dispatches — a static W-wide write past the
+    last page would clamp into the row's own tail and corrupt the same
+    dispatch's attention. Output must run exact right up to a full
+    cache."""
+    model, params = mp
+    plain, spec = pair
+    prompt = _rep(5, 9, reps=15) + [5]  # 31 toks (width bucket 32)
+    budget = 64 - 32                    # fill the cache to the brim:
+    #                                     final index 31 + 32 = 63,
+    #                                     so late dispatches trip the
+    #                                     idx + W > max_seq gate
+    accepted0 = spec.stats()["spec_accepted"]
+    want = plain.submit([prompt], max_new_tokens=budget)
+    assert spec.submit([prompt], max_new_tokens=budget) == want
+    assert want[0] == _solo(model, params, prompt, budget)
+    assert spec.stats()["spec_accepted"] > accepted0, (
+        "gate must not disable speculation")
+
+
+def test_spec_matches_plain_prompt_cache_paths(mp):
+    """Miss, exact hit, and prefix hit (COW tail page) stay bit-exact
+    under speculation AND take the same cache path (counters compared,
+    not just tokens). Own engine pair: the shared one has no prompt
+    cache."""
+    model, params = mp
+    plain = GenerateEngine(model, params, seed=0, page_size=8, slots=4,
+                           prompt_cache=4)
+    spec = GenerateEngine(model, params, seed=0, page_size=8, slots=4,
+                          prompt_cache=4, speculate=True)
+    try:
+        prompt = _rep(5, 6, reps=5) + [5]      # 11 toks: partial tail
+        # miss -> insert
+        want = plain.submit([prompt], max_new_tokens=6)
+        assert spec.submit([prompt], max_new_tokens=6) == want
+        # exact hit: same prompt again
+        want = plain.submit([prompt], max_new_tokens=6)
+        assert spec.submit([prompt], max_new_tokens=6) == want
+        # prefix hit: cached prompt + a repetitive tail (COW on the
+        # shared partial page, then speculative extends past it)
+        ext = prompt + [6, 5, 6]
+        want = plain.submit([ext], max_new_tokens=6)
+        assert spec.submit([ext], max_new_tokens=6) == want
+        ps, ss = plain.stats(), spec.stats()
+        for k in ("pcache_hits", "pcache_prefix_hits", "pcache_misses"):
+            assert ss[k] == ps[k], (k, ss[k], ps[k])
+        assert ss["pcache_hits"] >= 1 and ss["pcache_prefix_hits"] >= 1
+        assert ss["spec_accepted"] > 0
+        _assert_page_invariants(spec)
+    finally:
+        plain.close()
+        spec.close()
+
+
+def test_spec_zero_steady_state_recompiles(mp):
+    """The verify program takes a static (slots, gamma+1) chunk, so
+    after one warmup pass steady-state speculative traffic — different
+    tokens, depths, acceptance patterns, cache paths — must hit the jit
+    cache every time. Own engine: the count must start from this
+    engine's warmup."""
+    model, params = mp
+
+    def jit_cache_total():
+        return sum(f._cache_size() for f in vars(GenerateEngine).values()
+                   if hasattr(f, "_cache_size"))
+
+    engine = GenerateEngine(model, params, slots=4, seed=0,
+                            prompt_cache=4, page_size=8, speculate=True)
+    try:
+        def traffic(a, b):
+            p = _rep(a, b, reps=5)
+            engine.submit([p], max_new_tokens=6)
+            engine.submit([p], max_new_tokens=6)              # exact hit
+            engine.submit([p + [a, b, a]], max_new_tokens=6)  # prefix hit
+            engine.submit([[a, b], _rep(b, a, reps=4)],
+                          max_new_tokens=5)                   # ragged
+
+        traffic(5, 9)                    # warmup: compiles everything,
+        #                                  including the verify program
+        assert engine.stats()["spec_dispatches"] > 0
+        before = jit_cache_total()
+        for a, b in ((60, 61), (120, 121), (180, 181)):
+            traffic(a, b)
+        assert jit_cache_total() == before, (
+            "steady-state speculative traffic recompiled a program")
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+# --- int8 paged KV ------------------------------------------------------
+
+
+def _int8_variant(model):
+    return type(model)(dataclasses.replace(model.config,
+                                           kv_cache_dtype="int8"))
+
+
+def test_spec_int8_paged_matches_dense_int8(mp):
+    """Same storage dtype, paged-with-per-page-scales vs dense: token
+    streams must be identical — the paged int8 layout (int8 value pages
+    + fp32 scale pages) may not change the computed attention. Float
+    params drop in unchanged (cache dtype is storage-only)."""
+    model, params = mp
+    qmodel = _int8_variant(model)
+    dense = GenerateEngine(qmodel, params, slots=4, seed=0)
+    spec = GenerateEngine(qmodel, params, slots=4, seed=0, page_size=8,
+                          speculate=True)
+    try:
+        for prompts in ([_rep(5, 9)],
+                        [_rep(3, 4, reps=6), _rep(11, 12, reps=9)]):
+            want = dense.submit(prompts, max_new_tokens=8)
+            assert spec.submit(prompts, max_new_tokens=8) == want
+        assert spec.stats()["spec_accepted"] > 0
+        _assert_page_invariants(spec)
+    finally:
+        dense.close()
+        spec.close()
+
+
+def _paged_decode_logits(model, params, prompt, *, page_size=8):
+    """Last-step logits of `prompt` fed token-by-token through the
+    model's PAGED decode path (the engine's storage layout, without the
+    engine): one row, block table over pages 1..n_bt, index advanced
+    explicitly like the engine's host mirror."""
+    cfg = getattr(model.config, "base", model.config)
+    n_bt = cfg.max_seq_len // page_size
+    pmod = paged_model(model, num_pages=1 + n_bt, page_size=page_size)
+    cache = init_cache(pmod, 1)
+    bt = jnp.asarray(np.arange(1, 1 + n_bt, dtype=np.int32)[None, :])
+    logits = None
+    for i, t in enumerate(prompt):
+        cache = set_cache_index(cache, jnp.full((1,), i, jnp.int32))
+        cache, logits = decode_core(pmod, params, cache,
+                                    jnp.asarray([t], jnp.int32),
+                                    block_tables=bt)
+    return np.asarray(logits, np.float32)[0]
+
+
+def test_int8_paged_drift_bound_vs_fp_pool(mp):
+    """The documented drift guarantee (docs/SPECULATIVE.md): per-page
+    absmax int8 storage keeps decode logits within a bounded relative
+    error of the fp paged pool — same bound test_quant.py holds the
+    dense int8 cache to, here asserted against the PAGED layout whose
+    scales live in separate fp32 pages."""
+    model, params = mp
+    prompt = [3, 7, 1, 9, 4, 2, 8, 6, 5, 1, 7, 3]
+    lf = _paged_decode_logits(model, params, prompt)
+    lq = _paged_decode_logits(_int8_variant(model), params, prompt)
+    err = float(np.max(np.abs(lf - lq)))
+    span = float(np.max(np.abs(lf))) + 1e-6
+    assert err / span < 0.15, f"paged int8 drift {err/span:.3f} vs fp"
+    # And the per-page scales are faithful to the DENSE int8 cache: the
+    # paged layout quantizes per (token, kv-head) exactly like dense,
+    # so the two int8 paths must agree far tighter than the fp bound.
+    qmodel = _int8_variant(model)
+    dq_cache = init_cache(qmodel, 1)
+    dq = None
+    for i, t in enumerate(prompt):
+        dq_cache = set_cache_index(dq_cache, jnp.full((1,), i, jnp.int32))
+        dq_cache, dq = decode_core(qmodel, params, dq_cache,
+                                   jnp.asarray([t], jnp.int32))
+    dq = np.asarray(dq, np.float32)[0]
+    assert float(np.max(np.abs(dq - lq))) / span < 0.02
+
+
+def test_int8_doubles_pages_at_fixed_byte_budget(mp):
+    """Same HBM budget, same model: kv_cache_dtype='int8' must buy
+    >= 2x the pages of an fp32 pool (4x at large head_dim; 3.2x at this
+    model's head_dim 16), the planning formula must equal the engine's
+    MEASURED per-page bytes, and the pool gauges must reflect the
+    bigger pool."""
+    model, params = mp
+    ps = 16
+    cfg32 = dataclasses.replace(model.config, dtype=jnp.float32)
+    cfg8 = dataclasses.replace(model.config, kv_cache_dtype="int8")
+    budget = 40 * kv_page_bytes(cfg32, ps)          # fixed byte budget
+    n32 = kv_pages_for_budget(budget, cfg32, ps)
+    n8 = kv_pages_for_budget(budget, cfg8, ps)
+    assert n32 == 40
+    assert n8 >= 2 * n32, (n8, n32)
+    # Gauges: an int8 engine built at that budget reports the larger
+    # pool, its measured per-page bytes equal the planning formula
+    # (float engines asserted in test_paged's tier via _page_bytes),
+    # and the pool stays inside the budget. Construction only — the
+    # int8 pool's correctness under traffic is the exactness test
+    # above, and engine programs compile per instance (run_suite.sh
+    # compile-state horizon).
+    eng = GenerateEngine(_int8_variant(model), params, slots=2,
+                         page_size=ps, num_pages=n8, speculate=True)
+    try:
+        s = eng.stats()
+        assert s["pages_total"] == n8 - 1           # sink excluded
+        assert s["pages_free"] == n8 - 1
+        assert eng._page_bytes == kv_page_bytes(cfg8, ps)
+        assert eng._page_bytes * n8 <= budget
+    finally:
+        eng.close()
+    fpe = GenerateEngine(model, params, slots=2, page_size=ps)
+    try:
+        assert fpe._page_bytes == kv_page_bytes(model.config, ps)
+    finally:
+        fpe.close()
