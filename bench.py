@@ -22,6 +22,12 @@ of only the failure record while the device tunnel is down.
 (same contract): decode tokens/s with tracing+histograms on vs off;
 the <5% budget from ISSUE 2, vs_baseline = overhead/5.
 
+``--serve-tier`` gates the host KV page tier (same contract): warm-turn
+restore latency (tier swap-in + suffix prefill) vs cold re-prefill at a
+512-token prompt, gate <= 1/3 (vs_baseline = ratio*3, <=1.0 passes),
+with restorable-session capacity at a fixed page pool vs the no-tier
+engine (gate >= 8x) carried in the detail.
+
 ``--train-obs`` is the training twin (same contract): median step time
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
@@ -680,6 +686,170 @@ def _serve_obs_main() -> int:
                  **skw)
 
 
+def _serve_tier_worker() -> int:
+    """Host KV page tier gate (bounded subprocess, CPU tiny model).
+
+    Arm A (the headline): a 512-token session's warm second turn —
+    tier swap-in of the parked chain + suffix-only prefill — timed
+    against the same turn on a tierless engine that must re-prefill the
+    whole grown prompt. Gate: warm <= cold/3. Best-of-3 with distinct
+    prompts; both arms pay identical submit/loop overheads, so the
+    ratio isolates restore-vs-reprefill.
+
+    Arm B (in the detail): at one fixed page pool, how many sessions
+    remain warm-restorable — chain still pinned in the prompt cache OR
+    parked in the host tier — after S sessions run a turn each. The
+    no-tier engine keeps chains only while HBM pages last; the tier
+    engine parks every released chain in host RAM. Gate: >= 8x."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from k3stpu.models.transformer import transformer_lm_tiny
+    from k3stpu.serve.engine import GenerateEngine
+    from k3stpu.serve.tiering import HostPageStore
+
+    # max_seq 2048: the grown turn-2 prompt (512 + reply + 2) buckets
+    # to a 1024-wide prefill, which must still fit under the cache.
+    max_seq, page, slots = 2048, 64, 2
+    prompt_len, reply = 512, 8
+    pool_pages = 41  # sink + 40 usable: ~3 pinned chains + working room
+
+    model = transformer_lm_tiny(max_seq_len=max_seq)
+    params = model.init(jax.random.key(0),
+                        np.zeros((1, 1), np.int32))["params"]
+
+    def prompt_for(i: int) -> "list[int]":
+        rng = np.random.default_rng(100 + i)
+        return rng.integers(1, 1000, size=(prompt_len,)).tolist()
+
+    def make_engine(tier):
+        return GenerateEngine(model, params, slots=slots, seed=0,
+                              page_size=page, num_pages=pool_pages,
+                              prompt_cache=64, tier=tier)
+
+    def turn(engine, p, sid, n_new):
+        t0 = time.perf_counter()
+        out = engine.submit([p], max_new_tokens=n_new, session=sid)
+        return time.perf_counter() - t0, out[0]
+
+    # -- Arm A: warm restore vs cold re-prefill ------------------------
+    tier = HostPageStore(256 << 20)
+    eng_t, eng_c = make_engine(tier), make_engine(None)
+    warm_s: "list[float]" = []
+    cold_s: "list[float]" = []
+    try:
+        # Warm every program the measured turns hit (turn-1 prefill
+        # bucket, suffix bucket, swap gather/scatter) on BOTH engines.
+        for eng, rel in ((eng_t, True), (eng_c, False)):
+            _, rep = turn(eng, prompt_for(99), "w", reply)
+            if rel:
+                eng.release_session("w")
+            turn(eng, prompt_for(99) + rep + [1, 2], "w", 1)
+            if rel:
+                eng.release_session("w")
+        for i in range(3):
+            p = prompt_for(i)
+            _, rep = turn(eng_t, p, f"s{i}", reply)
+            eng_t.release_session(f"s{i}")  # chain parks on host
+            p2 = p + rep + [3, 4]
+            dt, _ = turn(eng_t, p2, f"s{i}", 1)  # swap-in + suffix
+            warm_s.append(dt)
+            eng_t.release_session(f"s{i}")
+            dt, _ = turn(eng_c, p2, None, 1)  # full re-prefill
+            cold_s.append(dt)
+    finally:
+        eng_t.close()
+        eng_c.close()
+
+    # -- Arm B: restorable sessions at a fixed pool --------------------
+    n_sessions = 40
+    tier_b = HostPageStore(256 << 20)
+    caps = {}
+    for label, t_store, rel in (("tier", tier_b, True),
+                                ("no_tier", None, False)):
+        eng = make_engine(t_store)
+        try:
+            for i in range(n_sessions):
+                eng.submit([prompt_for(200 + i)], max_new_tokens=reply,
+                           session=f"b{i}")
+                if rel:
+                    eng.release_session(f"b{i}")
+        finally:
+            eng.close()  # quiesce the loop before reading its ledgers
+        caps[label] = sum(
+            1 for key in eng._sessions.values()
+            if key in eng._pcache
+            or (t_store is not None and t_store.contains(key)))
+
+    ratio = min(warm_s) / max(min(cold_s), 1e-9)
+    capacity_x = caps["tier"] / max(caps["no_tier"], 1)
+    doc = {
+        # Headline: warm-turn restore time over cold re-prefill time.
+        # The bar is 1/3; vs_baseline = ratio*3 so <=1.0 passes.
+        "metric": "serve_tier_warm_restore_ratio",
+        "value": round(ratio, 4),
+        "unit": "warm_turn_s_over_cold_reprefill_s",
+        "vs_baseline": round(ratio * 3.0, 4),
+        "detail": {
+            "gate_warm_over_cold_max": round(1.0 / 3.0, 4),
+            "warm_gate_passed": ratio <= 1.0 / 3.0,
+            "warm_turn_s": round(min(warm_s), 6),
+            "cold_reprefill_s": round(min(cold_s), 6),
+            "prompt_tokens": prompt_len,
+            "runs_per_arm": 3,
+            "session_capacity_x": round(capacity_x, 2),
+            "gate_session_capacity_min_x": 8.0,
+            "capacity_gate_passed": capacity_x >= 8.0,
+            "sessions_run_per_arm": n_sessions,
+            "sessions_tier_restorable": caps["tier"],
+            "sessions_no_tier_restorable": caps["no_tier"],
+            "fixed_pool_pages": pool_pages - 1,
+            "page_size": page,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_tier_main() -> int:
+    """Bounded-subprocess wrapper for --serve-tier (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-tier-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_tier")
+    skw = {"metric": "serve_tier_warm_restore_ratio",
+           "unit": "warm_turn_s_over_cold_reprefill_s"}
+    if not ok:
+        why = (f"tier bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_tier", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _train_obs_worker() -> int:
     """TrainObs overhead microbench (bounded subprocess).
 
@@ -1148,6 +1318,10 @@ if __name__ == "__main__":
         sys.exit(_serve_obs_worker())
     if "--serve-obs" in sys.argv[1:]:
         sys.exit(_serve_obs_main())
+    if "--serve-tier-worker" in sys.argv[1:]:
+        sys.exit(_serve_tier_worker())
+    if "--serve-tier" in sys.argv[1:]:
+        sys.exit(_serve_tier_main())
     if "--train-obs-worker" in sys.argv[1:]:
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:

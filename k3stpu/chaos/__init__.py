@@ -35,6 +35,13 @@ point                 boundary
                       path — a raised fault makes that batch fall back to
                       plain decode (``spec_fallbacks`` counter), never
                       wedging the loop or corrupting output
+``tier_swap``         the device gather/scatter inside host-tier page
+                      swaps, both directions (``engine._tier_swap_out`` /
+                      ``_tier_swap_in``) — a failed swap-out drops the
+                      entry (next turn pays a cold prefill), a failed
+                      swap-in discards the tier entry and degrades that
+                      request to a cold prefill (``tier_fallbacks``
+                      counter); live rows are untouched either way
 ``sse_write``         per-event SSE write in the HTTP handler — a raised
                       ``BrokenPipeError`` simulates a client disconnect
                       mid-stream

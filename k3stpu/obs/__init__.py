@@ -98,6 +98,39 @@ class ServeObs:
             "k3stpu_serve_spec_verify_seconds",
             "Device verify-extend time per speculative dispatch.",
             bounds=TPOT_BUCKETS_S)
+        # Host KV page tier (engine tier=, docs/TIERING.md). The two
+        # gauges together are the capacity story: resident HBM pages vs
+        # page-equivalents parked in host RAM. All stay at zero/-1 on a
+        # tierless engine.
+        self.pages_resident = Gauge(
+            "k3stpu_serve_pages_resident",
+            "Allocated (non-free) KV pages in the device pool, sampled "
+            "by the loop.",
+            value=-1)  # -1 = engine not running in paged mode
+        self.host_tier_pages = Gauge(
+            "k3stpu_serve_host_tier_pages",
+            "KV page-equivalents currently held by the host-memory "
+            "tier, updated at each swap.")
+        self.tier_swap_in_seconds = Histogram(
+            "k3stpu_serve_tier_swap_in_seconds",
+            "Host-tier chain restore time (load + page alloc + batched "
+            "scatter) per swap-in.",
+            bounds=TPOT_BUCKETS_S)
+        self.tier_swap_out_seconds = Histogram(
+            "k3stpu_serve_tier_swap_out_seconds",
+            "Device-to-host chain gather time per tier swap-out.",
+            bounds=TPOT_BUCKETS_S)
+        self.tier_hits = Counter(
+            "k3stpu_serve_tier_hits_total",
+            "Admission probes that found a matching chain in the host "
+            "tier.")
+        self.tier_misses = Counter(
+            "k3stpu_serve_tier_misses_total",
+            "Admission probes that found no host-tier chain.")
+        self.tier_fallbacks = Counter(
+            "k3stpu_serve_tier_fallbacks_total",
+            "Tier swaps that failed and degraded to a cold prefill "
+            "(or plain eviction).")
         self.build_info = build_info_gauge("serve")
 
     # -- engine hooks (loop / submitter threads) ---------------------------
@@ -127,13 +160,39 @@ class ServeObs:
             tr.t_first = tr.event("first_token")
 
     def on_dispatch(self, n_active: int, queue_depth: int,
-                    pages_free: "int | None" = None) -> None:
+                    pages_free: "int | None" = None,
+                    pages_resident: "int | None" = None) -> None:
         if not self.enabled:
             return
         self.batch_occupancy.observe(float(n_active))
         self.queue_depth.set(float(queue_depth))
         if pages_free is not None:
             self.pages_free.set(float(pages_free))
+        if pages_resident is not None:
+            self.pages_resident.set(float(pages_resident))
+
+    def on_tier_probe(self, hit: bool) -> None:
+        if not self.enabled:
+            return
+        (self.tier_hits if hit else self.tier_misses).inc()
+
+    def on_tier_swap(self, direction: str, seconds: float,
+                     host_pages: int, pages_resident: int) -> None:
+        """One completed tier swap ('in' = host chain restored to fresh
+        device pages, 'out' = chain gathered off device). The gauges
+        re-sample here as well as at dispatch so an idle engine's
+        demotions still move them."""
+        if not self.enabled:
+            return
+        (self.tier_swap_in_seconds if direction == "in"
+         else self.tier_swap_out_seconds).observe(seconds)
+        self.host_tier_pages.set(float(host_pages))
+        self.pages_resident.set(float(pages_resident))
+
+    def on_tier_fallback(self) -> None:
+        if not self.enabled:
+            return
+        self.tier_fallbacks.inc()
 
     def on_spec_dispatch(self, proposed: int, accepted: int, emitted: int,
                          draft_s: float, verify_s: float) -> None:
@@ -175,17 +234,21 @@ class ServeObs:
     def histograms(self) -> "tuple[Histogram, ...]":
         return (self.ttft, self.tpot, self.e2e, self.queue_wait,
                 self.batch_occupancy, self.spec_draft_seconds,
-                self.spec_verify_seconds)
+                self.spec_verify_seconds, self.tier_swap_in_seconds,
+                self.tier_swap_out_seconds)
 
     def _counters(self) -> "tuple[Counter, ...]":
         return (self.spec_accepted_tokens, self.spec_proposed_tokens,
-                self.spec_dispatches)
+                self.spec_dispatches, self.tier_hits, self.tier_misses,
+                self.tier_fallbacks)
+
+    def _gauges(self) -> "tuple[Gauge, ...]":
+        return (self.queue_depth, self.pages_free, self.pages_resident,
+                self.host_tier_pages, self.spec_accept_ratio)
 
     def render_prometheus(self) -> str:
         parts = [h.render() for h in self.histograms()]
-        parts.append(self.queue_depth.render())
-        parts.append(self.pages_free.render())
-        parts.append(self.spec_accept_ratio.render())
+        parts.extend(g.render() for g in self._gauges())
         parts.extend(c.render() for c in self._counters())
         parts.append(self.build_info.render())
         return "\n".join(parts)
@@ -195,9 +258,7 @@ class ServeObs:
         carrying trace-id exemplars. No ``# EOF`` — the server appends
         it once after concatenating all parts."""
         parts = [h.render_openmetrics() for h in self.histograms()]
-        parts.append(self.queue_depth.render())
-        parts.append(self.pages_free.render())
-        parts.append(self.spec_accept_ratio.render())
+        parts.extend(g.render() for g in self._gauges())
         # Counters need the _total-stripped HELP/TYPE form OpenMetrics
         # requires; the rewrite leaves gauges/histograms untouched.
         parts.extend(prometheus_text_to_openmetrics(c.render())
@@ -218,6 +279,7 @@ class ServeObs:
             c.reset()
         self.spec_accept_ratio.set(0.0)
         self.queue_depth.set(0.0)
+        self.host_tier_pages.set(0.0)
         self.traces.reset()
 
 

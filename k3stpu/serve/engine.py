@@ -141,7 +141,7 @@ class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
                  "deadline", "stream_q", "_ptuple", "probe", "adapter",
-                 "trace", "trace_id")
+                 "trace", "trace_id", "session")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None, adapter=0):
@@ -178,6 +178,10 @@ class _Request:
         # thread waste. A stale entry stays CORRECT (immutable arrays);
         # the only cost is missing a better prefix inserted meanwhile.
         self.probe: "tuple | None" = None
+        # Session id (paged mode): names this request's finished KV
+        # chain in the prompt cache / host tier so the session's next
+        # turn restores it instead of re-prefilling. None = one-shot.
+        self.session: "str | None" = None
 
     def ptuple(self) -> tuple:
         """The single-prompt cache key, computed once — the admission
@@ -206,6 +210,33 @@ class _Request:
         self.event.set()
 
 
+class _TierCommand:
+    """A control message riding the request queue: allocator / prompt
+    cache / tier state belongs to the loop thread alone, so HTTP-thread
+    operations on it (session release) marshal through ``_q`` and run
+    inline at drain. Duck-types the slice of ``_Request`` the loop's
+    shutdown tail touches (``error`` + ``signal()`` + ``deadline``) so
+    a command stranded behind the close sentinel fails cleanly instead
+    of hanging its caller."""
+
+    __slots__ = ("kind", "session", "event", "result", "error",
+                 "deadline", "tokens", "stream_q", "trace")
+
+    def __init__(self, kind: str, session: str):
+        self.kind = kind
+        self.session = session
+        self.event = threading.Event()
+        self.result = None
+        self.error: "Exception | None" = None
+        self.deadline = float("inf")  # commands never expire
+        self.tokens = None
+        self.stream_q = None
+        self.trace = None
+
+    def signal(self) -> None:
+        self.event.set()
+
+
 class GenerateEngine:
     """Owns a ``slots``-row KV cache and a single decode loop thread.
 
@@ -223,7 +254,7 @@ class GenerateEngine:
                  speculate: bool = False, spec_gamma: int = 4,
                  obs=None,
                  breaker=None, watchdog_s: "float | None" = None,
-                 chaos=None):
+                 chaos=None, tier=None, tier_watermark: int = 0):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -319,7 +350,22 @@ class GenerateEngine:
 
         ``chaos``: a ``k3stpu.chaos.FaultInjector`` consulted at the
         loop/dispatch/allocator fault boundaries. None (the default) =
-        no injection, zero overhead — production paths never arm this."""
+        no injection, zero overhead — production paths never arm this.
+
+        ``tier`` / ``tier_watermark``: host-memory KV page tier
+        (``serve/tiering.HostPageStore`` — paged mode + prompt_cache
+        only). Prompt-cache evictions GATHER their page chains to host
+        RAM instead of dropping them; the admission probe checks the
+        tier before declaring a pcache miss and restores a match into
+        fresh pages (one batched device_put + scatter), token-identical
+        to a never-swapped run. When ``tier_watermark`` > 0 the loop
+        proactively swaps out LRU pcache entries whenever
+        ``pages_free`` sits below it, so HBM pressure converts idle
+        sessions into host bytes instead of admission stalls. A failed
+        swap-in (chaos ``tier_swap``, torn disk spill) degrades to a
+        cold prefill — counted in ``tier_fallbacks``, live rows
+        untouched. ``release_session(sid)`` force-evicts a session's
+        chain to the tier between turns (docs/TIERING.md)."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
@@ -342,6 +388,17 @@ class GenerateEngine:
                 "rides the paged cache's host-mirrored per-row index)")
         if speculate and spec_gamma < 1:
             raise ValueError(f"spec_gamma must be >= 1, got {spec_gamma}")
+        if tier is not None and page_size is None:
+            raise ValueError(
+                "tier requires page_size (the host tier stores paged "
+                "KV chains; the dense cache has no page chains to swap)")
+        if tier is not None and prompt_cache <= 0:
+            raise ValueError(
+                "tier requires prompt_cache > 0 (tier entries restore "
+                "through the prompt cache's pin/refcount discipline)")
+        if tier_watermark < 0:
+            raise ValueError(f"tier_watermark must be >= 0, got "
+                             f"{tier_watermark}")
         self.model = model
         self.params = params
         self.slots = slots
@@ -385,6 +442,13 @@ class GenerateEngine:
             self._chains: "list[list[int]]" = [[] for _ in range(slots)]
             self._pinned: "dict[int, int]" = {}  # page -> #pcache pins
 
+        # Host page tier (serve/tiering.py; loop thread only — HTTP
+        # threads reach it through _TierCommand marshalling). _sessions
+        # maps a session id to its chain's current pcache/tier key.
+        self._tier = tier
+        self.tier_watermark = tier_watermark
+        self._sessions: "dict[str, tuple]" = {}
+
         # Speculative decoding state (loop thread only). _spec_hist[r]
         # is row r's prompt + every emitted token — the drafter's
         # lookup corpus; _spec_depth[r] is the row's adaptive proposal
@@ -401,11 +465,19 @@ class GenerateEngine:
         self._cache = init_cache(self.pmodel if self.paged else model,
                                  slots)
         if self.paged:
-            # Per-page HBM (all layers: K/V pools + int8 scale pools) —
-            # the unit of the pcache byte accounting.
+            # Per-page HBM (all layers: K/V pools + int8 scale planes)
+            # — the unit of the pcache byte accounting. Layout-aware:
+            # pool leaves are identified BY NAME (`*_pages`, the same
+            # rule every paged scatter uses), not by rank — an ndim
+            # heuristic silently dropped the int8 pools' (P, ps, H)
+            # fp32 scale planes from the count. Matches
+            # models/quant.kv_page_bytes leaf for leaf (asserted in
+            # tests/test_tiering.py).
             self._page_bytes = sum(
-                x.nbytes // num_pages
-                for x in jax.tree.leaves(self._cache) if x.ndim >= 3)
+                v.nbytes // num_pages
+                for p, v in
+                jax.tree_util.tree_flatten_with_path(self._cache)[0]
+                if str(getattr(p[-1], "key", "")).endswith("_pages"))
         self.mesh = mesh
         if mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -462,6 +534,13 @@ class GenerateEngine:
                        "spec_dispatches": 0, "spec_proposed": 0,
                        "spec_accepted": 0, "spec_emitted": 0,
                        "spec_fallbacks": 0,
+                       # Host page tier (docs/TIERING.md): admission
+                       # probes that found / missed a tier chain,
+                       # completed swap directions, and swaps that
+                       # degraded to a cold prefill.
+                       "tier_hits": 0, "tier_misses": 0,
+                       "tier_swap_ins": 0, "tier_swap_outs": 0,
+                       "tier_fallbacks": 0,
                        # Containment counters (docs/RESILIENCE.md).
                        "deadline_expired": 0, "watchdog_trips": 0,
                        "loop_crashes": 0, "loop_restarts": 0,
@@ -669,6 +748,24 @@ class GenerateEngine:
                                  ).endswith("_pages") else x),
             pool)
 
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _restore_pages(self, pool, host, page_idx):
+        """Tier swap-in scatter: host-gathered page rows (a dict keyed
+        by "/"-joined leaf paths, each ``(n, page_size, ...)``) land at
+        pages ``page_idx`` across every ``*_pages`` pool leaf in ONE
+        dispatch — jit turns the host dict into a single batched
+        device_put + scatter. ``n`` is pow2-bucketed by the caller; pad
+        rows carry zeros and target the sink page 0 (which absorbs junk
+        writes by design), so one compile serves every chain length in
+        a bucket."""
+        def put(path, leaf):
+            if not str(getattr(path[-1], "key", "")).endswith("_pages"):
+                return leaf
+            key = "/".join(str(getattr(k, "key", k)) for k in path)
+            return leaf.at[page_idx].set(host[key])
+
+        return jax.tree_util.tree_map_with_path(put, pool)
+
     # --- prompt cache (loop thread only; entries are immutable jax
     #     arrays, so a cached row survives the decodes of whatever slot
     #     its copy was scattered into) ------------------------------------
@@ -678,11 +775,18 @@ class GenerateEngine:
         it, UNDER THE SAME ADAPTER (a row prefilled through adapter i's
         deltas is a different computation — cross-adapter reuse would be
         silently wrong); a hit refreshes its LRU position. Returns the
-        PROMPT part of the key."""
+        PROMPT part of the key. Session-tail entries (logits slot None —
+        the chain a finished session left behind covers prompt+reply
+        K/V but no next-token distribution) only ever serve as PREFIX
+        hits: an exact-length match would need the stored logits the
+        entry doesn't have, so it is skipped and the shorter
+        logits-bearing entry (or a miss) wins instead."""
         best = None
         for aid, key in self._pcache:
             if (aid == adapter and len(key) <= len(prompt)
                     and prompt[:len(key)] == key
+                    and not (len(key) == len(prompt)
+                             and self._pcache[(aid, key)][-2] is None)
                     and (best is None or len(key) > len(best))):
                 best = key
         if best is None:
@@ -810,24 +914,40 @@ class GenerateEngine:
             else:
                 del self._pinned[p]
 
-    def _pcache_evict_lru(self) -> int:
+    def _pcache_evict_lru(self, swap: bool = True) -> int:
         """Drop the LRU prompt-cache entry (paged entries release their
-        page pins); returns its byte size. Caller adjusts the stat."""
-        entry = self._pcache.pop(next(iter(self._pcache)))
+        page pins); returns its byte size. Caller adjusts the stat.
+        With a host tier attached the entry's chain is GATHERED off
+        device first (``swap=False`` skips that — crash paths where
+        device state is untrusted), so eviction demotes instead of
+        forgetting; a failed gather falls back to the plain drop."""
+        key = next(iter(self._pcache))
+        entry = self._pcache.pop(key)
         if self.paged:
+            if swap and self._tier is not None:
+                self._tier_swap_out(key, entry)
             self._unpin_pages(entry[0])
             self._alloc.decref(entry[0])
         return entry[-1]
 
     def _pcache_insert_paged(self, prompt: tuple, src_chain, last1,
-                             adapter: int = 0) -> None:
+                             adapter: int = 0,
+                             frozen: bool = False) -> None:
         """Pin ``prompt``'s pages into the prompt cache WITHOUT copying
         the prompt K/V: the entry shares the source row's full pages by
         incref — safe read-only, since a row only ever writes positions
         >= its admitted length, which live past its full prompt pages —
         and copies only the partial tail page (the row's next decode
         DOES write into that one). Skipped when the pool can't spare
-        the tail copy."""
+        the tail copy.
+
+        ``frozen``: the source row is FINISHED (session-end insert) —
+        nothing will ever write its tail page again, so the partial
+        tail is shared by incref like the full pages instead of COW
+        copied (a later admission that extends the entry takes its own
+        tail copy through ``build_row``, same as any prefix hit). Saves
+        one page + one device copy per session turn, and cannot fail on
+        an exhausted pool."""
         if self.prompt_cache <= 0:
             return
         ps = self.page_size
@@ -835,20 +955,25 @@ class GenerateEngine:
         chain = list(src_chain[:full])
         self._alloc.incref(chain)
         if len(prompt) % ps:
-            tail = self._alloc.alloc(1)
-            if tail is None:
-                self._alloc.decref(chain)
-                return  # pool too tight to pin a copy — skip caching
-            self._cache = self._copy_page(self._cache, src_chain[full],
-                                          tail[0])
-            chain.append(tail[0])
+            if frozen:
+                chain.append(src_chain[full])
+                self._alloc.incref(chain[-1:])
+            else:
+                tail = self._alloc.alloc(1)
+                if tail is None:
+                    self._alloc.decref(chain)
+                    return  # pool too tight to pin a copy — skip caching
+                self._cache = self._copy_page(self._cache,
+                                              src_chain[full], tail[0])
+                chain.append(tail[0])
         old = self._pcache.pop((adapter, prompt), None)
         if old is not None:
             self._unpin_pages(old[0])
             self._alloc.decref(old[0])
         self._pin_pages(chain)
         nbytes = len(chain) * self._page_bytes \
-            + sum(x.nbytes for x in jax.tree.leaves(last1))
+            + (sum(x.nbytes for x in jax.tree.leaves(last1))
+               if last1 is not None else 0)
         self._pcache[(adapter, prompt)] = (tuple(chain), len(prompt),
                                            last1, nbytes)
         delta = nbytes - (old[-1] if old else 0)
@@ -856,6 +981,235 @@ class GenerateEngine:
             delta -= self._pcache_evict_lru()
         with self._lock:
             self._stats["pcache_bytes"] += delta
+
+    # --- host page tier (docs/TIERING.md; loop thread only) -------------
+
+    def _gather_pages(self, chain) -> dict:
+        """One host copy of a page chain: every ``*_pages`` pool leaf
+        gathered at the chain's indices, fetched in a SINGLE
+        ``jax.device_get`` of the whole dict (one transfer round-trip,
+        not one per layer). Keys are the "/"-joined leaf paths —
+        exactly what ``_restore_pages`` scatters back from."""
+        idx = jnp.asarray(chain, jnp.int32)
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self._cache)[0]:
+            if str(getattr(path[-1], "key", "")).endswith("_pages"):
+                key = "/".join(str(getattr(k, "key", k)) for k in path)
+                out[key] = leaf[idx]
+        return jax.device_get(out)
+
+    def _tier_swap_out(self, key, entry) -> bool:
+        """Gather a pcache entry's chain to the host tier. The caller
+        still owns the entry (and drops its pins/refs afterwards) —
+        this only copies bytes off device, so a failure (chaos
+        ``tier_swap``, host OOM) simply leaves the entry to die the
+        pre-tier way: dropped, next turn pays a cold prefill. Entry
+        pages are immutable once inserted (COW discipline), so the
+        gather needs no quiescence even while live rows share the
+        chain's full pages."""
+        t0 = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("tier_swap")
+            host = self._gather_pages(entry[0])
+            last = entry[2]
+            if last is not None:
+                last = jax.device_get(last)
+            self._tier.put(key, entry[1], host, last=last)
+        except Exception:  # noqa: BLE001 — degrade to plain eviction
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["tier_swap_outs"] += 1
+        if self._obs is not None:
+            self._obs.on_tier_swap(
+                "out", dt, self._tier.stats()["tier_pages"],
+                self._alloc.total - self._alloc.free)
+        return True
+
+    def _tier_swap_in(self, key) -> bool:
+        """Restore a tier entry into the prompt cache: allocate fresh
+        pages (pressure-evicting idle pcache entries first), scatter
+        the host buffers in via one ``_restore_pages`` dispatch, pin +
+        insert — after which the entry serves hits exactly like one
+        that never left. FRESH pages only: no live row's table points
+        at them, so any failure rolls back by freeing them — live rows
+        are untouchable by construction. Failure paths degrade to a
+        cold prefill (``tier_fallbacks``); corrupt/undecodable entries
+        are discarded so they cannot fail every later probe too."""
+        t0 = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("tier_swap")
+            length, host, last = self._tier.load(key)
+        except Exception:  # noqa: BLE001 — torn spill / injected fault
+            self._tier.discard(key)
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        n = -(-length // self.page_size)
+        while n > self._alloc.free and self._pcache:
+            freed = self._pcache_evict_lru()
+            with self._lock:
+                self._stats["pcache_bytes"] -= freed
+        pages = self._alloc.alloc(n)
+        if pages is None:
+            # Pool too tight even after pressure: keep the host copy
+            # (it is still good — a later, calmer admission can restore
+            # it) and let THIS request prefill cold.
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        try:
+            npad = _pow2_at_least(n)
+            idx = np.zeros((npad,), np.int32)
+            idx[:n] = pages
+            hpad = {}
+            for k, v in host.items():
+                buf = np.zeros((npad,) + v.shape[1:], v.dtype)
+                buf[:n] = v[:n]
+                hpad[k] = buf
+            self._cache = self._restore_pages(self._cache, hpad,
+                                              jnp.asarray(idx))
+            last_dev = jnp.asarray(last) if last is not None else None
+        except Exception:  # noqa: BLE001 — restore dispatch failed
+            self._record_backend_failure()
+            self._alloc.decref(pages)
+            self._tier.discard(key)
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        self._pin_pages(pages)
+        old = self._pcache.pop(key, None)
+        if old is not None:  # raced a fresh insert; replace it
+            self._unpin_pages(old[0])
+            self._alloc.decref(old[0])
+        nbytes = n * self._page_bytes \
+            + (int(last_dev.nbytes) if last_dev is not None else 0)
+        self._pcache[key] = (tuple(pages), length, last_dev, nbytes)
+        delta = nbytes - (old[-1] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] += delta
+            self._stats["tier_swap_ins"] += 1
+        self._tier.discard(key)  # moved, not copied: one owner at a time
+        if self._obs is not None:
+            self._obs.on_tier_swap(
+                "in", time.perf_counter() - t0,
+                self._tier.stats()["tier_pages"],
+                self._alloc.total - self._alloc.free)
+        return True
+
+    def _tier_pressure(self) -> None:
+        """Low-watermark demotion, run once per loop iteration: while
+        the free list sits below ``tier_watermark`` and idle pcache
+        entries exist, gather the LRU entry to host and return its
+        pages. Terminates because each pass shrinks the pcache;
+        entries whose pages are shared with live rows free only their
+        unshared pages (refcounts), which is exactly the reclaimable
+        amount."""
+        while (self._alloc.free < self.tier_watermark and self._pcache):
+            freed = self._pcache_evict_lru()
+            with self._lock:
+                self._stats["pcache_bytes"] -= freed
+
+    def _session_insert(self, req: "_Request", r: int) -> None:
+        """Session-end insert (called from _finish_row BEFORE the row's
+        pages are released): pin the finished row's chain into the
+        prompt cache keyed by prompt + every reply token except the
+        last. That key is exactly the K/V the chain holds — after g
+        emitted tokens the row's index is L+g-1 and positions
+        L..L+g-2 hold t1..t_{g-1}; the last sampled token's K/V was
+        never written (and any mid-block post-eos junk lies beyond the
+        key length, invisible to the position mask). The entry stores
+        last=None — no logits exist for the uncommitted tail token —
+        so it serves prefix hits only (the next turn's prompt strictly
+        extends it through t_g). The session's previous chain is
+        dropped from pcache AND tier: one chain per session."""
+        toks = self._collected[r]
+        key_prompt = req.ptuple() + tuple(toks[:-1])
+        n_entry = -(-len(key_prompt) // self.page_size)
+        chain = self._chains[r]
+        if len(chain) < n_entry:  # defensive: never true by allocation
+            return
+        self._pcache_insert_paged(key_prompt, chain[:n_entry], None,
+                                  req.adapter, frozen=True)
+        key = (req.adapter, key_prompt)
+        if key not in self._pcache:
+            return  # capacity-evicted immediately; nothing to track
+        prev = self._sessions.get(req.session)
+        if prev is not None and prev != key:
+            ent = self._pcache.pop(prev, None)
+            if ent is not None:
+                self._unpin_pages(ent[0])
+                self._alloc.decref(ent[0])
+                with self._lock:
+                    self._stats["pcache_bytes"] -= ent[-1]
+            if self._tier is not None:
+                self._tier.discard(prev)
+        self._sessions[req.session] = key
+
+    def _do_release_session(self, session: str) -> bool:
+        """Loop-thread body of release_session: demote the session's
+        pcache entry to the host tier (gather + unpin + free pages).
+        True when a chain existed (now on host — or already there)."""
+        key = self._sessions.get(session)
+        if key is None:
+            return False
+        entry = self._pcache.pop(key, None)
+        if entry is None:
+            # Already demoted (watermark pressure / LRU eviction beat
+            # the explicit release to it).
+            return self._tier is not None and self._tier.contains(key)
+        if self._tier is not None:
+            self._tier_swap_out(key, entry)
+        self._unpin_pages(entry[0])
+        self._alloc.decref(entry[0])
+        with self._lock:
+            self._stats["pcache_bytes"] -= entry[-1]
+        return True
+
+    def release_session(self, session: str,
+                        timeout_s: float = 30.0) -> bool:
+        """Explicitly park a session between turns: its cached chain
+        leaves the device pool for the host tier (or is dropped when no
+        tier is attached) and the freed pages go back to admission.
+        Safe from any thread — the operation marshals to the loop
+        thread via the request queue. Returns whether the session had a
+        chain to release."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not self.paged:
+            return False
+        cmd = _TierCommand("release", session)
+        self._q.put(cmd)
+        if not cmd.event.wait(timeout_s):
+            raise TimeoutError("session release did not finish in time")
+        if cmd.error is not None:
+            raise cmd.error
+        return bool(cmd.result)
+
+    def _exec_tier_command(self, cmd: "_TierCommand") -> None:
+        try:
+            if cmd.kind == "release":
+                cmd.result = self._do_release_session(cmd.session)
+            else:  # unknown kinds fail loudly, never hang the caller
+                raise ValueError(f"unknown tier command {cmd.kind!r}")
+        except Exception as e:  # noqa: BLE001 — fail the one command
+            cmd.error = e
+        cmd.signal()
 
     def _aid_arg(self, n: int, adapter: int):
         """(n,)-row adapter-id array for a single request's device call —
@@ -1034,20 +1388,29 @@ class GenerateEngine:
                top_p: "float | None" = None,
                eos_id: "int | None" = None, adapter_id: int = 0,
                timeout_s: float = 600.0, admitted: bool = False,
-               trace_id: "str | None" = None) -> "list[list[int]]":
+               trace_id: "str | None" = None,
+               session: "str | None" = None) -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists.
         ``admitted``: the caller already holds an admission token
         covering this submit (see take_admission_token).
-        ``trace_id``: validated W3C trace id for the lifecycle trace."""
+        ``trace_id``: validated W3C trace id for the lifecycle trace.
+        ``session``: single-prompt only — names the request's finished
+        KV chain so the session's next turn (a prompt extending this
+        one's prompt + reply) restores it instead of re-prefilling,
+        and so ``release_session`` can park it on the host tier."""
         if self._closed:
             raise RuntimeError("engine is closed")
         n = len(prompts)
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        if session is not None and n != 1:
+            raise ValueError("session requires exactly one prompt "
+                             "(a session names ONE chain)")
         req = self._packed_request(prompts, max_new_tokens, temperature,
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
+        req.session = session
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
@@ -1077,7 +1440,8 @@ class GenerateEngine:
                       top_p: "float | None" = None,
                       eos_id: "int | None" = None, adapter_id: int = 0,
                       timeout_s: float = 600.0, admitted: bool = False,
-                      trace_id: "str | None" = None):
+                      trace_id: "str | None" = None,
+                      session: "str | None" = None):
         """Streaming submit(): returns an iterator of events.
 
         Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
@@ -1095,10 +1459,14 @@ class GenerateEngine:
         n = len(prompts)
         if n == 0 or n > self.slots:
             raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        if session is not None and n != 1:
+            raise ValueError("session requires exactly one prompt "
+                             "(a session names ONE chain)")
         req = self._packed_request(prompts, max_new_tokens, temperature,
                                    top_k, eos_id, top_p=top_p,
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
+        req.session = session
         req.stream_q = queue.SimpleQueue()
         return self._stream_events(req, timeout_s, admitted)
 
@@ -1189,7 +1557,13 @@ class GenerateEngine:
             total, free = self._alloc.total, self._alloc.free
             s["pages_total"] = total
             s["pages_free"] = free
+            s["pages_resident"] = total - free
             s["pages_pinned"] = len(self._pinned)
+            if self._tier is not None:
+                ts = self._tier.stats()
+                s["host_tier_pages"] = ts.pop("tier_pages")
+                s.update(ts)
+                s["sessions_tracked"] = len(self._sessions)
             s["page_utilization"] = round((total - free) / total, 4)
             # Pinned pages with >1 reference ARE the zero-copy sharing:
             # mapped read-only into a live row's table, or claimed by
@@ -1227,14 +1601,20 @@ class GenerateEngine:
                 and self._owner[i] is None]
 
     def _drain_queue(self, block: bool) -> bool:
-        """Move queued requests into pending. Returns False on shutdown."""
+        """Move queued requests into pending. Returns False on shutdown.
+        Tier commands (session release) execute INLINE here — they are
+        loop-thread state operations, not admissions, so they never
+        enter the pending list or compete with requests for slots."""
         try:
             timeout = 0.2 if block else 0.0
             while True:
                 req = self._q.get(block=block, timeout=timeout)
                 if req is None:
                     return False
-                self._pending.append(req)
+                if isinstance(req, _TierCommand):
+                    self._exec_tier_command(req)
+                else:
+                    self._pending.append(req)
                 block = False  # only the first get may wait
         except queue.Empty:
             return True
@@ -1275,6 +1655,31 @@ class GenerateEngine:
                 prompt = req.ptuple()
                 if req.probe is None:
                     pkey, pentry = self._pcache_lookup(prompt, req.adapter)
+                    if self._tier is not None:
+                        # Tier probe BEFORE declaring a pcache miss: a
+                        # host-resident chain longer than the best
+                        # device-resident prefix swaps in and the
+                        # lookup re-runs — the restored entry then
+                        # serves this admission exactly like one that
+                        # never left HBM. A failed swap-in already
+                        # counted its fallback; the request just
+                        # proceeds with whatever the pcache had.
+                        tkey = self._tier.match(req.adapter, prompt)
+                        with self._lock:
+                            self._stats["tier_hits" if tkey is not None
+                                        else "tier_misses"] += 1
+                        if self._obs is not None:
+                            self._obs.on_tier_probe(tkey is not None)
+                        if (tkey is not None
+                                and (pkey is None
+                                     or len(tkey[1]) > len(pkey))
+                                and self._tier_swap_in(tkey)):
+                            if req.trace is not None:
+                                req.trace.event(
+                                    "tier_swap_in",
+                                    {"cached_len": len(tkey[1])})
+                            pkey, pentry = self._pcache_lookup(
+                                prompt, req.adapter)
                     if pkey is not None and len(pkey) < len(prompt):
                         g = _pow2_at_least(len(prompt) - len(pkey))
                         if (len(pkey) + g > self.max_seq
@@ -1704,6 +2109,15 @@ class GenerateEngine:
         if self.speculate:
             self._spec_hist[r] = []  # corpus dies with the row
         if self.paged:
+            # Session-end insert BEFORE the release below: the chain's
+            # pages must be pinned while the row still holds its refs,
+            # or the free list could hand them out in between.
+            req = self._owner[r]
+            if (req is not None and req.session is not None
+                    and req.samples == 1 and req.block.shape[0] == 1
+                    and self.prompt_cache > 0
+                    and len(self._collected[r]) >= 2):
+                self._session_insert(req, r)
             # Free the row's pages NOW, not at request completion: the
             # zeroed table row sinks the slot's continued decode writes,
             # and shared prompt pages just drop a refcount — so a long
@@ -1807,7 +2221,15 @@ class GenerateEngine:
         if self.speculate:
             self._spec_hist = [[] for _ in range(self.slots)]
             self._spec_depth[:] = self.spec_gamma
+        # The pcache drops WHOLESALE, no tier swap-out: the failed
+        # dispatch left device state untrusted, and gathering unknown
+        # bytes to host would let corruption outlive the reset. Chains
+        # already on the host tier are fine (they reference no device
+        # pages) — sessions keep only the keys the tier still holds.
         self._pcache.clear()
+        self._sessions = (
+            {sid: k for sid, k in self._sessions.items()
+             if self._tier is not None and self._tier.contains(k)})
         with self._lock:
             self._stats["pcache_bytes"] = 0
             self._stats["loop_crashes"] += 1
@@ -2018,7 +2440,8 @@ class GenerateEngine:
             self._stats["spec_emitted"] += consumed
         if self._obs is not None:
             self._obs.on_dispatch(n_active, len(self._pending),
-                                  self._alloc.free)
+                                  self._alloc.free,
+                                  self._alloc.total - self._alloc.free)
             self._obs.on_spec_dispatch(proposed, accepted, consumed,
                                        draft_s, verify_s)
             if self._obs.enabled:
@@ -2056,6 +2479,9 @@ class GenerateEngine:
                 break  # shutdown sentinel
             self._expire_deadlines()
             self._admit()
+            if (self.paged and self._tier is not None
+                    and self.tier_watermark > 0):
+                self._tier_pressure()
             if not self._active.any():
                 continue
             t0 = time.perf_counter()
@@ -2149,7 +2575,9 @@ class GenerateEngine:
             if self._obs is not None:
                 self._obs.on_dispatch(
                     n_active, len(self._pending),
-                    self._alloc.free if self.paged else None)
+                    self._alloc.free if self.paged else None,
+                    (self._alloc.total - self._alloc.free)
+                    if self.paged else None)
                 if self._obs.enabled:
                     # One "decode" event per request per dispatch (not
                     # per token): slots is small, so this scan is noise

@@ -290,6 +290,129 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     return out
 
 
+def _session_turn(url: str, prompt: "list[int]", sid: str,
+                  gen_tokens: int) -> "tuple[float, float, list[int]]":
+    """One session turn over the SSE route: returns (ttft_s, latency_s,
+    reply_tokens). Streaming is load-bearing here — TTFT is the number
+    tiering moves (prefill skipped vs suffix-only vs full re-prefill),
+    so the turn must observe first-token time, not just total."""
+    import urllib.request
+
+    body = {"prompt_tokens": [prompt], "max_new_tokens": gen_tokens,
+            "stream": True, "session": sid}
+    req = urllib.request.Request(
+        url + "/v1/generate", data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json",
+                 "traceparent": format_traceparent(new_trace_id(),
+                                                   new_span_id())})
+    t0 = time.perf_counter()
+    with urllib.request.urlopen(req, timeout=300) as r:
+        ttft = None
+        last = None
+        for line in r:
+            if not line.startswith(b"data: "):
+                continue
+            if ttft is None:
+                ttft = time.perf_counter() - t0
+            last = json.loads(line[6:])
+    if last is None or "error" in last or not last.get("done"):
+        raise RuntimeError(f"stream ended badly: {last}")
+    return ttft, time.perf_counter() - t0, last["tokens"][0]
+
+
+def _release_session(url: str, sid: str) -> bool:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url + "/v1/session/release",
+        data=json.dumps({"session": sid}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return bool(json.loads(r.read()).get("released"))
+
+
+def _session_loop(url: str, idx: int, turns: int, rows: int,
+                  gen_tokens: int, release: bool, lock, turn1: list,
+                  warm: list, errors: list) -> None:
+    """One multi-turn chat session: each turn's prompt is the previous
+    turn's prompt + reply + two fresh 'user' tokens, so turn g strictly
+    extends the chain turn g-1 parked. Per-session prompt seeds differ —
+    sessions must NOT share prefixes, or pcache sharing would hand every
+    session after the first a warm turn 1."""
+    rng = np.random.default_rng(1000 + idx)
+    prompt = rng.integers(1, 1000, size=(max(4, rows),)).tolist()
+    sid = f"loadgen-{idx}"
+    for turn in range(turns):
+        try:
+            ttft, _lat, reply = _session_turn(url, prompt, sid, gen_tokens)
+        except Exception as e:  # noqa: BLE001 — record, session ends
+            with lock:
+                errors.append(f"session {idx} turn {turn}: {e}")
+            return
+        with lock:
+            (turn1 if turn == 0 else warm).append(ttft)
+        prompt = prompt + reply + rng.integers(1, 1000, size=(2,)).tolist()
+        if release and turn < turns - 1:
+            try:
+                _release_session(url, sid)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"session {idx} release {turn}: {e}")
+                return
+
+
+def run_sessions(url: str, *, sessions: int, turns: int, rows: int,
+                 gen_tokens: int, release: bool = True) -> dict:
+    """Multi-turn session load: N concurrent sessions x K turns each,
+    session ids carried across turns (the first client of the session-id
+    API). ``release`` parks each chain between turns via
+    /v1/session/release — against a --tier-host-mb server the next turn
+    swaps it back in (warm TTFT ~ suffix prefill + restore), against a
+    tierless one the chain is dropped (warm TTFT ~ full re-prefill):
+    the warm/turn-1 TTFT pair IS the tiering measurement."""
+    turn1: "list[float]" = []
+    warm: "list[float]" = []
+    errors: "list[str]" = []
+    lock = threading.Lock()
+    threads = [threading.Thread(
+        target=_session_loop,
+        args=(url, i, turns, rows, gen_tokens, release, lock, turn1,
+              warm, errors),
+        daemon=True) for i in range(sessions)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+    if not turn1:
+        raise RuntimeError(f"no session finished turn 1; "
+                           f"errors: {errors[:3]}")
+
+    def p50(xs: "list[float]") -> float:
+        s = sorted(xs)
+        return s[len(s) // 2]
+
+    out = {
+        "sessions": sessions,
+        "turns": turns,
+        "rows_per_request": rows,
+        "gen_tokens_per_request": gen_tokens,
+        "release_between_turns": release,
+        "wall_s": round(wall, 2),
+        "requests": len(turn1) + len(warm),
+        "errors": len(errors),
+        "retries_503": 0,
+        "gave_up_503": 0,
+        "turn1_ttft_p50_ms": round(1e3 * p50(turn1), 2),
+    }
+    if warm:
+        out["warm_ttft_p50_ms"] = round(1e3 * p50(warm), 2)
+        out["warm_vs_turn1_ttft"] = round(p50(warm) / max(p50(turn1),
+                                                          1e-9), 3)
+    return out
+
+
 def server_histogram_quantiles(metrics_text: str) -> dict:
     """Server-side latency quantiles estimated from a /metrics scrape's
     histograms (k3stpu/obs) — the numbers a Prometheus
@@ -431,6 +554,36 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--spec-gamma", type=int, default=4,
                     help="max draft tokens per slot per speculative "
                          "dispatch (with --speculate)")
+    ap.add_argument("--sessions", type=int, default=0,
+                    help="multi-turn session mode: run this many "
+                         "concurrent sessions instead of the open-loop "
+                         "client load. Each session runs --turns "
+                         "/v1/generate turns under one session id, each "
+                         "turn's prompt extending the last turn's "
+                         "prompt+reply; reports warm-turn TTFT vs "
+                         "turn-1 TTFT (requires --generate-tokens; "
+                         "self-hosted servers need --continuous-"
+                         "batching --kv-page-size)")
+    ap.add_argument("--turns", type=int, default=4,
+                    help="turns per session with --sessions")
+    ap.add_argument("--no-session-release", action="store_true",
+                    help="with --sessions: keep chains pinned in the "
+                         "prompt cache between turns instead of "
+                         "releasing them (the all-HBM upper bound; "
+                         "default releases, so warm turns measure the "
+                         "tier restore — or the full re-prefill on a "
+                         "tierless server)")
+    ap.add_argument("--tier-host-mb", type=int, default=None,
+                    help="self-hosted server parks released session "
+                         "chains in a host-RAM tier of this many MiB "
+                         "(see server --tier-host-mb)")
+    ap.add_argument("--tier-dir", default=None,
+                    help="self-hosted server's disk spill directory "
+                         "for the tier (see server --tier-dir)")
+    ap.add_argument("--tier-watermark", type=int, default=0,
+                    help="self-hosted server's free-page low watermark "
+                         "for tier demotion (see server "
+                         "--tier-watermark)")
     ap.add_argument("--report-spec", action="store_true",
                     help="after the run, scrape the speculation counters "
                          "from /metrics and print accepted-tokens/"
@@ -449,6 +602,15 @@ def main(argv: "list[str] | None" = None) -> int:
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
                  "generation-only)")
+    if args.sessions:
+        if args.generate_tokens <= 0:
+            ap.error("--sessions requires --generate-tokens (sessions "
+                     "are a generate workload)")
+        if args.url is None and not (args.continuous_batching
+                                     and args.kv_page_size):
+            ap.error("--sessions self-hosting needs --continuous-"
+                     "batching and --kv-page-size (session ids name "
+                     "paged chains)")
 
     url = args.url
     card_url = None
@@ -472,10 +634,29 @@ def main(argv: "list[str] | None" = None) -> int:
             kv_page_size=args.kv_page_size, kv_pages=args.kv_pages,
             speculate=args.speculate, spec_gamma=args.spec_gamma,
             quant=args.quant, kv_cache_dtype=args.kv_cache_dtype,
+            tier_host_mb=args.tier_host_mb, tier_dir=args.tier_dir,
+            tier_watermark=args.tier_watermark,
             shard_devices=None)  # None = all local devices; the engine
         # runs tensor-parallel now (mesh-sharded KV cache), so the old
         # single-device pin would just hide the pod's other chips.
-        if args.generate_tokens > 0:
+        if args.sessions:
+            # Session warmup: ONE throwaway session walks all K turn
+            # widths, so every pow2 prefill bucket the measured sessions
+            # will hit — and, with a tier, the swap-out/swap-in programs
+            # — compiles before the measured turns.
+            print("warming up (session path)...", flush=True)
+            rng = np.random.default_rng(0)
+            p = _gen_prompt(args.rows)
+            for turn in range(args.turns):
+                reply = server.generate_tokens(
+                    [p], max_new_tokens=args.generate_tokens,
+                    session="__warmup__")[0]
+                p = p + reply + rng.integers(1, 1000, size=(2,)).tolist()
+                if not args.no_session_release and turn < args.turns - 1:
+                    server.release_session("__warmup__")
+            server.release_session("__warmup__")
+            server.reset_stats()
+        elif args.generate_tokens > 0:
             # Compile prefill+decode (and engine programs) BEFORE the
             # measured window — first-request JIT would otherwise land in
             # the committed before/after numbers. Width-matched: the
@@ -515,12 +696,18 @@ def main(argv: "list[str] | None" = None) -> int:
         card = json.loads(r.read())
 
     traces = ClientTraces()
-    result = run_load(
-        url, clients=args.clients, seconds=args.seconds, rows=args.rows,
-        input_shape=tuple(card["input_shape"]),
-        input_dtype=card["input_dtype"],
-        generate_tokens=args.generate_tokens, stream=args.stream,
-        traces=traces)
+    if args.sessions:
+        result = run_sessions(
+            url, sessions=args.sessions, turns=args.turns,
+            rows=args.rows, gen_tokens=args.generate_tokens,
+            release=not args.no_session_release)
+    else:
+        result = run_load(
+            url, clients=args.clients, seconds=args.seconds,
+            rows=args.rows, input_shape=tuple(card["input_shape"]),
+            input_dtype=card["input_dtype"],
+            generate_tokens=args.generate_tokens, stream=args.stream,
+            traces=traces)
 
     # Server-side histogram quantiles from the same run (best-effort:
     # an older server without the obs layer just yields none).
@@ -570,6 +757,10 @@ def main(argv: "list[str] | None" = None) -> int:
               f"{result['spec_dispatches']} verify dispatches "
               f"(accept ratio {result['spec_accept_ratio']})",
               flush=True)
+    if result.get("warm_ttft_p50_ms") is not None:
+        print(f"sessions: turn-1 TTFT p50 {result['turn1_ttft_p50_ms']} "
+              f"ms, warm-turn TTFT p50 {result['warm_ttft_p50_ms']} ms "
+              f"(warm/turn1 {result['warm_vs_turn1_ttft']})", flush=True)
     if result["retries_503"] or result["gave_up_503"]:
         print(f"503 backoff: {result['retries_503']} retried, "
               f"{result['gave_up_503']} gave up "

@@ -266,6 +266,9 @@ class InferenceServer:
                  draft_ckpt_dir: "str | None" = None,
                  speculate: bool = False,
                  spec_gamma: int = 4,
+                 tier_host_mb: "int | None" = None,
+                 tier_dir: "str | None" = None,
+                 tier_watermark: int = 0,
                  watchdog_s: "float | None" = 120.0,
                  breaker_threshold: "int | None" = 5,
                  breaker_cooldown_s: float = 5.0,
@@ -596,6 +599,22 @@ class InferenceServer:
                 "--speculate requires --kv-page-size: speculative "
                 "rollback rides the paged cache's host-mirrored "
                 "per-row index")
+        # Host KV page tier (serve/tiering.py, docs/TIERING.md): parked
+        # session chains leave the device pool for host RAM and restore
+        # bit-exactly on the session's next turn.
+        self._tier = None
+        if tier_host_mb is not None and kv_page_size is None:
+            raise ValueError(
+                "--tier-host-mb requires --kv-page-size: the host tier "
+                "parks paged chains; a dense cache has none to park")
+        if tier_host_mb is not None and prompt_cache <= 0:
+            raise ValueError(
+                "--tier-host-mb requires --prompt-cache > 0: restored "
+                "chains re-enter the engine as prompt-cache entries")
+        if tier_dir is not None and tier_host_mb is None:
+            raise ValueError("--tier-dir requires --tier-host-mb")
+        if tier_watermark and tier_host_mb is None:
+            raise ValueError("--tier-watermark requires --tier-host-mb")
         if continuous_batching:
             if not model_name.startswith(("transformer", "moe")):
                 raise ValueError(
@@ -608,6 +627,11 @@ class InferenceServer:
                 self._breaker = CircuitBreaker(
                     threshold=breaker_threshold,
                     cooldown_s=breaker_cooldown_s)
+            if tier_host_mb is not None:
+                from k3stpu.serve.tiering import HostPageStore
+
+                self._tier = HostPageStore(tier_host_mb * (1 << 20),
+                                           spill_dir=tier_dir)
             self._engine = GenerateEngine(
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
@@ -616,7 +640,8 @@ class InferenceServer:
                 num_pages=kv_pages, speculate=speculate,
                 spec_gamma=spec_gamma, obs=self._obs,
                 breaker=self._breaker, watchdog_s=watchdog_s,
-                chaos=chaos)
+                chaos=chaos, tier=self._tier,
+                tier_watermark=tier_watermark)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -879,7 +904,8 @@ class InferenceServer:
                         eos_id: "int | None" = None,
                         num_samples: int = 1,
                         adapter: "str | None" = None,
-                        trace_id: "str | None" = None) -> "list[list[int]]":
+                        trace_id: "str | None" = None,
+                        session: "str | None" = None) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
@@ -899,6 +925,7 @@ class InferenceServer:
         max_new_tokens, num_samples = self._validate_gen(
             prompts, max_new_tokens, num_samples)
         aid = self._adapter_id(adapter)
+        self._validate_session(session, prompts, num_samples)
         if num_samples > 1:
             if len(prompts) != 1:
                 raise ValueError(
@@ -1005,7 +1032,8 @@ class InferenceServer:
                         prompts[ofs:ofs + self._engine.slots],
                         max_new_tokens=gen_budget, temperature=temperature,
                         top_k=top_k, top_p=top_p, eos_id=eos_id,
-                        adapter_id=aid, admitted=True, trace_id=trace_id))
+                        adapter_id=aid, admitted=True, trace_id=trace_id,
+                        session=session))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1056,6 +1084,24 @@ class InferenceServer:
         self._obs.e2e.observe(dt, trace_id=trace_id)
         return out.tolist()
 
+    def _validate_session(self, session, prompts, num_samples) -> None:
+        """ONE gate for the session-id API, shared by generate_tokens
+        and generate_stream: sessions name exactly one paged KV chain,
+        so they need the paged engine and a single unsampled prompt."""
+        if session is None:
+            return
+        if not isinstance(session, str) or not session:
+            raise ValueError("session must be a non-empty string")
+        if self._engine is None or not self._engine.paged:
+            raise ValueError(
+                "session ids require --continuous-batching with "
+                "--kv-page-size (the chain a session names lives in "
+                "the page pool)")
+        if len(prompts) != 1 or num_samples != 1:
+            raise ValueError("session takes exactly one prompt and "
+                             "num_samples == 1 (a session names ONE "
+                             "chain)")
+
     def _spec_eligible(self, width: int, gen_budget: int,
                        temperature: float) -> bool:
         """ONE routing gate for speculative decode, shared by
@@ -1073,7 +1119,8 @@ class InferenceServer:
                         eos_id: "int | None" = None,
                         num_samples: int = 1,
                         adapter: "str | None" = None,
-                        trace_id: "str | None" = None):
+                        trace_id: "str | None" = None,
+                        session: "str | None" = None):
         """Streaming generate: an iterator of JSON-able events for the
         SSE route. Engine-backed requests yield per-decode-block deltas
         ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
@@ -1089,6 +1136,7 @@ class InferenceServer:
         max_new_tokens, num_samples = self._validate_gen(
             prompts, max_new_tokens, num_samples)
         aid = self._adapter_id(adapter)
+        self._validate_session(session, prompts, num_samples)
         lens = [len(p) for p in prompts]
         (width, gen_budget, temperature, top_k, top_p,
          eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
@@ -1115,11 +1163,11 @@ class InferenceServer:
         self._engine.reject_if_at_capacity()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
-            top_p, eos_id, aid, trace_id)
+            top_p, eos_id, aid, trace_id, session)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid=0,
-                              trace_id=None):
+                              trace_id=None, session=None):
         """Engine-backed streaming (args pre-sanitized). The admission
         token is taken HERE, on the generator's first next(), so a
         generator that is created but never iterated cannot leak the
@@ -1135,7 +1183,7 @@ class InferenceServer:
         try:
             yield from self._stream_engine_chunks(
                 prompts, max_new_tokens, gen_budget, temperature, top_k,
-                top_p, eos_id, aid, out, trace_id)
+                top_p, eos_id, aid, out, trace_id, session)
         finally:
             self._engine.release_admission_token()
         dt = time.perf_counter() - t0
@@ -1148,7 +1196,7 @@ class InferenceServer:
 
     def _stream_engine_chunks(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid,
-                              out, trace_id=None):
+                              out, trace_id=None, session=None):
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
@@ -1156,7 +1204,7 @@ class InferenceServer:
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, adapter_id=aid, admitted=True,
-                trace_id=trace_id)
+                trace_id=trace_id, session=session)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -1178,6 +1226,20 @@ class InferenceServer:
                 # request instead of decoding on for nobody. No-op when
                 # the stream ran to completion.
                 events.close()
+
+    def release_session(self, session: str) -> bool:
+        """Park a session's cached KV chain between turns: the chain
+        leaves the device pool for the host tier (--tier-host-mb) or is
+        dropped (no tier), and its HBM pages return to admission. The
+        POST /v1/session/release body. Returns whether the session
+        named a live chain."""
+        if not isinstance(session, str) or not session:
+            raise ValueError("session must be a non-empty string")
+        if self._engine is None or not self._engine.paged:
+            raise ValueError(
+                "session release requires --continuous-batching with "
+                "--kv-page-size")
+        return self._engine.release_session(session)
 
     def busy_seconds(self) -> float:
         """Cumulative device-busy time — the duty-cycle numerator the
@@ -1294,6 +1356,29 @@ class InferenceServer:
                 emit(lines, "k3stpu_paged_density_ratio", "gauge",
                      "Dense token-slots per actual pooled token-slot.",
                      e["paged_density_ratio"])
+            if self._tier is not None and self._engine.paged:
+                # Tier swap latencies + hit/miss/fallback counters and
+                # the pages_resident/host_tier_pages gauges render from
+                # the shared obs layer; these are the capacity-ledger
+                # extras only the engine's stats dict carries.
+                emit(lines, "k3stpu_tier_entries", "gauge",
+                     "Chains (pcache keys) held by the host tier.",
+                     e["tier_entries"])
+                emit(lines, "k3stpu_tier_host_bytes", "gauge",
+                     "Host RAM held by resident tier chains.",
+                     e["tier_bytes"])
+                emit(lines, "k3stpu_tier_spilled_bytes", "gauge",
+                     "Bytes of tier chains spilled to the disk tier.",
+                     e["tier_spilled_bytes"])
+                emit(lines, "k3stpu_tier_sessions", "gauge",
+                     "Session ids with a tracked chain (device or "
+                     "host).", e["sessions_tracked"])
+                emit(lines, "k3stpu_tier_swap_ins_total", "counter",
+                     "Chains restored from the host tier into fresh "
+                     "device pages.", e["tier_swap_ins"])
+                emit(lines, "k3stpu_tier_swap_outs_total", "counter",
+                     "Chains gathered off-device into the host tier.",
+                     e["tier_swap_outs"])
             # Containment counters (docs/RESILIENCE.md).
             emit(lines, "k3stpu_engine_deadline_expired_total", "counter",
                  "Requests reaped by the deadline machinery (client "
@@ -1642,7 +1727,8 @@ def make_app(server: InferenceServer):
                         top_p=req.get("top_p"),
                         eos_id=req.get("eos_id"),
                         num_samples=req.get("num_samples", 1),
-                        adapter=req.get("adapter"))
+                        adapter=req.get("adapter"),
+                        session=req.get("session"))
                     if req.get("stream"):
                         events = server.generate_stream(
                             req["prompt_tokens"],
@@ -1673,6 +1759,23 @@ def make_app(server: InferenceServer):
                     # Crash-only containment turned a backend failure into
                     # a per-request error; surface it as a JSON 500, not
                     # an http.server traceback + connection reset.
+                    self._send(500, {"error": str(e)})
+                return
+            if self.path == "/v1/session/release":
+                # Explicit between-turn demotion: the client says "this
+                # session is idle, take its HBM back" instead of waiting
+                # for watermark pressure to decide.
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length))
+                    released = server.release_session(req["session"])
+                    self._send(200, {"released": released})
+                except (KeyError, ValueError, TypeError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                except TimeoutError as e:
+                    self._send(503, {"error": str(e)})
+                except Exception as e:  # noqa: BLE001 — backend failure
                     self._send(500, {"error": str(e)})
                 return
             if self.path != "/v1/predict":
@@ -1850,6 +1953,25 @@ def main(argv=None) -> int:
                          "identical to the plain engine. Requires "
                          "--continuous-batching and --kv-page-size")
     ap.add_argument("--spec-gamma", type=int, default=4)
+    ap.add_argument("--tier-host-mb", type=int, default=None,
+                    help="with --kv-page-size and --prompt-cache: host-"
+                         "RAM budget (MiB) for the KV page tier "
+                         "(serve/tiering.py) — released/evicted session "
+                         "chains park in host memory and restore bit-"
+                         "exactly on the session's next turn, turning "
+                         "idle-session capacity from an HBM number "
+                         "into a host-RAM number")
+    ap.add_argument("--tier-dir", default=None,
+                    help="with --tier-host-mb: spill directory for the "
+                         "disk tier — chains evicted past the host-RAM "
+                         "budget go to checksummed files here instead "
+                         "of being dropped")
+    ap.add_argument("--tier-watermark", type=int, default=0,
+                    help="with --tier-host-mb: when free pages drop "
+                         "below this, the engine demotes cold prompt-"
+                         "cache chains to the host tier until the pool "
+                         "recovers (0 = demote only on explicit "
+                         "session release / LRU eviction)")
     ap.add_argument("--watchdog-s", type=float, default=120.0,
                     help="with --continuous-batching: fail blocked "
                          "clients with retryable 503s when the engine "
@@ -1913,6 +2035,9 @@ def main(argv=None) -> int:
                              draft_ckpt_dir=args.draft_ckpt_dir,
                              speculate=args.speculate,
                              spec_gamma=args.spec_gamma,
+                             tier_host_mb=args.tier_host_mb,
+                             tier_dir=args.tier_dir,
+                             tier_watermark=args.tier_watermark,
                              watchdog_s=args.watchdog_s or None,
                              breaker_threshold=(args.breaker_threshold
                                                 or None),

@@ -1,0 +1,248 @@
+"""Host-memory page tier behind the paged-KV allocator.
+
+The paged engine (PR 1) keeps every cached page chain resident in the
+device pool: an idle chat session either pins HBM through the prompt
+cache's ``_pinned`` refcounts or loses its KV entirely and pays a full
+re-prefill on the next turn. ``HostPageStore`` is the middle ground —
+a byte-capped, last-use-ordered store of *gathered* page chains
+(``jax.device_get`` of each ``*_pages`` leaf at the chain's indices,
+so one contiguous host ndarray per leaf) that the engine consults
+before declaring a prompt-cache miss. Swap-in is one batched
+``device_put`` + scatter into freshly allocated pages
+(``GenerateEngine._restore_pages``); everything else about the entry —
+key scheme, prefix-match rule, pin/refcount discipline — is the prompt
+cache's, so bit-exactness of a restored chain reduces to the already
+pinned pcache-hit invariants (docs/TIERING.md has the full argument).
+
+Design points:
+
+- **Keys** are the prompt cache's ``(adapter, prompt_tuple)`` — the
+  tier is a backing store *behind* the pcache, not a second cache with
+  its own identity. ``match()`` implements the same longest-prefix rule
+  as ``GenerateEngine._pcache_lookup`` so a tier probe and a pcache
+  probe can be compared directly.
+- **Eviction** is last-use order (insertion-ordered dict, refreshed on
+  hit), capped by ``capacity_bytes``. With ``spill_dir`` set, evictees
+  spill to disk instead of vanishing — the third tier. Spilled files
+  are written tmp-then-``os.replace`` (atomic on POSIX) and carry a
+  crc32 of the payload; a torn or bit-rotted spill fails the checksum
+  at load and surfaces as ``TierCorrupt``, which the engine's swap-in
+  path degrades to a cold prefill (chaos point ``tier_swap`` drills
+  exactly this).
+- **No device handles.** Values are plain numpy arrays + ints; the
+  store survives ``_crash_reset`` rebuilding the device pool, which is
+  what makes it a *recovery* tier and not just a cache annex.
+
+Thread-safety: all mutation happens on the engine loop thread (HTTP
+threads marshal session-release through the engine queue), so the
+store itself takes no locks; ``stats()`` reads two ints and is safe to
+call from anywhere.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import zlib
+from typing import Any
+
+Key = tuple[Any, tuple]  # (adapter, prompt_tuple) — the pcache key scheme
+
+
+class TierCorrupt(RuntimeError):
+    """A spilled entry failed its checksum (torn write, bit rot)."""
+
+
+class _Entry:
+    """One gathered page chain, resident in host RAM or spilled.
+
+    ``pages`` maps "/"-joined cache-leaf path names (e.g.
+    ``"0/attn/key_pages"``) to numpy arrays of shape ``(n_pages, ...)``
+    — the leaf gathered at the chain's page indices, in chain order.
+    ``last`` is the pcache entry's last-position logits (host-side), or
+    None for session tails whose next-token distribution is recomputed
+    on restore. When spilled, ``pages``/``last`` are None and ``path``
+    points at the checksummed pickle on disk.
+    """
+
+    __slots__ = ("length", "n_pages", "nbytes", "pages", "last",
+                 "session", "path")
+
+    def __init__(self, length: int, n_pages: int, nbytes: int,
+                 pages: dict[str, Any] | None, last: Any,
+                 session: str | None):
+        self.length = length
+        self.n_pages = n_pages
+        self.nbytes = nbytes
+        self.pages = pages
+        self.last = last
+        self.session = session
+        self.path = None  # set when spilled
+
+
+class HostPageStore:
+    """Byte-capped host store of gathered KV page chains.
+
+    capacity_bytes: resident host-RAM budget. Entries past it are
+        evicted last-use-first — to ``spill_dir`` when set, to nowhere
+        otherwise (the entry is simply dropped, pre-tier behavior).
+    spill_dir: optional directory for the disk tier. Created on first
+        spill; files are atomic-renamed and checksummed.
+    """
+
+    def __init__(self, capacity_bytes: int, spill_dir: str | None = None):
+        if capacity_bytes <= 0:
+            raise ValueError("tier capacity_bytes must be positive")
+        self.capacity = int(capacity_bytes)
+        self.spill_dir = spill_dir
+        self._entries: dict[Key, _Entry] = {}  # insertion order = LRU
+        self._bytes = 0        # resident (non-spilled) host bytes
+        self._spill_seq = 0
+        self._spilled_bytes = 0
+
+    # -- write path ----------------------------------------------------
+
+    def put(self, key: Key, length: int, pages: dict[str, Any],
+            last: Any = None, session: str | None = None) -> None:
+        """Insert (or replace) a gathered chain; evict past capacity."""
+        n_pages = 0
+        nbytes = 0
+        for arr in pages.values():
+            n_pages = max(n_pages, int(arr.shape[0]))
+            nbytes += int(arr.nbytes)
+        if last is not None:
+            nbytes += sum(int(x.nbytes) for x in last
+                          if hasattr(x, "nbytes"))
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._forget(old)
+        ent = _Entry(length, n_pages, nbytes, pages, last, session)
+        self._entries[key] = ent
+        self._bytes += nbytes
+        while self._bytes > self.capacity and len(self._entries) > 1:
+            self._evict_oldest_resident()
+
+    def _evict_oldest_resident(self) -> None:
+        for key, ent in self._entries.items():
+            if ent.pages is not None:
+                break
+        else:
+            return
+        if self.spill_dir is not None:
+            self._spill(key, ent)
+        else:
+            del self._entries[key]
+            self._bytes -= ent.nbytes
+
+    def _spill(self, key: Key, ent: _Entry) -> None:
+        """Move one resident entry to disk (atomic, checksummed)."""
+        os.makedirs(self.spill_dir, exist_ok=True)
+        self._spill_seq += 1
+        path = os.path.join(self.spill_dir, f"tier-{self._spill_seq}.kv")
+        payload = pickle.dumps((key, ent.length, ent.pages, ent.last),
+                               protocol=pickle.HIGHEST_PROTOCOL)
+        crc = zlib.crc32(payload)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(crc.to_bytes(4, "big"))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        self._bytes -= ent.nbytes
+        self._spilled_bytes += ent.nbytes
+        ent.pages = None
+        ent.last = None
+        ent.path = path
+
+    # -- read path -----------------------------------------------------
+
+    def match(self, adapter: Any, prompt: tuple) -> Key | None:
+        """Longest stored key that is a prefix of ``prompt`` (same rule
+        as ``_pcache_lookup``). Does not refresh LRU order — only a
+        successful ``load`` counts as use."""
+        best = None
+        for key in self._entries:
+            aid, ptuple = key
+            if (aid == adapter and len(ptuple) <= len(prompt)
+                    and prompt[:len(ptuple)] == ptuple
+                    and (best is None or len(ptuple) > len(best[1]))):
+                best = key
+        return best
+
+    def contains(self, key: Key) -> bool:
+        return key in self._entries
+
+    def load(self, key: Key) -> tuple[int, dict[str, Any], Any]:
+        """Return (length, pages, last) for ``key``, reading the disk
+        tier if the entry was spilled. Refreshes last-use order. Raises
+        KeyError if absent, TierCorrupt on checksum failure (the caller
+        degrades to cold prefill and should ``discard``)."""
+        ent = self._entries.pop(key)
+        self._entries[key] = ent  # MRU refresh
+        if ent.pages is not None:
+            return ent.length, ent.pages, ent.last
+        try:
+            with open(ent.path, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise TierCorrupt(f"tier spill unreadable: {e}") from e
+        if len(raw) < 4:
+            raise TierCorrupt("tier spill truncated")
+        crc, payload = int.from_bytes(raw[:4], "big"), raw[4:]
+        if zlib.crc32(payload) != crc:
+            raise TierCorrupt("tier spill checksum mismatch")
+        skey, length, pages, last = pickle.loads(payload)
+        if skey != key:
+            raise TierCorrupt("tier spill key mismatch")
+        # Promote back to resident (it is about to be device_put anyway;
+        # the caller discards on successful swap-in).
+        ent.pages, ent.last = pages, last
+        self._bytes += ent.nbytes
+        self._spilled_bytes -= ent.nbytes
+        self._unlink(ent)
+        while self._bytes > self.capacity and len(self._entries) > 1:
+            self._evict_oldest_resident()
+        return ent.length, ent.pages, ent.last
+
+    # -- removal -------------------------------------------------------
+
+    def discard(self, key: Key) -> bool:
+        """Drop ``key`` (and any spill file). Returns whether present."""
+        ent = self._entries.pop(key, None)
+        if ent is None:
+            return False
+        self._forget(ent)
+        return True
+
+    def _forget(self, ent: _Entry) -> None:
+        if ent.pages is not None:
+            self._bytes -= ent.nbytes
+        else:
+            self._spilled_bytes -= ent.nbytes
+            self._unlink(ent)
+        ent.pages = None
+        ent.last = None
+
+    @staticmethod
+    def _unlink(ent: _Entry) -> None:
+        if ent.path is not None:
+            try:
+                os.unlink(ent.path)
+            except OSError:
+                pass  # best-effort; a stale spill file is inert
+            ent.path = None
+
+    def keys(self) -> list[Key]:
+        return list(self._entries)
+
+    # -- accounting ----------------------------------------------------
+
+    def stats(self) -> dict:
+        n_pages = sum(e.n_pages for e in self._entries.values())
+        return {
+            "tier_entries": len(self._entries),
+            "tier_bytes": self._bytes,
+            "tier_spilled_bytes": self._spilled_bytes,
+            "tier_pages": n_pages,
+        }

@@ -13,15 +13,17 @@ Supported template constructs (all the chart uses, nothing more):
 - pipelines ``| toYaml``, ``| indent N``, ``| nindent N``, ``| quote``;
   function-call form ``toYaml .Ref | nindent N``
 - ``{{- if <ref> }} ... {{- end }}`` (nested; truthy = present and not
-  false/empty)
+  false/empty), plus the flat boolean forms ``{{- if or <ref> <ref>
+  ... }}`` / ``{{- if and <ref> <ref> ... }}`` over bare refs only
 - whitespace chomping ``{{-`` / ``-}}``
 
 ANY construct outside this subset raises ValueError at render time —
 the keywords ``range``/``with``/``include``/``template``/``define``/
-``block``/``else``, compound ``if`` conditions (``and``/``or``/``not``/
-``eq``/...), and unknown pipeline functions (``default``, ``printf``,
-...) — even inside a disabled ``if`` branch, where tags are
-structurally validated without being evaluated. Silent mis-rendering of
+``block``/``else``, ``if`` conditions beyond the bare-ref or/and forms
+(``not``/``eq``/nested calls/literal operands), and unknown pipeline
+functions (``default``, ``printf``, ...) — even inside a disabled
+``if`` branch, where tags are structurally validated without being
+evaluated. Silent mis-rendering of
 production manifests is the one failure mode a bespoke renderer must
 not have: the first chart contributor to use a named template must get
 a hard error, not a subtly wrong DaemonSet.
@@ -64,16 +66,28 @@ def _reject_unsupported(expr: str) -> None:
             f"blocks ('{head}' needs real helm; see module docstring)")
 
 
-def _if_ref(expr: str) -> str:
-    """The condition of `if <ref>` — a single bare .Ref only. Compound
-    conditions (and/or/not/eq/...) would otherwise _lookup the whole
-    string, find nothing, and silently render the branch EMPTY."""
-    ref = expr[3:].strip()
-    if len(ref.split()) != 1 or not ref.startswith("."):
+def _if_refs(expr: str) -> "tuple[str, list[str]]":
+    """The condition of ``if <cond>`` — a single bare .Ref, or the flat
+    ``or``/``and`` of two-plus bare .Refs; returns (op, refs). Anything
+    else (not/eq/nested calls/literal operands) would otherwise _lookup
+    the whole string, find nothing, and silently render the branch
+    EMPTY — so it is rejected instead."""
+    tokens = expr[3:].split()
+    if len(tokens) >= 3 and tokens[0] in ("or", "and"):
+        op, refs = tokens[0], tokens[1:]
+    elif len(tokens) == 1:
+        op, refs = "or", tokens
+    else:
         raise ValueError(
             f"unsupported template construct: {{{{ {expr} }}}} — if takes "
-            f"a single bare .Ref (and/or/not/eq/... need real helm)")
-    return ref
+            f"a single bare .Ref or or/and of two-plus bare .Refs "
+            f"(not/eq/nested conditions need real helm)")
+    if not all(r.startswith(".") for r in refs):
+        raise ValueError(
+            f"unsupported template construct: {{{{ {expr} }}}} — if "
+            f"operands must be bare .Refs (literals/nested conditions "
+            f"need real helm)")
+    return op, refs
 
 
 def _parse_expr(expr: str) -> "tuple[str, list[str]]":
@@ -107,7 +121,7 @@ def _validate_tag(expr: str) -> None:
     the subset or rejected, independent of today's values."""
     _reject_unsupported(expr)
     if expr.startswith("if "):
-        _if_ref(expr)
+        _if_refs(expr)
     elif expr != "end":
         _parse_expr(expr)
 
@@ -174,7 +188,9 @@ def render_template(text: str, ctx: dict) -> str:
             expr = m.group(1)
             _reject_unsupported(expr)
             if expr.startswith("if "):
-                stack.append(_truthy(_lookup(ctx, _if_ref(expr))))
+                op, refs = _if_refs(expr)
+                vals = [_truthy(_lookup(ctx, r)) for r in refs]
+                stack.append(any(vals) if op == "or" else all(vals))
                 continue
             if expr == "end":
                 if not stack:

@@ -32,7 +32,9 @@
 #            liveness, rank-loss detection -> re-rendezvous -> resume),
 #            and the speculative-decoding suite (drafter units,
 #            exactness vs the plain engine, int8-paged-KV
-#            drift/capacity) ride along minus their @slow soak/bench
+#            drift/capacity), and the KV-tiering suite (host-store
+#            units, swap round-trip exactness, pin hygiene, tier_swap
+#            fault degradation) ride along minus their @slow soak/bench
 #            tests (the full suite runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
@@ -56,6 +58,7 @@ SMOKE=(
   tests/test_node_obs.py
   tests/test_env.py tests/test_elastic.py
   tests/test_spec_engine.py
+  tests/test_tiering.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

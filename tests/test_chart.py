@@ -403,18 +403,22 @@ def test_golden_matches_real_helm(name):
     "{{ template \"helper\" }}",
     "{{ block \"b\" . }}{{ end }}",
     "{{- if .Values.missing }}\na: 1\n{{- else }}\nb: 2\n{{- end }}",
-    "{{- if and .Values.a .Values.b }}\nx: 1\n{{- end }}",
     "{{- if not .Values.a }}\nx: 1\n{{- end }}",
+    "{{- if eq .Values.a .Values.b }}\nx: 1\n{{- end }}",
+    "{{- if or .Values.a }}\nx: 1\n{{- end }}",
+    "{{- if and .Values.a true }}\nx: 1\n{{- end }}",
+    "{{- if or .Values.a (not .Values.b) }}\nx: 1\n{{- end }}",
     "x: {{ .Values.n | default 3 }}",
 ])
 def test_renderer_rejects_constructs_outside_subset(snippet):
     """helm-lite must HARD-FAIL on any Go-template construct it does not
     implement — block keywords (range/with/include/template/define/
-    block/else), compound if conditions (and/not/eq/...), and unknown
-    pipeline functions (default/printf/...) — instead of silently
-    mis-rendering: a skipped {{ else }} would drop the else-body, a
-    compound if would _lookup nothing and render the branch empty, and
-    a skipped {{ range }}'s {{ end }} would corrupt the if-stack. The
+    block/else), if conditions beyond bare-.Ref or/and forms (not/eq/
+    literal operands/nested calls), and unknown pipeline functions
+    (default/printf/...) — instead of silently mis-rendering: a skipped
+    {{ else }} would drop the else-body, an unparsed if condition would
+    _lookup nothing and render the branch empty, and a skipped
+    {{ range }}'s {{ end }} would corrupt the if-stack. The
     guard fires even when the construct sits inside a disabled
     {{ if }} branch: subset membership must not depend on which values
     are set today."""
@@ -432,3 +436,24 @@ def test_renderer_rejects_inline_unsupported_constructs():
     with pytest.raises(ValueError, match="unsupported template construct"):
         render_template("name: {{ include \"x\" . }}-suffix",
                         {"Values": {}})
+
+
+@pytest.mark.parametrize("a,b,or_body,and_body", [
+    (True, True, True, True),
+    (True, False, True, False),
+    (False, True, True, False),
+    (False, False, False, False),
+])
+def test_renderer_flat_or_and_if(a, b, or_body, and_body):
+    """The flat boolean if-forms the inference template uses for shared
+    paged-engine flags: `if or .A .B` emits when either ref is truthy,
+    `if and .A .B` only when both are. Missing refs count as falsy,
+    matching the single-ref `if` semantics."""
+    from k3stpu.utils.helm_lite import render_template
+    tpl = ("{{- if or .Values.a .Values.b }}\nboth: or\n{{- end }}\n"
+           "{{- if and .Values.a .Values.b }}\nboth: and\n{{- end }}\n"
+           "tail: 1")
+    out = render_template(tpl, {"Values": {"a": a, "b": b}})
+    assert ("both: or" in out) == or_body
+    assert ("both: and" in out) == and_body
+    assert "tail: 1" in out

@@ -1,0 +1,560 @@
+"""Host KV page tier (k3stpu/serve/tiering.py + engine/server wiring).
+
+The correctness bar is BIT-EXACTNESS: a session chain that round-trips
+through the host tier (gather -> host RAM [-> disk spill] -> device_put
++ scatter into fresh pages) must make the engine emit exactly the
+tokens a never-swapped engine emits — greedy, sampled (same seed),
+int8 KV pools, and COW-shared prefixes with live co-resident entries.
+The capacity win must come from moving idle bytes off-device, never
+from numerics.
+
+The safety bar is pin hygiene: swap storms may never leak a page or
+strand a pin (free count returns to baseline), a failed swap-in (chaos
+``tier_swap``, torn disk spill) must degrade to a cold prefill without
+touching live rows, and the accounting the capacity planning trusts
+(``stats()['pcache_bytes']``, ``engine._page_bytes``) must agree with
+``models/quant.kv_page_bytes`` layout-for-layout. CPU-JAX stand-in per
+SURVEY.md §4.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.chaos import FaultInjector
+from k3stpu.models.generate import generate
+from k3stpu.models.quant import kv_page_bytes
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve.engine import GenerateEngine
+from k3stpu.serve.tiering import HostPageStore, TierCorrupt
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+def _tier_pair(model, params, *, tier_mb=64, spill_dir=None,
+               watermark=0, chaos=None, **kw):
+    """A no-tier paged engine and a tiered paged engine with identical
+    scheduling parameters (same seed => identical sampling-key folds).
+    Mirror every submit on both: swap traffic must not perturb the fold
+    sequence, so outputs stay comparable request-for-request."""
+    kw.setdefault("slots", 4)
+    kw.setdefault("prompt_cache", 4)
+    kw.setdefault("page_size", 8)
+    plain = GenerateEngine(model, params, seed=0, **kw)
+    store = HostPageStore(tier_mb * (1 << 20), spill_dir=spill_dir)
+    tiered = GenerateEngine(model, params, seed=0, tier=store,
+                            tier_watermark=watermark, chaos=chaos, **kw)
+    return plain, tiered, store
+
+
+def _assert_page_invariants(engine):
+    """Idle-engine allocator accounting, checked exactly (the same
+    proof as tests/test_paged.py): every page's refcount equals its
+    appearances across live slot chains plus prompt-cache pins. The
+    tier holds HOST bytes only, so a correct swap-out changes nothing
+    here — a stranded pin or leaked page after swap traffic fails."""
+    alloc = engine._alloc
+    expect = {}
+    for chain in engine._chains:
+        for p in chain:
+            expect[p] = expect.get(p, 0) + 1
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            expect[p] = expect.get(p, 0) + 1
+    for p in range(1, alloc.num_pages):
+        assert alloc.refcount(p) == expect.get(p, 0), (
+            f"page {p}: rc={alloc.refcount(p)} but "
+            f"{expect.get(p, 0)} live references")
+    assert alloc.free == alloc.total - sum(1 for v in expect.values()
+                                           if v > 0)
+    pinned = {}
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            pinned[p] = pinned.get(p, 0) + 1
+    assert engine._pinned == pinned
+
+
+# --- HostPageStore unit behavior ----------------------------------------
+
+
+def _fake_chain(seed, n_pages=2):
+    rng = np.random.default_rng(seed)
+    return {
+        "0/attn/key_pages": rng.standard_normal(
+            (n_pages, 8, 2, 4)).astype(np.float32),
+        "0/attn/value_pages": rng.standard_normal(
+            (n_pages, 8, 2, 4)).astype(np.float32),
+    }
+
+
+def test_store_match_is_longest_prefix_per_adapter():
+    store = HostPageStore(1 << 20)
+    store.put((0, (1, 2)), 2, _fake_chain(0))
+    store.put((0, (1, 2, 3)), 3, _fake_chain(1))
+    store.put((1, (1, 2, 3, 4)), 4, _fake_chain(2))
+    assert store.match(0, (1, 2, 3, 4, 5)) == (0, (1, 2, 3))
+    assert store.match(0, (1, 2)) == (0, (1, 2))
+    assert store.match(0, (9, 9, 9)) is None
+    assert store.match(2, (1, 2, 3)) is None  # adapter namespaced
+
+
+def test_store_capacity_evicts_last_use_first():
+    one = sum(a.nbytes for a in _fake_chain(0).values())
+    store = HostPageStore(int(one * 2.5))  # room for two entries
+    store.put((0, (1,)), 1, _fake_chain(0))
+    store.put((0, (2,)), 1, _fake_chain(1))
+    store.load((0, (1,)))                 # refresh: (2,) is now LRU
+    store.put((0, (3,)), 1, _fake_chain(2))
+    assert store.keys() == [(0, (1,)), (0, (3,))], (
+        "eviction must follow last-use order, not insertion order")
+    assert store.stats()["tier_bytes"] <= store.capacity
+
+
+def test_store_spill_roundtrip_and_unlink(tmp_path):
+    one = sum(a.nbytes for a in _fake_chain(0).values())
+    store = HostPageStore(int(one * 1.5), spill_dir=str(tmp_path))
+    want = _fake_chain(7)
+    store.put((0, (1,)), 1, want)
+    store.put((0, (2,)), 1, _fake_chain(8))   # pushes (1,) to disk
+    assert store.stats()["tier_spilled_bytes"] > 0
+    assert len(list(tmp_path.iterdir())) == 1
+    assert store.contains((0, (1,)))          # spilled, not gone
+    length, pages, last = store.load((0, (1,)))
+    assert length == 1 and last is None
+    for name, arr in want.items():
+        assert np.array_equal(pages[name], arr), name
+    # load promoted it back; the spill file must not linger...
+    spills = [p for p in tmp_path.iterdir() if p.suffix == ".kv"]
+    # ...(the promote may have spilled the OTHER entry to make room).
+    assert store.stats()["tier_entries"] == 2
+    for p in spills:
+        assert "tier-1" not in p.name, "consumed spill file not unlinked"
+
+
+def test_store_torn_spill_fails_checksum(tmp_path):
+    one = sum(a.nbytes for a in _fake_chain(0).values())
+    store = HostPageStore(int(one * 1.2), spill_dir=str(tmp_path))
+    store.put((0, (1,)), 1, _fake_chain(0))
+    store.put((0, (2,)), 1, _fake_chain(1))
+    (spill,) = list(tmp_path.iterdir())
+    raw = spill.read_bytes()
+    spill.write_bytes(raw[:len(raw) // 2])            # torn write
+    with pytest.raises(TierCorrupt):
+        store.load((0, (1,)))
+    spill.write_bytes(b"xy")                          # truncated header
+    with pytest.raises(TierCorrupt):
+        store.load((0, (1,)))
+    assert store.discard((0, (1,)))
+    assert not store.contains((0, (1,)))
+
+
+# --- accounting: the bytes capacity planning trusts (satellite) ---------
+
+
+@pytest.mark.parametrize("kv_dtype", [None, "int8"])
+def test_page_bytes_matches_kv_page_bytes(kv_dtype):
+    """The engine's measured per-page cost (summed from the live cache
+    leaves by name) must equal the planning-side models/quant form for
+    BOTH pool layouts — fp32 and int8+scale-planes — and
+    stats()['pcache_bytes'] must be the exact sum of entry footprints
+    computed from it. A drift here silently mis-sizes --tier-host-mb."""
+    kw = {"max_seq_len": 64}
+    if kv_dtype is not None:
+        kw["kv_cache_dtype"] = kv_dtype
+    model = transformer_lm_tiny(**kw)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    engine = GenerateEngine(model, params, slots=2, seed=0,
+                            page_size=8, prompt_cache=4)
+    try:
+        assert engine._page_bytes == kv_page_bytes(model.config, 8)
+        engine.submit([[5, 6, 7, 8, 9]], max_new_tokens=4)
+        engine.submit([[20, 21, 22]], max_new_tokens=4)
+        want = sum(entry[-1] for entry in engine._pcache.values())
+        assert engine.stats()["pcache_bytes"] == want
+        for entry in engine._pcache.values():
+            page_part = len(entry[0]) * kv_page_bytes(model.config, 8)
+            assert entry[-1] >= page_part
+    finally:
+        engine.close()
+
+
+# --- bit-exactness: swapped == never-swapped on every path --------------
+
+
+def test_session_restore_bit_exact_greedy(mp):
+    model, params = mp
+    plain, tiered, store = _tier_pair(model, params)
+    try:
+        p1 = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        want1 = plain.submit([p1], max_new_tokens=6)
+        got1 = tiered.submit([p1], max_new_tokens=6, session="s1")
+        assert got1 == want1
+        assert want1[0] == _solo(model, params, p1, 6)
+
+        assert tiered.release_session("s1")
+        assert tiered.stats()["tier_swap_outs"] == 1
+        assert store.stats()["tier_entries"] == 1
+
+        # Turn 2 extends turn 1's prompt + reply: the tier restore must
+        # be byte-for-byte the plain engine's warm pcache path.
+        p2 = p1 + got1[0] + [20, 21]
+        want2 = plain.submit([p2], max_new_tokens=6)
+        got2 = tiered.submit([p2], max_new_tokens=6, session="s1")
+        assert got2 == want2
+        assert want2[0] == _solo(model, params, p2, 6)
+        ts = tiered.stats()
+        assert ts["tier_hits"] == 1 and ts["tier_swap_ins"] == 1
+        assert ts["tier_fallbacks"] == 0
+        _assert_page_invariants(tiered)
+    finally:
+        plain.close()
+        tiered.close()
+
+
+def test_session_restore_bit_exact_sampled(mp):
+    """Same seed, same fold sequence => sampled tokens after a tier
+    round-trip must be IDENTICAL, not merely plausible — swap traffic
+    must never bump the step counter the sampling keys fold on."""
+    model, params = mp
+    plain, tiered, store = _tier_pair(model, params)
+    try:
+        p1 = [9, 10, 11, 12]
+        kw = {"temperature": 0.9, "top_k": 20}
+        want1 = plain.submit([p1], max_new_tokens=6, **kw)
+        got1 = tiered.submit([p1], max_new_tokens=6, session="s1", **kw)
+        assert got1 == want1
+        assert tiered.release_session("s1")
+        p2 = p1 + got1[0] + [30]
+        want2 = plain.submit([p2], max_new_tokens=8, **kw)
+        got2 = tiered.submit([p2], max_new_tokens=8, session="s1", **kw)
+        assert got2 == want2
+        assert tiered.stats()["tier_swap_ins"] == 1
+    finally:
+        plain.close()
+        tiered.close()
+
+
+def test_session_restore_bit_exact_int8(mp):
+    """The int8 pools carry fp32 absmax scale planes next to the int8
+    values; a swap that dropped or reordered either leaf would decode
+    garbage. Greedy output after a round-trip must match the no-tier
+    int8 engine exactly."""
+    model = transformer_lm_tiny(max_seq_len=64, kv_cache_dtype="int8")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    plain, tiered, store = _tier_pair(model, params)
+    try:
+        p1 = [3, 4, 5, 6, 7, 8, 9]
+        want1 = plain.submit([p1], max_new_tokens=5)
+        got1 = tiered.submit([p1], max_new_tokens=5, session="q")
+        assert got1 == want1
+        assert tiered.release_session("q")
+        p2 = p1 + got1[0] + [40]
+        want2 = plain.submit([p2], max_new_tokens=5)
+        assert tiered.submit([p2], max_new_tokens=5, session="q") == want2
+        assert tiered.stats()["tier_swap_ins"] == 1
+        _assert_page_invariants(tiered)
+    finally:
+        plain.close()
+        tiered.close()
+
+
+def test_cow_shared_prefix_survives_neighbor_release(mp):
+    """Two sessions sharing a COW prefix: releasing one to the tier
+    decrefs only ITS references — the co-resident entry keeps its pins,
+    stays exact, and the released chain restores exact alongside it."""
+    model, params = mp
+    plain, tiered, store = _tier_pair(model, params)
+    try:
+        base = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        r1p = plain.submit([base], max_new_tokens=4)
+        r1t = tiered.submit([base], max_new_tokens=4, session="a")
+        assert r1t == r1p
+        # b branches off a's turn-1 transcript: its prompt extends a's
+        # session key (base + reply[:-1]) so admission COW-shares a's
+        # pinned pages and only copies the partial tail.
+        ext = base + r1t[0] + [30, 31]
+        r2p = plain.submit([ext], max_new_tokens=4)
+        r2t = tiered.submit([ext], max_new_tokens=4, session="b")
+        assert r2t == r2p
+        assert tiered.stats()["pcache_prefix_hits"] >= 1
+
+        assert tiered.release_session("a")  # shared pages: b still pins
+        for entry in tiered._pcache.values():
+            for p in entry[0]:
+                assert tiered._alloc.refcount(p) >= 1, (
+                    "neighbor release reclaimed a shared pinned page")
+
+        # b continues exact on its still-resident chain...
+        b2 = ext + r2t[0] + [60]
+        assert (tiered.submit([b2], max_new_tokens=4, session="b")
+                == plain.submit([b2], max_new_tokens=4))
+        # ...and a restores exact from the tier.
+        a2 = base + r1t[0] + [50]
+        assert (tiered.submit([a2], max_new_tokens=4, session="a")
+                == plain.submit([a2], max_new_tokens=4))
+        assert a2[:len(base)] == b2[:len(base)] and a2 != b2
+        assert tiered.stats()["tier_swap_ins"] == 1
+        _assert_page_invariants(tiered)
+    finally:
+        plain.close()
+        tiered.close()
+
+
+def test_watermark_demotes_idle_entries_under_pressure(mp):
+    """tier_watermark > 0: when the free list sits below it, the loop
+    demotes LRU pcache entries to host instead of letting the next
+    admission stall — and a demoted session still restores exact."""
+    model, params = mp
+    store = HostPageStore(64 << 20)
+    engine = GenerateEngine(model, params, slots=2, seed=0,
+                            prompt_cache=8, page_size=8, num_pages=12,
+                            tier=store, tier_watermark=8)
+    try:
+        p1 = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        got1 = engine.submit([p1], max_new_tokens=4, session="w")
+        # Pressure: this request + the cached chain push free below the
+        # watermark; the loop (which wakes on its 0.2 s drain timeout
+        # even when idle) must gather idle entries to host.
+        engine.submit([list(range(20, 33))], max_new_tokens=4)
+        deadline = time.time() + 10
+        while (engine.stats()["tier_swap_outs"] < 1
+               and time.time() < deadline):
+            time.sleep(0.05)
+        s = engine.stats()
+        assert s["tier_swap_outs"] >= 1, "watermark demotion never ran"
+        assert s["host_tier_pages"] >= 1
+        p2 = p1 + got1[0] + [40]
+        assert engine.submit([p2], max_new_tokens=4, session="w") \
+            == [_solo(model, params, p2, 4)]
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+# --- lifecycle / API edges ----------------------------------------------
+
+
+def test_release_session_semantics(mp):
+    model, params = mp
+    dense = GenerateEngine(model, params, slots=2, seed=0)
+    plain, tiered, store = _tier_pair(model, params)
+    try:
+        assert dense.release_session("x") is False   # dense: no chains
+        assert tiered.release_session("ghost") is False
+        tiered.submit([[5, 6, 7]], max_new_tokens=4, session="s")
+        assert tiered.release_session("s") is True
+        assert tiered.release_session("s") is True   # idempotent: on host
+        # no-tier paged engine: release still frees HBM (entry dropped).
+        plain.submit([[5, 6, 7]], max_new_tokens=4, session="s")
+        assert plain.release_session("s") is True
+        assert plain.release_session("s") is False   # gone for good
+        with pytest.raises(ValueError, match="one prompt"):
+            tiered.submit([[1, 2], [3, 4]], max_new_tokens=2, session="s")
+    finally:
+        dense.close()
+        plain.close()
+        tiered.close()
+
+
+def test_chaos_tier_swap_in_degrades_to_cold_prefill(mp):
+    """An injected fault inside the swap-in dispatch must cost ONLY the
+    restore: the request falls back to a cold prefill with bit-exact
+    output, tier_fallbacks counts it, and the engine keeps serving."""
+    model, params = mp
+    inj = FaultInjector()
+    plain, tiered, store = _tier_pair(model, params, chaos=inj)
+    try:
+        p1 = [5, 6, 7, 8, 9]
+        got1 = tiered.submit([p1], max_new_tokens=4, session="c")
+        assert got1 == plain.submit([p1], max_new_tokens=4)
+        assert tiered.release_session("c")          # swap-out (clean)
+        inj.arm("tier_swap", times=1)
+        p2 = p1 + got1[0] + [20]
+        want2 = plain.submit([p2], max_new_tokens=4)
+        assert tiered.submit([p2], max_new_tokens=4, session="c") == want2
+        assert inj.fired("tier_swap") == 1
+        s = tiered.stats()
+        assert s["tier_fallbacks"] == 1 and s["tier_swap_ins"] == 0
+        # engine loop alive and exact afterwards
+        assert tiered.submit([[7, 8, 9]], max_new_tokens=3) \
+            == plain.submit([[7, 8, 9]], max_new_tokens=3)
+        _assert_page_invariants(tiered)
+    finally:
+        plain.close()
+        tiered.close()
+
+
+def test_torn_disk_spill_degrades_to_cold_prefill(mp, tmp_path):
+    """End-to-end fault matrix row: a spilled session whose file is
+    corrupted on disk fails the checksum at swap-in and degrades to a
+    cold prefill — exact output, fallback counted, loop alive."""
+    model, params = mp
+    plain, tiered, store = _tier_pair(model, params,
+                                      spill_dir=str(tmp_path))
+    try:
+        p1 = [5, 6, 7, 8, 9]
+        g1 = tiered.submit([p1], max_new_tokens=4, session="a")
+        plain.submit([p1], max_new_tokens=4)
+        p1b = [20, 21, 22, 23]
+        tiered.submit([p1b], max_new_tokens=4, session="b")
+        plain.submit([p1b], max_new_tokens=4)
+        assert tiered.release_session("a")
+        assert tiered.release_session("b")
+        # Shrink capacity so a's entry (LRU) hits the disk tier.
+        store.capacity = 1
+        store._evict_oldest_resident()
+        (spill,) = [p for p in tmp_path.iterdir() if p.suffix == ".kv"]
+        raw = spill.read_bytes()
+        spill.write_bytes(raw[:8] + b"\x00" * 8 + raw[16:])  # bit rot
+        p2 = p1 + g1[0] + [40]
+        want = plain.submit([p2], max_new_tokens=4)
+        assert tiered.submit([p2], max_new_tokens=4, session="a") == want
+        s = tiered.stats()
+        assert s["tier_fallbacks"] >= 1
+        assert not store.contains((0, tuple(p1 + g1[0][:-1])))
+        _assert_page_invariants(tiered)
+    finally:
+        plain.close()
+        tiered.close()
+
+
+# --- pin hygiene under sustained swap traffic (satellite) ---------------
+
+
+def test_swap_storm_free_count_returns_to_baseline(mp):
+    """500+ swap events (release -> restore cycles across sessions):
+    afterwards every page is back on the free list and the tier's
+    byte accounting is still capacity-bounded. One stranded pin or
+    leaked ref per cycle would compound into pool exhaustion in an
+    afternoon of chat traffic — this is the leak-free proof."""
+    model, params = mp
+    store = HostPageStore(2 << 20)   # tight: forces tier eviction churn
+    engine = GenerateEngine(model, params, slots=2, seed=0,
+                            prompt_cache=4, page_size=8,
+                            decode_block=1, tier=store)
+    try:
+        engine.submit([[1, 2, 3]], max_new_tokens=1)   # warm programs
+        for i in range(170):
+            p1 = [(i * 7 + j) % 400 + 1 for j in range(5)]
+            r1 = engine.submit([p1], max_new_tokens=2,
+                               session=f"s{i}")[0]
+            assert engine.release_session(f"s{i}")     # swap-out #1
+            p2 = p1 + r1 + [(i % 50) + 1]
+            engine.submit([p2], max_new_tokens=2,
+                          session=f"s{i}")             # swap-in
+            assert engine.release_session(f"s{i}")     # swap-out #2
+            if i % 40 == 0:
+                _assert_page_invariants(engine)
+        s = engine.stats()
+        assert s["tier_swap_outs"] + s["tier_swap_ins"] >= 500, s
+        # Free count returns to the working-set baseline: the ONLY pages
+        # off the free list are the (<= prompt_cache) live LRU entries'
+        # — 510+ swaps stranded nothing. A one-page leak per cycle would
+        # show up here as 170 missing pages.
+        live = set()
+        for entry in engine._pcache.values():
+            live.update(entry[0])
+        assert engine._alloc.free == engine._alloc.total - len(live), (
+            "swap storm leaked pages or stranded pins")
+        assert len(engine._pcache) <= 4
+        ts = store.stats()
+        assert ts["tier_bytes"] <= store.capacity
+        _assert_page_invariants(engine)
+        # and the engine still serves exact output
+        assert engine.submit([[5, 6, 7]], max_new_tokens=4) \
+            == [_solo(model, params, [5, 6, 7], 4)]
+    finally:
+        engine.close()
+
+
+# --- server surface ------------------------------------------------------
+
+
+def test_server_session_api_and_tier_metrics():
+    from k3stpu.serve.server import InferenceServer
+    server = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                             continuous_batching=True, kv_page_size=8,
+                             prompt_cache=4, tier_host_mb=16)
+    try:
+        p1 = [5, 6, 7, 8, 9]
+        g1 = server.generate_tokens([p1], max_new_tokens=4, session="s1")
+        assert server.release_session("s1") is True
+        p2 = p1 + g1[0] + [20]
+        server.generate_tokens([p2], max_new_tokens=4, session="s1")
+        stats = server._engine.stats()
+        assert stats["tier_swap_ins"] >= 1
+        text = server._counter_exposition()
+        for family in ("k3stpu_tier_entries", "k3stpu_tier_host_bytes",
+                       "k3stpu_tier_spilled_bytes", "k3stpu_tier_sessions",
+                       "k3stpu_tier_swap_ins_total",
+                       "k3stpu_tier_swap_outs_total"):
+            assert family in text, family
+        with pytest.raises(ValueError):
+            server.generate_tokens([p1, p1], max_new_tokens=2,
+                                   session="s2")   # sessions are 1-row
+        with pytest.raises(ValueError):
+            server.release_session("")
+    finally:
+        server.close()
+
+
+def test_server_rejects_tier_without_paged_engine():
+    from k3stpu.serve.server import InferenceServer
+    with pytest.raises(ValueError, match="tier-host-mb"):
+        InferenceServer(model_name="transformer-tiny", seq_len=32,
+                        tier_host_mb=16)
+    with pytest.raises(ValueError, match="tier-dir"):
+        InferenceServer(model_name="transformer-tiny", seq_len=32,
+                        continuous_batching=True, kv_page_size=8,
+                        prompt_cache=4, tier_dir="/tmp/nope")
+
+
+# --- bench mode ---------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_tier_bench_gates():
+    """bench.py --serve-tier: one JSON line; warm-turn restore latency
+    <= 1/3 of cold re-prefill at a 512-token prompt (vs_baseline <= 1.0)
+    and >= 8x restorable sessions at the fixed page pool."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serve-tier"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"must print exactly one line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serve_tier_warm_restore_ratio"
+    assert rec["vs_baseline"] <= 1.0, rec
+    d = rec["detail"]
+    assert d["warm_gate_passed"] and d["capacity_gate_passed"], d
+    assert d["session_capacity_x"] >= 8.0, d
