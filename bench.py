@@ -28,6 +28,14 @@ restore latency (tier swap-in + suffix prefill) vs cold re-prefill at a
 with restorable-session capacity at a fixed page pool vs the no-tier
 engine (gate >= 8x) carried in the detail.
 
+``--serve-router`` gates the scale-out router tier (same contract): two
+in-process replica servers behind a real router HTTP hop, multi-turn
+sessions driven through policy affinity vs policy random; sticky must
+keep >= 90% of warm turns on a warm cache (tier swap-in or prompt-cache
+hit) while the round-robin baseline stays <= 60%, and the router's
+measured proxy overhead p50 must stay <= 5% of the request p50
+(vs_baseline = sticky_rate/0.90, >= 1.0 passes all three in detail).
+
 ``--train-obs`` is the training twin (same contract): median step time
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
@@ -850,6 +858,201 @@ def _serve_tier_main() -> int:
                  **skw)
 
 
+def _serve_router_worker() -> int:
+    """Router-tier gate (bounded subprocess, CPU tiny model, loopback).
+
+    Two REAL InferenceServer replicas (continuous batching + paged KV +
+    prompt cache + host tier, distinct ``instance`` names) serve behind
+    two router arms over the same fleet: ``--policy affinity`` (sticky
+    sessions + prefix hash) vs ``--policy random`` (the deterministic
+    round-robin baseline). Each arm drives S sessions x T turns
+    sequentially through the router's real HTTP hop, releasing the
+    session between turns (the drain/park path), so every warm turn
+    either lands where its parked chain lives (tier swap-in / prompt
+    cache hit) or pays a cold re-prefill on the wrong replica.
+
+    Gates: sticky warm-turn hit rate >= 0.90, random <= 0.60, and the
+    router's own proxy-overhead histogram p50 <= 5% of the client-side
+    request p50 — the tier must buy cache locality without becoming a
+    latency tax."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    from k3stpu.router.router import Router, make_router_app
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    prompt_len, reply = 48, 4
+    n_sessions, n_turns = 6, 3
+    warm_turns = n_sessions * (n_turns - 1)
+
+    def prompt_for(seed: int) -> "list[int]":
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 1000, size=(prompt_len,)).tolist()
+
+    def post(url: str, path: str, body: dict) -> dict:
+        req = urllib.request.Request(
+            url + path, data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read().decode())
+
+    servers: list = []
+    httpds: list = []
+    urls: "list[str]" = []
+
+    def run_arm(policy: str, seed_base: int):
+        """Returns (warm-turn hit rate, request p50 s, proxy overhead
+        p50 s) for one policy over the shared fleet."""
+        router = Router(urls, policy=policy, prefix_tokens=16,
+                        health_period_s=0.5,
+                        instance=f"bench-router-{policy}")
+        rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_router_app(router))
+        threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+        rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        # A warm turn is a HIT when any warm-path counter moved on any
+        # replica while it ran (a single restore can tick both a tier
+        # swap-in and a prompt-cache hit — count turns, not counters).
+        def warm_marks() -> int:
+            return sum(srv._engine.stats()[k] for srv in servers
+                       for k in ("pcache_hits", "pcache_prefix_hits",
+                                 "tier_swap_ins"))
+
+        lat: "list[float]" = []
+        hits = 0
+        try:
+            for i in range(n_sessions):
+                sid = f"{policy}-s{i}"
+                toks = prompt_for(seed_base + i)
+                for turn in range(n_turns):
+                    before = warm_marks()
+                    t0 = time.perf_counter()
+                    out = post(rurl, "/v1/generate",
+                               {"prompt_tokens": [toks],
+                                "max_new_tokens": reply, "session": sid})
+                    lat.append(time.perf_counter() - t0)
+                    if turn > 0 and warm_marks() > before:
+                        hits += 1
+                    toks = toks + out["tokens"][0] + [11, 13]
+                    # Park the chain between turns — the scale-down /
+                    # migration path the pin table is built around.
+                    post(rurl, "/v1/session/release", {"session": sid})
+            lat.sort()
+            return (hits / warm_turns, lat[len(lat) // 2],
+                    router._obs.proxy_overhead.quantile(0.5) or 0.0)
+        finally:
+            rhttpd.shutdown()
+            router.close()
+
+    try:
+        for name in ("bench-rep-a", "bench-rep-b"):
+            srv = InferenceServer(
+                model_name="transformer-tiny", seq_len=256,
+                batch_window_ms=0.0, continuous_batching=True,
+                decode_block=4, prompt_cache=32, kv_page_size=16,
+                kv_pages=128, tier_host_mb=64, shard_devices=None,
+                instance=name)
+            servers.append(srv)
+            httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+            httpds.append(httpd)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+
+        # Warm every jitted program the measured turns hit (turn-width
+        # prefills, swap gather/scatter, decode) on BOTH replicas, then
+        # zero the counters so compiles don't poison either arm.
+        for srv in servers:
+            toks = prompt_for(999)
+            for _ in range(n_turns):
+                rep = srv.generate_tokens([toks], max_new_tokens=reply,
+                                          session="warm")[0]
+                srv.release_session("warm")
+                toks = toks + rep + [7]
+            srv.reset_stats()
+
+        sticky_rate, req_p50_s, overhead_p50_s = run_arm("affinity", 300)
+        random_rate, _, _ = run_arm("random", 400)
+    finally:
+        for httpd in httpds:
+            httpd.shutdown()
+        for srv in servers:
+            srv.close()
+
+    overhead_frac = overhead_p50_s / max(req_p50_s, 1e-9)
+    doc = {
+        # Headline: fraction of warm session turns the sticky router
+        # landed on a warm cache. Target 0.90 => vs_baseline >= 1.0.
+        "metric": "serve_router_sticky_hit_rate",
+        "value": round(sticky_rate, 4),
+        "unit": "warm_turn_cache_hit_fraction",
+        "vs_baseline": round(sticky_rate / 0.90, 4),
+        "detail": {
+            "gate_sticky_min": 0.90,
+            "sticky_gate_passed": sticky_rate >= 0.90,
+            "random_hit_rate": round(random_rate, 4),
+            "gate_random_max": 0.60,
+            "random_gate_passed": random_rate <= 0.60,
+            "proxy_overhead_p50_s": round(overhead_p50_s, 6),
+            "request_p50_s": round(req_p50_s, 6),
+            "proxy_overhead_frac": round(overhead_frac, 4),
+            "gate_overhead_frac_max": 0.05,
+            "overhead_gate_passed": overhead_frac <= 0.05,
+            "sessions": n_sessions,
+            "turns_per_session": n_turns,
+            "warm_turns": warm_turns,
+            "replicas": 2,
+            "prompt_tokens": prompt_len,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_router_main() -> int:
+    """Bounded-subprocess wrapper for --serve-router (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-router-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_router")
+    skw = {"metric": "serve_router_sticky_hit_rate",
+           "unit": "warm_turn_cache_hit_fraction"}
+    if not ok:
+        why = (f"router bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_router", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _train_obs_worker() -> int:
     """TrainObs overhead microbench (bounded subprocess).
 
@@ -1322,6 +1525,10 @@ if __name__ == "__main__":
         sys.exit(_serve_tier_worker())
     if "--serve-tier" in sys.argv[1:]:
         sys.exit(_serve_tier_main())
+    if "--serve-router-worker" in sys.argv[1:]:
+        sys.exit(_serve_router_worker())
+    if "--serve-router" in sys.argv[1:]:
+        sys.exit(_serve_router_main())
     if "--train-obs-worker" in sys.argv[1:]:
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:

@@ -67,6 +67,11 @@ point                 boundary
                       coordinator takeover by the next-lowest survivor
                       plus primary-duty handoff (checkpoint writes, GC,
                       metrics port)
+``route_proxy``       per proxy attempt in the router tier
+                      (``k3stpu/router``), before the upstream dispatch —
+                      a raised fault stands in for a replica dying under
+                      an in-flight request, exercising ejection +
+                      failover to the next ring candidate
 ====================  =====================================================
 """
 

@@ -45,7 +45,8 @@ class ServeObs:
     """All serving observability state, shareable between an
     InferenceServer and its GenerateEngine."""
 
-    def __init__(self, trace_capacity: int = 256, enabled: bool = True):
+    def __init__(self, trace_capacity: int = 256, enabled: bool = True,
+                 instance: "str | None" = None):
         self.enabled = enabled
         self.traces = TraceBuffer(capacity=trace_capacity)
         self.ttft = Histogram(
@@ -131,7 +132,10 @@ class ServeObs:
             "k3stpu_serve_tier_fallbacks_total",
             "Tier swaps that failed and degraded to a cold prefill "
             "(or plain eviction).")
-        self.build_info = build_info_gauge("serve")
+        # ``instance`` (pod name or host:port) stamps which replica of a
+        # scaled-out serving fleet this exposition came from; None (the
+        # default) keeps the single-replica label set byte-stable.
+        self.build_info = build_info_gauge("serve", instance=instance)
 
     # -- engine hooks (loop / submitter threads) ---------------------------
 

@@ -67,15 +67,25 @@ class InfoGauge:
         return f"{head}\n{self.name}{{{pairs}}} 1"
 
 
-def build_info_gauge(component: str) -> InfoGauge:
+def build_info_gauge(component: str,
+                     instance: "str | None" = None) -> InfoGauge:
     """The shared ``k3stpu_build_info`` family every metric server in
-    the stack (serve, train rank-0, node exporter) exposes, telling one
-    scrape apart from another by version and role."""
+    the stack (serve, train rank-0, node exporter, router) exposes,
+    telling one scrape apart from another by version and role.
+
+    ``instance`` names WHICH replica of a horizontally-scaled component
+    this is (pod name or host:port) — the label the router tier and
+    multi-endpoint loadgen join per-replica series on. Omitted (the
+    single-replica components), the label set stays exactly the
+    pre-router pair, so existing expositions are byte-stable."""
     from k3stpu import __version__
+    labels = {"version": __version__, "component": component}
+    if instance is not None:
+        labels["instance"] = instance
     return InfoGauge(
         "k3stpu_build_info",
         "Constant-1 build/version info gauge (standard convention)",
-        {"version": __version__, "component": component})
+        labels)
 
 
 class Gauge:

@@ -1,0 +1,22 @@
+"""Session- and prefix-aware request router — the horizontal scale-out
+tier (docs/ROUTER.md).
+
+A zero-dep stdlib-HTTP proxy that turns N replica-local caches (prompt
+cache, COW prefix pages, host KV tier) into fleet capacity: sticky
+session routing, consistent-hash prefix affinity, health-aware
+membership with eject/readmit and retry-with-failover, bounded
+per-replica in-flight, unbuffered SSE relay, traceparent passthrough,
+and ``k3stpu_router_*`` Prometheus families.
+
+Run: python -m k3stpu.router --replicas http://a:8096,http://b:8096
+"""
+
+from k3stpu.router.obs import ROUTE_REASONS, RouterObs  # noqa: F401
+from k3stpu.router.ring import HashRing  # noqa: F401
+from k3stpu.router.router import (  # noqa: F401
+    REPLICA_HEADER,
+    FleetUnavailable,
+    Router,
+    main,
+    make_router_app,
+)

@@ -1,0 +1,6 @@
+"""``python -m k3stpu.router`` entry point."""
+
+from k3stpu.router.router import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,149 @@
+"""Router observability: the ``k3stpu_router_*`` Prometheus families.
+
+Same facade discipline as ``ServeObs`` (obs/__init__.py): metric objects
+hang off instance attributes so ``tools/metrics_lint.py`` can construct
+a ``RouterObs()`` and scan ``vars()`` for the real families, the render
+methods concatenate the hand-rolled expositions, and every ``on_*`` hook
+is an early-return no-op when disabled. Constructs without jax — the
+router tier never touches a device, so its metrics server must not pay
+a backend import either.
+
+Label cardinality is bounded by construction: ``replica`` values are
+the configured fleet (a handful of URLs), ``reason`` is the fixed
+routing-decision enum {session, prefix, rebalance} — both are in the
+lint's bounded-label allow-list.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from k3stpu.obs.hist import (
+    TPOT_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCounter,
+    build_info_gauge,
+    prometheus_text_to_openmetrics,
+)
+
+# The fixed routing-decision enum. "session": a pinned session followed
+# its pin. "prefix": consistent-hash placement on the prompt prefix
+# (including a session's FIRST turn, which is placed by prefix and then
+# pinned). "rebalance": the affinity target was ejected or saturated and
+# the request moved — the cache-miss-risk bucket worth alerting on.
+ROUTE_REASONS = ("session", "prefix", "rebalance")
+
+
+class RouterObs:
+    """All router observability state, shared by the handler threads and
+    the health poller."""
+
+    def __init__(self, enabled: bool = True, instance: "str | None" = None):
+        self.enabled = enabled
+        self.requests = LabeledCounter(
+            "k3stpu_router_requests_total",
+            "Requests proxied to each replica (completed attempts, "
+            "any status).", "replica")
+        self.failovers = LabeledCounter(
+            "k3stpu_router_failovers_total",
+            "Proxy attempts that failed on a replica and moved to "
+            "another (connect error, mid-request death, or retryable "
+            "503).", "replica")
+        self.ejections = LabeledCounter(
+            "k3stpu_router_ejections_total",
+            "Health ejections per replica (failed /healthz poll or "
+            "fatal proxy error).", "replica")
+        self.decisions = LabeledCounter(
+            "k3stpu_router_routing_decisions_total",
+            "Routing decisions by reason: session (followed a pin), "
+            "prefix (consistent-hash placement), rebalance (affinity "
+            "target unavailable, request moved).", "reason")
+        self.rejected = Counter(
+            "k3stpu_router_rejected_total",
+            "Requests shed by the router with 503 + Retry-After "
+            "(every healthy replica saturated or none healthy).")
+        self.proxy_overhead = Histogram(
+            "k3stpu_router_proxy_overhead_seconds",
+            "Router-added latency per proxied request: total handler "
+            "time minus the upstream replica's own service time.",
+            bounds=TPOT_BUCKETS_S)
+        self.replicas_healthy = Gauge(
+            "k3stpu_router_replicas_healthy",
+            "Replicas currently in the ring (healthy and routable).")
+        self.sessions_pinned = Gauge(
+            "k3stpu_router_sessions_pinned",
+            "Session ids currently pinned to a replica.")
+        self.build_info = build_info_gauge(
+            "router", instance=instance or socket.gethostname())
+
+    # -- hooks (handler + poller threads) ----------------------------------
+
+    def on_route(self, reason: str) -> None:
+        if not self.enabled:
+            return
+        self.decisions.add(reason)
+
+    def on_proxy(self, replica: str, overhead_s: float) -> None:
+        if not self.enabled:
+            return
+        self.requests.add(replica)
+        self.proxy_overhead.observe(overhead_s)
+
+    def on_failover(self, replica: str) -> None:
+        if not self.enabled:
+            return
+        self.failovers.add(replica)
+
+    def on_eject(self, replica: str) -> None:
+        if not self.enabled:
+            return
+        self.ejections.add(replica)
+
+    def on_reject(self) -> None:
+        if not self.enabled:
+            return
+        self.rejected.inc()
+
+    def on_membership(self, healthy: int) -> None:
+        if not self.enabled:
+            return
+        self.replicas_healthy.set(float(healthy))
+
+    def on_pins(self, pinned: int) -> None:
+        if not self.enabled:
+            return
+        self.sessions_pinned.set(float(pinned))
+
+    # -- read side (HTTP threads) ------------------------------------------
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.proxy_overhead,)
+
+    def _counters(self):
+        return (self.requests, self.failovers, self.ejections,
+                self.decisions, self.rejected)
+
+    def _gauges(self) -> "tuple[Gauge, ...]":
+        return (self.replicas_healthy, self.sessions_pinned)
+
+    def render_prometheus(self) -> str:
+        parts = [h.render() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(c.render() for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n"
+
+    def render_openmetrics(self) -> str:
+        parts = [h.render_openmetrics() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(prometheus_text_to_openmetrics(c.render())
+                     for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n# EOF\n"
+
+    def reset(self) -> None:
+        for h in self.histograms():
+            h.reset()
+        self.rejected.reset()

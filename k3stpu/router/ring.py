@@ -1,0 +1,90 @@
+"""Consistent-hash ring with virtual nodes — the sessionless routing map.
+
+Prompt-prefix affinity only pays off if the same prefix keeps landing on
+the same replica ACROSS membership changes: a naive ``hash(key) % n``
+remaps almost every key when n changes, which would cold-start every
+prompt cache in the fleet each time a replica is ejected or readmitted.
+A consistent-hash ring bounds that movement to ~1/n of the key space per
+single-node change (the classic Karger property), and virtual nodes
+smooth the per-replica share so two replicas split traffic near 50/50
+instead of wherever two raw hashes happen to fall.
+
+Zero-dep and deterministic: positions come from sha256 over
+``"{node}#{i}"`` / the key bytes, so every router process (and the
+routing-determinism tests) computes the identical map — no process-seeded
+``hash()``, which Python randomizes per run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from bisect import bisect_right
+
+
+def _h(data: str) -> int:
+    """Ring position: the first 8 bytes of sha256 as an int. Stable
+    across processes and platforms (unlike builtin hash), cheap enough
+    for a per-request lookup."""
+    return int.from_bytes(
+        hashlib.sha256(data.encode("utf-8", "surrogatepass")).digest()[:8],
+        "big")
+
+
+class HashRing:
+    """Maps string keys to member nodes with bounded movement under
+    membership change. Not thread-safe by itself — the Router serializes
+    membership changes and lookups under its own lock."""
+
+    def __init__(self, vnodes: int = 128):
+        if vnodes < 1:
+            raise ValueError("vnodes must be >= 1")
+        self.vnodes = vnodes
+        self._points: "list[tuple[int, str]]" = []  # sorted (position, node)
+        self._nodes: "set[str]" = set()
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def __contains__(self, node: str) -> bool:
+        return node in self._nodes
+
+    def nodes(self) -> "list[str]":
+        return sorted(self._nodes)
+
+    def add(self, node: str) -> None:
+        if node in self._nodes:
+            return
+        self._nodes.add(node)
+        self._points.extend((_h(f"{node}#{i}"), node)
+                            for i in range(self.vnodes))
+        self._points.sort()
+
+    def remove(self, node: str) -> None:
+        if node not in self._nodes:
+            return
+        self._nodes.discard(node)
+        self._points = [p for p in self._points if p[1] != node]
+
+    def lookup(self, key: str) -> "str | None":
+        """The node owning ``key``: first ring point at or after the
+        key's position, wrapping. None on an empty ring."""
+        if not self._points:
+            return None
+        i = bisect_right(self._points, (_h(key), "￿"))
+        return self._points[i % len(self._points)][1]
+
+    def iter_nodes(self, key: str):
+        """Distinct nodes in ring order starting from ``key``'s owner —
+        the failover walk: the first yielded node is lookup(key), each
+        subsequent one is the next DIFFERENT replica clockwise, so a
+        saturated or dead owner has a deterministic successor."""
+        if not self._points:
+            return
+        start = bisect_right(self._points, (_h(key), "￿"))
+        seen: "set[str]" = set()
+        n = len(self._points)
+        for ofs in range(n):
+            node = self._points[(start + ofs) % n][1]
+            if node not in seen:
+                seen.add(node)
+                yield node

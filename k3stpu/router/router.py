@@ -1,0 +1,839 @@
+"""Session- and prefix-aware request router: the horizontal scale-out
+tier (docs/ROUTER.md).
+
+One inference pod cannot serve millions of users, and N pods behind a
+dumb Service are N cold caches: the prompt cache, COW prefix pages, and
+the host KV tier (docs/TIERING.md) are all strictly replica-local, so a
+warm turn landing on the wrong replica silently degrades to a cold
+prefill. This tier is the thin zero-dep layer that turns N replicas into
+N× capacity instead of N× cache misses — same stdlib-HTTP idiom as
+``serve/server.py``, no model, no jax, no device.
+
+Routing rules, in precedence order (see ``Router.route``):
+
+- **Sticky sessions.** A request carrying a ``session`` id routes to
+  the replica pinned for that session; the first turn is placed by
+  prefix hash and then pinned. ``POST /v1/session/release`` forwards to
+  the pinned replica and drops the pin — the drain/migration path (the
+  replica parks the chain in its host tier; the session's next turn
+  re-places and re-pins).
+- **Prefix affinity.** Sessionless requests consistent-hash on the
+  prompt prefix (first ``prefix_tokens`` tokens), so repeated and
+  shared-prefix prompts land where the cached pages live. The ring
+  (``ring.py``) bounds key movement under replica add/remove.
+- **Health + load.** A per-replica ``/healthz`` poller ejects failing
+  replicas from the ring and readmits them when they recover; proxy
+  attempts walk the ring past ejected/saturated replicas (bounded
+  in-flight per replica), and when the whole fleet is saturated the
+  router sheds with its own 503 + Retry-After — the same retryable
+  discipline loadgen already speaks.
+
+Cross-cutting invariants preserved across the hop:
+
+- **One trace per logical request**: the router forwards an inbound
+  ``traceparent`` unchanged, mints one only when absent, and echoes the
+  trace id on EVERY response it writes — its own 503s included.
+- **SSE streams relay unbuffered**, frame by frame, so TTFT survives
+  the extra hop; a replica dying mid-stream becomes a final
+  ``{"error": ...}`` frame (the headers are gone — no failover can
+  un-send them), while failures BEFORE any response bytes fail over to
+  the next replica.
+- **Replica identity**: the upstream's ``X-K3STPU-Replica`` header
+  passes through, so clients (and loadgen's per-replica report) can
+  name which replica actually served each request.
+
+Chaos point ``route_proxy`` fires per proxy attempt, standing in for a
+replica dying under an in-flight request (docs/RESILIENCE.md).
+
+Run: python -m k3stpu.router --replicas http://a:8096,http://b:8096
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k3stpu.chaos import InjectedFault
+from k3stpu.obs import (format_traceparent, new_span_id, new_trace_id,
+                        parse_traceparent)
+from k3stpu.router.obs import RouterObs
+from k3stpu.router.ring import HashRing
+
+REPLICA_HEADER = "X-K3STPU-Replica"
+
+# Fleet-saturated shed/backoff discipline — the same constants loadgen's
+# 503 retry chain uses, so a client backing off from the router behaves
+# exactly as it would backing off from a replica.
+_RETRY_AFTER_S = 1
+
+
+class FleetUnavailable(Exception):
+    """No replica could take the request: every healthy replica is
+    saturated, or none is healthy. The router's own 503 + Retry-After."""
+
+
+class Router:
+    """Membership, pins, and routing policy. The HTTP handler
+    (``make_router_app``) and the health poller both drive this; all
+    mutable state is guarded by one lock (routing decisions are
+    dict/ring lookups — never held across a proxy call)."""
+
+    def __init__(self, replicas: "list[str]", *,
+                 vnodes: int = 128,
+                 prefix_tokens: int = 16,
+                 max_inflight: int = 32,
+                 health_period_s: float = 1.0,
+                 health_timeout_s: float = 2.0,
+                 proxy_timeout_s: float = 120.0,
+                 policy: str = "affinity",
+                 instance: "str | None" = None,
+                 chaos=None):
+        if not replicas:
+            raise ValueError("router needs at least one replica URL")
+        if policy not in ("affinity", "random"):
+            raise ValueError(f"unknown policy {policy!r}")
+        self.prefix_tokens = prefix_tokens
+        self.max_inflight = max_inflight
+        self.health_period_s = health_period_s
+        self.health_timeout_s = health_timeout_s
+        self.proxy_timeout_s = proxy_timeout_s
+        self.policy = policy
+        self._chaos = chaos  # k3stpu.chaos.FaultInjector | None
+        self._obs = RouterObs(instance=instance)
+        self._lock = threading.Lock()
+        self._ring = HashRing(vnodes=vnodes)
+        self._replicas = [r.rstrip("/") for r in replicas]
+        # Replicas start HEALTHY: a router booting ahead of its fleet
+        # would otherwise 503 everything until the first poll round, and
+        # the reactive ejection path corrects an optimistic start within
+        # one failed request anyway.
+        self._healthy: "dict[str, bool]" = {r: True for r in self._replicas}
+        self._inflight: "dict[str, int]" = {r: 0 for r in self._replicas}
+        for r in self._replicas:
+            self._ring.add(r)
+        self._obs.on_membership(len(self._replicas))
+        # session id -> replica URL. A pin survives its replica's
+        # eject/readmit cycle untouched; it MOVES only when a turn
+        # actually lands elsewhere (the chain then lives there) and is
+        # DROPPED on /v1/session/release (the chain is parked — the next
+        # turn re-places by prefix).
+        self._pins: "dict[str, str]" = {}
+        self._draining = False
+        self._active_http = 0
+        self._rr = 0  # random-policy cursor (deterministic round-robin)
+        self._poller: "threading.Thread | None" = None
+        self._poller_stop = threading.Event()
+
+    # -- membership --------------------------------------------------------
+
+    def replicas(self) -> "list[str]":
+        return list(self._replicas)
+
+    def healthy_replicas(self) -> "list[str]":
+        with self._lock:
+            return [r for r in self._replicas if self._healthy[r]]
+
+    def eject(self, replica: str, reason: str = "") -> None:
+        """Remove a replica from routing (health-poll failure or a fatal
+        proxy error). Idempotent; pins into it stay — see _pins."""
+        with self._lock:
+            if not self._healthy.get(replica, False):
+                return
+            self._healthy[replica] = False
+            self._ring.remove(replica)
+            healthy = sum(self._healthy.values())
+        self._obs.on_eject(replica)
+        self._obs.on_membership(healthy)
+        print(f"router: ejected {replica}"
+              + (f" ({reason})" if reason else ""), flush=True)
+
+    def readmit(self, replica: str) -> None:
+        with self._lock:
+            if self._healthy.get(replica, True):
+                return
+            self._healthy[replica] = True
+            self._ring.add(replica)
+            healthy = sum(self._healthy.values())
+        self._obs.on_membership(healthy)
+        print(f"router: readmitted {replica}", flush=True)
+
+    def start_health_poller(self) -> None:
+        """Background membership: GET /healthz per replica each period;
+        non-200/unreachable ejects, 200 readmits. One thread for the
+        whole fleet — at a handful of replicas, serial polls inside one
+        period are fine and keep ordering trivial."""
+        if self._poller is not None:
+            return
+        self._poller = threading.Thread(target=self._poll_loop, daemon=True,
+                                        name="router-health")
+        self._poller.start()
+
+    def stop_health_poller(self) -> None:
+        self._poller_stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=self.health_timeout_s
+                              + self.health_period_s + 1.0)
+            self._poller = None
+            self._poller_stop.clear()
+
+    def _poll_loop(self) -> None:
+        while not self._poller_stop.wait(self.health_period_s):
+            for r in self._replicas:
+                if self._poller_stop.is_set():
+                    return
+                if self._probe(r):
+                    self.readmit(r)
+                else:
+                    self.eject(r, "healthz failed")
+
+    def _probe(self, replica: str) -> bool:
+        try:
+            req = urllib.request.Request(replica + "/healthz")
+            with urllib.request.urlopen(
+                    req, timeout=self.health_timeout_s) as resp:
+                return resp.status == 200
+        except OSError:
+            return False
+
+    # -- drain (SIGTERM path, same contract as server.py) ------------------
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def begin_drain(self) -> None:
+        self._draining = True
+
+    def http_begin(self) -> None:
+        with self._lock:
+            self._active_http += 1
+
+    def http_end(self) -> None:
+        with self._lock:
+            self._active_http -= 1
+
+    def active_http_requests(self) -> int:
+        with self._lock:
+            return self._active_http
+
+    # -- routing -----------------------------------------------------------
+
+    @staticmethod
+    def prefix_key(body: "dict | None", raw: bytes,
+                   prefix_tokens: int) -> str:
+        """The consistent-hash key for a sessionless request: the first
+        ``prefix_tokens`` prompt tokens (the shared-system-prompt head —
+        exactly the span the prompt cache prefix-matches on), falling
+        back to the raw body head for non-generate shapes so /v1/predict
+        repeats still stick."""
+        if isinstance(body, dict):
+            pt = body.get("prompt_tokens")
+            if (isinstance(pt, list) and pt and isinstance(pt[0], list)):
+                return json.dumps(pt[0][:prefix_tokens])
+            tok = body.get("tokens")
+            if (isinstance(tok, list) and tok and isinstance(tok[0], list)):
+                return json.dumps(tok[0][:prefix_tokens])
+        return raw[:256].decode("utf-8", "replace")
+
+    def route(self, body: "dict | None", raw: bytes
+              ) -> "tuple[list[str], str, str | None]":
+        """The routing decision: ``(candidates, reason, session)``.
+
+        ``candidates`` is the ordered attempt list (affinity target
+        first, then the failover walk). ``reason`` names why the FIRST
+        candidate was chosen — attempts past it are failovers and
+        re-counted as such by the proxy loop. Raises FleetUnavailable
+        when no healthy replica exists."""
+        session = None
+        if isinstance(body, dict) and isinstance(body.get("session"), str):
+            session = body["session"]
+        key = self.prefix_key(body, raw, self.prefix_tokens)
+        with self._lock:
+            healthy = [r for r in self._replicas if self._healthy[r]]
+            if not healthy:
+                raise FleetUnavailable("no healthy replicas")
+            if self.policy == "random":
+                # The measured baseline (bench --serve-router): spread
+                # with no affinity at all. Deterministic round-robin —
+                # "random" names the policy's cache behavior, and a
+                # seeded cursor keeps the bench reproducible.
+                self._rr += 1
+                start = self._rr % len(healthy)
+                return (healthy[start:] + healthy[:start], "prefix",
+                        session)
+            walk = list(self._ring.iter_nodes(key))
+            if session is not None:
+                pinned = self._pins.get(session)
+                if pinned is not None and self._healthy.get(pinned, False):
+                    rest = [r for r in walk if r != pinned]
+                    return [pinned] + rest, "session", session
+                if pinned is not None:
+                    # Pin target is ejected: the turn must land somewhere
+                    # — a rebalance. The pin moves to wherever it lands
+                    # (commit_route), because that replica now holds the
+                    # freshest chain.
+                    return walk, "rebalance", session
+                return walk, "prefix", session
+            return walk, "prefix", session
+
+    def commit_route(self, session: "str | None", replica: str) -> None:
+        """A request SERVED on ``replica``: pin (or move) its session
+        there. Called after the proxy attempt succeeds — pinning on the
+        attempt would stick sessions to replicas that failed."""
+        if session is None:
+            return
+        with self._lock:
+            self._pins[session] = replica
+            pinned = len(self._pins)
+        self._obs.on_pins(pinned)
+
+    def drop_pin(self, session: str) -> "str | None":
+        """/v1/session/release: forget the pin (the chain is parked in
+        the replica's host tier; the next turn re-places). Returns the
+        replica it pointed at, for forwarding the release."""
+        with self._lock:
+            replica = self._pins.pop(session, None)
+            pinned = len(self._pins)
+        self._obs.on_pins(pinned)
+        return replica
+
+    def pinned_replica(self, session: str) -> "str | None":
+        with self._lock:
+            return self._pins.get(session)
+
+    def acquire(self, replica: str) -> bool:
+        """Bounded in-flight admission: False when the replica is at its
+        cap (the proxy walk then tries the next candidate)."""
+        with self._lock:
+            if self._inflight[replica] >= self.max_inflight:
+                return False
+            self._inflight[replica] += 1
+            return True
+
+    def release(self, replica: str) -> None:
+        with self._lock:
+            self._inflight[replica] -= 1
+
+    def state(self) -> dict:
+        """The /debug/router payload: live membership and pin table —
+        what the chaos tests (and operators) assert against."""
+        with self._lock:
+            return {
+                "replicas": [
+                    {"url": r, "healthy": self._healthy[r],
+                     "inflight": self._inflight[r]}
+                    for r in self._replicas],
+                "policy": self.policy,
+                "sessions_pinned": len(self._pins),
+                "pins": dict(self._pins),
+                "draining": self._draining,
+            }
+
+    def close(self) -> None:
+        self.stop_health_poller()
+
+
+def make_router_app(router: Router):
+    """Returns the BaseHTTPRequestHandler class bound to ``router`` —
+    the same handler idiom as server.py's make_app, minus the model."""
+
+    class Handler(BaseHTTPRequestHandler):
+        # W3C trace context for the CURRENT request: (trace_id,
+        # parent_span_id | None), set at the top of do_POST/do_GET.
+        _trace_ctx: "tuple[str, str | None] | None" = None
+        # The raw inbound traceparent (None when absent/malformed): the
+        # router forwards THIS unchanged — minting a fresh parent here
+        # would orphan the replica's spans from the client's trace.
+        _inbound_tp: "str | None" = None
+
+        def _begin_trace(self) -> None:
+            raw = self.headers.get("traceparent")
+            parsed = parse_traceparent(raw)
+            if parsed is not None:
+                self._trace_ctx, self._inbound_tp = parsed, raw
+            else:
+                self._trace_ctx = (new_trace_id(), None)
+                self._inbound_tp = None
+
+        def _trace_id(self) -> "str | None":
+            return self._trace_ctx[0] if self._trace_ctx else None
+
+        def _upstream_traceparent(self) -> str:
+            """The traceparent forwarded to the replica: the inbound
+            header verbatim when one came (passthrough — mint only when
+            absent), else a fresh one under this request's minted id."""
+            if self._inbound_tp is not None:
+                return self._inbound_tp
+            return format_traceparent(self._trace_ctx[0], new_span_id())
+
+        def _trace_headers(self) -> None:
+            """Echo the trace id on EVERY response the router writes —
+            its own 503s included — so a shed request still joins the
+            client's log against the fleet's traces."""
+            if self._trace_ctx is not None:
+                self.send_header("traceparent", format_traceparent(
+                    self._trace_ctx[0], new_span_id()))
+
+        def _send(self, code: int, payload: dict,
+                  headers: "dict | None" = None):
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self._trace_headers()
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # quiet; state lives in /debug/router
+            pass
+
+        # -- GET: the router's own control surface -------------------------
+
+        def do_GET(self):
+            self._begin_trace()
+            if self.path == "/healthz":
+                # READINESS: the router is useful iff it can route —
+                # zero healthy replicas or draining pulls it from
+                # Service rotation with the standard retryable shape.
+                healthy = len(router.healthy_replicas())
+                if router.draining or healthy == 0:
+                    reason = ("draining" if router.draining
+                              else "no healthy replicas")
+                    self._send(503, {"ok": False, "reason": reason},
+                               headers={"Retry-After": str(_RETRY_AFTER_S)})
+                    return
+                self._send(200, {"ok": True, "replicas_healthy": healthy})
+            elif self.path == "/livez":
+                # LIVENESS: process-up only, fleet-blind — restarting
+                # the router because its REPLICAS are sick would take
+                # down the one component that can still shed cleanly.
+                self._send(200, {"ok": True})
+            elif self.path == "/metrics":
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    body = router._obs.render_openmetrics().encode()
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    body = router._obs.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif self.path == "/debug/router":
+                self._send(200, router.state())
+            elif self.path.startswith("/v1/"):
+                self._proxy_get()
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+        def _proxy_get(self) -> None:
+            """Read-only fan-in (/v1/models and friends): any healthy
+            replica can answer, so walk them and forward the first
+            response — loadgen pointed at the router fetches its model
+            card through here."""
+            last_err: "Exception | None" = None
+            for replica in router.healthy_replicas():
+                req = urllib.request.Request(
+                    replica + self.path,
+                    headers={"traceparent": self._upstream_traceparent()})
+                try:
+                    with urllib.request.urlopen(
+                            req, timeout=router.proxy_timeout_s) as r:
+                        self._forward_response(r.status, dict(r.headers),
+                                               r.read())
+                    return
+                except urllib.error.HTTPError as e:
+                    with e:
+                        self._forward_response(e.code, dict(e.headers),
+                                               e.read())
+                    return
+                except OSError as e:
+                    last_err = e
+            self._send(503, {"error": "no healthy replica answered GET "
+                                      f"{self.path}: {last_err}"},
+                       headers={"Retry-After": str(_RETRY_AFTER_S)})
+
+        # -- POST: the proxied data plane ------------------------------------
+
+        def do_POST(self):
+            self._begin_trace()
+            if not self.path.startswith("/v1/"):
+                self._send(404, {"error": f"no route {self.path}"})
+                return
+            if router.draining:
+                self._send(503, {"error": "router draining"},
+                           headers={"Retry-After": str(_RETRY_AFTER_S)})
+                return
+            router.http_begin()
+            try:
+                self._route_post()
+            finally:
+                router.http_end()
+
+        def _route_post(self):
+            length = int(self.headers.get("Content-Length", "0"))
+            raw = self.rfile.read(length)
+            try:
+                body = json.loads(raw) if raw else None
+            except json.JSONDecodeError:
+                body = None  # opaque bodies still route (by raw-head hash)
+
+            if self.path == "/v1/session/release":
+                self._release_session(body, raw)
+                return
+
+            t0 = time.perf_counter()
+            try:
+                candidates, reason, session = router.route(body, raw)
+            except FleetUnavailable as e:
+                router._obs.on_reject()
+                self._send(503, {"error": str(e)},
+                           headers={"Retry-After": str(_RETRY_AFTER_S)})
+                return
+            router._obs.on_route(reason)
+            self._proxy(candidates, session, raw, t0)
+
+        def _release_session(self, body, raw: bytes) -> None:
+            """Drain/migration path: forward the release to the pinned
+            replica and drop the pin. An unpinned session (router
+            restart, pin already dropped) broadcasts — some replica may
+            still hold the chain, and release is idempotent on the
+            rest."""
+            session = (body or {}).get("session")
+            if not isinstance(session, str) or not session:
+                self._send(400, {"error": "session must be a non-empty "
+                                          "string"})
+                return
+            pinned = router.drop_pin(session)
+            targets = ([pinned] if pinned is not None
+                       else router.healthy_replicas())
+            if not targets:
+                router._obs.on_reject()
+                self._send(503, {"error": "no healthy replicas"},
+                           headers={"Retry-After": str(_RETRY_AFTER_S)})
+                return
+            released, last_err, served_by = False, None, None
+            for replica in targets:
+                try:
+                    code, headers, data = self._upstream_json(replica, raw)
+                    if code == 200:
+                        doc = json.loads(data)
+                        released = released or bool(doc.get("released"))
+                        served_by = headers.get(REPLICA_HEADER, served_by)
+                    else:
+                        last_err = (code, data)
+                except OSError as e:
+                    last_err = (503, json.dumps(
+                        {"error": f"replica unreachable: {e}"}).encode())
+            if last_err is not None and not released and served_by is None:
+                code, data = last_err
+                self._forward_response(code, {}, data)
+                return
+            hdrs = ({REPLICA_HEADER: served_by} if served_by else None)
+            self._send(200, {"released": released}, headers=hdrs)
+
+        def _upstream_json(self, replica: str, raw: bytes
+                           ) -> "tuple[int, dict, bytes]":
+            """One non-streaming upstream POST: (status, headers, body).
+            HTTPError is a RESPONSE here (4xx/5xx carry a JSON body the
+            client deserves to see), not an exception."""
+            req = urllib.request.Request(
+                replica + self.path, data=raw, method="POST",
+                headers={"Content-Type": "application/json",
+                         "traceparent": self._upstream_traceparent()})
+            try:
+                with urllib.request.urlopen(
+                        req, timeout=router.proxy_timeout_s) as r:
+                    return r.status, dict(r.headers), r.read()
+            except urllib.error.HTTPError as e:
+                with e:
+                    return e.code, dict(e.headers), e.read()
+
+        def _proxy(self, candidates: "list[str]", session: "str | None",
+                   raw: bytes, t0: float) -> None:
+            """The attempt walk: try each candidate in ring order,
+            failing over past dead/saturated/draining replicas. The
+            router-added latency (everything here EXCEPT the upstream
+            call itself) feeds the proxy-overhead histogram."""
+            chaos = router._chaos
+            stream = self._wants_stream(raw)
+            saturated = True  # all skips were admission-bound?
+            last_err: "tuple[int, bytes] | None" = None
+            for replica in candidates:
+                if not router.acquire(replica):
+                    continue
+                saturated = False
+                try:
+                    if chaos is not None:
+                        # route_proxy: a replica dying under an in-flight
+                        # request, at the last instant the router can
+                        # still fail over (docs/RESILIENCE.md).
+                        chaos.fire("route_proxy")
+                    if stream:
+                        # Streaming overhead is the pre-dispatch prelude
+                        # only — once frames flow, router time and
+                        # replica time interleave inseparably.
+                        self._relay_sse(replica, raw,
+                                        time.perf_counter() - t0)
+                        router.commit_route(session, replica)
+                        return
+                    t1 = time.perf_counter()
+                    code, headers, data = self._upstream_json(replica, raw)
+                    t2 = time.perf_counter()
+                    if code == 503:
+                        # Retryable by contract (draining / overloaded /
+                        # breaker): the next replica gets the request
+                        # NOW — the Retry-After dance is for clients
+                        # with nowhere else to go; the router has
+                        # somewhere else to go.
+                        router._obs.on_failover(replica)
+                        last_err = (code, data)
+                        continue
+                    router.commit_route(session, replica)
+                    self._forward_response(code, headers, data)
+                    # Router-added latency: whole handler time minus the
+                    # upstream call — routing, body parse, and both
+                    # forwarding legs.
+                    router._obs.on_proxy(
+                        replica, (time.perf_counter() - t0) - (t2 - t1))
+                    return
+                except (OSError, InjectedFault) as e:
+                    # Connect refused / reset / timeout / injected fault:
+                    # the replica is gone under us. Eject it (the poller
+                    # readmits on recovery) and fail over — the request
+                    # never reached a response, so a retry is safe.
+                    router._obs.on_failover(replica)
+                    router.eject(replica, f"proxy error: {e}")
+                    last_err = (503, json.dumps(
+                        {"error": f"replica failed: {e}"}).encode())
+                    continue
+                finally:
+                    router.release(replica)
+            if saturated and last_err is None:
+                router._obs.on_reject()
+                self._send(503, {"error": "all replicas at max in-flight"},
+                           headers={"Retry-After": str(_RETRY_AFTER_S)})
+                return
+            code, data = last_err if last_err is not None else (
+                503, json.dumps({"error": "no healthy replicas"}).encode())
+            router._obs.on_reject()
+            self._forward_response(
+                code, {"Retry-After": str(_RETRY_AFTER_S)}, data)
+
+        @staticmethod
+        def _wants_stream(raw: bytes) -> bool:
+            try:
+                doc = json.loads(raw)
+                return bool(isinstance(doc, dict) and doc.get("stream"))
+            except json.JSONDecodeError:
+                return False
+
+        def _forward_response(self, code: int, headers, data: bytes
+                              ) -> None:
+            """Relay a complete upstream response: status + body verbatim,
+            plus the replica-identity header and the router's own
+            traceparent echo (the replica's echo is superseded — the
+            trace ID is the same; the span is the router's)."""
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self._trace_headers()
+            replica_id = (headers.get(REPLICA_HEADER)
+                          if hasattr(headers, "get") else None)
+            if replica_id:
+                self.send_header(REPLICA_HEADER, replica_id)
+            ra = headers.get("Retry-After") if hasattr(headers, "get") \
+                else None
+            if ra:
+                self.send_header("Retry-After", ra)
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _relay_sse(self, replica: str, raw: bytes,
+                       overhead_s: float) -> None:
+            """Unbuffered SSE relay: forward the upstream's event frames
+            line by line, flushing at each blank-line frame boundary, so
+            the client's TTFT is the replica's TTFT plus one hop — never
+            a full-response buffer. An upstream death mid-stream becomes
+            a final error frame (headers are sent; failover can't
+            un-send them); an upstream that fails BEFORE its headers
+            raises OSError back into the failover walk."""
+            req = urllib.request.Request(
+                replica + self.path, data=raw, method="POST",
+                headers={"Content-Type": "application/json",
+                         "traceparent": self._upstream_traceparent()})
+            try:
+                upstream = urllib.request.urlopen(
+                    req, timeout=router.proxy_timeout_s)
+            except urllib.error.HTTPError as e:
+                # Pre-stream upstream error (400/503 before any frame):
+                # forward or fail over via the non-stream machinery.
+                with e:
+                    code, headers, data = e.code, dict(e.headers), e.read()
+                if code == 503:
+                    raise ConnectionError(f"replica 503 pre-stream: "
+                                          f"{data[:200]!r}")
+                self._forward_response(code, headers, data)
+                return
+            with upstream:
+                if "text/event-stream" not in upstream.headers.get(
+                        "Content-Type", ""):
+                    # Replica answered non-stream (e.g. a 200 fallback
+                    # body): relay as a plain response.
+                    self._forward_response(upstream.status,
+                                           dict(upstream.headers),
+                                           upstream.read())
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self._trace_headers()
+                rid = upstream.headers.get(REPLICA_HEADER)
+                if rid:
+                    self.send_header(REPLICA_HEADER, rid)
+                self.end_headers()
+                router._obs.on_proxy(replica, overhead_s)
+                # Upstream reads and client writes fail with the SAME
+                # exception types (a reset is a reset), so each leg gets
+                # its own handler: an upstream death becomes a terminal
+                # error frame, a client death just ends the relay.
+                try:
+                    while True:
+                        try:
+                            line = upstream.readline()
+                        except OSError as e:
+                            # Upstream died mid-stream: clean error
+                            # propagation (the idempotent-unsafe case —
+                            # frames already reached the client).
+                            router._obs.on_failover(replica)
+                            router.eject(replica, f"mid-stream death: {e}")
+                            self.wfile.write(
+                                b"data: " + json.dumps(
+                                    {"error": "replica failed mid-"
+                                              f"stream: {e}"}).encode()
+                                + b"\n\n")
+                            self.wfile.flush()
+                            return
+                        if not line:
+                            break
+                        self.wfile.write(line)
+                        if line == b"\n":  # frame boundary: release it
+                            self.wfile.flush()
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    return  # client went away; upstream closes via with
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="K3S-TPU session/prefix-aware request router")
+    ap.add_argument("--port", type=int, default=8095)
+    ap.add_argument("--replicas", required=True,
+                    help="comma-separated replica base URLs "
+                         "(http://host:port) — in k8s, the per-pod "
+                         "endpoints of the inference Service")
+    ap.add_argument("--vnodes", type=int, default=128,
+                    help="virtual nodes per replica on the consistent-"
+                         "hash ring (more = smoother spread, slower "
+                         "membership change)")
+    ap.add_argument("--prefix-tokens", type=int, default=16,
+                    help="prompt-prefix length hashed for sessionless "
+                         "affinity — match the shared-system-prompt "
+                         "span you want to stick")
+    ap.add_argument("--max-inflight", type=int, default=32,
+                    help="per-replica in-flight cap; when every healthy "
+                         "replica is at cap new work sheds with 503 + "
+                         "Retry-After")
+    ap.add_argument("--health-period-s", type=float, default=1.0,
+                    help="per-replica /healthz poll period "
+                         "(eject/readmit cadence)")
+    ap.add_argument("--health-timeout-s", type=float, default=2.0)
+    ap.add_argument("--proxy-timeout-s", type=float, default=120.0,
+                    help="upstream request timeout; must exceed the "
+                         "slowest whole generation you intend to serve")
+    ap.add_argument("--policy", default="affinity",
+                    choices=["affinity", "random"],
+                    help="'affinity' = sticky sessions + prefix hash "
+                         "(production); 'random' = spread with no "
+                         "affinity (the bench baseline)")
+    ap.add_argument("--instance", default=None,
+                    help="replica-identity stamp for k3stpu_build_info "
+                         "(default: hostname)")
+    ap.add_argument("--drain-deadline-s", type=float, default=25.0,
+                    help="on SIGTERM: wait at most this long for "
+                         "in-flight proxies before stopping the "
+                         "listener; keep it below the pod's "
+                         "terminationGracePeriodSeconds")
+    args = ap.parse_args(argv)
+
+    from k3stpu.chaos import chaos_from_env
+
+    router = Router(
+        [r for r in args.replicas.split(",") if r.strip()],
+        vnodes=args.vnodes, prefix_tokens=args.prefix_tokens,
+        max_inflight=args.max_inflight,
+        health_period_s=args.health_period_s,
+        health_timeout_s=args.health_timeout_s,
+        proxy_timeout_s=args.proxy_timeout_s, policy=args.policy,
+        instance=args.instance, chaos=chaos_from_env())
+    router.start_health_poller()
+    httpd = ThreadingHTTPServer(("0.0.0.0", args.port),
+                                make_router_app(router))
+    # Non-daemon handler threads: server_close() joins them, which IS
+    # the "in-flight proxies finish" the drain promises (see server.py
+    # main() for the full rationale).
+    httpd.daemon_threads = False
+
+    import signal
+
+    draining = {"on": False}
+
+    def _drain(signum, frame):
+        if draining["on"]:
+            print(f"signal {signum} again: next one is fatal", flush=True)
+            signal.signal(signum, signal.SIG_DFL)
+            return
+        draining["on"] = True
+        router.begin_drain()
+        print(f"signal {signum}: draining (no new proxies; in-flight "
+              "requests finish)...", flush=True)
+
+        def _drainer():
+            deadline = time.monotonic() + args.drain_deadline_s
+            while (router.active_http_requests() > 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.05)
+            if router.active_http_requests() > 0:
+                print(f"drain deadline ({args.drain_deadline_s:.0f}s) "
+                      f"passed with proxies in flight; stopping anyway",
+                      flush=True)
+            httpd.shutdown()
+
+        threading.Thread(target=_drainer, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _drain)
+    signal.signal(signal.SIGINT, _drain)
+    print(f"routing {len(router.replicas())} replicas on :{args.port} "
+          f"(policy={args.policy})", flush=True)
+    httpd.serve_forever()
+    httpd.server_close()
+    router.close()
+    print("drained; bye", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

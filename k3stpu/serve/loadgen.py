@@ -13,7 +13,11 @@ one command produces a number on any box (CPU CI or a TPU pod):
     python -m k3stpu.serve.loadgen --model transformer --clients 8 \
         --seconds 10 --batch-window-ms 5
 
-Point it at a live server instead with --url http://host:8096.
+Point it at a live server instead with --url http://host:8096, or at a
+fleet with --endpoints http://a:8096,http://b:8096 (replicas for the
+client-side spread, or ONE router URL for the routed comparison) — the
+result then breaks p50/p95/p99 out per replica, keyed by each
+response's X-K3STPU-Replica header.
 Emits one LOADGEN_JSON line (pod-log interface, like the probe).
 """
 
@@ -62,7 +66,8 @@ class ClientTraces:
 
     def finish(self, tr, ok: bool, latency_s: "float | None",
                ttft_s: "float | None", attempts: int,
-               error: "str | None" = None) -> None:
+               error: "str | None" = None,
+               replica: "str | None" = None) -> None:
         rec = {"rid": tr.rid, "trace_id": tr.trace_id, "ok": ok,
                "attempts": attempts}
         if latency_s is not None:
@@ -71,6 +76,8 @@ class ClientTraces:
             rec["ttft_ms"] = round(ttft_s * 1e3, 3)
         if error is not None:
             rec["error"] = error
+        if replica is not None:
+            rec["replica"] = replica
         with self._lock:
             self._records.append(rec)
         tr.finish("ok" if ok else "error", error)
@@ -113,7 +120,13 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
     Every logical request carries a ``traceparent``: one trace id for
     its whole life (503 retries INCLUDED — each retry is a new span id
     under the same trace, so the server-side 503 echoes and the final
-    success all correlate), recorded in ``traces`` when given."""
+    success all correlate), recorded in ``traces`` when given.
+
+    Each success records which replica served it (the
+    ``X-K3STPU-Replica`` response header — passed through by the router
+    tier, so this works one hop or two): ``latencies`` entries are
+    ``(latency_s, replica | None)`` pairs and ``traces`` records gain a
+    ``replica`` field, feeding the per-replica percentile report."""
     import urllib.request
 
     rng = random.Random(seed)
@@ -123,10 +136,10 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
     tr = None
     t_first_try = None
 
-    def _finish(ok, latency_s, ttft_s, error=None):
+    def _finish(ok, latency_s, ttft_s, error=None, replica=None):
         if tr is not None:
             traces.finish(tr, ok, latency_s, ttft_s, attempt + 1,
-                          error=error)
+                          error=error, replica=replica)
 
     while not stop.is_set():
         if trace_id is None:  # new logical request, not a 503 retry
@@ -139,8 +152,10 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                      "traceparent": format_traceparent(trace_id,
                                                        new_span_id())})
         t0 = time.perf_counter()
+        replica = None
         try:
             with urllib.request.urlopen(req, timeout=300) as r:
+                replica = r.headers.get("X-K3STPU-Replica")
                 if tr is not None:
                     tr.t_admit = tr.event("response_headers")
                 if ttfts is None:
@@ -198,24 +213,32 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                 return  # persistently failing client stops; others continue
             continue
         latency = time.perf_counter() - t0
-        _finish(True, latency, ttft)
+        _finish(True, latency, ttft, replica=replica)
         trace_id = tr = None
         attempt = 0
         my_errors = 0  # consecutive-failure counter: success resets it
         with lock:
-            latencies.append(latency)
+            latencies.append((latency, replica))
             if ttft is not None:
                 ttfts.append(ttft)
 
 
-def run_load(url: str, *, clients: int, seconds: float, rows: int,
-             input_shape: "tuple[int, ...]", input_dtype: str,
+def run_load(url: "str | list[str]", *, clients: int, seconds: float,
+             rows: int, input_shape: "tuple[int, ...]", input_dtype: str,
              generate_tokens: int = 0, stream: bool = False,
              traces: "ClientTraces | None" = None) -> dict:
     """``generate_tokens > 0`` switches to /v1/generate load (each request
     one ragged prompt, ``generate_tokens`` new tokens) — the decode-loop
     workload the continuous-batching engine schedules. ``stream`` rides
-    the SSE route and adds time-to-first-token percentiles."""
+    the SSE route and adds time-to-first-token percentiles.
+
+    ``url`` may be a list (--endpoints): client i sticks to endpoint
+    ``i % len(urls)`` for its whole run — the dumb client-side spread the
+    router tier is measured against. Either way, every success is
+    attributed to the replica named by its ``X-K3STPU-Replica`` header
+    and the result carries per-replica percentiles alongside the
+    aggregate."""
+    urls = [url] if isinstance(url, str) else list(url)
     rng = np.random.default_rng(0)
     ttfts: "list[float] | None" = None
     if generate_tokens > 0:
@@ -236,15 +259,15 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         payload = json.dumps({"inputs": block.tolist()}).encode()
         route = "/v1/predict"
 
-    latencies: list[float] = []
+    latencies: "list[tuple[float, str | None]]" = []
     errors: list[str] = []
     retry_stats = {"retries": 0, "gave_up": 0}
     lock = threading.Lock()
     stop = threading.Event()
     threads = [threading.Thread(
-        target=_client_loop, args=(url, payload, stop, latencies, lock,
-                                   errors, route, ttfts, retry_stats, i,
-                                   traces),
+        target=_client_loop,
+        args=(urls[i % len(urls)], payload, stop, latencies, lock,
+              errors, route, ttfts, retry_stats, i, traces),
         daemon=True)
         for i in range(clients)]
     t0 = time.perf_counter()
@@ -262,10 +285,11 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
     def pct(sorted_ms: "list[float]", q: float) -> float:
         return sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))]
 
-    lat_ms = sorted(1e3 * l for l in latencies)
+    lat_ms = sorted(1e3 * l for l, _ in latencies)
     pick = lambda q: pct(lat_ms, q)
     out = {
         "clients": clients,
+        "endpoints": len(urls),
         "rows_per_request": rows,
         "wall_s": round(wall, 2),
         "requests": len(lat_ms),
@@ -287,6 +311,17 @@ def run_load(url: str, *, clients: int, seconds: float, rows: int,
         out["ttft_p50_ms"] = round(pct(tt, 0.50), 2)
         out["ttft_p95_ms"] = round(pct(tt, 0.95), 2)
         out["ttft_p99_ms"] = round(pct(tt, 0.99), 2)
+    by_replica: "dict[str, list[float]]" = {}
+    for lat, rep in latencies:
+        if rep is not None:
+            by_replica.setdefault(rep, []).append(1e3 * lat)
+    if by_replica:
+        out["per_replica"] = {
+            rep: {"requests": len(ms),
+                  "p50_ms": round(pct(sorted(ms), 0.50), 2),
+                  "p95_ms": round(pct(sorted(ms), 0.95), 2),
+                  "p99_ms": round(pct(sorted(ms), 0.99), 2)}
+            for rep, ms in sorted(by_replica.items())}
     return out
 
 
@@ -361,23 +396,29 @@ def _session_loop(url: str, idx: int, turns: int, rows: int,
                 return
 
 
-def run_sessions(url: str, *, sessions: int, turns: int, rows: int,
-                 gen_tokens: int, release: bool = True) -> dict:
+def run_sessions(url: "str | list[str]", *, sessions: int, turns: int,
+                 rows: int, gen_tokens: int, release: bool = True) -> dict:
     """Multi-turn session load: N concurrent sessions x K turns each,
     session ids carried across turns (the first client of the session-id
     API). ``release`` parks each chain between turns via
     /v1/session/release — against a --tier-host-mb server the next turn
     swaps it back in (warm TTFT ~ suffix prefill + restore), against a
     tierless one the chain is dropped (warm TTFT ~ full re-prefill):
-    the warm/turn-1 TTFT pair IS the tiering measurement."""
+    the warm/turn-1 TTFT pair IS the tiering measurement.
+
+    With a URL list, session i lives entirely on endpoint
+    ``i % len(urls)`` — a session split across endpoints would be a
+    cache miss on every turn, which is the router's problem to solve,
+    not the client's."""
+    urls = [url] if isinstance(url, str) else list(url)
     turn1: "list[float]" = []
     warm: "list[float]" = []
     errors: "list[str]" = []
     lock = threading.Lock()
     threads = [threading.Thread(
         target=_session_loop,
-        args=(url, i, turns, rows, gen_tokens, release, lock, turn1,
-              warm, errors),
+        args=(urls[i % len(urls)], i, turns, rows, gen_tokens, release,
+              lock, turn1, warm, errors),
         daemon=True) for i in range(sessions)]
     t0 = time.perf_counter()
     for t in threads:
@@ -500,6 +541,15 @@ def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description="inference-server load test")
     ap.add_argument("--url", default=None,
                     help="existing server; default self-hosts one in-process")
+    ap.add_argument("--endpoints", default=None, metavar="URL[,URL...]",
+                    help="comma-separated live endpoints — N replicas for "
+                         "a client-side spread (client i sticks to "
+                         "endpoint i %% N), or ONE router URL for the "
+                         "routed comparison. Every response's "
+                         "X-K3STPU-Replica header attributes the request, "
+                         "so the result (and each --json record) gains a "
+                         "per-replica p50/p95/p99 breakdown either way. "
+                         "Mutually exclusive with --url/self-hosting")
     ap.add_argument("--model", default="transformer",
                     choices=["resnet50", "resnet18-tiny", "transformer",
                              "transformer-medium", "transformer-tiny"])
@@ -599,6 +649,15 @@ def main(argv: "list[str] | None" = None) -> int:
                          "request, wall-anchored) to this file; merge with "
                          "the server's /debug/trace via tools/trace_merge.py")
     args = ap.parse_args(argv)
+    urls: "list[str] | None" = None
+    if args.endpoints:
+        if args.url:
+            ap.error("--endpoints and --url are mutually exclusive "
+                     "(one router URL goes in --endpoints)")
+        urls = [u.strip().rstrip("/")
+                for u in args.endpoints.split(",") if u.strip()]
+        if not urls:
+            ap.error("--endpoints needs at least one URL")
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
                  "generation-only)")
@@ -606,13 +665,13 @@ def main(argv: "list[str] | None" = None) -> int:
         if args.generate_tokens <= 0:
             ap.error("--sessions requires --generate-tokens (sessions "
                      "are a generate workload)")
-        if args.url is None and not (args.continuous_batching
-                                     and args.kv_page_size):
+        if args.url is None and urls is None \
+                and not (args.continuous_batching and args.kv_page_size):
             ap.error("--sessions self-hosting needs --continuous-"
                      "batching and --kv-page-size (session ids name "
                      "paged chains)")
 
-    url = args.url
+    url = args.url or (urls[0] if urls else None)
     card_url = None
     if url is None:
         from http.server import ThreadingHTTPServer
@@ -698,12 +757,12 @@ def main(argv: "list[str] | None" = None) -> int:
     traces = ClientTraces()
     if args.sessions:
         result = run_sessions(
-            url, sessions=args.sessions, turns=args.turns,
+            urls or url, sessions=args.sessions, turns=args.turns,
             rows=args.rows, gen_tokens=args.generate_tokens,
             release=not args.no_session_release)
     else:
         result = run_load(
-            url, clients=args.clients, seconds=args.seconds,
+            urls or url, clients=args.clients, seconds=args.seconds,
             rows=args.rows, input_shape=tuple(card["input_shape"]),
             input_dtype=card["input_dtype"],
             generate_tokens=args.generate_tokens, stream=args.stream,
@@ -751,6 +810,12 @@ def main(argv: "list[str] | None" = None) -> int:
             json.dump(traces.chrome_trace(), f)
         print(f"wrote client trace {args.trace_out}", flush=True)
     _print_quantile_skew(result)
+    if result.get("per_replica"):
+        print("per-replica latency (ms):", flush=True)
+        for rep, st in result["per_replica"].items():
+            print(f"  {rep}: {st['requests']} reqs  "
+                  f"p50 {st['p50_ms']}  p95 {st['p95_ms']}  "
+                  f"p99 {st['p99_ms']}", flush=True)
     if result.get("spec_accepted_tokens_per_dispatch") is not None:
         print(f"spec: {result['spec_accepted_tokens_per_dispatch']} "
               f"accepted-tokens/dispatch over "

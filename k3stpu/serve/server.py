@@ -272,6 +272,7 @@ class InferenceServer:
                  watchdog_s: "float | None" = 120.0,
                  breaker_threshold: "int | None" = 5,
                  breaker_cooldown_s: float = 5.0,
+                 instance: "str | None" = None,
                  chaos=None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
@@ -283,6 +284,16 @@ class InferenceServer:
         self.model_name = model_name
         self.image_size = image_size
         self.seq_len = seq_len
+        # Replica identity (pod name or host:port): stamped on every
+        # HTTP response as X-K3STPU-Replica and — when explicitly
+        # configured — as the instance label on k3stpu_build_info, so
+        # the router tier, traces, and loadgen can name which replica
+        # served a request. The fallback hostname keeps the header
+        # meaningful for library/test constructions without touching
+        # their exposition's label set.
+        import socket
+
+        self.instance = instance or socket.gethostname()
         # Two locks with distinct jobs: _lock serializes DEVICE dispatch
         # ("one chip, one queue" — held for whole generations), while
         # _stats_lock guards only the counters, so /metrics scrapes and
@@ -299,7 +310,7 @@ class InferenceServer:
         # Request-lifecycle traces + latency histograms (k3stpu/obs).
         # ONE instance feeds /metrics, /debug/requests, /debug/trace —
         # and the engine loop's hooks when continuous batching is on.
-        self._obs = ServeObs()
+        self._obs = ServeObs(instance=instance)
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
         # Failure containment (docs/RESILIENCE.md): the engine-facing
         # knobs default ON here (the HTTP server is the production
@@ -1563,6 +1574,10 @@ def make_app(server: InferenceServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            # Replica identity on EVERY response (503s included): the
+            # router's failover accounting and loadgen's per-replica
+            # report both read it.
+            self.send_header("X-K3STPU-Replica", server.instance)
             self._trace_headers()
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
@@ -1584,6 +1599,7 @@ def make_app(server: InferenceServer):
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
             self.send_header("Connection", "close")
+            self.send_header("X-K3STPU-Replica", server.instance)
             self._trace_headers()
             self.end_headers()
             chaos = server._chaos
@@ -1650,6 +1666,7 @@ def make_app(server: InferenceServer):
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
+                self.send_header("X-K3STPU-Replica", server.instance)
                 self.end_headers()
                 self.wfile.write(body)
             elif self.path.startswith("/debug/requests"):
@@ -1831,6 +1848,15 @@ def start_telemetry_thread(server: InferenceServer,
     return t
 
 
+def _default_instance(port: int) -> str:
+    """hostname:port — in k8s the hostname is the pod name, so this is
+    already the unique replica identity; the port disambiguates several
+    servers sharing one host (tests, bench's in-process replicas)."""
+    import socket
+
+    return f"{socket.gethostname()}:{port}"
+
+
 def _chaos_from_env():
     """Fault injection for subprocess tests (K3STPU_CHAOS spec string —
     see k3stpu.chaos.chaos_from_env). Unset (the only production state)
@@ -1993,6 +2019,12 @@ def main(argv=None) -> int:
                          "listener. Keep it BELOW the pod's "
                          "terminationGracePeriodSeconds or the kubelet "
                          "SIGKILLs mid-drain")
+    ap.add_argument("--instance", default=None,
+                    help="replica identity (pod name or host:port) "
+                         "stamped on the k3stpu_build_info instance "
+                         "label and the X-K3STPU-Replica response "
+                         "header. Default: hostname:port — in k8s the "
+                         "hostname IS the pod name")
     ap.add_argument("--compilation-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache (volume mount): "
                          "a restarted pod reuses compiled programs instead "
@@ -2042,6 +2074,8 @@ def main(argv=None) -> int:
                              breaker_threshold=(args.breaker_threshold
                                                 or None),
                              breaker_cooldown_s=args.breaker_cooldown_s,
+                             instance=args.instance or _default_instance(
+                                 args.port),
                              chaos=_chaos_from_env())
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "

@@ -59,6 +59,7 @@ SMOKE=(
   tests/test_env.py tests/test_elastic.py
   tests/test_spec_engine.py
   tests/test_tiering.py
+  tests/test_router.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -93,6 +93,48 @@ def test_inference_speculate_flags_travel_together():
     assert cmd[cmd.index("--kv-page-size") + 1] == "64"
 
 
+def test_router_disabled_by_default():
+    # Same opt-in rule as the workloads: the scale-out tier is explicit,
+    # and the default golden rendering stays byte-stable.
+    objs = render()
+    assert ("Deployment", "tpu-router") not in objs
+    assert ("Service", "tpu-router") not in objs
+
+
+def test_router_enabled_wiring():
+    objs = render({"router.enabled": "true"}, namespace="route-ns")
+    dep = objs[("Deployment", "tpu-router")]
+    assert dep["metadata"]["namespace"] == "route-ns"
+    ann = dep["spec"]["template"]["metadata"]["annotations"]
+    assert ann["prometheus.io/scrape"] == "true"
+    assert ann["prometheus.io/path"] == "/metrics"
+    svc = objs[("Service", "tpu-router")]
+    (port,) = svc["spec"]["ports"]
+    assert ann["prometheus.io/port"] == str(port["port"]) == "8095"
+    pod = dep["spec"]["template"]["spec"]
+    (ctr,) = pod["containers"]
+    cmd = ctr["command"]
+    # Replica discovery defaults to the inference Service's in-namespace
+    # DNS name on the inference port — the values the two components
+    # must agree on.
+    assert cmd[cmd.index("--replicas") + 1] == "http://tpu-inference:8096"
+    assert cmd[cmd.index("--policy") + 1] == "affinity"
+    # Probe split mirrors the server: readiness can-route (/healthz),
+    # liveness process-up (/livez) — a sick FLEET must not restart the
+    # router.
+    assert ctr["readinessProbe"]["httpGet"]["path"] == "/healthz"
+    assert ctr["livenessProbe"]["httpGet"]["path"] == "/livez"
+    assert ctr["readinessProbe"]["httpGet"]["port"] == port["port"]
+    # SIGTERM pairing, same invariant as inference.
+    drain_s = float(cmd[cmd.index("--drain-deadline-s") + 1])
+    assert pod["terminationGracePeriodSeconds"] > drain_s
+    # Stateless and deviceless: no TPU resource, no runtimeClass, and
+    # rolling updates allowed (no Recreate pin).
+    assert "resources" not in ctr
+    assert "runtimeClassName" not in pod
+    assert dep["spec"].get("strategy") is None
+
+
 def test_train_disabled_by_default():
     # Same opt-in rule as inference: the chart installs infrastructure,
     # workloads are explicit, and the default golden stays byte-stable.
@@ -318,6 +360,12 @@ def _golden_case(name):
         # Likewise for the opt-in training workload: the only reviewable
         # rendering of the Service/PVC/Job triple with scrape annotations.
         "train.yaml": {"train.enabled": "true"},
+        # Scale-out tier (docs/ROUTER.md): the router Deployment/Service
+        # pair in front of the enabled inference fleet — rendered
+        # together since the router's default replica discovery names
+        # the inference Service.
+        "router.yaml": {"router.enabled": "true",
+                        "inference.enabled": "true"},
         # Fleet observability tier: node-exporter DaemonSet + SLO rules
         # ConfigMap + the tfd health-labeling wiring they switch on —
         # all off in the default golden, which stays byte-unchanged.
@@ -327,7 +375,7 @@ def _golden_case(name):
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
-                "train.yaml", "node-obs.yaml"]
+                "train.yaml", "node-obs.yaml", "router.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

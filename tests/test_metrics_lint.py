@@ -38,13 +38,16 @@ def _check(fams):
     """Run the rule engine over a synthetic family list."""
     real_obs = metrics_lint._families_from_obs
     real_srv = metrics_lint._families_from_server
+    real_rtr = metrics_lint._families_from_router
     metrics_lint._families_from_obs = lambda: fams
     metrics_lint._families_from_server = lambda: []
+    metrics_lint._families_from_router = lambda: []
     try:
         return metrics_lint.lint()
     finally:
         metrics_lint._families_from_obs = real_obs
         metrics_lint._families_from_server = real_srv
+        metrics_lint._families_from_router = real_rtr
 
 
 def _pad(fams):

@@ -1,0 +1,522 @@
+"""Router tier (k3stpu/router, docs/ROUTER.md): routing determinism,
+session affinity, health-driven membership, failover, and the trace /
+replica-identity invariants across the extra hop.
+
+Replicas here are scriptable in-thread HTTP stand-ins, not model
+servers — the router is deliberately model-blind, so these tests stay
+jax-free and SMOKE-fast. The contract they script (healthz/livez,
+X-K3STPU-Replica, SSE framing, 503 + Retry-After) is the one
+server.py's handler actually speaks, asserted by its own suite.
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k3stpu.obs import parse_traceparent
+from k3stpu.router import (
+    REPLICA_HEADER,
+    FleetUnavailable,
+    HashRing,
+    Router,
+    RouterObs,
+    make_router_app,
+)
+
+# --- scriptable replica ----------------------------------------------------
+
+
+class _ReplicaState:
+    def __init__(self, name):
+        self.name = name
+        self.healthy = True          # /healthz answer
+        self.refuse = False          # raise pre-response (connection dies)
+        self.answer_503 = False      # answer 503 + Retry-After
+        self.die_mid_stream = False  # SSE: stop after the first frame
+        self.lock = threading.Lock()
+        self.requests = []           # (path, body, traceparent) per POST
+        self.sessions_released = []
+
+    def served(self):
+        with self.lock:
+            return len(self.requests)
+
+
+def _make_replica(state: _ReplicaState):
+    class H(BaseHTTPRequestHandler):
+        def _send(self, code, doc, extra=None):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header(REPLICA_HEADER, state.name)
+            for k, v in (extra or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz":
+                if state.healthy:
+                    self._send(200, {"ok": True})
+                else:
+                    self._send(503, {"ok": False},
+                               extra={"Retry-After": "1"})
+            elif self.path == "/v1/models":
+                self._send(200, {"model": "scripted"})
+            else:
+                self._send(404, {"error": self.path})
+
+        def do_POST(self):
+            raw = self.rfile.read(
+                int(self.headers.get("Content-Length", "0")))
+            body = json.loads(raw) if raw else {}
+            with state.lock:
+                state.requests.append(
+                    (self.path, body, self.headers.get("traceparent")))
+            if state.refuse:
+                # Kill the connection before any response bytes: the
+                # failover-safe shape.
+                self.connection.close()
+                return
+            if state.answer_503:
+                self._send(503, {"error": "overloaded"},
+                           extra={"Retry-After": "1"})
+                return
+            if self.path == "/v1/session/release":
+                with state.lock:
+                    state.sessions_released.append(body.get("session"))
+                self._send(200, {"released": True})
+                return
+            if body.get("stream"):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header(REPLICA_HEADER, state.name)
+                self.end_headers()
+                self.wfile.write(b"data: " + json.dumps(
+                    {"tokens": [[1]], "done": False}).encode() + b"\n\n")
+                self.wfile.flush()
+                if state.die_mid_stream:
+                    # RST, not FIN: a crashing process aborts its
+                    # sockets — a clean close would read as normal EOF
+                    # on an EOF-delimited stream.
+                    import socket
+                    import struct
+                    self.connection.setsockopt(
+                        socket.SOL_SOCKET, socket.SO_LINGER,
+                        struct.pack("ii", 1, 0))
+                    self.connection.close()
+                    return
+                self.wfile.write(b"data: " + json.dumps(
+                    {"tokens": [[1, 2]], "done": True,
+                     "served_by": state.name}).encode() + b"\n\n")
+                self.wfile.flush()
+                return
+            self._send(200, {"ok": True, "served_by": state.name,
+                             "echo_traceparent":
+                                 self.headers.get("traceparent")})
+
+        def log_message(self, *args):
+            pass
+
+    return H
+
+
+@pytest.fixture
+def fleet():
+    """Two scripted replicas plus a router in front, all in-thread.
+    Yields (router_url, router, [state_a, state_b], poke)."""
+    states, httpds, urls = [], [], []
+    for name in ("rep-a", "rep-b"):
+        st = _ReplicaState(name)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), _make_replica(st))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        states.append(st)
+        httpds.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    router = Router(urls, health_period_s=0.1, health_timeout_s=1.0,
+                    proxy_timeout_s=10.0, instance="test-router")
+    rhttpd = ThreadingHTTPServer(("127.0.0.1", 0), make_router_app(router))
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    try:
+        yield (f"http://127.0.0.1:{rhttpd.server_address[1]}", router,
+               states, urls)
+    finally:
+        router.close()
+        rhttpd.shutdown()
+        for h in httpds:
+            h.shutdown()
+
+
+def _post(url, path, doc, headers=None, timeout=30):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _until(cond, timeout=10.0, every=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
+# --- ring determinism ------------------------------------------------------
+
+
+def test_ring_lookup_is_process_stable():
+    # Two independently built rings over the same members agree on every
+    # key — the property that lets N router pods converge on one map
+    # (sha256 positions, never the process-seeded builtin hash).
+    a, b = HashRing(), HashRing()
+    for node in ("r1", "r2", "r3"):
+        a.add(node)
+        b.add(node)
+    for i in range(500):
+        key = f"key-{i}"
+        assert a.lookup(key) == b.lookup(key)
+
+
+def test_ring_bounded_movement_on_remove_and_add():
+    ring = HashRing()
+    nodes = ["r1", "r2", "r3", "r4"]
+    for n in nodes:
+        ring.add(n)
+    keys = [f"key-{i}" for i in range(2000)]
+    before = {k: ring.lookup(k) for k in keys}
+    # Every node owns a meaningful share (vnodes smooth the spread).
+    share = {n: sum(1 for v in before.values() if v == n) for n in nodes}
+    assert min(share.values()) > len(keys) / len(nodes) / 2, share
+
+    ring.remove("r2")
+    after = {k: ring.lookup(k) for k in keys}
+    # The Karger property: ONLY keys that lived on the removed node move.
+    moved = [k for k in keys if before[k] != after[k]]
+    assert all(before[k] == "r2" for k in moved)
+    assert all(after[k] != "r2" for k in keys)
+
+    # Readmission restores the exact original map — eject/readmit round
+    # trips are lossless, so a flapping replica can't permanently scramble
+    # prefix affinity.
+    ring.add("r2")
+    assert {k: ring.lookup(k) for k in keys} == before
+
+
+def test_ring_failover_walk_starts_at_owner_and_covers_all():
+    ring = HashRing()
+    for n in ("r1", "r2", "r3"):
+        ring.add(n)
+    for i in range(50):
+        walk = list(ring.iter_nodes(f"key-{i}"))
+        assert walk[0] == ring.lookup(f"key-{i}")
+        assert sorted(walk) == ["r1", "r2", "r3"]  # distinct, complete
+
+
+def test_prefix_key_uses_token_head_and_raw_fallback():
+    body = {"prompt_tokens": [[7] * 40], "max_new_tokens": 4}
+    k1 = Router.prefix_key(body, b"", prefix_tokens=16)
+    # Same head, different tail -> same key (the shared-system-prompt
+    # span sticks); different head -> different key.
+    body2 = {"prompt_tokens": [[7] * 16 + [9] * 24]}
+    assert Router.prefix_key(body2, b"", 16) == k1
+    body3 = {"prompt_tokens": [[8] * 40]}
+    assert Router.prefix_key(body3, b"", 16) != k1
+    # Opaque bodies still route deterministically by raw head.
+    assert (Router.prefix_key(None, b"blob-head", 16)
+            == Router.prefix_key(None, b"blob-head", 16))
+
+
+# --- routing policy + pins (Router unit level) -----------------------------
+
+
+def test_session_pin_set_on_commit_and_survives_eject_readmit():
+    router = Router(["http://a", "http://b"])
+    raw = json.dumps({"session": "s1",
+                      "prompt_tokens": [[1, 2, 3]]}).encode()
+    body = json.loads(raw)
+    cands, reason, session = router.route(body, raw)
+    assert session == "s1" and reason == "prefix"  # first turn: placed
+    router.commit_route(session, cands[0])
+    pinned = router.pinned_replica("s1")
+    assert pinned == cands[0]
+
+    # Pinned turn: pinned replica leads, reason says so.
+    cands2, reason2, _ = router.route(body, raw)
+    assert cands2[0] == pinned and reason2 == "session"
+
+    # Eject the pinned replica: the turn rebalances, but the PIN is kept
+    # (no traffic landed elsewhere — the chain still lives there).
+    router.eject(pinned, "test")
+    cands3, reason3, _ = router.route(body, raw)
+    assert reason3 == "rebalance" and pinned not in cands3
+    assert router.pinned_replica("s1") == pinned
+
+    # Readmit with no traffic in between: stickiness fully restored.
+    router.readmit(pinned)
+    cands4, reason4, _ = router.route(body, raw)
+    assert cands4[0] == pinned and reason4 == "session"
+
+    # A turn actually SERVED elsewhere moves the pin (freshest chain).
+    router.eject(pinned, "test")
+    cands5, _, _ = router.route(body, raw)
+    router.commit_route("s1", cands5[0])
+    assert router.pinned_replica("s1") == cands5[0] != pinned
+
+
+def test_route_raises_when_no_replica_is_healthy():
+    router = Router(["http://a", "http://b"])
+    router.eject("http://a", "t")
+    router.eject("http://b", "t")
+    with pytest.raises(FleetUnavailable):
+        router.route({"prompt_tokens": [[1]]}, b"{}")
+
+
+def test_random_policy_round_robins_and_sessionless_affinity_sticks():
+    router = Router(["http://a", "http://b"], policy="random")
+    firsts = {router.route(None, b"same-body")[0][0] for _ in range(4)}
+    assert firsts == {"http://a", "http://b"}  # spread, no affinity
+    sticky = Router(["http://a", "http://b"])
+    firsts = {sticky.route(None, b"same-body")[0][0] for _ in range(4)}
+    assert len(firsts) == 1  # prefix affinity: same body, same replica
+
+
+def test_inflight_admission_bounds():
+    router = Router(["http://a"], max_inflight=2)
+    assert router.acquire("http://a")
+    assert router.acquire("http://a")
+    assert not router.acquire("http://a")  # at cap
+    router.release("http://a")
+    assert router.acquire("http://a")
+
+
+# --- HTTP end-to-end -------------------------------------------------------
+
+
+def test_traceparent_passthrough_router_to_replica_to_response(fleet):
+    url, _router, states, _urls = fleet
+    inbound = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    with _post(url, "/v1/generate", {"prompt_tokens": [[1, 2, 3]]},
+               headers={"traceparent": inbound}) as r:
+        doc = json.loads(r.read())
+        echoed = r.headers.get("traceparent")
+    # The replica received the CLIENT's traceparent verbatim (the router
+    # forwards, never re-mints an existing trace)...
+    assert doc["echo_traceparent"] == inbound
+    # ...and the router's response echo carries the same trace id with a
+    # router span.
+    tid, _sid = parse_traceparent(echoed)
+    assert tid == "ab" * 16
+    # Replica identity passes through.
+    assert r.headers.get(REPLICA_HEADER) in {"rep-a", "rep-b"}
+
+
+def test_router_mints_trace_when_absent_and_echoes_own_503(fleet):
+    url, router, states, _urls = fleet
+    with _post(url, "/v1/generate", {"prompt_tokens": [[5, 5]]}) as r:
+        doc = json.loads(r.read())
+    upstream_tp = doc["echo_traceparent"]
+    assert parse_traceparent(upstream_tp) is not None  # minted, valid
+    # The router's own 503 (whole fleet down) still echoes a trace id
+    # and speaks the retryable shape.
+    router.eject(_urls[0], "t")
+    router.eject(_urls[1], "t")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, "/v1/generate", {"prompt_tokens": [[5]]})
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After")
+    assert parse_traceparent(ei.value.headers.get("traceparent"))
+
+
+def test_sticky_session_over_http_and_release_drops_pin(fleet):
+    url, router, states, urls = fleet
+    body = {"prompt_tokens": [[3, 1, 4, 1, 5]], "session": "chat-1"}
+    served = []
+    for _ in range(4):
+        with _post(url, "/v1/generate", body) as r:
+            served.append(json.loads(r.read())["served_by"])
+    assert len(set(served)) == 1  # every turn on the pinned replica
+    pinned_url = router.pinned_replica("chat-1")
+    assert pinned_url is not None
+
+    with _post(url, "/v1/session/release", {"session": "chat-1"}) as r:
+        assert json.loads(r.read())["released"] is True
+    assert router.pinned_replica("chat-1") is None
+    # The release reached exactly the replica that held the chain.
+    pinned_state = states[urls.index(pinned_url)]
+    assert pinned_state.sessions_released == ["chat-1"]
+
+
+def test_failover_on_dead_replica_and_readmit_after_recovery(fleet):
+    """The chaos acceptance shape: replica dies under load -> router
+    ejects it and fails over in-flight work; the fleet keeps serving;
+    the replica is readmitted once /healthz recovers."""
+    url, router, states, urls = fleet
+    body = {"prompt_tokens": [[2, 7, 1, 8]], "session": "s-fo"}
+    with _post(url, "/v1/generate", body) as r:
+        first = json.loads(r.read())["served_by"]
+    victim = states[0] if first == "rep-a" else states[1]
+    victim_url = urls[states.index(victim)]
+
+    # Kill it: connections die pre-response AND /healthz goes dark.
+    victim.refuse = True
+    victim.healthy = False
+    with _post(url, "/v1/generate", body) as r:
+        doc = json.loads(r.read())
+    assert doc["served_by"] != victim.name  # failed over, same request
+    # The failover target now holds the freshest chain: pin moved.
+    assert router.pinned_replica("s-fo") != victim_url
+    assert not any(rep["healthy"] for rep in router.state()["replicas"]
+                   if rep["url"] == victim_url)
+    # Fleet keeps serving while degraded.
+    with _post(url, "/v1/generate", body) as r:
+        assert json.loads(r.read())["served_by"] != victim.name
+
+    # Recovery: health poller readmits without operator action.
+    victim.refuse = False
+    victim.healthy = True
+    router.start_health_poller()
+    try:
+        assert _until(lambda: all(
+            rep["healthy"] for rep in router.state()["replicas"]))
+    finally:
+        router.stop_health_poller()
+
+
+def test_chaos_route_proxy_injects_failover(fleet):
+    url, router, states, _urls = fleet
+    from k3stpu.chaos import FaultInjector
+
+    chaos = FaultInjector()
+    chaos.arm("route_proxy", times=1)
+    router._chaos = chaos
+    with _post(url, "/v1/generate", {"prompt_tokens": [[9, 9]]}) as r:
+        doc = json.loads(r.read())
+    assert chaos.fired("route_proxy") == 1
+    # The injected first-attempt death failed over to a live replica;
+    # the first candidate was ejected on the way.
+    assert doc["ok"]
+    assert sum(1 for rep in router.state()["replicas"]
+               if not rep["healthy"]) == 1
+
+
+def test_sse_stream_relays_through_router(fleet):
+    url, _router, _states, _urls = fleet
+    frames = []
+    with _post(url, "/v1/generate",
+               {"prompt_tokens": [[6, 6]], "stream": True}) as r:
+        assert "text/event-stream" in r.headers.get("Content-Type")
+        assert r.headers.get(REPLICA_HEADER) in {"rep-a", "rep-b"}
+        for line in r:
+            if line.startswith(b"data: "):
+                frames.append(json.loads(line[6:]))
+    assert frames[-1]["done"] is True
+    assert frames[-1]["served_by"] == r.headers.get(REPLICA_HEADER)
+
+
+def test_sse_mid_stream_death_becomes_error_frame(fleet):
+    url, router, states, _urls = fleet
+    for st in states:
+        st.die_mid_stream = True
+    frames = []
+    with _post(url, "/v1/generate",
+               {"prompt_tokens": [[4, 2]], "stream": True}) as r:
+        for line in r:
+            if line.startswith(b"data: "):
+                frames.append(json.loads(line[6:]))
+    # Headers were sent before the death, so no failover: the client
+    # gets the frames that made it plus a terminal error frame (which
+    # loadgen's stream consumer already treats as a failed request).
+    assert any("error" in f for f in frames)
+    assert not frames[-1].get("done")
+
+
+def test_upstream_503_fails_over_before_shedding(fleet):
+    url, router, states, _urls = fleet
+    states[0].answer_503 = True
+    states[1].answer_503 = True
+    # Both replicas shed -> the router forwards the last 503 with
+    # Retry-After (the client's backoff discipline still works).
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, "/v1/generate", {"prompt_tokens": [[1, 1]]})
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After")
+    # One replica recovering is enough: the 503 from the first attempt
+    # fails over to the healthy one and the client sees a 200.
+    states[1].answer_503 = False
+    with _post(url, "/v1/generate", {"prompt_tokens": [[1, 1]]}) as r:
+        assert json.loads(r.read())["ok"]
+    # Both replicas were tried while both shed (failover, not instant
+    # give-up).
+    assert states[0].served() >= 1 and states[1].served() >= 1
+
+
+def test_saturated_fleet_sheds_503_with_retry_after(fleet):
+    url, router, _states, _urls = fleet
+    router.max_inflight = 0  # every acquire refuses: fully saturated
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(url, "/v1/generate", {"prompt_tokens": [[1]]})
+    assert ei.value.code == 503
+    assert ei.value.headers.get("Retry-After")
+    body = json.loads(ei.value.read())
+    assert "in-flight" in body["error"]
+
+
+def test_healthz_metrics_and_debug_surfaces(fleet):
+    url, router, _states, urls = fleet
+    with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+        assert json.loads(r.read())["replicas_healthy"] == 2
+    with urllib.request.urlopen(url + "/livez", timeout=10) as r:
+        assert json.loads(r.read())["ok"]
+    _post(url, "/v1/generate", {"prompt_tokens": [[1, 2]]}).read()
+    with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "k3stpu_router_requests_total" in text
+    assert 'k3stpu_build_info{component="router"' in text
+    assert 'instance="test-router"' in text
+    with urllib.request.urlopen(url + "/debug/router", timeout=10) as r:
+        state = json.loads(r.read())
+    assert {rep["url"] for rep in state["replicas"]} == set(urls)
+    # GET /v1/* fans in to a replica (loadgen's model-card fetch).
+    with urllib.request.urlopen(url + "/v1/models", timeout=10) as r:
+        assert json.loads(r.read())["model"] == "scripted"
+    # Fleet-down readiness: /healthz 503s (Service pulls the router),
+    # /livez stays 200 (no restart for a sick FLEET).
+    for u in urls:
+        router.eject(u, "t")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(url + "/healthz", timeout=10)
+    assert ei.value.code == 503
+    with urllib.request.urlopen(url + "/livez", timeout=10) as r:
+        assert r.status == 200
+
+
+def test_router_obs_families_render_clean():
+    obs = RouterObs(instance="unit")
+    obs.on_route("session")
+    obs.on_proxy("http://a", 0.002)
+    obs.on_failover("http://a")
+    obs.on_eject("http://a")
+    obs.on_reject()
+    obs.on_membership(2)
+    obs.on_pins(3)
+    text = obs.render_prometheus()
+    for family in ("k3stpu_router_requests_total",
+                   "k3stpu_router_failovers_total",
+                   "k3stpu_router_ejections_total",
+                   "k3stpu_router_routing_decisions_total",
+                   "k3stpu_router_rejected_total",
+                   "k3stpu_router_proxy_overhead_seconds",
+                   "k3stpu_router_replicas_healthy",
+                   "k3stpu_router_sessions_pinned"):
+        assert family in text, family
+    assert 'reason="session"' in text
+    om = obs.render_openmetrics()
+    assert om.endswith("# EOF\n")

@@ -67,11 +67,14 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 # Label keys whose value sets are bounded by construction: goodput
 # buckets and health states are fixed enums, chips/files are bounded by
 # the hardware inventory and live process count, version/component by
-# the build. A Labeled* family declaring any OTHER key (rid, trace_id,
-# pod, user...) is a cardinality bomb waiting for a dashboard, so the
-# lint rejects it until the key is reviewed and added here.
+# the build, replica/instance by the configured fleet, reason by the
+# router's fixed routing-decision enum. A Labeled* family declaring any
+# OTHER key (rid, trace_id, pod, user...) is a cardinality bomb waiting
+# for a dashboard, so the lint rejects it until the key is reviewed and
+# added here.
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
-                      "component", "version"}
+                      "component", "version", "instance",
+                      "replica", "reason"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
@@ -134,9 +137,33 @@ def _families_from_node_exporter() -> "list[tuple[str, str, str]]":
     return fams
 
 
+def _families_from_router() -> "list[tuple[str, str, str]]":
+    """The router tier's families, from a real RouterObs — the facade
+    constructs without jax (the router never touches a device)."""
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledGauge,
+    )
+    from k3stpu.router.obs import RouterObs
+
+    fams = []
+    for attr in vars(RouterObs(instance="lint")).values():
+        if isinstance(attr, Histogram):
+            fams.append((attr.name, "histogram", attr.help))
+        elif isinstance(attr, (Counter, LabeledCounter)):
+            fams.append((attr.name, "counter", attr.help))
+        elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
+            fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
 def _all_families() -> "list[tuple[str, str, str]]":
     return (_families_from_obs() + _families_from_server()
-            + _families_from_node_exporter())
+            + _families_from_node_exporter() + _families_from_router())
 
 
 def lint() -> "list[str]":
@@ -186,10 +213,12 @@ def _labeled_families() -> "list[tuple[str, tuple]]":
     from k3stpu.obs.hist import InfoGauge, LabeledCounter, LabeledGauge
     from k3stpu.obs.node_exporter import NodeCollector
     from k3stpu.obs.train import TrainObs
+    from k3stpu.router.obs import RouterObs
 
     out = []
     for owner in (ServeObs(), TrainObs(),
-                  NodeCollector(drop_dir="/nonexistent")):
+                  NodeCollector(drop_dir="/nonexistent"),
+                  RouterObs(instance="lint")):
         for attr in vars(owner).values():
             if isinstance(attr, (LabeledCounter, LabeledGauge)):
                 out.append((attr.name, (attr.label,)))
