@@ -35,22 +35,26 @@ def init_cache(model, batch: int):
                         shapes["cache"])
 
 
-def paged_model(model, *, num_pages: int, page_size: int):
+def paged_model(model, *, num_pages: int, page_size: int,
+                attn_backend: "str | None" = None):
     """The same LM with its decode/extend cache re-homed into a paged
     pool (cfg.kv_pages doc in models/transformer.py). Params are
     untouched — page geometry only changes the cache collection — so one
     trained tree serves both the dense and the paged engine. Handles the
-    MoE config's ``.base`` nesting."""
+    MoE config's ``.base`` nesting. ``attn_backend`` optionally selects
+    how the paged branch reads the pool ("xla-gather" | "pallas-paged",
+    cfg.attn_backend doc); None keeps the model's current setting."""
     import dataclasses
 
+    changes = dict(kv_pages=num_pages, kv_page_size=page_size)
+    if attn_backend is not None:
+        changes["attn_backend"] = attn_backend
     cfg = model.config
     if hasattr(cfg, "base"):
         new_cfg = dataclasses.replace(
-            cfg, base=dataclasses.replace(
-                cfg.base, kv_pages=num_pages, kv_page_size=page_size))
+            cfg, base=dataclasses.replace(cfg.base, **changes))
     else:
-        new_cfg = dataclasses.replace(cfg, kv_pages=num_pages,
-                                      kv_page_size=page_size)
+        new_cfg = dataclasses.replace(cfg, **changes)
     return type(model)(new_cfg)
 
 

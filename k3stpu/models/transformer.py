@@ -98,9 +98,23 @@ class TransformerConfig:
     # "flash" forces the kernel anywhere — on non-TPU backends it runs in
     # the Pallas interpreter (slow; tests).
     attn_impl: str = "auto"
+    # "xla-gather" | "pallas-paged": how the PAGED decode/extend branch
+    # reads the page pool. "xla-gather" (default) materializes each
+    # row's full (max_seq_len, kv_heads, head_dim) view via pool[bt]
+    # and attends with a position mask — simple, bit-stable, and what
+    # every exactness suite pins. "pallas-paged" walks the block table
+    # INSIDE a Pallas kernel (ops/paged_attention.py): one DMA per live
+    # page, ragged rows stop at their own length, int8 pages dequantize
+    # in-kernel — no gathered cache copy ever exists. Greedy decode is
+    # token-identical between the two; per-element outputs differ by
+    # online-softmax reassociation only (bounded in
+    # tests/test_paged_attention.py). Orthogonal to ``attn_impl``
+    # (which picks the full/prefill-mode kernel).
+    attn_backend: str = "xla-gather"
 
 
 _ATTN_IMPLS = ("auto", "einsum", "flash")
+ATTN_BACKENDS = ("xla-gather", "pallas-paged")
 
 
 def _resolve_attn_impl(impl: str, mha: bool = False) -> str:
@@ -274,6 +288,10 @@ class Attention(nn.Module):
             return dequantize_absmax(x8, s, axis=-1).astype(cfg.dtype)
 
         paged = cfg.kv_pages is not None
+        if cfg.attn_backend not in ATTN_BACKENDS:
+            raise ValueError(
+                f"attn_backend {cfg.attn_backend!r} not in {ATTN_BACKENDS}")
+        paged_kernel = cfg.attn_backend == "pallas-paged"
         if paged:
             if cfg.kv_page_size < 1 \
                     or cfg.max_seq_len % cfg.kv_page_size:
@@ -283,6 +301,10 @@ class Attention(nn.Module):
             if cfg.kv_pages < 2:
                 raise ValueError(f"kv_pages {cfg.kv_pages} needs the sink "
                                  f"page 0 plus at least one usable page")
+            if paged_kernel and cfg.sliding_window is not None:
+                raise ValueError(
+                    "attn_backend='pallas-paged' does not implement "
+                    "sliding_window yet — use the xla-gather backend")
 
         if mode in ("prefill", "decode", "extend"):
             # GQA shrinks the cache by n_heads/kv_heads — the whole point;
@@ -359,6 +381,7 @@ class Attention(nn.Module):
                 pid = jnp.take_along_axis(bt, woffs // ps, axis=1)  # (b,s)
                 sip = woffs % ps                           # slot in page
                 gshape = (b, cfg.max_seq_len, kv_heads, head_dim)
+                ck = cv = None
                 if kv_int8:
                     k8, ks = kv_quant(k)
                     v8, vs = kv_quant(v)
@@ -368,16 +391,18 @@ class Attention(nn.Module):
                     vsc = scale_v.value.at[pid, sip].set(vs)
                     cache_k.value, cache_v.value = ck8, cv8
                     scale_k.value, scale_v.value = ksc, vsc
-                    ck = kv_dequant(ck8[bt].reshape(gshape),
-                                    ksc[bt].reshape(gshape[:3]))
-                    cv = kv_dequant(cv8[bt].reshape(gshape),
-                                    vsc[bt].reshape(gshape[:3]))
+                    if not paged_kernel:
+                        ck = kv_dequant(ck8[bt].reshape(gshape),
+                                        ksc[bt].reshape(gshape[:3]))
+                        cv = kv_dequant(cv8[bt].reshape(gshape),
+                                        vsc[bt].reshape(gshape[:3]))
                 else:
                     pk = cache_k.value.at[pid, sip].set(k.astype(cfg.dtype))
                     pv = cache_v.value.at[pid, sip].set(v.astype(cfg.dtype))
                     cache_k.value, cache_v.value = pk, pv
-                    ck = pk[bt].reshape(gshape)
-                    cv = pv[bt].reshape(gshape)
+                    if not paged_kernel:
+                        ck = pk[bt].reshape(gshape)
+                        cv = pv[bt].reshape(gshape)
             elif kv_int8:
                 k8, ks = kv_quant(k)
                 v8, vs = kv_quant(v)
@@ -394,14 +419,31 @@ class Attention(nn.Module):
                 cache_k.value, cache_v.value = ck, cv
             cache_idx.value = idx + s
 
-            pos = jnp.arange(cfg.max_seq_len)
-            # Query j of row r sits at absolute position offs[r, j] and
-            # sees cache positions <= it (within the sliding window).
-            visible = pos[None, None, :] <= offs[..., None]   # (b, s, S)
-            if cfg.sliding_window is not None:
-                visible &= (pos[None, None, :]
-                            > offs[..., None] - cfg.sliding_window)
-            out = grouped_attention(q, ck, cv, visible)
+            if paged and paged_kernel:
+                # In-kernel page walk: no pool[bt] gather materializes.
+                # The scatter above stays XLA (a tiny (b, s)-sized
+                # write); the kernel reads the updated pools directly.
+                # Lengths clip like woffs so an over-run row reads its
+                # clamped window instead of past the pool.
+                from k3stpu.ops.paged_attention import paged_attention
+
+                lens = jnp.clip(idx + s, 1, cfg.max_seq_len)
+                skw = (dict(k_scale_pages=scale_k.value,
+                            v_scale_pages=scale_v.value)
+                       if kv_int8 else {})
+                out = paged_attention(
+                    q, cache_k.value, cache_v.value, bt, lens,
+                    scale=scale,
+                    interpret=jax.default_backend() != "tpu", **skw)
+            else:
+                pos = jnp.arange(cfg.max_seq_len)
+                # Query j of row r sits at absolute position offs[r, j]
+                # and sees cache positions <= it (within the window).
+                visible = pos[None, None, :] <= offs[..., None]  # (b,s,S)
+                if cfg.sliding_window is not None:
+                    visible &= (pos[None, None, :]
+                                > offs[..., None] - cfg.sliding_window)
+                out = grouped_attention(q, ck, cv, visible)
         else:
             q = apply_rope(q, angles)
             k = apply_rope(k, angles)

@@ -46,7 +46,8 @@ class ServeObs:
     InferenceServer and its GenerateEngine."""
 
     def __init__(self, trace_capacity: int = 256, enabled: bool = True,
-                 instance: "str | None" = None):
+                 instance: "str | None" = None,
+                 attn_backend: str = "xla-gather"):
         self.enabled = enabled
         self.traces = TraceBuffer(capacity=trace_capacity)
         self.ttft = Histogram(
@@ -99,6 +100,22 @@ class ServeObs:
             "k3stpu_serve_spec_verify_seconds",
             "Device verify-extend time per speculative dispatch.",
             bounds=TPOT_BUCKETS_S)
+        # Decode dispatch: device time per decode/verify dispatch, with
+        # the active attention backend pinned as a CONSTANT label so a
+        # bench diff or dashboard attributes every sample to the kernel
+        # that produced it (xla-gather vs pallas-paged — exactly one
+        # series per process; cardinality can't grow at observe time).
+        self.decode_dispatch_seconds = Histogram(
+            "k3stpu_serve_decode_dispatch_seconds",
+            "Device time per decode dispatch, labeled with the active "
+            "attention backend.",
+            bounds=TPOT_BUCKETS_S,
+            labels={"backend": attn_backend})
+        self.decode_mfu = Gauge(
+            "k3stpu_serve_decode_mfu",
+            "Model FLOPs utilization of the last decode dispatch "
+            "(modeled decode flops / measured time / device peak; 0 "
+            "when the device peak is unknown, e.g. the CPU stand-in).")
         # Host KV page tier (engine tier=, docs/TIERING.md). The two
         # gauges together are the capacity story: resident HBM pages vs
         # page-equivalents parked in host RAM. All stay at zero/-1 on a
@@ -175,6 +192,19 @@ class ServeObs:
         if pages_resident is not None:
             self.pages_resident.set(float(pages_resident))
 
+    def on_decode_dispatch(self, seconds: float,
+                           mfu: "float | None" = None) -> None:
+        """One completed decode (or speculative verify) dispatch took
+        ``seconds`` of wall time; ``mfu`` is the modeled-flops/peak
+        utilization when the engine knows the device peak (None on the
+        CPU stand-in — the gauge then keeps its last value, 0 at
+        boot)."""
+        if not self.enabled:
+            return
+        self.decode_dispatch_seconds.observe(seconds)
+        if mfu is not None:
+            self.decode_mfu.set(mfu)
+
     def on_tier_probe(self, hit: bool) -> None:
         if not self.enabled:
             return
@@ -237,7 +267,8 @@ class ServeObs:
 
     def histograms(self) -> "tuple[Histogram, ...]":
         return (self.ttft, self.tpot, self.e2e, self.queue_wait,
-                self.batch_occupancy, self.spec_draft_seconds,
+                self.batch_occupancy, self.decode_dispatch_seconds,
+                self.spec_draft_seconds,
                 self.spec_verify_seconds, self.tier_swap_in_seconds,
                 self.tier_swap_out_seconds)
 
@@ -248,7 +279,8 @@ class ServeObs:
 
     def _gauges(self) -> "tuple[Gauge, ...]":
         return (self.queue_depth, self.pages_free, self.pages_resident,
-                self.host_tier_pages, self.spec_accept_ratio)
+                self.host_tier_pages, self.spec_accept_ratio,
+                self.decode_mfu)
 
     def render_prometheus(self) -> str:
         parts = [h.render() for h in self.histograms()]
@@ -284,6 +316,7 @@ class ServeObs:
         self.spec_accept_ratio.set(0.0)
         self.queue_depth.set(0.0)
         self.host_tier_pages.set(0.0)
+        self.decode_mfu.set(0.0)
         self.traces.reset()
 
 

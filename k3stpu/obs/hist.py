@@ -223,18 +223,26 @@ class Histogram:
     ``bounds`` are the bucket upper edges (le values); an implicit +Inf
     bucket catches the tail. Counts are stored NON-cumulative and summed
     at render — observe() then touches exactly one cell, not a prefix.
+
+    ``labels`` optionally pins a CONSTANT label set on every sample line
+    (the ``build_info`` discipline applied to a histogram): one series
+    per family, labels fixed at construction, so cardinality can't grow
+    at observe time. ``le`` renders first so exposition parsers keyed on
+    the ``_bucket{le=`` prefix keep working.
     """
 
-    __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_lock",
-                 "_exemplars")
+    __slots__ = ("name", "help", "bounds", "labels", "_counts", "_sum",
+                 "_lock", "_exemplars")
 
     def __init__(self, name: str, help_text: str,
-                 bounds: "tuple[float, ...]" = LATENCY_BUCKETS_S):
+                 bounds: "tuple[float, ...]" = LATENCY_BUCKETS_S,
+                 labels: "dict[str, str] | None" = None):
         if list(bounds) != sorted(bounds) or len(set(bounds)) != len(bounds):
             raise ValueError(f"bucket bounds must be strictly increasing: "
                              f"{bounds}")
         self.name = name
         self.help = help_text
+        self.labels = dict(labels) if labels else {}
         self.bounds = tuple(float(b) for b in bounds)
         self._counts = [0] * (len(bounds) + 1)  # [+Inf] is the last cell
         self._sum = 0.0
@@ -285,15 +293,25 @@ class Histogram:
         cum, _, total = self.snapshot()
         return quantile_from_buckets(self.bounds, cum, total, q)
 
+    def _label_suffix(self) -> "tuple[str, str]":
+        """(suffix after le, bare {labels} for _sum/_count) — "" when
+        the histogram has no constant labels."""
+        if not self.labels:
+            return "", ""
+        pairs = ",".join(f'{k}="{v}"' for k, v in sorted(self.labels.items()))
+        return f",{pairs}", f"{{{pairs}}}"
+
     def render(self) -> str:
         cum, total_sum, total = self.snapshot()
+        after_le, bare = self._label_suffix()
         lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
                                         t="histogram")]
         for bound, c in zip(self.bounds, cum):
-            lines.append(f'{self.name}_bucket{{le="{_fmt(bound)}"}} {c}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {total}')
-        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
-        lines.append(f"{self.name}_count {total}")
+            lines.append(
+                f'{self.name}_bucket{{le="{_fmt(bound)}"{after_le}}} {c}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"{after_le}}} {total}')
+        lines.append(f"{self.name}_sum{bare} {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count{bare} {total}")
         return "\n".join(lines)
 
     def render_openmetrics(self) -> str:
@@ -311,16 +329,17 @@ class Histogram:
             running += c
             cum.append(running)
         total = running
+        after_le, bare = self._label_suffix()
         lines = [_NAME_HELP_TYPE.format(n=self.name, h=self.help,
                                         t="histogram")]
         edges = [_fmt(b) for b in self.bounds] + ["+Inf"]
         for le, c, ex in zip(edges, cum, exemplars):
-            line = f'{self.name}_bucket{{le="{le}"}} {c}'
+            line = f'{self.name}_bucket{{le="{le}"{after_le}}} {c}'
             if ex is not None:
                 line += format_exemplar(*ex)
             lines.append(line)
-        lines.append(f"{self.name}_sum {_fmt(total_sum)}")
-        lines.append(f"{self.name}_count {total}")
+        lines.append(f"{self.name}_sum{bare} {_fmt(total_sum)}")
+        lines.append(f"{self.name}_count{bare} {total}")
         return "\n".join(lines)
 
 
@@ -405,7 +424,10 @@ def parse_prometheus_histograms(text: str) -> "dict[str, dict]":
             continue
         if "_bucket{le=" in key:
             name = key[:key.index("_bucket{le=")]
-            le = key[key.index('le="') + 4:key.rindex('"')]
+            # le's value ends at ITS closing quote, not the line's last
+            # one — constant-labeled histograms carry more labels after.
+            start = key.index('le="') + 4
+            le = key[start:key.index('"', start)]
             h = out.setdefault(name, {"bounds": [], "cumulative": [],
                                       "sum": 0.0, "count": 0})
             if le == "+Inf":
@@ -413,8 +435,10 @@ def parse_prometheus_histograms(text: str) -> "dict[str, dict]":
             else:
                 h["bounds"].append(float(le))
                 h["cumulative"].append(int(float(val)))
-        elif key.endswith("_sum") and key[:-4] in out:
-            out[key[:-4]]["sum"] = float(val)
-        elif key.endswith("_count") and key[:-6] in out:
-            out[key[:-6]]["count"] = int(float(val))
+            continue
+        base = key.partition("{")[0]  # strip constant labels if present
+        if base.endswith("_sum") and base[:-4] in out:
+            out[base[:-4]]["sum"] = float(val)
+        elif base.endswith("_count") and base[:-6] in out:
+            out[base[:-6]]["count"] = int(float(val))
     return out

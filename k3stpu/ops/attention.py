@@ -53,6 +53,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
 _LANES = 128  # TPU lane width: trailing dim of any VMEM tile
+# jax renamed pltpu.TPUCompilerParams -> pltpu.CompilerParams; accept
+# either spelling so the kernels run on both sides of the rename.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
 # exp(x) lowers to exp2(x * log2(e)) — a full-tile VPU multiply per call.
 # The kernels work in the log2 domain instead: log2(e) folds into the
 # softmax scale (a compile-time constant on the O(S d) q side / the
@@ -351,7 +355,7 @@ def _flash_forward_bshd(q, k, v, *, scale, causal, block_q, block_k,
         out_specs=(q_spec, lse_spec) if with_lse else q_spec,
         out_shape=(o_shape, lse_shape) if with_lse else o_shape,
         scratch_shapes=_fwd_scratch(block_q, d, q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes,
         ),
@@ -556,7 +560,7 @@ def _flash_backward_bshd(q, k, v, o, lse, g, *, scale, causal, block_q,
                    jax.ShapeDtypeStruct(dkv_shape, v.dtype)),
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes),
         cost_estimate=pl.CostEstimate(
@@ -593,7 +597,7 @@ def _flash_backward_bshd(q, k, v, o, lse, g, *, scale, causal, block_q,
         out_specs=q_spec2,
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
             vmem_limit_bytes=vmem_limit_bytes),
         cost_estimate=pl.CostEstimate(

@@ -7,7 +7,18 @@ single bounded run on the chip picks the production default (DEFAULT_BLOCK
 in ops/attention.py). Bench discipline is measure_attention's: chained
 iterations, device->host sync, causal-aware flop accounting.
 
+``--paged`` switches to the ragged paged-DECODE sweep: a q-rows x
+kv_page_size grid at several ragged fill fractions, each point modeled
+against the chip's HBM wall with the shared byte accounting from
+ops/paged_attention.paged_decode_bytes (decode attention is
+HBM-streaming, so bytes ARE the roofline — there is no MXU axis worth
+sweeping at q widths of 1-8 rows). Every point prints one ROOFLINE_JSON
+line like the contiguous roofline's, and ``--check`` additionally runs
+the interpreter-mode kernel against the XLA-gather reference at that
+point so a sweep doubles as a parity scan.
+
 Run: python -m k3stpu.ops.attn_tune [--seq 4096] [--batch 8] [--fast]
+     python -m k3stpu.ops.attn_tune --paged [--int8] [--check]
 """
 
 from __future__ import annotations
@@ -50,6 +61,108 @@ def sweep(seq: int = 4096, batch: int = 8, heads: int = 8,
     return rows
 
 
+def _ragged_lengths(batch: int, max_seq: int, fill: float) -> "list[int]":
+    """Deterministic ragged batch around a mean fill fraction: rows span
+    0.5x..1.5x of fill*max_seq (clamped to [1, max_seq]), so every point
+    exercises early-stop on short rows AND full chains on long ones."""
+    mean = fill * max_seq
+    spread = [0.5 + (i / (batch - 1) if batch > 1 else 0.5)
+              for i in range(batch)]
+    return [max(1, min(max_seq, round(mean * s))) for s in spread]
+
+
+def paged_sweep(batch: int = 8, kv_heads: int = 8, q_heads: int = 8,
+                head_dim: int = 128, max_seq: int = 2048,
+                page_sizes: "tuple[int, ...]" = (16, 32, 64, 128),
+                q_widths: "tuple[int, ...]" = (1, 5),
+                fills: "tuple[float, ...]" = (0.25, 0.5, 1.0),
+                int8: bool = False, check: bool = False) -> list[dict]:
+    """Model (and optionally parity-check) the ragged paged-decode
+    kernel over a q-rows x page-size x fill grid; one ROOFLINE_JSON
+    line per point. q_width is the query-token width per dispatch (1 =
+    plain decode, gamma+1 = speculative verify); block_q reports the
+    kernel's actual padded q-row tile (q_width * group padded to the
+    sublane multiple)."""
+    from k3stpu.ops.attn_roofline import V5E
+    from k3stpu.ops.paged_attention import _pad_rows, paged_decode_bytes
+
+    chip = V5E
+    group = q_heads // kv_heads
+    rows = []
+    for ps, t, fill in itertools.product(page_sizes, q_widths, fills):
+        if max_seq % ps:
+            continue
+        lengths = _ragged_lengths(batch, max_seq, fill)
+        bb = paged_decode_bytes(batch, lengths, max_seq, kv_heads,
+                                head_dim, ps, int8=int8)
+        gather_ms = bb["xla_gather_bytes"] / (chip["hbm_gbps"] * 1e9) * 1e3
+        paged_ms = bb["pallas_paged_bytes"] / (chip["hbm_gbps"] * 1e9) * 1e3
+        row = {
+            "mode": "paged-decode", "chip": chip["name"],
+            "batch": batch, "kv_heads": kv_heads, "q_heads": q_heads,
+            "head_dim": head_dim, "max_seq": max_seq,
+            "page_size": ps, "q_width": t,
+            "block_q": _pad_rows(t * group), "fill": fill,
+            "int8": int8,
+            "live_tokens": bb["live_tokens"],
+            "xla_gather_bytes": bb["xla_gather_bytes"],
+            "pallas_paged_bytes": bb["pallas_paged_bytes"],
+            "bytes_ratio": round(bb["bytes_ratio"], 3),
+            "gather_hbm_ms": round(gather_ms, 4),
+            "paged_hbm_ms": round(paged_ms, 4),
+            "bound_by": "hbm",
+        }
+        if check:
+            row["max_err"] = _paged_check(batch, kv_heads, q_heads,
+                                          head_dim, max_seq, ps, t,
+                                          lengths, int8)
+        rows.append(row)
+        print("ROOFLINE_JSON " + json.dumps(row), flush=True)
+    return rows
+
+
+def _paged_check(batch, kv_heads, q_heads, head_dim, max_seq, ps, t,
+                 lengths, int8) -> float:
+    """Interpreter-mode kernel vs XLA-gather reference at one sweep
+    point; returns the max abs output error (fp32 pools unless int8)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from k3stpu.ops.paged_attention import (
+        paged_attention,
+        paged_attention_reference,
+    )
+
+    n_bt = max_seq // ps
+    num_pages = 1 + batch * n_bt
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal(
+        (batch, t, q_heads, head_dim)), jnp.float32)
+    bt = jnp.asarray(
+        1 + np.arange(batch * n_bt, dtype=np.int32).reshape(batch, n_bt))
+    lens = jnp.asarray(np.asarray(lengths, np.int32))
+    kw = {}
+    if int8:
+        kp = jnp.asarray(rng.integers(
+            -127, 128, (num_pages, ps, kv_heads, head_dim)), jnp.int8)
+        vp = jnp.asarray(rng.integers(
+            -127, 128, (num_pages, ps, kv_heads, head_dim)), jnp.int8)
+        kw["k_scale_pages"] = jnp.asarray(
+            rng.uniform(0.01, 0.05, (num_pages, ps, kv_heads)), jnp.float32)
+        kw["v_scale_pages"] = jnp.asarray(
+            rng.uniform(0.01, 0.05, (num_pages, ps, kv_heads)), jnp.float32)
+    else:
+        kp = jnp.asarray(rng.standard_normal(
+            (num_pages, ps, kv_heads, head_dim)), jnp.float32)
+        vp = jnp.asarray(rng.standard_normal(
+            (num_pages, ps, kv_heads, head_dim)), jnp.float32)
+    got = paged_attention(q, kp, vp, bt, lens, interpret=True, **kw)
+    want = paged_attention_reference(q, kp, vp, bt, lens, **kw)
+    return float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                 - want.astype(jnp.float32))))
+
+
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(description="flash-attention block sweep")
     ap.add_argument("--seq", type=int, default=4096)
@@ -61,7 +174,31 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--fast", action="store_true",
                     help="3-point sweep (256/512/1024 square tiles only)")
     ap.add_argument("--interpret", action="store_true")
+    ap.add_argument("--paged", action="store_true",
+                    help="ragged paged-decode sweep (q-rows x page-size "
+                         "x fill grid, modeled vs the HBM wall) instead "
+                         "of the contiguous block sweep")
+    ap.add_argument("--kv-heads", type=int, default=8)
+    ap.add_argument("--max-seq", type=int, default=2048)
+    ap.add_argument("--int8", action="store_true",
+                    help="--paged: model/check int8 KV pages with "
+                         "per-page fp32 scale planes")
+    ap.add_argument("--check", action="store_true",
+                    help="--paged: run the interpreter kernel vs the "
+                         "XLA-gather reference at each point (slow)")
     args = ap.parse_args(argv)
+
+    if args.paged:
+        page_sizes = (16, 32) if args.fast else (16, 32, 64, 128)
+        fills = (0.25, 1.0) if args.fast else (0.25, 0.5, 1.0)
+        rows = paged_sweep(batch=args.batch, kv_heads=args.kv_heads,
+                           q_heads=args.heads, head_dim=args.head_dim,
+                           max_seq=args.max_seq, page_sizes=page_sizes,
+                           fills=fills, int8=args.int8, check=args.check)
+        if rows:
+            best = max(rows, key=lambda r: r["bytes_ratio"])
+            print("ATTN_TUNE_BEST " + json.dumps(best), flush=True)
+        return 0 if rows else 1
 
     blocks = (256, 512, 1024) if args.fast else (256, 512, 1024, 2048)
     rows = sweep(seq=args.seq, batch=args.batch, heads=args.heads,

@@ -54,7 +54,16 @@ def measure_psum_allreduce(
     trials: int = 3,
 ) -> AllreduceResult:
     """Time ``iters`` chained psum allreduces of ~``mbytes`` MiB per rank."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        # Older jax spells it jax.experimental.shard_map; the pre-vma
+        # replication check stays off — this program is vma-typed.
+        from jax.experimental.shard_map import shard_map as _esm
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+            return _esm(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=check_vma)
 
     axes = mesh.axis_names
     n_dev = int(mesh.devices.size)

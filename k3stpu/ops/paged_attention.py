@@ -1,0 +1,351 @@
+"""Ragged paged-attention Pallas kernel: decode/extend attention that
+walks the engine's block tables INSIDE the kernel.
+
+The XLA-gather path (models/transformer.py paged branch) serves a
+decode step by materializing every row's full (max_seq_len, kv_heads,
+head_dim) cache view out of the page pool — ``pool[block_tables]`` —
+and then attending over it with a position mask. That costs, per step
+per layer, a gather write + read of ``B * max_seq_len * kv_dim`` K and
+V bytes regardless of how full the rows actually are, and the padded
+attention does the same full-width work. This kernel replaces gather +
+masked einsum with a vLLM-PagedAttention-style page walk fused into a
+FlashAttention-2-style blocked online softmax (the same log2-domain
+formulation as ops/attention.py):
+
+- the grid is ``(batch, kv_heads, n_block_table_entries)`` and the
+  k/v BlockSpec index maps read the SCALAR-PREFETCHED block table
+  (``pltpu.PrefetchScalarGridSpec``), so each grid step DMAs exactly
+  one physical page — no gathered copy of the cache ever exists;
+- ragged ``lengths`` stop short rows early: a row's dead trailing
+  table entries are renamed to its last live page (consecutive equal
+  index => Mosaic elides the DMA, the same trick as the contiguous
+  kernel's ``_clamped_kv_index_map``) and their compute is skipped
+  with ``pl.when`` — a row pays bytes for the pages it HAS, not for
+  ``max_seq_len``;
+- grouped-query heads fold into the q tile: the ``T`` query tokens x
+  ``n_heads // kv_heads`` group rows of one kv head form one resident
+  (rows, head_dim) tile, padded up to the fp32 sublane multiple, so
+  GQA reads the narrow k/v exactly once (nothing head-repeated);
+- int8 KV pages dequantize IN-KERNEL against their per-page scale
+  planes (models/quant.py absmax contract: one fp32 scale per (slot,
+  kv_head)) — the pool's int8 bytes are what cross HBM, not a
+  dequantized materialization.
+
+``T >= 1`` makes the same kernel serve plain decode (T=1), blocked
+decode under ``lax.scan``, chunked-prefill extends, and speculative
+verify at width gamma+1.
+
+Numerics: the online softmax re-associates the denominator sum, so
+outputs are not bit-identical to the one-shot softmax of the gather
+path — but both accumulate in fp32, the drift is ~1 ulp-scale (bounded
+in tests/test_paged_attention.py), and greedy decode through the
+engine is token-identical (the acceptance gate bench.py --serve-attn
+asserts per run). The interpreter path (``interpret=True``) runs the
+identical program on CPU for tier-1.
+
+Why the roofline cares (docs/ATTN_ROOFLINE.md "Paged decode"): decode
+attention is HBM-bound — per step the gather path moves
+``2 * B * max_seq * kv_dim`` K/V bytes twice (materialize + read),
+while the page walk moves ``2 * sum_b ceil(len_b / page_size) *
+page_size * kv_dim`` bytes once. At typical serving fill (rows ~50%
+of max_seq) that is a ~4x byte reduction before the int8 factor.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from k3stpu.ops.attention import _CompilerParams
+
+_NEG_INF = -1e30
+_LANES = 128    # TPU lane width: trailing dim of any VMEM tile
+_SUBLANES = 8   # fp32 sublane multiple: min second-to-minor tile dim
+_LOG2E = float(np.log2(np.e))
+
+
+def _pad_rows(rows: int) -> int:
+    """Query-tile row count padded to the fp32 sublane multiple (a
+    (1, head_dim) decode tile would occupy a full 8-row tile anyway;
+    padded rows are fully masked and sliced off)."""
+    return max(_SUBLANES, -(-rows // _SUBLANES) * _SUBLANES)
+
+
+def _page_index_map(ps: int):
+    """k/v page BlockSpec index map: table-walk with dead-entry
+    renaming. Grid ids first, then the scalar-prefetch refs (block
+    tables, lengths) — ``PrefetchScalarGridSpec`` calling convention."""
+
+    def index_map(b, h, i, bt_ref, lens_ref):
+        live = (lens_ref[b] + ps - 1) // ps
+        ic = jnp.minimum(i, jnp.maximum(live - 1, 0))
+        return (bt_ref[b, ic], 0, h, 0)
+
+    return index_map
+
+
+def _scale_index_map(ps: int):
+    def index_map(b, h, i, bt_ref, lens_ref):
+        live = (lens_ref[b] + ps - 1) // ps
+        ic = jnp.minimum(i, jnp.maximum(live - 1, 0))
+        return (bt_ref[b, ic], 0, h)
+
+    return index_map
+
+
+def _paged_kernel(bt_ref, lens_ref, q_ref, k_ref, v_ref, *rest,
+                  scale: float, t: int, group: int, rows: int, ps: int,
+                  int8: bool):
+    """One grid cell = one (row batch b, kv head h, table entry i).
+
+    The i sweep is the innermost "arbitrary" axis, so the VMEM scratch
+    (running max / denom / output accumulator) carries the online
+    softmax across a row's pages exactly like the contiguous kernel's
+    k sweep. Query row ``r`` of the folded (T * group) tile is token
+    ``r // group`` at absolute position ``lengths[b] - T + r // group``
+    — the ragged causal frontier each page's slots mask against.
+    """
+    if int8:
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+    else:
+        (o_ref, m_ref, l_ref, acc_ref) = rest
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    ni = pl.num_programs(2)
+    length = lens_ref[b]
+    live = (length + ps - 1) // ps
+
+    @pl.when(i == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(i < live)
+    def _update():
+        # Scale AND log2(e) fold into the q read (log2-domain softmax,
+        # raw exp2 — the house formulation, attention.py:_flash_kernel).
+        # fp32 operands: decode tiles are tiny and HBM-bound, so the
+        # halved-rate fp32 MXU path costs nothing measurable while
+        # keeping the int8-dequant product exact.
+        q = q_ref[0, 0].astype(jnp.float32) * (scale * _LOG2E)
+        k = k_ref[0, :, 0, :].astype(jnp.float32)      # (ps, d)
+        v = v_ref[0, :, 0, :]
+        if int8:
+            k = k * ks_ref[0, :, 0][:, None]
+            v = v.astype(jnp.float32) * vs_ref[0, :, 0][:, None]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # (rows_pad, ps)
+
+        # Ragged causal mask: page slot i*ps + c is visible to query
+        # token tr iff it sits at or before that token's absolute
+        # position length - T + tr; padded tile rows see nothing.
+        col = i * ps + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+        r = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+        visible = (col <= length - t + r // group) & (r < rows)
+        s = jnp.where(visible, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp2(m_prev - m_new)
+        p = jnp.exp2(s - m_new)
+        # Fully-masked rows (tile padding; a first token's empty
+        # history never occurs — length >= T >= 1) keep l == 0 so the
+        # finalize emits zeros instead of uniform garbage.
+        p = jnp.where(s > _NEG_INF / 2, p, 0.0)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        l = l_ref[:, :1]
+        denom = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / denom).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pages, v_pages, block_tables, lengths, *,
+                    scale: "float | None" = None,
+                    k_scale_pages=None, v_scale_pages=None,
+                    interpret: bool = False,
+                    vmem_limit_bytes: int = 32 * 1024 * 1024):
+    """Ragged paged decode/extend attention over a shared page pool.
+
+    Args:
+      q: (B, T, n_heads, head_dim) — the step's queries, RoPE applied.
+        T = 1 for plain decode; gamma+1 for speculative verify; the
+        chunk width for extends.
+      k_pages / v_pages: (num_pages, page_size, kv_heads, head_dim)
+        pool, float or int8 storage. The step's new K/V must already be
+        scattered in (the caller's tiny (B, T) write; this kernel only
+        reads).
+      block_tables: (B, max_seq_len // page_size) int32 page ids —
+        traced data, one compiled program for every page assignment.
+        Dead entries may hold anything (the sink-page-0 convention);
+        they are never read.
+      lengths: (B,) int32 — valid tokens per row INCLUDING the T new
+        ones: query token j of row b sits at position lengths[b]-T+j
+        and attends positions <= it. Ragged: each row walks only
+        ceil(lengths[b] / page_size) table entries.
+      scale: softmax scale; default 1/sqrt(head_dim).
+      k_scale_pages / v_scale_pages: (num_pages, page_size, kv_heads)
+        fp32 absmax scale planes — required iff the pools are int8
+        (models/quant.py contract: x ~= x8 * scale).
+      interpret: run the Pallas interpreter (CPU tier-1 path).
+
+    Returns (B, T, n_heads, head_dim) in q.dtype.
+    """
+    b, t, h, d = q.shape
+    p_total, ps, h_kv, d_k = k_pages.shape
+    if d_k != d:
+        raise ValueError(f"head_dim mismatch: q {d}, pages {d_k}")
+    if h % h_kv:
+        raise ValueError(
+            f"query heads ({h}) must be a multiple of kv heads ({h_kv})")
+    int8 = k_pages.dtype == jnp.int8
+    if int8 != (k_scale_pages is not None) or \
+            int8 != (v_scale_pages is not None):
+        raise ValueError("int8 pools need k/v scale planes (and float "
+                         "pools must not pass them)")
+    group = h // h_kv
+    rows = t * group
+    rows_pad = _pad_rows(rows)
+    n_bt = block_tables.shape[-1]
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+
+    # Fold (T, group) into one resident q tile per (b, kv head): row
+    # r = token (r // group) x group member (r % group).
+    qf = q.reshape(b, t, h_kv, group, d).transpose(0, 2, 1, 3, 4)
+    qf = qf.reshape(b, h_kv, rows, d)
+    if rows_pad != rows:
+        qf = jnp.pad(qf, ((0, 0), (0, 0), (0, rows_pad - rows), (0, 0)))
+
+    kernel = functools.partial(
+        _paged_kernel, scale=scale, t=t, group=group, rows=rows, ps=ps,
+        int8=int8)
+    q_spec = pl.BlockSpec((1, 1, rows_pad, d),
+                          lambda bb, hh, ii, bt, ln: (bb, hh, 0, 0))
+    kv_spec = pl.BlockSpec((1, ps, 1, d), _page_index_map(ps))
+    in_specs = [q_spec, kv_spec, kv_spec]
+    args = [jnp.asarray(block_tables, jnp.int32),
+            jnp.asarray(lengths, jnp.int32), qf, k_pages, v_pages]
+    if int8:
+        sc_spec = pl.BlockSpec((1, ps, 1), _scale_index_map(ps))
+        in_specs += [sc_spec, sc_spec]
+        args += [k_scale_pages, v_scale_pages]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, h_kv, n_bt),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((rows_pad, _LANES), jnp.float32),  # running max
+            pltpu.VMEM((rows_pad, _LANES), jnp.float32),  # running denom
+            pltpu.VMEM((rows_pad, d), jnp.float32),       # output accum
+        ],
+    )
+    esize = 1 if int8 else jnp.dtype(k_pages.dtype).itemsize
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, h_kv, rows_pad, d), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vmem_limit_bytes,
+        ),
+        # Worst-case (every entry live) — the scheduler only needs the
+        # order of magnitude; the ragged clamp makes real traffic pay
+        # the live fraction.
+        cost_estimate=pl.CostEstimate(
+            flops=4 * b * h_kv * n_bt * rows_pad * ps * d,
+            bytes_accessed=(2 * b * h_kv * n_bt * ps * d * esize
+                            + 2 * b * h * t * d * 4),
+            transcendentals=b * h_kv * n_bt * rows_pad * ps,
+        ),
+        interpret=interpret,
+    )(*args)
+
+    out = out[:, :, :rows, :].reshape(b, h_kv, t, group, d)
+    return out.transpose(0, 2, 1, 3, 4).reshape(b, t, h, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
+                              *, scale: "float | None" = None,
+                              k_scale_pages=None, v_scale_pages=None):
+    """XLA-gather oracle: the same arithmetic as the transformer's
+    gather branch (materialized pool[bt] view, one-shot fp32 softmax),
+    kept here so kernel tests and the tune sweep compare against the
+    exact production reference without building a model."""
+    b, t, h, d = q.shape
+    _, ps, h_kv, _ = k_pages.shape
+    group = h // h_kv
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(d))
+    bt = jnp.asarray(block_tables, jnp.int32)
+    max_seq = bt.shape[-1] * ps
+    gshape = (b, max_seq, h_kv, d)
+    ck = k_pages[bt].reshape(gshape)
+    cv = v_pages[bt].reshape(gshape)
+    if k_scale_pages is not None:
+        ck = ck.astype(jnp.float32) * \
+            k_scale_pages[bt].reshape(gshape[:3])[..., None]
+        cv = cv.astype(jnp.float32) * \
+            v_scale_pages[bt].reshape(gshape[:3])[..., None]
+        ck, cv = ck.astype(q.dtype), cv.astype(q.dtype)
+    lens = jnp.asarray(lengths, jnp.int32)
+    offs = (lens[:, None] - t) + jnp.arange(t)[None, :]      # (b, t)
+    pos = jnp.arange(max_seq)
+    visible = pos[None, None, :] <= offs[..., None]          # (b, t, S)
+    qg = q.reshape(b, t, h_kv, group, d)
+    logits = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck,
+                        preferred_element_type=jnp.float32) * scale
+    logits = jnp.where(visible[:, None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs, cv)
+    return out.reshape(b, t, h, d)
+
+
+def paged_decode_bytes(batch, lengths, max_seq_len, kv_heads, head_dim,
+                       page_size, dtype_bytes: float = 2.0,
+                       int8: bool = False) -> "dict[str, float]":
+    """Modeled HBM bytes for ONE decode step's attention reads, both
+    backends — the roofline bookkeeping docs/ATTN_ROOFLINE.md and
+    bench.py --serve-attn share. ``lengths`` is the per-row live token
+    count (list/array).
+
+    xla-gather: the pool[bt] gather WRITES a (B, max_seq, kv_dim) K and
+    V copy to HBM and the einsum reads it back — 4 full-width passes,
+    independent of fill (int8 additionally materializes the dequantized
+    copy at float width). pallas-paged: each row's live pages stream
+    through VMEM exactly once — one pass over live bytes (int8: the
+    int8 bytes plus the fp32 scale planes).
+    """
+    kv_dim = kv_heads * head_dim
+    ebytes = 1.0 if int8 else dtype_bytes
+    live_tokens = float(sum(-(-int(n) // page_size) * page_size
+                            for n in np.asarray(lengths).tolist()))
+    full_tokens = float(batch * max_seq_len)
+    # K and V, materialize + read (the gather's write then the einsum's
+    # read); the dequantized int8 view materializes at float width.
+    gather_width = dtype_bytes if int8 else ebytes
+    gather = 2.0 * full_tokens * kv_dim * (ebytes + 3.0 * gather_width) \
+        if int8 else 4.0 * full_tokens * kv_dim * ebytes
+    walk = 2.0 * live_tokens * kv_dim * ebytes
+    if int8:
+        walk += 2.0 * live_tokens * kv_heads * 4.0    # scale planes
+    return {"xla_gather_bytes": gather, "pallas_paged_bytes": walk,
+            "bytes_ratio": gather / walk if walk else float("inf"),
+            "live_tokens": live_tokens, "full_tokens": full_tokens}

@@ -30,6 +30,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 _NEG_INF = -1e30
 
 
+def _pcast_varying(x, axis_name):
+    # jax.lax.pcast marks replicated constants as device-varying for
+    # shard_map's vma typing; older jax has neither the primitive nor the
+    # check (we pass check_rep=False there), so identity is correct.
+    pcast = getattr(jax.lax, "pcast", None)
+    return pcast(x, axis_name, to="varying") if pcast is not None else x
+
+
 def _local_attention_update(q, k, v, m, l, acc, *, scale, q_offset, kv_offset,
                             causal):
     """One online-softmax update of (m, l, acc) with a visiting K/V shard.
@@ -87,7 +95,7 @@ def ring_attention(
     # pvary: the accumulators start as compile-time constants (replicated in
     # shard_map's replication-typing) but become device-varying inside the
     # loop; the carry types must agree up front.
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: _pcast_varying(x, axis_name)
     m = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
     l = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
     acc = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
@@ -146,7 +154,7 @@ def _ring_flash_fwd_core(q, k, v, axis_name, causal, scale, block_q,
     bq = _divisor_block(block_q, s_local)
     bk = _divisor_block(block_k, s_local)
 
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: _pcast_varying(x, axis_name)
     num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
     m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
@@ -209,7 +217,7 @@ def _ring_flash_bwd(axis_name, causal, scale, block_q, block_k, interpret,
     bk = _divisor_block(block_k, s_local)
     lse3 = lse[..., 0]                                 # (B, S, H)
 
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: _pcast_varying(x, axis_name)
     dq = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     dk_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     dv_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
@@ -353,7 +361,7 @@ def _zigzag_fwd_core(q, k, v, axis_name, scale, block_q, block_k, interpret):
     bq = _divisor_block(block_q, half)
     bk = _divisor_block(block_k, half)
 
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: _pcast_varying(x, axis_name)
     num = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     den = vary(jnp.zeros((b, s_local, h, 1), jnp.float32))
     m_run = vary(jnp.full((b, s_local, h, 1), _NEG_INF, jnp.float32))
@@ -460,7 +468,7 @@ def _zigzag_bwd(axis_name, scale, block_q, block_k, interpret, res, g):
     lse3 = lse[..., 0]
     lse_e, lse_l = lse3[:, :half], lse3[:, half:]
 
-    vary = lambda x: jax.lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: _pcast_varying(x, axis_name)
     dq = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     dk_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
     dv_t = vary(jnp.zeros((b, s_local, h, d), jnp.float32))
@@ -621,7 +629,16 @@ def _ring_program(mesh: Mesh, axis_name: str, causal: bool,
                   scale: "float | None", impl: str, interpret: bool):
     """Jitted shard_map ring program, cached so repeated calls with the
     same (mesh, axis, causal, scale, impl) hit the XLA compile cache."""
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        # Older jax spells it jax.experimental.shard_map with the vma
+        # check under its pre-rename kwarg name check_rep.
+        from jax.experimental.shard_map import shard_map as _esm
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+            return _esm(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=check_vma)
 
     spec = P(None, axis_name, None, None)
     if impl in ("flash", "zigzag", "ulysses"):

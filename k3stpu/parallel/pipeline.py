@@ -65,7 +65,17 @@ def place_stacked_params(stacked, mesh: Mesh, axis_name: str = "pipe"):
 @functools.lru_cache(maxsize=16)
 def _pipeline_program(mesh: Mesh, block_apply, axis_name: str,
                       num_micro: int):
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        # Older jax spells it jax.experimental.shard_map; its pre-vma
+        # replication check cannot type this program (no pcast to mark
+        # the scan carry varying), so it must be off there.
+        from jax.experimental.shard_map import shard_map as _esm
+
+        def shard_map(f, *, mesh, in_specs, out_specs):
+            return _esm(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=False)
 
     def run(params_local, x_micro):
         # params_local leaves: (1, k, ...) — this device's stage.
@@ -81,7 +91,11 @@ def _pipeline_program(mesh: Mesh, block_apply, axis_name: str,
             h, _ = jax.lax.scan(body, h, params)
             return h
 
-        vary = lambda a: jax.lax.pcast(a, axis_name, to="varying")
+        # Mark as device-varying for shard_map's vma typing; older jax
+        # has neither pcast nor the check, so identity is correct there.
+        pcast = getattr(jax.lax, "pcast", None)
+        vary = (lambda a: pcast(a, axis_name, to="varying")) \
+            if pcast is not None else (lambda a: a)
         outputs0 = vary(jnp.zeros_like(x_micro))
         recv0 = vary(jnp.zeros_like(x_micro[0]))
 

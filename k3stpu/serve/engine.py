@@ -251,6 +251,7 @@ class GenerateEngine:
                  mesh=None, max_pending: "int | None" = None,
                  page_size: "int | None" = None,
                  num_pages: "int | None" = None,
+                 attn_backend: str = "xla-gather",
                  speculate: bool = False, spec_gamma: int = 4,
                  obs=None,
                  breaker=None, watchdog_s: "float | None" = None,
@@ -307,6 +308,14 @@ class GenerateEngine:
         copying whole cache rows; only a partial tail page is copied
         (the row writes into it). Token streams stay bit-identical to
         the dense engine's. None = dense cache (everything unchanged).
+
+        ``attn_backend``: how the paged decode/extend path reads the KV
+        pool (cfg.attn_backend doc in models/transformer.py).
+        ``"xla-gather"`` (default) materializes gathered pages in XLA;
+        ``"pallas-paged"`` walks block tables inside the fused Pallas
+        kernel (ops/paged_attention.py) — token-identical under greedy
+        decoding, no gather materialization. Requires paged mode; off
+        TPU the kernel runs in interpreter mode (slow — tests only).
 
         ``speculate`` / ``spec_gamma``: draft-then-verify speculative
         decoding inside the slot loop (paged mode only — the host
@@ -382,6 +391,15 @@ class GenerateEngine:
                              f"{prompt_cache}")
         if watchdog_s is not None and watchdog_s <= 0:
             raise ValueError(f"watchdog_s must be > 0, got {watchdog_s}")
+        from k3stpu.models.transformer import ATTN_BACKENDS
+        if attn_backend not in ATTN_BACKENDS:
+            raise ValueError(f"attn_backend {attn_backend!r} not in "
+                             f"{ATTN_BACKENDS}")
+        if attn_backend != "xla-gather" and page_size is None:
+            raise ValueError(
+                f"attn_backend {attn_backend!r} requires page_size (the "
+                f"paged kernel walks block tables; the dense cache has "
+                f"none)")
         if speculate and page_size is None:
             raise ValueError(
                 "speculate=True requires page_size (speculative rollback "
@@ -419,6 +437,7 @@ class GenerateEngine:
         if num_pages is not None and page_size is None:
             raise ValueError("num_pages needs page_size")
         self.paged = page_size is not None
+        self.attn_backend = attn_backend
         if self.paged:
             if page_size < 1 or self.max_seq % page_size:
                 raise ValueError(f"page_size {page_size} must divide "
@@ -432,7 +451,8 @@ class GenerateEngine:
                                  f"{num_pages}")
             self.num_pages = num_pages
             self.pmodel = paged_model(model, num_pages=num_pages,
-                                      page_size=page_size)
+                                      page_size=page_size,
+                                      attn_backend=attn_backend)
             self._alloc = _PageAllocator(num_pages)
             self._tables = np.zeros((slots, self.n_bt), np.int32)
             # Host mirror of every row's cache index — the injected
@@ -461,6 +481,19 @@ class GenerateEngine:
             self._drafter = NgramDrafter()
             self._spec_hist: "list[list[int]]" = [[] for _ in range(slots)]
             self._spec_depth = np.full((slots,), spec_gamma, np.int32)
+
+        # Decode-MFU model: one decoded token streams every weight
+        # through the MXU once, ~2 flops per param (the standard
+        # inference-MFU convention; attention's O(len·d) term is noise
+        # next to the weight matmuls at serving batch sizes). Peak is
+        # None off-TPU (CPU stand-in) — the MFU gauge then stays 0
+        # rather than reporting a meaningless CPU ratio.
+        from k3stpu.ops.matmul import peak_tflops_for
+
+        self._decode_flops_per_tok = 2.0 * sum(
+            int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        peak = peak_tflops_for()
+        self._peak_flops = None if peak is None else peak * 1e12
 
         self._cache = init_cache(self.pmodel if self.paged else model,
                                  slots)
@@ -1550,6 +1583,7 @@ class GenerateEngine:
         s["avg_active_slots"] = (round(s["slot_occupancy_sum"] / s["steps"],
                                        2) if s["steps"] else None)
         s["pcache_entries"] = len(self._pcache)
+        s["attn_backend"] = self.attn_backend
         if self.breaker is not None:
             s["breaker_state"] = self.breaker.state()
             s["breaker_trips"] = self.breaker.trips
@@ -1587,6 +1621,14 @@ class GenerateEngine:
         return s
 
     # --- loop internals (single thread; owns all slot state) ------------
+
+    def _decode_mfu(self, tokens: int, dt: float) -> "float | None":
+        """Modeled MFU of one decode dispatch: emitted tokens × modeled
+        flops/token over measured wall time, against the device peak.
+        None when the peak is unknown (CPU stand-in) or dt is zero."""
+        if self._peak_flops is None or dt <= 0:
+            return None
+        return tokens * self._decode_flops_per_tok / dt / self._peak_flops
 
     def _free_slots(self) -> "list[int]":
         # A row that finished EARLY (eos) while its multi-row request is
@@ -2442,6 +2484,7 @@ class GenerateEngine:
             self._obs.on_dispatch(n_active, len(self._pending),
                                   self._alloc.free,
                                   self._alloc.total - self._alloc.free)
+            self._obs.on_decode_dispatch(dt, self._decode_mfu(consumed, dt))
             self._obs.on_spec_dispatch(proposed, accepted, consumed,
                                        draft_s, verify_s)
             if self._obs.enabled:
@@ -2578,6 +2621,8 @@ class GenerateEngine:
                     self._alloc.free if self.paged else None,
                     (self._alloc.total - self._alloc.free)
                     if self.paged else None)
+                self._obs.on_decode_dispatch(
+                    dt, self._decode_mfu(consumed, dt))
                 if self._obs.enabled:
                     # One "decode" event per request per dispatch (not
                     # per token): slots is small, so this scan is noise

@@ -261,6 +261,7 @@ class InferenceServer:
                  max_pending: "int | None" = None,
                  kv_page_size: "int | None" = None,
                  kv_pages: "int | None" = None,
+                 attn_backend: str = "xla-gather",
                  lora_adapters: "str | None" = None,
                  draft_model: "str | None" = None,
                  draft_ckpt_dir: "str | None" = None,
@@ -310,7 +311,7 @@ class InferenceServer:
         # Request-lifecycle traces + latency histograms (k3stpu/obs).
         # ONE instance feeds /metrics, /debug/requests, /debug/trace —
         # and the engine loop's hooks when continuous batching is on.
-        self._obs = ServeObs(instance=instance)
+        self._obs = ServeObs(instance=instance, attn_backend=attn_backend)
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
         # Failure containment (docs/RESILIENCE.md): the engine-facing
         # knobs default ON here (the HTTP server is the production
@@ -599,6 +600,14 @@ class InferenceServer:
             # would silently do nothing.
             raise ValueError(
                 "--kv-page-size requires --continuous-batching")
+        if attn_backend != "xla-gather" and kv_page_size is None:
+            # The kernel walks block tables; without a paged pool there
+            # is nothing for it to walk.
+            raise ValueError(
+                f"--attn-backend {attn_backend} requires --kv-page-size "
+                f"(the paged Pallas kernel reads the page pool through "
+                f"block tables; the dense cache has none)")
+        self.attn_backend = attn_backend
         if speculate and not continuous_batching:
             raise ValueError(
                 "--speculate is the engine's n-gram draft-then-verify "
@@ -648,7 +657,8 @@ class InferenceServer:
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
                 max_pending=max_pending, page_size=kv_page_size,
-                num_pages=kv_pages, speculate=speculate,
+                num_pages=kv_pages, attn_backend=attn_backend,
+                speculate=speculate,
                 spec_gamma=spec_gamma, obs=self._obs,
                 breaker=self._breaker, watchdog_s=watchdog_s,
                 chaos=chaos, tier=self._tier,
@@ -1439,8 +1449,11 @@ class InferenceServer:
 
     def debug_timelines(self, n: int = 50) -> dict:
         """Last n request timelines (completed ring + live), newest
-        last — the GET /debug/requests payload."""
-        return {"requests": self._obs.timelines(n)}
+        last — the GET /debug/requests payload. Carries the active
+        attention backend so traces attribute decode latency to the
+        kernel that produced it."""
+        return {"requests": self._obs.timelines(n),
+                "attn_backend": self.attn_backend}
 
     def debug_trace(self) -> dict:
         """Chrome-trace-format export of the request ring — the GET
@@ -1961,6 +1974,16 @@ def main(argv=None) -> int:
                          "0); default = dense parity (slots * seq_len / "
                          "page_size + 1) — set LOWER to spend less HBM "
                          "than dense for the same slot count")
+    ap.add_argument("--attn-backend", default="xla-gather",
+                    choices=["xla-gather", "pallas-paged"],
+                    help="with --kv-page-size: how decode reads the KV "
+                         "pool. xla-gather materializes gathered pages "
+                         "in XLA (default); pallas-paged walks block "
+                         "tables inside the fused Pallas kernel "
+                         "(ops/paged_attention.py) — token-identical "
+                         "greedy output, no gather materialization. "
+                         "Off TPU the kernel runs interpreted (tests "
+                         "only)")
     ap.add_argument("--draft-model", default=None,
                     choices=["transformer", "transformer-tiny"],
                     help="speculative decoding draft for greedy "
@@ -2062,6 +2085,7 @@ def main(argv=None) -> int:
                              max_pending=args.max_pending,
                              kv_page_size=args.kv_page_size,
                              kv_pages=args.kv_pages,
+                             attn_backend=args.attn_backend,
                              lora_adapters=args.lora_adapters,
                              draft_model=args.draft_model,
                              draft_ckpt_dir=args.draft_ckpt_dir,

@@ -8,12 +8,23 @@ GLOBAL mesh, runs a psum over it, and prints one JSON result line.
 """
 
 import json
+import os
 import sys
 
-import jax
+# 2 local devices per process. Set the XLA_FLAGS lever BEFORE jax loads:
+# on jax builds predating jax_num_cpu_devices it is the only one.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=2").strip()
+
+import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 2)  # 2 local devices per process
+try:
+    jax.config.update("jax_num_cpu_devices", 2)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above already forces 2
 
 import numpy as np  # noqa: E402
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
@@ -26,7 +37,16 @@ def main() -> int:
     initialize(rdv)
 
     import jax.numpy as jnp
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:
+        # Older jax spells it jax.experimental.shard_map; the pre-vma
+        # replication check stays off — this program is vma-typed.
+        from jax.experimental.shard_map import shard_map as _esm
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+            return _esm(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=check_vma)
 
     devices = jax.devices()  # GLOBAL list after initialize
     mesh = Mesh(np.array(devices), ("d",))

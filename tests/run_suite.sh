@@ -52,7 +52,8 @@ SMOKE=(
   tests/test_container_runtime.py tests/test_device_plugin.py
   tests/test_e2e_assets.py
   tests/test_bench.py tests/test_graft_entry.py
-  tests/test_paged.py tests/test_obs.py tests/test_trace.py
+  tests/test_paged.py tests/test_paged_attention.py
+  tests/test_obs.py tests/test_trace.py
   tests/test_chaos.py tests/test_train_resilience.py
   tests/test_train_obs.py tests/test_metrics_lint.py
   tests/test_node_obs.py

@@ -13,6 +13,17 @@ from k3stpu.parallel.context import (
     ring_attention,
 )
 
+try:
+    from jax import shard_map
+except ImportError:
+    # Older jax spells it jax.experimental.shard_map; the pre-vma
+    # replication check stays off — these programs are vma-typed.
+    from jax.experimental.shard_map import shard_map as _esm
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=False):
+        return _esm(f, mesh=mesh, in_specs=in_specs,
+                    out_specs=out_specs, check_rep=check_vma)
+
 
 def _qkv(b=2, s=256, h=4, d=32, seed=0, dtype=jnp.float32):
     ks = jax.random.split(jax.random.key(seed), 3)
@@ -62,7 +73,6 @@ def test_ring_attention_differentiable():
     """Gradients flow through ppermute + fori_loop (training viability)."""
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = make_context_mesh(4)
@@ -132,7 +142,6 @@ def test_ring_flash_gradients_match_reference(causal):
     dk/dv accumulators) must produce exact grads vs full attention."""
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from k3stpu.parallel.context import ring_flash_attention
@@ -222,7 +231,6 @@ def test_ulysses_matches_full(causal):
 def test_ulysses_gradients_match_reference():
     from functools import partial
 
-    from jax import shard_map
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from k3stpu.parallel.context import ulysses_attention

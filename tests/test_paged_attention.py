@@ -1,0 +1,243 @@
+"""Ragged paged-attention Pallas kernel (k3stpu/ops/paged_attention.py).
+
+Two correctness bars. The KERNEL bar is parity with the XLA-gather
+reference oracle: fp32 pools agree to float rounding (the online
+softmax reorders reductions, so "bit-exact" is the wrong spec — the
+assert is a tight allclose), int8/bf16 agree within the quantization
+drift already accepted elsewhere. The ENGINE bar is the one the ISSUE
+pins: greedy fp32 token streams through GenerateEngine must be
+IDENTICAL between attn_backend="xla-gather" and "pallas-paged" — same
+prompts, same pages, same tokens — across ragged batches, COW shared
+prefixes, and page-boundary positions. CPU-JAX interpreter mode per
+SURVEY.md §4.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_reference,
+    paged_decode_bytes,
+)
+
+jax.config.update("jax_platform_name", "cpu")
+
+
+def _inputs(batch, t, q_heads, kv_heads, head_dim, max_seq, ps, lengths,
+            dtype=jnp.float32, int8=False, seed=0, shared_rows=None):
+    """Random pools + identity block tables (page 0 reserved as sink).
+    ``shared_rows=(a, b)`` makes row b's table alias row a's pages — the
+    engine's COW zero-copy prefix-sharing layout."""
+    rng = np.random.default_rng(seed)
+    n_bt = max_seq // ps
+    num_pages = 1 + batch * n_bt
+    q = jnp.asarray(rng.standard_normal(
+        (batch, t, q_heads, head_dim)), dtype)
+    bt = 1 + np.arange(batch * n_bt, dtype=np.int32).reshape(batch, n_bt)
+    if shared_rows is not None:
+        a, b = shared_rows
+        bt[b] = bt[a]
+    kw = {}
+    if int8:
+        kp = jnp.asarray(rng.integers(
+            -127, 128, (num_pages, ps, kv_heads, head_dim)), jnp.int8)
+        vp = jnp.asarray(rng.integers(
+            -127, 128, (num_pages, ps, kv_heads, head_dim)), jnp.int8)
+        kw["k_scale_pages"] = jnp.asarray(rng.uniform(
+            0.005, 0.03, (num_pages, ps, kv_heads)), jnp.float32)
+        kw["v_scale_pages"] = jnp.asarray(rng.uniform(
+            0.005, 0.03, (num_pages, ps, kv_heads)), jnp.float32)
+    else:
+        kp = jnp.asarray(rng.standard_normal(
+            (num_pages, ps, kv_heads, head_dim)), dtype)
+        vp = jnp.asarray(rng.standard_normal(
+            (num_pages, ps, kv_heads, head_dim)), dtype)
+    lens = jnp.asarray(np.asarray(lengths, np.int32))
+    return q, kp, vp, jnp.asarray(bt), lens, kw
+
+
+def _agree(q, kp, vp, bt, lens, kw, atol):
+    got = paged_attention(q, kp, vp, bt, lens, interpret=True, **kw)
+    want = paged_attention_reference(q, kp, vp, bt, lens, **kw)
+    assert got.shape == want.shape and got.dtype == want.dtype
+    err = float(jnp.max(jnp.abs(got.astype(jnp.float32)
+                                - want.astype(jnp.float32))))
+    assert err < atol, f"kernel vs reference drift {err} >= {atol}"
+
+
+def test_fp32_ragged_batches():
+    for lengths in ([1, 5, 8, 32], [3, 3, 3, 3], [32, 1, 17, 9]):
+        q, kp, vp, bt, lens, kw = _inputs(
+            4, 1, 4, 4, 32, 32, 8, lengths, seed=1)
+        _agree(q, kp, vp, bt, lens, kw, 1e-5)
+
+
+def test_fp32_page_boundaries():
+    # Every length within +-1 of a page edge, plus the exact edges and
+    # the full chain — the off-by-one surface of the in-kernel walk.
+    ps = 8
+    q, kp, vp, bt, lens, kw = _inputs(
+        6, 1, 4, 4, 32, 32, ps, [ps - 1, ps, ps + 1, 2 * ps, 31, 32],
+        seed=2)
+    _agree(q, kp, vp, bt, lens, kw, 1e-5)
+
+
+def test_fp32_grouped_query_heads():
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 1, 8, 2, 32, 32, 8, [5, 16, 29], seed=3)
+    _agree(q, kp, vp, bt, lens, kw, 1e-5)
+
+
+def test_fp32_multi_token_query_width():
+    # T=5 is the speculative verify width (gamma+1); each query token j
+    # must see exactly lengths - T + j + 1 keys.
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 5, 4, 4, 32, 64, 8, [7, 30, 64], seed=4)
+    _agree(q, kp, vp, bt, lens, kw, 1e-5)
+
+
+def test_fp32_cow_shared_prefix_pages():
+    # Rows 0 and 2 alias the SAME physical pages (the prompt cache's
+    # zero-copy sharing); identical q rows must produce identical
+    # outputs, and both must match the reference.
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 1, 4, 4, 32, 32, 8, [17, 9, 17], seed=5, shared_rows=(0, 2))
+    q = q.at[2].set(q[0])
+    _agree(q, kp, vp, bt, lens, kw, 1e-5)
+    out = paged_attention(q, kp, vp, bt, lens, interpret=True, **kw)
+    np.testing.assert_array_equal(np.asarray(out[0]), np.asarray(out[2]))
+
+
+def test_int8_pages_bounded_drift():
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 2, 4, 4, 32, 32, 8, [5, 20, 32], int8=True, seed=6)
+    _agree(q, kp, vp, bt, lens, kw, 1e-4)
+
+
+def test_bf16_pools_bounded_drift():
+    # bf16 pools: the kernel accumulates fp32 where the gather path
+    # rounds probs through bf16, so drift is bounded, not bit-tight.
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 1, 4, 4, 32, 32, 8, [5, 20, 32], dtype=jnp.bfloat16, seed=7)
+    _agree(q, kp, vp, bt, lens, kw, 5e-2)
+
+
+def test_kernel_rejects_bad_shapes():
+    q, kp, vp, bt, lens, kw = _inputs(3, 1, 4, 4, 32, 32, 8, [5, 9, 2])
+    with pytest.raises(ValueError, match="multiple of kv heads"):
+        paged_attention(q[:, :, :3], kp, vp, bt, lens, interpret=True)
+    with pytest.raises(ValueError, match="scale"):
+        paged_attention(q, kp.astype(jnp.int8), vp.astype(jnp.int8),
+                        bt, lens, interpret=True)
+
+
+def test_decode_bytes_model():
+    bb = paged_decode_bytes(4, [8, 64, 128, 200], 256, 8, 64, 16)
+    # The gather pays full width regardless of fill; the walk pays live
+    # pages only — the ratio is the whole point of the kernel.
+    assert bb["bytes_ratio"] > 1.0
+    assert bb["live_tokens"] < bb["full_tokens"]
+    full = paged_decode_bytes(4, [256] * 4, 256, 8, 64, 16)
+    assert full["bytes_ratio"] == pytest.approx(2.0)  # 4 passes vs 2
+
+
+# --- engine-level token identity (the ISSUE's acceptance bar) -----------
+
+
+@pytest.fixture(scope="module")
+def fp32_mp():
+    from k3stpu.models.transformer import transformer_lm_tiny
+
+    model = transformer_lm_tiny(max_seq_len=64, dtype=jnp.float32)
+    variables = model.init(jax.random.key(0),
+                           jnp.zeros((1, 8), jnp.int32), train=False)
+    return model, variables["params"]
+
+
+def _engine_tokens(model, params, backend, cases, **kw):
+    from k3stpu.serve.engine import GenerateEngine
+
+    eng = GenerateEngine(model, params, seed=0, slots=4, page_size=8,
+                         attn_backend=backend, **kw)
+    try:
+        outs = [eng.submit(p, max_new_tokens=8) for p in cases]
+        assert eng.stats()["attn_backend"] == backend
+        return outs
+    finally:
+        eng.close()
+
+
+def test_engine_greedy_token_identity(fp32_mp):
+    model, params = fp32_mp
+    cases = [
+        [[5, 6, 7]],
+        [[3, 4], [9, 10, 11, 12, 13]],                # ragged batch
+        [list(range(1, 20)), [40], [7, 8, 9]],        # 3 ragged rows
+        [[7, 8, 9, 10, 11, 12, 13, 14]],              # page-aligned prompt
+    ]
+    want = _engine_tokens(model, params, "xla-gather", cases)
+    got = _engine_tokens(model, params, "pallas-paged", cases)
+    assert got == want
+
+
+def test_engine_token_identity_shared_prefix(fp32_mp):
+    # The prompt cache's zero-copy COW page sharing under the kernel:
+    # a repeat prompt and an extending prompt both pin the ancestor's
+    # pages read-only into the new row's table.
+    model, params = fp32_mp
+    prefix = list(range(3, 14))
+    cases = [[prefix], [prefix], [prefix + [50, 51]]]
+    want = _engine_tokens(model, params, "xla-gather", cases,
+                          prompt_cache=4)
+    got = _engine_tokens(model, params, "pallas-paged", cases,
+                        prompt_cache=4)
+    assert got == want
+
+
+def test_engine_token_identity_speculative(fp32_mp):
+    # Speculative decoding's batch-wide verify extend runs the kernel at
+    # query width gamma+1 — the T>1 ragged path through the engine.
+    model, params = fp32_mp
+    prompt = [3, 4, 5, 3, 4, 5, 3, 4]      # repetitive: drafter engages
+    cases = [[prompt], [[9, 2, 9, 2, 9, 2]]]
+    want = _engine_tokens(model, params, "xla-gather", cases,
+                          speculate=True, spec_gamma=3)
+    got = _engine_tokens(model, params, "pallas-paged", cases,
+                         speculate=True, spec_gamma=3)
+    assert got == want
+
+
+def test_engine_validation_and_exposure():
+    from k3stpu.serve.engine import GenerateEngine
+    from k3stpu.models.transformer import transformer_lm_tiny
+
+    model = transformer_lm_tiny(max_seq_len=64)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    with pytest.raises(ValueError, match="requires page_size"):
+        GenerateEngine(model, params, attn_backend="pallas-paged")
+    with pytest.raises(ValueError, match="not in"):
+        GenerateEngine(model, params, page_size=8,
+                       attn_backend="flash-paged")
+
+
+def test_obs_backend_label_and_mfu_gauge():
+    from k3stpu.obs import ServeObs
+
+    obs = ServeObs(attn_backend="pallas-paged")
+    obs.on_decode_dispatch(0.004, mfu=0.31)
+    text = obs.render_prometheus()
+    assert ('k3stpu_serve_decode_dispatch_seconds_bucket'
+            '{le="0.005",backend="pallas-paged"}') in text
+    assert 'k3stpu_serve_decode_dispatch_seconds_count'\
+           '{backend="pallas-paged"} 1' in text
+    assert "k3stpu_serve_decode_mfu 0.31" in text
+    # None MFU (CPU stand-in) leaves the gauge where it was.
+    obs.on_decode_dispatch(0.004, mfu=None)
+    assert "k3stpu_serve_decode_mfu 0.31" in obs.render_prometheus()
+    obs.reset()
+    assert "k3stpu_serve_decode_mfu 0" in obs.render_prometheus()

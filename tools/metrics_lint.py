@@ -71,10 +71,11 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 # router's fixed routing-decision enum. A Labeled* family declaring any
 # OTHER key (rid, trace_id, pod, user...) is a cardinality bomb waiting
 # for a dashboard, so the lint rejects it until the key is reviewed and
-# added here.
+# added here. "backend" is the attention-backend enum (xla-gather /
+# pallas-paged), fixed at construction on the decode-dispatch histogram.
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       "component", "version", "instance",
-                      "replica", "reason"}
+                      "replica", "reason", "backend"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
@@ -208,9 +209,15 @@ def lint() -> "list[str]":
 
 def _labeled_families() -> "list[tuple[str, tuple]]":
     """(family name, declared label keys) for every Labeled*/InfoGauge
-    family on the real facades — the cardinality lint's scan surface."""
+    family — and every Histogram carrying a constant label set — on the
+    real facades: the cardinality lint's scan surface."""
     from k3stpu.obs import ServeObs
-    from k3stpu.obs.hist import InfoGauge, LabeledCounter, LabeledGauge
+    from k3stpu.obs.hist import (
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledGauge,
+    )
     from k3stpu.obs.node_exporter import NodeCollector
     from k3stpu.obs.train import TrainObs
     from k3stpu.router.obs import RouterObs
@@ -223,6 +230,8 @@ def _labeled_families() -> "list[tuple[str, tuple]]":
             if isinstance(attr, (LabeledCounter, LabeledGauge)):
                 out.append((attr.name, (attr.label,)))
             elif isinstance(attr, InfoGauge):
+                out.append((attr.name, tuple(sorted(attr.labels))))
+            elif isinstance(attr, Histogram) and attr.labels:
                 out.append((attr.name, tuple(sorted(attr.labels))))
     return out
 
