@@ -47,6 +47,16 @@ hit) while the round-robin baseline stays <= 60%, and the router's
 measured proxy overhead p50 must stay <= 5% of the request p50
 (vs_baseline = sticky_rate/0.90, >= 1.0 passes all three in detail).
 
+``--serve-autoscale`` gates the autoscaler subsystem (same contract):
+the whole loop cluster-free — a LocalProcessActuator fleet of real
+server subprocesses, the router hot-reloading membership from the
+actuator's replicas file, and the controller scraping real /metrics.
+Under loadgen's ramp the fleet must scale 1->2 and back with zero
+failed requests, and a session parked by the scale-down drain protocol
+(released with spill=true) must serve its next turn warm on the
+survivor: restore <= 1/3 of a cold re-prefill, the --serve-tier bound
+(vs_baseline = ratio*3, <=1.0 passes; scale/zero-fail gates in detail).
+
 ``--train-obs`` is the training twin (same contract): median step time
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
@@ -1216,6 +1226,385 @@ def _serve_router_main() -> int:
                  **skw)
 
 
+def _serve_autoscale_worker() -> int:
+    """Autoscaler gate (bounded subprocess; the parent process of this
+    worker never imports jax — the replicas are REAL server
+    subprocesses spawned by the LocalProcessActuator, sharing one spill
+    dir and one compilation cache).
+
+    Topology: actuator fleet of ``python -m k3stpu.serve.server``
+    processes; in-process Router with a FileWatcher on the actuator's
+    replicas file (the same handshake production uses); in-process
+    Controller scraping the replicas' real /metrics through the
+    router's /debug/router membership.
+
+    Gates (all three must hold):
+    - scale 1->2 and back: loadgen's ramp (1x -> 8x -> 2x, 2 engine
+      slots per replica so the surge actually queues) must push queue
+      depth over the bar and the recede must drain it back under.
+    - zero failed requests: ramp errors == 0 and no client gave up
+      on 503s — scale-up, drain, and kill are all invisible to traffic.
+    - warm restore after scale-down: a session pinned to the victim is
+      released with spill=true by the drain protocol; its next turn on
+      the survivor must cost <= 1/3 of a cold re-prefill (the
+      --serve-tier bound) AND move the survivor's tier swap-in counter
+      (time could lie; the counter can't)."""
+    import random
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    from k3stpu.autoscaler import Controller, DecisionPolicy, LocalProcessActuator
+    from k3stpu.router import FileWatcher, Router, make_router_app
+    from k3stpu.serve.loadgen import run_ramp
+
+    # 512-token prompts with --seq-len 2048 (the tier gate's geometry):
+    # the grown turn-2 prompt (512 + reply + 2) buckets to a 1024-wide
+    # prefill, so "cold" costs a real re-prefill while the warm turn
+    # pays a swap-in + a 64-bucket suffix.
+    prompt_len, reply = 512, 8
+    workdir = tempfile.mkdtemp(prefix="bench-autoscale-")
+    tier_dir = os.path.join(workdir, "tier")
+    os.makedirs(tier_dir, exist_ok=True)
+    replicas_file = os.path.join(workdir, "replicas.txt")
+    base_port = random.randint(20000, 40000)
+
+    def spawn(index: int, port: int) -> "list[str]":
+        return [sys.executable, "-m", "k3stpu.serve.server",
+                "--model", "transformer-tiny", "--seq-len", "2048",
+                "--port", str(port), "--batch-window-ms", "0",
+                "--continuous-batching", "--engine-slots", "2",
+                "--decode-block", "4", "--prompt-cache", "8",
+                "--kv-page-size", "64", "--kv-pages", "64",
+                "--tier-host-mb", "64", "--tier-dir", tier_dir,
+                "--no-warmup", "--instance", f"as-rep-{index}"]
+
+    def prompt_for(seed: int) -> "list[int]":
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 1000, size=(prompt_len,)).tolist()
+
+    def post(url: str, path: str, body: dict, timeout: float = 180.0) -> dict:
+        data = json.dumps(body).encode()
+        for attempt in range(4):
+            req = urllib.request.Request(
+                url + path, data=data, method="POST",
+                headers={"Content-Type": "application/json"})
+            try:
+                with urllib.request.urlopen(req, timeout=timeout) as r:
+                    return json.loads(r.read().decode())
+            except urllib.error.HTTPError as e:
+                with e:
+                    detail = e.read()[:200]
+                if e.code == 503 and attempt < 3:  # shed/drain: retry
+                    time.sleep(0.5)
+                    continue
+                raise RuntimeError(f"{path} -> {e.code}: {detail!r}")
+        raise RuntimeError(f"{path}: retries exhausted")
+
+    def counter(url: str, name: str) -> float:
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        for line in text.splitlines():
+            if line.startswith(name + " "):
+                return float(line.split()[1])
+        return 0.0
+
+    def warm_replica(url: str, seed: int) -> None:
+        """Compile every program the measured turns hit on THIS
+        replica: turn-1 512-bucket prefill + decode, suffix 64-bucket
+        prefill, host-park restore, and the disk-spill load path."""
+        p = prompt_for(seed)
+        rep = post(url, "/v1/generate",
+                   {"prompt_tokens": [p], "max_new_tokens": reply,
+                    "session": "warmup"})["tokens"][0]
+        post(url, "/v1/session/release", {"session": "warmup"})
+        p2 = p + rep + [1, 2]
+        post(url, "/v1/generate",
+             {"prompt_tokens": [p2], "max_new_tokens": 1,
+              "session": "warmup"})
+        post(url, "/v1/session/release",
+             {"session": "warmup", "spill": True})
+        post(url, "/v1/generate",
+             {"prompt_tokens": [p2 + [3]], "max_new_tokens": 1,
+              "session": "warmup"})
+        post(url, "/v1/session/release", {"session": "warmup"})
+
+    def until(cond, deadline_s: float, every: float = 0.25) -> bool:
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            if cond():
+                return True
+            time.sleep(every)
+        return cond()
+
+    def healthy(url: str) -> bool:
+        try:
+            with urllib.request.urlopen(url + "/healthz",
+                                        timeout=2.0) as r:
+                return r.status == 200
+        except OSError:
+            return False
+
+    actuator = LocalProcessActuator(
+        spawn, base_port=base_port, replicas_file=replicas_file,
+        ready_timeout_s=180.0, kill_timeout_s=30.0)
+    router = Router([], allow_empty=True, health_period_s=0.5,
+                    proxy_timeout_s=180.0, instance="bench-autoscale")
+    # Without the poller a replica ejected during its boot window (the
+    # watcher adds it at Popen; /healthz serves ~15s later) would stay
+    # ejected forever and never take a placement.
+    router.start_health_poller()
+    rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 make_router_app(router))
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    watcher = FileWatcher(router, replicas_file, period_s=0.2)
+
+    # Queue depth is the only live signal (the latency histograms are
+    # cumulative, so a surge would block scale-down forever — neutralize
+    # them); 8 clients against 2 engine slots queues well past 1.0.
+    policy = DecisionPolicy(
+        min_replicas=1, max_replicas=2, queue_high=1.0, queue_low=0.25,
+        pages_free_low=0.05, queue_wait_high_s=1e9, ttft_high_s=1e9,
+        scale_up_cooldown_s=5.0, scale_down_cooldown_s=1.0)
+    controller = Controller(actuator, policy, router_url=rurl,
+                            drain_deadline_s=15.0, drain_poll_s=0.1)
+    reports: "list[dict]" = []
+    ctl_stop = threading.Event()
+    ctl_hold = threading.Event()  # measurement scaffolding: pause steps
+
+    def ctl_loop() -> None:
+        while not ctl_stop.wait(0.5):
+            if ctl_hold.is_set():
+                continue
+            try:
+                reports.append(controller.step())
+            except Exception as e:  # noqa: BLE001 — loop must survive
+                print(f"bench: controller step failed: {e}", flush=True)
+
+    try:
+        actuator.scale_to(1)
+        watcher.poll_once()
+        watcher.start()
+        rep0 = actuator.urls()[0]
+        warm_replica(rep0, 9000)
+        # Two sessions pinned to replica 0 BEFORE the surge: the victim
+        # pick is fewest-pins, so the scale-up replica (one parked
+        # session) is the victim and ITS session must migrate.
+        parked0 = []
+        for i in range(2):
+            p = prompt_for(100 + i)
+            rep = post(rurl, "/v1/generate",
+                       {"prompt_tokens": [p], "max_new_tokens": reply,
+                        "session": f"park-a{i}"})["tokens"][0]
+            parked0.append(p + rep + [5, 6])
+
+        threading.Thread(target=ctl_loop, daemon=True).start()
+        ramp_result: dict = {}
+
+        def ramp_thread() -> None:
+            ramp_result.update(run_ramp(
+                rurl, phases=[(1, 4.0), (8, 30.0), (2, 8.0)],
+                rows=32, input_shape=(), input_dtype="int32",
+                generate_tokens=32))
+
+        rt = threading.Thread(target=ramp_thread, daemon=True)
+        rt.start()
+
+        scaled_up = until(lambda: actuator.current() == 2
+                          and len(router.replicas()) == 2, 40.0)
+        victim_session, victim_prompt, victim_url = None, None, None
+        if scaled_up:
+            # Hold the controller while warming/parking on the new
+            # replica: once the ramp recedes it would otherwise drain
+            # and kill exactly this replica (fewest pins) mid-warm.
+            ctl_hold.set()
+            new_url = [u for u in actuator.urls() if u != rep0][0]
+            # current() counts the replica from Popen on; boot (the jax
+            # import + model build) finishes inside the actuator's own
+            # health-wait. Gate the warm-up on the replica serving.
+            if not until(lambda: healthy(new_url), 120.0):
+                raise RuntimeError(f"scale-up replica {new_url} "
+                                   "never became healthy")
+            warm_replica(new_url, 9100)
+            # Land one session on the scale-up replica (prefix-hash
+            # placement: distinct prompts spread ~50/50, so a handful
+            # of tries suffices).
+            for i in range(16):
+                sid = f"park-b{i}"
+                p = prompt_for(500 + i)
+                rep = post(rurl, "/v1/generate",
+                           {"prompt_tokens": [p],
+                            "max_new_tokens": reply,
+                            "session": sid})["tokens"][0]
+                pinned = router.state()["pins"].get(sid)
+                if pinned == new_url:
+                    victim_session = sid
+                    victim_prompt = p + rep + [5, 6]
+                    victim_url = new_url
+                    break
+                post(rurl, "/v1/session/release", {"session": sid})
+            ctl_hold.clear()
+        rt.join(timeout=120.0)
+
+        scaled_down = until(
+            lambda: any(r["action"] == "down" for r in reports)
+            and actuator.current() == 1, 90.0)
+        ctl_stop.set()
+        until(lambda: len(router.replicas()) == 1, 10.0)
+        survivor = actuator.urls()[0] if actuator.urls() else rep0
+
+        warm_s, swap_delta, cold_med = -1.0, 0.0, -1.0
+        warm_client_s, cold_client_s = -1.0, -1.0
+        if scaled_down and victim_session is not None \
+                and survivor != victim_url:
+            # Warm and cold are read from the SURVIVOR's own e2e
+            # histogram (sum delta around each single request): the
+            # restore-vs-reprefill comparison is a server-side
+            # property, and a one-shot client wall time folds in
+            # router/GIL jitter from the processes this bench itself
+            # is running. Client wall times ride along in the detail.
+            e2e = "k3stpu_request_e2e_seconds_sum"
+            swapc = "k3stpu_tier_swap_ins_total"
+            swaps0 = counter(survivor, swapc)
+            # Best-of-3 like the tier gate: the first attempt is the
+            # true post-drain disk restore; between attempts the
+            # session re-parks with spill=true so every attempt stays
+            # a tier restore. An attempt only COUNTS if its own
+            # swap-in delta moved — a pcache hit sneaking in (however
+            # it got there) must not masquerade as a restore.
+            warm_tries, warm_client = [], []
+            for k in range(3):
+                s0 = counter(survivor, swapc)
+                e0 = counter(survivor, e2e)
+                t0 = time.perf_counter()
+                post(rurl, "/v1/generate",
+                     {"prompt_tokens": [victim_prompt],
+                      "max_new_tokens": 1, "session": victim_session})
+                wall = time.perf_counter() - t0
+                if counter(survivor, swapc) - s0 >= 1.0:
+                    warm_client.append(wall)
+                    warm_tries.append(counter(survivor, e2e) - e0)
+                if k < 2:
+                    post(rurl, "/v1/session/release",
+                         {"session": victim_session, "spill": True})
+            if warm_tries:
+                warm_s = min(warm_tries)
+                warm_client_s = min(warm_client)
+            swap_delta = counter(survivor, swapc) - swaps0
+            try:  # lifecycle breakdown of the measured turn (stderr,
+                #   keeps the stdout BENCH_JSON contract clean)
+                with urllib.request.urlopen(
+                        survivor + "/debug/requests", timeout=10) as r:
+                    dbg = json.loads(r.read().decode())
+                print("warm turn trace: "
+                      + json.dumps(dbg.get("requests", dbg)[-1:]),
+                      file=sys.stderr, flush=True)
+            except Exception as e:  # noqa: BLE001 — diagnostics only
+                print(f"warm turn trace unavailable: {e}",
+                      file=sys.stderr, flush=True)
+            cold_s, cold_client = [], []
+            for i in range(3):
+                rng = np.random.default_rng(700 + i)
+                cold_p = rng.integers(
+                    1, 1000, size=(len(victim_prompt),)).tolist()
+                e0 = counter(survivor, e2e)
+                t0 = time.perf_counter()
+                post(rurl, "/v1/generate",
+                     {"prompt_tokens": [cold_p], "max_new_tokens": 1})
+                cold_client.append(time.perf_counter() - t0)
+                cold_s.append(counter(survivor, e2e) - e0)
+            cold_med = sorted(cold_s)[1]
+            cold_client_s = sorted(cold_client)[1]
+    finally:
+        ctl_stop.set()
+        watcher.stop()
+        rhttpd.shutdown()
+        router.close()
+        actuator.close()
+
+    ratio = (warm_s / max(cold_med, 1e-9)) if warm_s > 0 else 99.0
+    scale_events = [r["action"] for r in reports
+                    if r["action"] in ("up", "down")]
+    zero_failed = (bool(ramp_result)
+                   and ramp_result.get("errors", 1) == 0
+                   and ramp_result.get("gave_up_503", 1) == 0)
+    doc = {
+        # Headline: the migrated session's warm-turn cost over a cold
+        # re-prefill on the survivor. Bar 1/3; vs_baseline = ratio*3.
+        "metric": "serve_autoscale_warm_restore_ratio",
+        "value": round(ratio, 4),
+        "unit": "warm_turn_s_over_cold_reprefill_s",
+        "vs_baseline": round(ratio * 3.0, 4),
+        "detail": {
+            "gate_warm_over_cold_max": round(1.0 / 3.0, 4),
+            "warm_gate_passed": ratio <= 1.0 / 3.0 and swap_delta >= 1,
+            "scale_gate_passed": scaled_up and scaled_down,
+            "zero_failed_gate_passed": zero_failed,
+            "warm_turn_s": round(warm_s, 6),
+            "cold_reprefill_s": round(cold_med, 6),
+            "warm_turn_client_s": round(warm_client_s, 6),
+            "cold_reprefill_client_s": round(cold_client_s, 6),
+            "survivor_swap_ins_delta": swap_delta,
+            "scale_events": scale_events,
+            "controller_steps": len(reports),
+            "ramp_requests": ramp_result.get("requests", 0),
+            "ramp_errors": ramp_result.get("errors", -1),
+            "ramp_retries_503": ramp_result.get("retries_503", -1),
+            "ramp_gave_up_503": ramp_result.get("gave_up_503", -1),
+            "ramp_phase_p50_ms": [ph.get("p50_ms")
+                                  for ph in ramp_result.get(
+                                      "ramp_phases", [])],
+            "prompt_tokens": prompt_len,
+            "replicas_peak": 2,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_autoscale_main() -> int:
+    """Bounded-subprocess wrapper for --serve-autoscale (same
+    wedge-proof discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-autoscale-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False,
+        stage="serve_autoscale")
+    skw = {"metric": "serve_autoscale_warm_restore_ratio",
+           "unit": "warm_turn_s_over_cold_reprefill_s"}
+    if not ok:
+        why = (f"autoscale bench did not finish within "
+               f"{MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_autoscale", f"{why}; stderr: {err.strip()}",
+                     **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _train_obs_worker() -> int:
     """TrainObs overhead microbench (bounded subprocess).
 
@@ -1696,6 +2085,10 @@ if __name__ == "__main__":
         sys.exit(_serve_router_worker())
     if "--serve-router" in sys.argv[1:]:
         sys.exit(_serve_router_main())
+    if "--serve-autoscale-worker" in sys.argv[1:]:
+        sys.exit(_serve_autoscale_worker())
+    if "--serve-autoscale" in sys.argv[1:]:
+        sys.exit(_serve_autoscale_main())
     if "--train-obs-worker" in sys.argv[1:]:
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:

@@ -1,0 +1,40 @@
+"""Metrics-driven autoscaler for the routed inference fleet
+(docs/AUTOSCALING.md).
+
+A zero-dep control loop that scrapes each replica's ``/metrics``
+(queue depth, pages-free headroom, p50 queue wait, p50 TTFT), derives
+a desired replica count with hysteresis + per-direction cool-downs +
+min/max bounds, and actuates it — the Kubernetes Deployment ``scale``
+subresource in-cluster, or real server subprocesses locally. Scale-down
+is loss-free by protocol: the victim is drained through the router
+(``POST /v1/admin/drain``), its pinned sessions released with
+``spill=true`` so chains park through the KV tier's disk format, and
+only then is the count reduced — the survivor adopts the parked chains
+and the next turn restores warm. Exports ``k3stpu_autoscaler_*``
+Prometheus families; chaos point ``scale_actuate`` proves actuator
+failure degrades to a frozen fleet, never a thrashing one.
+
+Run: python -m k3stpu.autoscaler --mode k8s --deployment tpu-inference \
+         --router http://tpu-router:8095
+"""
+
+from k3stpu.autoscaler.actuators import (  # noqa: F401
+    DryRunActuator,
+    KubernetesActuator,
+    LocalProcessActuator,
+    ScaleError,
+)
+from k3stpu.autoscaler.controller import (  # noqa: F401
+    Controller,
+    DecisionPolicy,
+    main,
+    make_autoscaler_app,
+)
+from k3stpu.autoscaler.obs import SCALE_DIRECTIONS, AutoscalerObs  # noqa: F401
+from k3stpu.autoscaler.signals import (  # noqa: F401
+    FleetSignals,
+    ReplicaSample,
+    collect,
+    parse_replica_metrics,
+    scrape,
+)

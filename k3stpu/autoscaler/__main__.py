@@ -1,0 +1,8 @@
+"""``python -m k3stpu.autoscaler`` — run the fleet autoscaler."""
+
+import sys
+
+from k3stpu.autoscaler.controller import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,275 @@
+"""Scale actuators: how a desired replica count becomes real.
+
+Two real actuators behind one duck-typed contract (``current() -> int``
+and ``scale_to(n, victims=None)``), plus a dry-run wrapper:
+
+- **KubernetesActuator** drives the Deployment ``scale`` subresource
+  over the in-cluster API with stdlib HTTP — bearer token and CA from
+  the service-account mount, ``application/merge-patch+json`` PATCH of
+  ``spec.replicas``. RBAC needs exactly ``deployments/scale`` get+patch
+  (chart ``autoscaler.enabled`` wires the Role). ``victims`` is
+  accepted and ignored: which pod the ReplicaSet reaps is its choice —
+  the drain protocol ran first, so whichever pod dies, its sessions
+  are already parked in the shared tier.
+- **LocalProcessActuator** spawns/kills real server subprocesses on
+  this machine, so the whole controller loop — signals, decision,
+  drain, actuation — is testable (and benchable) without a cluster.
+  Scale-down SIGTERMs the victim (the server's own drain trio runs)
+  and escalates to SIGKILL past a deadline. With ``replicas_file``
+  set, the URL list is atomically rewritten after every change — the
+  handshake the router's FileWatcher hot-reloads membership from.
+- **DryRunActuator** wraps either: ``scale_to`` logs and records
+  instead of acting (``--dry-run`` — watch what the controller WOULD
+  do against production metrics before giving it the keys).
+
+All stdlib. Failures raise ``ScaleError``; the controller catches,
+backs off, and keeps the last-known-good count (chaos point
+``scale_actuate`` injects exactly this).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import ssl
+import subprocess
+import time
+import urllib.error
+import urllib.request
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ScaleError(RuntimeError):
+    """An actuator call failed; the fleet is whatever it was."""
+
+
+class DryRunActuator:
+    """Observe-only wrapper: decisions are computed and logged, nothing
+    changes. ``calls`` records every would-be scale for tests/ops."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.calls: "list[int]" = []
+
+    def current(self) -> int:
+        return self.inner.current()
+
+    def urls(self) -> "list[str]":
+        return self.inner.urls()
+
+    def scale_to(self, n: int, victims: "list[str] | None" = None) -> None:
+        self.calls.append(n)
+        print(f"autoscaler: DRY-RUN scale_to({n})"
+              + (f" victims={victims}" if victims else ""), flush=True)
+
+
+class LocalProcessActuator:
+    """A fleet of real server subprocesses on this host.
+
+    spawn_command: callable ``(index, port) -> list[str]`` building the
+        argv for replica ``index`` listening on ``port``. The default
+        fleet in bench/tests passes a closure over ``sys.executable``
+        and the server flags (tier dir shared across the fleet — that
+        sharing IS the warm-handoff path).
+    base_port: replica ``i`` listens on ``base_port + i``. Ports are
+        reused by index, so a scale 1→3→1→3 reboots the same URLs and
+        the router's ring placement stays stable.
+    replicas_file: optional path rewritten (tmp + atomic rename) after
+        every membership change — the router FileWatcher handshake.
+    """
+
+    def __init__(self, spawn_command, base_port: int = 8196, *,
+                 host: str = "127.0.0.1",
+                 replicas_file: "str | None" = None,
+                 ready_timeout_s: float = 120.0,
+                 kill_timeout_s: float = 10.0):
+        self.spawn_command = spawn_command
+        self.base_port = base_port
+        self.host = host
+        self.replicas_file = replicas_file
+        self.ready_timeout_s = ready_timeout_s
+        self.kill_timeout_s = kill_timeout_s
+        self._procs: "list[subprocess.Popen]" = []
+        self._write_replicas_file()
+
+    def current(self) -> int:
+        return len(self._procs)
+
+    def url(self, index: int) -> str:
+        return f"http://{self.host}:{self.base_port + index}"
+
+    def urls(self) -> "list[str]":
+        return [self.url(i) for i in range(len(self._procs))]
+
+    def _write_replicas_file(self) -> None:
+        if self.replicas_file is None:
+            return
+        tmp = self.replicas_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(self.urls()) + "\n")
+        os.replace(tmp, self.replicas_file)
+
+    def _wait_ready(self, index: int) -> None:
+        url = self.url(index) + "/healthz"
+        deadline = time.monotonic() + self.ready_timeout_s
+        while time.monotonic() < deadline:
+            proc = self._procs[index]
+            if proc.poll() is not None:
+                raise ScaleError(
+                    f"replica {index} exited rc={proc.returncode} "
+                    "before becoming ready")
+            try:
+                with urllib.request.urlopen(url, timeout=1.0) as resp:
+                    if resp.status == 200:
+                        return
+            except OSError:
+                pass
+            time.sleep(0.2)
+        raise ScaleError(f"replica {index} not ready within "
+                         f"{self.ready_timeout_s:.0f}s")
+
+    def scale_to(self, n: int, victims: "list[str] | None" = None) -> None:
+        """Spawn up or kill down to ``n`` processes. ``victims`` names
+        replica URLs to prefer killing (the controller's drained pick);
+        un-named victims die highest-index-first. Spawned replicas are
+        health-waited so a scale-up returning means a servable fleet."""
+        if n < 0:
+            raise ScaleError(f"cannot scale to {n}")
+        while len(self._procs) < n:
+            index = len(self._procs)
+            cmd = self.spawn_command(index, self.base_port + index)
+            try:
+                proc = subprocess.Popen(cmd)
+            except OSError as e:
+                raise ScaleError(f"spawn failed: {e}") from e
+            self._procs.append(proc)
+            self._write_replicas_file()
+            try:
+                self._wait_ready(index)
+            except ScaleError:
+                self._procs.pop()
+                self._reap(proc)
+                self._write_replicas_file()
+                raise
+        if len(self._procs) > n:
+            order = list(range(len(self._procs)))
+            victim_idx = []
+            for v in (victims or []):
+                for i in order:
+                    if self.url(i) == v.rstrip("/") and i not in victim_idx:
+                        victim_idx.append(i)
+            for i in reversed(order):
+                if len(victim_idx) >= len(self._procs) - n:
+                    break
+                if i not in victim_idx:
+                    victim_idx.append(i)
+            keep = [p for i, p in enumerate(self._procs)
+                    if i not in victim_idx]
+            dead = [p for i, p in enumerate(self._procs)
+                    if i in victim_idx]
+            self._procs = keep
+            self._write_replicas_file()
+            for proc in dead:
+                self._reap(proc)
+
+    def _reap(self, proc: "subprocess.Popen") -> None:
+        """SIGTERM (the server drains: in-flight requests finish) then
+        SIGKILL past the deadline."""
+        if proc.poll() is not None:
+            return
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=self.kill_timeout_s)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=5.0)
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        """Kill the whole fleet (test/bench teardown)."""
+        dead, self._procs = self._procs, []
+        self._write_replicas_file()
+        for proc in dead:
+            self._reap(proc)
+
+
+class KubernetesActuator:
+    """The Deployment ``scale`` subresource over the in-cluster API.
+
+    GET reads ``spec.replicas`` (the declared count — actual pod
+    readiness is the Endpoints watcher's and the router poller's
+    concern); PATCH merge-patches it. ``sa_dir``/``api_base`` are
+    injectable so tests drive the HTTP path against a stub server."""
+
+    def __init__(self, namespace: str, deployment: str, *,
+                 sa_dir: str = _SA_DIR,
+                 api_base: "str | None" = None,
+                 timeout_s: float = 10.0):
+        self.namespace = namespace
+        self.deployment = deployment
+        self.sa_dir = sa_dir
+        self.timeout_s = timeout_s
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST",
+                                  "kubernetes.default.svc")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            api_base = f"https://{host}:{port}"
+        self.api_base = api_base.rstrip("/")
+
+    def _scale_url(self) -> str:
+        return (f"{self.api_base}/apis/apps/v1/namespaces/"
+                f"{self.namespace}/deployments/{self.deployment}/scale")
+
+    def _request(self, method: str, body: "bytes | None" = None,
+                 content_type: "str | None" = None) -> dict:
+        headers = {}
+        try:
+            with open(os.path.join(self.sa_dir, "token"),
+                      encoding="utf-8") as f:
+                headers["Authorization"] = f"Bearer {f.read().strip()}"
+        except OSError as e:
+            raise ScaleError(f"service-account token unreadable: {e}") \
+                from e
+        if content_type:
+            headers["Content-Type"] = content_type
+        ctx = None
+        cafile = os.path.join(self.sa_dir, "ca.crt")
+        if self.api_base.startswith("https://"):
+            try:
+                ctx = ssl.create_default_context(cafile=cafile)
+            except (OSError, ssl.SSLError) as e:
+                raise ScaleError(f"service-account CA unreadable: {e}") \
+                    from e
+        req = urllib.request.Request(self._scale_url(), data=body,
+                                     method=method, headers=headers)
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                        context=ctx) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            with e:
+                detail = e.read()[:200]
+            raise ScaleError(
+                f"{method} scale -> {e.code}: {detail!r}") from e
+        except (OSError, json.JSONDecodeError) as e:
+            raise ScaleError(f"{method} scale failed: {e}") from e
+
+    def current(self) -> int:
+        doc = self._request("GET")
+        try:
+            return int(doc["spec"]["replicas"])
+        except (KeyError, TypeError, ValueError) as e:
+            raise ScaleError(f"malformed scale object: {doc}") from e
+
+    def urls(self) -> "list[str]":
+        return []  # replica URLs come from the Endpoints watcher
+
+    def scale_to(self, n: int, victims: "list[str] | None" = None) -> None:
+        if n < 0:
+            raise ScaleError(f"cannot scale to {n}")
+        body = json.dumps({"spec": {"replicas": int(n)}}).encode()
+        self._request("PATCH", body=body,
+                      content_type="application/merge-patch+json")

@@ -1,0 +1,554 @@
+"""The autoscaler controller: scrape → decide → (drain →) actuate.
+
+One reconcile ``step()`` is deterministic and side-effect-explicit so
+tests and the bench drive it directly with an injected clock; ``run()``
+just loops it on a period. The decision half (``DecisionPolicy``) is a
+pure function of (signals, current count, clock) plus two timestamps —
+no hidden state beyond the cool-down bookkeeping.
+
+Decision policy (docs/AUTOSCALING.md):
+
+- **Scale up** when any pressure signal breaches its high threshold:
+  per-replica queue depth, pages-free fraction under the floor, p50
+  queue wait (prefill backlog), or p50 TTFT. Queue depth sizes the
+  target (ceil(total_queue / queue_high) — one step of proportional
+  control); the latency/headroom signals add one replica each round
+  (their units don't convert to replica counts honestly).
+- **Scale down** only when EVERY signal sits below its low threshold —
+  the low bar is deliberately far under the high bar (hysteresis), and
+  down-steps move one replica at a time.
+- **Cool-downs** gate each direction separately: a scale-up is cheap
+  and urgent (short window), a scale-down destroys warm state and is
+  in no hurry (long window).
+- **Bounds** clamp last; a fleet below ``min_replicas`` repairs
+  immediately, cool-down or not.
+
+Scale-down is loss-free by protocol, not luck (the drain timeline in
+docs/AUTOSCALING.md): mark the victim draining in the router (no NEW
+pins), release each of its pinned sessions with ``spill=true`` (chains
+park through the tier's disk format the survivor can adopt), wait for
+its in-flight count to reach zero, and only then reduce the count.
+
+Chaos point ``scale_actuate`` fires per actuator call: on failure the
+controller emits the event, backs off exponentially, and keeps the
+last-known-good count — a broken apiserver must degrade to "fleet
+frozen", never "fleet thrashing" (docs/RESILIENCE.md).
+
+Run: python -m k3stpu.autoscaler --mode k8s --deployment tpu-inference \
+         --router http://tpu-router:8095
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k3stpu.autoscaler.actuators import (
+    DryRunActuator,
+    KubernetesActuator,
+    LocalProcessActuator,
+    ScaleError,
+)
+from k3stpu.autoscaler.obs import AutoscalerObs
+from k3stpu.autoscaler.signals import FleetSignals, collect
+
+
+class DecisionPolicy:
+    """Signals + current count -> desired count, with hysteresis,
+    per-direction cool-downs, and min/max bounds."""
+
+    def __init__(self, *,
+                 min_replicas: int = 1,
+                 max_replicas: int = 4,
+                 queue_high: float = 4.0,
+                 queue_low: float = 0.5,
+                 pages_free_low: float = 0.15,
+                 queue_wait_high_s: float = 1.0,
+                 ttft_high_s: float = 2.0,
+                 scale_up_cooldown_s: float = 15.0,
+                 scale_down_cooldown_s: float = 60.0):
+        if min_replicas < 1:
+            raise ValueError("min_replicas must be >= 1")
+        if max_replicas < min_replicas:
+            raise ValueError("max_replicas must be >= min_replicas")
+        if queue_low >= queue_high:
+            raise ValueError("queue_low must sit below queue_high "
+                             "(the hysteresis band)")
+        self.min_replicas = min_replicas
+        self.max_replicas = max_replicas
+        self.queue_high = queue_high
+        self.queue_low = queue_low
+        self.pages_free_low = pages_free_low
+        self.queue_wait_high_s = queue_wait_high_s
+        self.ttft_high_s = ttft_high_s
+        self.scale_up_cooldown_s = scale_up_cooldown_s
+        self.scale_down_cooldown_s = scale_down_cooldown_s
+        self._last_up_t: "float | None" = None
+        self._last_down_t: "float | None" = None
+
+    def note_scaled(self, direction: str, now: float) -> None:
+        """Called by the controller AFTER a successful actuation —
+        failed actuations must not start a cool-down (they already back
+        off) and dry-run decisions must keep re-announcing."""
+        if direction == "up":
+            self._last_up_t = now
+        else:
+            self._last_down_t = now
+
+    def _cooling(self, direction: str, now: float) -> bool:
+        if direction == "up":
+            return (self._last_up_t is not None
+                    and now - self._last_up_t < self.scale_up_cooldown_s)
+        return (self._last_down_t is not None
+                and now - self._last_down_t < self.scale_down_cooldown_s)
+
+    def decide(self, fleet: FleetSignals, current: int,
+               now: float) -> "tuple[int, list[str]]":
+        """Returns (desired, reasons). ``desired == current`` with a
+        non-empty reasons list means a move was wanted but vetoed
+        (cool-down) — the controller logs it but does not actuate."""
+        # Bounds repair runs before everything: a fleet below the floor
+        # is a config/boot state, not a load decision.
+        if current < self.min_replicas:
+            return self.min_replicas, ["below min_replicas"]
+        if current > self.max_replicas:
+            return self.max_replicas, ["above max_replicas"]
+
+        up_targets: "list[int]" = []
+        reasons: "list[str]" = []
+        if fleet.queue_depth_per_replica > self.queue_high:
+            target = math.ceil(fleet.total_queue_depth / self.queue_high)
+            up_targets.append(max(current + 1, target))
+            reasons.append(
+                f"queue_depth {fleet.queue_depth_per_replica:.1f}"
+                f"/replica > {self.queue_high:g}")
+        if 0.0 <= fleet.pages_free_frac < self.pages_free_low:
+            up_targets.append(current + 1)
+            reasons.append(f"pages_free {fleet.pages_free_frac:.2f} "
+                           f"< {self.pages_free_low:g}")
+        if fleet.queue_wait_p50_s > self.queue_wait_high_s:
+            up_targets.append(current + 1)
+            reasons.append(f"queue_wait p50 {fleet.queue_wait_p50_s:.2f}s "
+                           f"> {self.queue_wait_high_s:g}s")
+        if fleet.ttft_p50_s > self.ttft_high_s:
+            up_targets.append(current + 1)
+            reasons.append(f"ttft p50 {fleet.ttft_p50_s:.2f}s "
+                           f"> {self.ttft_high_s:g}s")
+        if up_targets:
+            desired = min(self.max_replicas, max(up_targets))
+            if desired <= current:
+                return current, []  # already at max
+            if self._cooling("up", now):
+                return current, reasons + ["held: up cool-down"]
+            return desired, reasons
+
+        # Scale-down wants EVERY signal comfortably idle — the low bar
+        # is the hysteresis band's floor, and latency signals must sit
+        # under HALF their high bar.
+        idle = (fleet.queue_depth_per_replica < self.queue_low
+                and (fleet.pages_free_frac < 0.0
+                     or fleet.pages_free_frac > 2 * self.pages_free_low)
+                and fleet.queue_wait_p50_s < self.queue_wait_high_s / 2
+                and fleet.ttft_p50_s < self.ttft_high_s / 2)
+        if idle and current > self.min_replicas:
+            reasons = ["all signals below low thresholds"]
+            if self._cooling("down", now):
+                return current, reasons + ["held: down cool-down"]
+            return current - 1, reasons
+        return current, []
+
+
+class Controller:
+    """One reconcile loop over (signals, policy, actuator, router).
+
+    router_url: the routing tier's base URL. With it, replica URLs come
+        from /debug/router and scale-down runs the full drain protocol;
+        without it (routerless fleets) URLs come from the actuator and
+        scale-down skips session parking (documented loss).
+    clock: injectable monotonic clock for deterministic tests.
+    """
+
+    def __init__(self, actuator, policy: DecisionPolicy, *,
+                 router_url: "str | None" = None,
+                 obs: "AutoscalerObs | None" = None,
+                 chaos=None,
+                 scrape_timeout_s: float = 2.0,
+                 http_timeout_s: float = 5.0,
+                 drain_deadline_s: float = 20.0,
+                 drain_poll_s: float = 0.2,
+                 backoff_s: float = 2.0,
+                 backoff_cap_s: float = 60.0,
+                 clock=time.monotonic):
+        self.actuator = actuator
+        self.policy = policy
+        self.router_url = router_url.rstrip("/") if router_url else None
+        self.obs = obs if obs is not None else AutoscalerObs()
+        self._chaos = chaos
+        self.scrape_timeout_s = scrape_timeout_s
+        self.http_timeout_s = http_timeout_s
+        self.drain_deadline_s = drain_deadline_s
+        self.drain_poll_s = drain_poll_s
+        self.backoff_s = backoff_s
+        self.backoff_cap_s = backoff_cap_s
+        self.clock = clock
+        self._backoff_until = 0.0
+        self._cur_backoff = backoff_s
+        self.steps = 0
+
+    # -- fleet introspection ----------------------------------------------
+
+    def _get_json(self, url: str) -> dict:
+        with urllib.request.urlopen(
+                url, timeout=self.http_timeout_s) as resp:
+            return json.loads(resp.read())
+
+    def _post_json(self, url: str, doc: dict) -> "tuple[int, dict]":
+        body = json.dumps(doc).encode()
+        req = urllib.request.Request(
+            url, data=body, method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.http_timeout_s) as resp:
+                return resp.status, json.loads(resp.read())
+        except urllib.error.HTTPError as e:
+            with e:
+                try:
+                    return e.code, json.loads(e.read())
+                except (json.JSONDecodeError, ValueError):
+                    return e.code, {}
+
+    def router_state(self) -> "dict | None":
+        if self.router_url is None:
+            return None
+        try:
+            return self._get_json(self.router_url + "/debug/router")
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None
+
+    def replica_urls(self) -> "list[str]":
+        state = self.router_state()
+        if state is not None:
+            return [r["url"] for r in state.get("replicas", [])]
+        return self.actuator.urls()
+
+    # -- the reconcile step -----------------------------------------------
+
+    def step(self, now: "float | None" = None) -> dict:
+        """One collect→decide→actuate round. Returns a report dict
+        ({"action": "none" | "up" | "down" | "backoff" |
+        "actuate_failed" | "held", ...}) that tests and the bench
+        assert on and ``run()`` logs."""
+        if now is None:
+            now = self.clock()
+        self.steps += 1
+        urls = self.replica_urls()
+        fleet = collect(urls, timeout_s=self.scrape_timeout_s)
+        self.obs.on_signals(fleet.queue_depth_per_replica,
+                            fleet.pages_free_frac,
+                            fleet.queue_wait_p50_s,
+                            fleet.ttft_p50_s, fleet.scraped)
+        try:
+            current = self.actuator.current()
+        except ScaleError as e:
+            return self._report("actuate_failed", fleet, 0, 0,
+                               [f"current() failed: {e}"], now)
+        desired, reasons = self.policy.decide(fleet, current, now)
+        self.obs.on_decision(desired, current)
+        if desired == current:
+            action = "held" if reasons else "none"
+            return self._report(action, fleet, current, desired,
+                               reasons, now)
+        if now < self._backoff_until:
+            return self._report("backoff", fleet, current, desired,
+                               reasons + [
+                                   f"backing off "
+                                   f"{self._backoff_until - now:.1f}s"],
+                               now)
+        if desired > current:
+            ok = self._actuate(desired, None, "up", now)
+        else:
+            victim = self._pick_victim(urls)
+            if victim is not None:
+                self._drain_victim(victim)
+            ok = self._actuate(desired, [victim] if victim else None,
+                               "down", now)
+        direction = "up" if desired > current else "down"
+        return self._report(direction if ok else "actuate_failed",
+                            fleet, current, desired, reasons, now)
+
+    def _report(self, action: str, fleet: FleetSignals, current: int,
+                desired: int, reasons: "list[str]", now: float) -> dict:
+        return {"action": action, "current": current, "desired": desired,
+                "reasons": reasons, "signals": fleet.as_dict(),
+                "t": now}
+
+    def _actuate(self, n: int, victims: "list[str] | None",
+                 direction: str, now: float) -> bool:
+        try:
+            if self._chaos is not None:
+                # scale_actuate: the actuator call failing (apiserver
+                # down, RBAC revoked, spawn error) at the only moment
+                # the controller changes the world.
+                self._chaos.fire("scale_actuate")
+            self.actuator.scale_to(n, victims=victims)
+        except Exception as e:  # noqa: BLE001 — contain ANY actuator fault
+            self.obs.on_actuate_failure()
+            self._backoff_until = now + self._cur_backoff
+            print("autoscaler: " + json.dumps(
+                {"event": "actuate_failed", "desired": n,
+                 "error": str(e),
+                 "backoff_s": round(self._cur_backoff, 1)}), flush=True)
+            self._cur_backoff = min(self.backoff_cap_s,
+                                    self._cur_backoff * 2)
+            return False
+        self._cur_backoff = self.backoff_s
+        self._backoff_until = 0.0
+        self.policy.note_scaled(direction, now)
+        self.obs.on_scale(direction)
+        print("autoscaler: " + json.dumps(
+            {"event": "scaled", "direction": direction, "replicas": n,
+             "victims": victims or []}), flush=True)
+        return True
+
+    # -- loss-free scale-down ---------------------------------------------
+
+    def _pick_victim(self, urls: "list[str]") -> "str | None":
+        """The replica to retire: fewest pinned sessions (least warm
+        state to move), ties broken by LAST in membership order (the
+        local-process actuator kills highest-index-first, so the pick
+        and the kill agree)."""
+        if not urls:
+            return None
+        state = self.router_state()
+        if state is None:
+            return urls[-1]
+        pins: "dict[str, int]" = {u: 0 for u in urls}
+        for _s, rep in state.get("pins", {}).items():
+            if rep in pins:
+                pins[rep] += 1
+        best = None
+        for i, u in enumerate(urls):
+            score = (pins[u], -i)
+            if best is None or score <= best[0]:
+                best = (score, u)
+        return best[1]
+
+    def _drain_victim(self, victim: str) -> None:
+        """The drain protocol (docs/AUTOSCALING.md timeline): mark
+        draining in the router, release every pinned session with
+        spill=true, wait for the victim to go idle. Every leg is
+        best-effort with a deadline — a wedged victim still dies, it
+        just loses its unparked chains (exactly what dying without the
+        protocol would have lost)."""
+        t0 = time.perf_counter()
+        state = self.router_state()
+        if state is not None:
+            try:
+                self._post_json(self.router_url + "/v1/admin/drain",
+                                {"replica": victim, "draining": True})
+            except OSError:
+                pass
+            sessions = [s for s, rep in state.get("pins", {}).items()
+                        if rep == victim]
+            for s in sessions:
+                try:
+                    self._post_json(
+                        self.router_url + "/v1/session/release",
+                        {"session": s, "spill": True})
+                except OSError:
+                    pass
+            if sessions:
+                print("autoscaler: " + json.dumps(
+                    {"event": "drained_sessions", "replica": victim,
+                     "sessions": len(sessions)}), flush=True)
+        deadline = time.monotonic() + self.drain_deadline_s
+        while time.monotonic() < deadline:
+            try:
+                status = self._get_json(victim + "/debug/drain")
+                if status.get("active_http_requests", 0) == 0:
+                    break
+            except (OSError, json.JSONDecodeError, ValueError):
+                break  # victim gone/old build: nothing left to wait on
+            time.sleep(self.drain_poll_s)
+        self.obs.on_drain(time.perf_counter() - t0)
+
+    # -- the loop ----------------------------------------------------------
+
+    def run(self, period_s: float, stop: "threading.Event") -> None:
+        while not stop.wait(period_s):
+            try:
+                report = self.step()
+            except Exception as e:  # noqa: BLE001 — the loop must live
+                print(f"autoscaler: step failed: {e}", flush=True)
+                continue
+            if report["action"] != "none":
+                print("autoscaler: " + json.dumps(
+                    {"event": "step", **{k: report[k] for k in
+                     ("action", "current", "desired", "reasons")}}),
+                    flush=True)
+
+
+def make_autoscaler_app(controller: Controller):
+    """The controller's own /metrics + /healthz surface — same handler
+    idiom as the router's."""
+    obs = controller.obs
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz" or self.path == "/livez":
+                self._send(200, {"ok": True,
+                                 "steps": controller.steps})
+            elif self.path == "/metrics":
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    body = obs.render_openmetrics().encode()
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    body = obs.render_prometheus().encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+    return Handler
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="K3S-TPU metrics-driven fleet autoscaler")
+    ap.add_argument("--mode", choices=["k8s", "local"], default="k8s",
+                    help="'k8s': Deployment scale subresource via the "
+                         "in-cluster API; 'local': real server "
+                         "subprocesses on this host (cluster-free)")
+    ap.add_argument("--router", default=None,
+                    help="router base URL — enables replica discovery "
+                         "via /debug/router and the loss-free drain "
+                         "protocol on scale-down")
+    ap.add_argument("--namespace", default="default")
+    ap.add_argument("--deployment", default="tpu-inference",
+                    help="Deployment whose scale subresource is "
+                         "actuated (k8s mode)")
+    ap.add_argument("--local-command", default=None,
+                    help="local mode: replica argv template; {port} and "
+                         "{index} are substituted per replica (e.g. "
+                         "\"python -m k3stpu.serve.server --port {port}"
+                         " ...\")")
+    ap.add_argument("--local-base-port", type=int, default=8196)
+    ap.add_argument("--replicas-file", default=None,
+                    help="local mode: replica-URL file rewritten after "
+                         "every scale — point the router's "
+                         "--replicas-file at it")
+    ap.add_argument("--min-replicas", type=int, default=1)
+    ap.add_argument("--max-replicas", type=int, default=4)
+    ap.add_argument("--queue-high", type=float, default=4.0,
+                    help="scale up past this mean per-replica queue "
+                         "depth (also the proportional sizing target)")
+    ap.add_argument("--queue-low", type=float, default=0.5,
+                    help="scale down only under this mean per-replica "
+                         "queue depth (hysteresis floor)")
+    ap.add_argument("--pages-free-low", type=float, default=0.15,
+                    help="scale up when any replica's free-page "
+                         "fraction drops below this")
+    ap.add_argument("--queue-wait-high-s", type=float, default=1.0,
+                    help="scale up past this fleet-max p50 queue wait")
+    ap.add_argument("--ttft-high-s", type=float, default=2.0,
+                    help="scale up past this fleet-max p50 TTFT")
+    ap.add_argument("--cooldown-up-s", type=float, default=15.0)
+    ap.add_argument("--cooldown-down-s", type=float, default=60.0)
+    ap.add_argument("--period-s", type=float, default=5.0,
+                    help="reconcile period")
+    ap.add_argument("--drain-deadline-s", type=float, default=20.0,
+                    help="max wait for a scale-down victim to go idle")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="compute and log decisions without actuating")
+    ap.add_argument("--metrics-port", type=int, default=8094,
+                    help="own /metrics + /healthz port (0 disables)")
+    ap.add_argument("--instance", default=None,
+                    help="identity stamp for k3stpu_build_info")
+    args = ap.parse_args(argv)
+
+    from k3stpu.chaos import chaos_from_env
+
+    if args.mode == "local":
+        if not args.local_command:
+            ap.error("--mode local requires --local-command")
+        import shlex
+        template = shlex.split(args.local_command)
+
+        def spawn_command(index: int, port: int) -> "list[str]":
+            return [part.format(index=index, port=port)
+                    for part in template]
+
+        actuator = LocalProcessActuator(
+            spawn_command, base_port=args.local_base_port,
+            replicas_file=args.replicas_file)
+    else:
+        actuator = KubernetesActuator(args.namespace, args.deployment)
+    if args.dry_run:
+        actuator = DryRunActuator(actuator)
+
+    policy = DecisionPolicy(
+        min_replicas=args.min_replicas, max_replicas=args.max_replicas,
+        queue_high=args.queue_high, queue_low=args.queue_low,
+        pages_free_low=args.pages_free_low,
+        queue_wait_high_s=args.queue_wait_high_s,
+        ttft_high_s=args.ttft_high_s,
+        scale_up_cooldown_s=args.cooldown_up_s,
+        scale_down_cooldown_s=args.cooldown_down_s)
+    controller = Controller(
+        actuator, policy, router_url=args.router,
+        obs=AutoscalerObs(instance=args.instance),
+        chaos=chaos_from_env(),
+        drain_deadline_s=args.drain_deadline_s)
+
+    httpd = None
+    if args.metrics_port > 0:
+        httpd = ThreadingHTTPServer(("0.0.0.0", args.metrics_port),
+                                    make_autoscaler_app(controller))
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="autoscaler-metrics").start()
+
+    import signal as _signal
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        print(f"signal {signum}: stopping autoscaler", flush=True)
+        stop.set()
+
+    _signal.signal(_signal.SIGTERM, _stop)
+    _signal.signal(_signal.SIGINT, _stop)
+    print(f"autoscaling ({args.mode}) every {args.period_s:g}s, "
+          f"bounds [{args.min_replicas}, {args.max_replicas}]"
+          + (" DRY-RUN" if args.dry_run else ""), flush=True)
+    controller.run(args.period_s, stop)
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+    if isinstance(actuator, LocalProcessActuator):
+        actuator.close()
+    print("autoscaler: bye", flush=True)
+    return 0

@@ -1,0 +1,148 @@
+"""Autoscaler observability: the ``k3stpu_autoscaler_*`` families.
+
+Same facade discipline as ``RouterObs`` (router/obs.py): metric objects
+hang off instance attributes so ``tools/metrics_lint.py`` can construct
+an ``AutoscalerObs()`` and scan ``vars()`` for the real families, the
+render methods concatenate the hand-rolled expositions, and every
+``on_*`` hook is an early-return no-op when disabled. Constructs
+without jax — the controller never touches a device.
+
+Label cardinality is bounded by construction: ``direction`` is the
+fixed two-value enum {up, down} (in the lint's bounded-label
+allow-list).
+"""
+
+from __future__ import annotations
+
+import socket
+
+from k3stpu.obs.hist import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCounter,
+    build_info_gauge,
+    prometheus_text_to_openmetrics,
+)
+
+SCALE_DIRECTIONS = ("up", "down")
+
+
+class AutoscalerObs:
+    """All autoscaler observability state: the controller loop writes,
+    the /metrics handler reads."""
+
+    def __init__(self, enabled: bool = True, instance: "str | None" = None):
+        self.enabled = enabled
+        self.desired_replicas = Gauge(
+            "k3stpu_autoscaler_desired_replicas",
+            "Replica count the last decision wanted (after hysteresis, "
+            "cool-down, and min/max clamping).")
+        self.current_replicas = Gauge(
+            "k3stpu_autoscaler_current_replicas",
+            "Replica count the actuator last reported.")
+        self.scale_events = LabeledCounter(
+            "k3stpu_autoscaler_scale_events_total",
+            "Actuated scale events by direction (dry-run decisions are "
+            "not events).", "direction")
+        self.actuate_failures = Counter(
+            "k3stpu_autoscaler_actuate_failures_total",
+            "Actuator calls that failed (apiserver error, spawn "
+            "failure, injected scale_actuate fault); the controller "
+            "backs off and keeps the last-known-good count.")
+        self.signal_queue_depth = Gauge(
+            "k3stpu_autoscaler_signal_queue_depth",
+            "Mean per-replica engine queue depth across the scraped "
+            "fleet — the primary scale-up signal.")
+        self.signal_pages_free_fraction = Gauge(
+            "k3stpu_autoscaler_signal_pages_free_fraction",
+            "Minimum pages-free fraction across the scraped fleet "
+            "(-1 when no replica reports a paged pool).")
+        self.signal_queue_wait_seconds = Gauge(
+            "k3stpu_autoscaler_signal_queue_wait_seconds",
+            "Fleet-max p50 request queue wait — the prefill backlog "
+            "signal.")
+        self.signal_ttft_seconds = Gauge(
+            "k3stpu_autoscaler_signal_ttft_seconds",
+            "Fleet-max p50 time-to-first-token — the predicted-TTFT "
+            "signal.")
+        self.replicas_scraped = Gauge(
+            "k3stpu_autoscaler_replicas_scraped",
+            "Replicas whose /metrics answered in the last collect "
+            "round.")
+        self.drain_duration = Histogram(
+            "k3stpu_autoscaler_drain_seconds",
+            "Scale-down drain duration: drain mark to victim idle "
+            "(sessions released, in-flight zero or deadline).",
+            bounds=LATENCY_BUCKETS_S)
+        self.build_info = build_info_gauge(
+            "autoscaler", instance=instance or socket.gethostname())
+
+    # -- hooks (controller loop thread) ------------------------------------
+
+    def on_signals(self, queue_depth: float, pages_free_frac: float,
+                   queue_wait_s: float, ttft_s: float,
+                   scraped: int) -> None:
+        if not self.enabled:
+            return
+        self.signal_queue_depth.set(queue_depth)
+        self.signal_pages_free_fraction.set(pages_free_frac)
+        self.signal_queue_wait_seconds.set(queue_wait_s)
+        self.signal_ttft_seconds.set(ttft_s)
+        self.replicas_scraped.set(float(scraped))
+
+    def on_decision(self, desired: int, current: int) -> None:
+        if not self.enabled:
+            return
+        self.desired_replicas.set(float(desired))
+        self.current_replicas.set(float(current))
+
+    def on_scale(self, direction: str) -> None:
+        if not self.enabled:
+            return
+        self.scale_events.add(direction)
+
+    def on_actuate_failure(self) -> None:
+        if not self.enabled:
+            return
+        self.actuate_failures.inc()
+
+    def on_drain(self, seconds: float) -> None:
+        if not self.enabled:
+            return
+        self.drain_duration.observe(seconds)
+
+    # -- read side (HTTP threads) ------------------------------------------
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.drain_duration,)
+
+    def _counters(self):
+        return (self.scale_events, self.actuate_failures)
+
+    def _gauges(self) -> "tuple[Gauge, ...]":
+        return (self.desired_replicas, self.current_replicas,
+                self.signal_queue_depth, self.signal_pages_free_fraction,
+                self.signal_queue_wait_seconds, self.signal_ttft_seconds,
+                self.replicas_scraped)
+
+    def render_prometheus(self) -> str:
+        parts = [h.render() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(c.render() for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n"
+
+    def render_openmetrics(self) -> str:
+        parts = [h.render_openmetrics() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(prometheus_text_to_openmetrics(c.render())
+                     for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n# EOF\n"
+
+    def reset(self) -> None:
+        for h in self.histograms():
+            h.reset()
+        self.actuate_failures.reset()

@@ -1,0 +1,162 @@
+"""Scale signals scraped from each replica's ``/metrics``.
+
+The autoscaler deliberately reuses the families the serving stack
+already exports (docs/OBSERVABILITY.md) instead of growing a private
+side channel — anything Prometheus can alert on, the controller can
+scale on:
+
+- ``k3stpu_engine_queue_depth`` (gauge): requests admitted but not yet
+  running — the primary scale-up signal.
+- ``k3stpu_engine_pages_free`` / ``k3stpu_pages_total`` (gauges): KV
+  page-pool headroom; a fleet running out of pages thrashes the tier
+  long before queue depth moves.
+- ``k3stpu_request_queue_wait_seconds`` (histogram): p50 queue wait =
+  the prefill backlog a newly admitted request will actually pay.
+- ``k3stpu_request_ttft_seconds`` (histogram): p50 TTFT = the
+  predicted first-token latency the NEXT request will see — the
+  SLO-facing signal.
+
+Histogram quantiles come from the shared exposition parser + bucket
+interpolation in ``k3stpu.obs.hist`` (the same math loadgen's report
+uses), so a scrape here and a PromQL ``histogram_quantile`` agree.
+
+``parse_replica_metrics`` is pure (text in, sample out) so the
+signal→decision path is unit-testable without a server; ``scrape``
+adds the one stdlib-HTTP GET around it. All stdlib — no jax.
+"""
+
+from __future__ import annotations
+
+import urllib.request
+
+from k3stpu.obs.hist import (
+    parse_prometheus_histograms,
+    quantile_from_buckets,
+)
+
+
+class ReplicaSample:
+    """One replica's scrape: ``ok=False`` means unreachable/unparsable
+    (the replica still COUNTS toward current size — an unreachable
+    replica is the health poller's problem, not a reason to scale)."""
+
+    __slots__ = ("url", "ok", "queue_depth", "pages_free", "pages_total",
+                 "queue_wait_p50_s", "ttft_p50_s")
+
+    def __init__(self, url: str, ok: bool = False, queue_depth: float = 0.0,
+                 pages_free: float = -1.0, pages_total: float = 0.0,
+                 queue_wait_p50_s: float = 0.0, ttft_p50_s: float = 0.0):
+        self.url = url
+        self.ok = ok
+        self.queue_depth = queue_depth
+        self.pages_free = pages_free
+        self.pages_total = pages_total
+        self.queue_wait_p50_s = queue_wait_p50_s
+        self.ttft_p50_s = ttft_p50_s
+
+    @property
+    def pages_free_frac(self) -> float:
+        """Fraction of the page pool free; -1 when the replica runs
+        non-paged (pages_free is exported as -1 there)."""
+        if self.pages_free < 0 or self.pages_total <= 0:
+            return -1.0
+        return self.pages_free / self.pages_total
+
+    def as_dict(self) -> dict:
+        return {"url": self.url, "ok": self.ok,
+                "queue_depth": self.queue_depth,
+                "pages_free_frac": self.pages_free_frac,
+                "queue_wait_p50_s": self.queue_wait_p50_s,
+                "ttft_p50_s": self.ttft_p50_s}
+
+
+def _gauge_value(text: str, name: str) -> "float | None":
+    """First un-labeled sample of ``name`` in a v0.0.4 exposition."""
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            try:
+                return float(line.split()[1])
+            except (IndexError, ValueError):
+                return None
+    return None
+
+
+def _hist_p50(text: str, name: str) -> float:
+    """p50 from a family's cumulative buckets; 0.0 when absent/empty
+    (an idle replica has no latency pressure by definition)."""
+    fam = parse_prometheus_histograms(text).get(name)
+    if not fam or fam["count"] <= 0:
+        return 0.0
+    q = quantile_from_buckets(fam["bounds"], fam["cumulative"],
+                              fam["count"], 0.5)
+    return float(q) if q is not None else 0.0
+
+
+def parse_replica_metrics(url: str, text: str) -> ReplicaSample:
+    """Pure exposition-text → sample (the unit-testable half)."""
+    qd = _gauge_value(text, "k3stpu_engine_queue_depth")
+    pf = _gauge_value(text, "k3stpu_engine_pages_free")
+    pt = _gauge_value(text, "k3stpu_pages_total")
+    return ReplicaSample(
+        url, ok=True,
+        queue_depth=qd if qd is not None else 0.0,
+        pages_free=pf if pf is not None else -1.0,
+        pages_total=pt if pt is not None else 0.0,
+        queue_wait_p50_s=_hist_p50(text, "k3stpu_request_queue_wait_seconds"),
+        ttft_p50_s=_hist_p50(text, "k3stpu_request_ttft_seconds"))
+
+
+def scrape(url: str, timeout_s: float = 2.0) -> ReplicaSample:
+    """GET ``url``/metrics and parse; an unreachable replica returns an
+    ``ok=False`` sample rather than raising — one sick replica must not
+    blind the controller to the rest of the fleet."""
+    try:
+        req = urllib.request.Request(url.rstrip("/") + "/metrics")
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            text = resp.read().decode("utf-8", "replace")
+    except (OSError, ValueError):
+        return ReplicaSample(url, ok=False)
+    try:
+        return parse_replica_metrics(url, text)
+    except Exception:  # noqa: BLE001 — malformed exposition
+        return ReplicaSample(url, ok=False)
+
+
+class FleetSignals:
+    """The fleet-level aggregate one decision runs on. Aggregation
+    rules are worst-case-biased on purpose: queue depth averages (it
+    is additive load the fleet shares), but latency and headroom take
+    the WORST replica — one saturated replica is an SLO breach even
+    when its siblings idle."""
+
+    __slots__ = ("samples", "scraped", "queue_depth_per_replica",
+                 "total_queue_depth", "pages_free_frac",
+                 "queue_wait_p50_s", "ttft_p50_s")
+
+    def __init__(self, samples: "list[ReplicaSample]"):
+        self.samples = samples
+        live = [s for s in samples if s.ok]
+        self.scraped = len(live)
+        self.total_queue_depth = sum(s.queue_depth for s in live)
+        self.queue_depth_per_replica = (
+            self.total_queue_depth / len(live) if live else 0.0)
+        fracs = [s.pages_free_frac for s in live
+                 if s.pages_free_frac >= 0.0]
+        self.pages_free_frac = min(fracs) if fracs else -1.0
+        self.queue_wait_p50_s = max(
+            (s.queue_wait_p50_s for s in live), default=0.0)
+        self.ttft_p50_s = max((s.ttft_p50_s for s in live), default=0.0)
+
+    def as_dict(self) -> dict:
+        return {"scraped": self.scraped,
+                "queue_depth_per_replica": self.queue_depth_per_replica,
+                "total_queue_depth": self.total_queue_depth,
+                "pages_free_frac": self.pages_free_frac,
+                "queue_wait_p50_s": self.queue_wait_p50_s,
+                "ttft_p50_s": self.ttft_p50_s}
+
+
+def collect(urls: "list[str]", timeout_s: float = 2.0) -> FleetSignals:
+    """Scrape every replica serially (fleet sizes here are single
+    digits; a thread pool would buy milliseconds and cost a stack)."""
+    return FleetSignals([scrape(u, timeout_s=timeout_s) for u in urls])

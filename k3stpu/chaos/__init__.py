@@ -72,6 +72,12 @@ point                 boundary
                       a raised fault stands in for a replica dying under
                       an in-flight request, exercising ejection +
                       failover to the next ring candidate
+``scale_actuate``     per actuator call in the autoscaler controller
+                      (``k3stpu/autoscaler``), before ``scale_to`` — a
+                      raised fault stands in for an apiserver outage or
+                      spawn failure, exercising the back-off +
+                      keep-last-known-good containment (the fleet
+                      freezes, never thrashes)
 ====================  =====================================================
 """
 

@@ -6,7 +6,10 @@ cache, COW prefix pages, host KV tier) into fleet capacity: sticky
 session routing, consistent-hash prefix affinity, health-aware
 membership with eject/readmit and retry-with-failover, bounded
 per-replica in-flight, unbuffered SSE relay, traceparent passthrough,
-and ``k3stpu_router_*`` Prometheus families.
+and ``k3stpu_router_*`` Prometheus families. Live membership (file
+hot-reload or Kubernetes Endpoints — ``watch.py``) and per-replica
+drain marks (``POST /v1/admin/drain``) make it the autoscaler's
+substrate (docs/AUTOSCALING.md).
 
 Run: python -m k3stpu.router --replicas http://a:8096,http://b:8096
 """
@@ -19,4 +22,11 @@ from k3stpu.router.router import (  # noqa: F401
     Router,
     main,
     make_router_app,
+)
+from k3stpu.router.watch import (  # noqa: F401
+    EndpointsWatcher,
+    FileWatcher,
+    MembershipWatcher,
+    endpoints_to_urls,
+    parse_replicas_text,
 )

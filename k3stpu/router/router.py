@@ -92,8 +92,9 @@ class Router:
                  proxy_timeout_s: float = 120.0,
                  policy: str = "affinity",
                  instance: "str | None" = None,
-                 chaos=None):
-        if not replicas:
+                 chaos=None,
+                 allow_empty: bool = False):
+        if not replicas and not allow_empty:
             raise ValueError("router needs at least one replica URL")
         if policy not in ("affinity", "random"):
             raise ValueError(f"unknown policy {policy!r}")
@@ -123,6 +124,11 @@ class Router:
         # DROPPED on /v1/session/release (the chain is parked — the next
         # turn re-places by prefix).
         self._pins: "dict[str, str]" = {}
+        # Replicas marked draining (POST /v1/admin/drain): still healthy,
+        # still serving their PINNED sessions, but excluded from NEW
+        # placement — the autoscaler's scale-down prologue. Distinct
+        # from _draining (the router's OWN SIGTERM flag).
+        self._draining_replicas: "set[str]" = set()
         self._draining = False
         self._active_http = 0
         self._rr = 0  # random-policy cursor (deterministic round-robin)
@@ -132,7 +138,75 @@ class Router:
     # -- membership --------------------------------------------------------
 
     def replicas(self) -> "list[str]":
-        return list(self._replicas)
+        with self._lock:
+            return list(self._replicas)
+
+    def set_membership(self, replicas: "list[str]") -> "tuple[int, int]":
+        """Reconcile the replica set against a watcher's view (file
+        hot-reload or Kubernetes Endpoints — watch.py). Additions join
+        the ring optimistically healthy (the poller/reactive ejection
+        corrects within one round, same as boot); removals leave the
+        ring, forget their drain mark, and DROP their pins (the replica
+        is gone — its chains are in the shared spill tier if it drained
+        first, and the next turn re-places). An empty list is ignored:
+        a watcher reading a half-written file must not evaporate the
+        fleet. Returns (added, removed)."""
+        new = [r.rstrip("/") for r in replicas if r.strip()]
+        if not new:
+            return (0, 0)
+        newset = set(new)
+        dropped_pins = []
+        with self._lock:
+            removed = [r for r in self._replicas if r not in newset]
+            added = [r for r in new if r not in self._healthy]
+            for r in removed:
+                if self._healthy.get(r, False):
+                    self._ring.remove(r)
+                self._replicas.remove(r)
+                self._healthy.pop(r, None)
+                self._inflight.pop(r, None)
+                self._draining_replicas.discard(r)
+                dropped_pins += [s for s, rep in self._pins.items()
+                                 if rep == r]
+            for s in dropped_pins:
+                self._pins.pop(s, None)
+            for r in added:
+                self._replicas.append(r)
+                self._healthy[r] = True
+                self._inflight[r] = 0
+                self._ring.add(r)
+            healthy = sum(self._healthy.values())
+            pinned = len(self._pins)
+        if added or removed:
+            self._obs.on_membership(healthy)
+            self._obs.on_pins(pinned)
+            print(f"router: membership now {len(newset)} replicas "
+                  f"(+{len(added)}/-{len(removed)})", flush=True)
+        return (len(added), len(removed))
+
+    def set_replica_drain(self, replica: str, draining: bool) -> bool:
+        """Mark/unmark one replica as draining (POST /v1/admin/drain):
+        a draining replica takes no NEW placements but keeps serving
+        its pinned sessions until they release. False when the replica
+        is not a member."""
+        replica = replica.rstrip("/")
+        with self._lock:
+            if replica not in self._healthy:
+                return False
+            if draining:
+                self._draining_replicas.add(replica)
+            else:
+                self._draining_replicas.discard(replica)
+        print(f"router: replica {replica} "
+              f"{'draining' if draining else 'undrained'}", flush=True)
+        return True
+
+    def pinned_sessions(self, replica: str) -> "list[str]":
+        """Sessions currently pinned to ``replica`` — what the
+        autoscaler releases one by one before the kill."""
+        replica = replica.rstrip("/")
+        with self._lock:
+            return [s for s, r in self._pins.items() if r == replica]
 
     def healthy_replicas(self) -> "list[str]":
         with self._lock:
@@ -183,7 +257,7 @@ class Router:
 
     def _poll_loop(self) -> None:
         while not self._poller_stop.wait(self.health_period_s):
-            for r in self._replicas:
+            for r in self.replicas():
                 if self._poller_stop.is_set():
                     return
                 if self._probe(r):
@@ -257,19 +331,34 @@ class Router:
             healthy = [r for r in self._replicas if self._healthy[r]]
             if not healthy:
                 raise FleetUnavailable("no healthy replicas")
+            # Draining replicas take no NEW placements — but when every
+            # healthy replica is draining, serving beats shedding, so
+            # the exclusion falls away (the autoscaler never drains the
+            # last replica; this guard is for operator error).
+            placeable = [r for r in healthy
+                         if r not in self._draining_replicas]
+            if not placeable:
+                placeable = healthy
             if self.policy == "random":
                 # The measured baseline (bench --serve-router): spread
                 # with no affinity at all. Deterministic round-robin —
                 # "random" names the policy's cache behavior, and a
                 # seeded cursor keeps the bench reproducible.
                 self._rr += 1
-                start = self._rr % len(healthy)
-                return (healthy[start:] + healthy[:start], "prefix",
+                start = self._rr % len(placeable)
+                return (placeable[start:] + placeable[:start], "prefix",
                         session)
-            walk = list(self._ring.iter_nodes(key))
+            walk = [r for r in self._ring.iter_nodes(key)
+                    if r in set(placeable)]
+            if not walk:
+                walk = list(self._ring.iter_nodes(key))
             if session is not None:
                 pinned = self._pins.get(session)
                 if pinned is not None and self._healthy.get(pinned, False):
+                    # A pin into a DRAINING replica still routes there —
+                    # the chain lives there until /v1/session/release
+                    # parks it; breaking stickiness early would turn the
+                    # drain into cold prefills on the survivor.
                     rest = [r for r in walk if r != pinned]
                     return [pinned] + rest, "session", session
                 if pinned is not None:
@@ -308,16 +397,19 @@ class Router:
 
     def acquire(self, replica: str) -> bool:
         """Bounded in-flight admission: False when the replica is at its
-        cap (the proxy walk then tries the next candidate)."""
+        cap (the proxy walk then tries the next candidate) or was
+        removed by a membership change after the route was computed."""
         with self._lock:
-            if self._inflight[replica] >= self.max_inflight:
+            count = self._inflight.get(replica)
+            if count is None or count >= self.max_inflight:
                 return False
-            self._inflight[replica] += 1
+            self._inflight[replica] = count + 1
             return True
 
     def release(self, replica: str) -> None:
         with self._lock:
-            self._inflight[replica] -= 1
+            if replica in self._inflight:  # may have been removed mid-proxy
+                self._inflight[replica] -= 1
 
     def state(self) -> dict:
         """The /debug/router payload: live membership and pin table —
@@ -326,7 +418,8 @@ class Router:
             return {
                 "replicas": [
                     {"url": r, "healthy": self._healthy[r],
-                     "inflight": self._inflight[r]}
+                     "inflight": self._inflight[r],
+                     "draining": r in self._draining_replicas}
                     for r in self._replicas],
                 "policy": self.policy,
                 "sessions_pinned": len(self._pins),
@@ -488,6 +581,10 @@ def make_router_app(router: Router):
             except json.JSONDecodeError:
                 body = None  # opaque bodies still route (by raw-head hash)
 
+            if self.path == "/v1/admin/drain":
+                self._admin_drain(body)
+                return
+
             if self.path == "/v1/session/release":
                 self._release_session(body, raw)
                 return
@@ -502,6 +599,26 @@ def make_router_app(router: Router):
                 return
             router._obs.on_route(reason)
             self._proxy(candidates, session, raw, t0)
+
+        def _admin_drain(self, body) -> None:
+            """Scale-down prologue (POST /v1/admin/drain): mark one
+            replica draining so no NEW sessions pin to it, while its
+            existing pins keep routing there until released. The
+            autoscaler then enumerates the pins from /debug/router,
+            releases each with spill=true, and only then kills the
+            replica. ``{"draining": false}`` undoes the mark (an
+            aborted scale-down)."""
+            replica = (body or {}).get("replica")
+            if not isinstance(replica, str) or not replica:
+                self._send(400, {"error": "replica must be a non-empty "
+                                          "string"})
+                return
+            draining = bool((body or {}).get("draining", True))
+            if not router.set_replica_drain(replica, draining):
+                self._send(404, {"error": f"unknown replica {replica}"})
+                return
+            self._send(200, {"replica": replica.rstrip("/"),
+                             "draining": draining})
 
         def _release_session(self, body, raw: bytes) -> None:
             """Drain/migration path: forward the release to the pinned
@@ -741,10 +858,28 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="K3S-TPU session/prefix-aware request router")
     ap.add_argument("--port", type=int, default=8095)
-    ap.add_argument("--replicas", required=True,
+    ap.add_argument("--replicas", default=None,
                     help="comma-separated replica base URLs "
                          "(http://host:port) — in k8s, the per-pod "
-                         "endpoints of the inference Service")
+                         "endpoints of the inference Service. Optional "
+                         "when --replicas-file or --endpoints provides "
+                         "membership")
+    ap.add_argument("--replicas-file", default=None,
+                    help="path to a replicas file (one URL per line or "
+                         "comma-separated, # comments) hot-reloaded on "
+                         "mtime change — the autoscaler's local-process "
+                         "handshake (watch.py)")
+    ap.add_argument("--endpoints", default=None,
+                    help="namespace/name of the inference Service's "
+                         "Endpoints object: in-cluster membership watch "
+                         "over the Kubernetes API (service-account "
+                         "token + CA from the standard mount)")
+    ap.add_argument("--endpoints-port", type=int, default=None,
+                    help="replica port override for --endpoints "
+                         "(default: the subset's first port)")
+    ap.add_argument("--watch-period-s", type=float, default=2.0,
+                    help="membership poll period for --replicas-file / "
+                         "--endpoints")
     ap.add_argument("--vnodes", type=int, default=128,
                     help="virtual nodes per replica on the consistent-"
                          "hash ring (more = smoother spread, slower "
@@ -780,15 +915,37 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from k3stpu.chaos import chaos_from_env
+    from k3stpu.router.watch import EndpointsWatcher, FileWatcher
 
+    if not (args.replicas or args.replicas_file or args.endpoints):
+        ap.error("one of --replicas, --replicas-file, --endpoints "
+                 "is required")
+    initial = ([r for r in args.replicas.split(",") if r.strip()]
+               if args.replicas else [])
     router = Router(
-        [r for r in args.replicas.split(",") if r.strip()],
+        initial,
         vnodes=args.vnodes, prefix_tokens=args.prefix_tokens,
         max_inflight=args.max_inflight,
         health_period_s=args.health_period_s,
         health_timeout_s=args.health_timeout_s,
         proxy_timeout_s=args.proxy_timeout_s, policy=args.policy,
-        instance=args.instance, chaos=chaos_from_env())
+        instance=args.instance, chaos=chaos_from_env(),
+        allow_empty=True)
+    watcher = None
+    if args.replicas_file:
+        watcher = FileWatcher(router, args.replicas_file,
+                              period_s=args.watch_period_s)
+    elif args.endpoints:
+        try:
+            ns, name = args.endpoints.split("/", 1)
+        except ValueError:
+            ap.error("--endpoints must be namespace/name")
+        watcher = EndpointsWatcher(router, ns, name,
+                                   port=args.endpoints_port,
+                                   period_s=args.watch_period_s)
+    if watcher is not None:
+        watcher.poll_once()  # seed membership before the first request
+        watcher.start()
     router.start_health_poller()
     httpd = ThreadingHTTPServer(("0.0.0.0", args.port),
                                 make_router_app(router))
@@ -830,6 +987,8 @@ def main(argv=None) -> int:
           f"(policy={args.policy})", flush=True)
     httpd.serve_forever()
     httpd.server_close()
+    if watcher is not None:
+        watcher.stop()
     router.close()
     print("drained; bye", flush=True)
     return 0

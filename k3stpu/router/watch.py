@@ -1,0 +1,197 @@
+"""Live replica membership for the router (docs/AUTOSCALING.md).
+
+The static ``--replicas`` list freezes the fleet at router boot — fine
+for a hand-sized deployment, wrong once the autoscaler changes the
+replica count at runtime. This module is the pluggable discovery layer:
+a small poll loop that computes the current replica set from some
+source of truth and reconciles the router through
+``Router.set_membership`` (ring add/remove with the existing exact-map
+restore — bounded key movement, pins into removed replicas dropped).
+
+Two sources, same loop:
+
+- **FileWatcher** (``--replicas-file``): a text file of replica URLs
+  (one per line or comma-separated, ``#`` comments), re-read when its
+  mtime moves. This is also the local-process actuator's handshake —
+  the autoscaler rewrites the file after every scale event
+  (atomic rename), and the router picks it up within one poll period.
+- **EndpointsWatcher** (``--endpoints ns/name``): the Kubernetes
+  Endpoints object of the inference Service, fetched from the
+  in-cluster API over stdlib HTTP with the service-account token + CA
+  (same mount contract as the autoscaler's scale actuator). Ready
+  addresses become ``http://<ip>:<port>`` replicas. Polling (default
+  2s) rather than a chunked watch stream: membership changes are
+  seconds-scale events driven by our own autoscaler, and a poll is
+  restart-free, re-list-free, and testable with one fake fetch.
+
+Both treat a failed fetch as "no information" — membership is KEPT, not
+emptied, because a flaky apiserver must not evaporate a healthy fleet.
+``Router.set_membership`` additionally ignores empty sets for the same
+reason (a half-written replicas file).
+
+Zero-dep like the rest of the router tier: stdlib only, no jax.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import ssl
+import threading
+import urllib.request
+
+_SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+def parse_replicas_text(text: str) -> "list[str]":
+    """URLs from a replicas file: one per line and/or comma-separated,
+    blank lines and ``#`` comments ignored."""
+    urls = []
+    for line in text.splitlines():
+        line = line.split("#", 1)[0]
+        for part in line.split(","):
+            part = part.strip()
+            if part:
+                urls.append(part.rstrip("/"))
+    return urls
+
+
+def endpoints_to_urls(doc: dict, port: "int | None" = None,
+                      scheme: str = "http") -> "list[str]":
+    """Ready replica URLs from a Kubernetes Endpoints object. Only
+    ``addresses`` count (``notReadyAddresses`` are booting or failing —
+    the router's own health poller re-judges anyway, but seeding the
+    ring with not-ready pods would route first turns at cold boots).
+    ``port`` overrides the subset's first port when given."""
+    urls = []
+    for subset in doc.get("subsets") or []:
+        ports = subset.get("ports") or []
+        p = port if port is not None else (
+            ports[0].get("port") if ports else None)
+        if p is None:
+            continue
+        for addr in subset.get("addresses") or []:
+            ip = addr.get("ip")
+            if ip:
+                urls.append(f"{scheme}://{ip}:{p}")
+    return sorted(set(urls))
+
+
+class MembershipWatcher:
+    """Poll loop shared by both sources: ``_fetch()`` returns the
+    current replica list, or None for "no information" (transient
+    failure — keep what we have)."""
+
+    def __init__(self, router, period_s: float = 2.0):
+        self.router = router
+        self.period_s = period_s
+        self._thread: "threading.Thread | None" = None
+        self._stop = threading.Event()
+
+    def _fetch(self) -> "list[str] | None":
+        raise NotImplementedError
+
+    def poll_once(self) -> "tuple[int, int]":
+        """One reconcile: fetch and apply. Returns (added, removed);
+        (0, 0) on no change or no information."""
+        urls = self._fetch()
+        if urls is None:
+            return (0, 0)
+        return self.router.set_membership(urls)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="router-membership")
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.period_s + 1.0)
+            self._thread = None
+            self._stop.clear()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.period_s):
+            try:
+                self.poll_once()
+            except Exception as e:  # noqa: BLE001 — watcher must survive
+                print(f"router: membership poll failed: {e}", flush=True)
+
+
+class FileWatcher(MembershipWatcher):
+    """--replicas-file hot-reload: re-read on mtime change. The writer
+    should rename-in-place (os.replace) so a read never sees a torn
+    file; set_membership's empty-set guard covers the ones that do."""
+
+    def __init__(self, router, path: str, period_s: float = 2.0):
+        super().__init__(router, period_s)
+        self.path = path
+        self._mtime: "float | None" = None
+
+    def _fetch(self) -> "list[str] | None":
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return None  # file gone/unreadable: keep membership
+        if self._mtime is not None and mtime == self._mtime:
+            return None  # unchanged since last read
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            return None
+        self._mtime = mtime
+        return parse_replicas_text(text)
+
+
+class EndpointsWatcher(MembershipWatcher):
+    """Kubernetes Endpoints membership, in-cluster: GET
+    /api/v1/namespaces/{ns}/endpoints/{name} with the service-account
+    token, TLS against the mounted CA. ``fetch_doc`` is injectable so
+    tests exercise the parse/reconcile path without an apiserver."""
+
+    def __init__(self, router, namespace: str, name: str, *,
+                 port: "int | None" = None, scheme: str = "http",
+                 period_s: float = 2.0, sa_dir: str = _SA_DIR,
+                 api_base: "str | None" = None,
+                 timeout_s: float = 5.0,
+                 fetch_doc=None):
+        super().__init__(router, period_s)
+        self.namespace = namespace
+        self.name = name
+        self.port = port
+        self.scheme = scheme
+        self.sa_dir = sa_dir
+        self.timeout_s = timeout_s
+        self._fetch_doc = fetch_doc
+        if api_base is None:
+            host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes."
+                                  "default.svc")
+            kport = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            api_base = f"https://{host}:{kport}"
+        self.api_base = api_base.rstrip("/")
+
+    def _read_doc(self) -> dict:
+        with open(os.path.join(self.sa_dir, "token"),
+                  encoding="utf-8") as f:
+            token = f.read().strip()
+        ctx = ssl.create_default_context(
+            cafile=os.path.join(self.sa_dir, "ca.crt"))
+        url = (f"{self.api_base}/api/v1/namespaces/{self.namespace}"
+               f"/endpoints/{self.name}")
+        req = urllib.request.Request(
+            url, headers={"Authorization": f"Bearer {token}"})
+        with urllib.request.urlopen(req, timeout=self.timeout_s,
+                                    context=ctx) as resp:
+            return json.loads(resp.read())
+
+    def _fetch(self) -> "list[str] | None":
+        try:
+            doc = (self._fetch_doc() if self._fetch_doc is not None
+                   else self._read_doc())
+        except (OSError, json.JSONDecodeError, ValueError):
+            return None  # apiserver flake: keep membership
+        return endpoints_to_urls(doc, port=self.port, scheme=self.scheme)

@@ -219,12 +219,13 @@ class _TierCommand:
     a command stranded behind the close sentinel fails cleanly instead
     of hanging its caller."""
 
-    __slots__ = ("kind", "session", "event", "result", "error",
+    __slots__ = ("kind", "session", "spill", "event", "result", "error",
                  "deadline", "tokens", "stream_q", "trace")
 
-    def __init__(self, kind: str, session: str):
+    def __init__(self, kind: str, session: str, spill: bool = False):
         self.kind = kind
         self.session = session
+        self.spill = spill
         self.event = threading.Event()
         self.result = None
         self.error: "Exception | None" = None
@@ -1170,18 +1171,32 @@ class GenerateEngine:
         last=None — no logits exist for the uncommitted tail token —
         so it serves prefix hits only (the next turn's prompt strictly
         extends it through t_g). The session's previous chain is
-        dropped from pcache AND tier: one chain per session."""
+        dropped from pcache AND tier: one chain per session. A
+        one-token turn adopts the admission-time exact-prompt entry
+        (same key, better: it has logits) rather than inserting."""
         toks = self._collected[r]
-        key_prompt = req.ptuple() + tuple(toks[:-1])
-        n_entry = -(-len(key_prompt) // self.page_size)
-        chain = self._chains[r]
-        if len(chain) < n_entry:  # defensive: never true by allocation
-            return
-        self._pcache_insert_paged(key_prompt, chain[:n_entry], None,
-                                  req.adapter, frozen=True)
-        key = (req.adapter, key_prompt)
-        if key not in self._pcache:
-            return  # capacity-evicted immediately; nothing to track
+        if len(toks) < 2:
+            # One-token turn: the key (prompt + zero committed reply
+            # tokens) IS the prompt, and admission already cached that
+            # exact chain WITH its next-token logits. Inserting a
+            # frozen last=None twin would replace the strictly better
+            # entry — adopt the existing one into the ledger instead,
+            # so release_session parks the live chain, not the
+            # previous turn's stale key.
+            key = (req.adapter, req.ptuple())
+            if key not in self._pcache:
+                return  # evicted (or never inserted); keep prev chain
+        else:
+            key_prompt = req.ptuple() + tuple(toks[:-1])
+            n_entry = -(-len(key_prompt) // self.page_size)
+            chain = self._chains[r]
+            if len(chain) < n_entry:  # defensive: never by allocation
+                return
+            self._pcache_insert_paged(key_prompt, chain[:n_entry], None,
+                                      req.adapter, frozen=True)
+            key = (req.adapter, key_prompt)
+            if key not in self._pcache:
+                return  # capacity-evicted immediately; nothing to track
         prev = self._sessions.get(req.session)
         if prev is not None and prev != key:
             ent = self._pcache.pop(prev, None)
@@ -1194,10 +1209,14 @@ class GenerateEngine:
                 self._tier.discard(prev)
         self._sessions[req.session] = key
 
-    def _do_release_session(self, session: str) -> bool:
+    def _do_release_session(self, session: str,
+                            spill: bool = False) -> bool:
         """Loop-thread body of release_session: demote the session's
         pcache entry to the host tier (gather + unpin + free pages).
-        True when a chain existed (now on host — or already there)."""
+        True when a chain existed (now on host — or already there).
+        ``spill`` additionally forces the parked chain to the disk tier
+        (no-op without --tier-dir): the drain path, where the chain
+        must outlive this process for a peer replica to adopt it."""
         key = self._sessions.get(session)
         if key is None:
             return False
@@ -1205,9 +1224,13 @@ class GenerateEngine:
         if entry is None:
             # Already demoted (watermark pressure / LRU eviction beat
             # the explicit release to it).
-            return self._tier is not None and self._tier.contains(key)
+            had = self._tier is not None and self._tier.contains(key)
+            if had and spill:
+                self._tier.spill(key)
+            return had
         if self._tier is not None:
-            self._tier_swap_out(key, entry)
+            if self._tier_swap_out(key, entry) and spill:
+                self._tier.spill(key)
         self._unpin_pages(entry[0])
         self._alloc.decref(entry[0])
         with self._lock:
@@ -1215,18 +1238,21 @@ class GenerateEngine:
         return True
 
     def release_session(self, session: str,
-                        timeout_s: float = 30.0) -> bool:
+                        timeout_s: float = 30.0,
+                        spill: bool = False) -> bool:
         """Explicitly park a session between turns: its cached chain
         leaves the device pool for the host tier (or is dropped when no
         tier is attached) and the freed pages go back to admission.
-        Safe from any thread — the operation marshals to the loop
-        thread via the request queue. Returns whether the session had a
-        chain to release."""
+        ``spill=True`` forces the parked chain through to the disk tier
+        so it survives this process (drain-before-kill; requires
+        --tier-dir to have any effect). Safe from any thread — the
+        operation marshals to the loop thread via the request queue.
+        Returns whether the session had a chain to release."""
         if self._closed:
             raise RuntimeError("engine is closed")
         if not self.paged:
             return False
-        cmd = _TierCommand("release", session)
+        cmd = _TierCommand("release", session, spill=spill)
         self._q.put(cmd)
         if not cmd.event.wait(timeout_s):
             raise TimeoutError("session release did not finish in time")
@@ -1237,7 +1263,8 @@ class GenerateEngine:
     def _exec_tier_command(self, cmd: "_TierCommand") -> None:
         try:
             if cmd.kind == "release":
-                cmd.result = self._do_release_session(cmd.session)
+                cmd.result = self._do_release_session(cmd.session,
+                                                      spill=cmd.spill)
             else:  # unknown kinds fail loudly, never hang the caller
                 raise ValueError(f"unknown tier command {cmd.kind!r}")
         except Exception as e:  # noqa: BLE001 — fail the one command
@@ -2158,7 +2185,7 @@ class GenerateEngine:
             if (req is not None and req.session is not None
                     and req.samples == 1 and req.block.shape[0] == 1
                     and self.prompt_cache > 0
-                    and len(self._collected[r]) >= 2):
+                    and self._collected[r]):
                 self._session_insert(req, r)
             # Free the row's pages NOW, not at request completion: the
             # zeroed table row sinks the slot's continued decode writes,

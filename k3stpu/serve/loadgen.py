@@ -325,6 +325,137 @@ def run_load(url: "str | list[str]", *, clients: int, seconds: float,
     return out
 
 
+def parse_ramp(spec: str, base_clients: int) -> "list[tuple[int, float]]":
+    """``--ramp`` spec → [(clients, seconds), ...] phases.
+
+    Spec: comma-separated ``<mult>x:<seconds>s`` phases, multipliers of
+    ``--clients`` — e.g. ``1x:30s,4x:60s,1x:30s`` is 30 s at base load,
+    a 4x surge for 60 s, then back. Fractional multipliers are allowed
+    (``0.5x:10s``); each phase must round to at least one client."""
+    phases = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        try:
+            mult_s, dur_s = part.split(":")
+            if not mult_s.endswith("x") or not dur_s.endswith("s"):
+                raise ValueError(part)
+            mult = float(mult_s[:-1])
+            dur = float(dur_s[:-1])
+        except ValueError:
+            raise ValueError(
+                f"bad ramp phase {part!r} (want e.g. '4x:60s')") from None
+        clients = max(1, round(mult * base_clients))
+        if dur <= 0:
+            raise ValueError(f"ramp phase {part!r}: duration must be > 0")
+        phases.append((clients, dur))
+    if not phases:
+        raise ValueError(f"empty ramp spec {spec!r}")
+    return phases
+
+
+def run_ramp(url: "str | list[str]", *, phases: "list[tuple[int, float]]",
+             rows: int, input_shape: "tuple[int, ...]", input_dtype: str,
+             generate_tokens: int = 0, stream: bool = False,
+             traces: "ClientTraces | None" = None) -> dict:
+    """Piecewise-constant load: each (clients, seconds) phase runs its
+    own client pool to completion (threads started, run, stopped, and
+    JOINED per phase — in-flight requests finish before the next phase
+    starts, so every request attributes to exactly one phase). The
+    surge-and-recede shape is the autoscaler's test signal: phase-level
+    p50/p95/p99 show whether the fleet grew fast enough to hold the
+    surge and whether the shrink gave anything back."""
+    urls = [url] if isinstance(url, str) else list(url)
+    rng = np.random.default_rng(0)
+    ttfts_wanted = stream and generate_tokens > 0
+    if generate_tokens > 0:
+        body = {"prompt_tokens": [_gen_prompt(rows)],
+                "max_new_tokens": generate_tokens}
+        if stream:
+            body["stream"] = True
+        payload = json.dumps(body).encode()
+        route = "/v1/generate"
+    else:
+        if input_dtype == "int32":
+            block = rng.integers(0, 1000, size=(rows, *input_shape),
+                                 dtype=np.int32)
+        else:
+            block = rng.standard_normal(
+                (rows, *input_shape)).astype(np.float32)
+        payload = json.dumps({"inputs": block.tolist()}).encode()
+        route = "/v1/predict"
+
+    def pct(sorted_ms: "list[float]", q: float) -> float:
+        return sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))]
+
+    phase_reports = []
+    all_lat_ms: "list[float]" = []
+    total_errors = 0
+    retry_stats = {"retries": 0, "gave_up": 0}
+    t0_all = time.perf_counter()
+    for pi, (clients, seconds) in enumerate(phases):
+        latencies: "list[tuple[float, str | None]]" = []
+        errors: "list[str]" = []
+        ttfts: "list[float] | None" = [] if ttfts_wanted else None
+        lock = threading.Lock()
+        stop = threading.Event()
+        threads = [threading.Thread(
+            target=_client_loop,
+            args=(urls[i % len(urls)], payload, stop, latencies, lock,
+                  errors, route, ttfts, retry_stats,
+                  1000 * pi + i, traces),
+            daemon=True) for i in range(clients)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=300)
+        wall = time.perf_counter() - t0
+        lat_ms = sorted(1e3 * l for l, _ in latencies)
+        report = {
+            "phase": pi,
+            "clients": clients,
+            "seconds": seconds,
+            "wall_s": round(wall, 2),
+            "requests": len(lat_ms),
+            "errors": len(errors),
+            "requests_per_s": round(len(lat_ms) / wall, 2),
+        }
+        if lat_ms:
+            report["p50_ms"] = round(pct(lat_ms, 0.50), 2)
+            report["p95_ms"] = round(pct(lat_ms, 0.95), 2)
+            report["p99_ms"] = round(pct(lat_ms, 0.99), 2)
+        if ttfts:
+            tt = sorted(1e3 * t for t in ttfts)
+            report["ttft_p50_ms"] = round(pct(tt, 0.50), 2)
+        phase_reports.append(report)
+        all_lat_ms.extend(lat_ms)
+        total_errors += len(errors)
+        print(f"ramp phase {pi}: {clients} clients x {seconds:g}s -> "
+              f"{len(lat_ms)} ok, {len(errors)} errors"
+              + (f", p50 {report.get('p50_ms')} ms" if lat_ms else ""),
+              flush=True)
+    wall_all = time.perf_counter() - t0_all
+    if not all_lat_ms:
+        raise RuntimeError("no ramp request succeeded")
+    all_lat_ms.sort()
+    return {
+        "ramp_phases": phase_reports,
+        "rows_per_request": rows,
+        "wall_s": round(wall_all, 2),
+        "requests": len(all_lat_ms),
+        "errors": total_errors,
+        "retries_503": retry_stats["retries"],
+        "gave_up_503": retry_stats["gave_up"],
+        "p50_ms": round(pct(all_lat_ms, 0.50), 2),
+        "p95_ms": round(pct(all_lat_ms, 0.95), 2),
+        "p99_ms": round(pct(all_lat_ms, 0.99), 2),
+    }
+
+
 def _session_turn(url: str, prompt: "list[int]", sid: str,
                   gen_tokens: int) -> "tuple[float, float, list[int]]":
     """One session turn over the SSE route: returns (ttft_s, latency_s,
@@ -604,6 +735,14 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--spec-gamma", type=int, default=4,
                     help="max draft tokens per slot per speculative "
                          "dispatch (with --speculate)")
+    ap.add_argument("--ramp", default=None, metavar="SPEC",
+                    help="piecewise load schedule instead of a flat "
+                         "--seconds window: comma-separated "
+                         "'<mult>x:<seconds>s' phases, multipliers of "
+                         "--clients (e.g. '1x:30s,4x:60s,1x:30s' = base, "
+                         "4x surge, base). The result (and --json) gains "
+                         "per-phase p50/p95/p99 — the surge shape "
+                         "autoscaler runs are judged by")
     ap.add_argument("--sessions", type=int, default=0,
                     help="multi-turn session mode: run this many "
                          "concurrent sessions instead of the open-loop "
@@ -661,6 +800,14 @@ def main(argv: "list[str] | None" = None) -> int:
     if args.stream and args.generate_tokens <= 0:
         ap.error("--stream requires --generate-tokens (the SSE route is "
                  "generation-only)")
+    ramp_phases = None
+    if args.ramp:
+        if args.sessions:
+            ap.error("--ramp and --sessions are mutually exclusive")
+        try:
+            ramp_phases = parse_ramp(args.ramp, args.clients)
+        except ValueError as e:
+            ap.error(str(e))
     if args.sessions:
         if args.generate_tokens <= 0:
             ap.error("--sessions requires --generate-tokens (sessions "
@@ -760,6 +907,13 @@ def main(argv: "list[str] | None" = None) -> int:
             urls or url, sessions=args.sessions, turns=args.turns,
             rows=args.rows, gen_tokens=args.generate_tokens,
             release=not args.no_session_release)
+    elif ramp_phases is not None:
+        result = run_ramp(
+            urls or url, phases=ramp_phases, rows=args.rows,
+            input_shape=tuple(card["input_shape"]),
+            input_dtype=card["input_dtype"],
+            generate_tokens=args.generate_tokens, stream=args.stream,
+            traces=traces)
     else:
         result = run_load(
             urls or url, clients=args.clients, seconds=args.seconds,

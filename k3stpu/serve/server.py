@@ -833,6 +833,26 @@ class InferenceServer:
                 return False, "circuit breaker open"
         return True, "ok"
 
+    def drain_status(self) -> dict:
+        """The scale-down probe (GET /debug/drain): is this replica
+        draining, how much HTTP work is still in flight, and how many
+        session chains it still tracks. The autoscaler polls this
+        between "release every session" and "kill the replica" so the
+        kill lands on an idle process whose chains are parked
+        (docs/AUTOSCALING.md drain timeline)."""
+        doc = {
+            "instance": self.instance,
+            "draining": self._draining,
+            "active_http_requests": self.active_http_requests(),
+            "sessions_tracked": 0,
+            "tier_spilled_bytes": 0,
+        }
+        if self._engine is not None and self._engine.paged:
+            e = self._engine.stats()
+            doc["sessions_tracked"] = e.get("sessions_tracked", 0)
+            doc["tier_spilled_bytes"] = e.get("tier_spilled_bytes", 0)
+        return doc
+
     def http_begin(self) -> None:
         with self._stats_lock:
             self._active_http += 1
@@ -1248,19 +1268,21 @@ class InferenceServer:
                 # the stream ran to completion.
                 events.close()
 
-    def release_session(self, session: str) -> bool:
+    def release_session(self, session: str, spill: bool = False) -> bool:
         """Park a session's cached KV chain between turns: the chain
         leaves the device pool for the host tier (--tier-host-mb) or is
         dropped (no tier), and its HBM pages return to admission. The
-        POST /v1/session/release body. Returns whether the session
-        named a live chain."""
+        POST /v1/session/release body. ``spill`` forces the parked
+        chain through to the disk tier (--tier-dir) so it survives
+        this process — the autoscaler's drain-before-kill path.
+        Returns whether the session named a live chain."""
         if not isinstance(session, str) or not session:
             raise ValueError("session must be a non-empty string")
         if self._engine is None or not self._engine.paged:
             raise ValueError(
                 "session release requires --continuous-batching with "
                 "--kv-page-size")
-        return self._engine.release_session(session)
+        return self._engine.release_session(session, spill=spill)
 
     def busy_seconds(self) -> float:
         """Cumulative device-busy time — the duty-cycle numerator the
@@ -1692,6 +1714,8 @@ def make_app(server: InferenceServer):
                 self._send(200, server.debug_timelines(n))
             elif self.path.startswith("/debug/trace"):
                 self._send(200, server.debug_trace())
+            elif self.path == "/debug/drain":
+                self._send(200, server.drain_status())
             else:
                 self._send(404, {"error": f"no route {self.path}"})
 
@@ -1798,7 +1822,8 @@ def make_app(server: InferenceServer):
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(length))
-                    released = server.release_session(req["session"])
+                    released = server.release_session(
+                        req["session"], spill=bool(req.get("spill", False)))
                     self._send(200, {"released": released})
                 except (KeyError, ValueError, TypeError,
                         json.JSONDecodeError) as e:

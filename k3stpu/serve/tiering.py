@@ -29,6 +29,17 @@ Design points:
   at load and surfaces as ``TierCorrupt``, which the engine's swap-in
   path degrades to a cold prefill (chaos point ``tier_swap`` drills
   exactly this).
+- **The spill format is a handoff format.** Filenames are store-unique
+  (``tier-<pid>-<store>-<seq>.kv``), payloads are self-describing (the
+  key is in the pickle, checked at load), and ``match()`` adopts unknown
+  spill files it finds in ``spill_dir`` — so replicas sharing a spill
+  directory can inherit each other's parked chains. This is the
+  autoscaler's loss-free scale-down: the victim replica force-spills
+  its released sessions (``spill(key)``), dies, and the survivor's
+  next tier probe indexes the orphaned files and restores them warm
+  (docs/AUTOSCALING.md). One owner at a time still holds: adoption
+  only indexes files this store has never seen, a local key always
+  wins over an on-disk twin, and a load unlinks the file.
 - **No device handles.** Values are plain numpy arrays + ints; the
   store survives ``_crash_reset`` rebuilding the device pool, which is
   what makes it a *recovery* tier and not just a cache annex.
@@ -41,10 +52,17 @@ call from anywhere.
 
 from __future__ import annotations
 
+import itertools
 import os
 import pickle
 import zlib
 from typing import Any
+
+# Per-process store counter: spill filenames carry (pid, store-id) so
+# two stores NEVER collide — across processes (distinct pids) or within
+# one (distinct store ids; bench and tests run multiple in-process
+# servers against one shared spill_dir).
+_STORE_IDS = itertools.count(1)
 
 Key = tuple[Any, tuple]  # (adapter, prompt_tuple) — the pcache key scheme
 
@@ -98,7 +116,12 @@ class HostPageStore:
         self._entries: dict[Key, _Entry] = {}  # insertion order = LRU
         self._bytes = 0        # resident (non-spilled) host bytes
         self._spill_seq = 0
+        self._tag = f"{os.getpid()}-{next(_STORE_IDS)}"
         self._spilled_bytes = 0
+        # Every spill path this store has written OR examined: adoption
+        # parses each foreign file at most once (corrupt ones included —
+        # a bad file must not be re-read on every probe).
+        self._known_paths: set[str] = set()
 
     # -- write path ----------------------------------------------------
 
@@ -134,11 +157,32 @@ class HostPageStore:
             del self._entries[key]
             self._bytes -= ent.nbytes
 
+    def spill(self, key: Key) -> bool:
+        """Force ``key``'s entry to the disk tier NOW — the drain path:
+        a parked chain must outlive this process for a surviving
+        replica to adopt it from the shared ``spill_dir``. True when
+        the entry is on disk afterwards (already-spilled included);
+        False when absent or no ``spill_dir`` is configured."""
+        if self.spill_dir is None:
+            return False
+        ent = self._entries.get(key)
+        if ent is None:
+            return False
+        if ent.pages is None:
+            return True  # already on disk
+        self._spill(key, ent)
+        return True
+
     def _spill(self, key: Key, ent: _Entry) -> None:
-        """Move one resident entry to disk (atomic, checksummed)."""
+        """Move one resident entry to disk (atomic, checksummed).
+        Filenames carry (pid, store-id) so stores sharing a spill_dir
+        never collide — and so ``adopt_orphans`` can tell a peer's file
+        from its own by path alone."""
         os.makedirs(self.spill_dir, exist_ok=True)
         self._spill_seq += 1
-        path = os.path.join(self.spill_dir, f"tier-{self._spill_seq}.kv")
+        path = os.path.join(
+            self.spill_dir, f"tier-{self._tag}-{self._spill_seq}.kv")
+        self._known_paths.add(path)
         payload = pickle.dumps((key, ent.length, ent.pages, ent.last),
                                protocol=pickle.HIGHEST_PROTOCOL)
         crc = zlib.crc32(payload)
@@ -160,7 +204,11 @@ class HostPageStore:
     def match(self, adapter: Any, prompt: tuple) -> Key | None:
         """Longest stored key that is a prefix of ``prompt`` (same rule
         as ``_pcache_lookup``). Does not refresh LRU order — only a
-        successful ``load`` counts as use."""
+        successful ``load`` counts as use. With a spill_dir the probe
+        first adopts any orphaned peer spills so a chain parked by a
+        drained replica is matchable here."""
+        if self.spill_dir is not None:
+            self.adopt_orphans()
         best = None
         for key in self._entries:
             aid, ptuple = key
@@ -169,6 +217,55 @@ class HostPageStore:
                     and (best is None or len(ptuple) > len(best[1]))):
                 best = key
         return best
+
+    def adopt_orphans(self) -> int:
+        """Index spill files this store did not write — chains a peer
+        replica (sharing ``spill_dir``) parked before it was scaled
+        away. Each unknown ``tier-*.kv`` is read once, checksum- and
+        shape-verified, and registered as a spilled entry under its
+        embedded key; corrupt or half-written files are skipped and
+        remembered so they are never re-parsed. A key already present
+        locally wins over its on-disk twin (the local copy is the one
+        LRU order knows about). Returns the number adopted."""
+        try:
+            names = os.listdir(self.spill_dir)
+        except OSError:
+            return 0
+        adopted = 0
+        for name in sorted(names):
+            if not (name.startswith("tier-") and name.endswith(".kv")):
+                continue
+            path = os.path.join(self.spill_dir, name)
+            if path in self._known_paths:
+                continue
+            self._known_paths.add(path)
+            try:
+                with open(path, "rb") as f:
+                    raw = f.read()
+                if len(raw) < 4:
+                    continue
+                crc, payload = int.from_bytes(raw[:4], "big"), raw[4:]
+                if zlib.crc32(payload) != crc:
+                    continue
+                key, length, pages, last = pickle.loads(payload)
+            except Exception:  # noqa: BLE001 — foreign bytes; skip them
+                continue
+            if not isinstance(pages, dict) or key in self._entries:
+                continue
+            n_pages = 0
+            nbytes = 0
+            for arr in pages.values():
+                n_pages = max(n_pages, int(arr.shape[0]))
+                nbytes += int(arr.nbytes)
+            if last is not None:
+                nbytes += sum(int(x.nbytes) for x in last
+                              if hasattr(x, "nbytes"))
+            ent = _Entry(int(length), n_pages, nbytes, None, None, None)
+            ent.path = path
+            self._entries[key] = ent
+            self._spilled_bytes += nbytes
+            adopted += 1
+        return adopted
 
     def contains(self, key: Key) -> bool:
         return key in self._entries

@@ -61,6 +61,7 @@ SMOKE=(
   tests/test_spec_engine.py
   tests/test_tiering.py
   tests/test_router.py
+  tests/test_autoscaler.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -1,0 +1,738 @@
+"""Autoscaler tier (k3stpu/autoscaler, docs/AUTOSCALING.md): signal
+parsing, decision policy (hysteresis / cool-downs / bounds), membership
+watchers, actuators, the scale_actuate chaos containment, and the
+drain-before-kill protocol end to end.
+
+Most of the file is jax-free: replicas are scripted exposition servers
+and actuator fleets are stub processes, because the controller is
+deliberately model-blind. The one real-server test
+(test_drain_before_kill_restores_warm_on_survivor) runs two in-process
+InferenceServers against a shared spill dir to prove the property the
+whole subsystem exists for: a session released with spill=true during
+a scale-down serves its next turn WARM on a surviving replica.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k3stpu.autoscaler import (
+    AutoscalerObs,
+    Controller,
+    DecisionPolicy,
+    DryRunActuator,
+    FleetSignals,
+    KubernetesActuator,
+    LocalProcessActuator,
+    ReplicaSample,
+    ScaleError,
+    make_autoscaler_app,
+    parse_replica_metrics,
+    scrape,
+)
+from k3stpu.chaos import FaultInjector
+from k3stpu.router import (
+    EndpointsWatcher,
+    FileWatcher,
+    Router,
+    endpoints_to_urls,
+    make_router_app,
+    parse_replicas_text,
+)
+
+# --- signal parsing --------------------------------------------------------
+
+
+def _exposition(queue_depth=0.0, pages_free=-1.0, pages_total=0.0,
+                ttft_bucket=None, wait_bucket=None):
+    """A minimal but real v0.0.4 exposition. ``ttft_bucket`` /
+    ``wait_bucket`` put all observations into ONE bucket upper bound so
+    the expected p50 is knowable without re-deriving interpolation."""
+    lines = [
+        "# HELP k3stpu_engine_queue_depth q",
+        "# TYPE k3stpu_engine_queue_depth gauge",
+        f"k3stpu_engine_queue_depth {queue_depth}",
+        "# HELP k3stpu_engine_pages_free f",
+        "# TYPE k3stpu_engine_pages_free gauge",
+        f"k3stpu_engine_pages_free {pages_free}",
+        "# HELP k3stpu_pages_total t",
+        "# TYPE k3stpu_pages_total gauge",
+        f"k3stpu_pages_total {pages_total}",
+    ]
+    for name, bucket in (("k3stpu_request_ttft_seconds", ttft_bucket),
+                         ("k3stpu_request_queue_wait_seconds",
+                          wait_bucket)):
+        if bucket is None:
+            continue
+        le, count = bucket
+        lines += [
+            f"# HELP {name} h",
+            f"# TYPE {name} histogram",
+            f'{name}_bucket{{le="{le}"}} {count}',
+            f'{name}_bucket{{le="+Inf"}} {count}',
+            f"{name}_sum {le * count}",
+            f"{name}_count {count}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def test_parse_replica_metrics_gauges_and_histograms():
+    text = _exposition(queue_depth=7.0, pages_free=20, pages_total=80,
+                       ttft_bucket=(2.0, 10), wait_bucket=(0.5, 4))
+    s = parse_replica_metrics("http://r0", text)
+    assert s.ok
+    assert s.queue_depth == 7.0
+    assert s.pages_free_frac == pytest.approx(0.25)
+    # All mass in the first finite bucket: p50 interpolates inside it.
+    assert 0.0 < s.ttft_p50_s <= 2.0
+    assert 0.0 < s.queue_wait_p50_s <= 0.5
+
+
+def test_parse_replica_metrics_non_paged_and_missing_families():
+    s = parse_replica_metrics("http://r0", _exposition())
+    assert s.ok and s.pages_free_frac == -1.0
+    assert s.queue_depth == 0.0 and s.ttft_p50_s == 0.0
+    # Families absent entirely (an old build): still a usable sample.
+    s2 = parse_replica_metrics("http://r0", "# nothing here\n")
+    assert s2.ok and s2.queue_depth == 0.0
+
+
+def test_scrape_unreachable_is_ok_false_not_raise():
+    s = scrape("http://127.0.0.1:1", timeout_s=0.2)
+    assert not s.ok
+
+
+def test_fleet_aggregation_worst_case_bias():
+    fleet = FleetSignals([
+        ReplicaSample("a", ok=True, queue_depth=6.0, pages_free=50,
+                      pages_total=100, queue_wait_p50_s=0.1,
+                      ttft_p50_s=0.2),
+        ReplicaSample("b", ok=True, queue_depth=2.0, pages_free=5,
+                      pages_total=100, queue_wait_p50_s=0.9,
+                      ttft_p50_s=3.0),
+        ReplicaSample("c", ok=False),       # unreachable: excluded
+    ])
+    assert fleet.scraped == 2
+    assert fleet.total_queue_depth == 8.0
+    assert fleet.queue_depth_per_replica == 4.0   # mean of the LIVE two
+    assert fleet.pages_free_frac == pytest.approx(0.05)   # WORST
+    assert fleet.queue_wait_p50_s == 0.9          # WORST
+    assert fleet.ttft_p50_s == 3.0                # WORST
+    empty = FleetSignals([])
+    assert empty.scraped == 0 and empty.queue_depth_per_replica == 0.0
+    assert empty.pages_free_frac == -1.0
+
+
+# --- decision policy -------------------------------------------------------
+
+
+def _pressure(queue=0.0, pages=-1.0, wait=0.0, ttft=0.0):
+    return FleetSignals([ReplicaSample(
+        "r", ok=True, queue_depth=queue,
+        pages_free=pages, pages_total=100 if pages >= 0 else 0,
+        queue_wait_p50_s=wait, ttft_p50_s=ttft)])
+
+
+def test_policy_queue_depth_sizes_proportionally():
+    p = DecisionPolicy(max_replicas=8, queue_high=4.0)
+    desired, reasons = p.decide(_pressure(queue=20.0), 1, 0.0)
+    # ceil(20 / 4) = 5 replicas, one proportional step.
+    assert desired == 5 and any("queue_depth" in r for r in reasons)
+
+
+def test_policy_hysteresis_band_holds_steady():
+    p = DecisionPolicy(queue_high=4.0, queue_low=0.5)
+    # Between low and high: no move in either direction.
+    desired, reasons = p.decide(_pressure(queue=2.0), 2, 0.0)
+    assert desired == 2 and reasons == []
+
+
+def test_policy_each_signal_triggers_one_step_up():
+    for kw in ({"pages": 5.0}, {"wait": 2.0}, {"ttft": 5.0}):
+        p = DecisionPolicy(max_replicas=4)
+        desired, reasons = p.decide(_pressure(**kw), 2, 0.0)
+        assert desired == 3, kw
+        assert reasons, kw
+
+
+def test_policy_down_requires_every_signal_idle():
+    p = DecisionPolicy()
+    # Idle queue but TTFT above half its bar: hold, don't shrink.
+    assert p.decide(_pressure(queue=0.1, ttft=1.5), 3, 0.0)[0] == 3
+    # Everything idle: one step down.
+    assert p.decide(_pressure(queue=0.1), 3, 0.0)[0] == 2
+
+
+def test_policy_cooldowns_are_per_direction():
+    p = DecisionPolicy(scale_up_cooldown_s=10.0,
+                       scale_down_cooldown_s=100.0)
+    p.note_scaled("up", t0 := 50.0)
+    d, reasons = p.decide(_pressure(queue=50.0), 2, t0 + 5.0)
+    assert d == 2 and any("cool-down" in r for r in reasons)
+    # Up cool-down does NOT block a scale-down...
+    assert p.decide(_pressure(queue=0.1), 2, t0 + 5.0)[0] == 1
+    p.note_scaled("down", t0 + 5.0)
+    # ...and the down cool-down holds shrinks but not growth.
+    assert p.decide(_pressure(queue=0.1), 2, t0 + 6.0)[0] == 2
+    assert p.decide(_pressure(queue=50.0), 2, t0 + 20.0)[0] > 2
+
+
+def test_policy_bounds_clamp_and_repair():
+    p = DecisionPolicy(min_replicas=2, max_replicas=3)
+    assert p.decide(_pressure(queue=100.0), 3, 0.0)[0] == 3  # at max
+    assert p.decide(_pressure(queue=0.0), 2, 0.0)[0] == 2    # at min
+    assert p.decide(_pressure(), 1, 0.0)[0] == 2             # below min
+    assert p.decide(_pressure(), 5, 0.0)[0] == 3             # above max
+
+
+def test_policy_validates_configuration():
+    with pytest.raises(ValueError):
+        DecisionPolicy(min_replicas=0)
+    with pytest.raises(ValueError):
+        DecisionPolicy(min_replicas=3, max_replicas=2)
+    with pytest.raises(ValueError):
+        DecisionPolicy(queue_high=1.0, queue_low=1.0)
+
+
+# --- membership watchers ---------------------------------------------------
+
+
+def test_parse_replicas_text_lines_commas_comments():
+    text = ("http://a:1, http://b:2/\n"
+            "# a comment line\n"
+            "http://c:3  # trailing comment\n\n")
+    assert parse_replicas_text(text) == [
+        "http://a:1", "http://b:2", "http://c:3"]
+
+
+def test_endpoints_to_urls_ready_only_sorted_deduped():
+    doc = {"subsets": [
+        {"addresses": [{"ip": "10.0.0.2"}, {"ip": "10.0.0.1"}],
+         "notReadyAddresses": [{"ip": "10.0.0.9"}],
+         "ports": [{"port": 8096}]},
+        {"addresses": [{"ip": "10.0.0.1"}], "ports": [{"port": 8096}]},
+    ]}
+    assert endpoints_to_urls(doc) == [
+        "http://10.0.0.1:8096", "http://10.0.0.2:8096"]
+    assert endpoints_to_urls(doc, port=9000)[0] == "http://10.0.0.1:9000"
+    assert endpoints_to_urls({}) == []
+
+
+def _quiet_router(urls, **kw):
+    # Long health period: the poller thread never fires inside a test,
+    # so scripted/absent replicas keep their optimistic boot health.
+    return Router(urls, health_period_s=3600.0, instance="test-as", **kw)
+
+
+def test_file_watcher_hot_reloads_membership(tmp_path):
+    path = tmp_path / "replicas.txt"
+    path.write_text("http://127.0.0.1:7001\n")
+    router = _quiet_router([], allow_empty=True)
+    try:
+        w = FileWatcher(router, str(path), period_s=3600.0)
+        assert w.poll_once() == (1, 0)
+        assert router.replicas() == ["http://127.0.0.1:7001"]
+        # Unchanged mtime: no re-read, no churn.
+        assert w.poll_once() == (0, 0)
+        # Atomic rewrite (the actuator's handshake): swap the fleet.
+        tmp = tmp_path / "replicas.txt.tmp"
+        tmp.write_text("http://127.0.0.1:7002,http://127.0.0.1:7003\n")
+        os.replace(tmp, path)
+        w._mtime = None  # force past same-second mtime granularity
+        assert w.poll_once() == (2, 1)
+        assert router.replicas() == ["http://127.0.0.1:7002",
+                                     "http://127.0.0.1:7003"]
+        # Empty file: torn-write guard keeps the fleet.
+        path.write_text("")
+        w._mtime = None
+        assert w.poll_once() == (0, 0)
+        assert len(router.replicas()) == 2
+        # File gone: no information, keep membership.
+        path.unlink()
+        assert w.poll_once() == (0, 0)
+    finally:
+        router.close()
+
+
+def test_endpoints_watcher_reconciles_with_stubbed_fetch():
+    docs = [
+        {"subsets": [{"addresses": [{"ip": "10.0.0.1"}],
+                      "ports": [{"port": 8096}]}]},
+        None,  # apiserver flake -> keep membership
+        {"subsets": [{"addresses": [{"ip": "10.0.0.1"},
+                                    {"ip": "10.0.0.2"}],
+                      "ports": [{"port": 8096}]}]},
+    ]
+
+    def fetch_doc():
+        doc = docs.pop(0)
+        if doc is None:
+            raise OSError("apiserver down")
+        return doc
+
+    router = _quiet_router([], allow_empty=True)
+    try:
+        w = EndpointsWatcher(router, "ns", "svc", fetch_doc=fetch_doc,
+                             period_s=3600.0)
+        assert w.poll_once() == (1, 0)
+        assert w.poll_once() == (0, 0)      # flake: unchanged
+        assert len(router.replicas()) == 1
+        assert w.poll_once() == (1, 0)
+        assert sorted(router.replicas()) == [
+            "http://10.0.0.1:8096", "http://10.0.0.2:8096"]
+    finally:
+        router.close()
+
+
+def test_router_drain_excludes_new_placement_keeps_pins():
+    urls = ["http://127.0.0.1:7101", "http://127.0.0.1:7102"]
+    router = _quiet_router(urls)
+    try:
+        # Pin a session somewhere, then drain that replica.
+        cands, _, _ = router.route({"session": "s1"}, b"{}")
+        pinned = cands[0]
+        router.commit_route("s1", pinned)
+        assert router.set_replica_drain(pinned, True)
+        assert router.pinned_sessions(pinned) == ["s1"]
+        other = [u for u in urls if u != pinned][0]
+        # New sessions place on the un-drained replica only...
+        for i in range(8):
+            c, _, _ = router.route({"session": f"n{i}"}, b"{}")
+            assert c[0] == other
+        # ...while the existing pin still routes to the draining one.
+        c, reason, _ = router.route({"session": "s1"}, b"{}")
+        assert c[0] == pinned and reason == "session"
+        # Undrain restores placement; unknown replicas are refused.
+        assert router.set_replica_drain(pinned, False)
+        assert not router.set_replica_drain("http://nope:1", True)
+        state = router.state()
+        assert {r["url"]: r["draining"] for r in state["replicas"]} == {
+            urls[0]: False, urls[1]: False}
+    finally:
+        router.close()
+
+
+# --- scripted-fleet controller loop ----------------------------------------
+
+
+class _ScriptedReplica:
+    """An HTTP stand-in replica: /metrics serves a settable exposition,
+    /debug/drain a settable in-flight count."""
+
+    def __init__(self):
+        self.text = _exposition()
+        self.active = 0
+        handler = self._make()
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def _make(self):
+        rep = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/metrics":
+                    body = rep.text.encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path == "/debug/drain":
+                    body = json.dumps(
+                        {"active_http_requests": rep.active}).encode()
+                    ctype = "application/json"
+                else:
+                    body, ctype = b"{}", "application/json"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        return H
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+class _StubActuator:
+    """In-memory fleet: current() tracks scale_to; urls() mirrors a
+    scripted replica list."""
+
+    def __init__(self, urls):
+        self._urls = list(urls)
+        self.n = len(urls)
+        self.calls = []
+
+    def current(self):
+        return self.n
+
+    def urls(self):
+        return self._urls[:self.n]
+
+    def scale_to(self, n, victims=None):
+        self.calls.append((n, victims))
+        self.n = n
+
+
+def test_controller_scales_up_on_queue_pressure():
+    rep = _ScriptedReplica()
+    try:
+        rep.text = _exposition(queue_depth=20.0)
+        act = _StubActuator([rep.url])
+        ctl = Controller(act, DecisionPolicy(max_replicas=4,
+                                             queue_high=4.0))
+        report = ctl.step(now=0.0)
+        assert report["action"] == "up"
+        assert act.calls == [(4, None)]
+        assert ctl.obs.desired_replicas.value == 4.0
+        # Same pressure immediately after: cool-down holds.
+        rep2 = [rep.url] * 4  # urls() now returns 4 entries
+        act._urls = rep2
+        report2 = ctl.step(now=1.0)
+        assert report2["action"] in ("held", "none")
+        assert len(act.calls) == 1
+    finally:
+        rep.close()
+
+
+def test_controller_scale_down_drains_victim_first():
+    reps = [_ScriptedReplica(), _ScriptedReplica()]
+    try:
+        act = _StubActuator([r.url for r in reps])
+        ctl = Controller(act, DecisionPolicy(min_replicas=1),
+                         drain_deadline_s=2.0, drain_poll_s=0.05)
+        report = ctl.step(now=1000.0)
+        assert report["action"] == "down"
+        (n, victims), = act.calls
+        assert n == 1
+        # No router: the victim is the last replica, still drain-polled.
+        assert victims == [reps[-1].url]
+        assert ctl.obs.drain_duration.count == 1
+    finally:
+        for r in reps:
+            r.close()
+
+
+def test_chaos_scale_actuate_backs_off_keeps_last_known_good():
+    rep = _ScriptedReplica()
+    try:
+        rep.text = _exposition(queue_depth=50.0)
+        act = _StubActuator([rep.url])
+        chaos = FaultInjector()
+        chaos.arm("scale_actuate", times=1)
+        ctl = Controller(act, DecisionPolicy(max_replicas=4), chaos=chaos,
+                         backoff_s=30.0)
+        report = ctl.step(now=0.0)
+        assert report["action"] == "actuate_failed"
+        assert chaos.fired("scale_actuate") == 1
+        assert act.calls == [] and act.n == 1   # last-known-good kept
+        assert ctl.obs.actuate_failures.value == 1
+        # Inside the back-off window: no actuation attempt at all.
+        report2 = ctl.step(now=10.0)
+        assert report2["action"] == "backoff"
+        assert act.calls == []
+        # Past the window the same decision goes through.
+        report3 = ctl.step(now=40.0)
+        assert report3["action"] == "up"
+        assert act.n == 4
+    finally:
+        rep.close()
+
+
+def test_autoscaler_obs_families_and_app_render_clean():
+    obs = AutoscalerObs(instance="t")
+    obs.on_signals(1.5, 0.4, 0.1, 0.2, scraped=2)
+    obs.on_decision(3, 2)
+    obs.on_scale("up")
+    obs.on_drain(0.25)
+    text = obs.render_prometheus()
+    for fam in ("k3stpu_autoscaler_desired_replicas",
+                "k3stpu_autoscaler_current_replicas",
+                "k3stpu_autoscaler_scale_events_total",
+                "k3stpu_autoscaler_signal_queue_depth",
+                "k3stpu_autoscaler_drain_seconds",
+                "k3stpu_build_info"):
+        assert fam in text, fam
+    assert 'direction="up"' in text
+    om = obs.render_openmetrics()
+    assert om.endswith("# EOF\n")
+    # The controller's own HTTP surface serves them.
+    ctl = Controller(_StubActuator([]), DecisionPolicy(), obs=obs)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                make_autoscaler_app(ctl))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        base = f"http://127.0.0.1:{httpd.server_address[1]}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            assert b"k3stpu_autoscaler_desired_replicas" in r.read()
+        with urllib.request.urlopen(base + "/healthz", timeout=5) as r:
+            assert json.loads(r.read())["ok"] is True
+    finally:
+        httpd.shutdown()
+
+
+# --- actuators -------------------------------------------------------------
+
+# A stand-in replica process: answers 200 on every GET (healthz), so
+# LocalProcessActuator's spawn/health-wait/kill machinery is testable
+# without jax or a model.
+_STUB_SERVER = """
+import sys
+from http.server import BaseHTTPRequestHandler, HTTPServer
+class H(BaseHTTPRequestHandler):
+    def do_GET(self):
+        body = b'{"ok": true}'
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+    def log_message(self, *a):
+        pass
+HTTPServer(("127.0.0.1", int(sys.argv[1])), H).serve_forever()
+"""
+
+
+def _stub_spawn(index, port):
+    return [sys.executable, "-c", _STUB_SERVER, str(port)]
+
+
+def _free_port_base():
+    # A base unlikely to collide across test runs; the actuator binds
+    # base+index so keep a spread.
+    import random
+    return random.randint(20000, 40000)
+
+
+def test_local_process_actuator_scale_up_down(tmp_path):
+    rf = str(tmp_path / "replicas.txt")
+    act = LocalProcessActuator(_stub_spawn, base_port=_free_port_base(),
+                               replicas_file=rf, ready_timeout_s=30.0,
+                               kill_timeout_s=5.0)
+    try:
+        assert act.current() == 0
+        assert parse_replicas_text(open(rf).read()) == []
+        act.scale_to(2)
+        assert act.current() == 2
+        urls = act.urls()
+        assert parse_replicas_text(open(rf).read()) == urls
+        for u in urls:  # health-waited: immediately reachable
+            with urllib.request.urlopen(u + "/healthz", timeout=5) as r:
+                assert r.status == 200
+        # Victim-directed scale-down: the named replica dies, the
+        # other survives on ITS port (index-stable URLs).
+        act.scale_to(1, victims=[urls[1]])
+        assert act.urls() == [urls[0]]
+        assert parse_replicas_text(open(rf).read()) == [urls[0]]
+        with urllib.request.urlopen(urls[0] + "/healthz", timeout=5):
+            pass
+        act.scale_to(0)
+        assert act.current() == 0
+    finally:
+        act.close()
+
+
+def test_local_process_actuator_spawn_failure_is_scale_error(tmp_path):
+    act = LocalProcessActuator(
+        lambda i, p: [sys.executable, "-c", "import sys; sys.exit(3)"],
+        base_port=_free_port_base(), ready_timeout_s=10.0)
+    try:
+        with pytest.raises(ScaleError, match="exited"):
+            act.scale_to(1)
+        assert act.current() == 0
+    finally:
+        act.close()
+
+
+def test_kubernetes_actuator_scale_subresource_http(tmp_path):
+    """GET/PATCH against a scripted apiserver: bearer token from the SA
+    mount, merge-patch body shape, ScaleError on HTTP failure."""
+    sa = tmp_path / "sa"
+    sa.mkdir()
+    (sa / "token").write_text("sekret-token\n")
+    seen = {"replicas": 2, "patches": [], "auth": []}
+
+    class API(BaseHTTPRequestHandler):
+        def _ok(self, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            seen["auth"].append(self.headers.get("Authorization"))
+            self._ok({"spec": {"replicas": seen["replicas"]}})
+
+        def do_PATCH(self):
+            raw = self.rfile.read(
+                int(self.headers.get("Content-Length", "0")))
+            seen["patches"].append((self.headers.get("Content-Type"),
+                                    json.loads(raw)))
+            seen["replicas"] = json.loads(raw)["spec"]["replicas"]
+            self._ok({"spec": {"replicas": seen["replicas"]}})
+
+        def log_message(self, *a):
+            pass
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), API)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    try:
+        act = KubernetesActuator(
+            "ns", "tpu-inference", sa_dir=str(sa),
+            api_base=f"http://127.0.0.1:{httpd.server_address[1]}")
+        assert act.current() == 2
+        assert seen["auth"][0] == "Bearer sekret-token"
+        act.scale_to(5, victims=["ignored"])
+        assert seen["patches"] == [("application/merge-patch+json",
+                                    {"spec": {"replicas": 5}})]
+        assert act.current() == 5
+        assert act.urls() == []
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    # Apiserver gone: every call is a contained ScaleError.
+    with pytest.raises(ScaleError):
+        act.current()
+
+
+def test_dry_run_actuator_records_without_acting():
+    inner = _StubActuator(["http://a"])
+    dry = DryRunActuator(inner)
+    dry.scale_to(5)
+    assert dry.calls == [5]
+    assert inner.n == 1 and inner.calls == []
+
+
+# --- drain-before-kill, real servers ---------------------------------------
+
+
+def _post(url, path, doc, timeout=120):
+    req = urllib.request.Request(
+        url + path, data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_drain_before_kill_restores_warm_on_survivor(tmp_path):
+    """The property the subsystem exists for: a session pinned to the
+    scale-down victim, released with spill=true through the router,
+    serves its NEXT turn warm (tier hit, no cold prefill) on the
+    surviving replica — two real engines handing a chain across a
+    shared spill dir."""
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    tier_dir = str(tmp_path / "tier")
+    servers, httpds, urls = [], [], []
+    for _ in range(2):
+        srv = InferenceServer(model_name="transformer-tiny", seq_len=64,
+                              continuous_batching=True, kv_page_size=8,
+                              prompt_cache=4, tier_host_mb=16,
+                              tier_dir=tier_dir)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(srv)
+        httpds.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    router = Router(urls, health_period_s=3600.0, instance="test-drain")
+    rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 make_router_app(router))
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    try:
+        p1 = [5, 6, 7, 8, 9, 10, 11, 12]
+        r1 = _post(rurl, "/v1/generate",
+                   {"prompt_tokens": [p1], "max_new_tokens": 4,
+                    "session": "s-drain"})
+        reply = r1["tokens"][0]
+        victim = router.state()["pins"]["s-drain"]
+        vi = urls.index(victim)
+        survivor_srv = servers[1 - vi]
+
+        # The controller's drain protocol, over the real HTTP surface.
+        assert _post(rurl, "/v1/admin/drain",
+                     {"replica": victim})["draining"] is True
+        assert _post(rurl, "/v1/session/release",
+                     {"session": "s-drain", "spill": True})["released"]
+        # The chain is parked on disk, pin is gone, victim is idle.
+        assert [f for f in os.listdir(tier_dir) if f.endswith(".kv")]
+        assert "s-drain" not in router.state()["pins"]
+        # Poll like the controller does: the victim's in-flight count
+        # for the forwarded release settles a beat after the router's
+        # response (the handler's finally runs post-write).
+        deadline = time.monotonic() + 10.0
+        while True:
+            drain = json.loads(urllib.request.urlopen(
+                victim + "/debug/drain", timeout=10).read())
+            if drain["active_http_requests"] == 0:
+                break
+            assert time.monotonic() < deadline, drain
+            time.sleep(0.05)
+
+        # Kill the victim (actuator's job); membership watcher's view.
+        router.set_membership([urls[1 - vi]])
+        httpds[vi].shutdown()
+        servers[vi].close()
+
+        # Next turn extends turn 1; it must land on the survivor and
+        # restore WARM by adopting the victim's spill file.
+        p2 = p1 + reply + [20, 21]
+        r2 = _post(rurl, "/v1/generate",
+                   {"prompt_tokens": [p2], "max_new_tokens": 4,
+                    "session": "s-drain"})
+        assert len(r2["tokens"][0]) == 4
+        stats = survivor_srv._engine.stats()
+        assert stats["tier_hits"] >= 1, stats
+        assert stats["tier_swap_ins"] >= 1, stats
+        assert stats["tier_fallbacks"] == 0, stats
+        assert router.state()["pins"]["s-drain"] == urls[1 - vi]
+    finally:
+        router.close()
+        rhttpd.shutdown()
+        for h in httpds:
+            h.shutdown()
+        for s in servers:
+            s.close()
+
+
+# --- bench gate ------------------------------------------------------------
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_serve_autoscale_bench_gates():
+    """bench.py --serve-autoscale-worker: one BENCH_JSON line; the
+    fleet scales 1->2 and back under a ramp with zero failed requests,
+    and the parked session's post-scale-down turn restores warm
+    (<= 1/3 of the cold re-prefill, the PR-10 tier bound)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env.pop("XLA_FLAGS", None)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, ".jax_cache"))
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--serve-autoscale-worker"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=900)
+    assert out.returncode == 0, out.stderr[-3000:]
+    lines = [l for l in out.stdout.splitlines()
+             if l.startswith("BENCH_JSON ")]
+    assert len(lines) == 1, out.stdout
+    doc = json.loads(lines[0][len("BENCH_JSON "):])
+    assert doc["metric"] == "serve_autoscale_warm_restore_ratio"
+    d = doc["detail"]
+    assert d["scale_gate_passed"], d
+    assert d["zero_failed_gate_passed"], d
+    assert d["warm_gate_passed"], d

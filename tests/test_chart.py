@@ -371,11 +371,18 @@ def _golden_case(name):
         # all off in the default golden, which stays byte-unchanged.
         "node-obs.yaml": {"nodeExporter.enabled": "true",
                           "rules.enabled": "true"},
+        # Fleet autoscaler (docs/AUTOSCALING.md): SA + scale-subresource
+        # Role/Binding + controller Deployment, rendered with the
+        # router and inference components it scales and drains through.
+        "autoscaler.yaml": {"autoscaler.enabled": "true",
+                            "router.enabled": "true",
+                            "inference.enabled": "true"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
-                "train.yaml", "node-obs.yaml", "router.yaml"]
+                "train.yaml", "node-obs.yaml", "router.yaml",
+                "autoscaler.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

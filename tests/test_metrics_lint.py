@@ -39,15 +39,18 @@ def _check(fams):
     real_obs = metrics_lint._families_from_obs
     real_srv = metrics_lint._families_from_server
     real_rtr = metrics_lint._families_from_router
+    real_asc = metrics_lint._families_from_autoscaler
     metrics_lint._families_from_obs = lambda: fams
     metrics_lint._families_from_server = lambda: []
     metrics_lint._families_from_router = lambda: []
+    metrics_lint._families_from_autoscaler = lambda: []
     try:
         return metrics_lint.lint()
     finally:
         metrics_lint._families_from_obs = real_obs
         metrics_lint._families_from_server = real_srv
         metrics_lint._families_from_router = real_rtr
+        metrics_lint._families_from_autoscaler = real_asc
 
 
 def _pad(fams):

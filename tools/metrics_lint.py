@@ -72,10 +72,11 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 # OTHER key (rid, trace_id, pod, user...) is a cardinality bomb waiting
 # for a dashboard, so the lint rejects it until the key is reviewed and
 # added here. "backend" is the attention-backend enum (xla-gather /
-# pallas-paged), fixed at construction on the decode-dispatch histogram.
+# pallas-paged), fixed at construction on the decode-dispatch histogram;
+# "direction" is the autoscaler's fixed {up, down} enum.
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       "component", "version", "instance",
-                      "replica", "reason", "backend"}
+                      "replica", "reason", "backend", "direction"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
@@ -162,9 +163,34 @@ def _families_from_router() -> "list[tuple[str, str, str]]":
     return fams
 
 
+def _families_from_autoscaler() -> "list[tuple[str, str, str]]":
+    """The autoscaler's families, from a real AutoscalerObs — same
+    no-jax construct-and-scan discipline as the router facade."""
+    from k3stpu.autoscaler.obs import AutoscalerObs
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledGauge,
+    )
+
+    fams = []
+    for attr in vars(AutoscalerObs(instance="lint")).values():
+        if isinstance(attr, Histogram):
+            fams.append((attr.name, "histogram", attr.help))
+        elif isinstance(attr, (Counter, LabeledCounter)):
+            fams.append((attr.name, "counter", attr.help))
+        elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
+            fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
 def _all_families() -> "list[tuple[str, str, str]]":
     return (_families_from_obs() + _families_from_server()
-            + _families_from_node_exporter() + _families_from_router())
+            + _families_from_node_exporter() + _families_from_router()
+            + _families_from_autoscaler())
 
 
 def lint() -> "list[str]":
@@ -218,6 +244,7 @@ def _labeled_families() -> "list[tuple[str, tuple]]":
         LabeledCounter,
         LabeledGauge,
     )
+    from k3stpu.autoscaler.obs import AutoscalerObs
     from k3stpu.obs.node_exporter import NodeCollector
     from k3stpu.obs.train import TrainObs
     from k3stpu.router.obs import RouterObs
@@ -225,7 +252,8 @@ def _labeled_families() -> "list[tuple[str, tuple]]":
     out = []
     for owner in (ServeObs(), TrainObs(),
                   NodeCollector(drop_dir="/nonexistent"),
-                  RouterObs(instance="lint")):
+                  RouterObs(instance="lint"),
+                  AutoscalerObs(instance="lint")):
         for attr in vars(owner).values():
             if isinstance(attr, (LabeledCounter, LabeledGauge)):
                 out.append((attr.name, (attr.label,)))
