@@ -69,13 +69,17 @@ class LocalProcessActuator:
     """A fleet of real server subprocesses on this host.
 
     spawn_command: callable ``(index, port) -> list[str]`` building the
-        argv for replica ``index`` listening on ``port``. The default
+        argv for the replica listening on ``port`` (``index`` is
+        ``port - base_port``, a stable identity stamp). The default
         fleet in bench/tests passes a closure over ``sys.executable``
         and the server flags (tier dir shared across the fleet — that
         sharing IS the warm-handoff path).
-    base_port: replica ``i`` listens on ``base_port + i``. Ports are
-        reused by index, so a scale 1→3→1→3 reboots the same URLs and
-        the router's ring placement stays stable.
+    base_port: spawns take the lowest free port at or above it. A
+        replica keeps its port for life — killing a middle victim (the
+        controller's fewest-pins pick) leaves every survivor's URL
+        untouched, and the next scale-up reuses the freed port, so a
+        scale 1→3→1→3 reboots the same URLs and the router's ring
+        placement stays stable.
     replicas_file: optional path rewritten (tmp + atomic rename) after
         every membership change — the router FileWatcher handshake.
     """
@@ -91,17 +95,23 @@ class LocalProcessActuator:
         self.replicas_file = replicas_file
         self.ready_timeout_s = ready_timeout_s
         self.kill_timeout_s = kill_timeout_s
-        self._procs: "list[subprocess.Popen]" = []
+        self._procs: "dict[int, subprocess.Popen]" = {}  # port -> proc
         self._write_replicas_file()
 
     def current(self) -> int:
         return len(self._procs)
 
-    def url(self, index: int) -> str:
-        return f"http://{self.host}:{self.base_port + index}"
+    def _url_for(self, port: int) -> str:
+        return f"http://{self.host}:{port}"
 
     def urls(self) -> "list[str]":
-        return [self.url(i) for i in range(len(self._procs))]
+        return [self._url_for(p) for p in sorted(self._procs)]
+
+    def _next_free_port(self) -> int:
+        port = self.base_port
+        while port in self._procs:
+            port += 1
+        return port
 
     def _write_replicas_file(self) -> None:
         if self.replicas_file is None:
@@ -111,14 +121,14 @@ class LocalProcessActuator:
             f.write("\n".join(self.urls()) + "\n")
         os.replace(tmp, self.replicas_file)
 
-    def _wait_ready(self, index: int) -> None:
-        url = self.url(index) + "/healthz"
+    def _wait_ready(self, port: int) -> None:
+        url = self._url_for(port) + "/healthz"
         deadline = time.monotonic() + self.ready_timeout_s
         while time.monotonic() < deadline:
-            proc = self._procs[index]
+            proc = self._procs[port]
             if proc.poll() is not None:
                 raise ScaleError(
-                    f"replica {index} exited rc={proc.returncode} "
+                    f"replica :{port} exited rc={proc.returncode} "
                     "before becoming ready")
             try:
                 with urllib.request.urlopen(url, timeout=1.0) as resp:
@@ -127,49 +137,43 @@ class LocalProcessActuator:
             except OSError:
                 pass
             time.sleep(0.2)
-        raise ScaleError(f"replica {index} not ready within "
+        raise ScaleError(f"replica :{port} not ready within "
                          f"{self.ready_timeout_s:.0f}s")
 
     def scale_to(self, n: int, victims: "list[str] | None" = None) -> None:
         """Spawn up or kill down to ``n`` processes. ``victims`` names
         replica URLs to prefer killing (the controller's drained pick);
-        un-named victims die highest-index-first. Spawned replicas are
+        un-named victims die highest-port-first. Spawned replicas are
         health-waited so a scale-up returning means a servable fleet."""
         if n < 0:
             raise ScaleError(f"cannot scale to {n}")
         while len(self._procs) < n:
-            index = len(self._procs)
-            cmd = self.spawn_command(index, self.base_port + index)
+            port = self._next_free_port()
+            cmd = self.spawn_command(port - self.base_port, port)
             try:
                 proc = subprocess.Popen(cmd)
             except OSError as e:
                 raise ScaleError(f"spawn failed: {e}") from e
-            self._procs.append(proc)
+            self._procs[port] = proc
             self._write_replicas_file()
             try:
-                self._wait_ready(index)
+                self._wait_ready(port)
             except ScaleError:
-                self._procs.pop()
+                del self._procs[port]
                 self._reap(proc)
                 self._write_replicas_file()
                 raise
         if len(self._procs) > n:
-            order = list(range(len(self._procs)))
-            victim_idx = []
-            for v in (victims or []):
-                for i in order:
-                    if self.url(i) == v.rstrip("/") and i not in victim_idx:
-                        victim_idx.append(i)
-            for i in reversed(order):
-                if len(victim_idx) >= len(self._procs) - n:
+            excess = len(self._procs) - n
+            wanted = {v.rstrip("/") for v in (victims or [])}
+            victim_ports = [p for p in sorted(self._procs)
+                            if self._url_for(p) in wanted]
+            for p in sorted(self._procs, reverse=True):
+                if len(victim_ports) >= excess:
                     break
-                if i not in victim_idx:
-                    victim_idx.append(i)
-            keep = [p for i, p in enumerate(self._procs)
-                    if i not in victim_idx]
-            dead = [p for i, p in enumerate(self._procs)
-                    if i in victim_idx]
-            self._procs = keep
+                if p not in victim_ports:
+                    victim_ports.append(p)
+            dead = [self._procs.pop(p) for p in victim_ports[:excess]]
             self._write_replicas_file()
             for proc in dead:
                 self._reap(proc)
@@ -190,7 +194,8 @@ class LocalProcessActuator:
 
     def close(self) -> None:
         """Kill the whole fleet (test/bench teardown)."""
-        dead, self._procs = self._procs, []
+        dead = list(self._procs.values())
+        self._procs = {}
         self._write_replicas_file()
         for proc in dead:
             self._reap(proc)

@@ -16,7 +16,10 @@ Decision policy (docs/AUTOSCALING.md):
   (their units don't convert to replica counts honestly).
 - **Scale down** only when EVERY signal sits below its low threshold —
   the low bar is deliberately far under the high bar (hysteresis), and
-  down-steps move one replica at a time.
+  down-steps move one replica at a time. The all-idle claim also
+  requires full scrape coverage: zero-filled signals from unreachable
+  replicas (or an empty membership view) read exactly like idleness,
+  and "no information" must never shrink the fleet.
 - **Cool-downs** gate each direction separately: a scale-up is cheap
   and urgent (short window), a scale-down destroys warm state and is
   in no hurry (long window).
@@ -157,6 +160,16 @@ class DecisionPolicy:
                 and fleet.queue_wait_p50_s < self.queue_wait_high_s / 2
                 and fleet.ttft_p50_s < self.ttft_high_s / 2)
         if idle and current > self.min_replicas:
+            # A failed scrape zero-fills every pressure signal, which is
+            # indistinguishable from a genuinely idle fleet — so the
+            # all-idle claim needs EVERY replica's testimony. Zero or
+            # partial coverage (router unreachable, empty membership,
+            # replicas mid-boot) is "no information", and no information
+            # never shrinks a possibly loaded fleet.
+            if fleet.scraped < 1 or fleet.scraped < len(fleet.samples):
+                return current, [
+                    f"held: scrape coverage {fleet.scraped}"
+                    f"/{len(fleet.samples)} — cannot prove fleet idle"]
             reasons = ["all signals below low thresholds"]
             if self._cooling("down", now):
                 return current, reasons + ["held: down cool-down"]
@@ -322,7 +335,7 @@ class Controller:
     def _pick_victim(self, urls: "list[str]") -> "str | None":
         """The replica to retire: fewest pinned sessions (least warm
         state to move), ties broken by LAST in membership order (the
-        local-process actuator kills highest-index-first, so the pick
+        local-process actuator kills highest-port-first, so the pick
         and the kill agree)."""
         if not urls:
             return None
@@ -343,32 +356,44 @@ class Controller:
     def _drain_victim(self, victim: str) -> None:
         """The drain protocol (docs/AUTOSCALING.md timeline): mark
         draining in the router, release every pinned session with
-        spill=true, wait for the victim to go idle. Every leg is
-        best-effort with a deadline — a wedged victim still dies, it
-        just loses its unparked chains (exactly what dying without the
-        protocol would have lost)."""
+        spill=true (re-enumerating until no pins remain), wait for the
+        victim to go idle. Every leg is best-effort with a deadline — a
+        wedged victim still dies, it just loses its unparked chains
+        (exactly what dying without the protocol would have lost)."""
         t0 = time.perf_counter()
-        state = self.router_state()
-        if state is not None:
+        deadline = time.monotonic() + self.drain_deadline_s
+        released = 0
+        if self.router_url is not None:
             try:
                 self._post_json(self.router_url + "/v1/admin/drain",
                                 {"replica": victim, "draining": True})
             except OSError:
                 pass
-            sessions = [s for s, rep in state.get("pins", {}).items()
-                        if rep == victim]
-            for s in sessions:
-                try:
-                    self._post_json(
-                        self.router_url + "/v1/session/release",
-                        {"session": s, "spill": True})
-                except OSError:
-                    pass
-            if sessions:
+            # Enumerate pins only AFTER the drain mark is in place, and
+            # keep re-fetching until none remain: a session that pinned
+            # to the victim between an earlier snapshot and the mark
+            # would otherwise die with the process.
+            while time.monotonic() < deadline:
+                state = self.router_state()
+                if state is None:
+                    break
+                sessions = [s for s, rep in state.get("pins", {}).items()
+                            if rep == victim]
+                if not sessions:
+                    break
+                for s in sessions:
+                    try:
+                        self._post_json(
+                            self.router_url + "/v1/session/release",
+                            {"session": s, "spill": True})
+                    except OSError:
+                        pass
+                released += len(sessions)
+                time.sleep(self.drain_poll_s)
+            if released:
                 print("autoscaler: " + json.dumps(
                     {"event": "drained_sessions", "replica": victim,
-                     "sessions": len(sessions)}), flush=True)
-        deadline = time.monotonic() + self.drain_deadline_s
+                     "sessions": released}), flush=True)
         while time.monotonic() < deadline:
             try:
                 status = self._get_json(victim + "/debug/drain")

@@ -30,16 +30,21 @@ Design points:
   path degrades to a cold prefill (chaos point ``tier_swap`` drills
   exactly this).
 - **The spill format is a handoff format.** Filenames are store-unique
-  (``tier-<pid>-<store>-<seq>.kv``), payloads are self-describing (the
-  key is in the pickle, checked at load), and ``match()`` adopts unknown
-  spill files it finds in ``spill_dir`` — so replicas sharing a spill
-  directory can inherit each other's parked chains. This is the
-  autoscaler's loss-free scale-down: the victim replica force-spills
-  its released sessions (``spill(key)``), dies, and the survivor's
-  next tier probe indexes the orphaned files and restores them warm
-  (docs/AUTOSCALING.md). One owner at a time still holds: adoption
-  only indexes files this store has never seen, a local key always
-  wins over an on-disk twin, and a load unlinks the file.
+  and namespaced by intent: capacity evictions write private
+  ``tier-<pid>-<store>-<seq>.kv`` files no peer will touch, while the
+  explicit park path (``spill(key)`` — the autoscaler's drain) writes
+  ``park-…`` files that are offered for adoption. Payloads are
+  self-describing (the key is in the pickle, checked at load), and
+  ``match()`` adopts unclaimed ``park-*`` files it finds in
+  ``spill_dir`` — so a replica sharing a spill directory inherits the
+  chains a scaled-away victim parked (docs/AUTOSCALING.md). One owner
+  at a time is enforced, not hoped for: an adopter CLAIMS a park file
+  by atomically renaming it into its own private namespace, so two
+  surviving stores racing for the same orphan resolve at the rename
+  (the loser's rename fails and it walks away) instead of both
+  indexing it and one finding the file gone at load time. The
+  per-probe cost is one ``os.stat`` of the directory — the full scan
+  runs only when the directory mtime says something changed.
 - **No device handles.** Values are plain numpy arrays + ints; the
   store survives ``_crash_reset`` rebuilding the device pool, which is
   what makes it a *recovery* tier and not just a cache annex.
@@ -55,6 +60,7 @@ from __future__ import annotations
 import itertools
 import os
 import pickle
+import time
 import zlib
 from typing import Any
 
@@ -122,6 +128,9 @@ class HostPageStore:
         # parses each foreign file at most once (corrupt ones included —
         # a bad file must not be re-read on every probe).
         self._known_paths: set[str] = set()
+        # spill_dir mtime at the last adoption scan: the probe-path
+        # gate that keeps listdir off the request hot path.
+        self._adopt_mtime_ns: int | None = None
 
     # -- write path ----------------------------------------------------
 
@@ -158,30 +167,48 @@ class HostPageStore:
             self._bytes -= ent.nbytes
 
     def spill(self, key: Key) -> bool:
-        """Force ``key``'s entry to the disk tier NOW — the drain path:
-        a parked chain must outlive this process for a surviving
-        replica to adopt it from the shared ``spill_dir``. True when
-        the entry is on disk afterwards (already-spilled included);
-        False when absent or no ``spill_dir`` is configured."""
+        """Force ``key``'s entry to the disk tier NOW, in the adoptable
+        ``park-*`` namespace — the drain path: a parked chain must
+        outlive this process for a surviving replica to adopt it from
+        the shared ``spill_dir``. An entry already on disk as a private
+        eviction spill is promoted (renamed) into the park namespace.
+        True when the entry is parked on disk afterwards; False when
+        absent or no ``spill_dir`` is configured."""
         if self.spill_dir is None:
             return False
         ent = self._entries.get(key)
         if ent is None:
             return False
-        if ent.pages is None:
-            return True  # already on disk
-        self._spill(key, ent)
+        if ent.pages is not None:
+            self._spill(key, ent, park=True)
+            return True
+        if ent.path is None:
+            return False
+        if os.path.basename(ent.path).startswith("park-"):
+            return True  # already parked
+        self._spill_seq += 1
+        parked = os.path.join(
+            self.spill_dir, f"park-{self._tag}-{self._spill_seq}.kv")
+        try:
+            os.rename(ent.path, parked)
+        except OSError:
+            return False
+        self._known_paths.add(parked)
+        ent.path = parked
         return True
 
-    def _spill(self, key: Key, ent: _Entry) -> None:
+    def _spill(self, key: Key, ent: _Entry, park: bool = False) -> None:
         """Move one resident entry to disk (atomic, checksummed).
         Filenames carry (pid, store-id) so stores sharing a spill_dir
-        never collide — and so ``adopt_orphans`` can tell a peer's file
-        from its own by path alone."""
+        never collide, and the prefix carries intent: ``tier-`` files
+        are this store's private evictions, ``park-`` files are drain
+        handoffs offered to peers via ``adopt_orphans``."""
         os.makedirs(self.spill_dir, exist_ok=True)
         self._spill_seq += 1
         path = os.path.join(
-            self.spill_dir, f"tier-{self._tag}-{self._spill_seq}.kv")
+            self.spill_dir,
+            f"{'park' if park else 'tier'}-{self._tag}"
+            f"-{self._spill_seq}.kv")
         self._known_paths.add(path)
         payload = pickle.dumps((key, ent.length, ent.pages, ent.last),
                                protocol=pickle.HIGHEST_PROTOCOL)
@@ -208,7 +235,7 @@ class HostPageStore:
         first adopts any orphaned peer spills so a chain parked by a
         drained replica is matchable here."""
         if self.spill_dir is not None:
-            self.adopt_orphans()
+            self._maybe_adopt()
         best = None
         for key in self._entries:
             aid, ptuple = key
@@ -218,22 +245,48 @@ class HostPageStore:
                 best = key
         return best
 
+    def _maybe_adopt(self) -> None:
+        """Probe-path gate for adoption: one ``os.stat`` of the spill
+        directory, with the listdir + per-file parse scan only when its
+        mtime moved since the last scan (any park, claim, or unlink by
+        any store touches the directory)."""
+        try:
+            mtime = os.stat(self.spill_dir).st_mtime_ns
+        except OSError:
+            return  # dir not created yet: nothing parked anywhere
+        if mtime == self._adopt_mtime_ns:
+            return
+        # Filesystem timestamps move on coarse clock ticks: a file
+        # parked in the same tick AFTER our scan would not move the
+        # mtime again. Only cache (and thereafter skip on) an mtime
+        # comfortably in the past; a just-modified directory keeps
+        # scanning until it quiesces.
+        if time.time_ns() - mtime > 50_000_000:  # 50 ms
+            self._adopt_mtime_ns = mtime
+        else:
+            self._adopt_mtime_ns = None
+        self.adopt_orphans()
+
     def adopt_orphans(self) -> int:
-        """Index spill files this store did not write — chains a peer
-        replica (sharing ``spill_dir``) parked before it was scaled
-        away. Each unknown ``tier-*.kv`` is read once, checksum- and
-        shape-verified, and registered as a spilled entry under its
-        embedded key; corrupt or half-written files are skipped and
-        remembered so they are never re-parsed. A key already present
-        locally wins over its on-disk twin (the local copy is the one
-        LRU order knows about). Returns the number adopted."""
+        """Index parked spill files (``park-*.kv``) this store did not
+        write — chains a peer replica (sharing ``spill_dir``) parked
+        before it was scaled away. Each candidate is read once and
+        checksum- and shape-verified, then CLAIMED by atomically
+        renaming it into this store's private ``tier-`` namespace and
+        registered as a spilled entry under its embedded key — stores
+        racing for the same orphan resolve at the rename (the loser's
+        rename fails and it walks away), never at a later load.
+        Corrupt or half-written files are skipped and remembered so
+        they are never re-parsed. A key already present locally wins
+        over its on-disk twin (the local copy is the one LRU order
+        knows about). Returns the number adopted."""
         try:
             names = os.listdir(self.spill_dir)
         except OSError:
             return 0
         adopted = 0
         for name in sorted(names):
-            if not (name.startswith("tier-") and name.endswith(".kv")):
+            if not (name.startswith("park-") and name.endswith(".kv")):
                 continue
             path = os.path.join(self.spill_dir, name)
             if path in self._known_paths:
@@ -252,6 +305,15 @@ class HostPageStore:
                 continue
             if not isinstance(pages, dict) or key in self._entries:
                 continue
+            self._spill_seq += 1
+            claimed = os.path.join(
+                self.spill_dir,
+                f"tier-{self._tag}-{self._spill_seq}.kv")
+            try:
+                os.rename(path, claimed)
+            except OSError:
+                continue  # a peer claimed it between listdir and here
+            self._known_paths.add(claimed)
             n_pages = 0
             nbytes = 0
             for arr in pages.values():
@@ -261,7 +323,7 @@ class HostPageStore:
                 nbytes += sum(int(x.nbytes) for x in last
                               if hasattr(x, "nbytes"))
             ent = _Entry(int(length), n_pages, nbytes, None, None, None)
-            ent.path = path
+            ent.path = claimed
             self._entries[key] = ent
             self._spilled_bytes += nbytes
             adopted += 1

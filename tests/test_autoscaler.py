@@ -192,6 +192,37 @@ def test_policy_bounds_clamp_and_repair():
     assert p.decide(_pressure(), 5, 0.0)[0] == 3             # above max
 
 
+def test_policy_down_vetoed_without_full_scrape_coverage():
+    p = DecisionPolicy()
+    # Zero coverage (router briefly unreachable, empty membership):
+    # every signal zero-fills to "idle" — the fleet holds, never
+    # shrinks on no information.
+    desired, reasons = p.decide(FleetSignals([]), 3, 0.0)
+    assert desired == 3 and any("coverage" in r for r in reasons)
+    # Partial coverage: one unreachable replica also vetoes the
+    # all-idle claim (its signals are unknown, not zero).
+    part = FleetSignals([
+        ReplicaSample("a", ok=True, queue_depth=0.0),
+        ReplicaSample("b", ok=False),
+    ])
+    desired, reasons = p.decide(part, 2, 0.0)
+    assert desired == 2 and any("coverage" in r for r in reasons)
+    # Full coverage of the same idle fleet shrinks as before.
+    assert p.decide(_pressure(queue=0.0), 2, 0.0)[0] == 1
+
+
+def test_controller_holds_when_fleet_view_is_empty():
+    """k8s mode with the router unreachable: replica_urls() is empty,
+    so a loaded fleet would read as idle — the step must report held,
+    not kill a replica with no drain possible."""
+    act = _StubActuator([])
+    act.n = 2
+    ctl = Controller(act, DecisionPolicy())
+    report = ctl.step(now=0.0)
+    assert report["action"] == "held"
+    assert act.calls == [] and act.n == 2
+
+
 def test_policy_validates_configuration():
     with pytest.raises(ValueError):
         DecisionPolicy(min_replicas=0)
@@ -449,6 +480,76 @@ def test_chaos_scale_actuate_backs_off_keeps_last_known_good():
         rep.close()
 
 
+class _ScriptedRouterState:
+    """Router HTTP stand-in for the drain protocol: a session pins to
+    the victim only AFTER the drain mark lands (the snapshot-vs-mark
+    race the controller must survive), and /v1/session/release drops
+    the pin."""
+
+    def __init__(self, victim):
+        self.victim = victim
+        self.drained = False
+        self.pins = {}
+        self.released = []
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), self._make())
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.url = f"http://127.0.0.1:{self.httpd.server_address[1]}"
+
+    def _make(self):
+        rt = self
+
+        class H(BaseHTTPRequestHandler):
+            def _send(self, doc):
+                body = json.dumps(doc).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                self._send({"replicas": [{"url": rt.victim}],
+                            "pins": dict(rt.pins)})
+
+            def do_POST(self):
+                doc = json.loads(self.rfile.read(
+                    int(self.headers.get("Content-Length", "0"))))
+                if self.path == "/v1/admin/drain":
+                    rt.drained = True
+                    rt.pins["late-session"] = rt.victim
+                elif self.path == "/v1/session/release":
+                    rt.released.append(doc["session"])
+                    rt.pins.pop(doc["session"], None)
+                self._send({"ok": True})
+
+            def log_message(self, *a):
+                pass
+
+        return H
+
+    def close(self):
+        self.httpd.shutdown()
+
+
+def test_drain_enumerates_pins_after_mark():
+    """A session that pins to the victim between any pre-mark snapshot
+    and the drain mark must still be released: pins are enumerated
+    after the mark is acknowledged and re-fetched until none remain."""
+    rep = _ScriptedReplica()
+    rt = _ScriptedRouterState(rep.url)
+    try:
+        ctl = Controller(_StubActuator([rep.url]), DecisionPolicy(),
+                         router_url=rt.url, drain_deadline_s=5.0,
+                         drain_poll_s=0.05)
+        ctl._drain_victim(rep.url)
+        assert rt.drained
+        assert rt.released == ["late-session"]
+        assert rt.pins == {}
+    finally:
+        rt.close()
+        rep.close()
+
+
 def test_autoscaler_obs_families_and_app_render_clean():
     obs = AutoscalerObs(instance="t")
     obs.on_signals(1.5, 0.4, 0.1, 0.2, scraped=2)
@@ -537,6 +638,32 @@ def test_local_process_actuator_scale_up_down(tmp_path):
             pass
         act.scale_to(0)
         assert act.current() == 0
+    finally:
+        act.close()
+
+
+def test_local_process_actuator_middle_victim_keeps_ports(tmp_path):
+    """Killing a non-tail victim (the controller's fewest-pins pick can
+    legitimately be a first/middle replica) must not shift survivors'
+    URLs: each process keeps its port for life, and the next scale-up
+    reuses the freed port instead of colliding with a survivor."""
+    rf = str(tmp_path / "replicas.txt")
+    act = LocalProcessActuator(_stub_spawn, base_port=_free_port_base(),
+                               replicas_file=rf, ready_timeout_s=30.0,
+                               kill_timeout_s=5.0)
+    try:
+        act.scale_to(3)
+        u0, u1, u2 = act.urls()
+        act.scale_to(2, victims=[u1])
+        assert act.urls() == [u0, u2]
+        assert parse_replicas_text(open(rf).read()) == [u0, u2]
+        for u in (u0, u2):  # survivors still serve on THEIR ports
+            with urllib.request.urlopen(u + "/healthz", timeout=5) as r:
+                assert r.status == 200
+        act.scale_to(3)  # spawns on the freed middle port
+        assert act.urls() == [u0, u1, u2]
+        with urllib.request.urlopen(u1 + "/healthz", timeout=5) as r:
+            assert r.status == 200
     finally:
         act.close()
 

@@ -173,6 +173,80 @@ def test_store_torn_spill_fails_checksum(tmp_path):
     assert not store.contains((0, (1,)))
 
 
+def test_park_spill_claimed_by_exactly_one_peer(tmp_path):
+    """The drain handoff: spill(key) parks as an adoptable park-*.kv;
+    a peer claims it by atomic rename so exactly ONE store adopts, and
+    private eviction spills (tier-*) are never offered."""
+    one = sum(a.nbytes for a in _fake_chain(0).values())
+    owner = HostPageStore(int(one * 4), spill_dir=str(tmp_path))
+    want = _fake_chain(3)
+    owner.put((0, (1, 2)), 2, want)
+    assert owner.spill((0, (1, 2)))
+    assert all(p.name.startswith("park-") for p in tmp_path.iterdir())
+    # An eviction spill rides the private tier-* namespace.
+    owner.put((0, (9,)), 1, _fake_chain(4))
+    owner.capacity = 1
+    owner._evict_oldest_resident()
+    assert any(p.name.startswith("tier-") for p in tmp_path.iterdir())
+
+    a = HostPageStore(int(one * 4), spill_dir=str(tmp_path))
+    b = HostPageStore(int(one * 4), spill_dir=str(tmp_path))
+    got = a.adopt_orphans() + b.adopt_orphans()
+    assert got == 1, "park file adopted once; tier file never offered"
+    winner, loser = (a, b) if a.contains((0, (1, 2))) else (b, a)
+    assert not loser.contains((0, (1, 2)))
+    assert loser.match(0, (1, 2, 3)) is None
+    assert winner.match(0, (1, 2, 3)) == (0, (1, 2))
+    length, pages, last = winner.load((0, (1, 2)))
+    assert length == 2
+    for name, arr in want.items():
+        assert np.array_equal(pages[name], arr), name
+    # The owner never adopts its own files back; its eviction spill
+    # still loads from the private namespace.
+    assert owner.adopt_orphans() == 0
+    owner.capacity = int(one * 4)
+    owner.load((0, (9,)))
+
+
+def test_spill_promotes_prior_eviction_spill_to_park(tmp_path):
+    """release with spill=true on an entry ALREADY evicted to disk:
+    the private tier-* file is renamed into the adoptable park-*
+    namespace rather than rewritten."""
+    one = sum(a.nbytes for a in _fake_chain(0).values())
+    store = HostPageStore(int(one * 1.2), spill_dir=str(tmp_path))
+    store.put((0, (1,)), 1, _fake_chain(0))
+    store.put((0, (2,)), 1, _fake_chain(1))   # evicts (1,) to tier-*
+    assert any(p.name.startswith("tier-") for p in tmp_path.iterdir())
+    assert store.spill((0, (1,)))
+    names = [p.name for p in tmp_path.iterdir()]
+    assert any(n.startswith("park-") for n in names)
+    assert store.spill((0, (1,)))             # idempotent: stays parked
+    peer = HostPageStore(int(one * 4), spill_dir=str(tmp_path))
+    assert peer.adopt_orphans() == 1
+    assert peer.load((0, (1,)))[0] == 1
+
+
+def test_match_adoption_gated_on_dir_mtime(tmp_path):
+    """The tier probe pays one os.stat, not a listdir+parse, while the
+    spill dir is quiet — and still adopts promptly when a peer parks."""
+    time.sleep(0.06)  # let the fresh dir's mtime age past the gate
+    store = HostPageStore(1 << 20, spill_dir=str(tmp_path))
+    calls = []
+    orig = store.adopt_orphans
+    store.adopt_orphans = lambda: (calls.append(1), orig())[1]
+    store.match(0, (1,))
+    n0 = len(calls)
+    assert n0 == 1, "first probe scans"
+    store.match(0, (1,))
+    store.match(0, (1,))
+    assert len(calls) == n0, "quiet dir: stat-only probes"
+    peer = HostPageStore(1 << 20, spill_dir=str(tmp_path))
+    peer.put((0, (5, 6)), 2, _fake_chain(1))
+    assert peer.spill((0, (5, 6)))
+    assert store.match(0, (5, 6, 7)) == (0, (5, 6))
+    assert len(calls) > n0, "dir change re-arms the scan"
+
+
 # --- accounting: the bytes capacity planning trusts (satellite) ---------
 
 
