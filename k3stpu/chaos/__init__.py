@@ -78,6 +78,18 @@ point                 boundary
                       spawn failure, exercising the back-off +
                       keep-last-known-good containment (the fleet
                       freezes, never thrashes)
+``kv_transfer``       the disagg KV handoff path (docs/DISAGG.md), both
+                      legs: top of ``engine._do_export_chain`` — a
+                      raised fault fails that export cleanly (the
+                      decode peer sees the HTTP error and prefills
+                      cold) — and top of ``engine._do_import_chain``,
+                      where it is caught like a torn/checksum-failed
+                      wire payload: ``import_chain`` returns False,
+                      ``transfer_fallbacks`` counts it, and the request
+                      completes via a cold prefill on the decode
+                      replica with exact output; live rows are
+                      untouched either way (imports only ever touch
+                      fresh pages)
 ====================  =====================================================
 """
 

@@ -47,7 +47,8 @@ class ServeObs:
 
     def __init__(self, trace_capacity: int = 256, enabled: bool = True,
                  instance: "str | None" = None,
-                 attn_backend: str = "xla-gather"):
+                 attn_backend: str = "xla-gather",
+                 role: "str | None" = None):
         self.enabled = enabled
         self.traces = TraceBuffer(capacity=trace_capacity)
         self.ttft = Histogram(
@@ -149,10 +150,33 @@ class ServeObs:
             "k3stpu_serve_tier_fallbacks_total",
             "Tier swaps that failed and degraded to a cold prefill "
             "(or plain eviction).")
+        # Disaggregated prefill/decode KV transfer (docs/DISAGG.md).
+        # One histogram covers both directions — a prefill replica only
+        # exports and a decode replica only imports, so per-process the
+        # series is already direction-pure; the engine's
+        # kv_exports/kv_imports stats split them when one process does
+        # both (tests, the monolithic fallback). All stay at zero on a
+        # monolithic replica.
+        self.kv_transfer_seconds = Histogram(
+            "k3stpu_serve_kv_transfer_seconds",
+            "KV page-chain transfer time per disagg handoff (gather + "
+            "serialize on export; verify + restore-scatter on import).",
+            bounds=TPOT_BUCKETS_S)
+        self.kv_transfer_bytes = Counter(
+            "k3stpu_serve_kv_transfer_bytes_total",
+            "Serialized KV page-chain bytes moved by disagg handoffs "
+            "(exported + imported).")
+        self.transfer_fallbacks = Counter(
+            "k3stpu_serve_transfer_fallbacks_total",
+            "Disagg KV handoffs that failed (torn/corrupt transfer, "
+            "unreachable prefill peer, pool too tight) and degraded to "
+            "a cold prefill on the decode replica.")
         # ``instance`` (pod name or host:port) stamps which replica of a
-        # scaled-out serving fleet this exposition came from; None (the
+        # scaled-out serving fleet this exposition came from; ``role``
+        # is the disagg serving role (prefill / decode). Both None (the
         # default) keeps the single-replica label set byte-stable.
-        self.build_info = build_info_gauge("serve", instance=instance)
+        self.build_info = build_info_gauge("serve", instance=instance,
+                                           role=role)
 
     # -- engine hooks (loop / submitter threads) ---------------------------
 
@@ -228,6 +252,23 @@ class ServeObs:
             return
         self.tier_fallbacks.inc()
 
+    def on_kv_transfer(self, direction: str, seconds: float,
+                       nbytes: int) -> None:
+        """One completed disagg KV handoff leg ('export' = chain
+        gathered + serialized on the prefill replica, 'import' = wire
+        bytes verified + restored on the decode replica). Direction
+        rides the engine's kv_exports/kv_imports counters; here both
+        legs feed the one transfer histogram and byte counter."""
+        if not self.enabled:
+            return
+        self.kv_transfer_seconds.observe(seconds)
+        self.kv_transfer_bytes.inc(nbytes)
+
+    def on_transfer_fallback(self) -> None:
+        if not self.enabled:
+            return
+        self.transfer_fallbacks.inc()
+
     def on_spec_dispatch(self, proposed: int, accepted: int, emitted: int,
                          draft_s: float, verify_s: float) -> None:
         """One speculative verify dispatch: ``proposed`` draft tokens
@@ -270,12 +311,13 @@ class ServeObs:
                 self.batch_occupancy, self.decode_dispatch_seconds,
                 self.spec_draft_seconds,
                 self.spec_verify_seconds, self.tier_swap_in_seconds,
-                self.tier_swap_out_seconds)
+                self.tier_swap_out_seconds, self.kv_transfer_seconds)
 
     def _counters(self) -> "tuple[Counter, ...]":
         return (self.spec_accepted_tokens, self.spec_proposed_tokens,
                 self.spec_dispatches, self.tier_hits, self.tier_misses,
-                self.tier_fallbacks)
+                self.tier_fallbacks, self.kv_transfer_bytes,
+                self.transfer_fallbacks)
 
     def _gauges(self) -> "tuple[Gauge, ...]":
         return (self.queue_depth, self.pages_free, self.pages_resident,

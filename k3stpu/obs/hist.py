@@ -68,20 +68,26 @@ class InfoGauge:
 
 
 def build_info_gauge(component: str,
-                     instance: "str | None" = None) -> InfoGauge:
+                     instance: "str | None" = None,
+                     role: "str | None" = None) -> InfoGauge:
     """The shared ``k3stpu_build_info`` family every metric server in
     the stack (serve, train rank-0, node exporter, router) exposes,
     telling one scrape apart from another by version and role.
 
     ``instance`` names WHICH replica of a horizontally-scaled component
     this is (pod name or host:port) — the label the router tier and
-    multi-endpoint loadgen join per-replica series on. Omitted (the
-    single-replica components), the label set stays exactly the
-    pre-router pair, so existing expositions are byte-stable."""
+    multi-endpoint loadgen join per-replica series on. ``role`` is the
+    disaggregated-serving role (``prefill`` / ``decode`` — the
+    docs/DISAGG.md topology), so a dashboard splits fleet series by
+    which half of the pipeline a replica runs. Both omitted (the
+    single-replica monolithic components), the label set stays exactly
+    the pre-router pair, so existing expositions are byte-stable."""
     from k3stpu import __version__
     labels = {"version": __version__, "component": component}
     if instance is not None:
         labels["instance"] = instance
+    if role is not None:
+        labels["role"] = role
     return InfoGauge(
         "k3stpu_build_info",
         "Constant-1 build/version info gauge (standard convention)",

@@ -65,6 +65,12 @@ from k3stpu.router.obs import RouterObs
 from k3stpu.router.ring import HashRing
 
 REPLICA_HEADER = "X-K3STPU-Replica"
+# Two-hop disagg placement (docs/DISAGG.md): the router picks the
+# prefill peer for each generate request and names it in this header;
+# the decode replica pulls the prompt's KV chain from that URL before
+# admission. Absent header = the decode replica's --prefill-upstream,
+# or a plain cold prefill — never an error.
+PREFILL_HEADER = "X-K3STPU-Prefill-Endpoint"
 
 # Fleet-saturated shed/backoff discipline — the same constants loadgen's
 # 503 retry chain uses, so a client backing off from the router behaves
@@ -93,7 +99,8 @@ class Router:
                  policy: str = "affinity",
                  instance: "str | None" = None,
                  chaos=None,
-                 allow_empty: bool = False):
+                 allow_empty: bool = False,
+                 prefill_replicas: "list[str] | None" = None):
         if not replicas and not allow_empty:
             raise ValueError("router needs at least one replica URL")
         if policy not in ("affinity", "random"):
@@ -132,6 +139,20 @@ class Router:
         self._draining = False
         self._active_http = 0
         self._rr = 0  # random-policy cursor (deterministic round-robin)
+        # Disagg prefill pool (docs/DISAGG.md): a SEPARATE membership
+        # from the decode ring — prefill-role replicas never take
+        # generate traffic, decode replicas never take /v1/prefill.
+        # Prefix-affine on its own ring so a repeated system prompt
+        # prefills where its cached pages already live, with the same
+        # optimistic-health + poller-correction discipline as the main
+        # pool. Empty pool = two-hop placement off, nothing changes.
+        self._prefill_replicas = [r.rstrip("/")
+                                  for r in (prefill_replicas or [])]
+        self._prefill_healthy: "dict[str, bool]" = {
+            r: True for r in self._prefill_replicas}
+        self._prefill_ring = HashRing(vnodes=vnodes)
+        for r in self._prefill_replicas:
+            self._prefill_ring.add(r)
         self._poller: "threading.Thread | None" = None
         self._poller_stop = threading.Event()
 
@@ -264,6 +285,10 @@ class Router:
                     self.readmit(r)
                 else:
                     self.eject(r, "healthz failed")
+            for r in self.prefill_pool():
+                if self._poller_stop.is_set():
+                    return
+                self.set_prefill_health(r, self._probe(r))
 
     def _probe(self, replica: str) -> bool:
         try:
@@ -273,6 +298,46 @@ class Router:
                 return resp.status == 200
         except OSError:
             return False
+
+    # -- disagg prefill pool (docs/DISAGG.md) ------------------------------
+
+    def prefill_pool(self) -> "list[str]":
+        with self._lock:
+            return list(self._prefill_replicas)
+
+    def set_prefill_health(self, replica: str, healthy: bool) -> None:
+        """Eject/readmit in the prefill pool. A fully-dark pool is NOT
+        an outage: prefill_endpoint returns None and every decode
+        replica degrades to cold prefills — capacity loss, not
+        availability loss."""
+        replica = replica.rstrip("/")
+        with self._lock:
+            was = self._prefill_healthy.get(replica)
+            if was is None or was == healthy:
+                return
+            self._prefill_healthy[replica] = healthy
+            if healthy:
+                self._prefill_ring.add(replica)
+            else:
+                self._prefill_ring.remove(replica)
+        print(f"router: prefill replica {replica} "
+              f"{'readmitted' if healthy else 'ejected'}", flush=True)
+
+    def prefill_endpoint(self, body: "dict | None",
+                         raw: bytes) -> "str | None":
+        """The first hop of two-hop placement: which prefill replica
+        should run this request's prompt. Prefix-affine on the prefill
+        ring — the span that repeats is exactly the span worth keeping
+        warm on ONE prefill replica. None when the pool is empty or
+        fully ejected (the decode replica then prefills cold)."""
+        key = self.prefix_key(body, raw, self.prefix_tokens)
+        with self._lock:
+            if not any(self._prefill_healthy.values()):
+                return None
+            for r in self._prefill_ring.iter_nodes(key):
+                if self._prefill_healthy.get(r, False):
+                    return r
+        return None
 
     # -- drain (SIGTERM path, same contract as server.py) ------------------
 
@@ -422,6 +487,9 @@ class Router:
                      "draining": r in self._draining_replicas}
                     for r in self._replicas],
                 "policy": self.policy,
+                "prefill_replicas": [
+                    {"url": r, "healthy": self._prefill_healthy[r]}
+                    for r in self._prefill_replicas],
                 "sessions_pinned": len(self._pins),
                 "pins": dict(self._pins),
                 "draining": self._draining,
@@ -443,6 +511,9 @@ def make_router_app(router: Router):
         # router forwards THIS unchanged — minting a fresh parent here
         # would orphan the replica's spans from the client's trace.
         _inbound_tp: "str | None" = None
+        # The prefill peer chosen for the CURRENT generate request
+        # (None = single-hop); set per request in _route_post.
+        _prefill_ep: "str | None" = None
 
         def _begin_trace(self) -> None:
             raw = self.headers.get("traceparent")
@@ -463,6 +534,17 @@ def make_router_app(router: Router):
             if self._inbound_tp is not None:
                 return self._inbound_tp
             return format_traceparent(self._trace_ctx[0], new_span_id())
+
+        def _upstream_headers(self) -> dict:
+            """Headers for one upstream POST: content type, the
+            forwarded traceparent, and — when two-hop placement chose a
+            prefill peer for this request — the prefill-endpoint hint
+            the decode replica pulls its KV chain from."""
+            headers = {"Content-Type": "application/json",
+                       "traceparent": self._upstream_traceparent()}
+            if self._prefill_ep is not None:
+                headers[PREFILL_HEADER] = self._prefill_ep
+            return headers
 
         def _trace_headers(self) -> None:
             """Echo the trace id on EVERY response the router writes —
@@ -574,6 +656,7 @@ def make_router_app(router: Router):
                 router.http_end()
 
         def _route_post(self):
+            self._prefill_ep = None  # keep-alive: don't leak across requests
             length = int(self.headers.get("Content-Length", "0"))
             raw = self.rfile.read(length)
             try:
@@ -597,6 +680,12 @@ def make_router_app(router: Router):
                 self._send(503, {"error": str(e)},
                            headers={"Retry-After": str(_RETRY_AFTER_S)})
                 return
+            # Two-hop disagg placement: pick the prefill peer for this
+            # prompt and name it in the upstream headers; the decode
+            # replica pulls the chain from there. None (no pool, pool
+            # dark, non-generate path) = normal single-hop behavior.
+            self._prefill_ep = (router.prefill_endpoint(body, raw)
+                                if self.path == "/v1/generate" else None)
             router._obs.on_route(reason)
             self._proxy(candidates, session, raw, t0)
 
@@ -666,8 +755,7 @@ def make_router_app(router: Router):
             client deserves to see), not an exception."""
             req = urllib.request.Request(
                 replica + self.path, data=raw, method="POST",
-                headers={"Content-Type": "application/json",
-                         "traceparent": self._upstream_traceparent()})
+                headers=self._upstream_headers())
             try:
                 with urllib.request.urlopen(
                         req, timeout=router.proxy_timeout_s) as r:
@@ -787,8 +875,7 @@ def make_router_app(router: Router):
             raises OSError back into the failover walk."""
             req = urllib.request.Request(
                 replica + self.path, data=raw, method="POST",
-                headers={"Content-Type": "application/json",
-                         "traceparent": self._upstream_traceparent()})
+                headers=self._upstream_headers())
             try:
                 upstream = urllib.request.urlopen(
                     req, timeout=router.proxy_timeout_s)
@@ -904,6 +991,14 @@ def main(argv=None) -> int:
                     help="'affinity' = sticky sessions + prefix hash "
                          "(production); 'random' = spread with no "
                          "affinity (the bench baseline)")
+    ap.add_argument("--prefill-replicas", default=None,
+                    help="comma-separated base URLs of prefill-role "
+                         "replicas (--role prefill) for disaggregated "
+                         "serving (docs/DISAGG.md): each generate "
+                         "request gets a prefix-affine prefill peer "
+                         "named in the X-K3STPU-Prefill-Endpoint "
+                         "header; the decode replica pulls the KV "
+                         "chain from it. Omitted = single-hop routing")
     ap.add_argument("--instance", default=None,
                     help="replica-identity stamp for k3stpu_build_info "
                          "(default: hostname)")
@@ -930,7 +1025,10 @@ def main(argv=None) -> int:
         health_timeout_s=args.health_timeout_s,
         proxy_timeout_s=args.proxy_timeout_s, policy=args.policy,
         instance=args.instance, chaos=chaos_from_env(),
-        allow_empty=True)
+        allow_empty=True,
+        prefill_replicas=([r for r in args.prefill_replicas.split(",")
+                           if r.strip()]
+                          if args.prefill_replicas else None))
     watcher = None
     if args.replicas_file:
         watcher = FileWatcher(router, args.replicas_file,

@@ -22,11 +22,27 @@ XLA-static shapes):
 The reference has no serving scheduler at all (its workload is a stock
 binary behind a Service, reference jellyfin.yaml:1-43); this is the
 match-or-beat half of the serving story.
+
+The engine is composed from three layers over one shared ``self``
+(their state is disjoint and every method runs against the same
+object, so the split moves code, not behavior — pinned by the
+bit-exactness suites):
+
+- ``serve/scheduler.py`` — admission, chunked-prefill budgeting, the
+  continuous-batching policy, and the client-facing submit paths.
+- ``serve/kv_manager.py`` — page pool + refcounts, prompt cache, host
+  tier, block tables, and the disagg KV-transfer primitives
+  (``export_chain`` / ``import_chain``, docs/DISAGG.md).
+- ``serve/runner.py`` — the jitted prefill/decode/spec-verify device
+  programs.
+
+This module keeps the loop thread itself (plus crash containment and
+the watchdog) and re-exports the public surface, so
+``from k3stpu.serve.engine import GenerateEngine`` keeps working.
 """
 
 from __future__ import annotations
 
-import functools
 import queue
 import threading
 import time
@@ -35,210 +51,33 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from k3stpu.models.generate import init_cache, paged_model, set_cache_index
-from k3stpu.serve.containment import CircuitOpen, EngineStalled
-from k3stpu.serve.programs import (
-    decode_core,
-    extend_core,
-    prefill_core,
-    prompt_width_bucket,
+from k3stpu.models.generate import init_cache, paged_model
+from k3stpu.serve.containment import EngineStalled
+from k3stpu.serve.kv_manager import KVManagerMixin, _PageAllocator
+from k3stpu.serve.runner import (
+    ModelRunnerMixin,
+    _pow2_at_least,
+    _sample_rows,
+)
+from k3stpu.serve.scheduler import (
+    EngineOverloaded,
+    SchedulerMixin,
+    _Request,
+    _TierCommand,
 )
 
-_NEG_INF = -1e30
+__all__ = [
+    "GenerateEngine",
+    "EngineOverloaded",
+    "_PageAllocator",
+    "_Request",
+    "_TierCommand",
+    "_pow2_at_least",
+    "_sample_rows",
+]
 
 
-class EngineOverloaded(RuntimeError):
-    """Raised by submit paths when max_pending requests are already in
-    flight — the backpressure signal the HTTP layer turns into a 503
-    (shed load at the door; queueing unboundedly just converts overload
-    into client timeouts plus held memory)."""
-
-
-def _pow2_at_least(n: int, lo: int = 1) -> int:
-    p = lo
-    while p < n:
-        p *= 2
-    return p
-
-
-class _PageAllocator:
-    """Host-side page bookkeeping for the paged KV cache (loop thread
-    only). Page 0 is the reserved sink — pad rows and neutralized batch
-    rows write there — so it is never handed out. Sharing (prompt-cache
-    pins, sampled fan-outs) is refcounted: a page returns to the free
-    list only when its last reference drops."""
-
-    def __init__(self, num_pages: int):
-        self.num_pages = num_pages
-        self._rc = np.zeros((num_pages,), np.int32)
-        self._free = list(range(num_pages - 1, 0, -1))  # pop() hands out 1 first
-
-    @property
-    def total(self) -> int:
-        return self.num_pages - 1  # the sink page is not allocatable
-
-    @property
-    def free(self) -> int:
-        return len(self._free)
-
-    def refcount(self, page: int) -> int:
-        return int(self._rc[page])
-
-    def alloc(self, n: int) -> "list[int] | None":
-        """n fresh pages at refcount 1, or None (all-or-nothing)."""
-        if n > len(self._free):
-            return None
-        pages = [self._free.pop() for _ in range(n)]
-        self._rc[pages] = 1
-        return pages
-
-    def incref(self, pages) -> None:
-        for p in pages:
-            if self._rc[p] <= 0:
-                raise RuntimeError(f"incref on free page {p}")
-            self._rc[p] += 1
-
-    def decref(self, pages) -> None:
-        for p in pages:
-            if self._rc[p] <= 0:
-                raise RuntimeError(f"double free of page {p}")
-            self._rc[p] -= 1
-            if self._rc[p] == 0:
-                self._free.append(p)
-
-
-def _sample_rows(logits, temps, topks, topps, key):
-    """Per-row sampling over (B, V) logits: temperature <= 0 is greedy;
-    top-k cuts below each row's own k-th value (k == V disables); top-p
-    keeps each row's smallest nucleus reaching mass p (1.0 disables).
-
-    The all-greedy batch — the dominant serving case, and every decode
-    step of the exactness-pinned capture runs — skips the sampling
-    machinery entirely via ``lax.cond``: the mixed path pays two full
-    (B, V) sorts (top-k kth-value + top-p nucleus) per step, pure
-    VPU/HBM waste when no row will use the result."""
-    from k3stpu.models.generate import top_p_mask
-
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    def mixed(_):
-        v = logits.shape[-1]
-        scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
-        srt = jnp.sort(scaled, axis=-1)
-        kth = jnp.take_along_axis(
-            srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
-        cut = jnp.where(scaled < kth, _NEG_INF, scaled)
-        cut = top_p_mask(cut, topps)
-        sampled = jax.random.categorical(key, cut,
-                                         axis=-1).astype(jnp.int32)
-        return jnp.where(temps <= 0.0, greedy, sampled)
-
-    return jax.lax.cond(jnp.all(temps <= 0.0), lambda _: greedy, mixed,
-                        None)
-
-
-class _Request:
-    __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
-                 "eos", "event", "tokens", "error", "slot_rows", "samples",
-                 "deadline", "stream_q", "_ptuple", "probe", "adapter",
-                 "trace", "trace_id", "session")
-
-    def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
-                 top_p=None, adapter=0):
-        self.block = block          # (n, P) int32, right-padded
-        self.lens = lens            # (n,) true lengths
-        self.budget = budget        # max new tokens (shared by the rows)
-        self.temp = temp
-        self.top_k = top_k
-        self.top_p = top_p          # float | None (None == 1.0, no cut)
-        self.eos = eos              # int | None
-        self.samples = samples      # >1: one prompt, n sampled rows
-        self.adapter = adapter      # multi-LoRA slot (0 = base)
-        self.event = threading.Event()
-        self.tokens: "list[list[int]] | None" = None
-        self.error: "Exception | None" = None
-        self.slot_rows: "list[int]" = []
-        self.deadline: float = float("inf")  # set by _enqueue_and_wait
-        # submit_stream() installs a queue here; the loop thread pushes
-        # per-block token deltas into it and signal() pushes the terminal
-        # None. Non-streaming requests leave it None (zero overhead).
-        self.stream_q: "queue.SimpleQueue | None" = None
-        self._ptuple: "tuple | None" = None  # memoized prompt key
-        # Lifecycle trace (k3stpu.obs.ReqTrace), set at enqueue when the
-        # engine carries a ServeObs; None costs nothing on any path.
-        self.trace = None
-        # W3C trace id (32 validated lowercase-hex chars) assigned at
-        # the HTTP edge; None for direct submits. Only parse_traceparent
-        # output ever lands here — raw header bytes never reach the
-        # engine.
-        self.trace_id: "str | None" = None
-        # Memoized prompt-cache probe result (pkey, pentry) — the probe
-        # re-runs every loop iteration while the request waits for free
-        # slots, and re-scanning the cache each time is pure engine-
-        # thread waste. A stale entry stays CORRECT (immutable arrays);
-        # the only cost is missing a better prefix inserted meanwhile.
-        self.probe: "tuple | None" = None
-        # Session id (paged mode): names this request's finished KV
-        # chain in the prompt cache / host tier so the session's next
-        # turn restores it instead of re-prefilling. None = one-shot.
-        self.session: "str | None" = None
-
-    def ptuple(self) -> tuple:
-        """The single-prompt cache key, computed once — the admission
-        probe re-runs while a request waits for free slots, and an
-        O(prompt) conversion per loop iteration on the engine thread
-        is waste (the block is immutable after packing)."""
-        if self._ptuple is None:
-            self._ptuple = tuple(
-                int(t) for t in self.block[0, :int(self.lens[0])])
-        return self._ptuple
-
-    def signal(self) -> None:
-        """Wake the submitter on EVERY terminal path (tokens ready, error,
-        expiry, shutdown): terminal stream marker first, THEN the event —
-        a streaming consumer must never wait on a queue nobody will feed
-        again. Being the single terminal funnel, this is also where the
-        lifecycle trace retires (finish() is idempotent — the success
-        path already closed it with completion timings)."""
-        if self.trace is not None:
-            if self.error is not None:
-                self.trace.finish("error", repr(self.error))
-            else:
-                self.trace.finish("ok")
-        if self.stream_q is not None:
-            self.stream_q.put(None)
-        self.event.set()
-
-
-class _TierCommand:
-    """A control message riding the request queue: allocator / prompt
-    cache / tier state belongs to the loop thread alone, so HTTP-thread
-    operations on it (session release) marshal through ``_q`` and run
-    inline at drain. Duck-types the slice of ``_Request`` the loop's
-    shutdown tail touches (``error`` + ``signal()`` + ``deadline``) so
-    a command stranded behind the close sentinel fails cleanly instead
-    of hanging its caller."""
-
-    __slots__ = ("kind", "session", "spill", "event", "result", "error",
-                 "deadline", "tokens", "stream_q", "trace")
-
-    def __init__(self, kind: str, session: str, spill: bool = False):
-        self.kind = kind
-        self.session = session
-        self.spill = spill
-        self.event = threading.Event()
-        self.result = None
-        self.error: "Exception | None" = None
-        self.deadline = float("inf")  # commands never expire
-        self.tokens = None
-        self.stream_q = None
-        self.trace = None
-
-    def signal(self) -> None:
-        self.event.set()
-
-
-class GenerateEngine:
+class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
     """Owns a ``slots``-row KV cache and a single decode loop thread.
 
     ``submit()`` blocks the calling (HTTP handler) thread until its
@@ -575,6 +414,12 @@ class GenerateEngine:
                        "tier_hits": 0, "tier_misses": 0,
                        "tier_swap_ins": 0, "tier_swap_outs": 0,
                        "tier_fallbacks": 0,
+                       # Disagg KV transfer (docs/DISAGG.md): completed
+                       # exports/imports, wire bytes moved in either
+                       # direction, and handoffs that degraded to a
+                       # cold prefill on the decode replica.
+                       "kv_exports": 0, "kv_imports": 0,
+                       "kv_transfer_bytes": 0, "transfer_fallbacks": 0,
                        # Containment counters (docs/RESILIENCE.md).
                        "deadline_expired": 0, "watchdog_trips": 0,
                        "loop_crashes": 0, "loop_restarts": 0,
@@ -605,976 +450,7 @@ class GenerateEngine:
                 name="engine-watchdog")
             self._watchdog.start()
 
-    # --- jitted device programs (compiled once per static bucket) -------
-
-    # params travel as jit ARGUMENTS (donated weights would bake into the
-    # compiled program as constants otherwise — double the HBM). The
-    # cache-model programs themselves are the shared cores in
-    # serve/programs.py (one definition for engine + speculative).
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _decode_step(self, params, cache, toks, temps, topks, topps,
-                     step, base_key, aids=None):
-        cache, logits = decode_core(self.model, params, cache, toks,
-                                    adapter_ids=aids)
-        key = jax.random.fold_in(base_key, step)
-        return cache, _sample_rows(logits, temps, topks, topps, key)
-
-    @functools.partial(jax.jit, static_argnums=(0, 9))
-    def _decode_block_step(self, params, cache, toks, temps, topks,
-                           topps, step, base_key, k_tokens: int,
-                           aids=None):
-        """K decode steps in ONE dispatch: ``lax.scan`` over the
-        single-token core, sampling on-device each step. Returns the
-        (K, B) token block; greedy rows are exactly K steps of argmax,
-        so engine output stays pinned to ``generate()`` token for
-        token. Rows that finish mid-block keep decoding (static shapes;
-        the host discards their surplus) — their cache writes clamp at
-        the row's last slot and the slot's next reuse scatters a fresh
-        prefill over everything, index included."""
-        block_key = jax.random.fold_in(base_key, step)
-
-        def body(carry, i):
-            cache, tok = carry
-            cache, logits = decode_core(self.model, params, cache, tok,
-                                        adapter_ids=aids)
-            key = jax.random.fold_in(block_key, i)
-            nxt = _sample_rows(logits, temps, topks, topps, key)
-            return (cache, nxt), nxt
-
-        (cache, _), out = jax.lax.scan(
-            body, (cache, toks), jnp.arange(k_tokens))
-        return cache, out
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _prefill(self, params, block, lens, aids=None):
-        return prefill_core(self.model, params, block, lens,
-                            adapter_ids=aids)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _scatter(self, big, small, slot_ids):
-        return jax.tree.map(lambda b, s: b.at[slot_ids].set(s), big, small)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _extend_chunk(self, params, cache, chunk, aids=None):
-        return extend_core(self.model, params, cache, chunk,
-                           adapter_ids=aids)[0]
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _decode_logits(self, params, cache, toks, aids=None):
-        return decode_core(self.model, params, cache, toks,
-                           adapter_ids=aids)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _first_sample(self, last_logits, temps, topks, topps, step,
-                      base_key):
-        key = jax.random.fold_in(base_key, step)
-        return _sample_rows(last_logits, temps, topks, topps, key)
-
-    @functools.partial(jax.jit, static_argnums=(0, 3))
-    def _broadcast_rows(self, cache, last, n: int):
-        """Row 0 of a 1-row admission cache replicated to n rows — the
-        shared-prefix fan-out (one prefill, n sampled continuations)."""
-        rep = jax.tree.map(
-            lambda x: jnp.broadcast_to(x[:1], (n, *x.shape[1:])), cache)
-        return rep, jnp.broadcast_to(last[:1], (n, *last.shape[1:]))
-
-    # --- paged-cache programs (block tables + host-injected indices) ----
-
-    # Every paged program takes the host's (slots,) index mirror and
-    # stamps it into the cache before the core runs: device-side index
-    # state is disposable, so a batch-wide call that advances OTHER
-    # rows' indices (the prefix-hit extension neutralizes those rows
-    # onto the sink page) is corrected for free at the next dispatch.
-    # Block tables are traced int32 data — one compiled program serves
-    # every page assignment, zero steady-state recompiles.
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _paged_decode_step(self, params, cache, idx, bts, toks, temps,
-                           topks, topps, step, base_key, aids=None):
-        cache = set_cache_index(cache, idx)
-        cache, logits = decode_core(self.pmodel, params, cache, toks,
-                                    adapter_ids=aids, block_tables=bts)
-        key = jax.random.fold_in(base_key, step)
-        return cache, _sample_rows(logits, temps, topks, topps, key)
-
-    @functools.partial(jax.jit, static_argnums=(0, 11))
-    def _paged_decode_block_step(self, params, cache, idx, bts, toks,
-                                 temps, topks, topps, step, base_key,
-                                 k_tokens: int, aids=None):
-        cache = set_cache_index(cache, idx)
-        block_key = jax.random.fold_in(base_key, step)
-
-        def body(carry, i):
-            cache, tok = carry
-            cache, logits = decode_core(self.pmodel, params, cache, tok,
-                                        adapter_ids=aids,
-                                        block_tables=bts)
-            key = jax.random.fold_in(block_key, i)
-            nxt = _sample_rows(logits, temps, topks, topps, key)
-            return (cache, nxt), nxt
-
-        (cache, _), out = jax.lax.scan(
-            body, (cache, toks), jnp.arange(k_tokens))
-        return cache, out
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _paged_extend(self, params, cache, idx, bts, chunk, aids=None):
-        cache = set_cache_index(cache, idx)
-        return extend_core(self.pmodel, params, cache, chunk,
-                           adapter_ids=aids, block_tables=bts)[0]
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _paged_decode_logits(self, params, cache, idx, bts, toks,
-                             aids=None):
-        cache = set_cache_index(cache, idx)
-        return decode_core(self.pmodel, params, cache, toks,
-                           adapter_ids=aids, block_tables=bts)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _spec_verify(self, params, cache, idx, bts, chunk, aids=None):
-        """Speculative verify: ONE extend over the static
-        ``(slots, spec_gamma+1)`` chunk ``[x0, d1..d_gamma]``.
-        ``logits[:, j]`` scores the token after ``chunk[:, :j+1]``, so
-        the row-wise argmax is the target's own greedy continuation at
-        every draft position — the host keeps each row's longest
-        matching prefix plus the token at the first divergence. The
-        argmax epilogue stays in-jit (shipping (slots, G, V) logits to
-        the host every dispatch would swamp the win) and is also what
-        pins ``speculate=True`` to greedy exactness: there is no
-        sampled verify."""
-        cache = set_cache_index(cache, idx)
-        cache, logits = extend_core(self.pmodel, params, cache, chunk,
-                                    adapter_ids=aids, block_tables=bts)
-        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _pack_pages(self, pool, small, page_map):
-        """Scatter a dense-prefilled admission cache into the page pool:
-        row j's (max_seq,) K/V reshapes into (n_bt, page_size) pages and
-        lands at pages ``page_map[j]`` (pad rows map to the sink). One
-        compile per admitted-rows bucket; 'index' leaves pass through —
-        they are host-injected at every dispatch."""
-        dense = {tuple(k.key for k in p): v for p, v
-                 in jax.tree_util.tree_flatten_with_path(small)[0]}
-
-        def pack(path, leaf):
-            name = path[-1].key
-            if not name.endswith("_pages"):
-                return leaf
-            src = dense[tuple(k.key for k in path[:-1])
-                        + (name[:-len("_pages")],)]
-            r = src.reshape(src.shape[0], -1, self.page_size,
-                            *src.shape[2:])
-            return leaf.at[page_map].set(r)
-
-        return jax.tree_util.tree_map_with_path(pack, pool)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _copy_page(self, pool, src, dst):
-        """Duplicate ONE page across every layer's pool — the
-        copy-on-write behind prefix sharing (a partial tail page gets
-        written by its row, so sharers take a private copy). src/dst
-        trace: every copy reuses one compiled program."""
-        return jax.tree_util.tree_map_with_path(
-            lambda p, x: (x.at[dst].set(x[src])
-                          if str(getattr(p[-1], "key", "")
-                                 ).endswith("_pages") else x),
-            pool)
-
-    @functools.partial(jax.jit, static_argnums=(0,))
-    def _restore_pages(self, pool, host, page_idx):
-        """Tier swap-in scatter: host-gathered page rows (a dict keyed
-        by "/"-joined leaf paths, each ``(n, page_size, ...)``) land at
-        pages ``page_idx`` across every ``*_pages`` pool leaf in ONE
-        dispatch — jit turns the host dict into a single batched
-        device_put + scatter. ``n`` is pow2-bucketed by the caller; pad
-        rows carry zeros and target the sink page 0 (which absorbs junk
-        writes by design), so one compile serves every chain length in
-        a bucket."""
-        def put(path, leaf):
-            if not str(getattr(path[-1], "key", "")).endswith("_pages"):
-                return leaf
-            key = "/".join(str(getattr(k, "key", k)) for k in path)
-            return leaf.at[page_idx].set(host[key])
-
-        return jax.tree_util.tree_map_with_path(put, pool)
-
-    # --- prompt cache (loop thread only; entries are immutable jax
-    #     arrays, so a cached row survives the decodes of whatever slot
-    #     its copy was scattered into) ------------------------------------
-
-    def _pcache_lookup(self, prompt: tuple, adapter: int = 0):
-        """Longest cached entry equal to ``prompt`` or a proper prefix of
-        it, UNDER THE SAME ADAPTER (a row prefilled through adapter i's
-        deltas is a different computation — cross-adapter reuse would be
-        silently wrong); a hit refreshes its LRU position. Returns the
-        PROMPT part of the key. Session-tail entries (logits slot None —
-        the chain a finished session left behind covers prompt+reply
-        K/V but no next-token distribution) only ever serve as PREFIX
-        hits: an exact-length match would need the stored logits the
-        entry doesn't have, so it is skipped and the shorter
-        logits-bearing entry (or a miss) wins instead."""
-        best = None
-        for aid, key in self._pcache:
-            if (aid == adapter and len(key) <= len(prompt)
-                    and prompt[:len(key)] == key
-                    and not (len(key) == len(prompt)
-                             and self._pcache[(aid, key)][-2] is None)
-                    and (best is None or len(key) > len(best))):
-                best = key
-        if best is None:
-            return None, None
-        entry = self._pcache.pop((adapter, best))  # re-insert at MRU
-        self._pcache[(adapter, best)] = entry
-        return best, entry
-
-    def _pcache_insert(self, prompt: tuple, cache1, last1,
-                       adapter: int = 0) -> None:
-        if self.prompt_cache <= 0:
-            return
-        old = self._pcache.pop((adapter, prompt), None)
-        nbytes = sum(x.nbytes for x in jax.tree.leaves((cache1, last1)))
-        self._pcache[(adapter, prompt)] = (cache1, last1, nbytes)
-        delta = nbytes - (old[2] if old else 0)
-        while len(self._pcache) > self.prompt_cache:
-            delta -= self._pcache_evict_lru()
-        with self._lock:
-            self._stats["pcache_bytes"] = (
-                self._stats.get("pcache_bytes", 0) + delta)
-
-    def _pcache_extend(self, cache1, prompt: tuple, p0: int,
-                       adapter: int = 0):
-        """Append ``prompt[p0:]`` to a restored 1-row cache (row index sits
-        at p0). Returns (cache, last_logits) in EXACTLY the post-prefill
-        state: the suffix pads to a pow2 chunk, the index rolls back to
-        len-1 (pad junk becomes invisible to the position mask, the
-        chunked-admission finalize invariant) and the last real token is
-        re-decoded in place for the exact first-token logits."""
-        extra = np.asarray(prompt[p0:], np.int32)[None]
-        g = _pow2_at_least(extra.shape[1])
-        pad = np.zeros((1, g), np.int32)
-        pad[:, :extra.shape[1]] = extra
-        aids = self._aid_arg(1, adapter)
-        cache = self._extend_chunk(self.params, cache1, jnp.asarray(pad),
-                                   aids)
-        cache = set_cache_index(
-            cache, jnp.asarray([len(prompt) - 1], jnp.int32))
-        return self._decode_logits(
-            self.params, cache, jnp.asarray([prompt[-1]], jnp.int32), aids)
-
-    # --- page-chain bookkeeping (paged mode; loop thread only) ----------
-
-    def _pages_for(self, length: int, budget: int) -> int:
-        return -(-(length + budget) // self.page_size)  # ceil div
-
-    def _set_row(self, r: int, chain, index: int) -> None:
-        self._chains[r] = list(chain)
-        self._tables[r, :] = 0
-        self._tables[r, :len(chain)] = chain
-        self._indices[r] = index
-
-    def _release_slot_pages(self, r: int) -> None:
-        if self._chains[r]:
-            self._alloc.decref(self._chains[r])
-        self._chains[r] = []
-        self._tables[r, :] = 0
-
-    def _free_chains(self, chains) -> None:
-        for c in chains or []:
-            if c:
-                self._alloc.decref(c)
-
-    def _pages_needed(self, req: "_Request", pkey) -> int:
-        """Worst-case fresh pages this admission will allocate — the fit
-        check, run BEFORE any device work or allocation. Mirrors the
-        alloc paths exactly: cache hits only pay for non-shared pages."""
-        ps, B = self.page_size, req.budget
-        n = req.samples if req.samples > 1 else req.block.shape[0]
-        # +1: a single-prompt admission pins a COW tail copy into the
-        # prompt cache (the insert skips gracefully when the pool is
-        # dry, but reserving it keeps the pin from stealing a page a
-        # sibling row's chain already counted on).
-        ins = 1 if (self.prompt_cache > 0
-                    and req.block.shape[0] == 1) else 0
-        if pkey is not None:
-            L = len(req.ptuple())
-            total = self._pages_for(L, B)
-            if len(pkey) == L:  # exact hit: no insert afterwards
-                return n * (total - len(pkey) // ps)
-            # prefix: row 0 shares the entry, siblings share row 0
-            return (total - len(pkey) // ps
-                    + (n - 1) * (total - L // ps) + ins)
-        if req.samples > 1:
-            L = int(req.lens[0])
-            total = self._pages_for(L, B)
-            return total + (n - 1) * (total - L // ps) + ins
-        return sum(self._pages_for(int(l), B)
-                   for l in req.lens) + (ins if n == 1 else 0)
-
-    def _alloc_request_chains(self, req: "_Request", nb: int, n: int,
-                              lens) -> "list[list[int]]":
-        """Fresh page chains for a dense-prefilled admission, one list
-        per real row (pad rows get []). samples>1 allocates the full
-        chain for row 0 only — siblings get just their non-shared pages
-        (install increfs the shared prefix into their chains)."""
-        B = req.budget
-        if self._chaos is not None:
-            self._chaos.fire("page_alloc")
-        if req.samples > 1:
-            L = int(lens[0])
-            total = self._pages_for(L, B)
-            want = [total] + [total - L // self.page_size] * (n - 1)
-        else:
-            want = [self._pages_for(int(lens[j]), B) for j in range(n)]
-        chains = []
-        for w in want:
-            c = self._alloc.alloc(w)
-            if c is None:  # can't happen after the fit check; roll back
-                self._free_chains(chains)
-                raise RuntimeError("page pool exhausted mid-admission")
-            chains.append(c)
-        return chains + [[] for _ in range(nb - n)]
-
-    def _pin_pages(self, chain) -> None:
-        for p in chain:
-            self._pinned[p] = self._pinned.get(p, 0) + 1
-
-    def _unpin_pages(self, chain) -> None:
-        for p in chain:
-            left = self._pinned[p] - 1
-            if left:
-                self._pinned[p] = left
-            else:
-                del self._pinned[p]
-
-    def _pcache_evict_lru(self, swap: bool = True) -> int:
-        """Drop the LRU prompt-cache entry (paged entries release their
-        page pins); returns its byte size. Caller adjusts the stat.
-        With a host tier attached the entry's chain is GATHERED off
-        device first (``swap=False`` skips that — crash paths where
-        device state is untrusted), so eviction demotes instead of
-        forgetting; a failed gather falls back to the plain drop."""
-        key = next(iter(self._pcache))
-        entry = self._pcache.pop(key)
-        if self.paged:
-            if swap and self._tier is not None:
-                self._tier_swap_out(key, entry)
-            self._unpin_pages(entry[0])
-            self._alloc.decref(entry[0])
-        return entry[-1]
-
-    def _pcache_insert_paged(self, prompt: tuple, src_chain, last1,
-                             adapter: int = 0,
-                             frozen: bool = False) -> None:
-        """Pin ``prompt``'s pages into the prompt cache WITHOUT copying
-        the prompt K/V: the entry shares the source row's full pages by
-        incref — safe read-only, since a row only ever writes positions
-        >= its admitted length, which live past its full prompt pages —
-        and copies only the partial tail page (the row's next decode
-        DOES write into that one). Skipped when the pool can't spare
-        the tail copy.
-
-        ``frozen``: the source row is FINISHED (session-end insert) —
-        nothing will ever write its tail page again, so the partial
-        tail is shared by incref like the full pages instead of COW
-        copied (a later admission that extends the entry takes its own
-        tail copy through ``build_row``, same as any prefix hit). Saves
-        one page + one device copy per session turn, and cannot fail on
-        an exhausted pool."""
-        if self.prompt_cache <= 0:
-            return
-        ps = self.page_size
-        full = len(prompt) // ps
-        chain = list(src_chain[:full])
-        self._alloc.incref(chain)
-        if len(prompt) % ps:
-            if frozen:
-                chain.append(src_chain[full])
-                self._alloc.incref(chain[-1:])
-            else:
-                tail = self._alloc.alloc(1)
-                if tail is None:
-                    self._alloc.decref(chain)
-                    return  # pool too tight to pin a copy — skip caching
-                self._cache = self._copy_page(self._cache,
-                                              src_chain[full], tail[0])
-                chain.append(tail[0])
-        old = self._pcache.pop((adapter, prompt), None)
-        if old is not None:
-            self._unpin_pages(old[0])
-            self._alloc.decref(old[0])
-        self._pin_pages(chain)
-        nbytes = len(chain) * self._page_bytes \
-            + (sum(x.nbytes for x in jax.tree.leaves(last1))
-               if last1 is not None else 0)
-        self._pcache[(adapter, prompt)] = (tuple(chain), len(prompt),
-                                           last1, nbytes)
-        delta = nbytes - (old[-1] if old else 0)
-        while len(self._pcache) > self.prompt_cache:
-            delta -= self._pcache_evict_lru()
-        with self._lock:
-            self._stats["pcache_bytes"] += delta
-
-    # --- host page tier (docs/TIERING.md; loop thread only) -------------
-
-    def _gather_pages(self, chain) -> dict:
-        """One host copy of a page chain: every ``*_pages`` pool leaf
-        gathered at the chain's indices, fetched in a SINGLE
-        ``jax.device_get`` of the whole dict (one transfer round-trip,
-        not one per layer). Keys are the "/"-joined leaf paths —
-        exactly what ``_restore_pages`` scatters back from."""
-        idx = jnp.asarray(chain, jnp.int32)
-        out = {}
-        for path, leaf in jax.tree_util.tree_flatten_with_path(
-                self._cache)[0]:
-            if str(getattr(path[-1], "key", "")).endswith("_pages"):
-                key = "/".join(str(getattr(k, "key", k)) for k in path)
-                out[key] = leaf[idx]
-        return jax.device_get(out)
-
-    def _tier_swap_out(self, key, entry) -> bool:
-        """Gather a pcache entry's chain to the host tier. The caller
-        still owns the entry (and drops its pins/refs afterwards) —
-        this only copies bytes off device, so a failure (chaos
-        ``tier_swap``, host OOM) simply leaves the entry to die the
-        pre-tier way: dropped, next turn pays a cold prefill. Entry
-        pages are immutable once inserted (COW discipline), so the
-        gather needs no quiescence even while live rows share the
-        chain's full pages."""
-        t0 = time.perf_counter()
-        try:
-            if self._chaos is not None:
-                self._chaos.fire("tier_swap")
-            host = self._gather_pages(entry[0])
-            last = entry[2]
-            if last is not None:
-                last = jax.device_get(last)
-            self._tier.put(key, entry[1], host, last=last)
-        except Exception:  # noqa: BLE001 — degrade to plain eviction
-            with self._lock:
-                self._stats["tier_fallbacks"] += 1
-            if self._obs is not None:
-                self._obs.on_tier_fallback()
-            return False
-        dt = time.perf_counter() - t0
-        with self._lock:
-            self._stats["tier_swap_outs"] += 1
-        if self._obs is not None:
-            self._obs.on_tier_swap(
-                "out", dt, self._tier.stats()["tier_pages"],
-                self._alloc.total - self._alloc.free)
-        return True
-
-    def _tier_swap_in(self, key) -> bool:
-        """Restore a tier entry into the prompt cache: allocate fresh
-        pages (pressure-evicting idle pcache entries first), scatter
-        the host buffers in via one ``_restore_pages`` dispatch, pin +
-        insert — after which the entry serves hits exactly like one
-        that never left. FRESH pages only: no live row's table points
-        at them, so any failure rolls back by freeing them — live rows
-        are untouchable by construction. Failure paths degrade to a
-        cold prefill (``tier_fallbacks``); corrupt/undecodable entries
-        are discarded so they cannot fail every later probe too."""
-        t0 = time.perf_counter()
-        try:
-            if self._chaos is not None:
-                self._chaos.fire("tier_swap")
-            length, host, last = self._tier.load(key)
-        except Exception:  # noqa: BLE001 — torn spill / injected fault
-            self._tier.discard(key)
-            with self._lock:
-                self._stats["tier_fallbacks"] += 1
-            if self._obs is not None:
-                self._obs.on_tier_fallback()
-            return False
-        n = -(-length // self.page_size)
-        while n > self._alloc.free and self._pcache:
-            freed = self._pcache_evict_lru()
-            with self._lock:
-                self._stats["pcache_bytes"] -= freed
-        pages = self._alloc.alloc(n)
-        if pages is None:
-            # Pool too tight even after pressure: keep the host copy
-            # (it is still good — a later, calmer admission can restore
-            # it) and let THIS request prefill cold.
-            with self._lock:
-                self._stats["tier_fallbacks"] += 1
-            if self._obs is not None:
-                self._obs.on_tier_fallback()
-            return False
-        try:
-            npad = _pow2_at_least(n)
-            idx = np.zeros((npad,), np.int32)
-            idx[:n] = pages
-            hpad = {}
-            for k, v in host.items():
-                buf = np.zeros((npad,) + v.shape[1:], v.dtype)
-                buf[:n] = v[:n]
-                hpad[k] = buf
-            self._cache = self._restore_pages(self._cache, hpad,
-                                              jnp.asarray(idx))
-            last_dev = jnp.asarray(last) if last is not None else None
-        except Exception:  # noqa: BLE001 — restore dispatch failed
-            self._record_backend_failure()
-            self._alloc.decref(pages)
-            self._tier.discard(key)
-            with self._lock:
-                self._stats["tier_fallbacks"] += 1
-            if self._obs is not None:
-                self._obs.on_tier_fallback()
-            return False
-        self._pin_pages(pages)
-        old = self._pcache.pop(key, None)
-        if old is not None:  # raced a fresh insert; replace it
-            self._unpin_pages(old[0])
-            self._alloc.decref(old[0])
-        nbytes = n * self._page_bytes \
-            + (int(last_dev.nbytes) if last_dev is not None else 0)
-        self._pcache[key] = (tuple(pages), length, last_dev, nbytes)
-        delta = nbytes - (old[-1] if old else 0)
-        while len(self._pcache) > self.prompt_cache:
-            delta -= self._pcache_evict_lru()
-        with self._lock:
-            self._stats["pcache_bytes"] += delta
-            self._stats["tier_swap_ins"] += 1
-        self._tier.discard(key)  # moved, not copied: one owner at a time
-        if self._obs is not None:
-            self._obs.on_tier_swap(
-                "in", time.perf_counter() - t0,
-                self._tier.stats()["tier_pages"],
-                self._alloc.total - self._alloc.free)
-        return True
-
-    def _tier_pressure(self) -> None:
-        """Low-watermark demotion, run once per loop iteration: while
-        the free list sits below ``tier_watermark`` and idle pcache
-        entries exist, gather the LRU entry to host and return its
-        pages. Terminates because each pass shrinks the pcache;
-        entries whose pages are shared with live rows free only their
-        unshared pages (refcounts), which is exactly the reclaimable
-        amount."""
-        while (self._alloc.free < self.tier_watermark and self._pcache):
-            freed = self._pcache_evict_lru()
-            with self._lock:
-                self._stats["pcache_bytes"] -= freed
-
-    def _session_insert(self, req: "_Request", r: int) -> None:
-        """Session-end insert (called from _finish_row BEFORE the row's
-        pages are released): pin the finished row's chain into the
-        prompt cache keyed by prompt + every reply token except the
-        last. That key is exactly the K/V the chain holds — after g
-        emitted tokens the row's index is L+g-1 and positions
-        L..L+g-2 hold t1..t_{g-1}; the last sampled token's K/V was
-        never written (and any mid-block post-eos junk lies beyond the
-        key length, invisible to the position mask). The entry stores
-        last=None — no logits exist for the uncommitted tail token —
-        so it serves prefix hits only (the next turn's prompt strictly
-        extends it through t_g). The session's previous chain is
-        dropped from pcache AND tier: one chain per session. A
-        one-token turn adopts the admission-time exact-prompt entry
-        (same key, better: it has logits) rather than inserting."""
-        toks = self._collected[r]
-        if len(toks) < 2:
-            # One-token turn: the key (prompt + zero committed reply
-            # tokens) IS the prompt, and admission already cached that
-            # exact chain WITH its next-token logits. Inserting a
-            # frozen last=None twin would replace the strictly better
-            # entry — adopt the existing one into the ledger instead,
-            # so release_session parks the live chain, not the
-            # previous turn's stale key.
-            key = (req.adapter, req.ptuple())
-            if key not in self._pcache:
-                return  # evicted (or never inserted); keep prev chain
-        else:
-            key_prompt = req.ptuple() + tuple(toks[:-1])
-            n_entry = -(-len(key_prompt) // self.page_size)
-            chain = self._chains[r]
-            if len(chain) < n_entry:  # defensive: never by allocation
-                return
-            self._pcache_insert_paged(key_prompt, chain[:n_entry], None,
-                                      req.adapter, frozen=True)
-            key = (req.adapter, key_prompt)
-            if key not in self._pcache:
-                return  # capacity-evicted immediately; nothing to track
-        prev = self._sessions.get(req.session)
-        if prev is not None and prev != key:
-            ent = self._pcache.pop(prev, None)
-            if ent is not None:
-                self._unpin_pages(ent[0])
-                self._alloc.decref(ent[0])
-                with self._lock:
-                    self._stats["pcache_bytes"] -= ent[-1]
-            if self._tier is not None:
-                self._tier.discard(prev)
-        self._sessions[req.session] = key
-
-    def _do_release_session(self, session: str,
-                            spill: bool = False) -> bool:
-        """Loop-thread body of release_session: demote the session's
-        pcache entry to the host tier (gather + unpin + free pages).
-        True when a chain existed (now on host — or already there).
-        ``spill`` additionally forces the parked chain to the disk tier
-        (no-op without --tier-dir): the drain path, where the chain
-        must outlive this process for a peer replica to adopt it."""
-        key = self._sessions.get(session)
-        if key is None:
-            return False
-        entry = self._pcache.pop(key, None)
-        if entry is None:
-            # Already demoted (watermark pressure / LRU eviction beat
-            # the explicit release to it).
-            had = self._tier is not None and self._tier.contains(key)
-            if had and spill:
-                self._tier.spill(key)
-            return had
-        if self._tier is not None:
-            if self._tier_swap_out(key, entry) and spill:
-                self._tier.spill(key)
-        self._unpin_pages(entry[0])
-        self._alloc.decref(entry[0])
-        with self._lock:
-            self._stats["pcache_bytes"] -= entry[-1]
-        return True
-
-    def release_session(self, session: str,
-                        timeout_s: float = 30.0,
-                        spill: bool = False) -> bool:
-        """Explicitly park a session between turns: its cached chain
-        leaves the device pool for the host tier (or is dropped when no
-        tier is attached) and the freed pages go back to admission.
-        ``spill=True`` forces the parked chain through to the disk tier
-        so it survives this process (drain-before-kill; requires
-        --tier-dir to have any effect). Safe from any thread — the
-        operation marshals to the loop thread via the request queue.
-        Returns whether the session had a chain to release."""
-        if self._closed:
-            raise RuntimeError("engine is closed")
-        if not self.paged:
-            return False
-        cmd = _TierCommand("release", session, spill=spill)
-        self._q.put(cmd)
-        if not cmd.event.wait(timeout_s):
-            raise TimeoutError("session release did not finish in time")
-        if cmd.error is not None:
-            raise cmd.error
-        return bool(cmd.result)
-
-    def _exec_tier_command(self, cmd: "_TierCommand") -> None:
-        try:
-            if cmd.kind == "release":
-                cmd.result = self._do_release_session(cmd.session,
-                                                      spill=cmd.spill)
-            else:  # unknown kinds fail loudly, never hang the caller
-                raise ValueError(f"unknown tier command {cmd.kind!r}")
-        except Exception as e:  # noqa: BLE001 — fail the one command
-            cmd.error = e
-        cmd.signal()
-
-    def _aid_arg(self, n: int, adapter: int):
-        """(n,)-row adapter-id array for a single request's device call —
-        None when the model carries no adapter stacks (exact pre-multi-
-        LoRA program signatures)."""
-        if self.n_adapters is None:
-            return None
-        return jnp.full((n,), adapter, jnp.int32)
-
-    # --- client API -----------------------------------------------------
-
-    def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
-                        eos_id, samples=1, top_p=None,
-                        adapter_id=0) -> "_Request":
-        """Shared validation + packing for both entry points: right-pad to
-        a pow2 width bucket and bound against the cache."""
-        adapter_id = int(adapter_id)
-        if adapter_id != 0 and self.n_adapters is None:
-            raise ValueError("this engine's model has no adapter stacks "
-                             "(multi_lora is off); adapter_id must be 0")
-        if self.n_adapters is not None \
-                and not 0 <= adapter_id < self.n_adapters:
-            raise ValueError(f"adapter_id {adapter_id} outside "
-                             f"[0, {self.n_adapters})")
-        lens = [len(p) for p in prompts]
-        if min(lens) == 0:
-            raise ValueError("prompts must be non-empty")
-        width = prompt_width_bucket(max(lens), self.max_seq)
-        if max(lens) > width or width + max_new_tokens > self.max_seq:
-            raise ValueError(
-                f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
-                f"cache ({self.max_seq})")
-        if self.paged:
-            # A request whose WORST-CASE page need (no cache sharing)
-            # exceeds the pool would wait in the queue forever — reject
-            # at the door instead of deadlocking admission.
-            ps = self.page_size
-            if samples > 1:
-                total = self._pages_for(lens[0], max_new_tokens)
-                worst = total + (samples - 1) * (total - lens[0] // ps)
-            else:
-                worst = sum(self._pages_for(l, max_new_tokens)
-                            for l in lens)
-            ins = 1 if (self.prompt_cache > 0 and len(prompts) == 1) else 0
-            if worst + ins > self._alloc.total:
-                raise ValueError(
-                    f"request needs up to {worst + ins} pages but the "
-                    f"pool has {self._alloc.total} usable — raise "
-                    f"num_pages or shrink prompt/budget")
-        block = np.zeros((len(prompts), width), np.int32)
-        for i, p in enumerate(prompts):
-            block[i, :len(p)] = p
-        return _Request(block, np.asarray(lens, np.int32), max_new_tokens,
-                        float(temperature), top_k, eos_id, samples=samples,
-                        top_p=top_p, adapter=adapter_id)
-
-    def _reject_if_full_locked(self) -> None:
-        """Caller holds self._lock. Raises EngineOverloaded (counted in
-        the rejected stat) when max_pending is exhausted."""
-        if (self.max_pending is not None
-                and self._inflight >= self.max_pending):
-            self._stats["rejected"] += 1
-            raise EngineOverloaded(
-                f"engine at capacity: {self._inflight} requests in "
-                f"flight (max_pending={self.max_pending})")
-
-    def _breaker_gate(self) -> bool:
-        """Circuit-breaker admission gate. Returns True when this caller
-        holds the half-open probe lease; raises CircuitOpen (counted in
-        breaker_rejected) when the breaker refuses traffic."""
-        br = self.breaker
-        if br is None:
-            return False
-        admitted, probe = br.allow()
-        if not admitted:
-            retry = br.retry_after_s()
-            with self._lock:
-                self._stats["breaker_rejected"] += 1
-            raise CircuitOpen(
-                f"circuit breaker open after repeated backend failures; "
-                f"retry in {retry:.1f}s", retry_after_s=retry)
-        return probe
-
-    def take_admission_token(self) -> None:
-        """Claim one unit of max_pending or raise EngineOverloaded.
-        Callers that split ONE logical request into several chunk
-        submits (the server's wider-than-slots path) take ONE token for
-        the whole request and pass ``admitted=True`` to the submits —
-        re-gating per chunk would reject an already-admitted request
-        mid-flight after burning its earlier chunks' decode work."""
-        probe = self._breaker_gate()
-        try:
-            with self._lock:
-                self._reject_if_full_locked()
-                self._inflight += 1
-        except EngineOverloaded:
-            if probe:
-                # The half-open probe lost the capacity race before
-                # reaching the backend — return the lease so the next
-                # arrival can probe instead of waiting out the window.
-                self.breaker.probe_aborted()
-            raise
-
-    def release_admission_token(self) -> None:
-        with self._lock:
-            self._inflight -= 1
-
-    def at_capacity(self) -> bool:
-        """Advisory (racy by nature): lets the HTTP layer 503 BEFORE
-        committing response headers; the authoritative check is the
-        token take in the submit paths."""
-        with self._lock:
-            return (self.max_pending is not None
-                    and self._inflight >= self.max_pending)
-
-    def reject_if_at_capacity(self) -> None:
-        """Advisory shed WITHOUT claiming a token: raises
-        EngineOverloaded (counted in the rejected stat, same as an
-        authoritative take failure) when at capacity. For callers that
-        must 503 before response headers but defer the real token take
-        until their generator actually starts."""
-        br = self.breaker
-        if br is not None and br.state() == "open":
-            retry = br.retry_after_s()
-            with self._lock:
-                self._stats["breaker_rejected"] += 1
-            raise CircuitOpen(
-                f"circuit breaker open after repeated backend failures; "
-                f"retry in {retry:.1f}s", retry_after_s=retry)
-        with self._lock:
-            self._reject_if_full_locked()
-
-    def _trace_enqueue(self, req: "_Request", stream: bool = False) -> None:
-        """Open the request's lifecycle trace at ingress (submitter
-        thread, just before the queue put — so queue wait is measured
-        from the moment the loop COULD have seen the request)."""
-        if self._obs is not None:
-            req.trace = self._obs.start_trace(
-                trace_id=req.trace_id,
-                rows=int(req.samples if req.samples > 1
-                         else req.block.shape[0]),
-                prompt_len=int(max(req.lens)), budget=int(req.budget),
-                stream=stream, adapter=int(req.adapter))
-
-    def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
-                          admitted: bool = False) -> "list[list[int]]":
-        # The loop thread enforces the same deadline: a request whose
-        # client gave up is dropped from the queue / its slots freed,
-        # instead of decoding its full budget for nobody.
-        if not admitted:
-            self.take_admission_token()
-        try:
-            req.deadline = time.time() + timeout_s
-            self._trace_enqueue(req)
-            # Waiter registry: the watchdog fails everyone in this set
-            # with a retryable error when the loop stalls or dies, so a
-            # client blocks for at most ~watchdog_s, never timeout_s.
-            with self._lock:
-                self._waiters.add(req)
-            try:
-                self._q.put(req)
-                if not req.event.wait(timeout_s + 1.0):
-                    raise TimeoutError("generation did not finish in time")
-                if req.error is not None:
-                    raise req.error
-                return req.tokens
-            finally:
-                with self._lock:
-                    self._waiters.discard(req)
-        finally:
-            if not admitted:
-                self.release_admission_token()
-
-    def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
-               temperature: float = 0.0, top_k: "int | None" = None,
-               top_p: "float | None" = None,
-               eos_id: "int | None" = None, adapter_id: int = 0,
-               timeout_s: float = 600.0, admitted: bool = False,
-               trace_id: "str | None" = None,
-               session: "str | None" = None) -> "list[list[int]]":
-        """Blocking: returns (n, max_new_tokens) token lists.
-        ``admitted``: the caller already holds an admission token
-        covering this submit (see take_admission_token).
-        ``trace_id``: validated W3C trace id for the lifecycle trace.
-        ``session``: single-prompt only — names the request's finished
-        KV chain so the session's next turn (a prompt extending this
-        one's prompt + reply) restores it instead of re-prefilling,
-        and so ``release_session`` can park it on the host tier."""
-        if self._closed:
-            raise RuntimeError("engine is closed")
-        n = len(prompts)
-        if n == 0 or n > self.slots:
-            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
-        if session is not None and n != 1:
-            raise ValueError("session requires exactly one prompt "
-                             "(a session names ONE chain)")
-        req = self._packed_request(prompts, max_new_tokens, temperature,
-                                   top_k, eos_id, top_p=top_p,
-                                   adapter_id=adapter_id)
-        req.trace_id = trace_id
-        req.session = session
-        return self._enqueue_and_wait(req, timeout_s, admitted)
-
-    def submit_samples(self, prompt: "list[int]", n: int, *,
-                       max_new_tokens: int, temperature: float = 1.0,
-                       top_k: "int | None" = None,
-                       top_p: "float | None" = None,
-                       eos_id: "int | None" = None, adapter_id: int = 0,
-                       timeout_s: float = 600.0, admitted: bool = False,
-                       trace_id: "str | None" = None) -> "list[list[int]]":
-        """n sampled continuations of ONE prompt for the price of one
-        prefill: the prefilled cache row broadcasts across n slots and the
-        rows diverge through per-row sampling noise. (With temperature 0
-        all rows are the same greedy continuation — use submit().)"""
-        if self._closed:
-            raise RuntimeError("engine is closed")
-        if not 1 <= n <= self.slots:
-            raise ValueError(f"need 1..{self.slots} samples, got {n}")
-        req = self._packed_request([prompt], max_new_tokens, temperature,
-                                   top_k, eos_id, samples=n, top_p=top_p,
-                                   adapter_id=adapter_id)
-        req.trace_id = trace_id
-        return self._enqueue_and_wait(req, timeout_s, admitted)
-
-    def submit_stream(self, prompts: "list[list[int]]", *,
-                      max_new_tokens: int, temperature: float = 0.0,
-                      top_k: "int | None" = None,
-                      top_p: "float | None" = None,
-                      eos_id: "int | None" = None, adapter_id: int = 0,
-                      timeout_s: float = 600.0, admitted: bool = False,
-                      trace_id: "str | None" = None,
-                      session: "str | None" = None):
-        """Streaming submit(): returns an iterator of events.
-
-        Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
-        — one per decode dispatch that produced tokens for this request
-        (granularity = ``decode_block``; the first event carries each
-        row's first token straight off the prefill logits, so
-        time-to-first-token is prefill latency). The final event is
-        ``{"done": True, "tokens": [[...]]}`` with exactly submit()'s
-        return value (greedy exactness stays pinned to ``generate()``).
-        Rows that hit eos stop producing deltas; the final tokens are
-        eos-extended to the budget like submit()'s. Errors (deadline
-        expiry, decode failure, shutdown) raise from the iterator."""
-        if self._closed:
-            raise RuntimeError("engine is closed")
-        n = len(prompts)
-        if n == 0 or n > self.slots:
-            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
-        if session is not None and n != 1:
-            raise ValueError("session requires exactly one prompt "
-                             "(a session names ONE chain)")
-        req = self._packed_request(prompts, max_new_tokens, temperature,
-                                   top_k, eos_id, top_p=top_p,
-                                   adapter_id=adapter_id)
-        req.trace_id = trace_id
-        req.session = session
-        req.stream_q = queue.SimpleQueue()
-        return self._stream_events(req, timeout_s, admitted)
-
-    def _stream_events(self, req: "_Request", timeout_s: float,
-                       admitted: bool = False):
-        # Same deadline contract as _enqueue_and_wait: the loop thread
-        # drops expired requests; this consumer gets the terminal marker
-        # and raises the TimeoutError the loop recorded. The admission
-        # token spans the generator's life — taken at first next() (no
-        # iteration, no enqueue, no token), released in the finally.
-        if not admitted:
-            self.take_admission_token()
-        try:
-            yield from self._stream_events_inner(req, timeout_s)
-        finally:
-            if not admitted:
-                self.release_admission_token()
-
-    def _stream_events_inner(self, req: "_Request", timeout_s: float):
-        req.deadline = time.time() + timeout_s
-        self._trace_enqueue(req, stream=True)
-        with self._lock:
-            self._waiters.add(req)
-        self._q.put(req)
-        hard = req.deadline + 1.0
-        try:
-            while True:
-                try:
-                    item = req.stream_q.get(
-                        timeout=max(0.0, hard - time.time()))
-                except queue.Empty:
-                    raise TimeoutError("generation did not finish in time")
-                if item is None:  # terminal: tokens ready or error
-                    if req.error is not None:
-                        raise req.error
-                    yield {"done": True, "tokens": req.tokens}
-                    return
-                yield {"done": False, "rows": item}
-        finally:
-            with self._lock:
-                self._waiters.discard(req)
-            # Consumer abandoned the stream (generator .close() on client
-            # disconnect, or an exception in the consumer): expire the
-            # request NOW so the loop reaps its queue entry / admission /
-            # slots next iteration, instead of decoding the rest of the
-            # budget for nobody.
-            if req.tokens is None and req.error is None:
-                req.deadline = 0.0
+    # --- lifecycle and stats --------------------------------------------
 
     def close(self) -> None:
         self._closed = True
@@ -1647,622 +523,7 @@ class GenerateEngine:
                 if s["spec_dispatches"] else None)
         return s
 
-    # --- loop internals (single thread; owns all slot state) ------------
-
-    def _decode_mfu(self, tokens: int, dt: float) -> "float | None":
-        """Modeled MFU of one decode dispatch: emitted tokens × modeled
-        flops/token over measured wall time, against the device peak.
-        None when the peak is unknown (CPU stand-in) or dt is zero."""
-        if self._peak_flops is None or dt <= 0:
-            return None
-        return tokens * self._decode_flops_per_tok / dt / self._peak_flops
-
-    def _free_slots(self) -> "list[int]":
-        # A row that finished EARLY (eos) while its multi-row request is
-        # still decoding stays owned: its collected tokens feed
-        # _maybe_complete, so handing the slot to a new request would
-        # clobber them (the stranger's tokens would surface in the
-        # finished request's result, and the completion bookkeeping of
-        # whichever finishes second corrupts the other's). Owner clears
-        # at completion/failure — only then is the slot reusable.
-        return [i for i in range(self.slots)
-                if not self._active[i] and not self._reserved[i]
-                and self._owner[i] is None]
-
-    def _drain_queue(self, block: bool) -> bool:
-        """Move queued requests into pending. Returns False on shutdown.
-        Tier commands (session release) execute INLINE here — they are
-        loop-thread state operations, not admissions, so they never
-        enter the pending list or compete with requests for slots."""
-        try:
-            timeout = 0.2 if block else 0.0
-            while True:
-                req = self._q.get(block=block, timeout=timeout)
-                if req is None:
-                    return False
-                if isinstance(req, _TierCommand):
-                    self._exec_tier_command(req)
-                else:
-                    self._pending.append(req)
-                block = False  # only the first get may wait
-        except queue.Empty:
-            return True
-
-    def _admit(self) -> None:
-        """Admit pending requests. Chunked admissions advance ONE chunk
-        per call, so an arriving long prompt delays in-flight decode by at
-        most one chunk's latency, never the whole prefill. While a
-        chunked admission is in flight, ONE short (single-shot) request
-        may still slip in per call — no head-of-line blocking behind a
-        long prefill when free slots exist."""
-        if self._adm is not None:
-            self._admission_step()
-            self._admit_pending(allow_chunked=False, limit=1)
-            return
-        self._admit_pending(allow_chunked=True)
-
-    def _admit_pending(self, *, allow_chunked: bool,
-                       limit: "int | None" = None) -> None:
-        admitted = 0
-        i = 0
-        while i < len(self._pending) and (limit is None
-                                          or admitted < limit):
-            req = self._pending[i]
-            # The pow2 bucket is the admission unit: bucket rows beyond n
-            # also land in free slots (they must not overwrite live rows),
-            # so the fit check runs on nb BEFORE any device work.
-            n, width = req.block.shape
-            n_rows = req.samples if req.samples > 1 else n
-            nb = min(_pow2_at_least(n_rows), self.slots)
-            c = self.chunk_prefill
-            # Prompt-cache probe (single-prompt requests): an exact hit
-            # skips the prefill outright; a prefix hit appends only the
-            # suffix — IF that suffix honors the same stall bound a
-            # chunked prefill enforces and fits the cache depth.
-            prompt = pkey = pentry = None
-            if self.prompt_cache > 0 and n == 1:
-                prompt = req.ptuple()
-                if req.probe is None:
-                    pkey, pentry = self._pcache_lookup(prompt, req.adapter)
-                    if self._tier is not None:
-                        # Tier probe BEFORE declaring a pcache miss: a
-                        # host-resident chain longer than the best
-                        # device-resident prefix swaps in and the
-                        # lookup re-runs — the restored entry then
-                        # serves this admission exactly like one that
-                        # never left HBM. A failed swap-in already
-                        # counted its fallback; the request just
-                        # proceeds with whatever the pcache had.
-                        tkey = self._tier.match(req.adapter, prompt)
-                        with self._lock:
-                            self._stats["tier_hits" if tkey is not None
-                                        else "tier_misses"] += 1
-                        if self._obs is not None:
-                            self._obs.on_tier_probe(tkey is not None)
-                        if (tkey is not None
-                                and (pkey is None
-                                     or len(tkey[1]) > len(pkey))
-                                and self._tier_swap_in(tkey)):
-                            if req.trace is not None:
-                                req.trace.event(
-                                    "tier_swap_in",
-                                    {"cached_len": len(tkey[1])})
-                            pkey, pentry = self._pcache_lookup(
-                                prompt, req.adapter)
-                    if pkey is not None and len(pkey) < len(prompt):
-                        g = _pow2_at_least(len(prompt) - len(pkey))
-                        if (len(pkey) + g > self.max_seq
-                                or (c is not None and g > c)):
-                            pkey = pentry = None  # suffix too big
-                    req.probe = (pkey, pentry)
-                pkey, pentry = req.probe
-            chunked = c is not None and width > c and pkey is None
-            if chunked and not allow_chunked:
-                i += 1  # long prompts wait for the in-flight one
-                continue
-            free = self._free_slots()
-            if len(free) < nb:
-                return  # strict FIFO on capacity: big requests don't starve
-            if self.paged:
-                need = self._pages_needed(req, pkey)
-                # Pinned prompt-cache pages are reclaimable HBM: evict
-                # idle entries (LRU) until the request fits — but never
-                # the entry THIS request is about to share (evicting it
-                # would cost more fresh pages than it frees).
-                while need > self._alloc.free and self._pcache:
-                    lru = next(iter(self._pcache))
-                    if pkey is not None and lru == (req.adapter, pkey):
-                        if len(self._pcache) == 1:
-                            break
-                        self._pcache[lru] = self._pcache.pop(lru)  # MRU
-                        continue
-                    freed = self._pcache_evict_lru()
-                    with self._lock:
-                        self._stats["pcache_bytes"] -= freed
-                if need > self._alloc.free:
-                    return  # strict FIFO: decodes must free pages first
-            self._pending.pop(i)
-            admitted += 1
-            tr = req.trace
-            if self._obs is not None:
-                wait = (time.perf_counter() - tr.t_enqueue
-                        if tr is not None and tr.t_enqueue is not None
-                        else 0.0)
-                self._obs.on_admit(tr, wait, slots=nb)
-            if pkey is not None:
-                exact = len(pkey) == len(prompt)
-                with self._lock:
-                    self._stats["pcache_hits" if exact
-                                else "pcache_prefix_hits"] += 1
-                if tr is not None:
-                    tr.event("pcache_hit" if exact else "pcache_prefix_hit",
-                             {"cached_len": len(pkey)})
-                try:
-                    if self.paged:
-                        self._admit_hit_paged(req, free[:nb], n_rows,
-                                              prompt, pkey, pentry)
-                        continue
-                    if exact:
-                        small, last = pentry[0], pentry[1]
-                    else:
-                        small, last = self._pcache_extend(
-                            pentry[0], prompt, len(pkey), req.adapter)
-                        self._pcache_insert(prompt, small, last,
-                                            req.adapter)
-                    if req.samples > 1:
-                        small, last = self._broadcast_rows(small, last, nb)
-                    self._activate(req, free[:nb], n_rows, small, last)
-                except Exception as e:  # noqa: BLE001 — fail the one request
-                    self._record_backend_failure()
-                    req.error = e
-                    req.signal()
-                continue
-            if prompt is not None:
-                with self._lock:
-                    self._stats["pcache_misses"] += 1
-                if tr is not None:
-                    tr.event("pcache_miss")
-            if req.samples > 1:
-                # Shared-prefix fan-out: prefill the ONE prompt row; the
-                # broadcast to nb rows happens at activation/finalize.
-                block, lens = req.block, req.lens
-            else:
-                block = np.zeros((nb, width), np.int32)
-                block[:n] = req.block
-                lens = np.concatenate(
-                    [req.lens, np.ones((nb - n,), np.int32)])
-            all_rows = free[:nb]
-            if chunked:
-                # Start a chunked admission: reserve the slots (and, in
-                # paged mode, the page chains — a later admission must
-                # not steal pages this one's finalize counts on), run
-                # the first chunk, and let subsequent loop iterations
-                # (with decode steps in between) carry the rest.
-                chains = None
-                try:
-                    if self.paged:
-                        chains = self._alloc_request_chains(
-                            req, nb, n_rows, lens)
-                    small, _ = self._prefill(
-                        self.params, jnp.asarray(block[:, :c]),
-                        jnp.full((block.shape[0],), c, jnp.int32),
-                        self._aid_arg(block.shape[0], req.adapter))
-                except Exception as e:  # noqa: BLE001
-                    self._record_backend_failure()
-                    self._free_chains(chains)
-                    req.error = e
-                    req.signal()
-                    continue
-                for r in all_rows:
-                    self._reserved[r] = True
-                self._adm = {"req": req, "cache": small, "block": block,
-                             "lens": lens, "pos": c, "rows": all_rows,
-                             "n": n_rows, "chains": chains}
-                with self._lock:
-                    self._stats["adm_chunks"] += 1
-                if tr is not None:
-                    tr.event("prefill_chunk", {"pos": c, "of": width})
-                return
-            chains = None
-            handed = False
-            try:
-                if self.paged:
-                    chains = self._alloc_request_chains(req, nb, n_rows,
-                                                        lens)
-                small, last = self._prefill(
-                    self.params, jnp.asarray(block), jnp.asarray(lens),
-                    self._aid_arg(block.shape[0], req.adapter))
-                if prompt is not None and not self.paged:
-                    # 1-row, pre-broadcast state; the paged engine
-                    # inserts AFTER packing (zero-copy page pins).
-                    self._pcache_insert(prompt, small, last, req.adapter)
-                if req.samples > 1 and not self.paged:
-                    small, last = self._broadcast_rows(small, last, nb)
-                handed = True
-                self._activate(req, all_rows, n_rows, small, last,
-                               chains=chains,
-                               pinsert=prompt if self.paged else None)
-            except Exception as e:  # noqa: BLE001 — fail the one request
-                self._record_backend_failure()
-                if not handed:
-                    self._free_chains(chains)
-                req.error = e
-                req.signal()
-                continue
-
-    def _admission_step(self) -> None:
-        """One chunk of the in-flight admission (or its finalize)."""
-        a = self._adm
-        req, c = a["req"], self.chunk_prefill
-        width = a["block"].shape[1]
-        try:
-            if a["pos"] < width:
-                end = min(a["pos"] + c, width)
-                a["cache"] = self._extend_chunk(
-                    self.params, a["cache"],
-                    jnp.asarray(a["block"][:, a["pos"]:end]),
-                    self._aid_arg(a["block"].shape[0], req.adapter))
-                a["pos"] = end
-                with self._lock:
-                    self._stats["adm_chunks"] += 1
-                if req.trace is not None:
-                    req.trace.event("prefill_chunk",
-                                    {"pos": end, "of": width})
-                return
-            # Finalize: every row consumed the padded width (short rows
-            # carry junk K/V beyond their length). Reset each row's index
-            # to len-1 (free rollback: junk becomes invisible) and decode
-            # the row's LAST REAL token — recomputing its K/V in place and
-            # yielding the exact first-token logits; index lands on len,
-            # the engine's steady-state invariant.
-            lens = a["lens"]
-            cache = set_cache_index(a["cache"],
-                                    jnp.asarray(lens - 1, jnp.int32))
-            last_toks = a["block"][np.arange(len(lens)), lens - 1]
-            cache, last = self._decode_logits(
-                self.params, cache, jnp.asarray(last_toks),
-                self._aid_arg(len(lens), req.adapter))
-            pinsert = None
-            if self.prompt_cache > 0 and a["block"].shape[0] == 1:
-                # a["block"] row 0 == req.block row 0 by construction
-                # (both admission paths copy it verbatim), so the
-                # memoized key is THE key.
-                if self.paged:
-                    pinsert = a["req"].ptuple()
-                else:
-                    self._pcache_insert(a["req"].ptuple(), cache, last,
-                                        req.adapter)
-            if req.samples > 1 and not self.paged:
-                cache, last = self._broadcast_rows(cache, last,
-                                                   len(a["rows"]))
-            for r in a["rows"]:
-                self._reserved[r] = False
-            # Chain ownership hands to _activate here: an abort after
-            # this point must not double-free what the rows now hold.
-            chains, a["chains"] = a.get("chains"), None
-            self._adm = None
-            self._activate(req, a["rows"], a["n"], cache, last,
-                           chains=chains, pinsert=pinsert)
-        except Exception as e:  # noqa: BLE001 — fail the one request
-            self._record_backend_failure()
-            self._abort_admission(a, e)
-
-    def _abort_admission(self, a: dict, err: Exception) -> None:
-        """The one admission-abort path: release the reserved rows, null
-        the in-flight record, and fail its request — in that order, so no
-        exit leaves rows reserved for a request nobody is waiting on.
-        Takes the record explicitly (NOT via self._adm): the finalize
-        branch nulls self._adm before _activate, so an _activate failure
-        must still reach the record it was admitting."""
-        self._adm = None
-        if self.paged:
-            self._free_chains(a.get("chains"))
-            a["chains"] = None
-        for r in a["rows"]:
-            self._reserved[r] = False
-        a["req"].error = err
-        a["req"].signal()
-
-    def _activate(self, req, all_rows, n, small_cache, last_logits,
-                  chains=None, pinsert=None) -> None:
-        """Install an admitted small cache into the slot block and light
-        up the rows (shared tail of both admission paths). Dense engines
-        scatter into the monolithic cache; paged engines pack the rows
-        into their preallocated page ``chains`` and, when ``pinsert``
-        names a prompt, pin the packed pages into the prompt cache
-        (zero-copy: full pages shared by incref, tail page copied)."""
-        if self.paged:
-            last_logits = self._install_paged(req, all_rows, n,
-                                              small_cache, last_logits,
-                                              chains, pinsert)
-        else:
-            self._cache = self._scatter(
-                self._cache, small_cache, jnp.asarray(all_rows, np.int32))
-        self._light_up(req, all_rows, n, last_logits)
-
-    def _install_paged(self, req, all_rows, n, small_cache, last_logits,
-                       chains, pinsert):
-        """Pack a dense-prefilled admission cache into the rows' page
-        chains. samples>1 packs the ONE prompt row and fans it out
-        zero-copy: siblings share row 0's full prompt pages (incref) +
-        a COW'd tail + their own fresh budget pages — no n-way prompt
-        replication in HBM. Returns the (possibly fanned-out)
-        first-token logits."""
-        ps = self.page_size
-        nb = len(all_rows)
-        if req.samples > 1:
-            L = int(req.lens[0])
-            chain0 = chains[0]
-            pm = np.zeros((1, self.n_bt), np.int32)
-            pm[0, :len(chain0)] = chain0
-            self._cache = self._pack_pages(self._cache, small_cache,
-                                           jnp.asarray(pm))
-            full = L // ps
-            row_chains = [chain0]
-            for j in range(1, n):
-                fresh = chains[j]
-                self._alloc.incref(chain0[:full])
-                if L % ps:
-                    self._cache = self._copy_page(self._cache,
-                                                  chain0[full], fresh[0])
-                row_chains.append(chain0[:full] + fresh)
-            row_lens = [L] * n
-        else:
-            pm = np.zeros((nb, self.n_bt), np.int32)
-            for j in range(n):
-                pm[j, :len(chains[j])] = chains[j]
-            self._cache = self._pack_pages(self._cache, small_cache,
-                                           jnp.asarray(pm))
-            row_chains = chains[:n]
-            row_lens = [int(x) for x in req.lens]
-        if pinsert is not None:
-            # Pin row 0's prompt pages before its first decode write
-            # lands in the tail page (device ordering follows the
-            # self._cache data flow — the COW copy reads the packed,
-            # pre-decode state).
-            self._pcache_insert_paged(pinsert, row_chains[0],
-                                      last_logits[:1], req.adapter)
-        for j, r in enumerate(all_rows):
-            if j < n:
-                self._set_row(r, row_chains[j], row_lens[j])
-            else:  # pad rows: sink-page table, dense pad index of 1
-                self._set_row(r, [], 1)
-        if req.samples > 1:
-            last_logits = jnp.broadcast_to(
-                last_logits[:1], (nb, *last_logits.shape[1:]))
-        return last_logits
-
-    def _admit_hit_paged(self, req, all_rows, n, prompt, pkey,
-                         pentry) -> None:
-        """Prompt-cache admission without copying the cached prompt K/V:
-        every admitted row maps the entry's full pages read-only into
-        its block table (incref), copies the partial tail page (the row
-        WILL write into it: position L lives there), and takes fresh
-        pages for the rest. An exact hit does zero device attention
-        work. A prefix hit first materializes row 0 and appends the
-        uncached suffix batch-wide with every OTHER row's table pointed
-        at the sink page — live rows' pages can't be touched, and their
-        device indices are re-injected from the host mirror at the next
-        dispatch — then re-decodes the last real token for the exact
-        post-prefill logits and shares row 0 into the siblings."""
-        ps = self.page_size
-        chain0, l0, last0 = pentry[0], pentry[1], pentry[2]
-        L, B = len(prompt), req.budget
-        total = self._pages_for(L, B)
-
-        def build_row(src_chain, src_len):
-            sf = src_len // ps
-            fresh = self._alloc.alloc(total - sf)
-            if fresh is None:  # fit-checked; defensive
-                raise RuntimeError("page pool exhausted mid-admission")
-            self._alloc.incref(src_chain[:sf])
-            if src_len % ps:
-                self._cache = self._copy_page(self._cache,
-                                              src_chain[sf], fresh[0])
-            return list(src_chain[:sf]) + fresh
-
-        if l0 == L:  # exact hit: host bookkeeping + stored logits only
-            row_chains = [build_row(chain0, L) for _ in range(n)]
-            last = last0
-        else:
-            r0 = all_rows[0]
-            c0 = build_row(chain0, l0)
-            self._set_row(r0, c0, l0)
-            bts = np.zeros((self.slots, self.n_bt), np.int32)
-            bts[r0] = self._tables[r0]
-            idx = self._indices.copy()
-            extra = np.asarray(prompt[l0:], np.int32)
-            g = _pow2_at_least(len(extra))
-            chunk = np.zeros((self.slots, g), np.int32)
-            chunk[r0, :len(extra)] = extra
-            aids = self._hit_aids(r0, req.adapter)
-            self._cache = self._paged_extend(
-                self.params, self._cache, jnp.asarray(idx),
-                jnp.asarray(bts), jnp.asarray(chunk), aids)
-            # Roll back over the suffix pad junk and re-decode the last
-            # real token in place (the dense _pcache_extend invariant).
-            idx[r0] = L - 1
-            toks = np.zeros((self.slots,), np.int32)
-            toks[r0] = prompt[-1]
-            self._cache, logits = self._paged_decode_logits(
-                self.params, self._cache, jnp.asarray(idx),
-                jnp.asarray(bts), jnp.asarray(toks), aids)
-            last = logits[r0:r0 + 1]
-            self._pcache_insert_paged(prompt, c0, last, req.adapter)
-            row_chains = [c0] + [build_row(c0, L) for _ in range(1, n)]
-        nb = len(all_rows)
-        for j, r in enumerate(all_rows):
-            if j < n:
-                self._set_row(r, row_chains[j], L)
-            else:
-                self._set_row(r, [], 1)
-        if nb > 1:
-            last = jnp.broadcast_to(last[:1], (nb, *last.shape[1:]))
-        self._light_up(req, all_rows, n, last)
-
-    def _hit_aids(self, r0: int, adapter: int):
-        """(slots,) adapter ids for a batch-wide hit-admission call:
-        row r0 uses the request's adapter, other rows keep their live
-        values (their output is discarded and their writes are sinked,
-        so any valid id works)."""
-        if self.n_adapters is None:
-            return None
-        a = self._aids.copy()
-        a[r0] = adapter
-        return jnp.asarray(a)
-
-    def _light_up(self, req, all_rows, n, last_logits) -> None:
-        """Shared activation tail: first-token sample + slot state."""
-        rows = all_rows[:n]
-        nb = len(all_rows)
-        temps = np.full((nb,), req.temp, np.float32)
-        topks = np.full(
-            (nb,), req.top_k if req.top_k else self.vocab, np.int32)
-        topps = np.full(
-            (nb,), 1.0 if req.top_p is None else req.top_p, np.float32)
-        self._step_counter += 1
-        first = np.asarray(self._first_sample(
-            last_logits, jnp.asarray(temps), jnp.asarray(topks),
-            jnp.asarray(topps), self._step_counter, self._base_key))
-        req.slot_rows = rows
-        for j, r in enumerate(rows):
-            self._active[r] = True
-            self._owner[r] = req
-            self._aids[r] = req.adapter
-            self._last_tok[r] = int(first[j])
-            self._left[r] = req.budget - 1
-            self._temps[r] = req.temp
-            self._topks[r] = req.top_k if req.top_k else self.vocab
-            self._topps[r] = 1.0 if req.top_p is None else req.top_p
-            self._eos[r] = -1 if req.eos is None else int(req.eos)
-            self._collected[r] = [int(first[j])]
-            if self.speculate:
-                # Drafting corpus: the row's real prompt (samples>1
-                # shares the one prompt row) + the first token; every
-                # emitted token appends, whichever path emitted it.
-                src = 0 if req.samples > 1 else j
-                self._spec_hist[r] = (
-                    req.block[src, :int(req.lens[src])].tolist()
-                    + [int(first[j])])
-                self._spec_depth[r] = self.spec_gamma
-        with self._lock:
-            self._stats["requests"] += 1
-            self._stats["tokens"] += len(rows)  # first sampled tokens
-        if self._obs is not None and req.trace is not None:
-            tr = req.trace
-            # TTFT from ENQUEUE (the client-visible clock: queue wait +
-            # prefill), not from admission.
-            t0 = tr.t_enqueue
-            ttft = time.perf_counter() - t0 if t0 is not None else 0.0
-            self._obs.on_first_token(tr, ttft)
-        if req.stream_q is not None:
-            # First token per row streams immediately — it came from the
-            # prefill's own logits, before any decode dispatch, so TTFT
-            # is prefill latency, not prefill + a decode block.
-            req.stream_q.put({j: [int(first[j])] for j in range(len(rows))})
-        # eos on the very first token / budget 1 finishes immediately.
-        for r in rows:
-            if (self._left[r] <= 0
-                    or (self._eos[r] >= 0
-                        and self._last_tok[r] == self._eos[r])):
-                self._finish_row(r)
-        self._maybe_complete(req)
-
-    def _finish_row(self, r: int) -> None:
-        self._active[r] = False
-        # Reset the slot's sampling temp: inactive rows still ride the
-        # decode batch, and one stale temp>0 would disable the all-greedy
-        # lax.cond fast path in _sample_rows for every later step until
-        # the slot is reused.
-        self._temps[r] = 0.0
-        if self.speculate:
-            self._spec_hist[r] = []  # corpus dies with the row
-        if self.paged:
-            # Session-end insert BEFORE the release below: the chain's
-            # pages must be pinned while the row still holds its refs,
-            # or the free list could hand them out in between.
-            req = self._owner[r]
-            if (req is not None and req.session is not None
-                    and req.samples == 1 and req.block.shape[0] == 1
-                    and self.prompt_cache > 0
-                    and self._collected[r]):
-                self._session_insert(req, r)
-            # Free the row's pages NOW, not at request completion: the
-            # zeroed table row sinks the slot's continued decode writes,
-            # and shared prompt pages just drop a refcount — so a long
-            # sibling can't hold a finished row's HBM hostage.
-            self._release_slot_pages(r)
-
-    def _fail_request(self, req: "_Request", err: Exception) -> None:
-        for r in req.slot_rows:
-            self._active[r] = False
-            self._temps[r] = 0.0  # keep the all-greedy fast path alive
-            self._owner[r] = None
-            self._collected[r] = []
-            if self.paged:
-                self._release_slot_pages(r)
-        req.error = err
-        req.signal()
-
-    def _expire_deadlines(self) -> None:
-        """Free resources of requests whose client stopped waiting."""
-        now = time.time()
-        n_expired = 0
-        expired = [r for r in self._pending if now > r.deadline]
-        for req in expired:
-            self._pending.remove(req)
-            req.error = TimeoutError("expired while queued")
-            req.signal()
-            n_expired += 1
-        # The in-flight chunked admission too: its client may have given
-        # up mid-prefill, and without this check the remaining chunks (and
-        # the whole decode budget) would still run for nobody.
-        if self._adm is not None and now > self._adm["req"].deadline:
-            self._abort_admission(self._adm,
-                                  TimeoutError("expired during admission"))
-            n_expired += 1
-        for req in {self._owner[r] for r in range(self.slots)
-                    if self._owner[r] is not None}:
-            if now > req.deadline:
-                self._fail_request(
-                    req, TimeoutError("expired while decoding"))
-                n_expired += 1
-        if n_expired:
-            with self._lock:
-                self._stats["deadline_expired"] += n_expired
-
-    def _maybe_complete(self, req: "_Request") -> None:
-        if any(self._active[r] for r in req.slot_rows):
-            return
-        pad_to = req.budget
-        if self._obs is not None and req.trace is not None:
-            tr = req.trace
-            now = time.perf_counter()
-            e2e = now - tr.t_enqueue if tr.t_enqueue is not None else 0.0
-            # Mean time per output token after the first, over the
-            # longest row (rows decode in lockstep, so the longest row's
-            # clock is the request's decode clock). Computed BEFORE the
-            # loop below clears the collected lists.
-            ntok = min(max((len(self._collected[r])
-                            for r in req.slot_rows), default=0), pad_to)
-            tpot = ((now - tr.t_first) / (ntok - 1)
-                    if tr.t_first is not None and ntok > 1 else None)
-            self._obs.on_complete(tr, e2e, tpot)
-        out = []
-        for r in req.slot_rows:
-            toks = self._collected[r][:pad_to]
-            toks += [toks[-1]] * (pad_to - len(toks))  # eos-extend
-            out.append(toks)
-            self._owner[r] = None
-            self._collected[r] = []
-            if self.paged:
-                self._release_slot_pages(r)  # no-op after _finish_row
-        req.tokens = out
-        req.signal()
-
-    def _record_backend_failure(self) -> None:
-        if self.breaker is not None:
-            self.breaker.record_failure()
+    # --- crash containment (docs/RESILIENCE.md) -------------------------
 
     def _crash_reset(self, err: Exception) -> None:
         """Crash-only containment after an unexpected dispatch failure
@@ -2372,6 +633,8 @@ class GenerateEngine:
         self._thread = threading.Thread(target=self._loop_main, daemon=True,
                                         name="generate-engine")
         self._thread.start()
+
+    # --- the decode loop (single thread; owns all slot state) -----------
 
     def _spec_iteration(self, aids, t0: float) -> bool:
         """One speculative decode iteration: draft per-row proposals,

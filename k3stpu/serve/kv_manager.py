@@ -1,0 +1,779 @@
+"""KV-page manager: the engine's page pool, refcounts, prompt cache,
+host tier, and block tables (docs/DISAGG.md names this layer in the
+decomposed engine).
+
+All mutation happens on the engine loop thread; HTTP threads marshal
+operations through ``_TierCommand`` messages on the request queue.
+``GenerateEngine`` composes this with the scheduler
+(serve/scheduler.py) and model runner (serve/runner.py) as mixins over
+one shared ``self`` — the decomposition moves code, not state, so the
+bit-exactness suites pin behavior across the split.
+
+This layer also owns the disaggregated-serving transfer primitives
+(``export_chain`` / ``import_chain``): a prefill-role replica runs a
+prompt's prefill into its prompt cache and serializes the finished
+page chain in the ``HostPageStore`` wire format
+(``tiering.encode_entry`` — crc32-checksummed, same leaf layout as
+tier spills and drain park files); a decode-role replica restores the
+bytes via one ``_restore_pages`` dispatch into a pinned prompt-cache
+entry, so the request's admission there is an exact pcache hit and the
+decode is bit-identical to a monolithic run."""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.generate import set_cache_index
+from k3stpu.serve.programs import prompt_width_bucket
+from k3stpu.serve.runner import _pow2_at_least
+from k3stpu.serve.scheduler import _TierCommand
+from k3stpu.serve.tiering import decode_entry, encode_entry, TierCorrupt
+
+
+class _PageAllocator:
+    """Host-side page bookkeeping for the paged KV cache (loop thread
+    only). Page 0 is the reserved sink — pad rows and neutralized batch
+    rows write there — so it is never handed out. Sharing (prompt-cache
+    pins, sampled fan-outs) is refcounted: a page returns to the free
+    list only when its last reference drops."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._rc = np.zeros((num_pages,), np.int32)
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() hands out 1 first
+
+    @property
+    def total(self) -> int:
+        return self.num_pages - 1  # the sink page is not allocatable
+
+    @property
+    def free(self) -> int:
+        return len(self._free)
+
+    def refcount(self, page: int) -> int:
+        return int(self._rc[page])
+
+    def alloc(self, n: int) -> "list[int] | None":
+        """n fresh pages at refcount 1, or None (all-or-nothing)."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._rc[pages] = 1
+        return pages
+
+    def incref(self, pages) -> None:
+        for p in pages:
+            if self._rc[p] <= 0:
+                raise RuntimeError(f"incref on free page {p}")
+            self._rc[p] += 1
+
+    def decref(self, pages) -> None:
+        for p in pages:
+            if self._rc[p] <= 0:
+                raise RuntimeError(f"double free of page {p}")
+            self._rc[p] -= 1
+            if self._rc[p] == 0:
+                self._free.append(p)
+
+
+class KVManagerMixin:
+    """Prompt cache, page-chain bookkeeping, host tier, and the disagg
+    KV-transfer primitives. Owns no state of its own — ``self`` is the
+    composed ``GenerateEngine``."""
+
+    # --- prompt cache (loop thread only; entries are immutable jax
+    #     arrays, so a cached row survives the decodes of whatever slot
+    #     its copy was scattered into) ------------------------------------
+
+    def _pcache_lookup(self, prompt: tuple, adapter: int = 0):
+        """Longest cached entry equal to ``prompt`` or a proper prefix of
+        it, UNDER THE SAME ADAPTER (a row prefilled through adapter i's
+        deltas is a different computation — cross-adapter reuse would be
+        silently wrong); a hit refreshes its LRU position. Returns the
+        PROMPT part of the key. Session-tail entries (logits slot None —
+        the chain a finished session left behind covers prompt+reply
+        K/V but no next-token distribution) only ever serve as PREFIX
+        hits: an exact-length match would need the stored logits the
+        entry doesn't have, so it is skipped and the shorter
+        logits-bearing entry (or a miss) wins instead."""
+        best = None
+        for aid, key in self._pcache:
+            if (aid == adapter and len(key) <= len(prompt)
+                    and prompt[:len(key)] == key
+                    and not (len(key) == len(prompt)
+                             and self._pcache[(aid, key)][-2] is None)
+                    and (best is None or len(key) > len(best))):
+                best = key
+        if best is None:
+            return None, None
+        entry = self._pcache.pop((adapter, best))  # re-insert at MRU
+        self._pcache[(adapter, best)] = entry
+        return best, entry
+
+    def _pcache_insert(self, prompt: tuple, cache1, last1,
+                       adapter: int = 0) -> None:
+        if self.prompt_cache <= 0:
+            return
+        old = self._pcache.pop((adapter, prompt), None)
+        nbytes = sum(x.nbytes for x in jax.tree.leaves((cache1, last1)))
+        self._pcache[(adapter, prompt)] = (cache1, last1, nbytes)
+        delta = nbytes - (old[2] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] = (
+                self._stats.get("pcache_bytes", 0) + delta)
+
+    def _pcache_extend(self, cache1, prompt: tuple, p0: int,
+                       adapter: int = 0):
+        """Append ``prompt[p0:]`` to a restored 1-row cache (row index sits
+        at p0). Returns (cache, last_logits) in EXACTLY the post-prefill
+        state: the suffix pads to a pow2 chunk, the index rolls back to
+        len-1 (pad junk becomes invisible to the position mask, the
+        chunked-admission finalize invariant) and the last real token is
+        re-decoded in place for the exact first-token logits."""
+        extra = np.asarray(prompt[p0:], np.int32)[None]
+        g = _pow2_at_least(extra.shape[1])
+        pad = np.zeros((1, g), np.int32)
+        pad[:, :extra.shape[1]] = extra
+        aids = self._aid_arg(1, adapter)
+        cache = self._extend_chunk(self.params, cache1, jnp.asarray(pad),
+                                   aids)
+        cache = set_cache_index(
+            cache, jnp.asarray([len(prompt) - 1], jnp.int32))
+        return self._decode_logits(
+            self.params, cache, jnp.asarray([prompt[-1]], jnp.int32), aids)
+
+    # --- page-chain bookkeeping (paged mode; loop thread only) ----------
+
+    def _pages_for(self, length: int, budget: int) -> int:
+        return -(-(length + budget) // self.page_size)  # ceil div
+
+    def _set_row(self, r: int, chain, index: int) -> None:
+        self._chains[r] = list(chain)
+        self._tables[r, :] = 0
+        self._tables[r, :len(chain)] = chain
+        self._indices[r] = index
+
+    def _release_slot_pages(self, r: int) -> None:
+        if self._chains[r]:
+            self._alloc.decref(self._chains[r])
+        self._chains[r] = []
+        self._tables[r, :] = 0
+
+    def _free_chains(self, chains) -> None:
+        for c in chains or []:
+            if c:
+                self._alloc.decref(c)
+
+    def _pages_needed(self, req, pkey) -> int:
+        """Worst-case fresh pages this admission will allocate — the fit
+        check, run BEFORE any device work or allocation. Mirrors the
+        alloc paths exactly: cache hits only pay for non-shared pages."""
+        ps, B = self.page_size, req.budget
+        n = req.samples if req.samples > 1 else req.block.shape[0]
+        # +1: a single-prompt admission pins a COW tail copy into the
+        # prompt cache (the insert skips gracefully when the pool is
+        # dry, but reserving it keeps the pin from stealing a page a
+        # sibling row's chain already counted on).
+        ins = 1 if (self.prompt_cache > 0
+                    and req.block.shape[0] == 1) else 0
+        if pkey is not None:
+            L = len(req.ptuple())
+            total = self._pages_for(L, B)
+            if len(pkey) == L:  # exact hit: no insert afterwards
+                return n * (total - len(pkey) // ps)
+            # prefix: row 0 shares the entry, siblings share row 0
+            return (total - len(pkey) // ps
+                    + (n - 1) * (total - L // ps) + ins)
+        if req.samples > 1:
+            L = int(req.lens[0])
+            total = self._pages_for(L, B)
+            return total + (n - 1) * (total - L // ps) + ins
+        return sum(self._pages_for(int(l), B)
+                   for l in req.lens) + (ins if n == 1 else 0)
+
+    def _alloc_request_chains(self, req, nb: int, n: int,
+                              lens) -> "list[list[int]]":
+        """Fresh page chains for a dense-prefilled admission, one list
+        per real row (pad rows get []). samples>1 allocates the full
+        chain for row 0 only — siblings get just their non-shared pages
+        (install increfs the shared prefix into their chains)."""
+        B = req.budget
+        if self._chaos is not None:
+            self._chaos.fire("page_alloc")
+        if req.samples > 1:
+            L = int(lens[0])
+            total = self._pages_for(L, B)
+            want = [total] + [total - L // self.page_size] * (n - 1)
+        else:
+            want = [self._pages_for(int(lens[j]), B) for j in range(n)]
+        chains = []
+        for w in want:
+            c = self._alloc.alloc(w)
+            if c is None:  # can't happen after the fit check; roll back
+                self._free_chains(chains)
+                raise RuntimeError("page pool exhausted mid-admission")
+            chains.append(c)
+        return chains + [[] for _ in range(nb - n)]
+
+    def _pin_pages(self, chain) -> None:
+        for p in chain:
+            self._pinned[p] = self._pinned.get(p, 0) + 1
+
+    def _unpin_pages(self, chain) -> None:
+        for p in chain:
+            left = self._pinned[p] - 1
+            if left:
+                self._pinned[p] = left
+            else:
+                del self._pinned[p]
+
+    def _pcache_evict_lru(self, swap: bool = True) -> int:
+        """Drop the LRU prompt-cache entry (paged entries release their
+        page pins); returns its byte size. Caller adjusts the stat.
+        With a host tier attached the entry's chain is GATHERED off
+        device first (``swap=False`` skips that — crash paths where
+        device state is untrusted), so eviction demotes instead of
+        forgetting; a failed gather falls back to the plain drop."""
+        key = next(iter(self._pcache))
+        entry = self._pcache.pop(key)
+        if self.paged:
+            if swap and self._tier is not None:
+                self._tier_swap_out(key, entry)
+            self._unpin_pages(entry[0])
+            self._alloc.decref(entry[0])
+        return entry[-1]
+
+    def _pcache_insert_paged(self, prompt: tuple, src_chain, last1,
+                             adapter: int = 0,
+                             frozen: bool = False) -> None:
+        """Pin ``prompt``'s pages into the prompt cache WITHOUT copying
+        the prompt K/V: the entry shares the source row's full pages by
+        incref — safe read-only, since a row only ever writes positions
+        >= its admitted length, which live past its full prompt pages —
+        and copies only the partial tail page (the row's next decode
+        DOES write into that one). Skipped when the pool can't spare
+        the tail copy.
+
+        ``frozen``: the source row is FINISHED (session-end insert) —
+        nothing will ever write its tail page again, so the partial
+        tail is shared by incref like the full pages instead of COW
+        copied (a later admission that extends the entry takes its own
+        tail copy through ``build_row``, same as any prefix hit). Saves
+        one page + one device copy per session turn, and cannot fail on
+        an exhausted pool."""
+        if self.prompt_cache <= 0:
+            return
+        ps = self.page_size
+        full = len(prompt) // ps
+        chain = list(src_chain[:full])
+        self._alloc.incref(chain)
+        if len(prompt) % ps:
+            if frozen:
+                chain.append(src_chain[full])
+                self._alloc.incref(chain[-1:])
+            else:
+                tail = self._alloc.alloc(1)
+                if tail is None:
+                    self._alloc.decref(chain)
+                    return  # pool too tight to pin a copy — skip caching
+                self._cache = self._copy_page(self._cache,
+                                              src_chain[full], tail[0])
+                chain.append(tail[0])
+        old = self._pcache.pop((adapter, prompt), None)
+        if old is not None:
+            self._unpin_pages(old[0])
+            self._alloc.decref(old[0])
+        self._pin_pages(chain)
+        nbytes = len(chain) * self._page_bytes \
+            + (sum(x.nbytes for x in jax.tree.leaves(last1))
+               if last1 is not None else 0)
+        self._pcache[(adapter, prompt)] = (tuple(chain), len(prompt),
+                                           last1, nbytes)
+        delta = nbytes - (old[-1] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] += delta
+
+    # --- host page tier (docs/TIERING.md; loop thread only) -------------
+
+    def _gather_pages(self, chain) -> dict:
+        """One host copy of a page chain: every ``*_pages`` pool leaf
+        gathered at the chain's indices, fetched in a SINGLE
+        ``jax.device_get`` of the whole dict (one transfer round-trip,
+        not one per layer). Keys are the "/"-joined leaf paths —
+        exactly what ``_restore_pages`` scatters back from."""
+        idx = jnp.asarray(chain, jnp.int32)
+        out = {}
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                self._cache)[0]:
+            if str(getattr(path[-1], "key", "")).endswith("_pages"):
+                key = "/".join(str(getattr(k, "key", k)) for k in path)
+                out[key] = leaf[idx]
+        return jax.device_get(out)
+
+    def _install_host_chain(self, key, length: int, host: dict,
+                            last) -> bool:
+        """Install a host-gathered chain as a pinned prompt-cache entry
+        — the shared tail of tier swap-in and disagg KV import. FRESH
+        pages only: no live row's table points at them, so any failure
+        rolls back by freeing them — live rows are untouchable by
+        construction. Allocates (pressure-evicting idle pcache entries
+        first), scatters the host buffers in via one ``_restore_pages``
+        dispatch, pins + inserts — after which the entry serves hits
+        exactly like one that never left HBM. Returns False when the
+        pool is too tight even after pressure; raises when the restore
+        dispatch itself fails (caller degrades to cold prefill)."""
+        n = -(-length // self.page_size)
+        while n > self._alloc.free and self._pcache:
+            freed = self._pcache_evict_lru()
+            with self._lock:
+                self._stats["pcache_bytes"] -= freed
+        pages = self._alloc.alloc(n)
+        if pages is None:
+            return False
+        try:
+            npad = _pow2_at_least(n)
+            idx = np.zeros((npad,), np.int32)
+            idx[:n] = pages
+            hpad = {}
+            for k, v in host.items():
+                buf = np.zeros((npad,) + v.shape[1:], v.dtype)
+                buf[:n] = v[:n]
+                hpad[k] = buf
+            self._cache = self._restore_pages(self._cache, hpad,
+                                              jnp.asarray(idx))
+            last_dev = jnp.asarray(last) if last is not None else None
+        except Exception:  # noqa: BLE001 — restore dispatch failed
+            self._alloc.decref(pages)
+            raise
+        self._pin_pages(pages)
+        old = self._pcache.pop(key, None)
+        if old is not None:  # raced a fresh insert; replace it
+            self._unpin_pages(old[0])
+            self._alloc.decref(old[0])
+        nbytes = n * self._page_bytes \
+            + (int(last_dev.nbytes) if last_dev is not None else 0)
+        self._pcache[key] = (tuple(pages), length, last_dev, nbytes)
+        delta = nbytes - (old[-1] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] += delta
+        return True
+
+    def _tier_swap_out(self, key, entry) -> bool:
+        """Gather a pcache entry's chain to the host tier. The caller
+        still owns the entry (and drops its pins/refs afterwards) —
+        this only copies bytes off device, so a failure (chaos
+        ``tier_swap``, host OOM) simply leaves the entry to die the
+        pre-tier way: dropped, next turn pays a cold prefill. Entry
+        pages are immutable once inserted (COW discipline), so the
+        gather needs no quiescence even while live rows share the
+        chain's full pages."""
+        t0 = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("tier_swap")
+            host = self._gather_pages(entry[0])
+            last = entry[2]
+            if last is not None:
+                last = jax.device_get(last)
+            self._tier.put(key, entry[1], host, last=last)
+        except Exception:  # noqa: BLE001 — degrade to plain eviction
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["tier_swap_outs"] += 1
+        if self._obs is not None:
+            self._obs.on_tier_swap(
+                "out", dt, self._tier.stats()["tier_pages"],
+                self._alloc.total - self._alloc.free)
+        return True
+
+    def _tier_swap_in(self, key) -> bool:
+        """Restore a tier entry into the prompt cache via
+        ``_install_host_chain`` — after which the entry serves hits
+        exactly like one that never left. Failure paths degrade to a
+        cold prefill (``tier_fallbacks``); corrupt/undecodable entries
+        are discarded so they cannot fail every later probe too."""
+        t0 = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("tier_swap")
+            length, host, last = self._tier.load(key)
+        except Exception:  # noqa: BLE001 — torn spill / injected fault
+            self._tier.discard(key)
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        try:
+            installed = self._install_host_chain(key, length, host, last)
+        except Exception:  # noqa: BLE001 — restore dispatch failed
+            self._record_backend_failure()
+            self._tier.discard(key)
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        if not installed:
+            # Pool too tight even after pressure: keep the host copy
+            # (it is still good — a later, calmer admission can restore
+            # it) and let THIS request prefill cold.
+            with self._lock:
+                self._stats["tier_fallbacks"] += 1
+            if self._obs is not None:
+                self._obs.on_tier_fallback()
+            return False
+        with self._lock:
+            self._stats["tier_swap_ins"] += 1
+        self._tier.discard(key)  # moved, not copied: one owner at a time
+        if self._obs is not None:
+            self._obs.on_tier_swap(
+                "in", time.perf_counter() - t0,
+                self._tier.stats()["tier_pages"],
+                self._alloc.total - self._alloc.free)
+        return True
+
+    def _tier_pressure(self) -> None:
+        """Low-watermark demotion, run once per loop iteration: while
+        the free list sits below ``tier_watermark`` and idle pcache
+        entries exist, gather the LRU entry to host and return its
+        pages. Terminates because each pass shrinks the pcache;
+        entries whose pages are shared with live rows free only their
+        unshared pages (refcounts), which is exactly the reclaimable
+        amount."""
+        while (self._alloc.free < self.tier_watermark and self._pcache):
+            freed = self._pcache_evict_lru()
+            with self._lock:
+                self._stats["pcache_bytes"] -= freed
+
+    def _session_insert(self, req, r: int) -> None:
+        """Session-end insert (called from _finish_row BEFORE the row's
+        pages are released): pin the finished row's chain into the
+        prompt cache keyed by prompt + every reply token except the
+        last. That key is exactly the K/V the chain holds — after g
+        emitted tokens the row's index is L+g-1 and positions
+        L..L+g-2 hold t1..t_{g-1}; the last sampled token's K/V was
+        never written (and any mid-block post-eos junk lies beyond the
+        key length, invisible to the position mask). The entry stores
+        last=None — no logits exist for the uncommitted tail token —
+        so it serves prefix hits only (the next turn's prompt strictly
+        extends it through t_g). The session's previous chain is
+        dropped from pcache AND tier: one chain per session. A
+        one-token turn adopts the admission-time exact-prompt entry
+        (same key, better: it has logits) rather than inserting."""
+        toks = self._collected[r]
+        if len(toks) < 2:
+            # One-token turn: the key (prompt + zero committed reply
+            # tokens) IS the prompt, and admission already cached that
+            # exact chain WITH its next-token logits. Inserting a
+            # frozen last=None twin would replace the strictly better
+            # entry — adopt the existing one into the ledger instead,
+            # so release_session parks the live chain, not the
+            # previous turn's stale key.
+            key = (req.adapter, req.ptuple())
+            if key not in self._pcache:
+                return  # evicted (or never inserted); keep prev chain
+        else:
+            key_prompt = req.ptuple() + tuple(toks[:-1])
+            n_entry = -(-len(key_prompt) // self.page_size)
+            chain = self._chains[r]
+            if len(chain) < n_entry:  # defensive: never by allocation
+                return
+            self._pcache_insert_paged(key_prompt, chain[:n_entry], None,
+                                      req.adapter, frozen=True)
+            key = (req.adapter, key_prompt)
+            if key not in self._pcache:
+                return  # capacity-evicted immediately; nothing to track
+        prev = self._sessions.get(req.session)
+        if prev is not None and prev != key:
+            ent = self._pcache.pop(prev, None)
+            if ent is not None:
+                self._unpin_pages(ent[0])
+                self._alloc.decref(ent[0])
+                with self._lock:
+                    self._stats["pcache_bytes"] -= ent[-1]
+            if self._tier is not None:
+                self._tier.discard(prev)
+        self._sessions[req.session] = key
+
+    def _do_release_session(self, session: str,
+                            spill: bool = False) -> bool:
+        """Loop-thread body of release_session: demote the session's
+        pcache entry to the host tier (gather + unpin + free pages).
+        True when a chain existed (now on host — or already there).
+        ``spill`` additionally forces the parked chain to the disk tier
+        (no-op without --tier-dir): the drain path, where the chain
+        must outlive this process for a peer replica to adopt it."""
+        key = self._sessions.get(session)
+        if key is None:
+            return False
+        entry = self._pcache.pop(key, None)
+        if entry is None:
+            # Already demoted (watermark pressure / LRU eviction beat
+            # the explicit release to it).
+            had = self._tier is not None and self._tier.contains(key)
+            if had and spill:
+                self._tier.spill(key)
+            return had
+        if self._tier is not None:
+            if self._tier_swap_out(key, entry) and spill:
+                self._tier.spill(key)
+        self._unpin_pages(entry[0])
+        self._alloc.decref(entry[0])
+        with self._lock:
+            self._stats["pcache_bytes"] -= entry[-1]
+        return True
+
+    def release_session(self, session: str,
+                        timeout_s: float = 30.0,
+                        spill: bool = False) -> bool:
+        """Explicitly park a session between turns: its cached chain
+        leaves the device pool for the host tier (or is dropped when no
+        tier is attached) and the freed pages go back to admission.
+        ``spill=True`` forces the parked chain through to the disk tier
+        so it survives this process (drain-before-kill; requires
+        --tier-dir to have any effect). Safe from any thread — the
+        operation marshals to the loop thread via the request queue.
+        Returns whether the session had a chain to release."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not self.paged:
+            return False
+        cmd = _TierCommand("release", session, spill=spill)
+        self._q.put(cmd)
+        if not cmd.event.wait(timeout_s):
+            raise TimeoutError("session release did not finish in time")
+        if cmd.error is not None:
+            raise cmd.error
+        return bool(cmd.result)
+
+    # --- disagg KV transfer (docs/DISAGG.md; loop-thread bodies) --------
+
+    def note_transfer_fallback(self) -> None:
+        """Count one degraded KV handoff (torn/checksum-failed transfer,
+        unreachable prefill peer, pool too tight to install): the
+        request still completes via a cold prefill on this replica —
+        this only records that the fast path was lost. Callable from
+        any thread (the server's HTTP-failure path uses it too)."""
+        with self._lock:
+            self._stats["transfer_fallbacks"] += 1
+        if self._obs is not None:
+            self._obs.on_transfer_fallback()
+
+    def _prefill_into_pcache(self, prompt: tuple, adapter: int) -> None:
+        """Prefill-role primitive: run ``prompt``'s prefill into a fresh
+        page chain and pin it as an exact prompt-cache entry WITH its
+        next-token logits — the same dense-prefill + ``_pack_pages``
+        pipeline a monolithic admission runs, minus any decode rows, so
+        the entry's bytes are identical to what a monolithic admission
+        would have pinned. The export owns the whole chain (no live row
+        shares it), so the insert pins directly without the COW tail
+        copy ``_pcache_insert_paged`` pays."""
+        L = len(prompt)
+        n = -(-L // self.page_size)
+        while n > self._alloc.free and self._pcache:
+            freed = self._pcache_evict_lru()
+            with self._lock:
+                self._stats["pcache_bytes"] -= freed
+        chain = self._alloc.alloc(n)
+        if chain is None:
+            raise RuntimeError(
+                f"prefill export needs {n} pages but only "
+                f"{self._alloc.free} are free")
+        try:
+            width = prompt_width_bucket(L, self.max_seq)
+            block = np.zeros((1, width), np.int32)
+            block[0, :L] = prompt
+            small, last = self._prefill(
+                self.params, jnp.asarray(block),
+                jnp.asarray([L], np.int32), self._aid_arg(1, adapter))
+            pm = np.zeros((1, self.n_bt), np.int32)
+            pm[0, :n] = chain
+            self._cache = self._pack_pages(self._cache, small,
+                                           jnp.asarray(pm))
+        except Exception:  # noqa: BLE001 — roll back, caller degrades
+            self._record_backend_failure()
+            self._alloc.decref(chain)
+            raise
+        old = self._pcache.pop((adapter, prompt), None)
+        if old is not None:
+            self._unpin_pages(old[0])
+            self._alloc.decref(old[0])
+        self._pin_pages(chain)
+        nbytes = n * self._page_bytes \
+            + sum(int(x.nbytes) for x in jax.tree.leaves(last))
+        self._pcache[(adapter, prompt)] = (tuple(chain), L, last, nbytes)
+        delta = nbytes - (old[-1] if old else 0)
+        while len(self._pcache) > self.prompt_cache:
+            delta -= self._pcache_evict_lru()
+        with self._lock:
+            self._stats["pcache_bytes"] += delta
+
+    def _do_export_chain(self, prompt: tuple, adapter: int) -> bytes:
+        """Loop-thread body of export_chain: stage the prompt's finished
+        prefill in the prompt cache (an exact repeat reuses the staged
+        entry — the prefill replica's own prompt cache makes repeated
+        exports free), gather the chain off device, and serialize it in
+        the tier wire format. Chaos ``kv_transfer`` fires first: an
+        injected fault fails THIS export cleanly (the decode peer
+        degrades to cold prefill), loop alive."""
+        t0 = time.perf_counter()
+        if self._chaos is not None:
+            self._chaos.fire("kv_transfer")
+        key = (adapter, prompt)
+        entry = self._pcache.get(key)
+        if entry is None or entry[2] is None:
+            # Miss (or a logits-less session tail an exact export can't
+            # use): run the prefill now.
+            self._prefill_into_pcache(prompt, adapter)
+            entry = self._pcache.get(key)
+            if entry is None or entry[2] is None:
+                raise RuntimeError("prefill export: cache insert failed")
+        else:
+            self._pcache[key] = self._pcache.pop(key)  # MRU refresh
+        host = self._gather_pages(entry[0])
+        last = jax.device_get(entry[2])
+        data = encode_entry(key, entry[1], host, last)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["kv_exports"] += 1
+            self._stats["kv_transfer_bytes"] += len(data)
+        if self._obs is not None:
+            self._obs.on_kv_transfer("export", dt, len(data))
+        return data
+
+    def _do_import_chain(self, data: bytes) -> bool:
+        """Loop-thread body of import_chain: checksum-verify the wire
+        bytes and install the chain as a pinned prompt-cache entry via
+        one ``_restore_pages`` dispatch — the next admission of that
+        prompt is then an exact pcache hit, bit-identical to a
+        monolithic run. EVERY failure (chaos ``kv_transfer``, torn or
+        checksum-failed payload, restore-dispatch error, pool too
+        tight) returns False with ``transfer_fallbacks`` counted — the
+        caller just submits normally and pays a cold prefill; live rows
+        are untouchable because only fresh pages were ever involved."""
+        t0 = time.perf_counter()
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("kv_transfer")
+            key, length, host, last = decode_entry(bytes(data))
+            adapter, prompt = key
+            if (not isinstance(prompt, tuple) or not isinstance(host, dict)
+                    or length != len(prompt) or length < 1
+                    or length > self.max_seq):
+                raise TierCorrupt("transfer payload malformed")
+        except Exception:  # noqa: BLE001 — torn transfer / injected fault
+            self.note_transfer_fallback()
+            return False
+        try:
+            installed = self._install_host_chain(key, length, host, last)
+        except Exception:  # noqa: BLE001 — restore dispatch failed
+            self._record_backend_failure()
+            self.note_transfer_fallback()
+            return False
+        if not installed:
+            self.note_transfer_fallback()
+            return False
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["kv_imports"] += 1
+            self._stats["kv_transfer_bytes"] += len(data)
+        if self._obs is not None:
+            self._obs.on_kv_transfer("import", dt, len(data))
+        return True
+
+    def export_chain(self, prompt, *, adapter_id: int = 0,
+                     timeout_s: float = 60.0) -> bytes:
+        """Prefill-role API: run ``prompt``'s prefill (or reuse this
+        replica's cached one) and return its finished page chain +
+        next-token logits serialized in the checksummed tier wire
+        format — the unit a decode-role replica restores with
+        ``import_chain``. Safe from any thread (marshals to the loop
+        thread); raises on any failure so the HTTP layer can signal the
+        decode peer to fall back to a cold prefill."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not self.paged:
+            raise ValueError("KV export requires paged mode (page_size)")
+        if self.prompt_cache <= 0:
+            raise ValueError("KV export requires prompt_cache > 0 (the "
+                             "exported chain is staged there)")
+        prompt = tuple(int(t) for t in prompt)
+        if not prompt:
+            raise ValueError("prompt must be non-empty")
+        if len(prompt) > self.max_seq:
+            raise ValueError(f"prompt {len(prompt)} exceeds the cache "
+                             f"({self.max_seq})")
+        adapter_id = int(adapter_id)
+        if adapter_id != 0 and self.n_adapters is None:
+            raise ValueError("this engine's model has no adapter stacks "
+                             "(multi_lora is off); adapter_id must be 0")
+        if self.n_adapters is not None \
+                and not 0 <= adapter_id < self.n_adapters:
+            raise ValueError(f"adapter_id {adapter_id} outside "
+                             f"[0, {self.n_adapters})")
+        n = -(-len(prompt) // self.page_size)
+        if n > self._alloc.total:
+            raise ValueError(
+                f"prompt needs {n} pages but the pool has "
+                f"{self._alloc.total} usable")
+        cmd = _TierCommand("export", "", payload=(prompt, adapter_id))
+        self._q.put(cmd)
+        if not cmd.event.wait(timeout_s):
+            raise TimeoutError("KV export did not finish in time")
+        if cmd.error is not None:
+            raise cmd.error
+        return cmd.result
+
+    def import_chain(self, data: bytes, *,
+                     timeout_s: float = 60.0) -> bool:
+        """Decode-role API: restore a chain exported by a prefill-role
+        peer into this engine's prompt cache. Returns True when the
+        next admission of that prompt will be an exact pcache hit;
+        False when the transfer was torn/corrupt or could not be
+        installed (``transfer_fallbacks`` counted — just submit
+        normally and pay a cold prefill). Safe from any thread."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not self.paged:
+            raise ValueError("KV import requires paged mode (page_size)")
+        if self.prompt_cache <= 0:
+            raise ValueError("KV import requires prompt_cache > 0 (the "
+                             "restored chain lands there)")
+        cmd = _TierCommand("import", "", payload=bytes(data))
+        self._q.put(cmd)
+        if not cmd.event.wait(timeout_s):
+            raise TimeoutError("KV import did not finish in time")
+        if cmd.error is not None:
+            raise cmd.error
+        return bool(cmd.result)
+
+    def _exec_tier_command(self, cmd: "_TierCommand") -> None:
+        try:
+            if cmd.kind == "release":
+                cmd.result = self._do_release_session(cmd.session,
+                                                      spill=cmd.spill)
+            elif cmd.kind == "export":
+                cmd.result = self._do_export_chain(*cmd.payload)
+            elif cmd.kind == "import":
+                cmd.result = self._do_import_chain(cmd.payload)
+            else:  # unknown kinds fail loudly, never hang the caller
+                raise ValueError(f"unknown tier command {cmd.kind!r}")
+        except Exception as e:  # noqa: BLE001 — fail the one command
+            cmd.error = e
+        cmd.signal()

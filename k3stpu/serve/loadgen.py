@@ -325,6 +325,135 @@ def run_load(url: "str | list[str]", *, clients: int, seconds: float,
     return out
 
 
+def parse_mix(spec: str) -> "tuple[int, int]":
+    """``--mix`` spec → (short_weight, long_weight).
+
+    Spec: ``short:long=<w>:<w>`` — e.g. ``short:long=9:1`` is nine
+    short requests for every long one. Both weights must be positive
+    integers; the class names are fixed (they name the two payloads the
+    mixed mode builds, not arbitrary traffic classes)."""
+    try:
+        names, _, weights = spec.partition("=")
+        if names != "short:long":
+            raise ValueError(spec)
+        w_short_s, w_long_s = weights.split(":")
+        w_short, w_long = int(w_short_s), int(w_long_s)
+    except ValueError:
+        raise ValueError(
+            f"bad mix spec {spec!r} (want e.g. 'short:long=9:1')") from None
+    if w_short < 1 or w_long < 1:
+        raise ValueError(f"mix weights must be >= 1, got {spec!r}")
+    return w_short, w_long
+
+
+def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
+              mix: "tuple[int, int]", rows: int, long_rows: int,
+              generate_tokens: int,
+              traces: "ClientTraces | None" = None) -> dict:
+    """Mixed short/long traffic against /v1/generate — the disagg
+    workload (docs/DISAGG.md): long prompts are the prefill
+    interference that inflates short requests' inter-token latency on
+    a monolithic replica, and the number this mode exists to expose is
+    the SHORT class's TPOT tail under that interference.
+
+    The client pool splits by the mix weights (each class keeps at
+    least one client; short rounds up — it is the measured class).
+    Both classes ride the SSE route so every request observes TTFT;
+    TPOT is the post-first-token decode rate,
+    ``(latency - ttft) / (generate_tokens - 1)``. The result carries
+    per-class TTFT and TPOT p50/p95/p99 under ``classes``."""
+    if generate_tokens < 2:
+        raise ValueError("mixed mode needs --generate-tokens >= 2 "
+                         "(TPOT is defined past the first token)")
+    urls = [url] if isinstance(url, str) else list(url)
+    w_short, w_long = mix
+    n_long = max(1, round(clients * w_long / (w_short + w_long)))
+    n_short = max(1, clients - n_long)
+    specs = [("short", n_short, _gen_prompt(rows)),
+             ("long", n_long, _gen_prompt(long_rows))]
+
+    lock = threading.Lock()
+    stop = threading.Event()
+    retry_stats = {"retries": 0, "gave_up": 0}
+    per_class: "dict[str, dict]" = {}
+    threads = []
+    seed = 0
+    for tag, n, prompt in specs:
+        payload = json.dumps({"prompt_tokens": [prompt],
+                              "max_new_tokens": generate_tokens,
+                              "stream": True}).encode()
+        cls = {"latencies": [], "ttfts": [], "errors": [],
+               "clients": n, "prompt_tokens": len(prompt)}
+        per_class[tag] = cls
+        for _ in range(n):
+            threads.append(threading.Thread(
+                target=_client_loop,
+                args=(urls[seed % len(urls)], payload, stop,
+                      cls["latencies"], lock, cls["errors"],
+                      "/v1/generate", cls["ttfts"], retry_stats, seed,
+                      traces),
+                daemon=True))
+            seed += 1
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=300)
+    wall = time.perf_counter() - t0
+
+    if not per_class["short"]["latencies"]:
+        raise RuntimeError(
+            f"no short request succeeded; errors: "
+            f"{(per_class['short']['errors'] + per_class['long']['errors'])[:3]}")
+
+    def pct(sorted_ms: "list[float]", q: float) -> float:
+        return sorted_ms[min(len(sorted_ms) - 1, int(q * len(sorted_ms)))]
+
+    classes = {}
+    all_lat_ms: "list[float]" = []
+    total_errors = 0
+    for tag, _, _ in specs:
+        cls = per_class[tag]
+        # latencies and ttfts append in the same locked block per
+        # success, so they are index-aligned pairs.
+        lats = [l for l, _ in cls["latencies"]]
+        tpots = [1e3 * (lat - tt) / (generate_tokens - 1)
+                 for lat, tt in zip(lats, cls["ttfts"])]
+        lat_ms = sorted(1e3 * l for l in lats)
+        tt_ms = sorted(1e3 * t for t in cls["ttfts"])
+        tpots.sort()
+        doc = {"clients": cls["clients"],
+               "prompt_tokens": cls["prompt_tokens"],
+               "requests": len(lat_ms),
+               "errors": len(cls["errors"])}
+        if lat_ms:
+            for q, label in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+                doc[f"ttft_{label}_ms"] = round(pct(tt_ms, q), 2)
+                doc[f"tpot_{label}_ms"] = round(pct(tpots, q), 3)
+                doc[f"{label}_ms"] = round(pct(lat_ms, q), 2)
+        classes[tag] = doc
+        all_lat_ms.extend(lat_ms)
+        total_errors += len(cls["errors"])
+    all_lat_ms.sort()
+    return {
+        "mix": f"short:long={w_short}:{w_long}",
+        "clients": n_short + n_long,
+        "endpoints": len(urls),
+        "gen_tokens_per_request": generate_tokens,
+        "wall_s": round(wall, 2),
+        "requests": len(all_lat_ms),
+        "errors": total_errors,
+        "retries_503": retry_stats["retries"],
+        "gave_up_503": retry_stats["gave_up"],
+        "p50_ms": round(pct(all_lat_ms, 0.50), 2),
+        "p95_ms": round(pct(all_lat_ms, 0.95), 2),
+        "p99_ms": round(pct(all_lat_ms, 0.99), 2),
+        "classes": classes,
+    }
+
+
 def parse_ramp(spec: str, base_clients: int) -> "list[tuple[int, float]]":
     """``--ramp`` spec → [(clients, seconds), ...] phases.
 
@@ -735,6 +864,19 @@ def main(argv: "list[str] | None" = None) -> int:
     ap.add_argument("--spec-gamma", type=int, default=4,
                     help="max draft tokens per slot per speculative "
                          "dispatch (with --speculate)")
+    ap.add_argument("--mix", default=None, metavar="SPEC",
+                    help="mixed short/long generate traffic: "
+                         "'short:long=<w>:<w>' (e.g. short:long=9:1) "
+                         "splits the client pool by weight — short "
+                         "prompts are --rows tokens, long prompts "
+                         "--long-prompt-tokens. Rides the SSE route and "
+                         "reports per-class TTFT and TPOT p50/p95/p99 "
+                         "(the disagg comparison's workload, "
+                         "docs/DISAGG.md). Requires --generate-tokens")
+    ap.add_argument("--long-prompt-tokens", type=int, default=2048,
+                    help="long-class prompt length for --mix (the "
+                         "prefill-interference source; raise --seq-len "
+                         "to fit it plus --generate-tokens)")
     ap.add_argument("--ramp", default=None, metavar="SPEC",
                     help="piecewise load schedule instead of a flat "
                          "--seconds window: comma-separated "
@@ -808,6 +950,17 @@ def main(argv: "list[str] | None" = None) -> int:
             ramp_phases = parse_ramp(args.ramp, args.clients)
         except ValueError as e:
             ap.error(str(e))
+    mix = None
+    if args.mix:
+        if args.ramp or args.sessions:
+            ap.error("--mix is mutually exclusive with --ramp/--sessions")
+        if args.generate_tokens <= 1:
+            ap.error("--mix requires --generate-tokens >= 2 (TPOT is "
+                     "defined past the first token)")
+        try:
+            mix = parse_mix(args.mix)
+        except ValueError as e:
+            ap.error(str(e))
     if args.sessions:
         if args.generate_tokens <= 0:
             ap.error("--sessions requires --generate-tokens (sessions "
@@ -872,6 +1025,12 @@ def main(argv: "list[str] | None" = None) -> int:
             print("warming up (generate path)...", flush=True)
             server.generate_tokens([_gen_prompt(args.rows)],
                                    max_new_tokens=2)
+            if mix is not None:
+                # Mixed load dispatches BOTH width buckets; the long
+                # class's prefill program must compile here too.
+                server.generate_tokens(
+                    [_gen_prompt(args.long_prompt_tokens)],
+                    max_new_tokens=2)
             # Warmup dispatches are compile-dominated: without the reset
             # they poison the committed device tokens/s (same reason
             # server.warmup() resets for the predict path).
@@ -907,6 +1066,11 @@ def main(argv: "list[str] | None" = None) -> int:
             urls or url, sessions=args.sessions, turns=args.turns,
             rows=args.rows, gen_tokens=args.generate_tokens,
             release=not args.no_session_release)
+    elif mix is not None:
+        result = run_mixed(
+            urls or url, clients=args.clients, seconds=args.seconds,
+            mix=mix, rows=args.rows, long_rows=args.long_prompt_tokens,
+            generate_tokens=args.generate_tokens, traces=traces)
     elif ramp_phases is not None:
         result = run_ramp(
             urls or url, phases=ramp_phases, rows=args.rows,
@@ -976,6 +1140,18 @@ def main(argv: "list[str] | None" = None) -> int:
               f"{result['spec_dispatches']} verify dispatches "
               f"(accept ratio {result['spec_accept_ratio']})",
               flush=True)
+    if result.get("classes"):
+        print("per-class latency (ms):", flush=True)
+        for tag, st in result["classes"].items():
+            if st.get("ttft_p50_ms") is None:
+                print(f"  {tag:5s} ({st['prompt_tokens']} prompt toks): "
+                      f"{st['requests']} reqs, no successes", flush=True)
+                continue
+            print(f"  {tag:5s} ({st['prompt_tokens']} prompt toks): "
+                  f"{st['requests']} reqs  "
+                  f"ttft p50 {st['ttft_p50_ms']} p99 {st['ttft_p99_ms']}  "
+                  f"tpot p50 {st['tpot_p50_ms']} p99 {st['tpot_p99_ms']}",
+                  flush=True)
     if result.get("warm_ttft_p50_ms") is not None:
         print(f"sessions: turn-1 TTFT p50 {result['turn1_ttft_p50_ms']} "
               f"ms, warm-turn TTFT p50 {result['warm_ttft_p50_ms']} ms "

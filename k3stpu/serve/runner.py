@@ -1,0 +1,296 @@
+"""Model runner: the engine's jitted device programs (docs/DISAGG.md
+names this layer in the decomposed engine).
+
+Every program here is compiled once per static bucket and dispatched by
+the scheduler loop (serve/scheduler.py) against the KV state owned by
+the page manager (serve/kv_manager.py). ``GenerateEngine`` composes the
+three as mixins over one shared ``self`` — the decomposition moves code,
+not state, so the bit-exactness suites pin behavior across the split.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from k3stpu.models.generate import set_cache_index
+from k3stpu.serve.programs import (
+    decode_core,
+    extend_core,
+    prefill_core,
+)
+
+_NEG_INF = -1e30
+
+
+def _pow2_at_least(n: int, lo: int = 1) -> int:
+    p = lo
+    while p < n:
+        p *= 2
+    return p
+
+
+def _sample_rows(logits, temps, topks, topps, key):
+    """Per-row sampling over (B, V) logits: temperature <= 0 is greedy;
+    top-k cuts below each row's own k-th value (k == V disables); top-p
+    keeps each row's smallest nucleus reaching mass p (1.0 disables).
+
+    The all-greedy batch — the dominant serving case, and every decode
+    step of the exactness-pinned capture runs — skips the sampling
+    machinery entirely via ``lax.cond``: the mixed path pays two full
+    (B, V) sorts (top-k kth-value + top-p nucleus) per step, pure
+    VPU/HBM waste when no row will use the result."""
+    from k3stpu.models.generate import top_p_mask
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def mixed(_):
+        v = logits.shape[-1]
+        scaled = logits / jnp.clip(temps, 1e-6, None)[:, None]
+        srt = jnp.sort(scaled, axis=-1)
+        kth = jnp.take_along_axis(
+            srt, (v - jnp.clip(topks, 1, v))[:, None], axis=-1)
+        cut = jnp.where(scaled < kth, _NEG_INF, scaled)
+        cut = top_p_mask(cut, topps)
+        sampled = jax.random.categorical(key, cut,
+                                         axis=-1).astype(jnp.int32)
+        return jnp.where(temps <= 0.0, greedy, sampled)
+
+    return jax.lax.cond(jnp.all(temps <= 0.0), lambda _: greedy, mixed,
+                        None)
+
+
+class ModelRunnerMixin:
+    """The jitted prefill/decode/extend/spec-verify dispatches plus the
+    small helpers that build their traced arguments. Owns no state of
+    its own — ``self`` is the composed ``GenerateEngine``."""
+
+    # --- jitted device programs (compiled once per static bucket) -------
+
+    # params travel as jit ARGUMENTS (donated weights would bake into the
+    # compiled program as constants otherwise — double the HBM). The
+    # cache-model programs themselves are the shared cores in
+    # serve/programs.py (one definition for engine + speculative).
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _decode_step(self, params, cache, toks, temps, topks, topps,
+                     step, base_key, aids=None):
+        cache, logits = decode_core(self.model, params, cache, toks,
+                                    adapter_ids=aids)
+        key = jax.random.fold_in(base_key, step)
+        return cache, _sample_rows(logits, temps, topks, topps, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 9))
+    def _decode_block_step(self, params, cache, toks, temps, topks,
+                           topps, step, base_key, k_tokens: int,
+                           aids=None):
+        """K decode steps in ONE dispatch: ``lax.scan`` over the
+        single-token core, sampling on-device each step. Returns the
+        (K, B) token block; greedy rows are exactly K steps of argmax,
+        so engine output stays pinned to ``generate()`` token for
+        token. Rows that finish mid-block keep decoding (static shapes;
+        the host discards their surplus) — their cache writes clamp at
+        the row's last slot and the slot's next reuse scatters a fresh
+        prefill over everything, index included."""
+        block_key = jax.random.fold_in(base_key, step)
+
+        def body(carry, i):
+            cache, tok = carry
+            cache, logits = decode_core(self.model, params, cache, tok,
+                                        adapter_ids=aids)
+            key = jax.random.fold_in(block_key, i)
+            nxt = _sample_rows(logits, temps, topks, topps, key)
+            return (cache, nxt), nxt
+
+        (cache, _), out = jax.lax.scan(
+            body, (cache, toks), jnp.arange(k_tokens))
+        return cache, out
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _prefill(self, params, block, lens, aids=None):
+        return prefill_core(self.model, params, block, lens,
+                            adapter_ids=aids)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _scatter(self, big, small, slot_ids):
+        return jax.tree.map(lambda b, s: b.at[slot_ids].set(s), big, small)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _extend_chunk(self, params, cache, chunk, aids=None):
+        return extend_core(self.model, params, cache, chunk,
+                           adapter_ids=aids)[0]
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _decode_logits(self, params, cache, toks, aids=None):
+        return decode_core(self.model, params, cache, toks,
+                           adapter_ids=aids)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _first_sample(self, last_logits, temps, topks, topps, step,
+                      base_key):
+        key = jax.random.fold_in(base_key, step)
+        return _sample_rows(last_logits, temps, topks, topps, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 3))
+    def _broadcast_rows(self, cache, last, n: int):
+        """Row 0 of a 1-row admission cache replicated to n rows — the
+        shared-prefix fan-out (one prefill, n sampled continuations)."""
+        rep = jax.tree.map(
+            lambda x: jnp.broadcast_to(x[:1], (n, *x.shape[1:])), cache)
+        return rep, jnp.broadcast_to(last[:1], (n, *last.shape[1:]))
+
+    # --- paged-cache programs (block tables + host-injected indices) ----
+
+    # Every paged program takes the host's (slots,) index mirror and
+    # stamps it into the cache before the core runs: device-side index
+    # state is disposable, so a batch-wide call that advances OTHER
+    # rows' indices (the prefix-hit extension neutralizes those rows
+    # onto the sink page) is corrected for free at the next dispatch.
+    # Block tables are traced int32 data — one compiled program serves
+    # every page assignment, zero steady-state recompiles.
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_decode_step(self, params, cache, idx, bts, toks, temps,
+                           topks, topps, step, base_key, aids=None):
+        cache = set_cache_index(cache, idx)
+        cache, logits = decode_core(self.pmodel, params, cache, toks,
+                                    adapter_ids=aids, block_tables=bts)
+        key = jax.random.fold_in(base_key, step)
+        return cache, _sample_rows(logits, temps, topks, topps, key)
+
+    @functools.partial(jax.jit, static_argnums=(0, 11))
+    def _paged_decode_block_step(self, params, cache, idx, bts, toks,
+                                 temps, topks, topps, step, base_key,
+                                 k_tokens: int, aids=None):
+        cache = set_cache_index(cache, idx)
+        block_key = jax.random.fold_in(base_key, step)
+
+        def body(carry, i):
+            cache, tok = carry
+            cache, logits = decode_core(self.pmodel, params, cache, tok,
+                                        adapter_ids=aids,
+                                        block_tables=bts)
+            key = jax.random.fold_in(block_key, i)
+            nxt = _sample_rows(logits, temps, topks, topps, key)
+            return (cache, nxt), nxt
+
+        (cache, _), out = jax.lax.scan(
+            body, (cache, toks), jnp.arange(k_tokens))
+        return cache, out
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_extend(self, params, cache, idx, bts, chunk, aids=None):
+        cache = set_cache_index(cache, idx)
+        return extend_core(self.pmodel, params, cache, chunk,
+                           adapter_ids=aids, block_tables=bts)[0]
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _paged_decode_logits(self, params, cache, idx, bts, toks,
+                             aids=None):
+        cache = set_cache_index(cache, idx)
+        return decode_core(self.pmodel, params, cache, toks,
+                           adapter_ids=aids, block_tables=bts)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _spec_verify(self, params, cache, idx, bts, chunk, aids=None):
+        """Speculative verify: ONE extend over the static
+        ``(slots, spec_gamma+1)`` chunk ``[x0, d1..d_gamma]``.
+        ``logits[:, j]`` scores the token after ``chunk[:, :j+1]``, so
+        the row-wise argmax is the target's own greedy continuation at
+        every draft position — the host keeps each row's longest
+        matching prefix plus the token at the first divergence. The
+        argmax epilogue stays in-jit (shipping (slots, G, V) logits to
+        the host every dispatch would swamp the win) and is also what
+        pins ``speculate=True`` to greedy exactness: there is no
+        sampled verify."""
+        cache = set_cache_index(cache, idx)
+        cache, logits = extend_core(self.pmodel, params, cache, chunk,
+                                    adapter_ids=aids, block_tables=bts)
+        return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _pack_pages(self, pool, small, page_map):
+        """Scatter a dense-prefilled admission cache into the page pool:
+        row j's (max_seq,) K/V reshapes into (n_bt, page_size) pages and
+        lands at pages ``page_map[j]`` (pad rows map to the sink). One
+        compile per admitted-rows bucket; 'index' leaves pass through —
+        they are host-injected at every dispatch."""
+        dense = {tuple(k.key for k in p): v for p, v
+                 in jax.tree_util.tree_flatten_with_path(small)[0]}
+
+        def pack(path, leaf):
+            name = path[-1].key
+            if not name.endswith("_pages"):
+                return leaf
+            src = dense[tuple(k.key for k in path[:-1])
+                        + (name[:-len("_pages")],)]
+            r = src.reshape(src.shape[0], -1, self.page_size,
+                            *src.shape[2:])
+            return leaf.at[page_map].set(r)
+
+        return jax.tree_util.tree_map_with_path(pack, pool)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _copy_page(self, pool, src, dst):
+        """Duplicate ONE page across every layer's pool — the
+        copy-on-write behind prefix sharing (a partial tail page gets
+        written by its row, so sharers take a private copy). src/dst
+        trace: every copy reuses one compiled program."""
+        return jax.tree_util.tree_map_with_path(
+            lambda p, x: (x.at[dst].set(x[src])
+                          if str(getattr(p[-1], "key", "")
+                                 ).endswith("_pages") else x),
+            pool)
+
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def _restore_pages(self, pool, host, page_idx):
+        """Tier swap-in scatter: host-gathered page rows (a dict keyed
+        by "/"-joined leaf paths, each ``(n, page_size, ...)``) land at
+        pages ``page_idx`` across every ``*_pages`` pool leaf in ONE
+        dispatch — jit turns the host dict into a single batched
+        device_put + scatter. ``n`` is pow2-bucketed by the caller; pad
+        rows carry zeros and target the sink page 0 (which absorbs junk
+        writes by design), so one compile serves every chain length in
+        a bucket."""
+        def put(path, leaf):
+            if not str(getattr(path[-1], "key", "")).endswith("_pages"):
+                return leaf
+            key = "/".join(str(getattr(k, "key", k)) for k in path)
+            return leaf.at[page_idx].set(host[key])
+
+        return jax.tree_util.tree_map_with_path(put, pool)
+
+    # --- traced-argument helpers ----------------------------------------
+
+    def _aid_arg(self, n: int, adapter: int):
+        """(n,)-row adapter-id array for a single request's device call —
+        None when the model carries no adapter stacks (exact pre-multi-
+        LoRA program signatures)."""
+        if self.n_adapters is None:
+            return None
+        return jnp.full((n,), adapter, jnp.int32)
+
+    def _hit_aids(self, r0: int, adapter: int):
+        """(slots,) adapter ids for a batch-wide hit-admission call:
+        row r0 uses the request's adapter, other rows keep their live
+        values (their output is discarded and their writes are sinked,
+        so any valid id works)."""
+        if self.n_adapters is None:
+            return None
+        a = self._aids.copy()
+        a[r0] = adapter
+        return jnp.asarray(a)
+
+    def _decode_mfu(self, tokens: int, dt: float) -> "float | None":
+        """Modeled MFU of one decode dispatch: emitted tokens × modeled
+        flops/token over measured wall time, against the device peak.
+        None when the peak is unknown (CPU stand-in) or dt is zero."""
+        if self._peak_flops is None or dt <= 0:
+            return None
+        return tokens * self._decode_flops_per_tok / dt / self._peak_flops
+
+    def _record_backend_failure(self) -> None:
+        if self.breaker is not None:
+            self.breaker.record_failure()

@@ -1,0 +1,1032 @@
+"""Scheduler: admission control, chunked prefill budgeting, and the
+continuous-batching policy of the decomposed engine (docs/DISAGG.md).
+
+Owns the request lifecycle — packing/validation, backpressure, the
+pending queue, chunked admission, slot activation, deadlines, and
+completion — and dispatches device work through the model runner
+(serve/runner.py) against KV state owned by the page manager
+(serve/kv_manager.py). ``GenerateEngine`` composes the three as mixins
+over one shared ``self``; behavior is pinned by the pre-split
+bit-exactness suites."""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from k3stpu.models.generate import set_cache_index
+from k3stpu.serve.containment import CircuitOpen
+from k3stpu.serve.programs import prompt_width_bucket
+from k3stpu.serve.runner import _pow2_at_least
+
+
+class EngineOverloaded(RuntimeError):
+    """Raised by submit paths when max_pending requests are already in
+    flight — the backpressure signal the HTTP layer turns into a 503
+    (shed load at the door; queueing unboundedly just converts overload
+    into client timeouts plus held memory)."""
+
+
+class _Request:
+    __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
+                 "eos", "event", "tokens", "error", "slot_rows", "samples",
+                 "deadline", "stream_q", "_ptuple", "probe", "adapter",
+                 "trace", "trace_id", "session")
+
+    def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
+                 top_p=None, adapter=0):
+        self.block = block          # (n, P) int32, right-padded
+        self.lens = lens            # (n,) true lengths
+        self.budget = budget        # max new tokens (shared by the rows)
+        self.temp = temp
+        self.top_k = top_k
+        self.top_p = top_p          # float | None (None == 1.0, no cut)
+        self.eos = eos              # int | None
+        self.samples = samples      # >1: one prompt, n sampled rows
+        self.adapter = adapter      # multi-LoRA slot (0 = base)
+        self.event = threading.Event()
+        self.tokens: "list[list[int]] | None" = None
+        self.error: "Exception | None" = None
+        self.slot_rows: "list[int]" = []
+        self.deadline: float = float("inf")  # set by _enqueue_and_wait
+        # submit_stream() installs a queue here; the loop thread pushes
+        # per-block token deltas into it and signal() pushes the terminal
+        # None. Non-streaming requests leave it None (zero overhead).
+        self.stream_q: "queue.SimpleQueue | None" = None
+        self._ptuple: "tuple | None" = None  # memoized prompt key
+        # Lifecycle trace (k3stpu.obs.ReqTrace), set at enqueue when the
+        # engine carries a ServeObs; None costs nothing on any path.
+        self.trace = None
+        # W3C trace id (32 validated lowercase-hex chars) assigned at
+        # the HTTP edge; None for direct submits. Only parse_traceparent
+        # output ever lands here — raw header bytes never reach the
+        # engine.
+        self.trace_id: "str | None" = None
+        # Memoized prompt-cache probe result (pkey, pentry) — the probe
+        # re-runs every loop iteration while the request waits for free
+        # slots, and re-scanning the cache each time is pure engine-
+        # thread waste. A stale entry stays CORRECT (immutable arrays);
+        # the only cost is missing a better prefix inserted meanwhile.
+        self.probe: "tuple | None" = None
+        # Session id (paged mode): names this request's finished KV
+        # chain in the prompt cache / host tier so the session's next
+        # turn restores it instead of re-prefilling. None = one-shot.
+        self.session: "str | None" = None
+
+    def ptuple(self) -> tuple:
+        """The single-prompt cache key, computed once — the admission
+        probe re-runs while a request waits for free slots, and an
+        O(prompt) conversion per loop iteration on the engine thread
+        is waste (the block is immutable after packing)."""
+        if self._ptuple is None:
+            self._ptuple = tuple(
+                int(t) for t in self.block[0, :int(self.lens[0])])
+        return self._ptuple
+
+    def signal(self) -> None:
+        """Wake the submitter on EVERY terminal path (tokens ready, error,
+        expiry, shutdown): terminal stream marker first, THEN the event —
+        a streaming consumer must never wait on a queue nobody will feed
+        again. Being the single terminal funnel, this is also where the
+        lifecycle trace retires (finish() is idempotent — the success
+        path already closed it with completion timings)."""
+        if self.trace is not None:
+            if self.error is not None:
+                self.trace.finish("error", repr(self.error))
+            else:
+                self.trace.finish("ok")
+        if self.stream_q is not None:
+            self.stream_q.put(None)
+        self.event.set()
+
+
+class _TierCommand:
+    """A control message riding the request queue: allocator / prompt
+    cache / tier state belongs to the loop thread alone, so HTTP-thread
+    operations on it (session release, disagg KV export/import) marshal
+    through ``_q`` and run inline at drain. Duck-types the slice of
+    ``_Request`` the loop's shutdown tail touches (``error`` +
+    ``signal()`` + ``deadline``) so a command stranded behind the close
+    sentinel fails cleanly instead of hanging its caller."""
+
+    __slots__ = ("kind", "session", "spill", "event", "result", "error",
+                 "deadline", "tokens", "stream_q", "trace", "payload")
+
+    def __init__(self, kind: str, session: str, spill: bool = False,
+                 payload=None):
+        self.kind = kind
+        self.session = session
+        self.spill = spill
+        self.payload = payload  # export: (prompt, adapter); import: bytes
+        self.event = threading.Event()
+        self.result = None
+        self.error: "Exception | None" = None
+        self.deadline = float("inf")  # commands never expire
+        self.tokens = None
+        self.stream_q = None
+        self.trace = None
+
+    def signal(self) -> None:
+        self.event.set()
+
+
+class SchedulerMixin:
+    """Admission, backpressure, chunked prefill, slot activation, and
+    completion. Owns no state of its own — ``self`` is the composed
+    ``GenerateEngine``."""
+
+    # --- client API -----------------------------------------------------
+
+    def _packed_request(self, prompts, max_new_tokens, temperature, top_k,
+                        eos_id, samples=1, top_p=None,
+                        adapter_id=0) -> "_Request":
+        """Shared validation + packing for both entry points: right-pad to
+        a pow2 width bucket and bound against the cache."""
+        adapter_id = int(adapter_id)
+        if adapter_id != 0 and self.n_adapters is None:
+            raise ValueError("this engine's model has no adapter stacks "
+                             "(multi_lora is off); adapter_id must be 0")
+        if self.n_adapters is not None \
+                and not 0 <= adapter_id < self.n_adapters:
+            raise ValueError(f"adapter_id {adapter_id} outside "
+                             f"[0, {self.n_adapters})")
+        lens = [len(p) for p in prompts]
+        if min(lens) == 0:
+            raise ValueError("prompts must be non-empty")
+        width = prompt_width_bucket(max(lens), self.max_seq)
+        if max(lens) > width or width + max_new_tokens > self.max_seq:
+            raise ValueError(
+                f"prompt {max(lens)} + budget {max_new_tokens} exceeds the "
+                f"cache ({self.max_seq})")
+        if self.paged:
+            # A request whose WORST-CASE page need (no cache sharing)
+            # exceeds the pool would wait in the queue forever — reject
+            # at the door instead of deadlocking admission.
+            ps = self.page_size
+            if samples > 1:
+                total = self._pages_for(lens[0], max_new_tokens)
+                worst = total + (samples - 1) * (total - lens[0] // ps)
+            else:
+                worst = sum(self._pages_for(l, max_new_tokens)
+                            for l in lens)
+            ins = 1 if (self.prompt_cache > 0 and len(prompts) == 1) else 0
+            if worst + ins > self._alloc.total:
+                raise ValueError(
+                    f"request needs up to {worst + ins} pages but the "
+                    f"pool has {self._alloc.total} usable — raise "
+                    f"num_pages or shrink prompt/budget")
+        block = np.zeros((len(prompts), width), np.int32)
+        for i, p in enumerate(prompts):
+            block[i, :len(p)] = p
+        return _Request(block, np.asarray(lens, np.int32), max_new_tokens,
+                        float(temperature), top_k, eos_id, samples=samples,
+                        top_p=top_p, adapter=adapter_id)
+
+    def _reject_if_full_locked(self) -> None:
+        """Caller holds self._lock. Raises EngineOverloaded (counted in
+        the rejected stat) when max_pending is exhausted."""
+        if (self.max_pending is not None
+                and self._inflight >= self.max_pending):
+            self._stats["rejected"] += 1
+            raise EngineOverloaded(
+                f"engine at capacity: {self._inflight} requests in "
+                f"flight (max_pending={self.max_pending})")
+
+    def _breaker_gate(self) -> bool:
+        """Circuit-breaker admission gate. Returns True when this caller
+        holds the half-open probe lease; raises CircuitOpen (counted in
+        breaker_rejected) when the breaker refuses traffic."""
+        br = self.breaker
+        if br is None:
+            return False
+        admitted, probe = br.allow()
+        if not admitted:
+            retry = br.retry_after_s()
+            with self._lock:
+                self._stats["breaker_rejected"] += 1
+            raise CircuitOpen(
+                f"circuit breaker open after repeated backend failures; "
+                f"retry in {retry:.1f}s", retry_after_s=retry)
+        return probe
+
+    def take_admission_token(self) -> None:
+        """Claim one unit of max_pending or raise EngineOverloaded.
+        Callers that split ONE logical request into several chunk
+        submits (the server's wider-than-slots path) take ONE token for
+        the whole request and pass ``admitted=True`` to the submits —
+        re-gating per chunk would reject an already-admitted request
+        mid-flight after burning its earlier chunks' decode work."""
+        probe = self._breaker_gate()
+        try:
+            with self._lock:
+                self._reject_if_full_locked()
+                self._inflight += 1
+        except EngineOverloaded:
+            if probe:
+                # The half-open probe lost the capacity race before
+                # reaching the backend — return the lease so the next
+                # arrival can probe instead of waiting out the window.
+                self.breaker.probe_aborted()
+            raise
+
+    def release_admission_token(self) -> None:
+        with self._lock:
+            self._inflight -= 1
+
+    def at_capacity(self) -> bool:
+        """Advisory (racy by nature): lets the HTTP layer 503 BEFORE
+        committing response headers; the authoritative check is the
+        token take in the submit paths."""
+        with self._lock:
+            return (self.max_pending is not None
+                    and self._inflight >= self.max_pending)
+
+    def reject_if_at_capacity(self) -> None:
+        """Advisory shed WITHOUT claiming a token: raises
+        EngineOverloaded (counted in the rejected stat, same as an
+        authoritative take failure) when at capacity. For callers that
+        must 503 before response headers but defer the real token take
+        until their generator actually starts."""
+        br = self.breaker
+        if br is not None and br.state() == "open":
+            retry = br.retry_after_s()
+            with self._lock:
+                self._stats["breaker_rejected"] += 1
+            raise CircuitOpen(
+                f"circuit breaker open after repeated backend failures; "
+                f"retry in {retry:.1f}s", retry_after_s=retry)
+        with self._lock:
+            self._reject_if_full_locked()
+
+    def _trace_enqueue(self, req: "_Request", stream: bool = False) -> None:
+        """Open the request's lifecycle trace at ingress (submitter
+        thread, just before the queue put — so queue wait is measured
+        from the moment the loop COULD have seen the request)."""
+        if self._obs is not None:
+            req.trace = self._obs.start_trace(
+                trace_id=req.trace_id,
+                rows=int(req.samples if req.samples > 1
+                         else req.block.shape[0]),
+                prompt_len=int(max(req.lens)), budget=int(req.budget),
+                stream=stream, adapter=int(req.adapter))
+
+    def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
+                          admitted: bool = False) -> "list[list[int]]":
+        # The loop thread enforces the same deadline: a request whose
+        # client gave up is dropped from the queue / its slots freed,
+        # instead of decoding its full budget for nobody.
+        if not admitted:
+            self.take_admission_token()
+        try:
+            req.deadline = time.time() + timeout_s
+            self._trace_enqueue(req)
+            # Waiter registry: the watchdog fails everyone in this set
+            # with a retryable error when the loop stalls or dies, so a
+            # client blocks for at most ~watchdog_s, never timeout_s.
+            with self._lock:
+                self._waiters.add(req)
+            try:
+                self._q.put(req)
+                if not req.event.wait(timeout_s + 1.0):
+                    raise TimeoutError("generation did not finish in time")
+                if req.error is not None:
+                    raise req.error
+                return req.tokens
+            finally:
+                with self._lock:
+                    self._waiters.discard(req)
+        finally:
+            if not admitted:
+                self.release_admission_token()
+
+    def submit(self, prompts: "list[list[int]]", *, max_new_tokens: int,
+               temperature: float = 0.0, top_k: "int | None" = None,
+               top_p: "float | None" = None,
+               eos_id: "int | None" = None, adapter_id: int = 0,
+               timeout_s: float = 600.0, admitted: bool = False,
+               trace_id: "str | None" = None,
+               session: "str | None" = None) -> "list[list[int]]":
+        """Blocking: returns (n, max_new_tokens) token lists.
+        ``admitted``: the caller already holds an admission token
+        covering this submit (see take_admission_token).
+        ``trace_id``: validated W3C trace id for the lifecycle trace.
+        ``session``: single-prompt only — names the request's finished
+        KV chain so the session's next turn (a prompt extending this
+        one's prompt + reply) restores it instead of re-prefilling,
+        and so ``release_session`` can park it on the host tier."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        n = len(prompts)
+        if n == 0 or n > self.slots:
+            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        if session is not None and n != 1:
+            raise ValueError("session requires exactly one prompt "
+                             "(a session names ONE chain)")
+        req = self._packed_request(prompts, max_new_tokens, temperature,
+                                   top_k, eos_id, top_p=top_p,
+                                   adapter_id=adapter_id)
+        req.trace_id = trace_id
+        req.session = session
+        return self._enqueue_and_wait(req, timeout_s, admitted)
+
+    def submit_samples(self, prompt: "list[int]", n: int, *,
+                       max_new_tokens: int, temperature: float = 1.0,
+                       top_k: "int | None" = None,
+                       top_p: "float | None" = None,
+                       eos_id: "int | None" = None, adapter_id: int = 0,
+                       timeout_s: float = 600.0, admitted: bool = False,
+                       trace_id: "str | None" = None) -> "list[list[int]]":
+        """n sampled continuations of ONE prompt for the price of one
+        prefill: the prefilled cache row broadcasts across n slots and the
+        rows diverge through per-row sampling noise. (With temperature 0
+        all rows are the same greedy continuation — use submit().)"""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        if not 1 <= n <= self.slots:
+            raise ValueError(f"need 1..{self.slots} samples, got {n}")
+        req = self._packed_request([prompt], max_new_tokens, temperature,
+                                   top_k, eos_id, samples=n, top_p=top_p,
+                                   adapter_id=adapter_id)
+        req.trace_id = trace_id
+        return self._enqueue_and_wait(req, timeout_s, admitted)
+
+    def submit_stream(self, prompts: "list[list[int]]", *,
+                      max_new_tokens: int, temperature: float = 0.0,
+                      top_k: "int | None" = None,
+                      top_p: "float | None" = None,
+                      eos_id: "int | None" = None, adapter_id: int = 0,
+                      timeout_s: float = 600.0, admitted: bool = False,
+                      trace_id: "str | None" = None,
+                      session: "str | None" = None):
+        """Streaming submit(): returns an iterator of events.
+
+        Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
+        — one per decode dispatch that produced tokens for this request
+        (granularity = ``decode_block``; the first event carries each
+        row's first token straight off the prefill logits, so
+        time-to-first-token is prefill latency). The final event is
+        ``{"done": True, "tokens": [[...]]}`` with exactly submit()'s
+        return value (greedy exactness stays pinned to ``generate()``).
+        Rows that hit eos stop producing deltas; the final tokens are
+        eos-extended to the budget like submit()'s. Errors (deadline
+        expiry, decode failure, shutdown) raise from the iterator."""
+        if self._closed:
+            raise RuntimeError("engine is closed")
+        n = len(prompts)
+        if n == 0 or n > self.slots:
+            raise ValueError(f"need 1..{self.slots} prompts, got {n}")
+        if session is not None and n != 1:
+            raise ValueError("session requires exactly one prompt "
+                             "(a session names ONE chain)")
+        req = self._packed_request(prompts, max_new_tokens, temperature,
+                                   top_k, eos_id, top_p=top_p,
+                                   adapter_id=adapter_id)
+        req.trace_id = trace_id
+        req.session = session
+        req.stream_q = queue.SimpleQueue()
+        return self._stream_events(req, timeout_s, admitted)
+
+    def _stream_events(self, req: "_Request", timeout_s: float,
+                       admitted: bool = False):
+        # Same deadline contract as _enqueue_and_wait: the loop thread
+        # drops expired requests; this consumer gets the terminal marker
+        # and raises the TimeoutError the loop recorded. The admission
+        # token spans the generator's life — taken at first next() (no
+        # iteration, no enqueue, no token), released in the finally.
+        if not admitted:
+            self.take_admission_token()
+        try:
+            yield from self._stream_events_inner(req, timeout_s)
+        finally:
+            if not admitted:
+                self.release_admission_token()
+
+    def _stream_events_inner(self, req: "_Request", timeout_s: float):
+        req.deadline = time.time() + timeout_s
+        self._trace_enqueue(req, stream=True)
+        with self._lock:
+            self._waiters.add(req)
+        self._q.put(req)
+        hard = req.deadline + 1.0
+        try:
+            while True:
+                try:
+                    item = req.stream_q.get(
+                        timeout=max(0.0, hard - time.time()))
+                except queue.Empty:
+                    raise TimeoutError("generation did not finish in time")
+                if item is None:  # terminal: tokens ready or error
+                    if req.error is not None:
+                        raise req.error
+                    yield {"done": True, "tokens": req.tokens}
+                    return
+                yield {"done": False, "rows": item}
+        finally:
+            with self._lock:
+                self._waiters.discard(req)
+            # Consumer abandoned the stream (generator .close() on client
+            # disconnect, or an exception in the consumer): expire the
+            # request NOW so the loop reaps its queue entry / admission /
+            # slots next iteration, instead of decoding the rest of the
+            # budget for nobody.
+            if req.tokens is None and req.error is None:
+                req.deadline = 0.0
+
+    # --- admission (loop thread; owns all slot state) -------------------
+
+    def _free_slots(self) -> "list[int]":
+        # A row that finished EARLY (eos) while its multi-row request is
+        # still decoding stays owned: its collected tokens feed
+        # _maybe_complete, so handing the slot to a new request would
+        # clobber them (the stranger's tokens would surface in the
+        # finished request's result, and the completion bookkeeping of
+        # whichever finishes second corrupts the other's). Owner clears
+        # at completion/failure — only then is the slot reusable.
+        return [i for i in range(self.slots)
+                if not self._active[i] and not self._reserved[i]
+                and self._owner[i] is None]
+
+    def _drain_queue(self, block: bool) -> bool:
+        """Move queued requests into pending. Returns False on shutdown.
+        Tier commands (session release, KV export/import) execute INLINE
+        here — they are loop-thread state operations, not admissions, so
+        they never enter the pending list or compete with requests for
+        slots."""
+        try:
+            timeout = 0.2 if block else 0.0
+            while True:
+                req = self._q.get(block=block, timeout=timeout)
+                if req is None:
+                    return False
+                if isinstance(req, _TierCommand):
+                    self._exec_tier_command(req)
+                else:
+                    self._pending.append(req)
+                block = False  # only the first get may wait
+        except queue.Empty:
+            return True
+
+    def _admit(self) -> None:
+        """Admit pending requests. Chunked admissions advance ONE chunk
+        per call, so an arriving long prompt delays in-flight decode by at
+        most one chunk's latency, never the whole prefill. While a
+        chunked admission is in flight, ONE short (single-shot) request
+        may still slip in per call — no head-of-line blocking behind a
+        long prefill when free slots exist."""
+        if self._adm is not None:
+            self._admission_step()
+            self._admit_pending(allow_chunked=False, limit=1)
+            return
+        self._admit_pending(allow_chunked=True)
+
+    def _admit_pending(self, *, allow_chunked: bool,
+                       limit: "int | None" = None) -> None:
+        admitted = 0
+        i = 0
+        while i < len(self._pending) and (limit is None
+                                          or admitted < limit):
+            req = self._pending[i]
+            # The pow2 bucket is the admission unit: bucket rows beyond n
+            # also land in free slots (they must not overwrite live rows),
+            # so the fit check runs on nb BEFORE any device work.
+            n, width = req.block.shape
+            n_rows = req.samples if req.samples > 1 else n
+            nb = min(_pow2_at_least(n_rows), self.slots)
+            c = self.chunk_prefill
+            # Prompt-cache probe (single-prompt requests): an exact hit
+            # skips the prefill outright; a prefix hit appends only the
+            # suffix — IF that suffix honors the same stall bound a
+            # chunked prefill enforces and fits the cache depth.
+            prompt = pkey = pentry = None
+            if self.prompt_cache > 0 and n == 1:
+                prompt = req.ptuple()
+                if req.probe is None:
+                    pkey, pentry = self._pcache_lookup(prompt, req.adapter)
+                    if self._tier is not None:
+                        # Tier probe BEFORE declaring a pcache miss: a
+                        # host-resident chain longer than the best
+                        # device-resident prefix swaps in and the
+                        # lookup re-runs — the restored entry then
+                        # serves this admission exactly like one that
+                        # never left HBM. A failed swap-in already
+                        # counted its fallback; the request just
+                        # proceeds with whatever the pcache had.
+                        tkey = self._tier.match(req.adapter, prompt)
+                        with self._lock:
+                            self._stats["tier_hits" if tkey is not None
+                                        else "tier_misses"] += 1
+                        if self._obs is not None:
+                            self._obs.on_tier_probe(tkey is not None)
+                        if (tkey is not None
+                                and (pkey is None
+                                     or len(tkey[1]) > len(pkey))
+                                and self._tier_swap_in(tkey)):
+                            if req.trace is not None:
+                                req.trace.event(
+                                    "tier_swap_in",
+                                    {"cached_len": len(tkey[1])})
+                            pkey, pentry = self._pcache_lookup(
+                                prompt, req.adapter)
+                    if pkey is not None and len(pkey) < len(prompt):
+                        g = _pow2_at_least(len(prompt) - len(pkey))
+                        if (len(pkey) + g > self.max_seq
+                                or (c is not None and g > c)):
+                            pkey = pentry = None  # suffix too big
+                    req.probe = (pkey, pentry)
+                pkey, pentry = req.probe
+            chunked = c is not None and width > c and pkey is None
+            if chunked and not allow_chunked:
+                i += 1  # long prompts wait for the in-flight one
+                continue
+            free = self._free_slots()
+            if len(free) < nb:
+                return  # strict FIFO on capacity: big requests don't starve
+            if self.paged:
+                need = self._pages_needed(req, pkey)
+                # Pinned prompt-cache pages are reclaimable HBM: evict
+                # idle entries (LRU) until the request fits — but never
+                # the entry THIS request is about to share (evicting it
+                # would cost more fresh pages than it frees).
+                while need > self._alloc.free and self._pcache:
+                    lru = next(iter(self._pcache))
+                    if pkey is not None and lru == (req.adapter, pkey):
+                        if len(self._pcache) == 1:
+                            break
+                        self._pcache[lru] = self._pcache.pop(lru)  # MRU
+                        continue
+                    freed = self._pcache_evict_lru()
+                    with self._lock:
+                        self._stats["pcache_bytes"] -= freed
+                if need > self._alloc.free:
+                    return  # strict FIFO: decodes must free pages first
+            self._pending.pop(i)
+            admitted += 1
+            tr = req.trace
+            if self._obs is not None:
+                wait = (time.perf_counter() - tr.t_enqueue
+                        if tr is not None and tr.t_enqueue is not None
+                        else 0.0)
+                self._obs.on_admit(tr, wait, slots=nb)
+            if pkey is not None:
+                exact = len(pkey) == len(prompt)
+                with self._lock:
+                    self._stats["pcache_hits" if exact
+                                else "pcache_prefix_hits"] += 1
+                if tr is not None:
+                    tr.event("pcache_hit" if exact else "pcache_prefix_hit",
+                             {"cached_len": len(pkey)})
+                try:
+                    if self.paged:
+                        self._admit_hit_paged(req, free[:nb], n_rows,
+                                              prompt, pkey, pentry)
+                        continue
+                    if exact:
+                        small, last = pentry[0], pentry[1]
+                    else:
+                        small, last = self._pcache_extend(
+                            pentry[0], prompt, len(pkey), req.adapter)
+                        self._pcache_insert(prompt, small, last,
+                                            req.adapter)
+                    if req.samples > 1:
+                        small, last = self._broadcast_rows(small, last, nb)
+                    self._activate(req, free[:nb], n_rows, small, last)
+                except Exception as e:  # noqa: BLE001 — fail the one request
+                    self._record_backend_failure()
+                    req.error = e
+                    req.signal()
+                continue
+            if prompt is not None:
+                with self._lock:
+                    self._stats["pcache_misses"] += 1
+                if tr is not None:
+                    tr.event("pcache_miss")
+            if req.samples > 1:
+                # Shared-prefix fan-out: prefill the ONE prompt row; the
+                # broadcast to nb rows happens at activation/finalize.
+                block, lens = req.block, req.lens
+            else:
+                block = np.zeros((nb, width), np.int32)
+                block[:n] = req.block
+                lens = np.concatenate(
+                    [req.lens, np.ones((nb - n,), np.int32)])
+            all_rows = free[:nb]
+            if chunked:
+                # Start a chunked admission: reserve the slots (and, in
+                # paged mode, the page chains — a later admission must
+                # not steal pages this one's finalize counts on), run
+                # the first chunk, and let subsequent loop iterations
+                # (with decode steps in between) carry the rest.
+                chains = None
+                try:
+                    if self.paged:
+                        chains = self._alloc_request_chains(
+                            req, nb, n_rows, lens)
+                    small, _ = self._prefill(
+                        self.params, jnp.asarray(block[:, :c]),
+                        jnp.full((block.shape[0],), c, jnp.int32),
+                        self._aid_arg(block.shape[0], req.adapter))
+                except Exception as e:  # noqa: BLE001
+                    self._record_backend_failure()
+                    self._free_chains(chains)
+                    req.error = e
+                    req.signal()
+                    continue
+                for r in all_rows:
+                    self._reserved[r] = True
+                self._adm = {"req": req, "cache": small, "block": block,
+                             "lens": lens, "pos": c, "rows": all_rows,
+                             "n": n_rows, "chains": chains}
+                with self._lock:
+                    self._stats["adm_chunks"] += 1
+                if tr is not None:
+                    tr.event("prefill_chunk", {"pos": c, "of": width})
+                return
+            chains = None
+            handed = False
+            try:
+                if self.paged:
+                    chains = self._alloc_request_chains(req, nb, n_rows,
+                                                        lens)
+                small, last = self._prefill(
+                    self.params, jnp.asarray(block), jnp.asarray(lens),
+                    self._aid_arg(block.shape[0], req.adapter))
+                if prompt is not None and not self.paged:
+                    # 1-row, pre-broadcast state; the paged engine
+                    # inserts AFTER packing (zero-copy page pins).
+                    self._pcache_insert(prompt, small, last, req.adapter)
+                if req.samples > 1 and not self.paged:
+                    small, last = self._broadcast_rows(small, last, nb)
+                handed = True
+                self._activate(req, all_rows, n_rows, small, last,
+                               chains=chains,
+                               pinsert=prompt if self.paged else None)
+            except Exception as e:  # noqa: BLE001 — fail the one request
+                self._record_backend_failure()
+                if not handed:
+                    self._free_chains(chains)
+                req.error = e
+                req.signal()
+                continue
+
+    def _admission_step(self) -> None:
+        """One chunk of the in-flight admission (or its finalize)."""
+        a = self._adm
+        req, c = a["req"], self.chunk_prefill
+        width = a["block"].shape[1]
+        try:
+            if a["pos"] < width:
+                end = min(a["pos"] + c, width)
+                a["cache"] = self._extend_chunk(
+                    self.params, a["cache"],
+                    jnp.asarray(a["block"][:, a["pos"]:end]),
+                    self._aid_arg(a["block"].shape[0], req.adapter))
+                a["pos"] = end
+                with self._lock:
+                    self._stats["adm_chunks"] += 1
+                if req.trace is not None:
+                    req.trace.event("prefill_chunk",
+                                    {"pos": end, "of": width})
+                return
+            # Finalize: every row consumed the padded width (short rows
+            # carry junk K/V beyond their length). Reset each row's index
+            # to len-1 (free rollback: junk becomes invisible) and decode
+            # the row's LAST REAL token — recomputing its K/V in place and
+            # yielding the exact first-token logits; index lands on len,
+            # the engine's steady-state invariant.
+            lens = a["lens"]
+            cache = set_cache_index(a["cache"],
+                                    jnp.asarray(lens - 1, jnp.int32))
+            last_toks = a["block"][np.arange(len(lens)), lens - 1]
+            cache, last = self._decode_logits(
+                self.params, cache, jnp.asarray(last_toks),
+                self._aid_arg(len(lens), req.adapter))
+            pinsert = None
+            if self.prompt_cache > 0 and a["block"].shape[0] == 1:
+                # a["block"] row 0 == req.block row 0 by construction
+                # (both admission paths copy it verbatim), so the
+                # memoized key is THE key.
+                if self.paged:
+                    pinsert = a["req"].ptuple()
+                else:
+                    self._pcache_insert(a["req"].ptuple(), cache, last,
+                                        req.adapter)
+            if req.samples > 1 and not self.paged:
+                cache, last = self._broadcast_rows(cache, last,
+                                                   len(a["rows"]))
+            for r in a["rows"]:
+                self._reserved[r] = False
+            # Chain ownership hands to _activate here: an abort after
+            # this point must not double-free what the rows now hold.
+            chains, a["chains"] = a.get("chains"), None
+            self._adm = None
+            self._activate(req, a["rows"], a["n"], cache, last,
+                           chains=chains, pinsert=pinsert)
+        except Exception as e:  # noqa: BLE001 — fail the one request
+            self._record_backend_failure()
+            self._abort_admission(a, e)
+
+    def _abort_admission(self, a: dict, err: Exception) -> None:
+        """The one admission-abort path: release the reserved rows, null
+        the in-flight record, and fail its request — in that order, so no
+        exit leaves rows reserved for a request nobody is waiting on.
+        Takes the record explicitly (NOT via self._adm): the finalize
+        branch nulls self._adm before _activate, so an _activate failure
+        must still reach the record it was admitting."""
+        self._adm = None
+        if self.paged:
+            self._free_chains(a.get("chains"))
+            a["chains"] = None
+        for r in a["rows"]:
+            self._reserved[r] = False
+        a["req"].error = err
+        a["req"].signal()
+
+    def _activate(self, req, all_rows, n, small_cache, last_logits,
+                  chains=None, pinsert=None) -> None:
+        """Install an admitted small cache into the slot block and light
+        up the rows (shared tail of both admission paths). Dense engines
+        scatter into the monolithic cache; paged engines pack the rows
+        into their preallocated page ``chains`` and, when ``pinsert``
+        names a prompt, pin the packed pages into the prompt cache
+        (zero-copy: full pages shared by incref, tail page copied)."""
+        if self.paged:
+            last_logits = self._install_paged(req, all_rows, n,
+                                              small_cache, last_logits,
+                                              chains, pinsert)
+        else:
+            self._cache = self._scatter(
+                self._cache, small_cache, jnp.asarray(all_rows, np.int32))
+        self._light_up(req, all_rows, n, last_logits)
+
+    def _install_paged(self, req, all_rows, n, small_cache, last_logits,
+                       chains, pinsert):
+        """Pack a dense-prefilled admission cache into the rows' page
+        chains. samples>1 packs the ONE prompt row and fans it out
+        zero-copy: siblings share row 0's full prompt pages (incref) +
+        a COW'd tail + their own fresh budget pages — no n-way prompt
+        replication in HBM. Returns the (possibly fanned-out)
+        first-token logits."""
+        ps = self.page_size
+        nb = len(all_rows)
+        if req.samples > 1:
+            L = int(req.lens[0])
+            chain0 = chains[0]
+            pm = np.zeros((1, self.n_bt), np.int32)
+            pm[0, :len(chain0)] = chain0
+            self._cache = self._pack_pages(self._cache, small_cache,
+                                           jnp.asarray(pm))
+            full = L // ps
+            row_chains = [chain0]
+            for j in range(1, n):
+                fresh = chains[j]
+                self._alloc.incref(chain0[:full])
+                if L % ps:
+                    self._cache = self._copy_page(self._cache,
+                                                  chain0[full], fresh[0])
+                row_chains.append(chain0[:full] + fresh)
+            row_lens = [L] * n
+        else:
+            pm = np.zeros((nb, self.n_bt), np.int32)
+            for j in range(n):
+                pm[j, :len(chains[j])] = chains[j]
+            self._cache = self._pack_pages(self._cache, small_cache,
+                                           jnp.asarray(pm))
+            row_chains = chains[:n]
+            row_lens = [int(x) for x in req.lens]
+        if pinsert is not None:
+            # Pin row 0's prompt pages before its first decode write
+            # lands in the tail page (device ordering follows the
+            # self._cache data flow — the COW copy reads the packed,
+            # pre-decode state).
+            self._pcache_insert_paged(pinsert, row_chains[0],
+                                      last_logits[:1], req.adapter)
+        for j, r in enumerate(all_rows):
+            if j < n:
+                self._set_row(r, row_chains[j], row_lens[j])
+            else:  # pad rows: sink-page table, dense pad index of 1
+                self._set_row(r, [], 1)
+        if req.samples > 1:
+            last_logits = jnp.broadcast_to(
+                last_logits[:1], (nb, *last_logits.shape[1:]))
+        return last_logits
+
+    def _admit_hit_paged(self, req, all_rows, n, prompt, pkey,
+                         pentry) -> None:
+        """Prompt-cache admission without copying the cached prompt K/V:
+        every admitted row maps the entry's full pages read-only into
+        its block table (incref), copies the partial tail page (the row
+        WILL write into it: position L lives there), and takes fresh
+        pages for the rest. An exact hit does zero device attention
+        work. A prefix hit first materializes row 0 and appends the
+        uncached suffix batch-wide with every OTHER row's table pointed
+        at the sink page — live rows' pages can't be touched, and their
+        device indices are re-injected from the host mirror at the next
+        dispatch — then re-decodes the last real token for the exact
+        post-prefill logits and shares row 0 into the siblings."""
+        ps = self.page_size
+        chain0, l0, last0 = pentry[0], pentry[1], pentry[2]
+        L, B = len(prompt), req.budget
+        total = self._pages_for(L, B)
+
+        def build_row(src_chain, src_len):
+            sf = src_len // ps
+            fresh = self._alloc.alloc(total - sf)
+            if fresh is None:  # fit-checked; defensive
+                raise RuntimeError("page pool exhausted mid-admission")
+            self._alloc.incref(src_chain[:sf])
+            if src_len % ps:
+                self._cache = self._copy_page(self._cache,
+                                              src_chain[sf], fresh[0])
+            return list(src_chain[:sf]) + fresh
+
+        if l0 == L:  # exact hit: host bookkeeping + stored logits only
+            row_chains = [build_row(chain0, L) for _ in range(n)]
+            last = last0
+        else:
+            r0 = all_rows[0]
+            c0 = build_row(chain0, l0)
+            self._set_row(r0, c0, l0)
+            bts = np.zeros((self.slots, self.n_bt), np.int32)
+            bts[r0] = self._tables[r0]
+            idx = self._indices.copy()
+            extra = np.asarray(prompt[l0:], np.int32)
+            g = _pow2_at_least(len(extra))
+            chunk = np.zeros((self.slots, g), np.int32)
+            chunk[r0, :len(extra)] = extra
+            aids = self._hit_aids(r0, req.adapter)
+            self._cache = self._paged_extend(
+                self.params, self._cache, jnp.asarray(idx),
+                jnp.asarray(bts), jnp.asarray(chunk), aids)
+            # Roll back over the suffix pad junk and re-decode the last
+            # real token in place (the dense _pcache_extend invariant).
+            idx[r0] = L - 1
+            toks = np.zeros((self.slots,), np.int32)
+            toks[r0] = prompt[-1]
+            self._cache, logits = self._paged_decode_logits(
+                self.params, self._cache, jnp.asarray(idx),
+                jnp.asarray(bts), jnp.asarray(toks), aids)
+            last = logits[r0:r0 + 1]
+            self._pcache_insert_paged(prompt, c0, last, req.adapter)
+            row_chains = [c0] + [build_row(c0, L) for _ in range(1, n)]
+        nb = len(all_rows)
+        for j, r in enumerate(all_rows):
+            if j < n:
+                self._set_row(r, row_chains[j], L)
+            else:
+                self._set_row(r, [], 1)
+        if nb > 1:
+            last = jnp.broadcast_to(last[:1], (nb, *last.shape[1:]))
+        self._light_up(req, all_rows, n, last)
+
+    def _light_up(self, req, all_rows, n, last_logits) -> None:
+        """Shared activation tail: first-token sample + slot state."""
+        rows = all_rows[:n]
+        nb = len(all_rows)
+        temps = np.full((nb,), req.temp, np.float32)
+        topks = np.full(
+            (nb,), req.top_k if req.top_k else self.vocab, np.int32)
+        topps = np.full(
+            (nb,), 1.0 if req.top_p is None else req.top_p, np.float32)
+        self._step_counter += 1
+        first = np.asarray(self._first_sample(
+            last_logits, jnp.asarray(temps), jnp.asarray(topks),
+            jnp.asarray(topps), self._step_counter, self._base_key))
+        req.slot_rows = rows
+        for j, r in enumerate(rows):
+            self._active[r] = True
+            self._owner[r] = req
+            self._aids[r] = req.adapter
+            self._last_tok[r] = int(first[j])
+            self._left[r] = req.budget - 1
+            self._temps[r] = req.temp
+            self._topks[r] = req.top_k if req.top_k else self.vocab
+            self._topps[r] = 1.0 if req.top_p is None else req.top_p
+            self._eos[r] = -1 if req.eos is None else int(req.eos)
+            self._collected[r] = [int(first[j])]
+            if self.speculate:
+                # Drafting corpus: the row's real prompt (samples>1
+                # shares the one prompt row) + the first token; every
+                # emitted token appends, whichever path emitted it.
+                src = 0 if req.samples > 1 else j
+                self._spec_hist[r] = (
+                    req.block[src, :int(req.lens[src])].tolist()
+                    + [int(first[j])])
+                self._spec_depth[r] = self.spec_gamma
+        with self._lock:
+            self._stats["requests"] += 1
+            self._stats["tokens"] += len(rows)  # first sampled tokens
+        if self._obs is not None and req.trace is not None:
+            tr = req.trace
+            # TTFT from ENQUEUE (the client-visible clock: queue wait +
+            # prefill), not from admission.
+            t0 = tr.t_enqueue
+            ttft = time.perf_counter() - t0 if t0 is not None else 0.0
+            self._obs.on_first_token(tr, ttft)
+        if req.stream_q is not None:
+            # First token per row streams immediately — it came from the
+            # prefill's own logits, before any decode dispatch, so TTFT
+            # is prefill latency, not prefill + a decode block.
+            req.stream_q.put({j: [int(first[j])] for j in range(len(rows))})
+        # eos on the very first token / budget 1 finishes immediately.
+        for r in rows:
+            if (self._left[r] <= 0
+                    or (self._eos[r] >= 0
+                        and self._last_tok[r] == self._eos[r])):
+                self._finish_row(r)
+        self._maybe_complete(req)
+
+    def _finish_row(self, r: int) -> None:
+        self._active[r] = False
+        # Reset the slot's sampling temp: inactive rows still ride the
+        # decode batch, and one stale temp>0 would disable the all-greedy
+        # lax.cond fast path in _sample_rows for every later step until
+        # the slot is reused.
+        self._temps[r] = 0.0
+        if self.speculate:
+            self._spec_hist[r] = []  # corpus dies with the row
+        if self.paged:
+            # Session-end insert BEFORE the release below: the chain's
+            # pages must be pinned while the row still holds its refs,
+            # or the free list could hand them out in between.
+            req = self._owner[r]
+            if (req is not None and req.session is not None
+                    and req.samples == 1 and req.block.shape[0] == 1
+                    and self.prompt_cache > 0
+                    and self._collected[r]):
+                self._session_insert(req, r)
+            # Free the row's pages NOW, not at request completion: the
+            # zeroed table row sinks the slot's continued decode writes,
+            # and shared prompt pages just drop a refcount — so a long
+            # sibling can't hold a finished row's HBM hostage.
+            self._release_slot_pages(r)
+
+    def _fail_request(self, req: "_Request", err: Exception) -> None:
+        for r in req.slot_rows:
+            self._active[r] = False
+            self._temps[r] = 0.0  # keep the all-greedy fast path alive
+            self._owner[r] = None
+            self._collected[r] = []
+            if self.paged:
+                self._release_slot_pages(r)
+        req.error = err
+        req.signal()
+
+    def _expire_deadlines(self) -> None:
+        """Free resources of requests whose client stopped waiting."""
+        now = time.time()
+        n_expired = 0
+        expired = [r for r in self._pending if now > r.deadline]
+        for req in expired:
+            self._pending.remove(req)
+            req.error = TimeoutError("expired while queued")
+            req.signal()
+            n_expired += 1
+        # The in-flight chunked admission too: its client may have given
+        # up mid-prefill, and without this check the remaining chunks (and
+        # the whole decode budget) would still run for nobody.
+        if self._adm is not None and now > self._adm["req"].deadline:
+            self._abort_admission(self._adm,
+                                  TimeoutError("expired during admission"))
+            n_expired += 1
+        for req in {self._owner[r] for r in range(self.slots)
+                    if self._owner[r] is not None}:
+            if now > req.deadline:
+                self._fail_request(
+                    req, TimeoutError("expired while decoding"))
+                n_expired += 1
+        if n_expired:
+            with self._lock:
+                self._stats["deadline_expired"] += n_expired
+
+    def _maybe_complete(self, req: "_Request") -> None:
+        if any(self._active[r] for r in req.slot_rows):
+            return
+        pad_to = req.budget
+        if self._obs is not None and req.trace is not None:
+            tr = req.trace
+            now = time.perf_counter()
+            e2e = now - tr.t_enqueue if tr.t_enqueue is not None else 0.0
+            # Mean time per output token after the first, over the
+            # longest row (rows decode in lockstep, so the longest row's
+            # clock is the request's decode clock). Computed BEFORE the
+            # loop below clears the collected lists.
+            ntok = min(max((len(self._collected[r])
+                            for r in req.slot_rows), default=0), pad_to)
+            tpot = ((now - tr.t_first) / (ntok - 1)
+                    if tr.t_first is not None and ntok > 1 else None)
+            self._obs.on_complete(tr, e2e, tpot)
+        out = []
+        for r in req.slot_rows:
+            toks = self._collected[r][:pad_to]
+            toks += [toks[-1]] * (pad_to - len(toks))  # eos-extend
+            out.append(toks)
+            self._owner[r] = None
+            self._collected[r] = []
+            if self.paged:
+                self._release_slot_pages(r)  # no-op after _finish_row
+        req.tokens = out
+        req.signal()

@@ -274,6 +274,8 @@ class InferenceServer:
                  breaker_threshold: "int | None" = 5,
                  breaker_cooldown_s: float = 5.0,
                  instance: "str | None" = None,
+                 role: str = "monolithic",
+                 prefill_upstream: "str | None" = None,
                  chaos=None):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
@@ -295,6 +297,32 @@ class InferenceServer:
         import socket
 
         self.instance = instance or socket.gethostname()
+        # Disaggregated prefill/decode serving (docs/DISAGG.md). A
+        # prefill-role replica answers /v1/prefill with serialized KV
+        # page chains; a decode-role replica pulls a chain from its
+        # prefill peer (the router's X-K3STPU-Prefill-Endpoint header,
+        # or --prefill-upstream) before admitting a generate request,
+        # so the admission is an exact prompt-cache hit and decode
+        # never pays prefill interference. Monolithic (the default)
+        # changes nothing anywhere — same exposition bytes, same paths.
+        if role not in ("monolithic", "prefill", "decode"):
+            raise ValueError(f"role must be monolithic, prefill, or "
+                             f"decode, got {role!r}")
+        if role != "monolithic" and (
+                not continuous_batching or kv_page_size is None
+                or prompt_cache <= 0):
+            raise ValueError(
+                "--role prefill/decode requires --continuous-batching, "
+                "--kv-page-size, and --prompt-cache > 0: the disagg KV "
+                "handoff stages page chains through the paged prompt "
+                "cache on both sides")
+        if prefill_upstream is not None and role != "decode":
+            raise ValueError(
+                "--prefill-upstream only applies to --role decode (it "
+                "names the prefill peer a decode replica pulls from)")
+        self.role = role
+        self._prefill_upstream = prefill_upstream
+        self._prefill_timeout_s = 30.0
         # Two locks with distinct jobs: _lock serializes DEVICE dispatch
         # ("one chip, one queue" — held for whole generations), while
         # _stats_lock guards only the counters, so /metrics scrapes and
@@ -311,7 +339,8 @@ class InferenceServer:
         # Request-lifecycle traces + latency histograms (k3stpu/obs).
         # ONE instance feeds /metrics, /debug/requests, /debug/trace —
         # and the engine loop's hooks when continuous batching is on.
-        self._obs = ServeObs(instance=instance, attn_backend=attn_backend)
+        self._obs = ServeObs(instance=instance, attn_backend=attn_backend,
+                             role=None if role == "monolithic" else role)
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
         # Failure containment (docs/RESILIENCE.md): the engine-facing
         # knobs default ON here (the HTTP server is the production
@@ -1284,6 +1313,63 @@ class InferenceServer:
                 "--kv-page-size")
         return self._engine.release_session(session, spill=spill)
 
+    # --- disaggregated prefill/decode (docs/DISAGG.md) ------------------
+
+    def export_kv(self, prompt_tokens: "list[int]",
+                  adapter: "str | None" = None) -> bytes:
+        """The POST /v1/prefill body of a prefill-role replica: run (or
+        reuse) the prompt's prefill and return the finished KV page
+        chain in the checksummed HostPageStore wire format, ready for a
+        decode peer's import_chain. Served by any paged replica — the
+        role gate is placement policy (the router only routes prefill
+        work at prefill-role replicas), not a capability gate, which
+        keeps single-process tests honest."""
+        if self._engine is None or not self._engine.paged:
+            raise ValueError(
+                "/v1/prefill requires --continuous-batching with "
+                "--kv-page-size")
+        if not isinstance(prompt_tokens, list) or not prompt_tokens:
+            raise ValueError("prompt_tokens must be a non-empty token list")
+        aid = self._adapter_id(adapter)
+        return self._engine.export_chain(
+            [int(t) for t in prompt_tokens], adapter_id=aid)
+
+    def maybe_disagg_prefetch(self, prompts, adapter: "str | None",
+                              endpoint: "str | None") -> None:
+        """Decode-role fast path, called by the HTTP layer before a
+        generate request is admitted: pull the prompt's KV chain from
+        the prefill peer (the router's X-K3STPU-Prefill-Endpoint header,
+        falling back to --prefill-upstream) and install it in the
+        prompt cache, so admission lands as an exact hit and the decode
+        loop never runs this prompt's prefill. Strictly best-effort:
+        ANY failure — peer down, torn stream, checksum mismatch, pool
+        too tight — counts a transfer fallback and the request proceeds
+        through the normal cold-prefill path with identical output."""
+        if self.role != "decode" or self._engine is None:
+            return
+        if not (isinstance(prompts, list) and len(prompts) == 1
+                and isinstance(prompts[0], list) and prompts[0]):
+            return  # multi-prompt batches take the normal path
+        endpoint = endpoint or self._prefill_upstream
+        if not endpoint:
+            return
+        import urllib.request
+
+        body = json.dumps({"prompt_tokens": [int(t) for t in prompts[0]],
+                           "adapter": adapter}).encode()
+        try:
+            req = urllib.request.Request(
+                endpoint.rstrip("/") + "/v1/prefill", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(
+                    req, timeout=self._prefill_timeout_s) as resp:
+                data = resp.read()
+            # import_chain counts its own fallback when the payload is
+            # torn or the pool can't host the chain.
+            self._engine.import_chain(data)
+        except Exception:
+            self._engine.note_transfer_fallback()
+
     def busy_seconds(self) -> float:
         """Cumulative device-busy time — the duty-cycle numerator the
         telemetry thread differentiates. With an engine, generate busy
@@ -1422,6 +1508,18 @@ class InferenceServer:
                 emit(lines, "k3stpu_tier_swap_outs_total", "counter",
                      "Chains gathered off-device into the host tier.",
                      e["tier_swap_outs"])
+            if self.role != "monolithic":
+                # Disagg handoff ledger (docs/DISAGG.md). Transfer
+                # latency, wire bytes, and fallback counts render from
+                # the shared obs layer; these are the engine's
+                # completed-handoff totals per direction. Gated on role
+                # so a monolithic replica's exposition stays byte-stable.
+                emit(lines, "k3stpu_kv_exports_total", "counter",
+                     "KV page chains serialized for a decode peer "
+                     "(/v1/prefill responses).", e["kv_exports"])
+                emit(lines, "k3stpu_kv_imports_total", "counter",
+                     "KV page chains restored from a prefill peer.",
+                     e["kv_imports"])
             # Containment counters (docs/RESILIENCE.md).
             emit(lines, "k3stpu_engine_deadline_expired_total", "counter",
                  "Requests reaped by the deadline machinery (client "
@@ -1552,6 +1650,7 @@ class InferenceServer:
         }
         return {
             "model": self.model_name,
+            "role": self.role,
             "input_shape": list(self.input_shape()),
             "input_dtype": np.dtype(self.input_dtype()).name,
             "batch_sizes": list(BATCH_SIZES),
@@ -1676,7 +1775,7 @@ def make_app(server: InferenceServer):
                     return
                 import jax
 
-                self._send(200, {"ok": True,
+                self._send(200, {"ok": True, "role": server.role,
                                  "devices": [str(d) for d in jax.devices()]})
             elif self.path == "/livez":
                 # LIVENESS: process-up only. Deliberately breaker-blind —
@@ -1770,10 +1869,44 @@ def make_app(server: InferenceServer):
                         json.JSONDecodeError) as e:
                     self._send(400, {"error": str(e)})
                 return
+            if self.path == "/v1/prefill":
+                # Disagg handoff (docs/DISAGG.md): a decode peer (or the
+                # router on its behalf) asks this replica to prefill a
+                # prompt and ship the finished KV page chain. The body is
+                # raw octet-stream — the checksummed HostPageStore wire
+                # format, fed verbatim to the peer's import_chain.
+                try:
+                    length = int(self.headers.get("Content-Length", "0"))
+                    req = json.loads(self.rfile.read(length))
+                    data = server.export_kv(req["prompt_tokens"],
+                                            adapter=req.get("adapter"))
+                except (KeyError, ValueError, TypeError, OverflowError,
+                        json.JSONDecodeError) as e:
+                    self._send(400, {"error": str(e)})
+                    return
+                except TimeoutError as e:
+                    self._send(503, {"error": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — backend failure
+                    # A chaos/backend fault inside the export dispatch
+                    # fails THIS handoff cleanly; the decode peer counts
+                    # a transfer fallback and prefills cold.
+                    self._send(500, {"error": str(e)})
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(data)))
+                self.send_header("X-K3STPU-Replica", server.instance)
+                self.end_headers()
+                self.wfile.write(data)
+                return
             if self.path == "/v1/generate":
                 try:
                     length = int(self.headers.get("Content-Length", "0"))
                     req = json.loads(self.rfile.read(length))
+                    server.maybe_disagg_prefetch(
+                        req.get("prompt_tokens"), req.get("adapter"),
+                        self.headers.get("X-K3STPU-Prefill-Endpoint"))
                     kwargs = dict(
                         max_new_tokens=req.get("max_new_tokens", 32),
                         temperature=req.get("temperature", 0.0),
@@ -2073,6 +2206,23 @@ def main(argv=None) -> int:
                          "label and the X-K3STPU-Replica response "
                          "header. Default: hostname:port — in k8s the "
                          "hostname IS the pod name")
+    ap.add_argument("--role", default="monolithic",
+                    choices=["monolithic", "prefill", "decode"],
+                    help="disaggregated serving role (docs/DISAGG.md). "
+                         "prefill: answers /v1/prefill with serialized "
+                         "KV page chains for decode peers. decode: "
+                         "pulls each prompt's chain from its prefill "
+                         "peer before admission, so decode never pays "
+                         "prefill interference. monolithic (default): "
+                         "both phases in-process, nothing changes. "
+                         "Non-monolithic roles require "
+                         "--continuous-batching, --kv-page-size, and "
+                         "--prompt-cache > 0")
+    ap.add_argument("--prefill-upstream", default=None,
+                    help="with --role decode: base URL of the prefill "
+                         "peer to pull KV chains from when the request "
+                         "carries no X-K3STPU-Prefill-Endpoint header "
+                         "(the router injects that header per request)")
     ap.add_argument("--compilation-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache (volume mount): "
                          "a restarted pod reuses compiled programs instead "
@@ -2125,6 +2275,8 @@ def main(argv=None) -> int:
                              breaker_cooldown_s=args.breaker_cooldown_s,
                              instance=args.instance or _default_instance(
                                  args.port),
+                             role=args.role,
+                             prefill_upstream=args.prefill_upstream,
                              chaos=_chaos_from_env())
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "

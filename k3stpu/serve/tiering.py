@@ -77,6 +77,31 @@ class TierCorrupt(RuntimeError):
     """A spilled entry failed its checksum (torn write, bit rot)."""
 
 
+def encode_entry(key: Key, length: int, pages: "dict[str, Any]",
+                 last: Any = None) -> bytes:
+    """THE wire format for a gathered page chain: 4-byte big-endian
+    crc32 of the pickled ``(key, length, pages, last)`` payload, then
+    the payload. One format for every mover of a chain — disk spills,
+    drain park files, and the disagg prefill→decode KV stream
+    (docs/DISAGG.md) — so all of them share the same torn-transfer
+    detection and the same ``decode_entry`` round-trip."""
+    payload = pickle.dumps((key, length, pages, last),
+                           protocol=pickle.HIGHEST_PROTOCOL)
+    return zlib.crc32(payload).to_bytes(4, "big") + payload
+
+
+def decode_entry(data: bytes) -> "tuple[Key, int, dict[str, Any], Any]":
+    """Inverse of ``encode_entry``: ``(key, length, pages, last)``.
+    Raises TierCorrupt on a truncated or checksum-failed buffer (torn
+    spill file, torn HTTP transfer) — never returns partial data."""
+    if len(data) < 4:
+        raise TierCorrupt("tier payload truncated")
+    crc, payload = int.from_bytes(data[:4], "big"), data[4:]
+    if zlib.crc32(payload) != crc:
+        raise TierCorrupt("tier payload checksum mismatch")
+    return pickle.loads(payload)
+
+
 class _Entry:
     """One gathered page chain, resident in host RAM or spilled.
 
@@ -210,13 +235,10 @@ class HostPageStore:
             f"{'park' if park else 'tier'}-{self._tag}"
             f"-{self._spill_seq}.kv")
         self._known_paths.add(path)
-        payload = pickle.dumps((key, ent.length, ent.pages, ent.last),
-                               protocol=pickle.HIGHEST_PROTOCOL)
-        crc = zlib.crc32(payload)
+        data = encode_entry(key, ent.length, ent.pages, ent.last)
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
-            f.write(crc.to_bytes(4, "big"))
-            f.write(payload)
+            f.write(data)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -295,12 +317,7 @@ class HostPageStore:
             try:
                 with open(path, "rb") as f:
                     raw = f.read()
-                if len(raw) < 4:
-                    continue
-                crc, payload = int.from_bytes(raw[:4], "big"), raw[4:]
-                if zlib.crc32(payload) != crc:
-                    continue
-                key, length, pages, last = pickle.loads(payload)
+                key, length, pages, last = decode_entry(raw)
             except Exception:  # noqa: BLE001 — foreign bytes; skip them
                 continue
             if not isinstance(pages, dict) or key in self._entries:
@@ -346,12 +363,7 @@ class HostPageStore:
                 raw = f.read()
         except OSError as e:
             raise TierCorrupt(f"tier spill unreadable: {e}") from e
-        if len(raw) < 4:
-            raise TierCorrupt("tier spill truncated")
-        crc, payload = int.from_bytes(raw[:4], "big"), raw[4:]
-        if zlib.crc32(payload) != crc:
-            raise TierCorrupt("tier spill checksum mismatch")
-        skey, length, pages, last = pickle.loads(payload)
+        skey, length, pages, last = decode_entry(raw)
         if skey != key:
             raise TierCorrupt("tier spill key mismatch")
         # Promote back to resident (it is about to be device_put anyway;

@@ -62,6 +62,7 @@ SMOKE=(
   tests/test_tiering.py
   tests/test_router.py
   tests/test_autoscaler.py
+  tests/test_disagg.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

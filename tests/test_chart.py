@@ -135,6 +135,73 @@ def test_router_enabled_wiring():
     assert dep["spec"].get("strategy") is None
 
 
+def test_disagg_disabled_by_default():
+    # Disaggregated serving is opt-in like every workload, and neither
+    # the role Deployments nor the router's --prefill-replicas flag may
+    # leak into default renders (byte-stable goldens).
+    objs = render()
+    for name in ("tpu-prefill", "tpu-decode"):
+        assert ("Deployment", name) not in objs
+        assert ("Service", name) not in objs
+    objs = render({"router.enabled": "true"})
+    cmd = objs[("Deployment", "tpu-router")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert "--prefill-replicas" not in cmd
+
+
+def test_disagg_enabled_wiring():
+    # docs/DISAGG.md: two role-flagged Deployments, each carrying the
+    # paged-engine unit the KV handoff stages through, the decode side
+    # pointed at the prefill Service for headerless requests, and the
+    # router handing out per-request prefill peers.
+    objs = render({"inference.disagg.enabled": "true",
+                   "inference.disagg.prefillReplicas": "2",
+                   "inference.disagg.decodeReplicas": "3",
+                   "router.enabled": "true",
+                   "router.replicaUrls": "http://tpu-decode:8096"})
+    for name, role, replicas in (("tpu-prefill", "prefill", 2),
+                                 ("tpu-decode", "decode", 3)):
+        dep = objs[("Deployment", name)]
+        assert dep["spec"]["replicas"] == replicas
+        pod = dep["spec"]["template"]["spec"]
+        (ctr,) = pod["containers"]
+        cmd = ctr["command"]
+        assert cmd[cmd.index("--role") + 1] == role
+        # The handoff's engine-level requirements travel as one unit.
+        assert "--continuous-batching" in cmd
+        assert cmd[cmd.index("--kv-page-size") + 1] == "64"
+        assert int(cmd[cmd.index("--prompt-cache") + 1]) > 0
+        # Device-holding replicas: Recreate pin + TPU limit, like the
+        # monolithic inference Deployment.
+        assert dep["spec"]["strategy"]["type"] == "Recreate"
+        assert ctr["resources"]["limits"]["google.com/tpu"] == "1"
+        assert ctr["readinessProbe"]["httpGet"]["path"] == "/healthz"
+        svc = objs[("Service", name)]
+        (port,) = svc["spec"]["ports"]
+        assert port["port"] == 8096
+    dec_cmd = objs[("Deployment", "tpu-decode")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert dec_cmd[dec_cmd.index("--prefill-upstream") + 1] \
+        == "http://tpu-prefill:8096"
+    pre_cmd = objs[("Deployment", "tpu-prefill")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert "--prefill-upstream" not in pre_cmd
+    router_cmd = objs[("Deployment", "tpu-router")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert router_cmd[router_cmd.index("--prefill-replicas") + 1] \
+        == "http://tpu-prefill:8096"
+    assert router_cmd[router_cmd.index("--replicas") + 1] \
+        == "http://tpu-decode:8096"
+
+
+def test_disagg_missing_values_fail_loudly():
+    # A half-specified disagg block must be a render-time error, not a
+    # Deployment with an empty replicas field.
+    with pytest.raises(ValueError, match="undefined reference"):
+        render({"inference.disagg.enabled": "true",
+                "inference.disagg.prefillReplicas": "null"})
+
+
 def test_train_disabled_by_default():
     # Same opt-in rule as inference: the chart installs infrastructure,
     # workloads are explicit, and the default golden stays byte-stable.
@@ -377,12 +444,20 @@ def _golden_case(name):
         "autoscaler.yaml": {"autoscaler.enabled": "true",
                             "router.enabled": "true",
                             "inference.enabled": "true"},
+        # Disaggregated prefill/decode serving (docs/DISAGG.md): the
+        # two role-flagged Deployments behind the router, with the
+        # router's replica pool pointed at the decode Service (decode
+        # replicas take generate traffic; prefill peers are per-request
+        # header hints).
+        "disagg.yaml": {"inference.disagg.enabled": "true",
+                        "router.enabled": "true",
+                        "router.replicaUrls": "http://tpu-decode:8096"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
                 "train.yaml", "node-obs.yaml", "router.yaml",
-                "autoscaler.yaml"]
+                "autoscaler.yaml", "disagg.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

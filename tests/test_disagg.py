@@ -1,0 +1,448 @@
+"""Disaggregated prefill/decode serving (docs/DISAGG.md).
+
+What is pinned here, in order:
+
+1. The engine decomposition is behavior-free: ``k3stpu.serve.engine``
+   still exports the full public surface (the shim over the scheduler /
+   kv-manager / runner mixins), so every existing import site keeps
+   working.
+2. The KV handoff is BIT-EXACT: a chain exported by a prefill-role
+   engine and imported by a decode-role engine yields token-identical
+   greedy output to a monolithic run — on plain prompts, ragged
+   batches, int8 KV pools, and under speculative decode. The mechanism
+   makes this structural: ``import_chain`` installs the chain as an
+   exact prompt-cache entry, so admission takes the same pcache-hit
+   path the monolithic engine takes for a repeated prompt.
+3. Every transfer failure (torn payload, checksum mismatch, chaos
+   ``kv_transfer`` on either leg, dark prefill peer) degrades to a
+   cold prefill with the SAME output, counted in
+   ``transfer_fallbacks``, allocator invariants intact, loop alive —
+   capacity loss, never correctness loss (docs/RESILIENCE.md).
+4. The HTTP layer composes: a prefill-role server's ``/v1/prefill``
+   feeds a decode-role server's pre-admission prefetch, one hop or
+   two (the router's X-K3STPU-Prefill-Endpoint header).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.chaos import FaultInjector, InjectedFault
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.serve import engine as engine_mod
+from k3stpu.serve.engine import EngineOverloaded, GenerateEngine, _PageAllocator
+from k3stpu.serve.kv_manager import KVManagerMixin
+from k3stpu.serve.runner import ModelRunnerMixin
+from k3stpu.serve.scheduler import SchedulerMixin
+from k3stpu.serve.tiering import TierCorrupt, decode_entry, encode_entry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("seed", 0)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 24)
+    kw.setdefault("prompt_cache", 4)
+    return GenerateEngine(model, params, **kw)
+
+
+def _assert_page_invariants(engine):
+    """Idle-engine allocator accounting, checked exactly (the proof
+    from tests/test_paged.py / test_tiering.py): every page's refcount
+    equals its appearances across live slot chains plus prompt-cache
+    pins — a failed import must never strand a pin or leak a page."""
+    alloc = engine._alloc
+    expect = {}
+    for chain in engine._chains:
+        for p in chain:
+            expect[p] = expect.get(p, 0) + 1
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            expect[p] = expect.get(p, 0) + 1
+    for p in range(1, alloc.num_pages):
+        assert alloc.refcount(p) == expect.get(p, 0), (
+            f"page {p}: rc={alloc.refcount(p)} but "
+            f"{expect.get(p, 0)} live references")
+    assert alloc.free == alloc.total - sum(1 for v in expect.values()
+                                           if v > 0)
+
+
+# --- 1. the decomposition shim ------------------------------------------
+
+
+def test_engine_module_is_the_compatibility_shim():
+    """Every pre-decomposition import site spells
+    ``k3stpu.serve.engine.X`` — the shim must keep that surface:
+    GenerateEngine composes the three mixins, and the names the tests,
+    server, and bench reach for still resolve from the old module."""
+    assert issubclass(GenerateEngine, SchedulerMixin)
+    assert issubclass(GenerateEngine, KVManagerMixin)
+    assert issubclass(GenerateEngine, ModelRunnerMixin)
+    for name in ("GenerateEngine", "EngineOverloaded", "_PageAllocator"):
+        assert getattr(engine_mod, name) is not None
+    assert EngineOverloaded is not None and _PageAllocator is not None
+    # The disagg surface lives on the KV-manager layer and is reachable
+    # through the composed class.
+    for meth in ("export_chain", "import_chain", "note_transfer_fallback"):
+        assert callable(getattr(GenerateEngine, meth))
+
+
+# --- 2. bit-exactness of the handoff ------------------------------------
+
+
+def test_export_import_roundtrip_bit_exact(mp):
+    model, params = mp
+    src, dst, mono = (_engine(model, params) for _ in range(3))
+    try:
+        p = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        data = src.export_chain(p)
+        assert isinstance(data, bytes) and len(data) > 4
+        assert dst.import_chain(data)
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        s = dst.stats()
+        # The admission consumed the imported entry as an exact hit —
+        # the decode replica never ran this prompt's prefill.
+        assert s["kv_imports"] == 1 and s["pcache_hits"] == 1
+        assert s["transfer_fallbacks"] == 0
+        assert src.stats()["kv_exports"] == 1
+        assert src.stats()["kv_transfer_bytes"] == len(data)
+        # A repeated export reuses the staged entry (prefill replica's
+        # steady state): same bytes, no second prefill.
+        assert src.export_chain(p) == data
+        _assert_page_invariants(src)
+        _assert_page_invariants(dst)
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+def test_disagg_ragged_batch_bit_exact(mp):
+    """Imported chains of different lengths admitted as concurrent
+    single-prompt requests — the decode loop interleaves them into one
+    ragged decode batch (the disagg serving shape: the HTTP prefetch is
+    per-request) — must decode token-identically to the monolithic
+    engine, each admission an exact hit on its imported entry."""
+    model, params = mp
+    src, dst, mono = (_engine(model, params, slots=4) for _ in range(3))
+    try:
+        p1 = [5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15]
+        p2 = [30, 31, 32]
+        for p in (p1, p2):
+            assert dst.import_chain(src.export_chain(p))
+        want = {id(p1): mono.submit([p1], max_new_tokens=5),
+                id(p2): mono.submit([p2], max_new_tokens=5)}
+        got = {}
+        threads = [threading.Thread(
+            target=lambda p=p: got.__setitem__(
+                id(p), dst.submit([p], max_new_tokens=5)))
+            for p in (p1, p2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert got == want
+        assert dst.stats()["pcache_hits"] == 2
+        _assert_page_invariants(dst)
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+def test_disagg_int8_pool_bit_exact():
+    """The wire format carries whatever leaves the pool holds — int8
+    pages and their scale planes round-trip bit-exactly too."""
+    model = transformer_lm_tiny(max_seq_len=64, kv_cache_dtype="int8")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    src, dst, mono = (_engine(model, params) for _ in range(3))
+    try:
+        p = [7, 8, 9, 10, 11, 12, 13]
+        assert dst.import_chain(src.export_chain(p))
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        assert dst.stats()["pcache_hits"] == 1
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+def test_disagg_speculative_bit_exact(mp):
+    """A speculative decode replica fed an imported chain must emit the
+    monolithic speculative engine's exact tokens — the handoff hands
+    over the same logits the draft/verify loop would have seen."""
+    model, params = mp
+    src = _engine(model, params)
+    dst = _engine(model, params, slots=4, speculate=True)
+    mono = _engine(model, params, slots=4, speculate=True)
+    try:
+        p = [5, 6, 7, 8, 9, 10, 11]
+        assert dst.import_chain(src.export_chain(p))
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        assert dst.stats()["pcache_hits"] == 1
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+# --- 3. failure matrix: every torn transfer is a cold prefill -----------
+
+
+def test_corrupt_transfer_degrades_to_cold_prefill(mp):
+    model, params = mp
+    src, dst, mono = (_engine(model, params) for _ in range(3))
+    try:
+        p = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        data = src.export_chain(p)
+        # Bit rot past the checksum prefix and a torn (truncated) copy:
+        # both fail closed, counted, nothing installed.
+        rotten = data[:4] + bytes(b ^ 0xFF for b in data[4:12]) + data[12:]
+        assert dst.import_chain(rotten) is False
+        assert dst.import_chain(data[:10]) is False
+        s = dst.stats()
+        assert s["transfer_fallbacks"] == 2 and s["kv_imports"] == 0
+        assert len(dst._pcache) == 0
+        _assert_page_invariants(dst)
+        # The caller's contract: just submit — cold prefill, same tokens.
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        assert dst.stats()["pcache_hits"] == 0
+        # The wire layer itself names the failure when decoded directly.
+        with pytest.raises(TierCorrupt):
+            decode_entry(rotten)
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+def test_chaos_kv_transfer_import_leg(mp):
+    """Fault matrix row (docs/RESILIENCE.md): chaos ``kv_transfer`` on
+    the import leg — request completes via cold prefill with exact
+    output, ``transfer_fallbacks`` counted, no live-row corruption,
+    loop alive for the next transfer."""
+    model, params = mp
+    inj = FaultInjector()
+    src = _engine(model, params)
+    dst = _engine(model, params, chaos=inj)
+    mono = _engine(model, params)
+    try:
+        p = [5, 6, 7, 8, 9, 10, 11]
+        data = src.export_chain(p)
+        inj.arm("kv_transfer", times=1)
+        assert dst.import_chain(data) is False
+        assert inj.fired("kv_transfer") == 1
+        s = dst.stats()
+        assert s["transfer_fallbacks"] == 1 and s["kv_imports"] == 0
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        _assert_page_invariants(dst)
+        # Disarmed, the same bytes install fine — the loop survived.
+        assert dst.import_chain(data)
+        assert dst.stats()["kv_imports"] == 1
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+def test_chaos_kv_transfer_export_leg(mp):
+    """The export leg fails LOUDLY (the HTTP layer turns it into a
+    non-200 so the decode peer falls back), and the prefill engine
+    keeps serving afterwards."""
+    model, params = mp
+    inj = FaultInjector()
+    src = _engine(model, params, chaos=inj)
+    mono = _engine(model, params)
+    try:
+        p = [5, 6, 7, 8, 9]
+        inj.arm("kv_transfer", times=1)
+        with pytest.raises(InjectedFault):
+            src.export_chain(p)
+        assert src.stats()["kv_exports"] == 0
+        _assert_page_invariants(src)
+        # Loop alive: the engine still prefills, exports, and decodes.
+        assert src.submit([p], max_new_tokens=4) \
+            == mono.submit([p], max_new_tokens=4)
+        assert isinstance(src.export_chain(p), bytes)
+    finally:
+        src.close()
+        mono.close()
+
+
+def test_import_guards_unpaged_and_oversized(mp):
+    model, params = mp
+    unpaged = GenerateEngine(model, params, slots=2, seed=0)
+    paged = _engine(model, params)
+    try:
+        with pytest.raises(ValueError, match="paged"):
+            unpaged.export_chain([1, 2, 3])
+        with pytest.raises(ValueError, match="paged"):
+            unpaged.import_chain(b"xxxx")
+        with pytest.raises(ValueError):
+            paged.export_chain([])
+        with pytest.raises(ValueError):
+            paged.export_chain(list(range(999)))  # exceeds max_seq
+        # An oversized LENGTH smuggled inside a valid checksum still
+        # fails closed at import (the malformed-payload guard).
+        key = (0, tuple(range(70)))
+        data = encode_entry(key, 70, {}, {})
+        assert paged.import_chain(data) is False
+        assert paged.stats()["transfer_fallbacks"] == 1
+    finally:
+        unpaged.close()
+        paged.close()
+
+
+# --- 4. the HTTP layer: /v1/prefill -> prefetch -> exact hit ------------
+
+
+def _http_server(**kw):
+    from http.server import ThreadingHTTPServer
+
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    kw.setdefault("model_name", "transformer-tiny")
+    kw.setdefault("seq_len", 128)
+    kw.setdefault("batch_window_ms", 0.0)
+    kw.setdefault("continuous_batching", True)
+    kw.setdefault("decode_block", 2)
+    kw.setdefault("prompt_cache", 8)
+    kw.setdefault("kv_page_size", 16)
+    kw.setdefault("kv_pages", 32)
+    kw.setdefault("shard_devices", None)
+    srv = InferenceServer(**kw)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return srv, httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def _post_generate(url, prompt, n, headers=None):
+    req = urllib.request.Request(
+        url + "/v1/generate",
+        data=json.dumps({"prompt_tokens": [prompt],
+                         "max_new_tokens": n}).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=120) as r:
+        return json.loads(r.read())["tokens"][0]
+
+
+def test_http_prefill_decode_handoff_bit_exact():
+    """Full two-replica path: decode-role server prefetches from its
+    --prefill-upstream peer's /v1/prefill, admission is an exact hit,
+    output token-identical to a monolithic server; the router's
+    per-request header overrides the static upstream."""
+    pre, pre_httpd, pre_url = _http_server(instance="t-pre",
+                                           role="prefill")
+    dec, dec_httpd, dec_url = _http_server(instance="t-dec",
+                                           role="decode",
+                                           prefill_upstream=pre_url)
+    mono, mono_httpd, mono_url = _http_server(instance="t-mono")
+    try:
+        rng = np.random.default_rng(7)
+        p = rng.integers(1, 1000, size=(40,)).tolist()
+        want = _post_generate(mono_url, p, 6)
+        assert _post_generate(dec_url, p, 6) == want
+        assert pre._engine.stats()["kv_exports"] == 1
+        ds = dec._engine.stats()
+        assert ds["kv_imports"] == 1 and ds["pcache_hits"] == 1
+        assert ds["transfer_fallbacks"] == 0
+        # Header-routed variant (the router's two-hop placement).
+        p2 = p[::-1]
+        want2 = _post_generate(mono_url, p2, 4)
+        got2 = _post_generate(dec_url, p2, 4,
+                              headers={"X-K3STPU-Prefill-Endpoint":
+                                       pre_url})
+        assert got2 == want2
+        assert dec._engine.stats()["kv_imports"] == 2
+        # Role is visible where operators look for it.
+        with urllib.request.urlopen(pre_url + "/healthz", timeout=30) as r:
+            assert json.loads(r.read())["role"] == "prefill"
+    finally:
+        for httpd in (pre_httpd, dec_httpd, mono_httpd):
+            httpd.shutdown()
+        for s in (pre, dec, mono):
+            s.close()
+
+
+def test_http_dark_prefill_peer_degrades_to_cold():
+    """A decode replica whose prefill peer is down serves EXACT output
+    via its own cold prefill — availability survives, the fallback is
+    counted (the autoscaler/operator signal that capacity, not
+    correctness, is degraded)."""
+    dec, dec_httpd, dec_url = _http_server(
+        instance="t-dark", role="decode",
+        prefill_upstream="http://127.0.0.1:9")  # nothing listens here
+    mono, mono_httpd, mono_url = _http_server(instance="t-mono2")
+    try:
+        dec._prefill_timeout_s = 2.0
+        rng = np.random.default_rng(11)
+        p = rng.integers(1, 1000, size=(24,)).tolist()
+        want = _post_generate(mono_url, p, 5)
+        assert _post_generate(dec_url, p, 5) == want
+        ds = dec._engine.stats()
+        assert ds["transfer_fallbacks"] == 1 and ds["kv_imports"] == 0
+    finally:
+        dec_httpd.shutdown()
+        mono_httpd.shutdown()
+        dec.close()
+        mono.close()
+
+
+def test_server_role_validation():
+    from k3stpu.serve.server import InferenceServer
+
+    with pytest.raises(ValueError, match="role"):
+        InferenceServer(model_name="transformer-tiny", role="hybrid")
+    # Roles require the paged-engine unit the handoff stages through.
+    with pytest.raises(ValueError, match="continuous-batching"):
+        InferenceServer(model_name="transformer-tiny", role="prefill")
+    with pytest.raises(ValueError, match="prefill-upstream"):
+        InferenceServer(model_name="transformer-tiny", seq_len=128,
+                        continuous_batching=True, kv_page_size=16,
+                        prompt_cache=8, role="prefill",
+                        prefill_upstream="http://x:1")
+
+
+# --- 5. the bench gate ---------------------------------------------------
+
+
+@pytest.mark.slow
+def test_serve_disagg_bench_gates():
+    """bench.py --serve-disagg: one JSON line; disagg short-class p99
+    TPOT <= 0.5x monolithic under mixed traffic (vs_baseline <= 1.0)
+    and the 512-token KV handoff <= 1/3 of the cold prefill it saves."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = ""
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--serve-disagg"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=600)
+    assert out.returncode == 0, out.stderr
+    lines = [l for l in out.stdout.strip().splitlines() if l.strip()]
+    assert len(lines) == 1, f"must print exactly one line, got: {lines}"
+    rec = json.loads(lines[0])
+    assert rec["metric"] == "serve_disagg_short_tpot_ratio"
+    assert rec["vs_baseline"] <= 1.0, rec
+    d = rec["detail"]
+    assert d["tpot_gate_passed"] and d["transfer_gate_passed"], d
+    assert d["transfer_fallbacks"] == 0, d

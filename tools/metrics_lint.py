@@ -73,10 +73,12 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 # for a dashboard, so the lint rejects it until the key is reviewed and
 # added here. "backend" is the attention-backend enum (xla-gather /
 # pallas-paged), fixed at construction on the decode-dispatch histogram;
-# "direction" is the autoscaler's fixed {up, down} enum.
+# "direction" is the autoscaler's fixed {up, down} enum; "role" is the
+# disagg serving-role enum (prefill / decode) on k3stpu_build_info.
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       "component", "version", "instance",
-                      "replica", "reason", "backend", "direction"}
+                      "replica", "reason", "backend", "direction",
+                      "role"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
