@@ -9,7 +9,10 @@ scale on:
   running — the primary scale-up signal.
 - ``k3stpu_engine_pages_free`` / ``k3stpu_pages_total`` (gauges): KV
   page-pool headroom; a fleet running out of pages thrashes the tier
-  long before queue depth moves.
+  long before queue depth moves. A tensor-parallel replica exposes
+  ``k3stpu_serve_tp_pages_free{shard="i"}`` per shard instead, and the
+  parser takes the MIN across shards (the tightest pool gates
+  admission — summing would overstate headroom N-fold).
 - ``k3stpu_request_queue_wait_seconds`` (histogram): p50 queue wait =
   the prefill backlog a newly admitted request will actually pay.
 - ``k3stpu_request_ttft_seconds`` (histogram): p50 TTFT = the
@@ -81,6 +84,23 @@ def _gauge_value(text: str, name: str) -> "float | None":
     return None
 
 
+def _labeled_gauge_min(text: str, name: str) -> "float | None":
+    """MIN over every labeled sample of ``name`` (``name{...} v``).
+    None when the family has no labeled samples — the caller falls back
+    to the unlabeled gauge. Min, not sum: on a tensor-parallel replica
+    each shard holds its own page pool, and admission stalls on the
+    tightest shard, so the fleet's free-page headroom is the worst
+    shard's, not the aggregate."""
+    vals = []
+    for line in text.splitlines():
+        if line.startswith(name + "{"):
+            try:
+                vals.append(float(line.split()[1]))
+            except (IndexError, ValueError):
+                continue
+    return min(vals) if vals else None
+
+
 def _hist_p50(text: str, name: str) -> float:
     """p50 from a family's cumulative buckets; 0.0 when absent/empty
     (an idle replica has no latency pressure by definition)."""
@@ -95,7 +115,13 @@ def _hist_p50(text: str, name: str) -> float:
 def parse_replica_metrics(url: str, text: str) -> ReplicaSample:
     """Pure exposition-text → sample (the unit-testable half)."""
     qd = _gauge_value(text, "k3stpu_engine_queue_depth")
-    pf = _gauge_value(text, "k3stpu_engine_pages_free")
+    # Tensor-parallel replicas expose per-shard pools
+    # (k3stpu_serve_tp_pages_free{shard="i"}); the tightest shard is the
+    # one that gates admission. Monolithic replicas have no such family
+    # and keep the unlabeled engine gauge.
+    pf = _labeled_gauge_min(text, "k3stpu_serve_tp_pages_free")
+    if pf is None:
+        pf = _gauge_value(text, "k3stpu_engine_pages_free")
     pt = _gauge_value(text, "k3stpu_pages_total")
     return ReplicaSample(
         url, ok=True,

@@ -150,13 +150,20 @@ def param_bytes(params: dict) -> int:
                for x in jax.tree.leaves(params))
 
 
-def kv_page_bytes(config, page_size: int) -> int:
+def kv_page_bytes(config, page_size: int, *, tp_shards: int = 1) -> int:
     """HBM bytes ONE paged-KV page costs across all layers: the K and V
     pools plus, when ``kv_cache_dtype == "int8"``, the per-(token,
     kv-head) fp32 absmax scale pools (transformer.py's paged layout).
     Matches the engine's measured ``_page_bytes`` (summed from the live
     cache leaves) by construction — this is the planning-side form that
     needs no cache to exist yet.
+
+    ``tp_shards``: per-CHIP bytes under tensor-parallel serving. The
+    pool partitions on the kv-head axis (engine ``--tp-shards``), so
+    each shard holds ``kv_heads / tp_shards`` heads' worth of every
+    page — the per-chip cost divides exactly (values AND scale planes
+    both carry the head axis). ``kv_heads`` must divide; the engine
+    enforces the same bound. Default 1 = whole-pool bytes, unchanged.
 
     The int8 win per (token, kv-head) row is ``head_dim * itemsize``
     bytes down to ``head_dim + 4``: 4x vs an fp32 cache at large
@@ -166,16 +173,22 @@ def kv_page_bytes(config, page_size: int) -> int:
     cfg = getattr(config, "base", config)
     kv_heads = cfg.n_kv_heads or cfg.n_heads
     head_dim = cfg.d_model // cfg.n_heads
+    if tp_shards < 1 or kv_heads % tp_shards:
+        raise ValueError(f"tp_shards={tp_shards} must divide kv heads "
+                         f"({kv_heads})")
     if cfg.kv_cache_dtype == "int8":
         per_token = kv_heads * (head_dim + 4)  # int8 values + fp32 scale
     else:
         per_token = kv_heads * head_dim * jnp.dtype(cfg.dtype).itemsize
-    return 2 * cfg.n_layers * page_size * per_token
+    return 2 * cfg.n_layers * page_size * per_token // tp_shards
 
 
-def kv_pages_for_budget(budget_bytes: int, config, page_size: int) -> int:
+def kv_pages_for_budget(budget_bytes: int, config, page_size: int,
+                        *, tp_shards: int = 1) -> int:
     """Pages a fixed HBM budget buys (sink page 0 included) — the
     capacity side of the int8-paged-KV trade: same budget, same model,
     ``kv_cache_dtype="int8"`` vs float is the pool-size multiplier the
-    bench records."""
-    return int(budget_bytes) // kv_page_bytes(config, page_size)
+    bench records. With ``tp_shards`` the budget is PER CHIP — sharding
+    the pool buys tp_shards× the pages at the same per-chip HBM."""
+    return int(budget_bytes) // kv_page_bytes(config, page_size,
+                                              tp_shards=tp_shards)

@@ -21,6 +21,7 @@ from .hist import (  # noqa: F401  (re-exported for tests/loadgen)
     Gauge,
     Histogram,
     InfoGauge,
+    LabeledGauge,
     build_info_gauge,
     parse_prometheus_histograms,
     prometheus_text_to_openmetrics,
@@ -48,7 +49,8 @@ class ServeObs:
     def __init__(self, trace_capacity: int = 256, enabled: bool = True,
                  instance: "str | None" = None,
                  attn_backend: str = "xla-gather",
-                 role: "str | None" = None):
+                 role: "str | None" = None,
+                 tp_shards: "int | None" = None):
         self.enabled = enabled
         self.traces = TraceBuffer(capacity=trace_capacity)
         self.ttft = Histogram(
@@ -171,12 +173,37 @@ class ServeObs:
             "Disagg KV handoffs that failed (torn/corrupt transfer, "
             "unreachable prefill peer, pool too tight) and degraded to "
             "a cold prefill on the decode replica.")
+        # Tensor-parallel serving (engine tp_shards=, docs/DISAGG.md
+        # "TP × disagg"). Families are constructed unconditionally (the
+        # metrics lint scans a real instance) but only RENDERED once
+        # set_tp_shards() arms them — a monolithic replica's exposition
+        # stays byte-stable.
+        self._tp_enabled = False
+        self.tp_shards_gauge = Gauge(
+            "k3stpu_serve_tp_shards",
+            "Tensor-parallel shard count of this replica's serving mesh "
+            "('model' axis extent; rendered only when > 1).")
+        self.tp_allreduce_seconds = Histogram(
+            "k3stpu_serve_tp_allreduce_seconds",
+            "Cross-shard all-reduce latency samples over the serving "
+            "mesh (init-time probe; in-dispatch collectives are fused).",
+            bounds=TPOT_BUCKETS_S)
+        self._tp_n = 0
+        self.tp_pages_free = LabeledGauge(
+            "k3stpu_serve_tp_pages_free",
+            "Free KV pages in each shard's page pool. Shards share one "
+            "block table, so the values agree today; the autoscaler "
+            "reads the MIN so the fleet math survives if they diverge.",
+            "shard")
         # ``instance`` (pod name or host:port) stamps which replica of a
         # scaled-out serving fleet this exposition came from; ``role``
-        # is the disagg serving role (prefill / decode). Both None (the
-        # default) keeps the single-replica label set byte-stable.
+        # is the disagg serving role (prefill / decode); ``tp_shards``
+        # the replica's tensor-parallel width. All None (the default)
+        # keeps the single-replica label set byte-stable.
         self.build_info = build_info_gauge("serve", instance=instance,
-                                           role=role)
+                                           role=role, tp_shards=tp_shards)
+        if tp_shards is not None and tp_shards > 1:
+            self.set_tp_shards(tp_shards)
 
     # -- engine hooks (loop / submitter threads) ---------------------------
 
@@ -213,6 +240,8 @@ class ServeObs:
         self.queue_depth.set(float(queue_depth))
         if pages_free is not None:
             self.pages_free.set(float(pages_free))
+            for i in range(self._tp_n):
+                self.tp_pages_free.set(str(i), float(pages_free))
         if pages_resident is not None:
             self.pages_resident.set(float(pages_resident))
 
@@ -269,6 +298,22 @@ class ServeObs:
             return
         self.transfer_fallbacks.inc()
 
+    def set_tp_shards(self, n: int) -> None:
+        """Arm the tensor-parallel families and stamp the shard count
+        (the engine calls this when it builds/adopts a TP mesh)."""
+        self._tp_enabled = True
+        self._tp_n = int(n)
+        self.tp_shards_gauge.set(float(n))
+        for i in range(self._tp_n):
+            # -1 mirrors the unlabeled pages_free boot value (engine
+            # not yet running in paged mode).
+            self.tp_pages_free.set(str(i), -1.0)
+
+    def on_tp_allreduce(self, seconds: float) -> None:
+        if not self.enabled or not self._tp_enabled:
+            return
+        self.tp_allreduce_seconds.observe(seconds)
+
     def on_spec_dispatch(self, proposed: int, accepted: int, emitted: int,
                          draft_s: float, verify_s: float) -> None:
         """One speculative verify dispatch: ``proposed`` draft tokens
@@ -307,11 +352,14 @@ class ServeObs:
     # -- read side (HTTP threads) ------------------------------------------
 
     def histograms(self) -> "tuple[Histogram, ...]":
-        return (self.ttft, self.tpot, self.e2e, self.queue_wait,
+        base = (self.ttft, self.tpot, self.e2e, self.queue_wait,
                 self.batch_occupancy, self.decode_dispatch_seconds,
                 self.spec_draft_seconds,
                 self.spec_verify_seconds, self.tier_swap_in_seconds,
                 self.tier_swap_out_seconds, self.kv_transfer_seconds)
+        if self._tp_enabled:
+            base += (self.tp_allreduce_seconds,)
+        return base
 
     def _counters(self) -> "tuple[Counter, ...]":
         return (self.spec_accepted_tokens, self.spec_proposed_tokens,
@@ -320,9 +368,12 @@ class ServeObs:
                 self.transfer_fallbacks)
 
     def _gauges(self) -> "tuple[Gauge, ...]":
-        return (self.queue_depth, self.pages_free, self.pages_resident,
+        base = (self.queue_depth, self.pages_free, self.pages_resident,
                 self.host_tier_pages, self.spec_accept_ratio,
                 self.decode_mfu)
+        if self._tp_enabled:
+            base += (self.tp_shards_gauge, self.tp_pages_free)
+        return base
 
     def render_prometheus(self) -> str:
         parts = [h.render() for h in self.histograms()]
@@ -359,6 +410,8 @@ class ServeObs:
         self.queue_depth.set(0.0)
         self.host_tier_pages.set(0.0)
         self.decode_mfu.set(0.0)
+        # tp_shards_gauge survives reset: the mesh width is live config,
+        # not a counter (same rule as pcache_bytes in engine stats).
         self.traces.reset()
 
 

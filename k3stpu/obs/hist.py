@@ -69,7 +69,8 @@ class InfoGauge:
 
 def build_info_gauge(component: str,
                      instance: "str | None" = None,
-                     role: "str | None" = None) -> InfoGauge:
+                     role: "str | None" = None,
+                     tp_shards: "int | None" = None) -> InfoGauge:
     """The shared ``k3stpu_build_info`` family every metric server in
     the stack (serve, train rank-0, node exporter, router) exposes,
     telling one scrape apart from another by version and role.
@@ -79,15 +80,20 @@ def build_info_gauge(component: str,
     multi-endpoint loadgen join per-replica series on. ``role`` is the
     disaggregated-serving role (``prefill`` / ``decode`` — the
     docs/DISAGG.md topology), so a dashboard splits fleet series by
-    which half of the pipeline a replica runs. Both omitted (the
-    single-replica monolithic components), the label set stays exactly
-    the pre-router pair, so existing expositions are byte-stable."""
+    which half of the pipeline a replica runs. ``tp_shards`` is the
+    replica's tensor-parallel width (--tp-shards > 1) — the per-replica
+    chip count the autoscaler and capacity dashboards reason about.
+    All omitted (the single-replica monolithic components), the label
+    set stays exactly the pre-router pair, so existing expositions are
+    byte-stable."""
     from k3stpu import __version__
     labels = {"version": __version__, "component": component}
     if instance is not None:
         labels["instance"] = instance
     if role is not None:
         labels["role"] = role
+    if tp_shards is not None:
+        labels["tp_shards"] = str(tp_shards)
     return InfoGauge(
         "k3stpu_build_info",
         "Constant-1 build/version info gauge (standard convention)",

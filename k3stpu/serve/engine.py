@@ -88,7 +88,8 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
     def __init__(self, model, params, *, slots: int = 8,
                  seed: int = 0, chunk_prefill: "int | None" = None,
                  decode_block: int = 1, prompt_cache: int = 0,
-                 mesh=None, max_pending: "int | None" = None,
+                 mesh=None, tp_shards: int = 1,
+                 max_pending: "int | None" = None,
                  page_size: "int | None" = None,
                  num_pages: "int | None" = None,
                  attn_backend: str = "xla-gather",
@@ -133,6 +134,19 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
         by head under TP) and replicated otherwise. Host-side numpy
         inputs stay uncommitted — jit places them. None =
         single-device (programs unchanged).
+
+        ``tp_shards``: tensor-parallel shard count — the serving twin
+        of the training side's model parallelism (--tp-shards on the
+        server). ``1`` (the default) is byte-identical to the pre-TP
+        engine: no mesh is built and every program traces exactly as
+        before. ``N > 1`` with no explicit ``mesh`` builds a pure-TP
+        mesh over the first N local devices and shards ``params``
+        itself (parallel/sharding.shard_params); with an explicit
+        ``mesh`` the counts must agree. Attention-head divisibility is
+        validated up front (the KV pool partitions on the head axis —
+        per-shard page pools behind ONE shared block table, so the
+        allocator, COW sharing, and chain export/import are all
+        shard-count-agnostic).
 
         ``page_size`` / ``num_pages``: PAGED KV cache. The decode cache
         becomes one pool of ``num_pages`` fixed pages per layer instead
@@ -220,6 +234,32 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
         if mesh is not None and "model" not in mesh.shape:
             raise ValueError(
                 f"engine mesh needs a 'model' axis, got {mesh.shape}")
+        if tp_shards < 1:
+            raise ValueError(f"tp_shards must be >= 1, got {tp_shards}")
+        if (mesh is not None and tp_shards > 1
+                and int(mesh.shape["model"]) != tp_shards):
+            raise ValueError(
+                f"tp_shards={tp_shards} disagrees with the mesh's "
+                f"'model' axis ({mesh.shape['model']})")
+        if tp_shards > 1:
+            cfg_ = getattr(model.config, "base", model.config)
+            kvh = cfg_.n_kv_heads or cfg_.n_heads
+            if cfg_.n_heads % tp_shards or kvh % tp_shards:
+                raise ValueError(
+                    f"tp_shards={tp_shards} must divide the attention "
+                    f"heads (q={cfg_.n_heads}, kv={kvh}) — the KV pool "
+                    f"partitions on the head axis")
+            if mesh is None:
+                n_dev = len(jax.devices())
+                if n_dev < tp_shards:
+                    raise ValueError(
+                        f"tp_shards={tp_shards} needs that many devices, "
+                        f"have {n_dev}")
+                from k3stpu.parallel.mesh import make_mesh
+                from k3stpu.parallel.sharding import shard_params
+
+                mesh = make_mesh(tp_shards, model_parallelism=tp_shards)
+                params, _ = shard_params(params, mesh)
         if chunk_prefill is not None and chunk_prefill < 1:
             raise ValueError(f"chunk_prefill must be >= 1, got "
                              f"{chunk_prefill}")
@@ -366,6 +406,22 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
             self._cache = jax.tree.map(
                 lambda x: jax.device_put(x, _cache_sharding(x)),
                 self._cache)
+        # Serving-side tensor parallelism degree: the mesh's 'model'
+        # extent whether the mesh was built here (tp_shards > 1) or
+        # handed in pre-built. 1 = monolithic, stats/exposition gated.
+        self.tp_shards = int(mesh.shape["model"]) if mesh is not None else 1
+        if self.paged:
+            # Per-SHARD page bytes: leaves sharded on the head axis put
+            # 1/tp of their bytes on each chip; indivisible leaves are
+            # replicated and cost full freight everywhere. Matches
+            # models/quant.kv_page_bytes(..., tp_shards=) leaf for leaf.
+            tp = self.tp_shards
+            self._page_bytes_per_shard = sum(
+                (v.nbytes // num_pages)
+                // (tp if v.ndim >= 3 and v.shape[2] % tp == 0 else 1)
+                for p, v in
+                jax.tree_util.tree_flatten_with_path(self._cache)[0]
+                if str(getattr(p[-1], "key", "")).endswith("_pages"))
         self._base_key = jax.random.key(seed)
         self._step_counter = 0
 
@@ -392,6 +448,17 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
         self._closed = False
         self._lock = threading.Lock()
         self._obs = obs
+        if obs is not None and tp_shards > 1:
+            # Stamp the shard-count gauge and sample the cross-shard
+            # all-reduce latency once at init (the per-layer psum is
+            # fused inside the jitted programs, so a standalone probe
+            # is the one place its cost is separable). Gated on the
+            # EXPLICIT tp_shards knob — a pre-built mesh alone (the
+            # server's multi-device auto-shard) keeps the monolithic
+            # exposition byte-stable.
+            if getattr(obs, "set_tp_shards", None) is not None:
+                obs.set_tp_shards(self.tp_shards)
+            self._tp_allreduce_probe()
         self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
                        "busy_s": 0.0, "requests": 0,
                        "slot_occupancy_sum": 0.0, "peak_active_slots": 0,
@@ -452,6 +519,30 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
 
     # --- lifecycle and stats --------------------------------------------
 
+    def _tp_allreduce_probe(self) -> None:
+        """Sample the mesh's cross-shard all-reduce latency.
+
+        One tiny jitted sum over a 'model'-sharded array IS an
+        all-reduce on the wire; three timed repetitions after a warmup
+        feed ``k3stpu_serve_tp_allreduce_seconds`` so the histogram
+        carries the collective's standalone cost (inside the decode
+        programs it is fused and overlapped — unobservable on its own).
+        """
+        obs = self._obs
+        if obs is None or getattr(obs, "on_tp_allreduce", None) is None:
+            return
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        x = jax.device_put(
+            np.ones((self.tp_shards, 256), np.float32),
+            NamedSharding(self.mesh, P("model", None)))
+        f = jax.jit(lambda a: jnp.sum(a, axis=0))
+        jax.block_until_ready(f(x))  # compile outside the timed region
+        for _ in range(3):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            obs.on_tp_allreduce(time.perf_counter() - t0)
+
     def close(self) -> None:
         self._closed = True
         self._wd_stop.set()
@@ -487,6 +578,7 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                                        2) if s["steps"] else None)
         s["pcache_entries"] = len(self._pcache)
         s["attn_backend"] = self.attn_backend
+        s["tp_shards"] = self.tp_shards
         if self.breaker is not None:
             s["breaker_state"] = self.breaker.state()
             s["breaker_trips"] = self.breaker.trips
@@ -502,6 +594,11 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                 s.update(ts)
                 s["sessions_tracked"] = len(self._sessions)
             s["page_utilization"] = round((total - free) / total, 4)
+            # HBM planning surface (docs/ARCHITECTURE.md sizing recipe):
+            # per-page bytes for the whole pool and for ONE shard's
+            # slice of it — at tp_shards=1 they coincide.
+            s["page_bytes"] = self._page_bytes
+            s["page_bytes_per_shard"] = self._page_bytes_per_shard
             # Pinned pages with >1 reference ARE the zero-copy sharing:
             # mapped read-only into a live row's table, or claimed by
             # several cache entries (an extended prompt shares its

@@ -308,7 +308,17 @@ class KVManagerMixin:
         gathered at the chain's indices, fetched in a SINGLE
         ``jax.device_get`` of the whole dict (one transfer round-trip,
         not one per layer). Keys are the "/"-joined leaf paths —
-        exactly what ``_restore_pages`` scatters back from."""
+        exactly what ``_restore_pages`` scatters back from.
+
+        This is also what makes the tier/disagg wire format
+        shard-count-AGNOSTIC under tensor parallelism: on a TP engine
+        each pool leaf is sharded on its head axis, and ``device_get``
+        assembles the full head-axis-concat array on the host — the
+        exported bytes are identical whatever ``tp_shards`` produced
+        them. The import side's jitted ``_restore_pages`` scatter then
+        re-splits per the DESTINATION engine's sharding, so a 2-shard
+        prefill replica can hand off to a 1-shard decode replica (or
+        vice versa) bit-exact (docs/DISAGG.md "TP × disagg")."""
         idx = jnp.asarray(chain, jnp.int32)
         out = {}
         for path, leaf in jax.tree_util.tree_flatten_with_path(
@@ -703,9 +713,12 @@ class KVManagerMixin:
         replica's cached one) and return its finished page chain +
         next-token logits serialized in the checksummed tier wire
         format — the unit a decode-role replica restores with
-        ``import_chain``. Safe from any thread (marshals to the loop
-        thread); raises on any failure so the HTTP layer can signal the
-        decode peer to fall back to a cold prefill."""
+        ``import_chain``. The wire format is shard-count-agnostic:
+        ``_gather_pages`` assembles sharded pool leaves to full
+        head-axis-concat host arrays, so the exporter's ``tp_shards``
+        never leaks into the bytes. Safe from any thread (marshals to
+        the loop thread); raises on any failure so the HTTP layer can
+        signal the decode peer to fall back to a cold prefill."""
         if self._closed:
             raise RuntimeError("engine is closed")
         if not self.paged:
@@ -743,7 +756,10 @@ class KVManagerMixin:
     def import_chain(self, data: bytes, *,
                      timeout_s: float = 60.0) -> bool:
         """Decode-role API: restore a chain exported by a prefill-role
-        peer into this engine's prompt cache. Returns True when the
+        peer into this engine's prompt cache. The peer may run a
+        different ``tp_shards`` — the wire carries full head-axis
+        arrays and the restore scatter re-splits them per THIS
+        engine's sharding. Returns True when the
         next admission of that prompt will be an exact pcache hit;
         False when the transfer was torn/corrupt or could not be
         installed (``transfer_fallbacks`` counted — just submit

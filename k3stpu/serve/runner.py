@@ -74,6 +74,42 @@ class ModelRunnerMixin:
     # cache-model programs themselves are the shared cores in
     # serve/programs.py (one definition for engine + speculative).
 
+    # Tensor parallelism (--tp-shards): when the engine carries a mesh,
+    # params arrive sharded Megatron-style (attention heads and MLP
+    # hidden split over the 'model' axis — parallel/sharding.py) and
+    # every program below is an auto-SPMD program over that mesh. The
+    # KV leaves are pinned to their head-axis layout INSIDE the traced
+    # program via _tp_constrain, so XLA never round-trips the pool
+    # through a replicated layout between the scatter ops and the
+    # attention core — each shard reads and writes only its own heads'
+    # pages. The per-token all-reduce (attention/MLP output psum) is
+    # scheduled by XLA's latency-hiding scheduler, which overlaps it
+    # with the NEXT layer's first matmul where the dependency allows.
+
+    def _tp_constrain(self, cache):
+        """Pin head-axis sharding on KV leaves inside a jitted program.
+
+        (B, S, H, D) dense rows, (P, ps, H, D) page pools and
+        (P, ps, H) int8 scale planes shard on axis 2 when the 'model'
+        axis divides it — the SAME predicate the engine's device_put
+        uses at init, so constraint and resident layout always agree.
+        Indivisible leaves (indices, logits) pass through. No-op (and
+        trace-identical to the pre-TP programs) when there is no mesh.
+        """
+        if self.mesh is None:
+            return cache
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        tp = self.mesh.shape["model"]
+
+        def pin(x):
+            if getattr(x, "ndim", 0) >= 3 and x.shape[2] % tp == 0:
+                return jax.lax.with_sharding_constraint(
+                    x, NamedSharding(self.mesh, P(None, None, "model")))
+            return x
+
+        return jax.tree.map(pin, cache)
+
     @functools.partial(jax.jit, static_argnums=(0,))
     def _decode_step(self, params, cache, toks, temps, topks, topps,
                      step, base_key, aids=None):
@@ -110,8 +146,8 @@ class ModelRunnerMixin:
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _prefill(self, params, block, lens, aids=None):
-        return prefill_core(self.model, params, block, lens,
-                            adapter_ids=aids)
+        return self._tp_constrain(prefill_core(self.model, params, block,
+                                               lens, adapter_ids=aids))
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _scatter(self, big, small, slot_ids):
@@ -154,7 +190,7 @@ class ModelRunnerMixin:
     @functools.partial(jax.jit, static_argnums=(0,))
     def _paged_decode_step(self, params, cache, idx, bts, toks, temps,
                            topks, topps, step, base_key, aids=None):
-        cache = set_cache_index(cache, idx)
+        cache = self._tp_constrain(set_cache_index(cache, idx))
         cache, logits = decode_core(self.pmodel, params, cache, toks,
                                     adapter_ids=aids, block_tables=bts)
         key = jax.random.fold_in(base_key, step)
@@ -164,7 +200,7 @@ class ModelRunnerMixin:
     def _paged_decode_block_step(self, params, cache, idx, bts, toks,
                                  temps, topks, topps, step, base_key,
                                  k_tokens: int, aids=None):
-        cache = set_cache_index(cache, idx)
+        cache = self._tp_constrain(set_cache_index(cache, idx))
         block_key = jax.random.fold_in(base_key, step)
 
         def body(carry, i):
@@ -182,7 +218,7 @@ class ModelRunnerMixin:
 
     @functools.partial(jax.jit, static_argnums=(0,))
     def _paged_extend(self, params, cache, idx, bts, chunk, aids=None):
-        cache = set_cache_index(cache, idx)
+        cache = self._tp_constrain(set_cache_index(cache, idx))
         return extend_core(self.pmodel, params, cache, chunk,
                            adapter_ids=aids, block_tables=bts)[0]
 
@@ -205,7 +241,7 @@ class ModelRunnerMixin:
         the host every dispatch would swamp the win) and is also what
         pins ``speculate=True`` to greedy exactness: there is no
         sampled verify."""
-        cache = set_cache_index(cache, idx)
+        cache = self._tp_constrain(set_cache_index(cache, idx))
         cache, logits = extend_core(self.pmodel, params, cache, chunk,
                                     adapter_ids=aids, block_tables=bts)
         return cache, jnp.argmax(logits, axis=-1).astype(jnp.int32)

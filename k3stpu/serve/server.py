@@ -249,6 +249,7 @@ class InferenceServer:
                  image_size: int = 224, seq_len: int = 128,
                  batch_window_ms: float = 5.0,
                  shard_devices: "int | None" = None,
+                 tp_shards: int = 1,
                  ckpt_dir: "str | None" = None,
                  ckpt_step: "int | None" = None,
                  quant: "str | None" = None,
@@ -281,7 +282,17 @@ class InferenceServer:
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
         plugin's GetPreferredAllocation already made them ICI-adjacent).
-        None = all local devices when there are several, else single."""
+        None = all local devices when there are several, else single.
+
+        ``tp_shards``: the EXPLICIT tensor-parallel width (--tp-shards,
+        the chart's inference.tpShards). Functionally it pins
+        shard_devices to N (the two must agree if both given), and it
+        additionally arms the TP observability surface — the
+        k3stpu_serve_tp_* families, the tp_shards build_info label, the
+        per-shard pages-free series, and the engine's head-divisibility
+        validation. Default 1 leaves every exposition byte identical to
+        the pre-TP server, even on a multi-device host where
+        shard_devices still auto-shards the mesh."""
         import jax
 
         self.model_name = model_name
@@ -323,6 +334,19 @@ class InferenceServer:
         self.role = role
         self._prefill_upstream = prefill_upstream
         self._prefill_timeout_s = 30.0
+        # Tensor-parallel width (docs/ARCHITECTURE.md HBM sizing). The
+        # explicit knob both pins the mesh width and opts in to the TP
+        # exposition; auto-sharding alone (multi-device host, no flag)
+        # keeps the monolithic exposition byte-stable.
+        if tp_shards < 1:
+            raise ValueError(f"--tp-shards must be >= 1, got {tp_shards}")
+        if tp_shards > 1:
+            if shard_devices is not None and shard_devices != tp_shards:
+                raise ValueError(
+                    f"--tp-shards {tp_shards} disagrees with "
+                    f"--shard-devices {shard_devices}")
+            shard_devices = tp_shards
+        self.tp_shards = tp_shards
         # Two locks with distinct jobs: _lock serializes DEVICE dispatch
         # ("one chip, one queue" — held for whole generations), while
         # _stats_lock guards only the counters, so /metrics scrapes and
@@ -340,7 +364,8 @@ class InferenceServer:
         # ONE instance feeds /metrics, /debug/requests, /debug/trace —
         # and the engine loop's hooks when continuous batching is on.
         self._obs = ServeObs(instance=instance, attn_backend=attn_backend,
-                             role=None if role == "monolithic" else role)
+                             role=None if role == "monolithic" else role,
+                             tp_shards=tp_shards if tp_shards > 1 else None)
         self._profile_lock = threading.Lock()  # one /debug/profile at a time
         # Failure containment (docs/RESILIENCE.md): the engine-facing
         # knobs default ON here (the HTTP server is the production
@@ -590,6 +615,12 @@ class InferenceServer:
         n_local = len(jax.local_devices())
         if shard_devices is None:
             shard_devices = n_local if n_local > 1 else 1
+        if tp_shards > n_local:
+            raise ValueError(
+                f"--tp-shards {tp_shards} exceeds the {n_local} local "
+                f"device(s) this replica holds (the chart's "
+                f"inference.tpShards sets the pod's google.com/tpu "
+                f"resource count to match)")
         self._mesh = None
         if shard_devices > 1:
             from k3stpu.parallel.mesh import make_mesh
@@ -685,6 +716,7 @@ class InferenceServer:
                 self.model, self._variables["params"], slots=engine_slots,
                 chunk_prefill=prefill_chunk, decode_block=decode_block,
                 prompt_cache=prompt_cache, mesh=self._mesh,
+                tp_shards=tp_shards,
                 max_pending=max_pending, page_size=kv_page_size,
                 num_pages=kv_pages, attn_backend=attn_backend,
                 speculate=speculate,
@@ -1657,6 +1689,7 @@ class InferenceServer:
             "batching": {"window_ms": (self._batcher._window_s * 1e3
                                        if self._batcher else 0.0)},
             "sharding": (dict(self._mesh.shape) if self._mesh else None),
+            "tp_shards": self.tp_shards,
             "adapters": (["base"] + self.adapter_names
                          if self.adapter_names else None),
             "quant": self._quant_card(),
@@ -2068,6 +2101,14 @@ def main(argv=None) -> int:
                     help="tensor-parallel serving over N local chips "
                          "(default: all local devices when a multi-chip "
                          "pod granted several; 1 = single-chip)")
+    ap.add_argument("--tp-shards", type=int, default=1,
+                    help="EXPLICIT tensor-parallel width for the serving "
+                         "engine: shard attention heads / MLP hidden and "
+                         "the paged KV pool across N chips ('model' mesh "
+                         "axis) and arm the k3stpu_serve_tp_* metric "
+                         "families. Default 1 keeps the monolithic path "
+                         "(and its exposition) byte-stable; implies "
+                         "--shard-devices N when > 1")
     ap.add_argument("--profile-port", type=int, default=0,
                     help="expose jax.profiler.start_server on this port "
                          "(0 = off); capture with jax.profiler.trace or "
@@ -2248,6 +2289,7 @@ def main(argv=None) -> int:
                              image_size=args.image_size, seq_len=args.seq_len,
                              batch_window_ms=args.batch_window_ms,
                              shard_devices=args.shard_devices,
+                             tp_shards=args.tp_shards,
                              ckpt_dir=args.ckpt_dir,
                              ckpt_step=args.ckpt_step,
                              quant=args.quant,

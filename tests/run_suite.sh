@@ -63,6 +63,7 @@ SMOKE=(
   tests/test_router.py
   tests/test_autoscaler.py
   tests/test_disagg.py
+  tests/test_tp_serve.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -104,6 +104,32 @@ def test_parse_replica_metrics_non_paged_and_missing_families():
     assert s2.ok and s2.queue_depth == 0.0
 
 
+def test_parse_tp_per_shard_pages_free_takes_min():
+    """A tensor-parallel replica exposes per-shard pool gauges
+    (k3stpu_serve_tp_pages_free{shard="i"}); the parser must take the
+    MIN across shards — the tightest pool gates admission, and summing
+    would overstate the fleet's headroom N-fold."""
+    text = _exposition(pages_free=40, pages_total=80) + "\n".join([
+        "# HELP k3stpu_serve_tp_pages_free f",
+        "# TYPE k3stpu_serve_tp_pages_free gauge",
+        'k3stpu_serve_tp_pages_free{shard="0"} 24',
+        'k3stpu_serve_tp_pages_free{shard="1"} 8',
+    ]) + "\n"
+    s = parse_replica_metrics("http://r0", text)
+    assert s.pages_free == 8.0          # min, not 32 (sum) or 24
+    assert s.pages_free_frac == pytest.approx(0.1)
+    # Monolithic replica (no per-shard family): the unlabeled engine
+    # gauge still rules.
+    s2 = parse_replica_metrics("http://r0",
+                               _exposition(pages_free=40, pages_total=80))
+    assert s2.pages_free == 40.0
+    # And the policy sees the tight shard: a fleet whose TP replica is
+    # page-starved aggregates to the starved fraction even when the
+    # unlabeled gauge looks healthy.
+    fleet = FleetSignals([s, s2])
+    assert fleet.pages_free_frac == pytest.approx(0.1)
+
+
 def test_scrape_unreachable_is_ok_false_not_raise():
     s = scrape("http://127.0.0.1:1", timeout_s=0.2)
     assert not s.ok

@@ -117,6 +117,43 @@ def test_int8_pages_bounded_drift():
     _agree(q, kp, vp, bt, lens, kw, 1e-4)
 
 
+def test_sharded_head_slice_walk_parity():
+    """Tensor-parallel pool walk (engine tp_shards=N): each shard's
+    kernel sees only ITS heads' slice of the page pool (axis 2) and its
+    matching query-head group, but the same block tables and lengths.
+    Running the kernel on a head-slice must equal the reference on the
+    same slice — per-head independence is what makes the head-axis
+    shard legal, so this is the sharded walk's parity oracle. GQA
+    shape: 8 query heads over 4 kv heads, split 2 ways."""
+    q, kp, vp, bt, lens, kw = _inputs(
+        3, 1, 8, 4, 32, 32, 8, [5, 17, 31], seed=11)
+    full = paged_attention_reference(q, kp, vp, bt, lens, **kw)
+    group = 8 // 4  # query heads per kv head
+    for shard, (k0, k1) in enumerate(((0, 2), (2, 4))):
+        q_s = q[:, :, k0 * group:k1 * group]
+        kp_s, vp_s = kp[:, :, k0:k1], vp[:, :, k0:k1]
+        _agree(q_s, kp_s, vp_s, bt, lens, kw, 1e-5)
+        # And the slice IS the full result's head range — nothing
+        # about the walk couples heads across the shard boundary.
+        got = paged_attention(q_s, kp_s, vp_s, bt, lens, interpret=True,
+                              **kw)
+        err = float(jnp.max(jnp.abs(
+            got - full[:, :, k0 * group:k1 * group])))
+        assert err < 1e-5, f"shard {shard} diverged from full walk: {err}"
+
+
+def test_sharded_head_slice_walk_parity_int8():
+    """Same oracle over an int8 pool: the scale planes slice on the
+    same head axis, so a shard dequantizes exactly its own heads."""
+    q, kp, vp, bt, lens, kw = _inputs(
+        2, 1, 4, 4, 32, 32, 8, [9, 26], int8=True, seed=12)
+    for k0, k1 in ((0, 2), (2, 4)):
+        kw_s = {"k_scale_pages": kw["k_scale_pages"][:, :, k0:k1],
+                "v_scale_pages": kw["v_scale_pages"][:, :, k0:k1]}
+        _agree(q[:, :, k0:k1], kp[:, :, k0:k1], vp[:, :, k0:k1],
+               bt, lens, kw_s, 1e-4)
+
+
 def test_bf16_pools_bounded_drift():
     # bf16 pools: the kernel accumulates fp32 where the gather path
     # rounds probs through bf16, so drift is bounded, not bit-tight.

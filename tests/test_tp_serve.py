@@ -1,0 +1,289 @@
+"""Tensor-parallel serving (engine ``tp_shards=``): exactness and
+accounting.
+
+The TP contract is the dense/paged contract one more time: sharding the
+attention heads, MLP hidden, and KV page pool across a 'model' mesh
+axis is an EXECUTION-LAYOUT change, not a numerical one — greedy decode
+must be token-identical between ``tp_shards=1`` and ``tp_shards=2`` on
+the same seed, across every serving mode that touches the pool (ragged
+batches, COW shared-prefix prompt cache, int8 pools, speculative
+decode). The accounting half pins what the layout buys: per-shard pool
+bytes halve (stats + the models/quant byte model), the
+``k3stpu_serve_tp_*`` families arm only on an explicit TP engine, and
+the disagg wire format stays shard-count-agnostic (a 2-shard prefill
+replica hands off to a 1-shard decode replica bit-exact —
+docs/DISAGG.md "TP x disagg").
+
+Runs on the conftest-forced 8-virtual-device CPU backend; anything
+needing 2+ devices skips below that.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.models.quant import kv_page_bytes
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.obs import ServeObs
+from k3stpu.parallel.mesh import make_mesh
+from k3stpu.serve.engine import GenerateEngine
+
+needs_2 = pytest.mark.skipif(len(jax.devices()) < 2,
+                             reason="needs >= 2 devices for tp_shards=2")
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("slots", 4)
+    kw.setdefault("seed", 0)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("num_pages", 33)
+    return GenerateEngine(model, params, **kw)
+
+
+def _pair(model, params, **kw):
+    """A single-chip engine and a 2-shard engine with identical
+    scheduling parameters (same seed => identical sampling-key
+    folds)."""
+    mono = _engine(model, params, **kw)
+    tp = _engine(model, params, tp_shards=2, **kw)
+    return mono, tp
+
+
+RAGGED = [[5, 6, 7], [3, 4, 5, 6, 7, 8, 9, 10],
+          list(range(1, 21)), [40, 41]]
+
+
+# --- 1. token identity across serving modes -----------------------------
+
+
+@needs_2
+def test_tp_ragged_greedy_token_identical(mp):
+    """The headline exactness gate: concurrent ragged greedy requests
+    decode token-identically on the 2-shard engine."""
+    model, params = mp
+    mono, tp = _pair(model, params)
+    try:
+        want, got = {}, {}
+        for eng, out in ((mono, want), (tp, got)):
+            threads = [threading.Thread(
+                target=lambda p=p, e=eng, o=out: o.__setitem__(
+                    id(p), e.submit([p], max_new_tokens=12)))
+                for p in RAGGED]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        assert got == want and len(want) == len(RAGGED)
+    finally:
+        mono.close()
+        tp.close()
+
+
+@needs_2
+def test_tp_cow_shared_prefix_token_identical(mp):
+    """Prompt-cache COW path: an exact hit and a prefix-extend both
+    walk shared pages — the sharded pool must serve them identically
+    and count the same hits."""
+    model, params = mp
+    mono, tp = _pair(model, params, prompt_cache=4)
+    try:
+        base = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        ext = base + [20, 21, 22]
+        for eng in (mono, tp):
+            eng.submit([base], max_new_tokens=4)  # seed the cache
+        assert (tp.submit([base], max_new_tokens=6)
+                == mono.submit([base], max_new_tokens=6))
+        assert (tp.submit([ext], max_new_tokens=6)
+                == mono.submit([ext], max_new_tokens=6))
+        for eng in (mono, tp):
+            s = eng.stats()
+            assert s["pcache_hits"] >= 1
+            assert s["pcache_prefix_hits"] >= 1
+    finally:
+        mono.close()
+        tp.close()
+
+
+@needs_2
+def test_tp_int8_pool_token_identical():
+    """int8 KV pools carry a per-(page, slot, head) scale plane — also
+    head-axis sharded, so quantize/dequantize must round-trip the same
+    values per shard."""
+    model = transformer_lm_tiny(max_seq_len=64, kv_cache_dtype="int8")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    mono, tp = _pair(model, params)
+    try:
+        for p in RAGGED:
+            assert (tp.submit([p], max_new_tokens=8)
+                    == mono.submit([p], max_new_tokens=8))
+    finally:
+        mono.close()
+        tp.close()
+
+
+@needs_2
+def test_tp_speculative_token_identical(mp):
+    """Speculative decode's verify-extend dispatch writes gamma+1
+    positions per row per step — the widest pool-write path, so the
+    sharded scatter gets no slack here."""
+    model, params = mp
+    mono, tp = _pair(model, params, speculate=True)
+    try:
+        for p in RAGGED:
+            assert (tp.submit([p], max_new_tokens=8)
+                    == mono.submit([p], max_new_tokens=8))
+        # Acceptance accounting must agree too: same tokens => same
+        # draft/verify outcomes.
+        assert tp.stats()["spec_accepted"] == mono.stats()["spec_accepted"]
+    finally:
+        mono.close()
+        tp.close()
+
+
+# --- 2. the accounting the layout buys ----------------------------------
+
+
+@needs_2
+def test_tp_stats_and_per_shard_bytes(mp):
+    """stats() carries the shard count and the per-shard pool bill —
+    halved at 2 shards, in exact agreement with the models/quant byte
+    model the HBM-sizing recipe uses (docs/ARCHITECTURE.md)."""
+    model, params = mp
+    mono, tp = _pair(model, params)
+    try:
+        sm, st = mono.stats(), tp.stats()
+        assert sm["tp_shards"] == 1 and st["tp_shards"] == 2
+        assert sm["page_bytes"] == st["page_bytes"]  # pool-wide bill
+        assert st["page_bytes_per_shard"] * 2 == sm["page_bytes_per_shard"]
+        cfg = model.config
+        assert (kv_page_bytes(cfg, 8, tp_shards=2) * 2
+                == kv_page_bytes(cfg, 8))
+        # num_pages * per-page bytes == the pool's modeled bill.
+        assert (kv_page_bytes(cfg, 8, tp_shards=2) * st["pages_total"]
+                == st["page_bytes_per_shard"] * st["pages_total"])
+    finally:
+        mono.close()
+        tp.close()
+
+
+def test_kv_page_bytes_tp_validation():
+    cfg = transformer_lm_tiny(max_seq_len=64).config
+    with pytest.raises(ValueError):
+        kv_page_bytes(cfg, 8, tp_shards=0)
+    with pytest.raises(ValueError):
+        kv_page_bytes(cfg, 8, tp_shards=3)  # 4 kv heads % 3 != 0
+
+
+@needs_2
+def test_tp_obs_families_arm_only_on_explicit_tp(mp):
+    """The k3stpu_serve_tp_* families render on a tp_shards=2 engine
+    (shard count, all-reduce probe samples, per-shard pages-free) and
+    are ABSENT from a monolithic engine's exposition — including one
+    handed a pre-built mesh, the server's multi-device auto-shard
+    default, which must stay byte-stable."""
+    model, params = mp
+    obs_tp = ServeObs()
+    tp = _engine(model, params, tp_shards=2, obs=obs_tp)
+    try:
+        tp.submit([[5, 6, 7]], max_new_tokens=4)
+        text = obs_tp.render_prometheus()
+        assert "k3stpu_serve_tp_shards 2" in text
+        assert "k3stpu_serve_tp_allreduce_seconds_count" in text
+        # Per-shard pool series, one per shard, sampled by the loop.
+        assert 'k3stpu_serve_tp_pages_free{shard="0"}' in text
+        assert 'k3stpu_serve_tp_pages_free{shard="1"}' in text
+        free = tp.stats()["pages_free"]
+        assert obs_tp.tp_pages_free.get("0") == float(free)
+        assert obs_tp.tp_pages_free.get("1") == float(free)
+    finally:
+        tp.close()
+
+    obs_mono = ServeObs()
+    n = len(jax.devices())
+    mesh = make_mesh(n, model_parallelism=n)
+    mono = _engine(model, params, mesh=mesh, obs=obs_mono)
+    try:
+        mono.submit([[5, 6, 7]], max_new_tokens=4)
+        assert "k3stpu_serve_tp" not in obs_mono.render_prometheus()
+    finally:
+        mono.close()
+
+
+@needs_2
+def test_tp_validation_errors(mp):
+    model, params = mp
+    with pytest.raises(ValueError):
+        _engine(model, params, tp_shards=0)
+    with pytest.raises(ValueError):  # 4 heads % 3 != 0
+        _engine(model, params, tp_shards=3)
+    with pytest.raises(ValueError):  # more shards than devices
+        _engine(model, params, tp_shards=2 * len(jax.devices()))
+    with pytest.raises(ValueError):  # mesh width disagrees with knob
+        mesh = make_mesh(4, model_parallelism=4)
+        _engine(model, params, mesh=mesh, tp_shards=2)
+
+
+# --- 3. TP x disagg: shard-count-agnostic wire format -------------------
+
+
+@needs_2
+def test_tp_prefill_to_mono_decode_handoff_bit_exact(mp):
+    """A 2-shard prefill replica exports, a 1-shard decode replica
+    imports — and decodes token-identically to a monolithic engine
+    that never saw a handoff. The wire carries full head-axis-concat
+    arrays (_gather_pages assembles sharded leaves on device_get), so
+    the exporter's tp_shards never leaks into the bytes."""
+    model, params = mp
+    src = _engine(model, params, tp_shards=2, prompt_cache=4)
+    dst = _engine(model, params, prompt_cache=4)
+    mono = _engine(model, params, prompt_cache=4)
+    try:
+        p = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        data = src.export_chain(p)
+        assert dst.import_chain(data)
+        want = mono.submit([p], max_new_tokens=6)
+        assert dst.submit([p], max_new_tokens=6) == want
+        s = dst.stats()
+        assert s["kv_imports"] == 1 and s["pcache_hits"] == 1
+        assert s["transfer_fallbacks"] == 0
+        # Shard-count-agnostic means SHAPE-agnostic: the 2-shard
+        # export carries the same full head-axis arrays a 1-shard one
+        # does (the pool values themselves may differ in float ULPs —
+        # sharded reductions re-associate), so the serialized sizes
+        # match and the 1-shard bytes restore interchangeably.
+        assert len(mono.export_chain(p)) == len(data)
+        assert dst.import_chain(mono.export_chain(p))
+    finally:
+        for e in (src, dst, mono):
+            e.close()
+
+
+@needs_2
+def test_mono_prefill_to_tp_decode_handoff_bit_exact(mp):
+    """The reverse direction: a 1-shard export restores into a 2-shard
+    pool (the import scatter re-splits per the DESTINATION sharding)."""
+    model, params = mp
+    src = _engine(model, params, prompt_cache=4)
+    dst = _engine(model, params, tp_shards=2, prompt_cache=4)
+    mono = _engine(model, params, prompt_cache=4)
+    try:
+        p = [30, 31, 32, 33, 34, 35, 36]
+        assert dst.import_chain(src.export_chain(p))
+        assert (dst.submit([p], max_new_tokens=6)
+                == mono.submit([p], max_new_tokens=6))
+        assert dst.stats()["pcache_hits"] == 1
+    finally:
+        for e in (src, dst, mono):
+            e.close()

@@ -74,11 +74,14 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 # added here. "backend" is the attention-backend enum (xla-gather /
 # pallas-paged), fixed at construction on the decode-dispatch histogram;
 # "direction" is the autoscaler's fixed {up, down} enum; "role" is the
-# disagg serving-role enum (prefill / decode) on k3stpu_build_info.
+# disagg serving-role enum (prefill / decode) on k3stpu_build_info;
+# "shard" is bounded by --tp-shards (the per-shard pages-free series a
+# TP replica appends, k3stpu_engine_pages_free{shard="i"}); "tp_shards"
+# is the single configured shard count stamped on k3stpu_build_info.
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       "component", "version", "instance",
                       "replica", "reason", "backend", "direction",
-                      "role"}
+                      "role", "shard", "tp_shards"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
@@ -96,6 +99,7 @@ def _families_from_obs() -> "list[tuple[str, str, str]]":
         Histogram,
         InfoGauge,
         LabeledCounter,
+        LabeledGauge,
     )
     from k3stpu.obs.train import TrainObs
 
@@ -106,7 +110,7 @@ def _families_from_obs() -> "list[tuple[str, str, str]]":
                 fams.append((attr.name, "histogram", attr.help))
             elif isinstance(attr, (Counter, LabeledCounter)):
                 fams.append((attr.name, "counter", attr.help))
-            elif isinstance(attr, (Gauge, InfoGauge)):
+            elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
                 fams.append((attr.name, "gauge", attr.help))
     return fams
 
