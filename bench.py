@@ -68,6 +68,14 @@ failed requests, and a session parked by the scale-down drain protocol
 survivor: restore <= 1/3 of a cold re-prefill, the --serve-tier bound
 (vs_baseline = ratio*3, <=1.0 passes; scale/zero-fail gates in detail).
 
+``--serve-canary`` gates the correctness watchdog (same contract): a
+2-replica routed fleet under threaded loadgen, paired arms with the
+blackbox canary probing at 1 Hz (all four known-answer paths) vs
+canary-off; the prober must cost <= 5% of loadgen throughput
+(vs_baseline = overhead/5) AND, with gen_corrupt armed on one replica
+(silent token corruption, /healthz stays green), flag the mismatch
+within two probe rounds (detection gate in detail).
+
 ``--train-obs`` is the training twin (same contract): median step time
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
@@ -1403,6 +1411,229 @@ def _serve_router_main() -> int:
                  **skw)
 
 
+def _serve_canary_worker() -> int:
+    """Correctness-canary gate (bounded subprocess, CPU tiny model,
+    loopback HTTP).
+
+    Paired arms over ONE live 2-replica routed fleet: threaded loadgen
+    through the router with the canary OFF, then the identical loadgen
+    with the canary probing at 1 Hz (all four paths: router, per-
+    replica, two-turn session, SSE stream). Best-of-N throughput per
+    arm (the --serve-obs noise idiom); the watchdog must cost <= 5% of
+    loadgen throughput — its probes ride the same continuous batches
+    as organic traffic, so the marginal cost is a few extra rows, not
+    extra dispatches.
+
+    Then the detection leg, the reason the subsystem exists: arm
+    ``gen_corrupt`` on one replica (every output token perturbed,
+    request still completes with nominal status/latency) and the
+    canary must flag the token mismatch within TWO probe rounds while
+    the corrupt replica's own /healthz stays green."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    from k3stpu.canary import Canary, CanaryObs
+    from k3stpu.chaos import FaultInjector
+    from k3stpu.router.router import Router, make_router_app
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    prompt_len, reply = 48, 8
+    n_threads, reqs_per_thread, runs_per_arm = 3, 16, 3
+    probe_interval_s = 1.0
+
+    def prompt_for(seed: int) -> "list[int]":
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 1000, size=(prompt_len,)).tolist()
+
+    servers: list = []
+    httpds: list = []
+    urls: "list[str]" = []
+    inj = FaultInjector()  # armed only for the detection leg
+    try:
+        for name, chaos in (("bench-can-a", None), ("bench-can-b", inj)):
+            # prompt_cache=0 on purpose: the arms replay the SAME
+            # prompts (paired), so any cache would hand the second arm
+            # free prefills and bias the overhead negative. It also
+            # charges the canary full prefill per probe — the honest
+            # worst case for the 5% budget.
+            srv = InferenceServer(
+                model_name="transformer-tiny", seq_len=256,
+                batch_window_ms=0.0, continuous_batching=True,
+                decode_block=4, prompt_cache=0, kv_page_size=16,
+                kv_pages=128, shard_devices=None, instance=name,
+                chaos=chaos)
+            servers.append(srv)
+            httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+            httpds.append(httpd)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+        router = Router(urls, health_period_s=5.0,
+                        instance="bench-canary-router")
+        rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_router_app(router))
+        threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+        rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        # Warm every jitted program both arms touch: the loadgen
+        # prompt shape on each replica, then one full probe round
+        # (probe-prompt buckets, session park/restore, SSE path).
+        for srv in servers:
+            srv.generate_tokens([prompt_for(999)], max_new_tokens=reply)
+        can = Canary(rurl, prompts=((1, 2, 3, 4),), max_new_tokens=4,
+                     timeout_s=60.0, obs=CanaryObs(instance="bench"))
+        can.record_golden()
+        if not all(r.verdict == "ok" for r in can.probe_round()):
+            raise RuntimeError("clean probe round failed — fleet broken")
+
+        def post(body: dict) -> dict:
+            req = urllib.request.Request(
+                rurl + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read().decode())
+
+        def loadgen_once(seed_base: int) -> float:
+            """One timed loadgen run; returns organic requests/s."""
+            def go(tid: int):
+                for j in range(reqs_per_thread):
+                    out = post({"prompt_tokens":
+                                [prompt_for(seed_base + tid * 100 + j)],
+                                "max_new_tokens": reply})
+                    assert len(out["tokens"][0]) == reply
+            threads = [threading.Thread(target=go, args=(i,))
+                       for i in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return (n_threads * reqs_per_thread) / (time.perf_counter()
+                                                    - t0)
+
+        def arm(with_canary: bool, seed_base: int) -> float:
+            stop = threading.Event()
+            prober = None
+            if with_canary:
+                def probe_loop():
+                    # Fire immediately, then on the interval — a short
+                    # run must still overlap at least one probe round
+                    # or the on-arm measures nothing.
+                    while True:
+                        can.probe_round()
+                        if stop.wait(probe_interval_s):
+                            return
+                prober = threading.Thread(target=probe_loop, daemon=True)
+                prober.start()
+            try:
+                return max(loadgen_once(seed_base + r * 1000)
+                           for r in range(runs_per_arm))
+            finally:
+                stop.set()
+                if prober is not None:
+                    prober.join()
+
+        loadgen_once(5_000)  # unmeasured warm pass: caches, threads
+        rps_off = arm(False, 10_000)
+        rps_on = arm(True, 10_000)  # same prompts: paired arms
+        overhead_pct = ((1.0 - rps_on / rps_off) * 100.0
+                        if rps_off else 0.0)
+
+        # Detection leg: silent corruption on replica B, flagged fast.
+        inj.arm("gen_corrupt", times=100_000)
+        rounds_to_flag = 0
+        for i in range(2):
+            if any(r.verdict == "mismatch" for r in can.probe_round()):
+                rounds_to_flag = i + 1
+                break
+        with urllib.request.urlopen(urls[1] + "/healthz",
+                                    timeout=10) as r:
+            bad_healthz_ok = bool(json.loads(r.read()).get("ok"))
+    finally:
+        try:
+            rhttpd.shutdown()
+            router.close()
+        except NameError:
+            pass
+        for httpd in httpds:
+            httpd.shutdown()
+        for srv in servers:
+            srv.close()
+
+    doc = {
+        # Headline: loadgen throughput lost to the 1 Hz prober, in
+        # percent. The bar is 5%; vs_baseline = value/5 so <=1.0 means
+        # within budget (negative = run-to-run noise exceeded the true
+        # cost). Detection gate rides in detail.
+        "metric": "serve_canary_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "pct_loadgen_requests_per_s",
+        "vs_baseline": round(overhead_pct / 5.0, 4),
+        "detail": {
+            "budget_pct": 5.0,
+            "overhead_gate_passed": overhead_pct <= 5.0,
+            "requests_per_s_canary_off": round(rps_off, 3),
+            "requests_per_s_canary_on": round(rps_on, 3),
+            "probe_interval_s": probe_interval_s,
+            "runs_per_arm": runs_per_arm,
+            "loadgen_threads": n_threads,
+            "requests_per_thread": reqs_per_thread,
+            "rounds_to_flag_corruption": rounds_to_flag,
+            "gate_detect_within_rounds": 2,
+            "detection_gate_passed": 1 <= rounds_to_flag <= 2,
+            "corrupt_replica_healthz_ok": bad_healthz_ok,
+            "replicas": 2,
+            "prompt_tokens": prompt_len,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_canary_main() -> int:
+    """Bounded-subprocess wrapper for --serve-canary (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-canary-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_canary")
+    skw = {"metric": "serve_canary_overhead_pct",
+           "unit": "pct_loadgen_requests_per_s"}
+    if not ok:
+        why = (f"canary bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_canary", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_disagg_worker() -> int:
     """Disaggregated prefill/decode gate (bounded subprocess, CPU tiny
     model, loopback HTTP).
@@ -2504,6 +2735,10 @@ if __name__ == "__main__":
         sys.exit(_serve_autoscale_worker())
     if "--serve-autoscale" in sys.argv[1:]:
         sys.exit(_serve_autoscale_main())
+    if "--serve-canary-worker" in sys.argv[1:]:
+        sys.exit(_serve_canary_worker())
+    if "--serve-canary" in sys.argv[1:]:
+        sys.exit(_serve_canary_main())
     if "--train-obs-worker" in sys.argv[1:]:
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:

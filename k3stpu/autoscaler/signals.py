@@ -23,6 +23,14 @@ Histogram quantiles come from the shared exposition parser + bucket
 interpolation in ``k3stpu.obs.hist`` (the same math loadgen's report
 uses), so a scrape here and a PromQL ``histogram_quantile`` agree.
 
+Canary probes never reach these signals: requests carrying the
+``X-K3STPU-Canary`` header are excluded from the latency histograms at
+observe time (they count only ``k3stpu_serve_synthetic_requests_total``
+— see ``k3stpu/canary``), so a 1 Hz watchdog cannot nudge queue-wait or
+TTFT quantiles and cause phantom scale-ups. Same exclusion feeds the
+SLO burn-rate engine (``k3stpu.obs.slo``): both consumers see organic
+traffic only, by construction rather than by PromQL label filtering.
+
 ``parse_replica_metrics`` is pure (text in, sample out) so the
 signal→decision path is unit-testable without a server; ``scrape``
 adds the one stdlib-HTTP GET around it. All stdlib — no jax.

@@ -1,0 +1,349 @@
+"""Blackbox correctness canary for the routed serving fleet.
+
+The fleet's 117+ metric families measure how FAST it is; nothing
+verifies that a replica still produces the RIGHT tokens — a silently
+miscompiling replica, a corrupt tier restore, or a bad TP re-split
+looks perfectly healthy on every latency gauge. The canary closes that
+gap with known-answer probes (the blackbox-exporter pattern applied to
+greedy token-identity, the repo's core invariant):
+
+- at boot, ``record_golden()`` generates greedy outputs for a small
+  fixed prompt set against ONE healthy replica (discovered through the
+  router's ``/debug/router`` membership) and pins them as the golden
+  answers — greedy decoding is deterministic, so every correct replica
+  must reproduce them token-for-token;
+- each ``probe_round()`` then fires the same prompts along four
+  distinct paths — through the **router** (the client's view), direct
+  to each discovered **replica** (isolates the bad one the router
+  would average away), a two-turn **session** probe (exercises KV
+  park/restore), and an SSE **stream**-integrity probe (deltas must
+  prefix the final frame) — verifying token-exact output and measuring
+  per-path latency.
+
+Probes carry the ``X-K3STPU-Canary: 1`` header, so the server and
+router keep them out of the organic latency histograms (the SLO and
+autoscaler inputs); the canary's own verdicts export as the
+``k3stpu_canary_*`` families (canary/obs.py), composited into
+``k3stpu_canary_fleet_ok`` — the single gauge the CanaryFailing alert
+watches.
+
+Golden-recording caveat (docs/OBSERVABILITY.md): goldens are only
+valid for the model weights they were recorded against. A model
+reload/redeploy must restart the canary so it re-records; a canary
+holding stale goldens reports a fleet-wide mismatch, which is the safe
+failure mode (loud, not silent).
+
+Zero-dep (stdlib http client), same house style as the router tier.
+``python -m k3stpu.canary`` wraps this in the standard metrics-server
+CLI (canary/__main__.py). Chaos point ``canary_probe`` fires at the
+top of every probe so the resilience suite can knock probes out
+without touching the fleet.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+from k3stpu.canary.obs import (  # noqa: F401  (re-exported for tests)
+    PROBE_PATHS,
+    VERDICT_MISMATCH,
+    VERDICT_OK,
+    VERDICT_UNREACHABLE,
+    CanaryObs,
+)
+from k3stpu.chaos import InjectedFault
+
+CANARY_HEADER = "X-K3STPU-Canary"
+
+# The fixed golden prompt set: small, token-id based (model-agnostic —
+# any LM family serves ids), distinct enough to hit different prompt
+# buckets. Tiny on purpose: the canary's job is correctness coverage,
+# not load.
+DEFAULT_PROMPTS = ((1, 2, 3, 4), (5, 6, 7), (2, 4, 6, 8, 9, 10))
+
+
+class ProbeResult:
+    """One probe's outcome: verdict (ok / mismatch / unreachable),
+    latencies, and the detail string a human reads in /healthz."""
+
+    __slots__ = ("path", "verdict", "e2e_s", "ttft_s", "tpot_s", "detail")
+
+    def __init__(self, path: str, verdict: str, e2e_s: float,
+                 ttft_s: "float | None" = None,
+                 tpot_s: "float | None" = None, detail: str = ""):
+        self.path = path
+        self.verdict = verdict
+        self.e2e_s = e2e_s
+        self.ttft_s = ttft_s
+        self.tpot_s = tpot_s
+        self.detail = detail
+
+
+class Canary:
+    """The prober. Construct, ``record_golden()``, then call
+    ``probe_round()`` on the interval; every verdict lands in ``obs``.
+    """
+
+    def __init__(self, router_url: str,
+                 prompts: "tuple | None" = None,
+                 max_new_tokens: int = 8,
+                 timeout_s: float = 30.0,
+                 obs: "CanaryObs | None" = None,
+                 chaos=None,
+                 probe_session: bool = True,
+                 probe_stream: bool = True):
+        self.router_url = router_url.rstrip("/")
+        self.prompts = [list(p) for p in (prompts or DEFAULT_PROMPTS)]
+        self.max_new_tokens = int(max_new_tokens)
+        self.timeout_s = float(timeout_s)
+        self.obs = obs or CanaryObs()
+        self._chaos = chaos
+        self.probe_session = probe_session
+        self.probe_stream = probe_stream
+        # prompt tuple -> golden greedy tokens; the two-turn golden is
+        # keyed by the concatenated turn-2 prompt.
+        self.golden: "dict[tuple, list[int]]" = {}
+        self._session_seq = 0
+
+    # -- HTTP plumbing -----------------------------------------------------
+
+    def _headers(self) -> dict:
+        return {"Content-Type": "application/json", CANARY_HEADER: "1"}
+
+    def _generate(self, base_url: str, prompt: "list[int]",
+                  session: "str | None" = None) -> "list[int]":
+        """One non-streaming greedy generate; returns the single row.
+        Raises OSError/ValueError on anything that isn't a clean
+        200-with-tokens (the caller's unreachable bucket)."""
+        payload = {"prompt_tokens": [prompt],
+                   "max_new_tokens": self.max_new_tokens,
+                   "temperature": 0.0}
+        if session is not None:
+            payload["session"] = session
+        req = urllib.request.Request(
+            base_url + "/v1/generate", method="POST",
+            data=json.dumps(payload).encode(), headers=self._headers())
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            doc = json.loads(r.read())
+        tokens = doc.get("tokens")
+        if (not isinstance(tokens, list) or len(tokens) != 1
+                or not isinstance(tokens[0], list)):
+            raise ValueError(f"malformed generate response: {doc!r}")
+        return [int(t) for t in tokens[0]]
+
+    def _generate_stream(self, base_url: str, prompt: "list[int]"
+                         ) -> "tuple[list[int], list[int], float, float]":
+        """One SSE greedy generate: (final tokens, delta-assembled
+        tokens, ttft_s, t_last_s) measured from request start. Raises
+        on transport errors, error frames, or a missing final frame."""
+        payload = {"prompt_tokens": [prompt],
+                   "max_new_tokens": self.max_new_tokens,
+                   "temperature": 0.0, "stream": True}
+        req = urllib.request.Request(
+            base_url + "/v1/generate", method="POST",
+            data=json.dumps(payload).encode(), headers=self._headers())
+        t0 = time.perf_counter()
+        t_first = None
+        t_last = t0
+        assembled: "list[int]" = []
+        final: "list[int] | None" = None
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            ctype = r.headers.get("Content-Type", "")
+            if "text/event-stream" not in ctype:
+                raise ValueError(f"expected SSE, got {ctype!r}")
+            for raw in r:
+                line = raw.decode("utf-8", "replace").strip()
+                if not line.startswith("data: "):
+                    continue
+                ev = json.loads(line[len("data: "):])
+                if "error" in ev:
+                    raise ValueError(f"stream error frame: {ev['error']}")
+                now = time.perf_counter()
+                if t_first is None:
+                    t_first = now
+                t_last = now
+                if ev.get("done"):
+                    rows = ev.get("tokens")
+                    if not isinstance(rows, list) or len(rows) != 1:
+                        raise ValueError(f"malformed final frame: {ev!r}")
+                    final = [int(t) for t in rows[0]]
+                else:
+                    for toks in ev.get("rows", {}).values():
+                        assembled.extend(int(t) for t in toks)
+        if final is None:
+            raise ValueError("stream ended without a final frame")
+        return final, assembled, (t_first or t_last) - t0, t_last - t0
+
+    def discover_replicas(self) -> "list[str]":
+        """Healthy replica URLs from the router's /debug/router state
+        (live membership — scale events change the probe set on the
+        next round, no canary restart)."""
+        req = urllib.request.Request(self.router_url + "/debug/router")
+        with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+            state = json.loads(r.read())
+        return [rep["url"] for rep in state.get("replicas", [])
+                if rep.get("healthy") and not rep.get("draining")]
+
+    # -- golden recording --------------------------------------------------
+
+    def record_golden(self) -> int:
+        """Record golden greedy outputs against ONE healthy replica
+        (greedy exactness is the fleet invariant, so any one correct
+        replica defines the answers for all). Also records the two-turn
+        continuation golden for the session probe — turn 2's prompt is
+        turn 1's prompt + its golden reply, and a correct session
+        restore must match a cold prefill of that concatenation
+        token-for-token. Returns the number of goldens recorded;
+        raises when no replica is reachable."""
+        replicas = self.discover_replicas()
+        if not replicas:
+            raise OSError("no healthy replicas to record goldens against")
+        base = replicas[0]
+        golden: "dict[tuple, list[int]]" = {}
+        for prompt in self.prompts:
+            golden[tuple(prompt)] = self._generate(base, prompt)
+        # Two-turn golden for the session probe (first prompt only).
+        p0 = self.prompts[0]
+        turn2 = p0 + golden[tuple(p0)]
+        golden[tuple(turn2)] = self._generate(base, turn2)
+        self.golden = golden
+        self.obs.on_golden(len(golden))
+        return len(golden)
+
+    # -- probes ------------------------------------------------------------
+
+    def _fire_chaos(self) -> None:
+        """Chaos point ``canary_probe``: an armed injector fails the
+        probe into the unreachable bucket — the resilience suite's
+        handle on "the canary itself is blind", distinct from the
+        fleet being wrong."""
+        if self._chaos is not None:
+            self._chaos.fire("canary_probe")
+
+    def _verdict(self, got: "list[int]", want: "list[int]"
+                 ) -> "tuple[str, str]":
+        if got == want:
+            return VERDICT_OK, ""
+        return VERDICT_MISMATCH, f"want {want} got {got}"
+
+    def _probe_generate(self, path: str, base_url: str,
+                        prompts: "list[list[int]]") -> ProbeResult:
+        """Non-stream known-answer probe: every prompt must reproduce
+        its golden; first divergence decides the verdict."""
+        t0 = time.perf_counter()
+        try:
+            self._fire_chaos()
+            for prompt in prompts:
+                got = self._generate(base_url, prompt)
+                verdict, detail = self._verdict(got,
+                                                self.golden[tuple(prompt)])
+                if verdict != VERDICT_OK:
+                    return ProbeResult(path, verdict,
+                                       time.perf_counter() - t0,
+                                       detail=f"{base_url}: {detail}")
+        except (OSError, ValueError, InjectedFault) as e:
+            return ProbeResult(path, VERDICT_UNREACHABLE,
+                               time.perf_counter() - t0,
+                               detail=f"{base_url}: {e}")
+        return ProbeResult(path, VERDICT_OK, time.perf_counter() - t0)
+
+    def _probe_session(self) -> ProbeResult:
+        """Two-turn session probe through the router: turn 1 parks a
+        KV chain under a fresh session id, turn 2 extends it (the
+        restore path — host-tier or prompt-cache hit), and both turns
+        must match their cold-prefill goldens. The session releases
+        afterwards so probe chains never accumulate in the fleet."""
+        self._session_seq += 1
+        sid = f"canary-{self._session_seq}"
+        p0 = self.prompts[0]
+        t0 = time.perf_counter()
+        try:
+            self._fire_chaos()
+            got1 = self._generate(self.router_url, p0, session=sid)
+            verdict, detail = self._verdict(got1, self.golden[tuple(p0)])
+            if verdict == VERDICT_OK:
+                turn2 = p0 + self.golden[tuple(p0)]
+                got2 = self._generate(self.router_url, turn2, session=sid)
+                verdict, detail = self._verdict(
+                    got2, self.golden[tuple(turn2)])
+                if verdict != VERDICT_OK:
+                    detail = f"turn 2 (restore): {detail}"
+            else:
+                detail = f"turn 1: {detail}"
+            self._release_session(sid)
+        except (OSError, ValueError, InjectedFault) as e:
+            return ProbeResult("session", VERDICT_UNREACHABLE,
+                               time.perf_counter() - t0, detail=str(e))
+        return ProbeResult("session", verdict, time.perf_counter() - t0,
+                           detail=detail)
+
+    def _release_session(self, sid: str) -> None:
+        """Best-effort: a failed release costs one parked chain until
+        the replica's own pressure eviction reclaims it — never a
+        probe verdict."""
+        req = urllib.request.Request(
+            self.router_url + "/v1/session/release", method="POST",
+            data=json.dumps({"session": sid}).encode(),
+            headers=self._headers())
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s):
+                pass
+        except (OSError, urllib.error.HTTPError):
+            pass
+
+    def _probe_stream(self) -> ProbeResult:
+        """SSE stream-integrity probe through the router: the final
+        frame must match the golden AND the incremental deltas must be
+        a prefix of it (a relay that reorders or drops frames is a
+        correctness bug even when the final frame survives)."""
+        p0 = self.prompts[0]
+        t0 = time.perf_counter()
+        try:
+            self._fire_chaos()
+            final, assembled, ttft, t_last = self._generate_stream(
+                self.router_url, p0)
+        except (OSError, ValueError, InjectedFault) as e:
+            return ProbeResult("stream", VERDICT_UNREACHABLE,
+                               time.perf_counter() - t0, detail=str(e))
+        e2e = time.perf_counter() - t0
+        n = len(final)
+        tpot = (t_last - ttft) / (n - 1) if n > 1 else None
+        verdict, detail = self._verdict(final, self.golden[tuple(p0)])
+        if verdict == VERDICT_OK and assembled != final[:len(assembled)]:
+            verdict = VERDICT_MISMATCH
+            detail = (f"deltas diverge from final frame: "
+                      f"{assembled} vs {final}")
+        return ProbeResult("stream", verdict, e2e, ttft_s=ttft,
+                           tpot_s=tpot, detail=detail)
+
+    def probe_round(self) -> "list[ProbeResult]":
+        """One full round: router path (all prompts), each discovered
+        replica directly (first prompt), the two-turn session probe,
+        and the stream probe. Verdicts land in obs; fleet_ok composites
+        to 1 only when EVERY probe verified token-exact."""
+        if not self.golden:
+            raise RuntimeError("record_golden() before probe_round()")
+        results = [self._probe_generate("router", self.router_url,
+                                        self.prompts)]
+        try:
+            replicas = self.discover_replicas()
+        except (OSError, ValueError) as e:
+            replicas = []
+            results.append(ProbeResult("replica", VERDICT_UNREACHABLE,
+                                       0.0, detail=f"discovery: {e}"))
+        for url in replicas:
+            results.append(self._probe_generate("replica", url,
+                                                [self.prompts[0]]))
+        if self.probe_session:
+            results.append(self._probe_session())
+        if self.probe_stream:
+            results.append(self._probe_stream())
+        for res in results:
+            self.obs.on_probe(res.path, res.verdict, res.e2e_s,
+                              ttft_s=res.ttft_s, tpot_s=res.tpot_s)
+        all_ok = all(r.verdict == VERDICT_OK for r in results)
+        self.obs.on_round(all_ok, len(replicas))
+        return results

@@ -1,0 +1,207 @@
+"""``python -m k3stpu.canary`` — the fleet correctness watchdog CLI.
+
+Runs the blackbox prober (k3stpu/canary/__init__.py) on an interval
+against a routed fleet, hosts the multi-window SLO burn-rate engine
+(k3stpu/obs/slo.py) over the fleet's organic latency histograms, and
+serves both metric surfaces on its own ``/metrics`` + ``/healthz``
+port — the same metrics-server shape as the router and autoscaler
+CLIs, SIGTERM drain trio included.
+
+Each round:
+1. ``probe_round()``: known-answer probes along the router / replica /
+   session / stream paths; verdicts export as ``k3stpu_canary_*``.
+2. Scrape every discovered replica's ``/metrics``, merge the SLO
+   histograms fleet-wide, ingest into the SloEngine, and re-evaluate
+   burn rates — exported as ``k3stpu_slo_*``. Canary traffic is
+   already excluded upstream (X-K3STPU-Canary), so the SLO math here
+   is organic-only without any label filtering.
+
+Run: python -m k3stpu.canary --router http://tpu-router:8095
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k3stpu.canary import Canary, CanaryObs
+from k3stpu.obs.slo import SloEngine, SloSpec
+
+
+def make_canary_app(canary: Canary, slo: SloEngine):
+    """The canary's own /metrics + /healthz surface — same handler
+    idiom as the autoscaler's, with the SLO families appended to the
+    canary exposition."""
+    obs = canary.obs
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/healthz" or self.path == "/livez":
+                self._send(200, {
+                    "ok": True,
+                    "golden_prompts": int(obs.golden_prompts.value),
+                    "fleet_ok": obs.fleet_ok.value,
+                    "rounds": int(obs.rounds.value)})
+            elif self.path == "/metrics":
+                accept = self.headers.get("Accept", "")
+                if "application/openmetrics-text" in accept:
+                    # CanaryObs ends with "# EOF"; the SLO block (plain
+                    # gauges, OpenMetrics-identical) slots in before it.
+                    om = obs.render_openmetrics()
+                    if om.endswith("# EOF\n"):
+                        om = om[:-len("# EOF\n")]
+                    body = (om + slo.render_prometheus()
+                            + "\n# EOF\n").encode()
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8")
+                else:
+                    body = (obs.render_prometheus()
+                            + slo.render_prometheus() + "\n").encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": f"no route {self.path}"})
+
+    return Handler
+
+
+def _scrape(url: str, timeout_s: float) -> "str | None":
+    try:
+        with urllib.request.urlopen(url + "/metrics",
+                                    timeout=timeout_s) as r:
+            return r.read().decode("utf-8", "replace")
+    except (OSError, ValueError):
+        return None
+
+
+def run_loop(canary: Canary, slo: SloEngine, interval_s: float,
+             stop: "threading.Event", scrape_timeout_s: float = 2.0
+             ) -> None:
+    """Record goldens (retrying until the fleet answers), then probe +
+    ingest + evaluate every interval until stopped."""
+    while not stop.is_set():
+        try:
+            n = canary.record_golden()
+            print(f"canary: recorded {n} goldens", flush=True)
+            break
+        except (OSError, ValueError) as e:
+            print(f"canary: golden recording failed ({e}); retrying",
+                  flush=True)
+            stop.wait(interval_s)
+    while not stop.is_set():
+        t0 = time.perf_counter()
+        try:
+            results = canary.probe_round()
+            bad = [r for r in results if r.verdict != "ok"]
+            if bad:
+                print("canary: " + json.dumps({
+                    "event": "probe_failed",
+                    "failures": [{"path": r.path, "verdict": r.verdict,
+                                  "detail": r.detail} for r in bad]}),
+                    flush=True)
+        except Exception as e:  # noqa: BLE001 — the loop must live
+            print(f"canary: round failed: {e}", flush=True)
+        try:
+            replicas = canary.discover_replicas()
+            texts = [t for t in (_scrape(u, scrape_timeout_s)
+                                 for u in replicas) if t is not None]
+            if texts:
+                slo.ingest(texts, time.time())
+            slo.evaluate(time.time())
+        except Exception as e:  # noqa: BLE001
+            print(f"canary: slo ingest failed: {e}", flush=True)
+        elapsed = time.perf_counter() - t0
+        stop.wait(max(0.0, interval_s - elapsed))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="K3S-TPU blackbox correctness canary + SLO engine")
+    ap.add_argument("--router", default="http://127.0.0.1:8095",
+                    help="router base URL (probe target + replica "
+                         "discovery via /debug/router)")
+    ap.add_argument("--interval-s", type=float, default=15.0,
+                    help="probe round interval")
+    ap.add_argument("--max-new-tokens", type=int, default=8,
+                    help="golden generation budget per probe prompt")
+    ap.add_argument("--probe-timeout-s", type=float, default=30.0)
+    ap.add_argument("--no-probe-session", action="store_true",
+                    help="skip the two-turn session probe (fleets "
+                         "without paged engines 400 it)")
+    ap.add_argument("--no-probe-stream", action="store_true",
+                    help="skip the SSE stream-integrity probe")
+    ap.add_argument("--slo-ttft-threshold-s", type=float, default=2.5,
+                    help="TTFT SLO latency threshold (mirrors the "
+                         "chart's rules.ttftP99SloSeconds)")
+    ap.add_argument("--slo-target", type=float, default=0.999,
+                    help="TTFT SLO target fraction")
+    ap.add_argument("--slo-window-days", type=float, default=30.0,
+                    help="TTFT SLO error-budget window")
+    ap.add_argument("--metrics-port", type=int, default=8093,
+                    help="own /metrics + /healthz port (0 disables)")
+    ap.add_argument("--instance", default=None,
+                    help="identity stamp for k3stpu_build_info")
+    args = ap.parse_args(argv)
+
+    from k3stpu.chaos import chaos_from_env
+
+    canary = Canary(args.router,
+                    max_new_tokens=args.max_new_tokens,
+                    timeout_s=args.probe_timeout_s,
+                    obs=CanaryObs(instance=args.instance),
+                    chaos=chaos_from_env(),
+                    probe_session=not args.no_probe_session,
+                    probe_stream=not args.no_probe_stream)
+    slo = SloEngine([SloSpec("ttft", "k3stpu_request_ttft_seconds",
+                             threshold_s=args.slo_ttft_threshold_s,
+                             target=args.slo_target,
+                             window_days=args.slo_window_days)])
+
+    httpd = None
+    if args.metrics_port > 0:
+        httpd = ThreadingHTTPServer(("0.0.0.0", args.metrics_port),
+                                    make_canary_app(canary, slo))
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="canary-metrics").start()
+
+    import signal as _signal
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        print(f"signal {signum}: stopping canary", flush=True)
+        stop.set()
+
+    _signal.signal(_signal.SIGTERM, _stop)
+    _signal.signal(_signal.SIGINT, _stop)
+    print(f"canary: probing {args.router} every {args.interval_s:g}s",
+          flush=True)
+    run_loop(canary, slo, args.interval_s, stop)
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+    print("canary: bye", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

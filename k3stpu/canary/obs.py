@@ -1,0 +1,161 @@
+"""Canary observability: the ``k3stpu_canary_*`` Prometheus families.
+
+Same facade discipline as ``RouterObs`` (router/obs.py): metric objects
+hang off instance attributes so ``tools/metrics_lint.py`` constructs a
+``CanaryObs()`` and scans ``vars()``, the render methods concatenate
+the hand-rolled expositions, and the facade constructs without jax —
+the canary is a pure HTTP client and must not pay a backend import.
+
+Label cardinality is bounded by construction: ``path`` is the fixed
+probe-path enum below (which leg of the fleet a known-answer probe
+exercised), in the lint's bounded-label allow-list.
+"""
+
+from __future__ import annotations
+
+import socket
+
+from k3stpu.obs.hist import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    LabeledCounter,
+    LabeledGauge,
+    build_info_gauge,
+    prometheus_text_to_openmetrics,
+)
+
+# The fixed probe-path enum. "router": through the routing tier (the
+# client's view). "replica": direct to one discovered replica (isolates
+# a bad replica the router would average away). "session": a two-turn
+# session= probe (exercises KV park/restore — the tier path). "stream":
+# SSE stream-integrity (deltas must prefix the final frame).
+PROBE_PATHS = ("router", "replica", "session", "stream")
+
+# Verdict enum for one probe: token-exact match, wrong tokens, or no
+# usable response (connect error / HTTP error / bad frame).
+VERDICT_OK = "ok"
+VERDICT_MISMATCH = "mismatch"
+VERDICT_UNREACHABLE = "unreachable"
+
+
+class CanaryObs:
+    """All canary observability state, shared by the probe loop and the
+    metrics handler threads."""
+
+    def __init__(self, enabled: bool = True, instance: "str | None" = None):
+        self.enabled = enabled
+        self.ok = LabeledCounter(
+            "k3stpu_canary_ok_total",
+            "Known-answer probes whose output matched the golden "
+            "tokens exactly, per probe path.", "path")
+        self.mismatch = LabeledCounter(
+            "k3stpu_canary_mismatch_total",
+            "Probes that returned WRONG tokens — the silent-corruption "
+            "signal (miscompile, bad tier restore, bad TP re-split); "
+            "per probe path.", "path")
+        self.unreachable = LabeledCounter(
+            "k3stpu_canary_unreachable_total",
+            "Probes that got no usable response (connect error, "
+            "non-200, malformed frame), per probe path.", "path")
+        self.probe_seconds = Histogram(
+            "k3stpu_canary_probe_seconds",
+            "Wall time of each individual probe request (all paths).",
+            bounds=LATENCY_BUCKETS_S)
+        self.last_ttft = LabeledGauge(
+            "k3stpu_canary_last_ttft_seconds",
+            "Last probe's time-to-first-token per path (stream path "
+            "only — non-stream probes can't see first-token time).",
+            "path")
+        self.last_tpot = LabeledGauge(
+            "k3stpu_canary_last_tpot_seconds",
+            "Last probe's mean time per output token after the first, "
+            "per path (stream path only).", "path")
+        self.last_e2e = LabeledGauge(
+            "k3stpu_canary_last_e2e_seconds",
+            "Last probe's end-to-end latency per path.", "path")
+        self.fleet_ok = Gauge(
+            "k3stpu_canary_fleet_ok",
+            "1 when every probe path verified token-exact in the last "
+            "round, 0 when any failed, -1 before the first round.",
+            value=-1.0)
+        self.rounds = Counter(
+            "k3stpu_canary_rounds_total",
+            "Completed probe rounds (every path fired once).")
+        self.replicas_probed = Gauge(
+            "k3stpu_canary_replicas_probed",
+            "Replicas discovered via /debug/router and probed directly "
+            "in the last round.")
+        self.golden_prompts = Gauge(
+            "k3stpu_canary_golden_prompts",
+            "Golden prompt/answer pairs recorded at boot (0 until "
+            "recording succeeds).")
+        self.build_info = build_info_gauge(
+            "canary", instance=instance or socket.gethostname())
+
+    # -- hooks (probe loop) ------------------------------------------------
+
+    def on_probe(self, path: str, verdict: str, e2e_s: float,
+                 ttft_s: "float | None" = None,
+                 tpot_s: "float | None" = None) -> None:
+        """One probe request came back: count its verdict and stamp the
+        last-latency gauges (a path's ttft/tpot series only ever render
+        once the stream path touches them)."""
+        if not self.enabled:
+            return
+        counter = {VERDICT_OK: self.ok,
+                   VERDICT_MISMATCH: self.mismatch,
+                   VERDICT_UNREACHABLE: self.unreachable}[verdict]
+        counter.add(path)
+        self.probe_seconds.observe(e2e_s)
+        self.last_e2e.set(path, e2e_s)
+        if ttft_s is not None:
+            self.last_ttft.set(path, ttft_s)
+        if tpot_s is not None:
+            self.last_tpot.set(path, tpot_s)
+
+    def on_round(self, all_ok: bool, replicas: int) -> None:
+        if not self.enabled:
+            return
+        self.rounds.inc()
+        self.fleet_ok.set(1.0 if all_ok else 0.0)
+        self.replicas_probed.set(float(replicas))
+
+    def on_golden(self, n_prompts: int) -> None:
+        if not self.enabled:
+            return
+        self.golden_prompts.set(float(n_prompts))
+
+    # -- read side (HTTP threads) ------------------------------------------
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.probe_seconds,)
+
+    def _counters(self):
+        return (self.ok, self.mismatch, self.unreachable, self.rounds)
+
+    def _gauges(self):
+        return (self.last_ttft, self.last_tpot, self.last_e2e,
+                self.fleet_ok, self.replicas_probed, self.golden_prompts)
+
+    def render_prometheus(self) -> str:
+        parts = [h.render() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(c.render() for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n"
+
+    def render_openmetrics(self) -> str:
+        parts = [h.render_openmetrics() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(prometheus_text_to_openmetrics(c.render())
+                     for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n# EOF\n"
+
+    def reset(self) -> None:
+        for h in self.histograms():
+            h.reset()
+        self.rounds.reset()
+        self.fleet_ok.set(-1.0)

@@ -90,6 +90,20 @@ point                 boundary
                       replica with exact output; live rows are
                       untouched either way (imports only ever touch
                       fresh pages)
+``gen_corrupt``       the serving tier's generate return paths
+                      (``server._corrupt_check``, all four
+                      generate_tokens routes plus the final stream
+                      frame) — a firing fault perturbs every output
+                      token (+1 mod vocab) while the request completes
+                      normally: the silent-wrong-output failure mode
+                      (miscompile, corrupt tier restore, bad TP
+                      re-split) that no latency gauge can see and only
+                      the canary's token-exact compare catches
+``canary_probe``      top of each canary probe (``k3stpu/canary``) —
+                      a raised fault fails that probe into the
+                      ``unreachable`` verdict bucket, exercising "the
+                      watchdog itself is blind" distinctly from "the
+                      fleet is wrong"
 ====================  =====================================================
 """
 

@@ -195,6 +195,17 @@ class ServeObs:
             "block table, so the values agree today; the autoscaler "
             "reads the MIN so the fleet math survives if they diverge.",
             "shard")
+        # Synthetic (canary) traffic: requests arriving with the
+        # X-K3STPU-Canary header are counted HERE and excluded from the
+        # latency histograms above, so autoscaler signals and SLO
+        # accounting (both derived from those histograms) never see
+        # probe load as organic demand (docs/OBSERVABILITY.md
+        # "Correctness & SLOs").
+        self.synthetic_requests = Counter(
+            "k3stpu_serve_synthetic_requests_total",
+            "Completed synthetic (canary-probe) requests — excluded "
+            "from the request latency histograms so SLO and autoscaler "
+            "math stay organic-only.")
         # ``instance`` (pod name or host:port) stamps which replica of a
         # scaled-out serving fleet this exposition came from; ``role``
         # is the disagg serving role (prefill / decode); ``tp_shards``
@@ -220,14 +231,16 @@ class ServeObs:
         # Exemplars only for requests that arrived with an edge-minted
         # trace id — lazily minting one here would attach ids nothing
         # else (client output, response headers) can join on.
-        self.queue_wait.observe(queue_wait_s, trace_id=_ex_id(tr))
+        if not _is_synthetic(tr):
+            self.queue_wait.observe(queue_wait_s, trace_id=_ex_id(tr))
         if tr is not None:
             tr.t_admit = tr.event("admit", attrs or None)
 
     def on_first_token(self, tr: "ReqTrace | None", ttft_s: float) -> None:
         if not self.enabled:
             return
-        self.ttft.observe(ttft_s, trace_id=_ex_id(tr))
+        if not _is_synthetic(tr):
+            self.ttft.observe(ttft_s, trace_id=_ex_id(tr))
         if tr is not None:
             tr.t_first = tr.event("first_token")
 
@@ -337,10 +350,13 @@ class ServeObs:
                     tpot_s: "float | None") -> None:
         if not self.enabled:
             return
-        ex = _ex_id(tr)
-        self.e2e.observe(e2e_s, trace_id=ex)
-        if tpot_s is not None:
-            self.tpot.observe(tpot_s, trace_id=ex)
+        if _is_synthetic(tr):
+            self.synthetic_requests.inc()
+        else:
+            ex = _ex_id(tr)
+            self.e2e.observe(e2e_s, trace_id=ex)
+            if tpot_s is not None:
+                self.tpot.observe(tpot_s, trace_id=ex)
         if tr is not None:
             tr.finish("ok")
 
@@ -365,7 +381,7 @@ class ServeObs:
         return (self.spec_accepted_tokens, self.spec_proposed_tokens,
                 self.spec_dispatches, self.tier_hits, self.tier_misses,
                 self.tier_fallbacks, self.kv_transfer_bytes,
-                self.transfer_fallbacks)
+                self.transfer_fallbacks, self.synthetic_requests)
 
     def _gauges(self) -> "tuple[Gauge, ...]":
         base = (self.queue_depth, self.pages_free, self.pages_resident,
@@ -413,6 +429,13 @@ class ServeObs:
         # tp_shards_gauge survives reset: the mesh width is live config,
         # not a counter (same rule as pcache_bytes in engine stats).
         self.traces.reset()
+
+
+def _is_synthetic(tr: "ReqTrace | None") -> bool:
+    """Canary-probe requests are stamped ``synthetic=True`` in trace
+    meta by the engine; their latencies must never land in the organic
+    histograms (the SLO/autoscaler inputs)."""
+    return tr is not None and bool(tr.meta.get("synthetic"))
 
 
 def _ex_id(tr: "ReqTrace | None") -> "str | None":

@@ -430,6 +430,11 @@ def parse_prometheus_histograms(text: str) -> "dict[str, dict]":
     for line in text.splitlines():
         if line.startswith("#") or not line.strip():
             continue
+        # OpenMetrics bucket lines may carry an exemplar suffix
+        # (` # {trace_id="..."} value ts`); drop it or rsplit would
+        # read the exemplar timestamp as the sample value. No label
+        # value here ever contains " # " (trace ids are hex).
+        line = line.split(" # ", 1)[0]
         try:
             key, val = line.rsplit(None, 1)
         except ValueError:

@@ -1,0 +1,275 @@
+"""Multi-window SLO burn-rate engine over scraped histogram buckets.
+
+The stack's alert layer so far was static thresholds (p99 over a line
+for N minutes). This module replaces that with the standard SRE
+error-budget formulation: a declarative ``SloSpec`` names a latency
+histogram, a threshold, and a target fraction (e.g. "TTFT ≤ 2.5 s for
+99.9% of requests over 30 days"); the engine ingests cumulative bucket
+counts from ``/metrics`` scrapes and turns deltas into
+
+- **burn rate** per window — the rate the error budget is being spent,
+  ``bad_fraction(window) / (1 - target)``, where 1.0 means "spending
+  exactly the budget" and 14.4 means "a 30-day budget gone in 2 days";
+  evaluated over the standard multi-window pairs, 5m/1h (fast burn,
+  page at 14.4x) and 6h/3d (slow burn, ticket at 1x) — the short
+  window confirms the long one so a recovered blip self-resolves;
+- **error budget remaining** over the SLO's full window — the fraction
+  of allowed-bad requests not yet consumed.
+
+Everything is computed from (good, total) cumulative counters sampled
+at ingest time and differenced over window horizons, so the engine is
+deterministic given its inputs: tests feed hand-computed bucket
+fixtures with explicit timestamps (``now`` is always a parameter,
+never read from the clock here).
+
+Good-event counting is bucket-conservative: a request counts as good
+iff it landed at or under the largest bucket bound ≤ threshold — no
+interpolation, so the verdict never flatters the fleet. Canary probes
+never reach these histograms at all (the serve path excludes
+X-K3STPU-Canary traffic at observe time), so SLO math is organic-only
+by construction.
+
+Exposition: ``k3stpu_slo_error_budget_remaining_ratio{slo=}`` and the
+two-label ``k3stpu_slo_burn_rate{slo=,window=}`` (hand-rendered — the
+one-label LabeledGauge can't carry a window dimension). Both are
+registered with tools/metrics_lint.py via the LINT_* constants below.
+"""
+
+from __future__ import annotations
+
+from k3stpu.obs.hist import LabeledGauge, _fmt
+
+# The standard multi-window alert horizons (seconds). Fast pair pages,
+# slow pair tickets; each alert requires BOTH windows of its pair over
+# the threshold (deploy/charts/k3s-tpu/templates/rules.yaml).
+WINDOWS = (("5m", 300.0), ("1h", 3600.0),
+           ("6h", 21600.0), ("3d", 259200.0))
+
+# Burn-rate alert thresholds the chart's rules encode: 14.4x on the
+# fast pair consumes 2% of a 30d budget in an hour; 1x on the slow
+# pair is budget-neutral burn sustained long enough to matter.
+FAST_BURN_THRESHOLD = 14.4
+SLOW_BURN_THRESHOLD = 1.0
+
+_BURN_NAME = "k3stpu_slo_burn_rate"
+_BURN_HELP = ("Error-budget burn rate per SLO and window: "
+              "bad_fraction(window) / (1 - target). 1.0 spends the "
+              "budget exactly at its horizon; 0 when the window saw "
+              "no traffic.")
+_BUDGET_NAME = "k3stpu_slo_error_budget_remaining_ratio"
+_BUDGET_HELP = ("Fraction of the SLO's error budget not yet consumed "
+                "over its full window (1.0 = untouched, 0 = spent; "
+                "clamps at 0).")
+
+# Registered with tools/metrics_lint.py: the burn-rate family is
+# hand-rendered (two label dimensions), so the construct-and-scan
+# collectors can't discover it; these constants are its declaration.
+LINT_FAMILIES = ((_BUDGET_NAME, "gauge", _BUDGET_HELP),
+                 (_BURN_NAME, "gauge", _BURN_HELP))
+LINT_LABELED = ((_BUDGET_NAME, ("slo",)),
+                (_BURN_NAME, ("slo", "window")))
+
+
+class SloSpec:
+    """One declarative objective: of all requests whose latency lands
+    in ``metric`` (a k3stpu histogram family), at least ``target``
+    must finish within ``threshold_s``, measured over ``window_days``.
+    """
+
+    def __init__(self, name: str, metric: str, threshold_s: float,
+                 target: float = 0.999, window_days: float = 30.0):
+        if not 0.0 < target < 1.0:
+            raise ValueError(f"target must be in (0, 1), got {target}")
+        if threshold_s <= 0.0:
+            raise ValueError(f"threshold_s must be > 0, got {threshold_s}")
+        if window_days <= 0.0:
+            raise ValueError(f"window_days must be > 0, got {window_days}")
+        self.name = name
+        self.metric = metric
+        self.threshold_s = float(threshold_s)
+        self.target = float(target)
+        self.window_days = float(window_days)
+
+    @property
+    def window_s(self) -> float:
+        return self.window_days * 86400.0
+
+    def good_total(self, hist: "dict | None") -> "tuple[int, int] | None":
+        """(good, total) cumulative counts from one parsed histogram
+        (``parse_prometheus_histograms`` entry for ``self.metric``).
+        Good = count at the largest bucket bound ≤ threshold —
+        conservative: a threshold between bounds rounds DOWN to the
+        bucket that provably met it. None when the family is absent
+        or the threshold sits under the first bound (nothing provably
+        good — a spec/bounds mismatch worth surfacing, not guessing)."""
+        if hist is None:
+            return None
+        bounds, cum = hist["bounds"], hist["cumulative"]
+        if not bounds or len(cum) != len(bounds) + 1:
+            return None
+        idx = -1
+        for i, b in enumerate(bounds):
+            if b <= self.threshold_s:
+                idx = i
+        if idx < 0:
+            return None
+        return int(cum[idx]), int(cum[-1])
+
+
+def default_specs() -> "list[SloSpec]":
+    """The stock objective set: the chart's TTFT SLO (rules.yaml keeps
+    its threshold in values.yaml; this default mirrors it for the CLI
+    path where no flags override)."""
+    return [SloSpec("ttft", "k3stpu_request_ttft_seconds",
+                    threshold_s=2.5, target=0.999, window_days=30.0)]
+
+
+def merge_histograms(parsed: "list[dict]",
+                     metric: str) -> "dict | None":
+    """Sum one family's cumulative buckets across replica scrapes
+    (entrywise — identical bounds are a deploy invariant; mismatched
+    bounds drop the odd replica rather than corrupt the sum)."""
+    out: "dict | None" = None
+    for p in parsed:
+        h = p.get(metric)
+        if h is None or not h["bounds"]:
+            continue
+        if out is None:
+            out = {"bounds": list(h["bounds"]),
+                   "cumulative": list(h["cumulative"]),
+                   "sum": float(h["sum"]), "count": int(h["count"])}
+            continue
+        if h["bounds"] != out["bounds"] \
+                or len(h["cumulative"]) != len(out["cumulative"]):
+            continue
+        out["cumulative"] = [a + b for a, b in
+                             zip(out["cumulative"], h["cumulative"])]
+        out["sum"] += float(h["sum"])
+        out["count"] += int(h["count"])
+    return out
+
+
+class _Snap:
+    __slots__ = ("t", "good", "total")
+
+    def __init__(self, t: float, good: int, total: int):
+        self.t = t
+        self.good = good
+        self.total = total
+
+
+class SloEngine:
+    """Snapshots (good, total) cumulative counts per spec and evaluates
+    burn rates / budget remaining by differencing over the window
+    horizons. All entry points take explicit ``now`` timestamps so the
+    math is a pure function of its inputs (tests pin hand-computed
+    fixtures; the CLI passes time.time())."""
+
+    def __init__(self, specs: "list[SloSpec]"):
+        names = [s.name for s in specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate spec names in {names}")
+        self.specs = list(specs)
+        self._snaps: "dict[str, list[_Snap]]" = {s.name: []
+                                                 for s in self.specs}
+        self.budget_remaining = LabeledGauge(
+            _BUDGET_NAME, _BUDGET_HELP, "slo")
+        # (spec name, window label) -> burn rate, refreshed by
+        # evaluate(); rendered by hand (two label dimensions).
+        self._burn: "dict[tuple[str, str], float]" = {}
+
+    # -- write side --------------------------------------------------------
+
+    def ingest_counts(self, name: str, good: int, total: int,
+                      now: float) -> None:
+        """Record one cumulative (good, total) sample for spec ``name``.
+        A counter reset upstream (replica restart: total went DOWN)
+        restarts the series — differencing across a reset would invent
+        negative traffic."""
+        snaps = self._snaps[name]  # KeyError on unknown spec = caller bug
+        if snaps and (total < snaps[-1].total or good < snaps[-1].good):
+            snaps.clear()
+        snaps.append(_Snap(float(now), int(good), int(total)))
+        self._prune(name, float(now))
+
+    def ingest(self, texts: "list[str]", now: float) -> None:
+        """Scrape-driven ingest: parse each replica's exposition text,
+        merge each spec's family fleet-wide, snapshot the counts.
+        Specs whose family is absent from every scrape skip the round
+        (no snapshot — absence of data is not zero traffic)."""
+        from k3stpu.obs.hist import parse_prometheus_histograms
+
+        parsed = [parse_prometheus_histograms(t) for t in texts]
+        for spec in self.specs:
+            gt = spec.good_total(merge_histograms(parsed, spec.metric))
+            if gt is not None:
+                self.ingest_counts(spec.name, gt[0], gt[1], now)
+
+    def _prune(self, name: str, now: float) -> None:
+        """Drop snapshots older than the spec's own window plus slack
+        for one scrape period (the oldest in-window delta needs ONE
+        snapshot at or before the horizon to difference against)."""
+        spec = next(s for s in self.specs if s.name == name)
+        horizon = now - max(spec.window_s, WINDOWS[-1][1]) - 120.0
+        snaps = self._snaps[name]
+        while len(snaps) > 2 and snaps[1].t <= horizon:
+            snaps.pop(0)
+
+    # -- read side ---------------------------------------------------------
+
+    def _delta(self, snaps: "list[_Snap]", now: float,
+               window_s: float) -> "tuple[int, int]":
+        """(Δgood, Δtotal) over the trailing window: latest snapshot
+        minus the newest snapshot at or before the window start (a
+        snapshot exactly at the horizon anchors the full window). All
+        snapshots inside the window means the series is younger than
+        the window — difference from its oldest point instead."""
+        if len(snaps) < 2:
+            return 0, 0
+        latest = snaps[-1]
+        start = now - window_s
+        anchor = snaps[0]
+        for s in snaps:
+            if s.t <= start:
+                anchor = s
+            else:
+                break
+        return latest.good - anchor.good, latest.total - anchor.total
+
+    def evaluate(self, now: float) -> "dict[str, dict]":
+        """Burn rates + budget remaining per spec; refreshes the
+        exported families as a side effect. Windows with no traffic
+        burn at 0 (nothing served = nothing violated)."""
+        out: "dict[str, dict]" = {}
+        for spec in self.specs:
+            snaps = self._snaps[spec.name]
+            budget = 1.0 - spec.target
+            burn: "dict[str, float]" = {}
+            for label, wsec in WINDOWS:
+                dgood, dtotal = self._delta(snaps, now, wsec)
+                bad_frac = ((dtotal - dgood) / dtotal) if dtotal > 0 \
+                    else 0.0
+                burn[label] = bad_frac / budget
+                self._burn[(spec.name, label)] = burn[label]
+            dgood, dtotal = self._delta(snaps, now, spec.window_s)
+            consumed = (((dtotal - dgood) / dtotal) / budget) \
+                if dtotal > 0 else 0.0
+            remaining = max(0.0, 1.0 - consumed)
+            self.budget_remaining.set(spec.name, remaining)
+            out[spec.name] = {"burn_rate": burn,
+                              "budget_remaining": remaining,
+                              "window_total": dtotal}
+        return out
+
+    def render_prometheus(self) -> str:
+        """The two SLO families. Burn-rate series render for every
+        (spec, window) pair that evaluate() has refreshed — call
+        evaluate() before scraping (the CLI's round loop does)."""
+        parts = [self.budget_remaining.render()]
+        lines = [f"# HELP {_BURN_NAME} {_BURN_HELP}",
+                 f"# TYPE {_BURN_NAME} gauge"]
+        for (name, label), v in sorted(self._burn.items()):
+            lines.append(f'{_BURN_NAME}{{slo="{name}",'
+                         f'window="{label}"}} {_fmt(v)}')
+        parts.append("\n".join(lines))
+        return "\n".join(parts)

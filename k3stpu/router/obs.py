@@ -64,6 +64,12 @@ class RouterObs:
             "k3stpu_router_rejected_total",
             "Requests shed by the router with 503 + Retry-After "
             "(every healthy replica saturated or none healthy).")
+        self.synthetic = Counter(
+            "k3stpu_router_synthetic_requests_total",
+            "Canary probes proxied through the router (X-K3STPU-Canary "
+            "header) — excluded from the per-replica request counters "
+            "and the overhead histogram so organic routing signals stay "
+            "probe-free.")
         self.proxy_overhead = Histogram(
             "k3stpu_router_proxy_overhead_seconds",
             "Router-added latency per proxied request: total handler "
@@ -85,8 +91,12 @@ class RouterObs:
             return
         self.decisions.add(reason)
 
-    def on_proxy(self, replica: str, overhead_s: float) -> None:
+    def on_proxy(self, replica: str, overhead_s: float,
+                 synthetic: bool = False) -> None:
         if not self.enabled:
+            return
+        if synthetic:
+            self.synthetic.inc()
             return
         self.requests.add(replica)
         self.proxy_overhead.observe(overhead_s)
@@ -123,7 +133,7 @@ class RouterObs:
 
     def _counters(self):
         return (self.requests, self.failovers, self.ejections,
-                self.decisions, self.rejected)
+                self.decisions, self.rejected, self.synthetic)
 
     def _gauges(self) -> "tuple[Gauge, ...]":
         return (self.replicas_healthy, self.sessions_pinned)

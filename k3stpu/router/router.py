@@ -71,6 +71,11 @@ REPLICA_HEADER = "X-K3STPU-Replica"
 # admission. Absent header = the decode replica's --prefill-upstream,
 # or a plain cold prefill — never an error.
 PREFILL_HEADER = "X-K3STPU-Prefill-Endpoint"
+# Canary probes (k3stpu.canary) mark themselves with this header; the
+# router forwards it upstream unchanged (the replica excludes the
+# request from its organic histograms) and keeps the probe out of its
+# own per-replica request counters / overhead histogram.
+CANARY_HEADER = "X-K3STPU-Canary"
 
 # Fleet-saturated shed/backoff discipline — the same constants loadgen's
 # 503 retry chain uses, so a client backing off from the router behaves
@@ -514,8 +519,13 @@ def make_router_app(router: Router):
         # The prefill peer chosen for the CURRENT generate request
         # (None = single-hop); set per request in _route_post.
         _prefill_ep: "str | None" = None
+        # Inbound X-K3STPU-Canary header value for the CURRENT request
+        # (None = organic traffic); captured in _begin_trace so every
+        # upstream leg forwards it and obs hooks can exclude the probe.
+        _canary: "str | None" = None
 
         def _begin_trace(self) -> None:
+            self._canary = self.headers.get(CANARY_HEADER)
             raw = self.headers.get("traceparent")
             parsed = parse_traceparent(raw)
             if parsed is not None:
@@ -544,6 +554,8 @@ def make_router_app(router: Router):
                        "traceparent": self._upstream_traceparent()}
             if self._prefill_ep is not None:
                 headers[PREFILL_HEADER] = self._prefill_ep
+            if self._canary is not None:
+                headers[CANARY_HEADER] = self._canary
             return headers
 
         def _trace_headers(self) -> None:
@@ -810,7 +822,8 @@ def make_router_app(router: Router):
                     # upstream call — routing, body parse, and both
                     # forwarding legs.
                     router._obs.on_proxy(
-                        replica, (time.perf_counter() - t0) - (t2 - t1))
+                        replica, (time.perf_counter() - t0) - (t2 - t1),
+                        synthetic=self._canary is not None)
                     return
                 except (OSError, InjectedFault) as e:
                     # Connect refused / reset / timeout / injected fault:
@@ -907,7 +920,8 @@ def make_router_app(router: Router):
                 if rid:
                     self.send_header(REPLICA_HEADER, rid)
                 self.end_headers()
-                router._obs.on_proxy(replica, overhead_s)
+                router._obs.on_proxy(replica, overhead_s,
+                                     synthetic=self._canary is not None)
                 # Upstream reads and client writes fail with the SAME
                 # exception types (a reset is a reset), so each leg gets
                 # its own handler: an upstream death becomes a terminal

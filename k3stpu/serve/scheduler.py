@@ -35,7 +35,7 @@ class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
                  "deadline", "stream_q", "_ptuple", "probe", "adapter",
-                 "trace", "trace_id", "session")
+                 "trace", "trace_id", "session", "synthetic")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None, adapter=0):
@@ -76,6 +76,11 @@ class _Request:
         # chain in the prompt cache / host tier so the session's next
         # turn restores it instead of re-prefilling. None = one-shot.
         self.session: "str | None" = None
+        # Canary-probe flag (X-K3STPU-Canary at the HTTP edge): the
+        # request runs on the ordinary path but its latencies stay out
+        # of the organic histograms (ServeObs hooks read it from trace
+        # meta).
+        self.synthetic = False
 
     def ptuple(self) -> tuple:
         """The single-prompt cache key, computed once — the admission
@@ -267,12 +272,16 @@ class SchedulerMixin:
         thread, just before the queue put — so queue wait is measured
         from the moment the loop COULD have seen the request)."""
         if self._obs is not None:
-            req.trace = self._obs.start_trace(
-                trace_id=req.trace_id,
+            meta = dict(
                 rows=int(req.samples if req.samples > 1
                          else req.block.shape[0]),
                 prompt_len=int(max(req.lens)), budget=int(req.budget),
                 stream=stream, adapter=int(req.adapter))
+            # Only stamp the key when set — keeps organic trace meta
+            # byte-identical to the pre-canary layout.
+            if req.synthetic:
+                meta["synthetic"] = True
+            req.trace = self._obs.start_trace(trace_id=req.trace_id, **meta)
 
     def _enqueue_and_wait(self, req: "_Request", timeout_s: float,
                           admitted: bool = False) -> "list[list[int]]":
@@ -309,7 +318,8 @@ class SchedulerMixin:
                eos_id: "int | None" = None, adapter_id: int = 0,
                timeout_s: float = 600.0, admitted: bool = False,
                trace_id: "str | None" = None,
-               session: "str | None" = None) -> "list[list[int]]":
+               session: "str | None" = None,
+               synthetic: bool = False) -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists.
         ``admitted``: the caller already holds an admission token
         covering this submit (see take_admission_token).
@@ -331,6 +341,7 @@ class SchedulerMixin:
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
         req.session = session
+        req.synthetic = synthetic
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
@@ -339,7 +350,8 @@ class SchedulerMixin:
                        top_p: "float | None" = None,
                        eos_id: "int | None" = None, adapter_id: int = 0,
                        timeout_s: float = 600.0, admitted: bool = False,
-                       trace_id: "str | None" = None) -> "list[list[int]]":
+                       trace_id: "str | None" = None,
+                       synthetic: bool = False) -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
         prefill: the prefilled cache row broadcasts across n slots and the
         rows diverge through per-row sampling noise. (With temperature 0
@@ -352,6 +364,7 @@ class SchedulerMixin:
                                    top_k, eos_id, samples=n, top_p=top_p,
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
+        req.synthetic = synthetic
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_stream(self, prompts: "list[list[int]]", *,
@@ -361,7 +374,8 @@ class SchedulerMixin:
                       eos_id: "int | None" = None, adapter_id: int = 0,
                       timeout_s: float = 600.0, admitted: bool = False,
                       trace_id: "str | None" = None,
-                      session: "str | None" = None):
+                      session: "str | None" = None,
+                      synthetic: bool = False):
         """Streaming submit(): returns an iterator of events.
 
         Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
@@ -387,6 +401,7 @@ class SchedulerMixin:
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
         req.session = session
+        req.synthetic = synthetic
         req.stream_q = queue.SimpleQueue()
         return self._stream_events(req, timeout_s, admitted)
 

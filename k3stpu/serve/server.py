@@ -59,6 +59,12 @@ from k3stpu.obs import (ServeObs, format_traceparent, new_span_id,
 
 BATCH_SIZES = (1, 8, 32)
 
+# Canary probes (k3stpu.canary) mark themselves with this request
+# header; the handler turns it into the ``synthetic=True`` kwarg so the
+# request runs the ordinary serving path but its latencies stay out of
+# the organic histograms (SLO / autoscaler inputs).
+CANARY_HEADER = "X-K3STPU-Canary"
+
 
 def lm_base_cfg(cfg):
     """The TransformerConfig that actually carries the LM knobs: MoE
@@ -999,6 +1005,22 @@ class InferenceServer:
                 raise ValueError(f"eos_id {eos_id} outside vocab [0, {vocab})")
         return width, gen_budget, temperature, top_k, top_p, eos_id
 
+    def _corrupt_check(self, rows: "list[list[int]]") -> "list[list[int]]":
+        """Chaos point ``gen_corrupt``: when armed, perturb every output
+        token (+1 mod vocab) while the request completes normally — the
+        silent-wrong-output failure mode (miscompile, corrupt tier
+        restore, bad TP re-split) that looks healthy on every latency
+        gauge and that only the canary's token-exact compare catches."""
+        if self._chaos is None:
+            return rows
+        from k3stpu.chaos import InjectedFault
+        try:
+            self._chaos.fire("gen_corrupt")
+        except InjectedFault:
+            vocab = lm_base_cfg(self.model.config).vocab_size
+            return [[(int(t) + 1) % vocab for t in row] for row in rows]
+        return rows
+
     def generate_tokens(self, prompts: "list[list[int]]",
                         max_new_tokens: int = 32, temperature: float = 0.0,
                         top_k: "int | None" = None,
@@ -1007,7 +1029,8 @@ class InferenceServer:
                         num_samples: int = 1,
                         adapter: "str | None" = None,
                         trace_id: "str | None" = None,
-                        session: "str | None" = None) -> "list[list[int]]":
+                        session: "str | None" = None,
+                        synthetic: bool = False) -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
@@ -1059,7 +1082,7 @@ class InferenceServer:
                         prompts[0], k, max_new_tokens=gen_budget,
                         temperature=temperature, top_k=top_k, top_p=top_p,
                         eos_id=eos_id, adapter_id=aid, admitted=True,
-                        trace_id=trace_id))
+                        trace_id=trace_id, synthetic=synthetic))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1069,7 +1092,7 @@ class InferenceServer:
                 self._stats["gen_examples"] += num_samples
                 self._stats["tokens"] += sum(len(r) for r in out)
                 self._stats["gen_seconds"] += dt
-            return out
+            return self._corrupt_check(out)
 
         # Spec decode needs a gamma-token margin in the cache; requests
         # without it (or sampled / adapter-routed ones — the draft model
@@ -1115,8 +1138,12 @@ class InferenceServer:
                 self._spec_stats["accepted"] += spec["accepted"]
             # Engine-less path: the server IS the request lifecycle, so
             # e2e is observed here (engine paths record inside the loop).
-            self._obs.e2e.observe(dt, trace_id=trace_id)
-            return out.tolist()
+            # Synthetic (canary) probes stay out of the organic families.
+            if synthetic:
+                self._obs.synthetic_requests.inc()
+            else:
+                self._obs.e2e.observe(dt, trace_id=trace_id)
+            return self._corrupt_check(out.tolist())
 
         if self._engine is not None:
             # Continuous batching: no global lock — the engine interleaves
@@ -1135,7 +1162,7 @@ class InferenceServer:
                         max_new_tokens=gen_budget, temperature=temperature,
                         top_k=top_k, top_p=top_p, eos_id=eos_id,
                         adapter_id=aid, admitted=True, trace_id=trace_id,
-                        session=session))
+                        session=session, synthetic=synthetic))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1145,7 +1172,7 @@ class InferenceServer:
                 self._stats["gen_examples"] += len(prompts)
                 self._stats["tokens"] += sum(len(r) for r in out)
                 self._stats["gen_seconds"] += dt
-            return out
+            return self._corrupt_check(out)
 
         n = len(prompts)
         batch = served_batch(n)
@@ -1183,8 +1210,11 @@ class InferenceServer:
             self._stats["tokens"] += int(out.size)
             self._stats["gen_seconds"] += dt
         # engine-less: see the spec path note
-        self._obs.e2e.observe(dt, trace_id=trace_id)
-        return out.tolist()
+        if synthetic:
+            self._obs.synthetic_requests.inc()
+        else:
+            self._obs.e2e.observe(dt, trace_id=trace_id)
+        return self._corrupt_check(out.tolist())
 
     def _validate_session(self, session, prompts, num_samples) -> None:
         """ONE gate for the session-id API, shared by generate_tokens
@@ -1222,7 +1252,8 @@ class InferenceServer:
                         num_samples: int = 1,
                         adapter: "str | None" = None,
                         trace_id: "str | None" = None,
-                        session: "str | None" = None):
+                        session: "str | None" = None,
+                        synthetic: bool = False):
         """Streaming generate: an iterator of JSON-able events for the
         SSE route. Engine-backed requests yield per-decode-block deltas
         ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
@@ -1250,7 +1281,7 @@ class InferenceServer:
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, num_samples=num_samples, adapter=adapter,
-                trace_id=trace_id)
+                trace_id=trace_id, synthetic=synthetic)
             return iter([{"done": True, "tokens": tokens}])
         # Engine route only, AFTER the routing decisions (a spec/fallback
         # request never touches the admission counter, so it must not be
@@ -1265,11 +1296,12 @@ class InferenceServer:
         self._engine.reject_if_at_capacity()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
-            top_p, eos_id, aid, trace_id, session)
+            top_p, eos_id, aid, trace_id, session, synthetic)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid=0,
-                              trace_id=None, session=None):
+                              trace_id=None, session=None,
+                              synthetic=False):
         """Engine-backed streaming (args pre-sanitized). The admission
         token is taken HERE, on the generator's first next(), so a
         generator that is created but never iterated cannot leak the
@@ -1285,7 +1317,7 @@ class InferenceServer:
         try:
             yield from self._stream_engine_chunks(
                 prompts, max_new_tokens, gen_budget, temperature, top_k,
-                top_p, eos_id, aid, out, trace_id, session)
+                top_p, eos_id, aid, out, trace_id, session, synthetic)
         finally:
             self._engine.release_admission_token()
         dt = time.perf_counter() - t0
@@ -1294,11 +1326,12 @@ class InferenceServer:
             self._stats["gen_examples"] += len(prompts)
             self._stats["tokens"] += sum(len(r) for r in out)
             self._stats["gen_seconds"] += dt
-        yield {"done": True, "tokens": out}
+        yield {"done": True, "tokens": self._corrupt_check(out)}
 
     def _stream_engine_chunks(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid,
-                              out, trace_id=None, session=None):
+                              out, trace_id=None, session=None,
+                              synthetic=False):
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
@@ -1306,7 +1339,7 @@ class InferenceServer:
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, adapter_id=aid, admitted=True,
-                trace_id=trace_id, session=session)
+                trace_id=trace_id, session=session, synthetic=synthetic)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -1948,7 +1981,8 @@ def make_app(server: InferenceServer):
                         eos_id=req.get("eos_id"),
                         num_samples=req.get("num_samples", 1),
                         adapter=req.get("adapter"),
-                        session=req.get("session"))
+                        session=req.get("session"),
+                        synthetic=bool(self.headers.get(CANARY_HEADER)))
                     if req.get("stream"):
                         events = server.generate_stream(
                             req["prompt_tokens"],

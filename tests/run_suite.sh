@@ -34,8 +34,10 @@
 #            exactness vs the plain engine, int8-paged-KV
 #            drift/capacity), and the KV-tiering suite (host-store
 #            units, swap round-trip exactness, pin hygiene, tier_swap
-#            fault degradation) ride along minus their @slow soak/bench
-#            tests (the full suite runs those).
+#            fault degradation), and the correctness-watchdog suite
+#            (canary known-answer probes + SLO burn-rate math) ride
+#            along minus their @slow soak/bench tests (the full suite
+#            runs those).
 set -u
 cd "$(dirname "$0")/.." || exit 2
 export PYTHONPATH=
@@ -64,6 +66,8 @@ SMOKE=(
   tests/test_autoscaler.py
   tests/test_disagg.py
   tests/test_tp_serve.py
+  tests/test_slo.py
+  tests/test_canary.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

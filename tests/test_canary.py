@@ -1,0 +1,356 @@
+"""Correctness canary (k3stpu/canary): known-answer probes + verdicts.
+
+Unit tests drive the prober against scriptable fake fleets (stdlib
+HTTP, no jax) to pin the verdict logic per path; the E2E test is the
+acceptance criterion — two REAL replicas behind a real router, one
+chaos-armed to corrupt its output tokens, and the canary must flag the
+mismatch within two probe rounds while every pre-existing health and
+latency signal on the bad replica stays nominal (the exact gap the
+canary exists to close). The synthetic-exclusion tentpole is asserted
+on the same fleet: canary traffic must leave the organic latency
+histograms untouched.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k3stpu.canary import (
+    CANARY_HEADER,
+    VERDICT_MISMATCH,
+    VERDICT_OK,
+    VERDICT_UNREACHABLE,
+    Canary,
+    CanaryObs,
+)
+from k3stpu.chaos import FaultInjector
+
+# --- scriptable fake fleet -------------------------------------------------
+
+# One prompt keeps fake answer tables (and the E2E compile count) small;
+# the canary derives the two-turn golden key from it.
+PROMPTS = ((1, 2),)
+ANSWERS = {(1, 2): [7, 8], (1, 2, 7, 8): [9, 10]}
+
+
+def _start_fake(answers, corrupt=False, bad_deltas=False):
+    """A fake that plays router AND replica: /debug/router membership
+    is scriptable via state["replicas"], /v1/generate answers from the
+    canned table (optionally corrupted / with lying SSE deltas)."""
+    state = {"answers": dict(answers), "replicas": [], "corrupt": corrupt,
+             "bad_deltas": bad_deltas, "canary_headers": []}
+
+    class _H(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def _json(self, code, doc):
+            body = json.dumps(doc).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/debug/router":
+                self._json(200, {"replicas": state["replicas"]})
+            elif self.path == "/healthz":
+                self._json(200, {"ok": True})
+            else:
+                self._json(404, {"error": self.path})
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(n) or b"{}")
+            if self.path == "/v1/session/release":
+                self._json(200, {"released": True})
+                return
+            state["canary_headers"].append(
+                self.headers.get(CANARY_HEADER))
+            ans = list(state["answers"][tuple(body["prompt_tokens"][0])])
+            if state["corrupt"]:
+                ans = [t + 1 for t in ans]
+            if not body.get("stream"):
+                self._json(200, {"tokens": [ans]})
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.end_headers()
+            deltas = [[999]] if state["bad_deltas"] else [ans[:1], ans[1:]]
+            for d in deltas:
+                self.wfile.write(b"data: " + json.dumps(
+                    {"done": False, "rows": {"0": d}}).encode() + b"\n\n")
+            self.wfile.write(b"data: " + json.dumps(
+                {"done": True, "tokens": [ans]}).encode() + b"\n\n")
+
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _H)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+    state["replicas"] = [{"url": url, "healthy": True, "draining": False}]
+    return httpd, url, state
+
+
+def _canary(url, **kw):
+    kw.setdefault("prompts", PROMPTS)
+    kw.setdefault("max_new_tokens", 2)
+    kw.setdefault("timeout_s", 5.0)
+    kw.setdefault("obs", CanaryObs(instance="test-canary"))
+    return Canary(url, **kw)
+
+
+def _by_path(results):
+    out = {}
+    for r in results:
+        out.setdefault(r.path, []).append(r)
+    return out
+
+
+# --- unit: verdicts per path ----------------------------------------------
+
+
+def test_golden_then_clean_round_all_paths_ok():
+    httpd, url, state = _start_fake(ANSWERS)
+    try:
+        can = _canary(url)
+        assert can.record_golden() == 2  # prompt + two-turn golden
+        assert can.obs.golden_prompts.value == 2.0
+        results = can.probe_round()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    paths = _by_path(results)
+    assert set(paths) == {"router", "replica", "session", "stream"}
+    assert all(r.verdict == VERDICT_OK for r in results)
+    assert can.obs.fleet_ok.value == 1.0
+    assert can.obs.rounds.value == 1
+    assert can.obs.replicas_probed.value == 1.0
+    # Every probe (and the golden recording itself) carried the
+    # synthetic marker — nothing the canary sends may look organic.
+    assert state["canary_headers"] and all(
+        h == "1" for h in state["canary_headers"])
+    # Stream probe measured per-token latency.
+    assert paths["stream"][0].ttft_s is not None
+
+
+def test_corrupt_replica_direct_probe_isolates_mismatch():
+    router_httpd, router_url, router_state = _start_fake(ANSWERS)
+    bad_httpd, bad_url, _ = _start_fake(ANSWERS, corrupt=True)
+    try:
+        can = _canary(router_url)
+        can.record_golden()  # against the (correct) router fake
+        # Membership now gains the corrupt replica: the routed paths
+        # stay green (the fake router answers correctly itself), but
+        # the direct replica probe must isolate the bad one.
+        router_state["replicas"].append(
+            {"url": bad_url, "healthy": True, "draining": False})
+        results = can.probe_round()
+    finally:
+        for h in (router_httpd, bad_httpd):
+            h.shutdown()
+            h.server_close()
+    paths = _by_path(results)
+    assert paths["router"][0].verdict == VERDICT_OK
+    verdicts = {r.detail.split(":")[0]: r.verdict
+                for r in paths["replica"]}
+    assert VERDICT_MISMATCH in verdicts.values()
+    assert can.obs.mismatch.get("replica") == 1
+    assert can.obs.fleet_ok.value == 0.0
+    bad = [r for r in paths["replica"]
+           if r.verdict == VERDICT_MISMATCH][0]
+    assert "want" in bad.detail and bad_url in bad.detail
+
+
+def test_dead_replica_counts_unreachable():
+    httpd, url, state = _start_fake(ANSWERS)
+    try:
+        can = _canary(url)
+        can.record_golden()
+        state["replicas"].append(  # nothing listens on port 1
+            {"url": "http://127.0.0.1:1", "healthy": True,
+             "draining": False})
+        can.probe_round()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert can.obs.unreachable.get("replica") == 1
+    assert can.obs.fleet_ok.value == 0.0
+
+
+def test_discovery_failure_is_one_unreachable_replica_probe():
+    can = _canary("http://127.0.0.1:1")  # no router at all
+    with pytest.raises(OSError):
+        can.record_golden()
+    can.golden = {tuple(p): [0] for p in PROMPTS}  # force past boot
+    can.golden[(1, 2, 0)] = [0]
+    results = can.probe_round()
+    paths = _by_path(results)
+    assert paths["router"][0].verdict == VERDICT_UNREACHABLE
+    assert any("discovery" in r.detail for r in paths["replica"])
+    assert can.obs.fleet_ok.value == 0.0
+
+
+def test_chaos_canary_probe_fails_probe_not_fleet():
+    httpd, url, _ = _start_fake(ANSWERS)
+    inj = FaultInjector()
+    try:
+        can = _canary(url, chaos=inj)
+        can.record_golden()
+        inj.arm("canary_probe", times=1)
+        results = can.probe_round()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert inj.fired("canary_probe") == 1
+    paths = _by_path(results)
+    # First probe in the round (router) eats the fault; the rest of
+    # the round still runs and verifies the fleet is actually fine.
+    assert paths["router"][0].verdict == VERDICT_UNREACHABLE
+    assert all(r.verdict == VERDICT_OK for r in paths["replica"])
+    assert can.obs.unreachable.get("router") == 1
+
+
+def test_stream_deltas_must_prefix_final_frame():
+    httpd, url, _ = _start_fake(ANSWERS, bad_deltas=True)
+    try:
+        can = _canary(url, probe_session=False)
+        can.record_golden()
+        results = can.probe_round()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    paths = _by_path(results)
+    stream = paths["stream"][0]
+    assert stream.verdict == VERDICT_MISMATCH
+    assert "deltas diverge" in stream.detail
+    assert can.obs.mismatch.get("stream") == 1
+
+
+def test_probe_round_requires_goldens():
+    can = _canary("http://127.0.0.1:1")
+    with pytest.raises(RuntimeError):
+        can.probe_round()
+
+
+def test_canary_obs_exposition():
+    obs = CanaryObs(instance="t")
+    obs.on_probe("stream", VERDICT_OK, 0.5, ttft_s=0.1, tpot_s=0.05)
+    obs.on_round(True, 2)
+    text = obs.render_prometheus()
+    for fam in ("k3stpu_canary_ok_total", "k3stpu_canary_fleet_ok",
+                "k3stpu_canary_probe_seconds_bucket",
+                "k3stpu_canary_last_ttft_seconds",
+                "k3stpu_canary_replicas_probed", "k3stpu_build_info"):
+        assert fam in text
+    assert 'k3stpu_canary_ok_total{path="stream"} 1' in text
+    assert "k3stpu_canary_fleet_ok 1" in text
+    assert obs.render_openmetrics().endswith("# EOF\n")
+
+
+# --- E2E acceptance: real fleet, silent corruption detected ----------------
+
+
+def _real_fleet():
+    """Two real transformer-tiny replicas behind a real router; the
+    second replica carries a FaultInjector for gen_corrupt."""
+    from k3stpu.router import Router, make_router_app
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    inj = FaultInjector()
+    servers, httpds, urls = [], [], []
+    for instance, chaos in (("canary-good", None), ("canary-bad", inj)):
+        srv = InferenceServer(
+            model_name="transformer-tiny", seq_len=128,
+            batch_window_ms=0.0, continuous_batching=True,
+            decode_block=2, prompt_cache=8, kv_page_size=16,
+            kv_pages=32, shard_devices=None, instance=instance,
+            chaos=chaos)
+        httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        servers.append(srv)
+        httpds.append(httpd)
+        urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+    router = Router(urls, health_period_s=5.0, health_timeout_s=2.0,
+                    proxy_timeout_s=30.0, instance="canary-router")
+    rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                 make_router_app(router))
+    threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+    router_url = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+    return servers, httpds, urls, router, rhttpd, router_url, inj
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10.0) as r:
+        return r.read().decode()
+
+
+def test_e2e_silent_corruption_detected_within_two_rounds():
+    servers, httpds, urls, router, rhttpd, router_url, inj = _real_fleet()
+    bad_url = urls[1]
+    try:
+        can = _canary(router_url, max_new_tokens=4, timeout_s=60.0)
+        can.record_golden()
+        first = can.probe_round()  # clean fleet: everything verifies
+        assert all(r.verdict == VERDICT_OK for r in first), \
+            [(r.path, r.detail) for r in first]
+        assert can.obs.fleet_ok.value == 1.0
+
+        # Arm silent corruption on the bad replica: every generate
+        # completes normally (status 200, sane latency) but every
+        # output token is perturbed — invisible to health/latency.
+        inj.arm("gen_corrupt", times=10_000)
+        flagged_round = None
+        for i in range(2):  # acceptance bar: within TWO intervals
+            results = can.probe_round()
+            if any(r.verdict == VERDICT_MISMATCH for r in results):
+                flagged_round = i + 1
+                break
+        assert flagged_round is not None
+        assert inj.fired("gen_corrupt") > 0  # the fault actually fired
+        assert can.obs.fleet_ok.value == 0.0
+        assert can.obs.mismatch.get("replica") >= 1
+
+        # The exact gap the canary closes: every PRE-EXISTING signal
+        # on the corrupting replica still reads nominal.
+        health = json.loads(_get(bad_url + "/healthz"))
+        assert health["ok"] is True
+        bad_metrics = _get(bad_url + "/metrics")
+        from k3stpu.obs.hist import parse_prometheus_histograms
+        for text in (bad_metrics, _get(urls[0] + "/metrics")):
+            parsed = parse_prometheus_histograms(text)
+            # Tentpole exclusion: ALL traffic so far is canary traffic,
+            # and none of it may land in the organic latency
+            # histograms the SLO engine and autoscaler consume.
+            assert parsed["k3stpu_request_e2e_seconds"]["count"] == 0
+            assert parsed["k3stpu_request_ttft_seconds"]["count"] == 0
+        assert "k3stpu_engine_queue_depth 0" in bad_metrics
+        import re
+        m = re.search(r"k3stpu_serve_synthetic_requests_total (\d+)",
+                      bad_metrics)
+        assert m and int(m.group(1)) > 0
+
+        # An ORGANIC request (no canary header) still lands in the
+        # histograms — the exclusion is header-scoped, not global.
+        req = urllib.request.Request(
+            urls[0] + "/v1/generate", method="POST",
+            data=json.dumps({"prompt_tokens": [[3, 1, 2]],
+                             "max_new_tokens": 2,
+                             "temperature": 0.0}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60.0) as r:
+            assert r.status == 200
+        parsed = parse_prometheus_histograms(_get(urls[0] + "/metrics"))
+        assert parsed["k3stpu_request_e2e_seconds"]["count"] == 1
+    finally:
+        router.close()
+        rhttpd.shutdown()
+        rhttpd.server_close()
+        for h in httpds:
+            h.shutdown()
+            h.server_close()
+        for s in servers:
+            s.close()

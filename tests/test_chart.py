@@ -305,13 +305,60 @@ def test_rules_configmap_thresholds_reach_exprs():
     recorded = {r["record"] for g in recording["groups"]
                 for r in g["rules"]}
     assert "k3stpu:request_ttft_seconds:p99" in recorded
-    exprs = {r["alert"]: r["expr"] for g in alerts["groups"]
-             for r in g["rules"]}
-    # Values-driven thresholds land in the rendered expressions.
-    assert "> 1.5" in exprs["K3sTpuTtftSloBreach"]
+    rules = [r for g in alerts["groups"] for r in g["rules"]]
+    exprs = {r["alert"]: r["expr"] for r in rules}
+    # The static TtftSloBreach threshold rule is gone, replaced by the
+    # multi-window burn-rate pair over the canary pod's SLO engine.
+    assert "K3sTpuTtftSloBreach" not in exprs
+    fast = exprs["K3sTpuTtftBudgetFastBurn"]
+    assert 'k3stpu_slo_burn_rate{slo="ttft",window="5m"} > 14.4' in fast
+    assert 'window="1h"' in fast  # both windows must confirm
+    slow = exprs["K3sTpuTtftBudgetSlowBurn"]
+    assert 'window="6h"' in slow and 'window="3d"' in slow
+    # The values-driven threshold still reaches the operator (via the
+    # description — the expr consumes it through the canary's
+    # --slo-ttft-threshold-s flag, not inline).
+    descs = {r["alert"]: r["annotations"]["description"] for r in rules}
+    assert "1.5" in descs["K3sTpuTtftBudgetFastBurn"]
+    assert exprs["K3sTpuCanaryFailing"] == "k3stpu_canary_fleet_ok == 0"
+    assert ("k3stpu_canary_mismatch_total"
+            in exprs["K3sTpuCanaryTokenMismatch"])
     assert "< 0.9" in exprs["K3sTpuGoodputLow"]
     # Alerts on recorded series reference them by the recorded name.
     assert "k3stpu:node_tpu_health:max" in exprs["K3sTpuNodeUnhealthy"]
+
+
+def test_canary_disabled_by_default():
+    objs = render()
+    assert ("Deployment", "tpu-canary") not in objs
+
+
+def test_canary_deployment_wiring():
+    objs = render({"canary.enabled": "true",
+                   "rules.ttftP99SloSeconds": "1.5",
+                   "canary.skipSessionProbe": "true"})
+    dep = objs[("Deployment", "tpu-canary")]
+    tmpl = dep["spec"]["template"]
+    (ctr,) = tmpl["spec"]["containers"]
+    cmd = ctr["command"]
+    assert cmd[:3] == ["python", "-m", "k3stpu.canary"]
+    assert cmd[cmd.index("--router") + 1] == "http://tpu-router:8095"
+    # The SLO threshold single-sources from rules.ttftP99SloSeconds —
+    # the burn-rate alerts and the engine computing them can't drift.
+    assert cmd[cmd.index("--slo-ttft-threshold-s") + 1] == "1.5"
+    # Probe toggles are skip-phrased (helm_lite `if` takes bare refs
+    # only); session skipped here, stream probe stays on.
+    assert "--no-probe-session" in cmd
+    assert "--no-probe-stream" not in cmd
+    # Scrape annotation, liveness and the --metrics-port flag agree.
+    ann = tmpl["metadata"]["annotations"]
+    assert (ann["prometheus.io/port"]
+            == cmd[cmd.index("--metrics-port") + 1] == "8093")
+    assert ctr["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert ctr["livenessProbe"]["httpGet"]["port"] == 8093
+    # One replica, no RBAC: the canary is a pure HTTP client.
+    assert dep["spec"]["replicas"] == 1
+    assert "serviceAccountName" not in tmpl["spec"]
 
 
 def test_runtimeclass_and_namespace():
@@ -452,12 +499,20 @@ def _golden_case(name):
         "disagg.yaml": {"inference.disagg.enabled": "true",
                         "router.enabled": "true",
                         "router.replicaUrls": "http://tpu-decode:8096"},
+        # Correctness watchdog (docs/OBSERVABILITY.md "Correctness &
+        # SLOs"): the canary Deployment probing the routed fleet, plus
+        # the rules ConfigMap whose burn-rate/canary alerts consume
+        # the families it exports.
+        "canary.yaml": {"canary.enabled": "true",
+                        "router.enabled": "true",
+                        "inference.enabled": "true",
+                        "rules.enabled": "true"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
                 "train.yaml", "node-obs.yaml", "router.yaml",
-                "autoscaler.yaml", "disagg.yaml"]
+                "autoscaler.yaml", "disagg.yaml", "canary.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

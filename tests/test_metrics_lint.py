@@ -34,23 +34,22 @@ def test_scan_actually_finds_families():
     assert len(names) >= 20
 
 
+_COLLECTORS = ("_families_from_obs", "_families_from_server",
+               "_families_from_router", "_families_from_autoscaler",
+               "_families_from_canary", "_families_from_slo")
+
+
 def _check(fams):
     """Run the rule engine over a synthetic family list."""
-    real_obs = metrics_lint._families_from_obs
-    real_srv = metrics_lint._families_from_server
-    real_rtr = metrics_lint._families_from_router
-    real_asc = metrics_lint._families_from_autoscaler
+    real = {name: getattr(metrics_lint, name) for name in _COLLECTORS}
     metrics_lint._families_from_obs = lambda: fams
-    metrics_lint._families_from_server = lambda: []
-    metrics_lint._families_from_router = lambda: []
-    metrics_lint._families_from_autoscaler = lambda: []
+    for name in _COLLECTORS[1:]:
+        setattr(metrics_lint, name, lambda: [])
     try:
         return metrics_lint.lint()
     finally:
-        metrics_lint._families_from_obs = real_obs
-        metrics_lint._families_from_server = real_srv
-        metrics_lint._families_from_router = real_rtr
-        metrics_lint._families_from_autoscaler = real_asc
+        for name, fn in real.items():
+            setattr(metrics_lint, name, fn)
 
 
 def _pad(fams):
@@ -92,6 +91,47 @@ def test_lint_accepts_unit_suffix_variants():
 def test_lint_fails_when_collectors_break():
     # An empty scan is a broken scan — the gate must not pass vacuously.
     assert any("collectors are broken" in p for p in _check([]))
+
+
+def test_scan_finds_canary_and_slo_families():
+    canary = [n for n, _, _ in metrics_lint._families_from_canary()]
+    assert "k3stpu_canary_fleet_ok" in canary
+    assert "k3stpu_canary_mismatch_total" in canary
+    assert "k3stpu_canary_probe_seconds" in canary
+    slo = [n for n, _, _ in metrics_lint._families_from_slo()]
+    assert "k3stpu_slo_burn_rate" in slo
+    assert "k3stpu_slo_error_budget_remaining_ratio" in slo
+    # The burn-rate family's two-label shape is in the labeled scan
+    # (it is hand-rendered, so only the LINT_LABELED declaration can
+    # carry it).
+    labeled = dict(metrics_lint._labeled_families())
+    assert labeled["k3stpu_slo_burn_rate"] == ("slo", "window")
+
+
+def test_every_build_info_stamps_the_single_sourced_version():
+    """Satellite of the canary PR: k3stpu.__version__ is the ONE
+    version that every component's k3stpu_build_info carries — a
+    facade hand-rolling its own version string fails here, not in a
+    fleet dashboard join."""
+    import re
+
+    from k3stpu import __version__
+    from k3stpu.autoscaler.obs import AutoscalerObs
+    from k3stpu.canary.obs import CanaryObs
+    from k3stpu.obs import ServeObs
+    from k3stpu.obs.train import TrainObs
+    from k3stpu.router.obs import RouterObs
+
+    facades = {"serve": ServeObs(), "train": TrainObs(),
+               "router": RouterObs(instance="t"),
+               "autoscaler": AutoscalerObs(instance="t"),
+               "canary": CanaryObs(instance="t")}
+    for component, obs in facades.items():
+        text = obs.build_info.render()
+        m = re.search(r'version="([^"]*)"', text)
+        assert m, f"{component}: build_info lost its version label"
+        assert m.group(1) == __version__, component
+        assert f'component="{component}"' in text
 
 
 def test_scan_finds_node_exporter_families():

@@ -85,6 +85,41 @@ def test_quantile_from_buckets_edge_cases():
     assert quantile_from_buckets((1.0, 2.0), [0, 0, 3], 3, 0.5) == 2.0
 
 
+def test_quantile_single_bucket_interpolates_from_zero():
+    # One finite bucket, all mass in it: p50 of rank 2-of-4 sits
+    # halfway up the [0, 1.0) interpolation span.
+    assert quantile_from_buckets((1.0,), [4, 4], 4, 0.5) == 0.5
+    assert quantile_from_buckets((1.0,), [4, 4], 4, 1.0) == 1.0
+
+
+def test_quantile_total_zero_is_none_even_with_bounds():
+    # total <= 0 short-circuits before any bucket walk — a scrape of
+    # a fresh histogram must read as "no data", not 0.0.
+    assert quantile_from_buckets((0.1, 1.0, 10.0), [0, 0, 0, 0], 0,
+                                 0.99) is None
+    assert quantile_from_buckets((0.1,), [0, 0], -1, 0.5) is None
+
+
+def test_parse_labeled_buckets_with_exemplars():
+    # The OpenMetrics render carries constant labels AFTER le= and
+    # exemplar suffixes on bucket lines; the parser must read the
+    # sample value, not the exemplar's value or timestamp.
+    h = Histogram("t_seconds", "test", bounds=(0.1, 1.0),
+                  labels={"shard": "3"})
+    h.observe(0.05, trace_id="a" * 32)
+    h.observe(0.5, trace_id="b" * 32)
+    h.observe(5.0, trace_id="c" * 32)
+    text = h.render_openmetrics()
+    assert ' # {trace_id="' in text  # exemplars actually rendered
+    assert '_bucket{le="0.1",shard="3"}' in text
+    parsed = parse_prometheus_histograms(text)
+    p = parsed["t_seconds"]
+    assert p["bounds"] == [0.1, 1.0]
+    assert p["cumulative"] == [1, 2, 3]
+    assert p["count"] == 3
+    assert abs(p["sum"] - 5.55) < 1e-9
+
+
 def test_histogram_reset_and_rejects_bad_bounds():
     h = Histogram("t_seconds", "test", bounds=(1.0, 2.0))
     h.observe(1.5)

@@ -1,0 +1,223 @@
+"""SLO burn-rate engine (k3stpu/obs/slo.py): hand-computed fixtures.
+
+The acceptance bar for this layer is that the burn-rate math is pinned
+against hand-computed bucket fixtures for all four windows (5m/1h fast
+pair, 6h/3d slow pair) — every expected value below is derived in the
+comments, not from the code under test. The engine is deterministic by
+design (explicit ``now`` everywhere), so these tests use a fixed epoch
+and never touch the clock.
+"""
+
+import pytest
+
+from k3stpu.obs.hist import Histogram
+from k3stpu.obs.slo import (
+    FAST_BURN_THRESHOLD,
+    SLOW_BURN_THRESHOLD,
+    WINDOWS,
+    SloEngine,
+    SloSpec,
+    default_specs,
+    merge_histograms,
+)
+
+NOW = 1_000_000.0
+
+
+def _spec(**kw):
+    kw.setdefault("target", 0.999)
+    kw.setdefault("window_days", 30.0)
+    return SloSpec("ttft", "k3stpu_request_ttft_seconds",
+                   threshold_s=2.5, **kw)
+
+
+# -- burn-rate math, all four windows ---------------------------------------
+
+
+def test_burn_rates_all_four_windows_hand_computed():
+    """Distinct per-segment traffic so every window's burn differs.
+
+    Cumulative (t, good, total) snapshots; budget = 1 - 0.999 = 0.001:
+
+      t = NOW-3d   good       0  total         0
+      t = NOW-6h   good  899200  total   900000   (bad so far:  800)
+      t = NOW-1h   good  989054  total   990000   (bad so far:  946)
+      t = NOW-5m   good  998020  total   999000   (bad so far:  980)
+      t = NOW      good  999000  total  1000000   (bad so far: 1000)
+
+      5m window: delta vs the NOW-5m snap  -> bad  20 / 1000    = 0.02
+                 burn = 0.02   / 0.001 = 20.0
+      1h window: delta vs the NOW-1h snap  -> bad  54 / 10000   = 0.0054
+                 burn = 0.0054 / 0.001 = 5.4
+      6h window: delta vs the NOW-6h snap  -> bad 200 / 100000  = 0.002
+                 burn = 0.002  / 0.001 = 2.0
+      3d window: delta vs the NOW-3d snap  -> bad 1000 / 1e6    = 0.001
+                 burn = 0.001  / 0.001 = 1.0
+    """
+    eng = SloEngine([_spec()])
+    for dt, good, total in ((259200.0, 0, 0),
+                            (21600.0, 899200, 900000),
+                            (3600.0, 989054, 990000),
+                            (300.0, 998020, 999000),
+                            (0.0, 999000, 1000000)):
+        eng.ingest_counts("ttft", good, total, NOW - dt)
+    res = eng.evaluate(NOW)["ttft"]
+    assert res["burn_rate"]["5m"] == pytest.approx(20.0)
+    assert res["burn_rate"]["1h"] == pytest.approx(5.4)
+    assert res["burn_rate"]["6h"] == pytest.approx(2.0)
+    assert res["burn_rate"]["3d"] == pytest.approx(1.0)
+    assert res["window_total"] == 1000000
+    # Fast pair (5m AND 1h) is NOT paging here (1h under 14.4), but the
+    # slow pair (6h AND 3d) is at/over 1x — exactly the "sustained
+    # steady burn" ticket condition.
+    assert not (res["burn_rate"]["5m"] > FAST_BURN_THRESHOLD
+                and res["burn_rate"]["1h"] > FAST_BURN_THRESHOLD)
+    assert (res["burn_rate"]["6h"] >= SLOW_BURN_THRESHOLD - 1e-9
+            and res["burn_rate"]["3d"] >= SLOW_BURN_THRESHOLD - 1e-9)
+    # Budget over the 30d window: series is only 3d old, so the delta
+    # anchors at its oldest point -> bad_frac 0.001 = the whole budget.
+    assert res["budget_remaining"] == pytest.approx(0.0)
+
+
+def test_budget_remaining_partial_consumption():
+    # 500 bad of 1e6 -> bad_frac 5e-4 -> consumed 0.5 of a 0.001 budget.
+    eng = SloEngine([_spec()])
+    eng.ingest_counts("ttft", 0, 0, NOW - 86400.0)
+    eng.ingest_counts("ttft", 999500, 1000000, NOW)
+    res = eng.evaluate(NOW)["ttft"]
+    assert res["budget_remaining"] == pytest.approx(0.5)
+
+
+def test_no_traffic_burns_nothing():
+    eng = SloEngine([_spec()])
+    res = eng.evaluate(NOW)["ttft"]
+    assert all(res["burn_rate"][w] == 0.0 for w, _ in WINDOWS)
+    assert res["budget_remaining"] == 1.0
+    assert res["window_total"] == 0
+
+
+def test_counter_reset_restarts_the_series():
+    # A replica restart drops the cumulative counters; differencing
+    # across it would invent negative traffic. The reset clears the
+    # series, so the next evaluate sees a single-snapshot series (no
+    # delta -> burn 0) instead of garbage.
+    eng = SloEngine([_spec()])
+    eng.ingest_counts("ttft", 100, 100, NOW - 600.0)
+    eng.ingest_counts("ttft", 200, 200, NOW - 300.0)
+    eng.ingest_counts("ttft", 10, 60, NOW)  # total went DOWN: reset
+    res = eng.evaluate(NOW)["ttft"]
+    assert all(res["burn_rate"][w] == 0.0 for w, _ in WINDOWS)
+
+
+# -- bucket-conservative good counting --------------------------------------
+
+
+def test_good_total_rounds_threshold_down_to_provable_bucket():
+    spec = _spec()  # threshold 2.5 between bounds 2.0 and 4.0
+    hist = {"bounds": [1.0, 2.0, 4.0], "cumulative": [5, 8, 9, 10],
+            "sum": 20.0, "count": 10}
+    # Largest bound <= 2.5 is 2.0 -> good = cum[1] = 8. The 9th request
+    # (<= 4.0) MIGHT have met 2.5s, but is not provably good.
+    assert spec.good_total(hist) == (8, 10)
+
+
+def test_good_total_threshold_under_first_bound_is_none():
+    spec = SloSpec("t", "m", threshold_s=0.5)
+    hist = {"bounds": [1.0, 2.0], "cumulative": [1, 2, 3],
+            "sum": 1.0, "count": 3}
+    assert spec.good_total(hist) is None  # nothing provably good
+    assert spec.good_total(None) is None  # family absent
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        SloSpec("t", "m", threshold_s=1.0, target=1.0)
+    with pytest.raises(ValueError):
+        SloSpec("t", "m", threshold_s=0.0)
+    with pytest.raises(ValueError):
+        SloSpec("t", "m", threshold_s=1.0, window_days=0.0)
+    with pytest.raises(ValueError):
+        SloEngine([_spec(), _spec()])  # duplicate names
+
+
+# -- fleet merge + scrape-text ingest ---------------------------------------
+
+
+def _ttft_hist():
+    return Histogram("k3stpu_request_ttft_seconds", "test",
+                     bounds=(1.0, 2.0, 4.0))
+
+
+def test_merge_histograms_sums_and_drops_mismatched_bounds():
+    a, b = _ttft_hist(), _ttft_hist()
+    odd = Histogram("k3stpu_request_ttft_seconds", "test", bounds=(1.0,))
+    for v in (0.5, 1.5):
+        a.observe(v)
+    b.observe(3.0)
+    odd.observe(0.1)
+    from k3stpu.obs.hist import parse_prometheus_histograms
+    parsed = [parse_prometheus_histograms(h.render())
+              for h in (a, b, odd)]
+    m = merge_histograms(parsed, "k3stpu_request_ttft_seconds")
+    assert m["bounds"] == [1.0, 2.0, 4.0]
+    assert m["cumulative"] == [1, 2, 3, 3]  # odd replica dropped
+    assert m["count"] == 3
+
+
+def test_ingest_scrape_texts_end_to_end():
+    # Two replicas serve 20 good requests (first snapshot), then one
+    # serves 5 at 3.0 s (over the 2.5 s threshold). The trailing-5m
+    # delta is those 5 requests, all bad: burn = (5/5) / 0.001 = 1000x
+    # — well past the fast-burn page line.
+    eng = SloEngine([_spec()])
+    a, b = _ttft_hist(), _ttft_hist()
+    for _ in range(10):
+        a.observe(1.5)
+        b.observe(1.5)
+    eng.ingest([a.render(), b.render()], NOW - 300.0)
+    for _ in range(5):
+        a.observe(3.0)
+    eng.ingest([a.render(), b.render()], NOW)
+    res = eng.evaluate(NOW)["ttft"]
+    assert res["burn_rate"]["5m"] == pytest.approx(1000.0)
+    assert res["burn_rate"]["5m"] > FAST_BURN_THRESHOLD
+    assert res["budget_remaining"] == 0.0
+    assert res["window_total"] == 5
+
+
+def test_ingest_skips_rounds_with_family_absent():
+    eng = SloEngine([_spec()])
+    eng.ingest(["# HELP x_total nope\n# TYPE x_total counter\n"
+                "x_total 3\n"], NOW)
+    assert eng._snaps["ttft"] == []
+
+
+# -- exposition -------------------------------------------------------------
+
+
+def test_render_prometheus_two_label_burn_series():
+    eng = SloEngine([_spec()])
+    eng.ingest_counts("ttft", 0, 0, NOW - 600.0)
+    eng.ingest_counts("ttft", 999, 1000, NOW)
+    eng.evaluate(NOW)
+    text = eng.render_prometheus()
+    assert "# TYPE k3stpu_slo_error_budget_remaining_ratio gauge" in text
+    assert "# TYPE k3stpu_slo_burn_rate gauge" in text
+    assert 'k3stpu_slo_error_budget_remaining_ratio{slo="ttft"}' in text
+    for label, _ in WINDOWS:
+        assert (f'k3stpu_slo_burn_rate{{slo="ttft",window="{label}"}}'
+                in text)
+
+
+def test_default_specs_mirror_chart_threshold():
+    import os
+    import re
+    values = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deploy", "charts", "k3s-tpu", "values.yaml")
+    with open(values) as f:
+        m = re.search(r"ttftP99SloSeconds:\s*([\d.]+)", f.read())
+    assert m, "chart lost its TTFT threshold value"
+    (spec,) = default_specs()
+    assert spec.threshold_s == float(m.group(1))
+    assert spec.metric == "k3stpu_request_ttft_seconds"

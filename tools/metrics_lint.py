@@ -81,7 +81,12 @@ DUPLICATE_EXEMPT = {"k3stpu_build_info"}
 BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       "component", "version", "instance",
                       "replica", "reason", "backend", "direction",
-                      "role", "shard", "tp_shards"}
+                      "role", "shard", "tp_shards",
+                      # "path" is the canary's fixed probe-path enum
+                      # (router/replica/session/stream); "slo" the
+                      # declared SloSpec names; "window" the fixed
+                      # burn-rate horizon enum (5m/1h/6h/3d).
+                      "path", "slo", "window"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
@@ -193,10 +198,45 @@ def _families_from_autoscaler() -> "list[tuple[str, str, str]]":
     return fams
 
 
+def _families_from_canary() -> "list[tuple[str, str, str]]":
+    """The canary's families, from a real CanaryObs — same no-jax
+    construct-and-scan discipline as the router facade."""
+    from k3stpu.canary.obs import CanaryObs
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledGauge,
+    )
+
+    fams = []
+    for attr in vars(CanaryObs(instance="lint")).values():
+        if isinstance(attr, Histogram):
+            fams.append((attr.name, "histogram", attr.help))
+        elif isinstance(attr, (Counter, LabeledCounter)):
+            fams.append((attr.name, "counter", attr.help))
+        elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
+            fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
+def _families_from_slo() -> "list[tuple[str, str, str]]":
+    """The SLO engine's families. The burn-rate family is hand-rendered
+    (two label dimensions — no Labeled* primitive carries that), so
+    slo.py declares both via LINT_FAMILIES instead of construct-and-
+    scan; the exposition renders from the same constants."""
+    from k3stpu.obs.slo import LINT_FAMILIES
+
+    return list(LINT_FAMILIES)
+
+
 def _all_families() -> "list[tuple[str, str, str]]":
     return (_families_from_obs() + _families_from_server()
             + _families_from_node_exporter() + _families_from_router()
-            + _families_from_autoscaler())
+            + _families_from_autoscaler() + _families_from_canary()
+            + _families_from_slo())
 
 
 def lint() -> "list[str]":
@@ -251,15 +291,18 @@ def _labeled_families() -> "list[tuple[str, tuple]]":
         LabeledGauge,
     )
     from k3stpu.autoscaler.obs import AutoscalerObs
+    from k3stpu.canary.obs import CanaryObs
     from k3stpu.obs.node_exporter import NodeCollector
+    from k3stpu.obs.slo import LINT_LABELED
     from k3stpu.obs.train import TrainObs
     from k3stpu.router.obs import RouterObs
 
-    out = []
+    out = [(name, tuple(keys)) for name, keys in LINT_LABELED]
     for owner in (ServeObs(), TrainObs(),
                   NodeCollector(drop_dir="/nonexistent"),
                   RouterObs(instance="lint"),
-                  AutoscalerObs(instance="lint")):
+                  AutoscalerObs(instance="lint"),
+                  CanaryObs(instance="lint")):
         for attr in vars(owner).values():
             if isinstance(attr, (LabeledCounter, LabeledGauge)):
                 out.append((attr.name, (attr.label,)))

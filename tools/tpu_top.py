@@ -17,6 +17,13 @@ An unreachable endpoint renders as its own row (health `unreachable`)
 instead of killing the sweep — a down exporter is exactly the node you
 want visible. Exit code 0 when every node is healthy, 1 otherwise
 (scriptable: a cron wrapper can page on it).
+
+Endpoints that also expose the canary/SLO families (the tpu-canary
+pod's /metrics, k3stpu/canary) get two extra columns: CANARY (the
+`k3stpu_canary_fleet_ok` verdict) and BUDGET (the tightest
+`k3stpu_slo_error_budget_remaining_ratio` across SLOs). A failing
+canary — silent wrong tokens that every latency gauge misses — also
+forces the nonzero exit, same as an unhealthy node.
 """
 
 from __future__ import annotations
@@ -78,11 +85,15 @@ def node_row(endpoint: str, fams) -> dict:
     if fams is None:
         return {"node": name, "health": "unreachable", "chips": None,
                 "expected": None, "drop_files": None, "max_age_s": None,
-                "stale_files": None, "devices": []}
+                "stale_files": None, "devices": [],
+                "canary_ok": None, "budget_remaining": None}
     health = "unknown"
     for labels, v in fams.get("k3stpu_node_tpu_health_state", []):
         if v:
             health = labels.get("state", "unknown")
+    if (health == "unknown"
+            and _scalar(fams, "k3stpu_canary_fleet_ok") is not None):
+        health = "canary"     # the watchdog pod, not a node exporter
     used = {d["chip"]: v for d, v in
             fams.get("k3stpu_node_chip_hbm_used_bytes", [])}
     limit = {d["chip"]: v for d, v in
@@ -97,6 +108,13 @@ def node_row(endpoint: str, fams) -> dict:
                        key=lambda c: (len(c), c)):
         devices.append({"chip": chip, "used": used.get(chip),
                         "limit": limit.get(chip), "duty": duty.get(chip)})
+    # Canary/SLO families (present only when the endpoint is the
+    # tpu-canary pod, not a node exporter). fleet_ok is -1 until the
+    # first probe round completes — treated as "no verdict yet", not
+    # a failure.
+    fleet_ok = _scalar(fams, "k3stpu_canary_fleet_ok")
+    budgets = [v for _, v in
+               fams.get("k3stpu_slo_error_budget_remaining_ratio", [])]
     return {
         "node": name,
         "health": health,
@@ -106,6 +124,8 @@ def node_row(endpoint: str, fams) -> dict:
         "max_age_s": max(ages) if ages else None,
         "stale_files": stale,
         "devices": devices,
+        "canary_ok": None if fleet_ok is None else int(fleet_ok),
+        "budget_remaining": min(budgets) if budgets else None,
     }
 
 
@@ -122,7 +142,8 @@ def render_table(rows: "list[dict]") -> str:
     node's workloads report on (a chip in sysfs with no telemetry is
     visible as the CHIPS count exceeding the chip lines)."""
     hdr = (f"{'NODE':<28} {'HEALTH':<16} {'CHIPS':>5} "
-           f"{'HBM GiB':>12} {'UTIL':>5} {'DROPS':>5} {'AGE s':>7}")
+           f"{'HBM GiB':>12} {'UTIL':>5} {'DROPS':>5} {'AGE s':>7} "
+           f"{'CANARY':>7} {'BUDGET':>7}")
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         chips = ("n/a" if r["chips"] is None else
@@ -140,8 +161,13 @@ def render_table(rows: "list[dict]") -> str:
                  + (f"({r['stale_files']}!)" if r["stale_files"] else ""))
         age = ("n/a" if r["max_age_s"] is None
                else f"{r['max_age_s']:.1f}")
+        canary = {None: "-", 1: "ok", 0: "FAIL", -1: "warm"}.get(
+            r.get("canary_ok"), "?")
+        budget = ("-" if r.get("budget_remaining") is None
+                  else f"{r['budget_remaining']:.2f}")
         lines.append(f"{r['node']:<28} {r['health']:<16} {chips:>5} "
-                     f"{hbm:>12} {util:>5} {drops:>5} {age:>7}")
+                     f"{hbm:>12} {util:>5} {drops:>5} {age:>7} "
+                     f"{canary:>7} {budget:>7}")
         for d in r["devices"]:
             lines.append(f"  chip {d['chip']:<4} "
                          f"{_gib(d['used'])}/{_gib(d['limit'])} GiB"
@@ -151,6 +177,19 @@ def render_table(rows: "list[dict]") -> str:
 
 def sweep(endpoints: "list[str]", timeout: float = 5.0) -> "list[dict]":
     return [node_row(ep, fetch(ep, timeout)) for ep in endpoints]
+
+
+def fleet_ok(rows: "list[dict]") -> bool:
+    """Scriptable verdict for the exit code: every node exporter must
+    report `healthy`, and any swept canary endpoint must not be failing
+    (fleet_ok == 0). A canary that has not completed its first round
+    (-1) is warming, not failing."""
+    for r in rows:
+        if r["health"] not in ("healthy", "canary"):
+            return False
+        if r.get("canary_ok") == 0:
+            return False
+    return True
 
 
 def main(argv: "list[str] | None" = None) -> int:
@@ -175,7 +214,7 @@ def main(argv: "list[str] | None" = None) -> int:
         if not args.watch:
             break
         time.sleep(args.watch)
-    return 0 if all(r["health"] == "healthy" for r in rows) else 1
+    return 0 if fleet_ok(rows) else 1
 
 
 if __name__ == "__main__":
