@@ -76,6 +76,13 @@ canary-off; the prober must cost <= 5% of loadgen throughput
 (silent token corruption, /healthz stays green), flag the mismatch
 within two probe rounds (detection gate in detail).
 
+``--serve-qos`` gates the SLO-aware QoS layer (same contract): one
+qos+tier replica at 2x overload (concurrency = 2x engine slots, split
+interactive:batch); interactive p99 TTFT (streamed, first-token timed)
+must stay within the class SLO while EVERY batch request completes —
+predictive-admission 503s retried per Retry-After, shed means delayed,
+never lost (vs_baseline = p99/SLO; no-batch-lost gate in detail).
+
 ``--train-obs`` is the training twin (same contract): median step time
 of a short CPU train loop with TrainObs metrics on (K3STPU_TRAIN_OBS=1,
 the default) vs off; <=5% step-time budget, vs_baseline = overhead/5.
@@ -1634,6 +1641,266 @@ def _serve_canary_main() -> int:
                  **skw)
 
 
+def _serve_qos_worker() -> int:
+    """SLO-aware QoS gate (bounded subprocess, CPU tiny model,
+    loopback HTTP).
+
+    ONE qos+tier replica at 2x overload: concurrency is twice the
+    engine's slot count, split evenly between interactive (short,
+    streamed, TTFT timed at the first SSE token frame) and batch
+    (long, non-streaming) clients. The two halves of the acceptance
+    bar (docs/QOS.md):
+
+      * interactive p99 TTFT stays within the configured class SLO —
+        the class-weighted admission walk plus loss-free preemption
+        must keep the latency class ahead of the backlog;
+      * batch degrades GRACEFULLY: every batch request completes.
+        Predictive-admission 503s are retried per their Retry-After,
+        so shed means delayed, never lost.
+
+    The preemption/rejection counters ride in detail straight off the
+    replica's /metrics so the gate also proves the mechanism (not just
+    the outcome) engaged under overload."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import re
+    import threading
+    import urllib.error
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    slots = 2
+    # 4 concurrent clients over 2 slots = 2x overload. Three of the
+    # four are batch so the batch class genuinely saturates the slots
+    # (one batch always pending): every interactive arrival faces
+    # fully-occupied hardware and must go through the preemption path,
+    # not get lucky with an idle slot.
+    inter_threads, batch_threads = 1, 3
+    inter_reqs, batch_reqs = 24, 4      # per thread
+    inter_len, batch_len = 32, 64
+    # Batch decodes LONG (96 tokens) so slots stay occupied when the
+    # interactive class arrives — the regime where the preemption and
+    # class-weighted-admission machinery must carry the SLO, not idle
+    # slot luck.
+    inter_reply, batch_reply = 4, 96
+    slo_ms = 10_000.0  # CPU-scaled interactive TTFT budget
+    max_attempts = 50  # per batch request; bounds a pathological shed
+
+    def prompt_for(seed: int, n: int) -> "list[int]":
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 1000, size=(n,)).tolist()
+
+    srv = InferenceServer(
+        model_name="transformer-tiny", seq_len=512,
+        batch_window_ms=0.0, continuous_batching=True,
+        engine_slots=slots, decode_block=4, prompt_cache=8,
+        kv_page_size=16, kv_pages=256, shard_devices=None,
+        instance="bench-qos", tier_host_mb=64, qos=True,
+        interactive_ttft_slo_ms=slo_ms)
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    url = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    lock = threading.Lock()
+    stats = {"ttfts": [], "inter_shed": 0, "batch_retries": 0,
+             "batch_done": 0}
+
+    def _post(body: dict, timeout: float = 120.0):
+        req = urllib.request.Request(
+            url + "/v1/generate", data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        return urllib.request.urlopen(req, timeout=timeout)
+
+    def interactive_once(seed: int) -> None:
+        """One streamed interactive request; records TTFT at the first
+        SSE token frame. A shed (pre-header 503 or in-stream error
+        frame) is counted and retried — the TTFT sample then times the
+        admitted attempt, which is what the SLO governs."""
+        body = {"prompt_tokens": [prompt_for(seed, inter_len)],
+                "max_new_tokens": inter_reply, "temperature": 0.0,
+                "priority": "interactive", "stream": True}
+        for _ in range(max_attempts):
+            t0 = time.perf_counter()
+            try:
+                with _post(body) as r:
+                    ttft = None
+                    for raw in r:
+                        line = raw.decode()
+                        if not line.startswith("data: "):
+                            continue
+                        doc = json.loads(line[len("data: "):])
+                        if doc.get("error"):
+                            raise urllib.error.HTTPError(
+                                url, 503, doc["error"], {}, None)
+                        if ttft is None and doc.get("rows"):
+                            ttft = time.perf_counter() - t0
+                        if doc.get("done"):
+                            assert len(doc["tokens"][0]) == inter_reply
+                            with lock:
+                                stats["ttfts"].append(ttft)
+                            return
+            except urllib.error.HTTPError as e:
+                if e.code != 503:
+                    raise
+                with lock:
+                    stats["inter_shed"] += 1
+                time.sleep(min(float(e.headers.get("Retry-After") or 1),
+                               5.0))
+        raise RuntimeError(f"interactive request {seed} never admitted")
+
+    def batch_once(seed: int) -> None:
+        """One batch request, retried per Retry-After until it lands:
+        the no-request-lost half of the gate."""
+        body = {"prompt_tokens": [prompt_for(seed, batch_len)],
+                "max_new_tokens": batch_reply, "temperature": 0.0,
+                "priority": "batch"}
+        for _ in range(max_attempts):
+            try:
+                with _post(body) as r:
+                    out = json.loads(r.read().decode())
+                assert len(out["tokens"][0]) == batch_reply
+                with lock:
+                    stats["batch_done"] += 1
+                return
+            except urllib.error.HTTPError as e:
+                if e.code != 503:
+                    raise
+                with lock:
+                    stats["batch_retries"] += 1
+                time.sleep(min(float(e.headers.get("Retry-After") or 1),
+                               5.0))
+        raise RuntimeError(f"batch request {seed} lost after retries")
+
+    try:
+        # Warm every jitted program both classes touch (prefill shapes
+        # + decode blocks) so the timed window measures scheduling, not
+        # XLA compiles.
+        srv.generate_tokens([prompt_for(999, inter_len)],
+                            max_new_tokens=inter_reply)
+        srv.generate_tokens([prompt_for(998, batch_len)],
+                            max_new_tokens=batch_reply)
+        # A preempted batch resumes with prompt+collected tokens, so
+        # its re-prefill lands in WIDER pow2 buckets than any fresh
+        # request — warm them too or the first preemption charges an
+        # XLA compile to whichever interactive request queued behind it.
+        for n in (100, 180):
+            srv.generate_tokens([prompt_for(900 + n, n)],
+                                max_new_tokens=inter_reply)
+
+        errs: list = []
+
+        def run(fn, tid: int, n: int, base: int) -> None:
+            try:
+                for j in range(n):
+                    fn(base + tid * 1000 + j)
+            except BaseException as e:  # noqa: BLE001 — join + reraise
+                errs.append(e)
+
+        threads = (
+            [threading.Thread(target=run,
+                              args=(interactive_once, i, inter_reqs,
+                                    10_000))
+             for i in range(inter_threads)] +
+            [threading.Thread(target=run,
+                              args=(batch_once, i, batch_reqs, 20_000))
+             for i in range(batch_threads)])
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+
+        with urllib.request.urlopen(url + "/metrics", timeout=10) as r:
+            metrics = r.read().decode()
+    finally:
+        httpd.shutdown()
+        srv.close()
+
+    def counter(pat: str) -> int:
+        m = re.search(pat, metrics)
+        return int(m.group(1)) if m else 0
+
+    ttfts = sorted(stats["ttfts"])
+    p99_ms = ttfts[max(0, int(0.99 * (len(ttfts) - 1)))] * 1000.0
+    batch_submitted = batch_threads * batch_reqs
+    doc = {
+        # Headline: interactive p99 TTFT under 2x overload, in ms.
+        # vs_baseline = p99/SLO so <=1.0 passes; the no-batch-lost
+        # gate rides in detail.
+        "metric": "serve_qos_interactive_p99_ttft_ms",
+        "value": round(p99_ms, 1),
+        "unit": "ms",
+        "vs_baseline": round(p99_ms / slo_ms, 4),
+        "detail": {
+            "interactive_ttft_slo_ms": slo_ms,
+            "ttft_gate_passed": p99_ms <= slo_ms,
+            "interactive_requests": len(ttfts),
+            "interactive_shed_503": stats["inter_shed"],
+            "batch_submitted": batch_submitted,
+            "batch_completed": stats["batch_done"],
+            "batch_lost": batch_submitted - stats["batch_done"],
+            "batch_retries_503": stats["batch_retries"],
+            "no_batch_lost_gate_passed":
+                stats["batch_done"] == batch_submitted,
+            "preemptions": counter(
+                r"k3stpu_serve_preemptions_total (\d+)"),
+            "admission_rejected_interactive": counter(
+                r'k3stpu_serve_admission_rejected_total'
+                r'\{class="interactive"\} (\d+)'),
+            "admission_rejected_batch": counter(
+                r'k3stpu_serve_admission_rejected_total'
+                r'\{class="batch"\} (\d+)'),
+            "engine_slots": slots,
+            "concurrency": inter_threads + batch_threads,
+            "overload_factor": (inter_threads + batch_threads) / slots,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _serve_qos_main() -> int:
+    """Bounded-subprocess wrapper for --serve-qos (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--serve-qos-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="serve_qos")
+    skw = {"metric": "serve_qos_interactive_p99_ttft_ms", "unit": "ms"}
+    if not ok:
+        why = (f"qos bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("serve_qos", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_disagg_worker() -> int:
     """Disaggregated prefill/decode gate (bounded subprocess, CPU tiny
     model, loopback HTTP).
@@ -2739,6 +3006,10 @@ if __name__ == "__main__":
         sys.exit(_serve_canary_worker())
     if "--serve-canary" in sys.argv[1:]:
         sys.exit(_serve_canary_main())
+    if "--serve-qos-worker" in sys.argv[1:]:
+        sys.exit(_serve_qos_worker())
+    if "--serve-qos" in sys.argv[1:]:
+        sys.exit(_serve_qos_main())
     if "--train-obs-worker" in sys.argv[1:]:
         sys.exit(_train_obs_worker())
     if "--train-obs" in sys.argv[1:]:

@@ -71,6 +71,7 @@ class DecisionPolicy:
                  max_replicas: int = 4,
                  queue_high: float = 4.0,
                  queue_low: float = 0.5,
+                 interactive_queue_high: float = 1.0,
                  pages_free_low: float = 0.15,
                  queue_wait_high_s: float = 1.0,
                  ttft_high_s: float = 2.0,
@@ -87,6 +88,11 @@ class DecisionPolicy:
         self.max_replicas = max_replicas
         self.queue_high = queue_high
         self.queue_low = queue_low
+        # Class-aware pressure (docs/QOS.md): interactive work queued
+        # ANYWHERE in the fleet breaches far sooner than the blended
+        # average shows, so its own (much lower) fleet-total threshold
+        # fires independently. Classless replicas report 0 — inert.
+        self.interactive_queue_high = interactive_queue_high
         self.pages_free_low = pages_free_low
         self.queue_wait_high_s = queue_wait_high_s
         self.ttft_high_s = ttft_high_s
@@ -131,6 +137,11 @@ class DecisionPolicy:
             reasons.append(
                 f"queue_depth {fleet.queue_depth_per_replica:.1f}"
                 f"/replica > {self.queue_high:g}")
+        if fleet.interactive_queue_depth > self.interactive_queue_high:
+            up_targets.append(current + 1)
+            reasons.append(
+                f"interactive_queue {fleet.interactive_queue_depth:.1f} "
+                f"> {self.interactive_queue_high:g}")
         if 0.0 <= fleet.pages_free_frac < self.pages_free_low:
             up_targets.append(current + 1)
             reasons.append(f"pages_free {fleet.pages_free_frac:.2f} "
@@ -155,6 +166,8 @@ class DecisionPolicy:
         # is the hysteresis band's floor, and latency signals must sit
         # under HALF their high bar.
         idle = (fleet.queue_depth_per_replica < self.queue_low
+                and fleet.interactive_queue_depth
+                < self.interactive_queue_high / 2
                 and (fleet.pages_free_frac < 0.0
                      or fleet.pages_free_frac > 2 * self.pages_free_low)
                 and fleet.queue_wait_p50_s < self.queue_wait_high_s / 2
@@ -494,6 +507,10 @@ def main(argv=None) -> int:
     ap.add_argument("--queue-low", type=float, default=0.5,
                     help="scale down only under this mean per-replica "
                          "queue depth (hysteresis floor)")
+    ap.add_argument("--interactive-queue-high", type=float, default=1.0,
+                    help="scale up past this fleet-TOTAL interactive-"
+                         "class pending depth (QoS replicas only; "
+                         "classless replicas report 0)")
     ap.add_argument("--pages-free-low", type=float, default=0.15,
                     help="scale up when any replica's free-page "
                          "fraction drops below this")
@@ -538,6 +555,7 @@ def main(argv=None) -> int:
     policy = DecisionPolicy(
         min_replicas=args.min_replicas, max_replicas=args.max_replicas,
         queue_high=args.queue_high, queue_low=args.queue_low,
+        interactive_queue_high=args.interactive_queue_high,
         pages_free_low=args.pages_free_low,
         queue_wait_high_s=args.queue_wait_high_s,
         ttft_high_s=args.ttft_high_s,

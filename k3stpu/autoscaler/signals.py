@@ -40,10 +40,7 @@ from __future__ import annotations
 
 import urllib.request
 
-from k3stpu.obs.hist import (
-    parse_prometheus_histograms,
-    quantile_from_buckets,
-)
+from k3stpu.obs.hist import hist_p50
 
 
 class ReplicaSample:
@@ -52,11 +49,13 @@ class ReplicaSample:
     replica is the health poller's problem, not a reason to scale)."""
 
     __slots__ = ("url", "ok", "queue_depth", "pages_free", "pages_total",
-                 "queue_wait_p50_s", "ttft_p50_s")
+                 "queue_wait_p50_s", "ttft_p50_s",
+                 "interactive_queue_depth")
 
     def __init__(self, url: str, ok: bool = False, queue_depth: float = 0.0,
                  pages_free: float = -1.0, pages_total: float = 0.0,
-                 queue_wait_p50_s: float = 0.0, ttft_p50_s: float = 0.0):
+                 queue_wait_p50_s: float = 0.0, ttft_p50_s: float = 0.0,
+                 interactive_queue_depth: float = 0.0):
         self.url = url
         self.ok = ok
         self.queue_depth = queue_depth
@@ -64,6 +63,11 @@ class ReplicaSample:
         self.pages_total = pages_total
         self.queue_wait_p50_s = queue_wait_p50_s
         self.ttft_p50_s = ttft_p50_s
+        # Per-class pending depth from the QoS scheduler
+        # (k3stpu_serve_class_queue_depth{class="interactive"}); 0 on a
+        # classless replica — the family renders only when QoS is armed,
+        # so the pre-QoS signal set is unchanged there.
+        self.interactive_queue_depth = interactive_queue_depth
 
     @property
     def pages_free_frac(self) -> float:
@@ -78,7 +82,8 @@ class ReplicaSample:
                 "queue_depth": self.queue_depth,
                 "pages_free_frac": self.pages_free_frac,
                 "queue_wait_p50_s": self.queue_wait_p50_s,
-                "ttft_p50_s": self.ttft_p50_s}
+                "ttft_p50_s": self.ttft_p50_s,
+                "interactive_queue_depth": self.interactive_queue_depth}
 
 
 def _gauge_value(text: str, name: str) -> "float | None":
@@ -109,15 +114,26 @@ def _labeled_gauge_min(text: str, name: str) -> "float | None":
     return min(vals) if vals else None
 
 
-def _hist_p50(text: str, name: str) -> float:
-    """p50 from a family's cumulative buckets; 0.0 when absent/empty
-    (an idle replica has no latency pressure by definition)."""
-    fam = parse_prometheus_histograms(text).get(name)
-    if not fam or fam["count"] <= 0:
-        return 0.0
-    q = quantile_from_buckets(fam["bounds"], fam["cumulative"],
-                              fam["count"], 0.5)
-    return float(q) if q is not None else 0.0
+# The p50 derivation moved to k3stpu.obs.hist.hist_p50 so the serving
+# scheduler's predictive admission gate computes THE SAME estimate the
+# controller scales on; this alias keeps the module's local name.
+_hist_p50 = hist_p50
+
+
+def _labeled_gauge_value(text: str, name: str,
+                         label: str, value: str) -> "float | None":
+    """The sample of ``name`` whose (single) label pair is exactly
+    ``label="value"`` — the read side of LabeledGauge.render. None when
+    the series is absent (family not armed, or that class idle since
+    boot)."""
+    needle = f'{name}{{{label}="{value}"}}'
+    for line in text.splitlines():
+        if line.startswith(needle + " "):
+            try:
+                return float(line.split()[1])
+            except (IndexError, ValueError):
+                return None
+    return None
 
 
 def parse_replica_metrics(url: str, text: str) -> ReplicaSample:
@@ -131,13 +147,16 @@ def parse_replica_metrics(url: str, text: str) -> ReplicaSample:
     if pf is None:
         pf = _gauge_value(text, "k3stpu_engine_pages_free")
     pt = _gauge_value(text, "k3stpu_pages_total")
+    iq = _labeled_gauge_value(text, "k3stpu_serve_class_queue_depth",
+                              "class", "interactive")
     return ReplicaSample(
         url, ok=True,
         queue_depth=qd if qd is not None else 0.0,
         pages_free=pf if pf is not None else -1.0,
         pages_total=pt if pt is not None else 0.0,
         queue_wait_p50_s=_hist_p50(text, "k3stpu_request_queue_wait_seconds"),
-        ttft_p50_s=_hist_p50(text, "k3stpu_request_ttft_seconds"))
+        ttft_p50_s=_hist_p50(text, "k3stpu_request_ttft_seconds"),
+        interactive_queue_depth=iq if iq is not None else 0.0)
 
 
 def scrape(url: str, timeout_s: float = 2.0) -> ReplicaSample:
@@ -165,7 +184,8 @@ class FleetSignals:
 
     __slots__ = ("samples", "scraped", "queue_depth_per_replica",
                  "total_queue_depth", "pages_free_frac",
-                 "queue_wait_p50_s", "ttft_p50_s")
+                 "queue_wait_p50_s", "ttft_p50_s",
+                 "interactive_queue_depth")
 
     def __init__(self, samples: "list[ReplicaSample]"):
         self.samples = samples
@@ -180,6 +200,12 @@ class FleetSignals:
         self.queue_wait_p50_s = max(
             (s.queue_wait_p50_s for s in live), default=0.0)
         self.ttft_p50_s = max((s.ttft_p50_s for s in live), default=0.0)
+        # Sum, not average: interactive work queued ANYWHERE in the
+        # fleet is an SLO breach in the making — the class-aware
+        # scale-up must fire even when batch-dominated averages look
+        # calm (docs/QOS.md).
+        self.interactive_queue_depth = sum(
+            s.interactive_queue_depth for s in live)
 
     def as_dict(self) -> dict:
         return {"scraped": self.scraped,
@@ -187,7 +213,8 @@ class FleetSignals:
                 "total_queue_depth": self.total_queue_depth,
                 "pages_free_frac": self.pages_free_frac,
                 "queue_wait_p50_s": self.queue_wait_p50_s,
-                "ttft_p50_s": self.ttft_p50_s}
+                "ttft_p50_s": self.ttft_p50_s,
+                "interactive_queue_depth": self.interactive_queue_depth}
 
 
 def collect(urls: "list[str]", timeout_s: float = 2.0) -> FleetSignals:

@@ -57,6 +57,14 @@ from k3stpu.canary.obs import (  # noqa: F401  (re-exported for tests)
 from k3stpu.chaos import InjectedFault
 
 CANARY_HEADER = "X-K3STPU-Canary"
+# QoS class tag (docs/QOS.md): probes ride the interactive class so a
+# QoS fleet treats them like the traffic they stand in for — and the
+# serving layers additionally pin canary traffic un-sheddable and
+# un-preemptible (the synthetic flag skips predictive admission; the
+# preemption victim scan never picks a synthetic row): a probe that
+# could be shed ahead of organic traffic would report "fleet down"
+# exactly when the fleet is busiest.
+PRIORITY_HEADER = "X-K3STPU-Priority"
 
 # The fixed golden prompt set: small, token-id based (model-agnostic —
 # any LM family serves ids), distinct enough to hit different prompt
@@ -111,7 +119,8 @@ class Canary:
     # -- HTTP plumbing -----------------------------------------------------
 
     def _headers(self) -> dict:
-        return {"Content-Type": "application/json", CANARY_HEADER: "1"}
+        return {"Content-Type": "application/json", CANARY_HEADER: "1",
+                PRIORITY_HEADER: "interactive"}
 
     def _generate(self, base_url: str, prompt: "list[int]",
                   session: "str | None" = None) -> "list[int]":
@@ -120,7 +129,7 @@ class Canary:
         200-with-tokens (the caller's unreachable bucket)."""
         payload = {"prompt_tokens": [prompt],
                    "max_new_tokens": self.max_new_tokens,
-                   "temperature": 0.0}
+                   "temperature": 0.0, "priority": "interactive"}
         if session is not None:
             payload["session"] = session
         req = urllib.request.Request(
@@ -141,7 +150,8 @@ class Canary:
         on transport errors, error frames, or a missing final frame."""
         payload = {"prompt_tokens": [prompt],
                    "max_new_tokens": self.max_new_tokens,
-                   "temperature": 0.0, "stream": True}
+                   "temperature": 0.0, "priority": "interactive",
+                   "stream": True}
         req = urllib.request.Request(
             base_url + "/v1/generate", method="POST",
             data=json.dumps(payload).encode(), headers=self._headers())

@@ -156,6 +156,18 @@ def main(argv=None) -> int:
                     help="TTFT SLO target fraction")
     ap.add_argument("--slo-window-days", type=float, default=30.0,
                     help="TTFT SLO error-budget window")
+    ap.add_argument("--qos-slos", action="store_true",
+                    help="track the per-class QoS TTFT SLOs "
+                         "(ttft-interactive / ttft-batch, docs/QOS.md) "
+                         "alongside the blended TTFT SLO — for fleets "
+                         "running --qos replicas")
+    ap.add_argument("--qos-interactive-ttft-slo-s", type=float,
+                    default=2.5,
+                    help="interactive-class TTFT threshold for "
+                         "--qos-slos (mirrors the replica's "
+                         "--interactive-ttft-slo-ms)")
+    ap.add_argument("--qos-batch-ttft-slo-s", type=float, default=30.0,
+                    help="batch-class TTFT threshold for --qos-slos")
     ap.add_argument("--metrics-port", type=int, default=8093,
                     help="own /metrics + /healthz port (0 disables)")
     ap.add_argument("--instance", default=None,
@@ -171,10 +183,18 @@ def main(argv=None) -> int:
                     chaos=chaos_from_env(),
                     probe_session=not args.no_probe_session,
                     probe_stream=not args.no_probe_stream)
-    slo = SloEngine([SloSpec("ttft", "k3stpu_request_ttft_seconds",
-                             threshold_s=args.slo_ttft_threshold_s,
-                             target=args.slo_target,
-                             window_days=args.slo_window_days)])
+    specs = [SloSpec("ttft", "k3stpu_request_ttft_seconds",
+                     threshold_s=args.slo_ttft_threshold_s,
+                     target=args.slo_target,
+                     window_days=args.slo_window_days)]
+    if args.qos_slos:
+        from k3stpu.obs.slo import qos_specs
+
+        specs.extend(qos_specs(
+            interactive_threshold_s=args.qos_interactive_ttft_slo_s,
+            batch_threshold_s=args.qos_batch_ttft_slo_s,
+            window_days=args.slo_window_days))
+    slo = SloEngine(specs)
 
     httpd = None
     if args.metrics_port > 0:

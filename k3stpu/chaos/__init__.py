@@ -104,6 +104,22 @@ point                 boundary
                       ``unreachable`` verdict bucket, exercising "the
                       watchdog itself is blind" distinctly from "the
                       fleet is wrong"
+``preempt_park``      top of the scheduler's preemption park
+                      (``scheduler._preempt_park``, docs/QOS.md) — a
+                      raised fault stands in for a failed page gather /
+                      tier put mid-swap: the park aborts BEFORE any
+                      victim state is torn down, so the victim keeps
+                      its slot and keeps decoding; the interactive
+                      request that wanted the slot is rejected with
+                      503 + Retry-After (``preempt_fallbacks``
+                      counter), and allocator invariants hold
+``admission_predict`` inside the predictive-admission TTFT forecast
+                      (``scheduler._admission_forecast``) — a raised
+                      fault stands in for a broken estimator (p50
+                      derivation error, histogram corruption): the
+                      gate fails OPEN (``predict_fallbacks`` counter),
+                      degrading to the pre-QoS FIFO admission rather
+                      than rejecting traffic on a bad forecast
 ====================  =====================================================
 """
 

@@ -21,8 +21,10 @@ from .hist import (  # noqa: F401  (re-exported for tests/loadgen)
     Gauge,
     Histogram,
     InfoGauge,
+    LabeledCounter,
     LabeledGauge,
     build_info_gauge,
+    hist_p50,
     parse_prometheus_histograms,
     prometheus_text_to_openmetrics,
     quantile_from_buckets,
@@ -206,6 +208,32 @@ class ServeObs:
             "Completed synthetic (canary-probe) requests — excluded "
             "from the request latency histograms so SLO and autoscaler "
             "math stay organic-only.")
+        # SLO-aware QoS (engine qos=True, docs/QOS.md). Families are
+        # constructed unconditionally (the metrics lint scans a real
+        # instance) but only RENDERED once set_qos() arms them, so the
+        # classless serving path's exposition stays byte-stable.
+        self._qos_enabled = False
+        self.class_queue_depth = LabeledGauge(
+            "k3stpu_serve_class_queue_depth",
+            "Pending (not yet admitted) requests per QoS priority "
+            "class, sampled by the engine loop.",
+            "class")
+        self.preemptions = Counter(
+            "k3stpu_serve_preemptions_total",
+            "Batch rows swapped out mid-generation to admit an "
+            "interactive request (loss-free: the victim's KV chain "
+            "parks on the host tier and resumes token-identically).")
+        self.admission_rejected = LabeledCounter(
+            "k3stpu_serve_admission_rejected_total",
+            "Requests rejected at the door by predictive admission "
+            "control (503 + Retry-After: forecast TTFT would breach "
+            "the class SLO), per priority class.",
+            "class")
+        self.preempt_park_seconds = Histogram(
+            "k3stpu_serve_preempt_park_seconds",
+            "Device-to-host gather + tier-put time to park a preempted "
+            "row's KV chain.",
+            bounds=TPOT_BUCKETS_S)
         # ``instance`` (pod name or host:port) stamps which replica of a
         # scaled-out serving fleet this exposition came from; ``role``
         # is the disagg serving role (prefill / decode); ``tp_shards``
@@ -327,6 +355,36 @@ class ServeObs:
             return
         self.tp_allreduce_seconds.observe(seconds)
 
+    def set_qos(self, classes: "tuple[str, ...]") -> None:
+        """Arm the QoS families (the engine calls this when qos=True).
+        Every configured class's depth/rejection series is touched at 0
+        so the armed exposition is stable from the first scrape — a
+        class that never rejects still renders, and dashboards never
+        see series pop into existence mid-incident."""
+        self._qos_enabled = True
+        for c in classes:
+            self.class_queue_depth.set(str(c), 0.0)
+            self.admission_rejected.add(str(c), 0.0)
+
+    def on_class_queue_depth(self, cls: str, depth: int) -> None:
+        if not self.enabled or not self._qos_enabled:
+            return
+        self.class_queue_depth.set(cls, float(depth))
+
+    def on_preempt(self, park_s: float) -> None:
+        """One completed loss-free preemption: a batch row's chain was
+        gathered + parked on the tier in ``park_s`` and its request
+        requeued."""
+        if not self.enabled:
+            return
+        self.preemptions.inc()
+        self.preempt_park_seconds.observe(park_s)
+
+    def on_admission_rejected(self, cls: str) -> None:
+        if not self.enabled:
+            return
+        self.admission_rejected.add(cls)
+
     def on_spec_dispatch(self, proposed: int, accepted: int, emitted: int,
                          draft_s: float, verify_s: float) -> None:
         """One speculative verify dispatch: ``proposed`` draft tokens
@@ -375,13 +433,18 @@ class ServeObs:
                 self.tier_swap_out_seconds, self.kv_transfer_seconds)
         if self._tp_enabled:
             base += (self.tp_allreduce_seconds,)
+        if self._qos_enabled:
+            base += (self.preempt_park_seconds,)
         return base
 
     def _counters(self) -> "tuple[Counter, ...]":
-        return (self.spec_accepted_tokens, self.spec_proposed_tokens,
+        base = (self.spec_accepted_tokens, self.spec_proposed_tokens,
                 self.spec_dispatches, self.tier_hits, self.tier_misses,
                 self.tier_fallbacks, self.kv_transfer_bytes,
                 self.transfer_fallbacks, self.synthetic_requests)
+        if self._qos_enabled:
+            base += (self.preemptions, self.admission_rejected)
+        return base
 
     def _gauges(self) -> "tuple[Gauge, ...]":
         base = (self.queue_depth, self.pages_free, self.pages_resident,
@@ -389,6 +452,8 @@ class ServeObs:
                 self.decode_mfu)
         if self._tp_enabled:
             base += (self.tp_shards_gauge, self.tp_pages_free)
+        if self._qos_enabled:
+            base += (self.class_queue_depth,)
         return base
 
     def render_prometheus(self) -> str:
@@ -428,6 +493,8 @@ class ServeObs:
         self.decode_mfu.set(0.0)
         # tp_shards_gauge survives reset: the mesh width is live config,
         # not a counter (same rule as pcache_bytes in engine stats).
+        # _qos_enabled survives too — armed families keep rendering
+        # (LabeledCounter.reset zeroes series without dropping them).
         self.traces.reset()
 
 

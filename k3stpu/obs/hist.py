@@ -180,6 +180,14 @@ class LabeledCounter:
         with self._lock:
             return self._values.get(label_value, 0.0)
 
+    def reset(self) -> None:
+        """Zero every series WITHOUT dropping it: a reset counter keeps
+        rendering its label values at 0, so a post-warmup stats reset
+        doesn't make series vanish from the next scrape."""
+        with self._lock:
+            for k in self._values:
+                self._values[k] = 0.0
+
     def render(self) -> str:
         with self._lock:
             items = list(self._values.items())
@@ -418,6 +426,22 @@ def quantile_from_buckets(bounds, cumulative, total: int,
             frac = (rank - prev) / in_bucket if in_bucket else 1.0
             return lo + (bounds[i] - lo) * frac
     return float(bounds[-1])
+
+
+def hist_p50(text: str, name: str) -> float:
+    """p50 of one histogram family lifted from exposition text; 0.0
+    when the family is absent or empty (an idle replica has no latency
+    pressure by definition). THE shared TTFT/queue-wait derivation:
+    the autoscaler's scrape signals (autoscaler/signals.py) and the
+    serving scheduler's predictive admission gate both consume this
+    exact math, so a controller scale decision and an in-process 503
+    agree on what "current p50" means."""
+    fam = parse_prometheus_histograms(text).get(name)
+    if not fam or fam["count"] <= 0:
+        return 0.0
+    q = quantile_from_buckets(fam["bounds"], fam["cumulative"],
+                              fam["count"], 0.5)
+    return float(q) if q is not None else 0.0
 
 
 def parse_prometheus_histograms(text: str) -> "dict[str, dict]":

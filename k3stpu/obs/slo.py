@@ -124,6 +124,51 @@ def default_specs() -> "list[SloSpec]":
                     threshold_s=2.5, target=0.999, window_days=30.0)]
 
 
+def qos_specs(interactive_threshold_s: float = 2.5,
+              batch_threshold_s: float = 30.0,
+              window_days: float = 30.0) -> "list[SloSpec]":
+    """Per-class objectives for a QoS-enabled fleet (docs/QOS.md): both
+    read the SAME organic TTFT family (no per-class histograms — the
+    class split lives in the scheduler, not the exposition), but at the
+    class's own threshold and budget. Interactive keeps the strict
+    default target; batch tolerates 10x the errors at 12x the latency —
+    its traffic is delay-tolerant by contract, and preemption + weighted
+    admission make delay its ONLY failure mode."""
+    return [SloSpec("ttft-interactive", "k3stpu_request_ttft_seconds",
+                    threshold_s=interactive_threshold_s, target=0.999,
+                    window_days=window_days),
+            SloSpec("ttft-batch", "k3stpu_request_ttft_seconds",
+                    threshold_s=batch_threshold_s, target=0.99,
+                    window_days=window_days)]
+
+
+def predict_ttft(ttft_p50_s: float, queue_depth: int,
+                 backlog_tokens: int, slots: int,
+                 chunk_tokens: int) -> float:
+    """Forecast the TTFT a newly enqueued request would see, from
+    signals every replica already has: the measured p50 (the shared
+    ``hist_p50`` derivation — the SAME estimate the autoscaler scales
+    on), the pending-queue depth ahead of it, and the prefill backlog
+    those requests will run through the chunked-admission budget.
+
+    The model is admission waves: one "wave" is a queue slot worth of
+    work, and the backlog's chunked prefill adds
+    ``backlog_tokens / chunk_tokens`` chunk-ticks of serialized
+    admission work on top. A request behind ``w`` waves pays roughly
+    ``(1 + w / slots)`` times the empty-queue p50 (admission drains
+    ``slots`` requests per wave at best). Deliberately coarse and
+    monotone: the gate that consumes this needs "will this class's SLO
+    be breached", not milliseconds — and a monotone-in-load estimate
+    can't flap under bursty arrivals. 0.0 (admit) when there is no
+    latency history yet."""
+    if ttft_p50_s <= 0.0:
+        return 0.0
+    waves = ((float(queue_depth)
+              + float(backlog_tokens) / float(max(chunk_tokens, 1)))
+             / float(max(slots, 1)))
+    return ttft_p50_s * (1.0 + waves)
+
+
 def merge_histograms(parsed: "list[dict]",
                      metric: str) -> "dict | None":
     """Sum one family's cumulative buckets across replica scrapes

@@ -76,6 +76,12 @@ PREFILL_HEADER = "X-K3STPU-Prefill-Endpoint"
 # request from its organic histograms) and keeps the probe out of its
 # own per-replica request counters / overhead histogram.
 CANARY_HEADER = "X-K3STPU-Canary"
+# QoS priority class (docs/QOS.md): forwarded upstream unchanged so the
+# replica's admission control sees the class, and read by the router's
+# own in-flight cap — batch traffic saturates one slot EARLIER than
+# interactive, so an interactive request always has a slot to shed
+# batch into (batch-first shedding without tracking per-class queues).
+PRIORITY_HEADER = "X-K3STPU-Priority"
 
 # Fleet-saturated shed/backoff discipline — the same constants loadgen's
 # 503 retry chain uses, so a client backing off from the router behaves
@@ -465,13 +471,18 @@ class Router:
         with self._lock:
             return self._pins.get(session)
 
-    def acquire(self, replica: str) -> bool:
+    def acquire(self, replica: str, batch: bool = False) -> bool:
         """Bounded in-flight admission: False when the replica is at its
         cap (the proxy walk then tries the next candidate) or was
-        removed by a membership change after the route was computed."""
+        removed by a membership change after the route was computed.
+        Batch-class requests see the cap one slot lower (min 1), so the
+        last slot on every replica is reserved for interactive traffic
+        — batch sheds first under fleet saturation (docs/QOS.md)."""
         with self._lock:
             count = self._inflight.get(replica)
-            if count is None or count >= self.max_inflight:
+            cap = max(1, self.max_inflight - 1) if batch \
+                else self.max_inflight
+            if count is None or count >= cap:
                 return False
             self._inflight[replica] = count + 1
             return True
@@ -523,9 +534,15 @@ def make_router_app(router: Router):
         # (None = organic traffic); captured in _begin_trace so every
         # upstream leg forwards it and obs hooks can exclude the probe.
         _canary: "str | None" = None
+        # QoS class for the CURRENT request (body "priority" field wins
+        # over the inbound header; None = unclassed -> interactive).
+        # Canary probes are pinned interactive regardless — the prober
+        # must never be shed ahead of the traffic it stands in for.
+        _priority: "str | None" = None
 
         def _begin_trace(self) -> None:
             self._canary = self.headers.get(CANARY_HEADER)
+            self._priority = self.headers.get(PRIORITY_HEADER)
             raw = self.headers.get("traceparent")
             parsed = parse_traceparent(raw)
             if parsed is not None:
@@ -556,6 +573,8 @@ def make_router_app(router: Router):
                 headers[PREFILL_HEADER] = self._prefill_ep
             if self._canary is not None:
                 headers[CANARY_HEADER] = self._canary
+            if self._priority is not None:
+                headers[PRIORITY_HEADER] = self._priority
             return headers
 
         def _trace_headers(self) -> None:
@@ -675,6 +694,13 @@ def make_router_app(router: Router):
                 body = json.loads(raw) if raw else None
             except json.JSONDecodeError:
                 body = None  # opaque bodies still route (by raw-head hash)
+            # QoS class resolution mirrors the replica's: body field wins
+            # over the forwarded header; canary probes pin interactive.
+            if isinstance(body, dict) and isinstance(
+                    body.get("priority"), str):
+                self._priority = body["priority"]
+            if self._canary is not None:
+                self._priority = "interactive"
 
             if self.path == "/v1/admin/drain":
                 self._admin_drain(body)
@@ -784,10 +810,11 @@ def make_router_app(router: Router):
             call itself) feeds the proxy-overhead histogram."""
             chaos = router._chaos
             stream = self._wants_stream(raw)
+            batch = self._priority == "batch"
             saturated = True  # all skips were admission-bound?
             last_err: "tuple[int, bytes] | None" = None
             for replica in candidates:
-                if not router.acquire(replica):
+                if not router.acquire(replica, batch=batch):
                     continue
                 saturated = False
                 try:

@@ -60,6 +60,8 @@ from k3stpu.serve.runner import (
     _sample_rows,
 )
 from k3stpu.serve.scheduler import (
+    QOS_CLASSES,
+    AdmissionRejected,
     EngineOverloaded,
     SchedulerMixin,
     _Request,
@@ -68,7 +70,9 @@ from k3stpu.serve.scheduler import (
 
 __all__ = [
     "GenerateEngine",
+    "AdmissionRejected",
     "EngineOverloaded",
+    "QOS_CLASSES",
     "_PageAllocator",
     "_Request",
     "_TierCommand",
@@ -96,7 +100,10 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                  speculate: bool = False, spec_gamma: int = 4,
                  obs=None,
                  breaker=None, watchdog_s: "float | None" = None,
-                 chaos=None, tier=None, tier_watermark: int = 0):
+                 chaos=None, tier=None, tier_watermark: int = 0,
+                 qos: bool = False,
+                 interactive_ttft_slo_s: "float | None" = 2.5,
+                 batch_ttft_slo_s: "float | None" = 30.0):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -228,7 +235,24 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
         swap-in (chaos ``tier_swap``, torn disk spill) degrades to a
         cold prefill — counted in ``tier_fallbacks``, live rows
         untouched. ``release_session(sid)`` force-evicts a session's
-        chain to the tier between turns (docs/TIERING.md)."""
+        chain to the tier between turns (docs/TIERING.md).
+
+        ``qos``: SLO-aware priority classes (docs/QOS.md). Requests
+        carry ``priority`` ("interactive"/"batch"); admission walks
+        interactive first and splits the chunked-prefill token budget
+        between the classes; predictive admission control rejects a
+        request up front (``AdmissionRejected`` → 503 + Retry-After)
+        when the TTFT forecast breaches its class SLO; and — on a
+        paged engine with a ``tier`` — an interactive request that
+        cannot be admitted preempts a running batch request by parking
+        its KV chain + generation state on the tier, loss-free: the
+        victim resumes token-identically. False (the default) is
+        byte-identical to the classless engine.
+
+        ``interactive_ttft_slo_s`` / ``batch_ttft_slo_s``: per-class
+        TTFT SLOs the predictive gate enforces (None or <= 0 disables
+        the gate for that class). Defaults match
+        ``k3stpu.obs.slo.qos_specs``."""
         if max_pending is not None and max_pending < 1:
             raise ValueError(f"max_pending must be >= 1, got {max_pending}")
         if mesh is not None and "model" not in mesh.shape:
@@ -297,6 +321,12 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
         if tier_watermark < 0:
             raise ValueError(f"tier_watermark must be >= 0, got "
                              f"{tier_watermark}")
+        self.qos = bool(qos)
+        self.interactive_ttft_slo_s = (
+            None if interactive_ttft_slo_s is None
+            else float(interactive_ttft_slo_s))
+        self.batch_ttft_slo_s = (
+            None if batch_ttft_slo_s is None else float(batch_ttft_slo_s))
         self.model = model
         self.params = params
         self.slots = slots
@@ -459,6 +489,11 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
             if getattr(obs, "set_tp_shards", None) is not None:
                 obs.set_tp_shards(self.tp_shards)
             self._tp_allreduce_probe()
+        if obs is not None and self.qos \
+                and getattr(obs, "set_qos", None) is not None:
+            # Arm the per-class families only on an EXPLICIT qos engine
+            # — a classless deployment's /metrics stays byte-stable.
+            obs.set_qos(QOS_CLASSES)
         self._stats = {"tokens": 0, "steps": 0, "dispatches": 0,
                        "busy_s": 0.0, "requests": 0,
                        "slot_occupancy_sum": 0.0, "peak_active_slots": 0,
@@ -490,7 +525,13 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                        # Containment counters (docs/RESILIENCE.md).
                        "deadline_expired": 0, "watchdog_trips": 0,
                        "loop_crashes": 0, "loop_restarts": 0,
-                       "breaker_rejected": 0}
+                       "breaker_rejected": 0,
+                       # QoS (docs/QOS.md): loss-free preemptions,
+                       # parks that failed (victim kept running),
+                       # predictive-gate rejections, and forecasts
+                       # that failed open to FIFO.
+                       "preemptions": 0, "preempt_fallbacks": 0,
+                       "admission_rejected": 0, "predict_fallbacks": 0}
         # Prompt cache: tuple(prompt tokens) -> (cache_1row, last_1row),
         # insertion-ordered dict as LRU (loop thread only).
         self.prompt_cache = prompt_cache
@@ -909,6 +950,12 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                 break  # shutdown sentinel
             self._expire_deadlines()
             self._admit()
+            if self.qos and self._obs is not None:
+                n_batch = sum(1 for r in self._pending
+                              if r.priority == "batch")
+                self._obs.on_class_queue_depth(
+                    "interactive", len(self._pending) - n_batch)
+                self._obs.on_class_queue_depth("batch", n_batch)
             if (self.paged and self._tier is not None
                     and self.tier_watermark > 0):
                 self._tier_pressure()

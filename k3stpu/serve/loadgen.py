@@ -361,7 +361,13 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
     Both classes ride the SSE route so every request observes TTFT;
     TPOT is the post-first-token decode rate,
     ``(latency - ttft) / (generate_tokens - 1)``. The result carries
-    per-class TTFT and TPOT p50/p95/p99 under ``classes``."""
+    per-class TTFT and TPOT p50/p95/p99 under ``classes``.
+
+    QoS mapping (docs/QOS.md): short requests are ``interactive``, long
+    requests ``batch`` — the payloads carry the ``priority`` field
+    always (a classless server validates and ignores it), so the same
+    mixed run exercises class-weighted admission, batch-first shedding,
+    and preemption when pointed at a --qos fleet."""
     if generate_tokens < 2:
         raise ValueError("mixed mode needs --generate-tokens >= 2 "
                          "(TPOT is defined past the first token)")
@@ -369,8 +375,8 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
     w_short, w_long = mix
     n_long = max(1, round(clients * w_long / (w_short + w_long)))
     n_short = max(1, clients - n_long)
-    specs = [("short", n_short, _gen_prompt(rows)),
-             ("long", n_long, _gen_prompt(long_rows))]
+    specs = [("short", n_short, _gen_prompt(rows), "interactive"),
+             ("long", n_long, _gen_prompt(long_rows), "batch")]
 
     lock = threading.Lock()
     stop = threading.Event()
@@ -378,12 +384,14 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
     per_class: "dict[str, dict]" = {}
     threads = []
     seed = 0
-    for tag, n, prompt in specs:
+    for tag, n, prompt, priority in specs:
         payload = json.dumps({"prompt_tokens": [prompt],
                               "max_new_tokens": generate_tokens,
+                              "priority": priority,
                               "stream": True}).encode()
         cls = {"latencies": [], "ttfts": [], "errors": [],
-               "clients": n, "prompt_tokens": len(prompt)}
+               "clients": n, "prompt_tokens": len(prompt),
+               "priority": priority}
         per_class[tag] = cls
         for _ in range(n):
             threads.append(threading.Thread(
@@ -414,7 +422,7 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
     classes = {}
     all_lat_ms: "list[float]" = []
     total_errors = 0
-    for tag, _, _ in specs:
+    for tag, _, _, _ in specs:
         cls = per_class[tag]
         # latencies and ttfts append in the same locked block per
         # success, so they are index-aligned pairs.
@@ -426,6 +434,7 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
         tpots.sort()
         doc = {"clients": cls["clients"],
                "prompt_tokens": cls["prompt_tokens"],
+               "priority": cls["priority"],
                "requests": len(lat_ms),
                "errors": len(cls["errors"])}
         if lat_ms:

@@ -19,9 +19,31 @@ import jax.numpy as jnp
 import numpy as np
 
 from k3stpu.models.generate import set_cache_index
+from k3stpu.obs.slo import predict_ttft
 from k3stpu.serve.containment import CircuitOpen
 from k3stpu.serve.programs import prompt_width_bucket
 from k3stpu.serve.runner import _pow2_at_least
+
+# QoS priority classes (docs/QOS.md). "interactive" is the default for
+# unlabeled traffic ON PURPOSE: classless deployments keep exactly the
+# pre-QoS behavior (never preempted, never class-shed), and batch is an
+# explicit opt-in to delay-tolerance.
+QOS_CLASSES = ("interactive", "batch")
+
+
+def _validated_priority(priority: str) -> str:
+    if priority not in QOS_CLASSES:
+        raise ValueError(
+            f"priority must be one of {QOS_CLASSES}, got {priority!r}")
+    return priority
+
+
+# Interactive's share of the per-tick chunked-prefill token budget on a
+# qos=True engine (batch gets the rest; an empty class donates its
+# share). 3:1, not 1:0 — batch must keep a guaranteed prefill trickle
+# under sustained interactive load or its clients time out holding
+# admission tokens, which is worse than slow.
+QOS_INTERACTIVE_SHARE = 0.75
 
 
 class EngineOverloaded(RuntimeError):
@@ -31,11 +53,25 @@ class EngineOverloaded(RuntimeError):
     into client timeouts plus held memory)."""
 
 
+class AdmissionRejected(RuntimeError):
+    """Predictive admission control refused this request: the TTFT
+    forecast (queue depth + prefill backlog over the measured p50 —
+    ``k3stpu.obs.slo.predict_ttft``) breaches the class SLO, so the
+    honest answer is an immediate 503 with ``Retry-After`` instead of a
+    queued timeout. Also raised when a preemption park fails mid-swap:
+    the victim keeps running and THIS request is turned away."""
+
+    def __init__(self, msg: str, retry_after_s: float = 1.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
+
+
 class _Request:
     __slots__ = ("block", "lens", "budget", "temp", "top_k", "top_p",
                  "eos", "event", "tokens", "error", "slot_rows", "samples",
                  "deadline", "stream_q", "_ptuple", "probe", "adapter",
-                 "trace", "trace_id", "session", "synthetic")
+                 "trace", "trace_id", "session", "synthetic", "priority",
+                 "preempted_tokens")
 
     def __init__(self, block, lens, budget, temp, top_k, eos, samples=1,
                  top_p=None, adapter=0):
@@ -81,6 +117,17 @@ class _Request:
         # of the organic histograms (ServeObs hooks read it from trace
         # meta).
         self.synthetic = False
+        # QoS priority class (docs/QOS.md). Unlabeled traffic is
+        # "interactive": classless deployments keep pre-QoS behavior
+        # exactly, and only explicit "batch" requests are preemptible /
+        # shed-first.
+        self.priority = "interactive"
+        # Tokens this request emitted BEFORE being preempted (loss-free
+        # preemption, paged+tier engines): the requeued continuation
+        # decodes only the remaining budget, and _maybe_complete
+        # prepends these so the client sees one uninterrupted stream —
+        # token-identical to a never-preempted run.
+        self.preempted_tokens: "list[int]" = []
 
     def ptuple(self) -> tuple:
         """The single-prompt cache key, computed once — the admission
@@ -267,6 +314,78 @@ class SchedulerMixin:
         with self._lock:
             self._reject_if_full_locked()
 
+    # --- predictive admission control (QoS; submitter threads) ----------
+
+    def _admission_forecast(self, priority: str) -> "float | None":
+        """TTFT forecast for a request of ``priority`` arriving NOW,
+        from this replica's own signals: the obs TTFT p50 (the same
+        bucket math the autoscaler's scrape derives — obs.hist.hist_p50
+        over the rendered family equals Histogram.quantile(0.5) here)
+        plus live queue depth and prefill backlog. Interactive requests
+        count only the interactive queue ahead of them — the
+        class-ordered admission walk means batch backlog cannot delay
+        them (preemption reclaims slots). Reads of the loop-owned
+        pending list are snapshot copies (atomic under the GIL) — the
+        forecast is advisory, so a stale element is noise, not a bug.
+
+        None = no basis to reject (no latency history, obs off, or the
+        chaos point ``admission_predict`` fired — the estimator FAILS
+        OPEN: a broken predictor must degrade to the pre-QoS FIFO
+        behavior, never to rejecting everything)."""
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("admission_predict")
+            if self._obs is None:
+                return None
+            p50 = self._obs.ttft.quantile(0.5)
+            if p50 is None:
+                return None
+            pend = list(self._pending)
+            if priority != "batch":
+                pend = [r for r in pend
+                        if getattr(r, "priority", "interactive")
+                        != "batch"]
+            backlog = sum(int(r.lens.sum()) for r in pend)
+            depth = len(pend) + self._q.qsize()
+            return predict_ttft(
+                p50, depth, backlog, self.slots,
+                self.chunk_prefill if self.chunk_prefill is not None
+                else self.max_seq)
+        except Exception:  # noqa: BLE001 — estimator down ≠ service down
+            with self._lock:
+                self._stats["predict_fallbacks"] += 1
+            return None
+
+    def _class_slo_s(self, priority: str) -> "float | None":
+        return (self.batch_ttft_slo_s if priority == "batch"
+                else self.interactive_ttft_slo_s)
+
+    def _qos_admission_gate(self, req: "_Request") -> None:
+        """Reject-before-enqueue (engine qos=True): when the forecast
+        TTFT breaches the class SLO, raise AdmissionRejected with a
+        finite Retry-After sized to the predicted overshoot — overload
+        degrades to early honest rejection instead of queued timeouts.
+        Canary probes are exempt: the watchdog must see the fleet's
+        real serving behavior, and a watchdog blinded by its own
+        admission gate can't tell overload from wrongness."""
+        if not self.qos or req.synthetic:
+            return
+        slo = self._class_slo_s(req.priority)
+        if slo is None or slo <= 0.0:
+            return
+        predicted = self._admission_forecast(req.priority)
+        if predicted is None or predicted <= slo:
+            return
+        retry = min(max(predicted - slo, 1.0), 30.0)
+        with self._lock:
+            self._stats["admission_rejected"] += 1
+        if self._obs is not None:
+            self._obs.on_admission_rejected(req.priority)
+        raise AdmissionRejected(
+            f"predicted TTFT {predicted:.2f}s breaches the "
+            f"{req.priority} SLO ({slo:.2f}s); retry in {retry:.0f}s",
+            retry_after_s=retry)
+
     def _trace_enqueue(self, req: "_Request", stream: bool = False) -> None:
         """Open the request's lifecycle trace at ingress (submitter
         thread, just before the queue put — so queue wait is measured
@@ -319,7 +438,8 @@ class SchedulerMixin:
                timeout_s: float = 600.0, admitted: bool = False,
                trace_id: "str | None" = None,
                session: "str | None" = None,
-               synthetic: bool = False) -> "list[list[int]]":
+               synthetic: bool = False,
+               priority: str = "interactive") -> "list[list[int]]":
         """Blocking: returns (n, max_new_tokens) token lists.
         ``admitted``: the caller already holds an admission token
         covering this submit (see take_admission_token).
@@ -327,7 +447,13 @@ class SchedulerMixin:
         ``session``: single-prompt only — names the request's finished
         KV chain so the session's next turn (a prompt extending this
         one's prompt + reply) restores it instead of re-prefilling,
-        and so ``release_session`` can park it on the host tier."""
+        and so ``release_session`` can park it on the host tier.
+        ``priority``: QoS class ("interactive" / "batch"). On a
+        qos=True engine, batch requests are preemptible and share a
+        minority of the admission budget; either class may be rejected
+        at the door (AdmissionRejected) when its TTFT SLO would be
+        breached. On a classless engine the label is carried but
+        changes nothing."""
         if self._closed:
             raise RuntimeError("engine is closed")
         n = len(prompts)
@@ -342,6 +468,8 @@ class SchedulerMixin:
         req.trace_id = trace_id
         req.session = session
         req.synthetic = synthetic
+        req.priority = _validated_priority(priority)
+        self._qos_admission_gate(req)
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_samples(self, prompt: "list[int]", n: int, *,
@@ -351,7 +479,8 @@ class SchedulerMixin:
                        eos_id: "int | None" = None, adapter_id: int = 0,
                        timeout_s: float = 600.0, admitted: bool = False,
                        trace_id: "str | None" = None,
-                       synthetic: bool = False) -> "list[list[int]]":
+                       synthetic: bool = False,
+                       priority: str = "interactive") -> "list[list[int]]":
         """n sampled continuations of ONE prompt for the price of one
         prefill: the prefilled cache row broadcasts across n slots and the
         rows diverge through per-row sampling noise. (With temperature 0
@@ -365,6 +494,8 @@ class SchedulerMixin:
                                    adapter_id=adapter_id)
         req.trace_id = trace_id
         req.synthetic = synthetic
+        req.priority = _validated_priority(priority)
+        self._qos_admission_gate(req)
         return self._enqueue_and_wait(req, timeout_s, admitted)
 
     def submit_stream(self, prompts: "list[list[int]]", *,
@@ -375,7 +506,8 @@ class SchedulerMixin:
                       timeout_s: float = 600.0, admitted: bool = False,
                       trace_id: "str | None" = None,
                       session: "str | None" = None,
-                      synthetic: bool = False):
+                      synthetic: bool = False,
+                      priority: str = "interactive"):
         """Streaming submit(): returns an iterator of events.
 
         Incremental events are ``{"done": False, "rows": {row: [tok, ...]}}``
@@ -402,6 +534,8 @@ class SchedulerMixin:
         req.trace_id = trace_id
         req.session = session
         req.synthetic = synthetic
+        req.priority = _validated_priority(priority)
+        self._qos_admission_gate(req)
         req.stream_q = queue.SimpleQueue()
         return self._stream_events(req, timeout_s, admitted)
 
@@ -498,13 +632,39 @@ class SchedulerMixin:
             return
         self._admit_pending(allow_chunked=True)
 
+    def _admission_walk(self) -> "tuple[list, dict | None]":
+        """Admission order + per-tick class prefill budgets. Classless
+        engines walk the pending list in arrival order with no budget —
+        byte-identical to the pre-QoS scheduler. qos=True walks
+        interactive first (FIFO within each class) and splits the
+        chunked-prefill token budget QOS_INTERACTIVE_SHARE/rest between
+        the classes, work-conserving: a class with nothing pending
+        donates its share to the other."""
+        if not self.qos:
+            return list(self._pending), None
+        inter = [r for r in self._pending if r.priority != "batch"]
+        batch = [r for r in self._pending if r.priority == "batch"]
+        budget = None
+        if self.chunk_prefill is not None:
+            b = float(self.chunk_prefill)
+            budget = {"interactive": QOS_INTERACTIVE_SHARE * b,
+                      "batch": (1.0 - QOS_INTERACTIVE_SHARE) * b}
+            if not batch:
+                budget["interactive"] = b
+            if not inter:
+                budget["batch"] = b
+        return inter + batch, budget
+
     def _admit_pending(self, *, allow_chunked: bool,
                        limit: "int | None" = None) -> None:
         admitted = 0
-        i = 0
-        while i < len(self._pending) and (limit is None
-                                          or admitted < limit):
-            req = self._pending[i]
+        walk, budget_left = self._admission_walk()
+        for req in walk:
+            if limit is not None and admitted >= limit:
+                return
+            if (budget_left is not None
+                    and budget_left[req.priority] <= 0.0):
+                continue  # class prefill budget spent this tick
             # The pow2 bucket is the admission unit: bucket rows beyond n
             # also land in free slots (they must not overwrite live rows),
             # so the fit check runs on nb BEFORE any device work.
@@ -555,9 +715,15 @@ class SchedulerMixin:
                 pkey, pentry = req.probe
             chunked = c is not None and width > c and pkey is None
             if chunked and not allow_chunked:
-                i += 1  # long prompts wait for the in-flight one
-                continue
+                continue  # long prompts wait for the in-flight one
             free = self._free_slots()
+            if len(free) < nb and not chunked:
+                outcome = self._preempt_for(req)
+                while outcome == "freed" and len(self._free_slots()) < nb:
+                    outcome = self._preempt_for(req)
+                if outcome == "failed":
+                    continue  # park failed: req rejected, walk on
+                free = self._free_slots()
             if len(free) < nb:
                 return  # strict FIFO on capacity: big requests don't starve
             if self.paged:
@@ -576,10 +742,18 @@ class SchedulerMixin:
                     freed = self._pcache_evict_lru()
                     with self._lock:
                         self._stats["pcache_bytes"] -= freed
+                if need > self._alloc.free and not chunked:
+                    outcome = self._preempt_for(req)
+                    while outcome == "freed" and need > self._alloc.free:
+                        outcome = self._preempt_for(req)
+                    if outcome == "failed":
+                        continue  # park failed: req rejected, walk on
                 if need > self._alloc.free:
                     return  # strict FIFO: decodes must free pages first
-            self._pending.pop(i)
+            self._pending.remove(req)
             admitted += 1
+            if budget_left is not None:
+                budget_left[req.priority] -= float(width)
             tr = req.trace
             if self._obs is not None:
                 wait = (time.perf_counter() - tr.t_enqueue
@@ -686,6 +860,131 @@ class SchedulerMixin:
                 req.error = e
                 req.signal()
                 continue
+
+    # --- loss-free preemption (loop thread; docs/QOS.md) ----------------
+
+    def _preempt_for(self, req: "_Request") -> str:
+        """Try to free capacity for interactive ``req`` by parking ONE
+        running batch-class row's generation state on the host tier and
+        requeueing it as its own continuation. Returns "freed" (caller
+        re-checks capacity and may preempt again), "none" (no eligible
+        victim — req waits FIFO exactly like the classless engine), or
+        "failed" (the park failed mid-swap: the victim keeps running
+        untouched and ``req`` was rejected with a Retry-After).
+
+        Eligible victims are single-prompt, single-sample, greedy,
+        non-streaming batch requests: greedy because the resumed
+        continuation must be token-identical (a sampled row's RNG
+        stream is positional state the park does not carry), and
+        non-streaming because the client already consumed the parked
+        tokens — replaying them through a live stream would emit them
+        twice. Among eligible rows the one with the FEWEST collected
+        tokens parks (smallest host copy), ties to the highest row."""
+        if (not self.qos or not self.paged or self._tier is None
+                or req.priority == "batch"):
+            return "none"
+        victim = None
+        for r in range(self.slots):
+            o = self._owner[r]
+            if o is None or not self._active[r]:
+                continue
+            if (o.priority != "batch" or o.synthetic or o.samples != 1
+                    or o.block.shape[0] != 1 or o.stream_q is not None
+                    or o.temp != 0.0):
+                continue
+            if (victim is None or len(self._collected[r])
+                    <= len(self._collected[victim])):
+                victim = r
+        if victim is None:
+            return "none"
+        vreq = self._owner[victim]
+        t0 = time.perf_counter()
+        if not self._preempt_park(vreq, victim):
+            # Nothing was mutated: the victim keeps decoding, and the
+            # interactive trigger is turned away honestly instead of
+            # waiting behind a batch request it was promised priority
+            # over.
+            with self._lock:
+                self._stats["preempt_fallbacks"] += 1
+                self._stats["admission_rejected"] += 1
+            if self._obs is not None:
+                self._obs.on_admission_rejected(req.priority)
+            self._pending.remove(req)
+            req.error = AdmissionRejected(
+                "preemption park failed mid-swap; the running request "
+                "keeps its slot — retry shortly", retry_after_s=1.0)
+            req.signal()
+            return "failed"
+        self._preempt_requeue(vreq, victim)
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self._stats["preemptions"] += 1
+        if self._obs is not None:
+            self._obs.on_preempt(dt)
+        if vreq.trace is not None:
+            vreq.trace.event("preempted",
+                             {"row": victim,
+                              "emitted": len(vreq.preempted_tokens)})
+        return "freed"
+
+    def _preempt_park(self, vreq: "_Request", r: int) -> bool:
+        """Copy row ``r``'s generation state to the host tier WITHOUT
+        mutating engine state — all-or-nothing, so a failure leaves the
+        victim running exactly as before (chaos point ``preempt_park``
+        drills this). The parked key is the victim's prompt + every
+        emitted token but the LAST: the chain holds K/V for exactly
+        those positions (the newest sampled token was never fed back —
+        the same invariant ``_session_insert`` relies on), so the
+        resume prompt (prompt + ALL emitted tokens) prefix-hits the
+        entry and re-decodes one token for exact continuation logits.
+        ``last=None`` like a session tail: the entry is a resume point,
+        not an exact-hit cache (no stored logits to serve)."""
+        try:
+            if self._chaos is not None:
+                self._chaos.fire("preempt_park")
+            toks = self._collected[r]
+            key_prompt = vreq.ptuple() + tuple(int(t) for t in toks[:-1])
+            n_entry = -(-len(key_prompt) // self.page_size)
+            host = self._gather_pages(self._chains[r][:n_entry])
+            self._tier.put((vreq.adapter, key_prompt), len(key_prompt),
+                           host, last=None)
+            return True
+        except Exception:  # noqa: BLE001 — containment: park must not kill
+            return False   # the loop; the caller degrades per contract
+
+    def _preempt_requeue(self, vreq: "_Request", r: int) -> None:
+        """Release the victim's row and mutate the request object into
+        its own continuation at the FRONT of the pending queue: prompt
+        grows by the emitted tokens, budget shrinks by the same count
+        (B - g >= 1 because an active row always has >= 1 budget left).
+        The event/trace/deadline/waiter registration all carry over —
+        the blocked submitter never notices. Runs ONLY after a
+        successful park; on re-admission the tier probe prefix-hits the
+        parked chain (or, if it was evicted, a cold prefill of the
+        grown prompt — token-identical either way, just slower)."""
+        toks = [int(t) for t in self._collected[r]]
+        prompt = list(vreq.ptuple()) + toks
+        # Row teardown = the _finish_row discipline minus the session
+        # insert (the request is NOT finished; its session, if any,
+        # inserts when the continuation completes the conversation).
+        self._active[r] = False
+        self._temps[r] = 0.0
+        if self.speculate:
+            self._spec_hist[r] = []
+        self._owner[r] = None
+        self._collected[r] = []
+        self._release_slot_pages(r)
+        width = prompt_width_bucket(len(prompt), self.max_seq)
+        block = np.zeros((1, width), np.int32)
+        block[0, :len(prompt)] = prompt
+        vreq.block = block
+        vreq.lens = np.asarray([len(prompt)], np.int32)
+        vreq.budget = vreq.budget - len(toks)
+        vreq.preempted_tokens.extend(toks)
+        vreq._ptuple = None  # prompt changed; recompute on next use
+        vreq.probe = None
+        vreq.slot_rows = []
+        self._pending.insert(0, vreq)
 
     def _admission_step(self) -> None:
         """One chunk of the in-flight admission (or its finalize)."""
@@ -932,9 +1231,13 @@ class SchedulerMixin:
                     + [int(first[j])])
                 self._spec_depth[r] = self.spec_gamma
         with self._lock:
-            self._stats["requests"] += 1
+            # A preempted continuation is the SAME request resuming,
+            # not a new one (its first token is a mid-stream token).
+            if not req.preempted_tokens:
+                self._stats["requests"] += 1
             self._stats["tokens"] += len(rows)  # first sampled tokens
-        if self._obs is not None and req.trace is not None:
+        if (self._obs is not None and req.trace is not None
+                and not req.preempted_tokens):
             tr = req.trace
             # TTFT from ENQUEUE (the client-visible clock: queue wait +
             # prefill), not from admission.
@@ -1038,6 +1341,12 @@ class SchedulerMixin:
         for r in req.slot_rows:
             toks = self._collected[r][:pad_to]
             toks += [toks[-1]] * (pad_to - len(toks))  # eos-extend
+            if req.preempted_tokens:
+                # Loss-free preemption: the tokens emitted before the
+                # park + the resumed tail = the ORIGINAL budget, one
+                # uninterrupted greedy stream (tests/test_qos.py pins
+                # bit-exactness against a never-preempted twin).
+                toks = req.preempted_tokens + toks
             out.append(toks)
             self._owner[r] = None
             self._collected[r] = []

@@ -65,6 +65,12 @@ BATCH_SIZES = (1, 8, 32)
 # the organic histograms (SLO / autoscaler inputs).
 CANARY_HEADER = "X-K3STPU-Canary"
 
+# QoS priority class (docs/QOS.md): the router forwards it, the handler
+# turns it into the engine's ``priority`` kwarg. The JSON body's
+# ``priority`` field wins over the header (the header is the router's
+# channel; the body is the client's).
+PRIORITY_HEADER = "X-K3STPU-Priority"
+
 
 def lm_base_cfg(cfg):
     """The TransformerConfig that actually carries the LM knobs: MoE
@@ -283,7 +289,11 @@ class InferenceServer:
                  instance: "str | None" = None,
                  role: str = "monolithic",
                  prefill_upstream: "str | None" = None,
-                 chaos=None):
+                 chaos=None,
+                 qos: bool = False,
+                 qos_classes: str = "interactive,batch",
+                 interactive_ttft_slo_ms: float = 2500.0,
+                 batch_ttft_slo_ms: float = 30000.0):
         """``shard_devices``: tensor-parallel serving over that many local
         devices (the multi-chip-pod workload — a pod requesting
         ``google.com/tpu: 4`` shards the model across its 4 chips; the
@@ -353,6 +363,24 @@ class InferenceServer:
                     f"--shard-devices {shard_devices}")
             shard_devices = tp_shards
         self.tp_shards = tp_shards
+        # SLO-aware QoS (docs/QOS.md): priority classes + predictive
+        # admission + loss-free preemption. Engine-loop features, so the
+        # flag requires continuous batching; default off keeps the
+        # classless exposition byte-stable.
+        if qos and not continuous_batching:
+            raise ValueError(
+                "--qos requires --continuous-batching: priority classes, "
+                "predictive admission, and preemption are engine-loop "
+                "features")
+        self.qos = bool(qos)
+        self.qos_classes = tuple(
+            c.strip() for c in qos_classes.split(",") if c.strip())
+        if qos and self.qos_classes != ("interactive", "batch"):
+            raise ValueError(
+                f"--qos-classes must be 'interactive,batch' (the only "
+                f"supported class set), got {qos_classes!r}")
+        self.interactive_ttft_slo_ms = float(interactive_ttft_slo_ms)
+        self.batch_ttft_slo_ms = float(batch_ttft_slo_ms)
         # Two locks with distinct jobs: _lock serializes DEVICE dispatch
         # ("one chip, one queue" — held for whole generations), while
         # _stats_lock guards only the counters, so /metrics scrapes and
@@ -729,7 +757,9 @@ class InferenceServer:
                 spec_gamma=spec_gamma, obs=self._obs,
                 breaker=self._breaker, watchdog_s=watchdog_s,
                 chaos=chaos, tier=self._tier,
-                tier_watermark=tier_watermark)
+                tier_watermark=tier_watermark, qos=qos,
+                interactive_ttft_slo_s=interactive_ttft_slo_ms / 1000.0,
+                batch_ttft_slo_s=batch_ttft_slo_ms / 1000.0)
 
         # Speculative decoding (serve/speculative.py): greedy /v1/generate
         # requests draft with a small model and verify whole proposal
@@ -1030,7 +1060,10 @@ class InferenceServer:
                         adapter: "str | None" = None,
                         trace_id: "str | None" = None,
                         session: "str | None" = None,
-                        synthetic: bool = False) -> "list[list[int]]":
+                        synthetic: bool = False,
+                        priority: str = "interactive",
+                        deadline_ms: "float | None" = None) \
+            -> "list[list[int]]":
         """KV-cache generation for a ragged batch of token prompts.
 
         Prompts are right-padded with each row's last token to a shared
@@ -1051,6 +1084,7 @@ class InferenceServer:
             prompts, max_new_tokens, num_samples)
         aid = self._adapter_id(adapter)
         self._validate_session(session, prompts, num_samples)
+        timeout_s = self._deadline_timeout(deadline_ms)
         if num_samples > 1:
             if len(prompts) != 1:
                 raise ValueError(
@@ -1082,7 +1116,8 @@ class InferenceServer:
                         prompts[0], k, max_new_tokens=gen_budget,
                         temperature=temperature, top_k=top_k, top_p=top_p,
                         eos_id=eos_id, adapter_id=aid, admitted=True,
-                        trace_id=trace_id, synthetic=synthetic))
+                        trace_id=trace_id, synthetic=synthetic,
+                        timeout_s=timeout_s, priority=priority))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1162,7 +1197,8 @@ class InferenceServer:
                         max_new_tokens=gen_budget, temperature=temperature,
                         top_k=top_k, top_p=top_p, eos_id=eos_id,
                         adapter_id=aid, admitted=True, trace_id=trace_id,
-                        session=session, synthetic=synthetic))
+                        session=session, synthetic=synthetic,
+                        timeout_s=timeout_s, priority=priority))
             finally:
                 self._engine.release_admission_token()
             dt = time.perf_counter() - t0
@@ -1216,6 +1252,21 @@ class InferenceServer:
             self._obs.e2e.observe(dt, trace_id=trace_id)
         return self._corrupt_check(out.tolist())
 
+    @staticmethod
+    def _deadline_timeout(deadline_ms: "float | None") -> float:
+        """Map a client ``deadline_ms`` onto the engine's submit timeout:
+        a request that cannot finish inside its deadline should fail AT
+        the deadline (EngineStalled -> 503 + Retry-After), not hold its
+        slot for the default ten minutes. Capped at the default so a huge
+        deadline never extends the watchdog window."""
+        if deadline_ms is None:
+            return 600.0
+        d = float(deadline_ms)
+        if not (d > 0.0) or d != d:
+            raise ValueError(
+                f"deadline_ms must be a positive number, got {deadline_ms!r}")
+        return min(600.0, d / 1000.0)
+
     def _validate_session(self, session, prompts, num_samples) -> None:
         """ONE gate for the session-id API, shared by generate_tokens
         and generate_stream: sessions name exactly one paged KV chain,
@@ -1253,7 +1304,9 @@ class InferenceServer:
                         adapter: "str | None" = None,
                         trace_id: "str | None" = None,
                         session: "str | None" = None,
-                        synthetic: bool = False):
+                        synthetic: bool = False,
+                        priority: str = "interactive",
+                        deadline_ms: "float | None" = None):
         """Streaming generate: an iterator of JSON-able events for the
         SSE route. Engine-backed requests yield per-decode-block deltas
         ``{"done": False, "rows": {global_row: [tok, ...]}}`` as tokens
@@ -1270,6 +1323,7 @@ class InferenceServer:
             prompts, max_new_tokens, num_samples)
         aid = self._adapter_id(adapter)
         self._validate_session(session, prompts, num_samples)
+        timeout_s = self._deadline_timeout(deadline_ms)
         lens = [len(p) for p in prompts]
         (width, gen_budget, temperature, top_k, top_p,
          eos_id) = self._sanitize_gen(lens, max_new_tokens, temperature,
@@ -1281,7 +1335,8 @@ class InferenceServer:
                 prompts, max_new_tokens=max_new_tokens,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, num_samples=num_samples, adapter=adapter,
-                trace_id=trace_id, synthetic=synthetic)
+                trace_id=trace_id, synthetic=synthetic,
+                priority=priority, deadline_ms=deadline_ms)
             return iter([{"done": True, "tokens": tokens}])
         # Engine route only, AFTER the routing decisions (a spec/fallback
         # request never touches the admission counter, so it must not be
@@ -1296,12 +1351,14 @@ class InferenceServer:
         self._engine.reject_if_at_capacity()
         return self._stream_engine_events(
             prompts, max_new_tokens, gen_budget, temperature, top_k,
-            top_p, eos_id, aid, trace_id, session, synthetic)
+            top_p, eos_id, aid, trace_id, session, synthetic,
+            priority, timeout_s)
 
     def _stream_engine_events(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid=0,
                               trace_id=None, session=None,
-                              synthetic=False):
+                              synthetic=False, priority="interactive",
+                              timeout_s=600.0):
         """Engine-backed streaming (args pre-sanitized). The admission
         token is taken HERE, on the generator's first next(), so a
         generator that is created but never iterated cannot leak the
@@ -1317,7 +1374,8 @@ class InferenceServer:
         try:
             yield from self._stream_engine_chunks(
                 prompts, max_new_tokens, gen_budget, temperature, top_k,
-                top_p, eos_id, aid, out, trace_id, session, synthetic)
+                top_p, eos_id, aid, out, trace_id, session, synthetic,
+                priority, timeout_s)
         finally:
             self._engine.release_admission_token()
         dt = time.perf_counter() - t0
@@ -1331,7 +1389,8 @@ class InferenceServer:
     def _stream_engine_chunks(self, prompts, max_new_tokens, gen_budget,
                               temperature, top_k, top_p, eos_id, aid,
                               out, trace_id=None, session=None,
-                              synthetic=False):
+                              synthetic=False, priority="interactive",
+                              timeout_s=600.0):
         for ofs in range(0, len(prompts), self._engine.slots):
             chunk = prompts[ofs:ofs + self._engine.slots]
             emitted = [0] * len(chunk)
@@ -1339,7 +1398,8 @@ class InferenceServer:
                 chunk, max_new_tokens=gen_budget,
                 temperature=temperature, top_k=top_k, top_p=top_p,
                 eos_id=eos_id, adapter_id=aid, admitted=True,
-                trace_id=trace_id, session=session, synthetic=synthetic)
+                trace_id=trace_id, session=session, synthetic=synthetic,
+                priority=priority, timeout_s=timeout_s)
             try:
                 for ev in events:
                     if ev["done"]:
@@ -1738,7 +1798,7 @@ class InferenceServer:
 def make_app(server: InferenceServer):
     """Returns the BaseHTTPRequestHandler class bound to `server`."""
     from k3stpu.serve.containment import CircuitOpen, EngineStalled
-    from k3stpu.serve.engine import EngineOverloaded
+    from k3stpu.serve.engine import AdmissionRejected, EngineOverloaded
 
     class Handler(BaseHTTPRequestHandler):
         # W3C trace context for the CURRENT request: (trace_id,
@@ -1982,7 +2042,11 @@ def make_app(server: InferenceServer):
                         num_samples=req.get("num_samples", 1),
                         adapter=req.get("adapter"),
                         session=req.get("session"),
-                        synthetic=bool(self.headers.get(CANARY_HEADER)))
+                        synthetic=bool(self.headers.get(CANARY_HEADER)),
+                        priority=(req.get("priority")
+                                  or self.headers.get(PRIORITY_HEADER)
+                                  or "interactive"),
+                        deadline_ms=req.get("deadline_ms"))
                     if req.get("stream"):
                         events = server.generate_stream(
                             req["prompt_tokens"],
@@ -2000,6 +2064,13 @@ def make_app(server: InferenceServer):
                     # Engine queue backlog exceeded the wait budget: a
                     # clean 503 beats an http.server traceback + reset.
                     self._send(503, {"error": str(e)})
+                except AdmissionRejected as e:
+                    # Predictive admission control (docs/QOS.md): the
+                    # class TTFT SLO would be breached if this request
+                    # queued — or a preemption park failed mid-swap.
+                    # Retry-After carries the predicted drain time.
+                    self._send(503, {"error": str(e)}, headers={
+                        "Retry-After": str(max(1, round(e.retry_after_s)))})
                 except (EngineOverloaded, EngineStalled) as e:
                     # Admission bound hit (--max-pending) or a watchdog
                     # trip failed the request mid-flight: shed load with
@@ -2298,6 +2369,27 @@ def main(argv=None) -> int:
                          "peer to pull KV chains from when the request "
                          "carries no X-K3STPU-Prefill-Endpoint header "
                          "(the router injects that header per request)")
+    ap.add_argument("--qos", action="store_true",
+                    help="SLO-aware QoS (docs/QOS.md): priority classes on "
+                         "/v1/generate, class-weighted prefill budgeting, "
+                         "predictive admission control, and tier-backed "
+                         "loss-free preemption of batch requests; requires "
+                         "--continuous-batching")
+    ap.add_argument("--qos-classes", default="interactive,batch",
+                    metavar="CLASSES",
+                    help="comma-separated priority class set (only "
+                         "'interactive,batch' is supported; the flag "
+                         "exists so the chart's class list renders "
+                         "explicitly)")
+    ap.add_argument("--interactive-ttft-slo-ms", type=float, default=2500.0,
+                    metavar="MS",
+                    help="interactive-class TTFT SLO: predictive admission "
+                         "rejects an interactive request with 503 + "
+                         "Retry-After when its forecast TTFT exceeds this")
+    ap.add_argument("--batch-ttft-slo-ms", type=float, default=30000.0,
+                    metavar="MS",
+                    help="batch-class TTFT SLO for predictive admission "
+                         "(batch tolerates long queues; this bounds them)")
     ap.add_argument("--compilation-cache", default=None, metavar="DIR",
                     help="persistent XLA compilation cache (volume mount): "
                          "a restarted pod reuses compiled programs instead "
@@ -2353,7 +2445,12 @@ def main(argv=None) -> int:
                                  args.port),
                              role=args.role,
                              prefill_upstream=args.prefill_upstream,
-                             chaos=_chaos_from_env())
+                             chaos=_chaos_from_env(),
+                             qos=args.qos,
+                             qos_classes=args.qos_classes,
+                             interactive_ttft_slo_ms=(
+                                 args.interactive_ttft_slo_ms),
+                             batch_ttft_slo_ms=args.batch_ttft_slo_ms)
     if server.loaded_step is not None:
         print(f"loaded checkpoint step {server.loaded_step} "
               f"from {args.ckpt_dir}", flush=True)

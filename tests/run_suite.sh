@@ -35,7 +35,9 @@
 #            drift/capacity), and the KV-tiering suite (host-store
 #            units, swap round-trip exactness, pin hygiene, tier_swap
 #            fault degradation), and the correctness-watchdog suite
-#            (canary known-answer probes + SLO burn-rate math) ride
+#            (canary known-answer probes + SLO burn-rate math), and
+#            the QoS suite (priority classes, predictive admission,
+#            loss-free preemption bit-exactness) ride
 #            along minus their @slow soak/bench tests (the full suite
 #            runs those).
 set -u
@@ -68,6 +70,7 @@ SMOKE=(
   tests/test_tp_serve.py
   tests/test_slo.py
   tests/test_canary.py
+  tests/test_qos.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -21,6 +21,7 @@ import pytest
 
 from k3stpu.canary import (
     CANARY_HEADER,
+    PRIORITY_HEADER,
     VERDICT_MISMATCH,
     VERDICT_OK,
     VERDICT_UNREACHABLE,
@@ -42,7 +43,8 @@ def _start_fake(answers, corrupt=False, bad_deltas=False):
     is scriptable via state["replicas"], /v1/generate answers from the
     canned table (optionally corrupted / with lying SSE deltas)."""
     state = {"answers": dict(answers), "replicas": [], "corrupt": corrupt,
-             "bad_deltas": bad_deltas, "canary_headers": []}
+             "bad_deltas": bad_deltas, "canary_headers": [],
+             "priority_headers": [], "body_priorities": []}
 
     class _H(BaseHTTPRequestHandler):
         def log_message(self, *a):
@@ -72,6 +74,9 @@ def _start_fake(answers, corrupt=False, bad_deltas=False):
                 return
             state["canary_headers"].append(
                 self.headers.get(CANARY_HEADER))
+            state["priority_headers"].append(
+                self.headers.get(PRIORITY_HEADER))
+            state["body_priorities"].append(body.get("priority"))
             ans = list(state["answers"][tuple(body["prompt_tokens"][0])])
             if state["corrupt"]:
                 ans = [t + 1 for t in ans]
@@ -135,6 +140,26 @@ def test_golden_then_clean_round_all_paths_ok():
         h == "1" for h in state["canary_headers"])
     # Stream probe measured per-token latency.
     assert paths["stream"][0].ttft_s is not None
+
+
+def test_probes_are_tagged_interactive_end_to_end():
+    """Every canary request — golden recording and all probe paths —
+    must carry the interactive priority in BOTH the router header and
+    the engine-facing body field, or a QoS-enabled fleet under overload
+    would shed/preempt/reject its own watchdog and the correctness
+    signal would flap exactly when it matters most."""
+    httpd, url, state = _start_fake(ANSWERS)
+    try:
+        can = _canary(url)
+        can.record_golden()
+        can.probe_round()
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+    assert state["priority_headers"] and all(
+        h == "interactive" for h in state["priority_headers"])
+    assert state["body_priorities"] and all(
+        p == "interactive" for p in state["body_priorities"])
 
 
 def test_corrupt_replica_direct_probe_isolates_mismatch():

@@ -175,6 +175,110 @@ def test_spec_verify_fault_falls_back_to_plain_decode(mp):
         eng.close()
 
 
+# --- fault class: QoS preemption park / predictive admission ------------
+
+
+def test_preempt_park_fault_leaves_victim_running_rejects_trigger(mp):
+    """A park that dies mid-swap (page gather / tier put) must abort
+    BEFORE any victim state is torn down: the batch victim keeps its
+    slot and finishes bit-exactly, the interactive trigger is rejected
+    honestly (503-shaped AdmissionRejected with a Retry-After), and
+    the allocator comes back to baseline — a failed park is a capacity
+    miss, never a lost or corrupted request (docs/QOS.md)."""
+    from k3stpu.models.generate import generate
+    from k3stpu.serve.engine import AdmissionRejected
+    from k3stpu.serve.tiering import HostPageStore
+
+    model, params = mp
+    chaos = FaultInjector()
+    eng = GenerateEngine(model, params, seed=0, slots=1, page_size=8,
+                         prompt_cache=2, qos=True,
+                         tier=HostPageStore(64 << 20), chaos=chaos)
+    try:
+        bp = [5, 6, 7, 8, 9, 10, 11, 12]
+        want = np.asarray(generate(
+            model, params, jnp.asarray(np.array([bp], np.int32)),
+            jnp.array([len(bp)], jnp.int32), 20,
+            temperature=0.0))[0].tolist()
+        chaos.arm("preempt_park", exc=InjectedFault("park died mid-swap"))
+        out = {}
+
+        def run_batch():
+            out["batch"] = eng.submit([bp], max_new_tokens=20,
+                                      priority="batch", timeout_s=60.0)
+
+        t = threading.Thread(target=run_batch)
+        t.start()
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            o = eng._owner[0]
+            if (o is not None and eng._active[0]
+                    and len(eng._collected[0]) >= 2):
+                break
+            time.sleep(0.002)
+        with pytest.raises(AdmissionRejected) as ei:
+            eng.submit([[20, 21, 22]], max_new_tokens=4, timeout_s=60.0)
+        assert ei.value.retry_after_s >= 1.0
+        t.join(60)
+        assert not t.is_alive(), "victim thread stuck"
+        assert chaos.fired("preempt_park") == 1
+        assert out["batch"] == [want], (
+            "the victim's output changed — the failed park tore state")
+        s = eng.stats()
+        assert s["preempt_fallbacks"] == 1
+        assert s["preemptions"] == 0
+        # Allocator invariants hold exactly: every page's refcount is
+        # its live chain + prompt-cache-pin references, free agrees.
+        alloc, expect = eng._alloc, {}
+        for chain in eng._chains:
+            for p in chain:
+                expect[p] = expect.get(p, 0) + 1
+        for entry in eng._pcache.values():
+            for p in entry[0]:
+                expect[p] = expect.get(p, 0) + 1
+        for p in range(1, alloc.num_pages):
+            assert alloc.refcount(p) == expect.get(p, 0)
+        assert alloc.free == alloc.total - sum(
+            1 for v in expect.values() if v > 0)
+        # Fresh work still completes: nothing is wedged or poisoned.
+        eng.submit([[1, 2, 3]], max_new_tokens=2, timeout_s=30.0)
+    finally:
+        eng.close()
+
+
+def test_admission_predict_fault_fails_open(mp):
+    """A broken TTFT estimator must degrade the predictive gate to the
+    pre-QoS FIFO admission (fail OPEN, ``predict_fallbacks`` counted)
+    — never to rejecting live traffic on a bad forecast."""
+    from k3stpu.obs import ServeObs
+    from k3stpu.serve.engine import AdmissionRejected
+    from k3stpu.serve.tiering import HostPageStore
+
+    model, params = mp
+    chaos = FaultInjector()
+    obs = ServeObs()
+    eng = GenerateEngine(model, params, seed=0, slots=2, page_size=8,
+                         prompt_cache=2, qos=True,
+                         tier=HostPageStore(64 << 20),
+                         chaos=chaos, obs=obs,
+                         interactive_ttft_slo_s=1e-4)
+    try:
+        eng.submit([[5, 6, 7, 8]], max_new_tokens=2)  # seeds the p50
+        # Positive control: with the estimator healthy, the impossible
+        # SLO rejects at the door.
+        with pytest.raises(AdmissionRejected):
+            eng.submit([[5, 6, 7, 9]], max_new_tokens=2)
+        chaos.arm("admission_predict",
+                  exc=InjectedFault("estimator down"))
+        out = eng.submit([[5, 6, 8, 9]], max_new_tokens=2,
+                         timeout_s=30.0)
+        assert len(out[0]) == 2, "fail-open admission must still serve"
+        assert chaos.fired("admission_predict") == 1
+        assert eng.stats()["predict_fallbacks"] == 1
+    finally:
+        eng.close()
+
+
 # --- fault class: loop-thread death -------------------------------------
 
 

@@ -93,6 +93,53 @@ def test_inference_speculate_flags_travel_together():
     assert cmd[cmd.index("--kv-page-size") + 1] == "64"
 
 
+def test_qos_disabled_by_default():
+    # QoS is opt-in like every serving feature: no --qos flags (and no
+    # engine flags they would drag in) leak into a plain inference
+    # render, and the per-class burn-rate alerts stay out of the rules
+    # ConfigMap — default renders stay byte-stable.
+    objs = render({"inference.enabled": "true", "rules.enabled": "true"})
+    cmd = objs[("Deployment", "tpu-inference")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    for flag in ("--qos", "--qos-classes", "--interactive-ttft-slo-ms",
+                 "--continuous-batching"):
+        assert flag not in cmd
+    alerts = yaml.safe_load(objs[("ConfigMap", "k3s-tpu-rules")][
+        "data"]["k3s-tpu-alerts.rules.yaml"])
+    names = {r["alert"] for g in alerts["groups"] for r in g["rules"]}
+    assert "K3sTpuInteractiveTtftBudgetFastBurn" not in names
+    assert "K3sTpuBatchTtftBudgetSlowBurn" not in names
+
+
+def test_qos_enabled_wiring():
+    # docs/QOS.md: inference.qos.* renders the server's QoS unit — the
+    # class flags plus the paged-engine flags QoS requires (the server
+    # validates --qos needs --continuous-batching at boot) — and the
+    # same switch grows the per-class burn-rate alert pair, with the
+    # interactive SLO value reaching the page alert's description.
+    objs = render({"inference.enabled": "true",
+                   "inference.qos.enabled": "true",
+                   "inference.qos.interactiveTtftSloMs": "1800",
+                   "rules.enabled": "true"})
+    cmd = objs[("Deployment", "tpu-inference")]["spec"]["template"][
+        "spec"]["containers"][0]["command"]
+    assert "--qos" in cmd and "--continuous-batching" in cmd
+    assert cmd[cmd.index("--qos-classes") + 1] == "interactive,batch"
+    assert cmd[cmd.index("--interactive-ttft-slo-ms") + 1] == "1800"
+    assert cmd[cmd.index("--kv-page-size") + 1] == "64"
+    alerts = yaml.safe_load(objs[("ConfigMap", "k3s-tpu-rules")][
+        "data"]["k3s-tpu-alerts.rules.yaml"])
+    rules = {r["alert"]: r for g in alerts["groups"] for r in g["rules"]}
+    fast = rules["K3sTpuInteractiveTtftBudgetFastBurn"]
+    assert 'slo="ttft-interactive",window="5m"} > 14.4' in fast["expr"]
+    assert 'window="1h"' in fast["expr"]
+    assert fast["labels"]["severity"] == "page"
+    assert "1800" in fast["annotations"]["description"]
+    slow = rules["K3sTpuBatchTtftBudgetSlowBurn"]
+    assert 'slo="ttft-batch",window="6h"} > 1' in slow["expr"]
+    assert slow["labels"]["severity"] == "ticket"
+
+
 def test_router_disabled_by_default():
     # Same opt-in rule as the workloads: the scale-out tier is explicit,
     # and the default golden rendering stays byte-stable.
@@ -507,12 +554,20 @@ def _golden_case(name):
                         "router.enabled": "true",
                         "inference.enabled": "true",
                         "rules.enabled": "true"},
+        # SLO-aware QoS (docs/QOS.md): the inference Deployment with
+        # priority classes + predictive admission + preemption on, and
+        # the rules ConfigMap growing the per-class burn-rate alert
+        # pair the same values switch on.
+        "qos.yaml": {"inference.enabled": "true",
+                     "inference.qos.enabled": "true",
+                     "rules.enabled": "true"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
                 "train.yaml", "node-obs.yaml", "router.yaml",
-                "autoscaler.yaml", "disagg.yaml", "canary.yaml"]
+                "autoscaler.yaml", "disagg.yaml", "canary.yaml",
+                "qos.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

@@ -1,0 +1,362 @@
+"""SLO-aware QoS (docs/QOS.md): priority classes, predictive admission
+control, and tier-backed loss-free preemption.
+
+The acceptance centerpiece: a batch request preempted mid-generation —
+page chain parked in the host tier, request requeued, resumed — must
+finish with output TOKEN-IDENTICAL to a never-preempted solo run,
+including across a COW-shared prefix and the int8 KV pool. The
+predictive gate must reject with a finite Retry-After under backlog,
+fail OPEN when its estimator breaks, and the classless engine's
+scheduling and /metrics exposition must stay byte-identical to the
+pre-QoS build.
+"""
+
+import math
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from k3stpu.chaos import FaultInjector
+from k3stpu.models.generate import generate
+from k3stpu.models.transformer import transformer_lm_tiny
+from k3stpu.obs import ServeObs
+from k3stpu.obs.slo import predict_ttft, qos_specs
+from k3stpu.serve.engine import (
+    QOS_CLASSES,
+    AdmissionRejected,
+    GenerateEngine,
+)
+from k3stpu.serve.scheduler import QOS_INTERACTIVE_SHARE
+from k3stpu.serve.server import InferenceServer
+from k3stpu.serve.tiering import HostPageStore
+
+QOS_FAMILIES = (
+    "k3stpu_serve_class_queue_depth",
+    "k3stpu_serve_preemptions_total",
+    "k3stpu_serve_admission_rejected_total",
+    "k3stpu_serve_preempt_park_seconds",
+)
+
+
+@pytest.fixture(scope="module")
+def mp():
+    model = transformer_lm_tiny(max_seq_len=64)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                           train=False)
+    return model, variables["params"]
+
+
+def _solo(model, params, prompt, budget):
+    out = generate(model, params,
+                   jnp.asarray(np.array([prompt], np.int32)),
+                   jnp.array([len(prompt)], jnp.int32), budget,
+                   temperature=0.0)
+    return np.asarray(out)[0].tolist()
+
+
+def _qos_engine(model, params, *, tier_mb=64, chaos=None, obs=None, **kw):
+    """A qos=True paged+tiered engine, slots=1 by default so ONE batch
+    request owns the only decode row and an interactive arrival has no
+    choice but the preemption path — the race-free way to force a
+    park on every scheduler tick ordering."""
+    kw.setdefault("slots", 1)
+    kw.setdefault("prompt_cache", 4)
+    kw.setdefault("page_size", 8)
+    store = HostPageStore(tier_mb * (1 << 20))
+    eng = GenerateEngine(model, params, seed=0, qos=True, tier=store,
+                         chaos=chaos, obs=obs, **kw)
+    return eng, store
+
+
+def _assert_page_invariants(engine):
+    """Idle-engine allocator accounting, checked exactly (the same
+    proof as tests/test_paged.py / tests/test_tiering.py): every
+    page's refcount equals its appearances across live slot chains
+    plus prompt-cache pins — a leaked page or stranded pin after
+    preemption traffic fails here."""
+    alloc = engine._alloc
+    expect = {}
+    for chain in engine._chains:
+        for p in chain:
+            expect[p] = expect.get(p, 0) + 1
+    for entry in engine._pcache.values():
+        for p in entry[0]:
+            expect[p] = expect.get(p, 0) + 1
+    for p in range(1, alloc.num_pages):
+        assert alloc.refcount(p) == expect.get(p, 0), (
+            f"page {p}: rc={alloc.refcount(p)} but "
+            f"{expect.get(p, 0)} live references")
+    assert alloc.free == alloc.total - sum(1 for v in expect.values()
+                                           if v > 0)
+
+
+def _preempt_scenario(engine, batch_prompt, batch_budget, inter_prompt,
+                      inter_budget, min_tokens=2):
+    """Run the preemption race deterministically: a batch request
+    holding the lone slot, polled until it has decoded ``min_tokens``
+    (so the park carries real mid-generation state), then an
+    interactive submit that must displace it. Returns
+    (batch_result_or_exc, interactive_result_or_exc)."""
+    out = {}
+
+    def run_batch():
+        try:
+            out["batch"] = engine.submit(
+                [batch_prompt], max_new_tokens=batch_budget,
+                priority="batch")
+        except Exception as e:  # noqa: BLE001 — surfaced to the test
+            out["batch"] = e
+
+    t = threading.Thread(target=run_batch)
+    t.start()
+    deadline = time.time() + 60.0
+    while time.time() < deadline:
+        o = engine._owner[0]
+        if (o is not None and engine._active[0]
+                and getattr(o, "priority", None) == "batch"
+                and len(engine._collected[0]) >= min_tokens):
+            break
+        time.sleep(0.002)
+    else:
+        t.join(5.0)
+        raise AssertionError("batch request never reached mid-generation")
+    try:
+        inter = engine.submit([inter_prompt],
+                              max_new_tokens=inter_budget,
+                              priority="interactive")
+    except Exception as e:  # noqa: BLE001
+        inter = e
+    t.join(60.0)
+    assert not t.is_alive(), "batch request never completed"
+    return out["batch"], inter
+
+
+# --- loss-free preemption: bit-exactness ---------------------------------
+
+
+def test_preempted_batch_output_identical_to_unpreempted_twin(mp):
+    model, params = mp
+    engine, store = _qos_engine(model, params)
+    try:
+        bp = [5, 6, 7, 8, 9, 10, 11, 12]
+        ip = [20, 21, 22, 23]
+        batch, inter = _preempt_scenario(engine, bp, 24, ip, 4)
+        assert batch == [_solo(model, params, bp, 24)]
+        assert inter == [_solo(model, params, ip, 4)]
+        s = engine.stats()
+        assert s["preemptions"] >= 1, "the preemption never fired"
+        assert s["preempt_fallbacks"] == 0
+        # The park went THROUGH the tier and the resume prefix-hit it.
+        assert s["tier_swap_outs"] >= 1 or store.stats()["tier_entries"] >= 0
+        assert s["tier_hits"] >= 1
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+def test_preempted_batch_exact_across_cow_shared_prefix(mp):
+    """The victim's chain COW-shares pinned prompt-cache pages with an
+    earlier request: the park gathers the shared prefix, the requeue
+    decrefs only the victim's references, and both the co-resident
+    entry and the resumed continuation stay exact."""
+    model, params = mp
+    engine, store = _qos_engine(model, params)
+    try:
+        base = [5, 6, 7, 8, 9, 10, 11, 12, 13]
+        warm = engine.submit([base], max_new_tokens=4)
+        assert warm == [_solo(model, params, base, 4)]
+        bp = base + warm[0] + [30, 31]
+        ip = [40, 41, 42]
+        batch, inter = _preempt_scenario(engine, bp, 20, ip, 4)
+        assert batch == [_solo(model, params, bp, 20)]
+        assert inter == [_solo(model, params, ip, 4)]
+        s = engine.stats()
+        assert s["preemptions"] >= 1
+        assert s["preempt_fallbacks"] == 0
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+def test_preempted_batch_exact_on_int8_pool(mp):
+    """int8 pools park value pages AND their fp32 absmax scale planes;
+    a park that dropped or reordered either leaf would resume garbage
+    — the twin compare is against the solo int8 run."""
+    model = transformer_lm_tiny(max_seq_len=64, kv_cache_dtype="int8")
+    params = model.init(jax.random.key(0), jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    engine, store = _qos_engine(model, params)
+    try:
+        bp = [3, 4, 5, 6, 7, 8, 9]
+        ip = [15, 16, 17]
+        batch, inter = _preempt_scenario(engine, bp, 20, ip, 4)
+        assert batch == [_solo(model, params, bp, 20)]
+        assert inter == [_solo(model, params, ip, 4)]
+        assert engine.stats()["preemptions"] >= 1
+        _assert_page_invariants(engine)
+    finally:
+        engine.close()
+
+
+# --- predictive admission control ----------------------------------------
+
+
+def test_predictive_rejection_fires_with_finite_retry_after(mp):
+    """Once the obs TTFT histogram has history, an interactive SLO set
+    below any achievable latency must reject the NEXT submit at the
+    door with AdmissionRejected and a finite Retry-After in the
+    [1, 30] s clamp — and count it per class."""
+    model, params = mp
+    obs = ServeObs()
+    engine, _ = _qos_engine(model, params, obs=obs,
+                            interactive_ttft_slo_s=1e-4)
+    try:
+        # No latency history yet: the gate has no basis and admits.
+        out = engine.submit([[5, 6, 7, 8]], max_new_tokens=3)
+        assert out == [_solo(model, params, [5, 6, 7, 8], 3)]
+        assert obs.ttft.count >= 1
+        with pytest.raises(AdmissionRejected) as ei:
+            engine.submit([[5, 6, 7, 9]], max_new_tokens=3)
+        ra = ei.value.retry_after_s
+        assert math.isfinite(ra) and 1.0 <= ra <= 30.0
+        s = engine.stats()
+        assert s["admission_rejected"] == 1
+        assert s["predict_fallbacks"] == 0
+        text = obs.render_prometheus()
+        assert ('k3stpu_serve_admission_rejected_total'
+                '{class="interactive"} 1') in text
+    finally:
+        engine.close()
+
+
+def test_predict_ttft_is_monotone_in_load():
+    # No history => no basis to reject (0.0 admits everything).
+    assert predict_ttft(0.0, 10, 1000, 4, 64) == 0.0
+    # Empty queue: the forecast IS the p50.
+    assert predict_ttft(0.5, 0, 0, 4, 64) == 0.5
+    # One wave per slot doubles it; backlog converts through the
+    # chunk budget into serialized admission ticks.
+    assert predict_ttft(0.5, 4, 0, 4, 64) == pytest.approx(1.0)
+    assert predict_ttft(0.5, 0, 128, 4, 64) == pytest.approx(
+        0.5 * (1.0 + (128 / 64) / 4))
+    # Monotone: more depth or backlog never lowers the forecast.
+    base = predict_ttft(0.5, 2, 64, 4, 64)
+    assert predict_ttft(0.5, 3, 64, 4, 64) >= base
+    assert predict_ttft(0.5, 2, 128, 4, 64) >= base
+
+
+def test_qos_specs_share_the_organic_ttft_family():
+    inter, batch = qos_specs(interactive_threshold_s=1.5,
+                             batch_threshold_s=20.0, window_days=7.0)
+    assert inter.name == "ttft-interactive"
+    assert batch.name == "ttft-batch"
+    # Both read the SAME organic family at their own threshold —
+    # no per-class histograms in the exposition.
+    assert inter.metric == batch.metric == "k3stpu_request_ttft_seconds"
+    assert inter.threshold_s == 1.5 and batch.threshold_s == 20.0
+    assert inter.target > batch.target
+    assert inter.window_days == batch.window_days == 7.0
+
+
+# --- class-ordered admission walk ----------------------------------------
+
+
+def test_admission_walk_orders_interactive_first_and_splits_budget(mp):
+    model, params = mp
+    engine, _ = _qos_engine(model, params, chunk_prefill=16)
+    engine.close()  # stop the loop; the walk is a pure pending read
+
+    class R:
+        def __init__(self, priority):
+            self.priority = priority
+
+    i1, i2, b1, b2 = R("interactive"), R("interactive"), R("batch"), R("batch")
+    engine._pending = [b1, i1, b2, i2]
+    walk, budget = engine._admission_walk()
+    # Interactive first, FIFO within each class.
+    assert walk == [i1, i2, b1, b2]
+    assert budget == {"interactive": QOS_INTERACTIVE_SHARE * 16.0,
+                      "batch": (1.0 - QOS_INTERACTIVE_SHARE) * 16.0}
+    # Work-conserving: an empty class donates its share.
+    engine._pending = [b1, b2]
+    _, budget = engine._admission_walk()
+    assert budget["batch"] == 16.0
+    engine._pending = [i1]
+    _, budget = engine._admission_walk()
+    assert budget["interactive"] == 16.0
+    # A classless engine's walk is the pre-QoS arrival order, no budget.
+    engine.qos = False
+    engine._pending = [b1, i1]
+    walk, budget = engine._admission_walk()
+    assert walk == [b1, i1] and budget is None
+
+
+def test_bad_priority_rejected_at_submit(mp):
+    model, params = mp
+    engine, _ = _qos_engine(model, params)
+    try:
+        with pytest.raises(ValueError, match="priority"):
+            engine.submit([[1, 2, 3]], max_new_tokens=2,
+                          priority="best-effort")
+    finally:
+        engine.close()
+
+
+def test_deadline_ms_maps_onto_engine_timeout():
+    f = InferenceServer._deadline_timeout
+    assert f(None) == 600.0
+    assert f(2500) == 2.5
+    assert f(250.0) == 0.25
+    # Capped at the default watchdog window: a huge client deadline
+    # must not extend how long a wedged request can hold a waiter.
+    assert f(10**9) == 600.0
+    for bad in (0, -5, float("nan"), float("inf") * -1):
+        with pytest.raises(ValueError, match="deadline_ms"):
+            f(bad)
+
+
+# --- exposition stability -------------------------------------------------
+
+
+def test_classless_exposition_carries_no_qos_families(mp):
+    """The four QoS families are constructed on every ServeObs (so the
+    metrics lint scans them) but rendered ONLY once a qos=True engine
+    arms them — a classless server's /metrics must stay byte-identical
+    to the pre-QoS exposition."""
+    model, params = mp
+    obs = ServeObs()
+    engine = GenerateEngine(model, params, seed=0, slots=2,
+                            page_size=8, prompt_cache=2, obs=obs)
+    try:
+        out = engine.submit([[4, 5, 6, 7]], max_new_tokens=3)
+        assert out == [_solo(model, params, [4, 5, 6, 7], 3)]
+        text = obs.render_prometheus()
+        for fam in QOS_FAMILIES:
+            assert fam not in text
+    finally:
+        engine.close()
+
+
+def test_qos_exposition_renders_per_class_families(mp):
+    model, params = mp
+    obs = ServeObs()
+    engine, _ = _qos_engine(model, params, obs=obs, slots=2)
+    try:
+        engine.submit([[4, 5, 6, 7]], max_new_tokens=2)
+        engine.submit([[8, 9, 10]], max_new_tokens=2, priority="batch")
+        text = obs.render_prometheus()
+        for cls in QOS_CLASSES:
+            assert (f'k3stpu_serve_class_queue_depth{{class="{cls}"}}'
+                    in text)
+        assert "k3stpu_serve_preemptions_total" in text
+        assert "k3stpu_serve_preempt_park_seconds_bucket" in text
+        # Zero-armed counters render (a scrape can tell "no rejections
+        # yet" from "family missing").
+        assert ('k3stpu_serve_admission_rejected_total'
+                '{class="interactive"} 0') in text
+    finally:
+        engine.close()

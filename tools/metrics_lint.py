@@ -86,7 +86,12 @@ BOUNDED_LABEL_KEYS = {"bucket", "state", "chip", "file",
                       # (router/replica/session/stream); "slo" the
                       # declared SloSpec names; "window" the fixed
                       # burn-rate horizon enum (5m/1h/6h/3d).
-                      "path", "slo", "window"}
+                      "path", "slo", "window",
+                      # "class" is the QoS priority-class enum
+                      # (interactive/batch, docs/QOS.md) on the
+                      # per-class queue-depth and admission-rejection
+                      # families.
+                      "class"}
 
 # OpenMetrics exemplar cap (spec): the combined length of the exemplar
 # label names and values must not exceed 128 UTF-8 characters.
