@@ -2883,6 +2883,88 @@ def _node_obs_main() -> int:
                  **skw)
 
 
+def _sim_worker() -> int:
+    """Fleet digital-twin acceptance soak (bounded subprocess, no jax).
+
+    Runs the ``diurnal-1000`` scenario — a 1000-replica fleet, 100k
+    requests over a compressed diurnal day, the FULL chaos fault matrix
+    (all 19 injection points plus the fleet-scale faults), the shipped
+    autoscaler/router/admission policy code driven BY IDENTITY inside
+    the simulator. The headline metric is interactive TTFT SLO
+    attainment (bar: >=0.999 good at 2.5s — vs_baseline = value/0.999
+    so >=1.0 means within budget); lost requests, oscillations and the
+    sim's own wall-clock ride in detail. The wall-clock lives HERE, not
+    in the sim report — the report is byte-stable by construction and
+    must never contain wall time."""
+    from k3stpu.sim import scenarios
+    from k3stpu.sim.report import build_report
+
+    t0 = time.monotonic()
+    fleet = scenarios.run_scenario("diurnal-1000", seed=0)
+    wall_s = time.monotonic() - t0
+    report = build_report(fleet)
+
+    inter = report["latency"].get("interactive") or {}
+    att = inter.get("attainment")
+    target = inter.get("slo_target") or 0.999
+    doc = {
+        "metric": "sim_fleet_interactive_slo_attainment",
+        "value": round(att, 6) if att is not None else 0.0,
+        "unit": "frac_good_at_2.5s",
+        "vs_baseline": (round(att / target, 4)
+                        if att is not None else 0.0),
+        "detail": {
+            "scenario": report["scenario"],
+            "seed": report["seed"],
+            "slo_target": target,
+            "requests_total": report["requests"]["total"],
+            "requests_lost": report["requests"]["lost"],
+            "requests_completed": report["requests"]["completed"],
+            "faults_applied": report["faults"]["applied"],
+            "faults_scheduled": report["faults"]["scheduled"],
+            "oscillations": len(report["autoscaler"]["oscillations"]),
+            "actuations": len(report["autoscaler"]["actuations"]),
+            "final_replicas": report["autoscaler"]["final_replicas"],
+            "events_processed": report["events_processed"],
+            "wall_s": round(wall_s, 2),
+            "events_per_s": (round(report["events_processed"] / wall_s)
+                             if wall_s > 0 else None),
+            "interactive_p99_ttft_s": inter.get("p99_s"),
+            "calibration": report["calibration"],
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _sim_main() -> int:
+    """Bounded-subprocess wrapper for --sim (the worker never imports
+    jax — the twin is pure-python — but the bounded-run + one-JSON-line
+    contract is identical to every other bench stage)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__), "--sim-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="sim")
+    skw = {"metric": "sim_fleet_interactive_slo_attainment",
+           "unit": "frac_good_at_2.5s"}
+    if not ok:
+        why = (f"sim bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("sim", f"{why}; stderr: {err.strip()}", **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_paged_main() -> int:
     """Bounded-subprocess wrapper for --serve-paged (same wedge-proof
     discipline as the matmul path: the parent never imports jax)."""
@@ -3022,4 +3104,8 @@ if __name__ == "__main__":
         sys.exit(_node_obs_worker())
     if "--node-obs" in sys.argv[1:]:
         sys.exit(_node_obs_main())
+    if "--sim-worker" in sys.argv[1:]:
+        sys.exit(_sim_worker())
+    if "--sim" in sys.argv[1:]:
+        sys.exit(_sim_main())
     sys.exit(main())

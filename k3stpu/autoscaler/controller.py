@@ -64,7 +64,9 @@ from k3stpu.autoscaler.signals import FleetSignals, collect
 
 class DecisionPolicy:
     """Signals + current count -> desired count, with hysteresis,
-    per-direction cool-downs, and min/max bounds."""
+    cross-direction cool-downs (per-direction window lengths, armed by
+    the last actuation in either direction — see ``_cooling``), and
+    min/max bounds."""
 
     def __init__(self, *,
                  min_replicas: int = 1,
@@ -111,11 +113,26 @@ class DecisionPolicy:
             self._last_down_t = now
 
     def _cooling(self, direction: str, now: float) -> bool:
-        if direction == "up":
-            return (self._last_up_t is not None
-                    and now - self._last_up_t < self.scale_up_cooldown_s)
-        return (self._last_down_t is not None
-                and now - self._last_down_t < self.scale_down_cooldown_s)
+        """Each direction keeps its own window LENGTH, but both windows
+        measure from the most recent actuation in EITHER direction.
+
+        The per-direction stamps alone left a gap the simulator's
+        adversarial sweep (k3stpu/sim) turned into a reproducible
+        counterexample: a burst ends just after a scale-up, the fleet
+        reads idle while the new replica is still warming, and the
+        policy hands back the replica it added seconds earlier — then
+        re-adds it on the next burst (up→down→up oscillation entirely
+        inside the nominal cool-down windows). Gating each direction on
+        the last actuation of ANY direction makes an opposite-direction
+        flip within the flipped direction's window impossible by
+        construction (tests/test_autoscaler.py property test)."""
+        stamps = [t for t in (self._last_up_t, self._last_down_t)
+                  if t is not None]
+        if not stamps:
+            return False
+        window = (self.scale_up_cooldown_s if direction == "up"
+                  else self.scale_down_cooldown_s)
+        return now - max(stamps) < window
 
     def decide(self, fleet: FleetSignals, current: int,
                now: float) -> "tuple[int, list[str]]":
@@ -210,7 +227,8 @@ class Controller:
                  drain_poll_s: float = 0.2,
                  backoff_s: float = 2.0,
                  backoff_cap_s: float = 60.0,
-                 clock=time.monotonic):
+                 clock=time.monotonic,
+                 sleep=time.sleep):
         self.actuator = actuator
         self.policy = policy
         self.router_url = router_url.rstrip("/") if router_url else None
@@ -223,6 +241,10 @@ class Controller:
         self.backoff_s = backoff_s
         self.backoff_cap_s = backoff_cap_s
         self.clock = clock
+        # Drain-poll sleep, injectable alongside the clock: the drain
+        # protocol is a policy-decision path (deadline + poll cadence),
+        # and a simulated controller must not block a real thread.
+        self._sleep = sleep
         self._backoff_until = 0.0
         self._cur_backoff = backoff_s
         self.steps = 0
@@ -374,7 +396,7 @@ class Controller:
         wedged victim still dies, it just loses its unparked chains
         (exactly what dying without the protocol would have lost)."""
         t0 = time.perf_counter()
-        deadline = time.monotonic() + self.drain_deadline_s
+        deadline = self.clock() + self.drain_deadline_s
         released = 0
         if self.router_url is not None:
             try:
@@ -386,7 +408,7 @@ class Controller:
             # keep re-fetching until none remain: a session that pinned
             # to the victim between an earlier snapshot and the mark
             # would otherwise die with the process.
-            while time.monotonic() < deadline:
+            while self.clock() < deadline:
                 state = self.router_state()
                 if state is None:
                     break
@@ -402,19 +424,19 @@ class Controller:
                     except OSError:
                         pass
                 released += len(sessions)
-                time.sleep(self.drain_poll_s)
+                self._sleep(self.drain_poll_s)
             if released:
                 print("autoscaler: " + json.dumps(
                     {"event": "drained_sessions", "replica": victim,
                      "sessions": released}), flush=True)
-        while time.monotonic() < deadline:
+        while self.clock() < deadline:
             try:
                 status = self._get_json(victim + "/debug/drain")
                 if status.get("active_http_requests", 0) == 0:
                     break
             except (OSError, json.JSONDecodeError, ValueError):
                 break  # victim gone/old build: nothing left to wait on
-            time.sleep(self.drain_poll_s)
+            self._sleep(self.drain_poll_s)
         self.obs.on_drain(time.perf_counter() - t0)
 
     # -- the loop ----------------------------------------------------------

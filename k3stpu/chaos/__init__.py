@@ -129,6 +129,19 @@ import os
 import threading
 import time
 
+# Canonical registry of every fault point wired in this repo (the rows
+# of the table above). The simulator's fault matrix (k3stpu/sim/faults)
+# asserts it covers every entry, so adding a point here without a sim
+# effect fails tests/test_sim.py — the table and the twin cannot drift
+# apart silently.
+KNOWN_POINTS = (
+    "engine_loop", "decode_dispatch", "page_alloc", "spec_verify",
+    "tier_swap", "sse_write", "ckpt_save", "ckpt_restore", "rdv_connect",
+    "train_step", "rank_loss", "coordinator_loss", "route_proxy",
+    "scale_actuate", "kv_transfer", "gen_corrupt", "canary_probe",
+    "preempt_park", "admission_predict",
+)
+
 
 def chaos_from_env() -> "FaultInjector | None":
     """Build an injector from the ``K3STPU_CHAOS`` environment variable.
@@ -217,6 +230,19 @@ class FaultInjector:
         an ``InjectedFault``). Example::
 
             K3STPU_CHAOS="decode_dispatch:stall_s=2.5:times=1"
+
+        Scripted schedule form: ``point@n:K`` arms the fault to fire on
+        exactly the K-th hit of the point and never again — sugar for
+        ``times=1:skip=K-1``. Deterministic run-to-run by construction
+        (program order, no clocks), which is what the simulator's fault
+        replays and reproducible chaos tests want::
+
+            K3STPU_CHAOS="decode_dispatch@n:3"          # 3rd hit only
+            K3STPU_CHAOS="page_alloc@n:2:exc=pool gone" # 2nd hit, custom exc
+
+        Extra ``key=value`` fields compose with the ``@n`` form the same
+        way they do with the plain form (``times``/``skip`` are already
+        determined by it and may not be restated).
         """
         inj = cls()
         for part in spec.split(";"):
@@ -225,8 +251,20 @@ class FaultInjector:
                 continue
             fields = part.split(":")
             point, kw = fields[0], {}
+            if point.endswith("@n"):
+                point = point[:-len("@n")]
+                if len(fields) < 2:
+                    raise ValueError(f"{part!r}: point@n needs :K (the hit ordinal)")
+                nth = int(fields[1])
+                if nth < 1:
+                    raise ValueError(f"{part!r}: hit ordinal must be >= 1")
+                kw["times"], kw["skip"] = 1, nth - 1
+                fields = fields[1:]  # consume K; remaining are key=value
             for field in fields[1:]:
                 key, _, val = field.partition("=")
+                if key in ("times", "skip") and "times" in kw:
+                    raise ValueError(
+                        f"{part!r}: {key} conflicts with the @n schedule")
                 if key == "times":
                     kw["times"] = int(val)
                 elif key == "skip":

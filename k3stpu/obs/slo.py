@@ -169,6 +169,17 @@ def predict_ttft(ttft_p50_s: float, queue_depth: int,
     return ttft_p50_s * (1.0 + waves)
 
 
+def admission_retry_after(predicted_s: float, slo_s: float) -> float:
+    """Retry-After for a predictive-admission rejection: the forecast
+    overshoot past the class SLO, clamped to [1, 30] seconds. The floor
+    keeps clients from hammering a replica whose forecast is barely
+    over the line; the ceiling keeps a wild forecast from parking a
+    client for minutes. One definition shared by the live scheduler
+    gate and the simulator's replica model, so the twin's backoff
+    arithmetic can never drift from production's."""
+    return min(max(predicted_s - slo_s, 1.0), 30.0)
+
+
 def merge_histograms(parsed: "list[dict]",
                      metric: str) -> "dict | None":
     """Sum one family's cumulative buckets across replica scrapes

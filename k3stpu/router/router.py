@@ -111,7 +111,8 @@ class Router:
                  instance: "str | None" = None,
                  chaos=None,
                  allow_empty: bool = False,
-                 prefill_replicas: "list[str] | None" = None):
+                 prefill_replicas: "list[str] | None" = None,
+                 max_failover_candidates: "int | None" = None):
         if not replicas and not allow_empty:
             raise ValueError("router needs at least one replica URL")
         if policy not in ("affinity", "random"):
@@ -122,6 +123,16 @@ class Router:
         self.health_timeout_s = health_timeout_s
         self.proxy_timeout_s = proxy_timeout_s
         self.policy = policy
+        # Cap on the failover walk ``route()`` materializes. None (the
+        # default, and the serving deployment's setting) walks every
+        # placeable replica — maximum failover depth. A small cap makes
+        # each routing decision O(cap) instead of O(fleet), which is
+        # what lets the simulator (k3stpu/sim) drive THIS code at
+        # 1000-replica scale; a real deployment that big would want the
+        # same cap for the same reason. Attempts past the cap would be
+        # the (cap+1)-th consecutive replica failure for one request —
+        # at that point the fleet is down, not unlucky.
+        self.max_failover_candidates = max_failover_candidates
         self._chaos = chaos  # k3stpu.chaos.FaultInjector | None
         self._obs = RouterObs(instance=instance)
         self._lock = threading.Lock()
@@ -424,8 +435,19 @@ class Router:
                 start = self._rr % len(placeable)
                 return (placeable[start:] + placeable[:start], "prefix",
                         session)
-            walk = [r for r in self._ring.iter_nodes(key)
-                    if r in set(placeable)]
+            # Hoisted membership set + early-terminated ring walk: the
+            # ring generator yields each distinct node once, so bounding
+            # the walk at max_failover_candidates stops the clockwise
+            # scan as soon as enough candidates exist (uncapped, this
+            # loop is the old full materialization, same order).
+            placeable_set = set(placeable)
+            cap = self.max_failover_candidates
+            walk = []
+            for r in self._ring.iter_nodes(key):
+                if r in placeable_set:
+                    walk.append(r)
+                    if cap is not None and len(walk) >= cap:
+                        break
             if not walk:
                 walk = list(self._ring.iter_nodes(key))
             if session is not None:

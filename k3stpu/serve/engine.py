@@ -103,7 +103,8 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
                  chaos=None, tier=None, tier_watermark: int = 0,
                  qos: bool = False,
                  interactive_ttft_slo_s: "float | None" = 2.5,
-                 batch_ttft_slo_s: "float | None" = 30.0):
+                 batch_ttft_slo_s: "float | None" = 30.0,
+                 clock=time.time):
         """``chunk_prefill``: admit long prompts in chunks of this many
         tokens, one chunk per loop iteration — bounds how long a decode
         step can be delayed by an arriving prompt to one chunk's latency
@@ -322,6 +323,12 @@ class GenerateEngine(SchedulerMixin, KVManagerMixin, ModelRunnerMixin):
             raise ValueError(f"tier_watermark must be >= 0, got "
                              f"{tier_watermark}")
         self.qos = bool(qos)
+        # Wall clock behind every policy-visible time read (request
+        # deadlines, queue expiry — scheduler.py). Injectable so the
+        # fleet simulator can drive admission policy at virtual time;
+        # watchdog heartbeats stay on time.monotonic (liveness, not
+        # policy).
+        self._clock = clock
         self.interactive_ttft_slo_s = (
             None if interactive_ttft_slo_s is None
             else float(interactive_ttft_slo_s))

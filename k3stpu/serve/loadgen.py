@@ -90,6 +90,61 @@ class ClientTraces:
         return self.buf.chrome_trace()
 
 
+class ArrivalRecorder:
+    """--record-arrivals: one record per LOGICAL request (503 retries
+    collapse into their first try) in the simulator's trace schema
+    (``k3stpu/sim/traces.py``, ``k3stpu-sim-trace-v1``), so real
+    captured traffic replays through the digital twin unchanged.
+
+    ``t`` is seconds since the first recorded arrival — the sim's
+    virtual epoch. Prompt shape/class/session come from the request
+    payload itself (parsed once per note; the payload is what the
+    server would have seen, so the trace can't drift from the load)."""
+
+    SCHEMA = "k3stpu-sim-trace-v1"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._t0: "float | None" = None
+        self._requests: "list[dict]" = []
+
+    def note(self, t_perf: float, payload: bytes) -> None:
+        try:
+            body = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            body = {}
+        pt = body.get("prompt_tokens")
+        if isinstance(pt, list) and pt and isinstance(pt[0], list):
+            prompt_tokens = len(pt[0])
+        else:
+            # /v1/predict shapes: rows of feature vectors, no prompt.
+            inputs = body.get("inputs")
+            prompt_tokens = len(inputs) if isinstance(inputs, list) else 0
+        rec = {
+            "priority": body.get("priority", "interactive"),
+            "prompt_tokens": prompt_tokens,
+            "max_new_tokens": int(body.get("max_new_tokens", 0)),
+            "session": body.get("session"),
+        }
+        with self._lock:
+            if self._t0 is None:
+                self._t0 = t_perf
+            rec["t"] = round(max(0.0, t_perf - self._t0), 6)
+            self._requests.append(rec)
+
+    def trace(self) -> dict:
+        with self._lock:
+            reqs = sorted(self._requests, key=lambda r: r["t"])
+        return {"schema": self.SCHEMA, "requests": reqs}
+
+    def dump(self, path: str) -> int:
+        doc = self.trace()
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        return len(doc["requests"])
+
+
 def _gen_prompt(rows: int) -> "list[int]":
     """THE generate-load prompt — deterministic and shared by the warmup
     and the measured load, so the warmed prefill program (and, with
@@ -104,7 +159,8 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
                  latencies: list, lock: "threading.Lock", errors: list,
                  route: str = "/v1/predict", ttfts: "list | None" = None,
                  retry_stats: "dict | None" = None, seed: int = 0,
-                 traces: "ClientTraces | None" = None):
+                 traces: "ClientTraces | None" = None,
+                 recorder: "ArrivalRecorder | None" = None):
     """``ttfts`` non-None switches to SSE consumption: the request body
     carries ``"stream": true`` and the client records time-to-first-token
     (first ``data:`` frame) alongside the full-response latency — the
@@ -146,6 +202,8 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
             trace_id = new_trace_id()
             tr = traces.start(trace_id) if traces is not None else None
             t_first_try = time.perf_counter()
+            if recorder is not None:
+                recorder.note(t_first_try, payload)
         req = urllib.request.Request(
             url + route, data=payload,
             headers={"Content-Type": "application/json",
@@ -226,7 +284,8 @@ def _client_loop(url: str, payload: bytes, stop: "threading.Event",
 def run_load(url: "str | list[str]", *, clients: int, seconds: float,
              rows: int, input_shape: "tuple[int, ...]", input_dtype: str,
              generate_tokens: int = 0, stream: bool = False,
-             traces: "ClientTraces | None" = None) -> dict:
+             traces: "ClientTraces | None" = None,
+             recorder: "ArrivalRecorder | None" = None) -> dict:
     """``generate_tokens > 0`` switches to /v1/generate load (each request
     one ragged prompt, ``generate_tokens`` new tokens) — the decode-loop
     workload the continuous-batching engine schedules. ``stream`` rides
@@ -267,7 +326,7 @@ def run_load(url: "str | list[str]", *, clients: int, seconds: float,
     threads = [threading.Thread(
         target=_client_loop,
         args=(urls[i % len(urls)], payload, stop, latencies, lock,
-              errors, route, ttfts, retry_stats, i, traces),
+              errors, route, ttfts, retry_stats, i, traces, recorder),
         daemon=True)
         for i in range(clients)]
     t0 = time.perf_counter()
@@ -349,7 +408,8 @@ def parse_mix(spec: str) -> "tuple[int, int]":
 def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
               mix: "tuple[int, int]", rows: int, long_rows: int,
               generate_tokens: int,
-              traces: "ClientTraces | None" = None) -> dict:
+              traces: "ClientTraces | None" = None,
+              recorder: "ArrivalRecorder | None" = None) -> dict:
     """Mixed short/long traffic against /v1/generate — the disagg
     workload (docs/DISAGG.md): long prompts are the prefill
     interference that inflates short requests' inter-token latency on
@@ -399,7 +459,7 @@ def run_mixed(url: "str | list[str]", *, clients: int, seconds: float,
                 args=(urls[seed % len(urls)], payload, stop,
                       cls["latencies"], lock, cls["errors"],
                       "/v1/generate", cls["ttfts"], retry_stats, seed,
-                      traces),
+                      traces, recorder),
                 daemon=True))
             seed += 1
     t0 = time.perf_counter()
@@ -496,7 +556,8 @@ def parse_ramp(spec: str, base_clients: int) -> "list[tuple[int, float]]":
 def run_ramp(url: "str | list[str]", *, phases: "list[tuple[int, float]]",
              rows: int, input_shape: "tuple[int, ...]", input_dtype: str,
              generate_tokens: int = 0, stream: bool = False,
-             traces: "ClientTraces | None" = None) -> dict:
+             traces: "ClientTraces | None" = None,
+             recorder: "ArrivalRecorder | None" = None) -> dict:
     """Piecewise-constant load: each (clients, seconds) phase runs its
     own client pool to completion (threads started, run, stopped, and
     JOINED per phase — in-flight requests finish before the next phase
@@ -542,7 +603,7 @@ def run_ramp(url: "str | list[str]", *, phases: "list[tuple[int, float]]",
             target=_client_loop,
             args=(urls[i % len(urls)], payload, stop, latencies, lock,
                   errors, route, ttfts, retry_stats,
-                  1000 * pi + i, traces),
+                  1000 * pi + i, traces, recorder),
             daemon=True) for i in range(clients)]
         t0 = time.perf_counter()
         for t in threads:
@@ -938,6 +999,11 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="write the CLIENT-side Chrome trace (one tid per "
                          "request, wall-anchored) to this file; merge with "
                          "the server's /debug/trace via tools/trace_merge.py")
+    ap.add_argument("--record-arrivals", default=None, metavar="PATH",
+                    help="dump the per-request arrival-time/class/"
+                         "prompt-shape trace (k3stpu-sim-trace-v1) to "
+                         "this file, replayable through the fleet "
+                         "simulator: python -m k3stpu.sim --trace PATH")
     args = ap.parse_args(argv)
     urls: "list[str] | None" = None
     if args.endpoints:
@@ -971,6 +1037,11 @@ def main(argv: "list[str] | None" = None) -> int:
         except ValueError as e:
             ap.error(str(e))
     if args.sessions:
+        if args.record_arrivals:
+            ap.error("--record-arrivals covers the shared client loop "
+                     "(load/mix/ramp); the session loop drives turns "
+                     "from completions, which the sim's session "
+                     "generator models directly")
         if args.generate_tokens <= 0:
             ap.error("--sessions requires --generate-tokens (sessions "
                      "are a generate workload)")
@@ -1070,6 +1141,7 @@ def main(argv: "list[str] | None" = None) -> int:
         card = json.loads(r.read())
 
     traces = ClientTraces()
+    recorder = ArrivalRecorder() if args.record_arrivals else None
     if args.sessions:
         result = run_sessions(
             urls or url, sessions=args.sessions, turns=args.turns,
@@ -1079,21 +1151,22 @@ def main(argv: "list[str] | None" = None) -> int:
         result = run_mixed(
             urls or url, clients=args.clients, seconds=args.seconds,
             mix=mix, rows=args.rows, long_rows=args.long_prompt_tokens,
-            generate_tokens=args.generate_tokens, traces=traces)
+            generate_tokens=args.generate_tokens, traces=traces,
+            recorder=recorder)
     elif ramp_phases is not None:
         result = run_ramp(
             urls or url, phases=ramp_phases, rows=args.rows,
             input_shape=tuple(card["input_shape"]),
             input_dtype=card["input_dtype"],
             generate_tokens=args.generate_tokens, stream=args.stream,
-            traces=traces)
+            traces=traces, recorder=recorder)
     else:
         result = run_load(
             urls or url, clients=args.clients, seconds=args.seconds,
             rows=args.rows, input_shape=tuple(card["input_shape"]),
             input_dtype=card["input_dtype"],
             generate_tokens=args.generate_tokens, stream=args.stream,
-            traces=traces)
+            traces=traces, recorder=recorder)
 
     # Server-side histogram quantiles from the same run (best-effort:
     # an older server without the obs layer just yields none).
@@ -1136,6 +1209,10 @@ def main(argv: "list[str] | None" = None) -> int:
         with open(args.trace_out, "w") as f:
             json.dump(traces.chrome_trace(), f)
         print(f"wrote client trace {args.trace_out}", flush=True)
+    if recorder is not None:
+        n = recorder.dump(args.record_arrivals)
+        print(f"wrote arrival trace {args.record_arrivals}: {n} requests "
+              f"({ArrivalRecorder.SCHEMA})", flush=True)
     _print_quantile_skew(result)
     if result.get("per_replica"):
         print("per-replica latency (ms):", flush=True)

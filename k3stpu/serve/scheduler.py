@@ -19,7 +19,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from k3stpu.models.generate import set_cache_index
-from k3stpu.obs.slo import predict_ttft
+from k3stpu.obs.slo import admission_retry_after, predict_ttft
 from k3stpu.serve.containment import CircuitOpen
 from k3stpu.serve.programs import prompt_width_bucket
 from k3stpu.serve.runner import _pow2_at_least
@@ -190,6 +190,13 @@ class SchedulerMixin:
     """Admission, backpressure, chunked prefill, slot activation, and
     completion. Owns no state of its own — ``self`` is the composed
     ``GenerateEngine``."""
+
+    # Injectable wall clock for every policy-visible time read (request
+    # deadlines, queue expiry). The engine overrides this from its
+    # ``clock=`` kwarg; the class default keeps the mixin usable on any
+    # duck-typed host. The simulator (k3stpu/sim) swaps in a virtual
+    # clock so deadline/admission policy runs at simulated time.
+    _clock = staticmethod(time.time)
 
     # --- client API -----------------------------------------------------
 
@@ -376,7 +383,7 @@ class SchedulerMixin:
         predicted = self._admission_forecast(req.priority)
         if predicted is None or predicted <= slo:
             return
-        retry = min(max(predicted - slo, 1.0), 30.0)
+        retry = admission_retry_after(predicted, slo)
         with self._lock:
             self._stats["admission_rejected"] += 1
         if self._obs is not None:
@@ -410,7 +417,7 @@ class SchedulerMixin:
         if not admitted:
             self.take_admission_token()
         try:
-            req.deadline = time.time() + timeout_s
+            req.deadline = self._clock() + timeout_s
             self._trace_enqueue(req)
             # Waiter registry: the watchdog fails everyone in this set
             # with a retryable error when the loop stalls or dies, so a
@@ -555,7 +562,7 @@ class SchedulerMixin:
                 self.release_admission_token()
 
     def _stream_events_inner(self, req: "_Request", timeout_s: float):
-        req.deadline = time.time() + timeout_s
+        req.deadline = self._clock() + timeout_s
         self._trace_enqueue(req, stream=True)
         with self._lock:
             self._waiters.add(req)
@@ -565,7 +572,7 @@ class SchedulerMixin:
             while True:
                 try:
                     item = req.stream_q.get(
-                        timeout=max(0.0, hard - time.time()))
+                        timeout=max(0.0, hard - self._clock()))
                 except queue.Empty:
                     raise TimeoutError("generation did not finish in time")
                 if item is None:  # terminal: tokens ready or error
@@ -1295,7 +1302,7 @@ class SchedulerMixin:
 
     def _expire_deadlines(self) -> None:
         """Free resources of requests whose client stopped waiting."""
-        now = time.time()
+        now = self._clock()
         n_expired = 0
         expired = [r for r in self._pending if now > r.deadline]
         for req in expired:

@@ -1,0 +1,22 @@
+"""k3stpu.sim — the fleet's digital twin (docs/SIMULATOR.md).
+
+A seeded, zero-dependency discrete-event simulator that drives the REAL
+control-plane code — ``Router`` placement/failover, ``DecisionPolicy``
+scaling, the QoS admission walk and predictive gate, the ``SloEngine``
+burn-rate math — against token-level replica cost models calibrated
+from the repo's own bench artifacts. Same seed, byte-identical report.
+
+Entry points::
+
+    python -m k3stpu.sim --scenario diurnal --seed 7 --json out.json
+    python -m k3stpu.sim --adversarial --sweep 20
+
+The heavy imports (the real serve/router/autoscaler stack) load on
+first use, not at package import — ``python -m k3stpu.sim
+--list-scenarios`` answers without touching jax.
+"""
+
+__all__ = ["SCHEMA_TRACE", "SCHEMA_REPORT"]
+
+SCHEMA_TRACE = "k3stpu-sim-trace-v1"
+SCHEMA_REPORT = "k3stpu-sim-report-v1"

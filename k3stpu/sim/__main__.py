@@ -1,0 +1,140 @@
+"""CLI for the fleet digital twin.
+
+    python -m k3stpu.sim --scenario smoke --seed 0 --json report.json
+    python -m k3stpu.sim --scenario diurnal-1000        # acceptance soak
+    python -m k3stpu.sim --trace arrivals.json          # replay loadgen
+    python -m k3stpu.sim --adversarial --sweep 20       # hunt flapping
+
+The adversarial mode sweeps seeds over a bursty+faulted scenario and
+reports every autoscaler oscillation (opposite-direction actuations
+inside the SHIPPED cool-down windows) and pin stampede it finds — the
+search that surfaced the cross-direction cool-down gap the policy now
+closes. ``--disable-cooldowns`` re-opens the gap on demand so the
+counterexample stays reproducible.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _summary_lines(fleet, report: dict) -> "list[str]":
+    req = report["requests"]
+    lines = [
+        f"scenario={report['scenario']} seed={report['seed']} "
+        f"events={report['events_processed']}",
+        f"requests: total={req['total']} completed={req['completed']} "
+        f"lost={req['lost']} aborted={req['aborted']} "
+        f"retries={req['retries']} "
+        f"admission_rejected={req['admission_rejected']}",
+    ]
+    for cls, lat in sorted(report["latency"].items()):
+        if not lat["count"]:
+            lines.append(f"ttft[{cls}]: no traffic")
+            continue
+        att = lat["attainment"]
+        lines.append(
+            f"ttft[{cls}]: p50={lat['p50_s']}s p99={lat['p99_s']}s "
+            f"attainment={att if att is None else round(att, 5)} "
+            f"(target {lat['slo_target']} @ {lat['slo_threshold_s']}s)")
+    auto = report["autoscaler"]
+    lines.append(
+        f"autoscaler: actuations={len(auto['actuations'])} "
+        f"oscillations={len(auto['oscillations'])} "
+        f"final_replicas={auto['final_replicas']}")
+    lines.append(
+        f"faults: applied={report['faults']['applied']}/"
+        f"{report['faults']['scheduled']} "
+        f"stampedes={len(report['pins']['stampedes'])}")
+    return lines
+
+
+def _run_one(args) -> int:
+    from k3stpu.sim import report as report_mod
+    from k3stpu.sim import scenarios
+    fleet = scenarios.run_scenario(
+        args.scenario, args.seed, trace_path=args.trace,
+        replicas=args.replicas, max_requests=args.requests,
+        disable_cooldowns=args.disable_cooldowns)
+    report = report_mod.build_report(fleet)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(report_mod.canonical_json(report))
+        print(f"wrote {args.json}", flush=True)
+    for line in _summary_lines(fleet, report):
+        print(line, flush=True)
+    return 0
+
+
+def _run_adversarial(args) -> int:
+    from k3stpu.sim import scenarios
+    counterexamples = []
+    for i in range(args.sweep):
+        seed = args.seed + i
+        fleet = scenarios.run_scenario(
+            args.scenario, seed, replicas=args.replicas,
+            max_requests=args.requests,
+            disable_cooldowns=args.disable_cooldowns)
+        osc = fleet.oscillations()
+        for o in osc:
+            counterexamples.append(("oscillation", seed, o))
+            print(f"seed={seed}: OSCILLATION {o['flip']} "
+                  f"gap={o['gap_s']}s < window={o['window_s']}s "
+                  f"at t={o['t_second']}", flush=True)
+        for s in fleet.stampedes:
+            counterexamples.append(("stampede", seed, s))
+            print(f"seed={seed}: PIN STAMPEDE replica={s['replica']} "
+                  f"max={s['max_pins']} mean={s['mean_pins']} "
+                  f"at t={s['t']}", flush=True)
+        if not osc and not fleet.stampedes:
+            print(f"seed={seed}: clean "
+                  f"({len(fleet.scale_log)} actuations, "
+                  f"{fleet.counters['lost']} lost)", flush=True)
+    print(f"adversarial sweep: {args.sweep} seeds, "
+          f"{len(counterexamples)} counterexamples", flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m k3stpu.sim",
+        description="Deterministic fleet digital twin "
+                    "(docs/SIMULATOR.md).")
+    ap.add_argument("--scenario", default="smoke",
+                    help="named scenario (--list-scenarios)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="override the scenario's starting fleet size")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="override the scenario's request cap")
+    ap.add_argument("--trace", default=None, metavar="PATH",
+                    help="replay a k3stpu-sim-trace-v1 file (loadgen "
+                         "--record-arrivals output) instead of "
+                         "generating the scenario's workload")
+    ap.add_argument("--json", default=None, metavar="PATH",
+                    help="write the canonical (byte-stable) report")
+    ap.add_argument("--disable-cooldowns", action="store_true",
+                    help="zero both cool-down windows (regression: "
+                         "reproduces autoscaler oscillation)")
+    ap.add_argument("--adversarial", action="store_true",
+                    help="sweep seeds hunting oscillation/stampede "
+                         "counterexamples instead of one run")
+    ap.add_argument("--sweep", type=int, default=5,
+                    help="adversarial mode: number of seeds")
+    ap.add_argument("--list-scenarios", action="store_true")
+    args = ap.parse_args(argv)
+    if args.list_scenarios:
+        from k3stpu.sim.scenarios import SCENARIOS
+        for name in sorted(SCENARIOS):
+            print(f"{name}: {SCENARIOS[name]().description}")
+        return 0
+    if args.adversarial:
+        if args.scenario == "smoke":
+            args.scenario = "burst"  # the hunting-ground default
+        return _run_adversarial(args)
+    return _run_one(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,83 @@
+"""Virtual time: the clock + event heap under the fleet simulator.
+
+The whole twin runs on ONE thread against ONE clock: every latency,
+cool-down, drain deadline, and SLO window in a run is derived from the
+`(time, seq)`-ordered heap below, so a scenario is a pure function of
+(config, seed) — same inputs, byte-identical report (docs/SIMULATOR.md).
+
+``VirtualClock`` is shaped like the house injectable-clock convention
+(``Controller(clock=...)``, ``GenerateEngine(clock=...)``): calling the
+instance returns the current virtual time, so it drops into any
+``clock=`` slot the real policy code exposes.
+"""
+
+from __future__ import annotations
+
+import heapq
+
+
+class VirtualClock:
+    """Monotone virtual seconds since scenario start. Callable so it can
+    be injected wherever the real stack takes ``clock=``."""
+
+    __slots__ = ("_now",)
+
+    def __init__(self, start: float = 0.0):
+        self._now = float(start)
+
+    def now(self) -> float:
+        return self._now
+
+    def __call__(self) -> float:
+        return self._now
+
+    def advance_to(self, t: float) -> None:
+        if t < self._now:
+            raise ValueError(f"virtual time cannot rewind: "
+                             f"{t} < {self._now}")
+        self._now = t
+
+
+class EventQueue:
+    """Min-heap of ``(t, seq, fn, args)``. The monotone ``seq`` breaks
+    time ties in SCHEDULING order, which is what makes simultaneous
+    events (a crash and an autoscaler tick at the same instant)
+    deterministic — dict/heap iteration order never decides a race."""
+
+    __slots__ = ("clock", "_heap", "_seq", "processed")
+
+    def __init__(self, clock: VirtualClock):
+        self.clock = clock
+        self._heap: "list[tuple[float, int, object, tuple]]" = []
+        self._seq = 0
+        self.processed = 0
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def schedule(self, t: float, fn, *args) -> None:
+        """Run ``fn(t_fire, *args)`` at virtual time ``t`` (clamped to
+        now — an event can never fire in the past)."""
+        at = max(float(t), self.clock.now())
+        heapq.heappush(self._heap, (at, self._seq, fn, args))
+        self._seq += 1
+
+    def run_until(self, t_end: float) -> None:
+        """Drain every event with ``t <= t_end``, advancing the clock to
+        each event's time before its handler runs. Handlers may schedule
+        further events (including at the current instant)."""
+        while self._heap and self._heap[0][0] <= t_end:
+            at, _, fn, args = heapq.heappop(self._heap)
+            self.clock.advance_to(at)
+            self.processed += 1
+            fn(at, *args)
+
+    def run_all(self, hard_cap_s: float) -> None:
+        """Drain the heap completely (the post-trace cool-down where
+        in-flight work finishes), bounded by ``hard_cap_s`` so a bug
+        that self-schedules forever fails loudly instead of spinning."""
+        self.run_until(hard_cap_s)
+        if self._heap:
+            raise RuntimeError(
+                f"{len(self._heap)} events still queued past the "
+                f"hard cap {hard_cap_s}s — self-rescheduling leak?")

@@ -1,0 +1,204 @@
+"""Fleet-scale fault replay: every chaos injection point the live stack
+defines (``k3stpu.chaos.KNOWN_POINTS``), plus the fleet-level failure
+modes a single process can't host (replica crashes, wedged telemetry,
+partial scrape coverage, correlated drains, ring churn), scripted at
+exact virtual times.
+
+The mapping contract is tested: ``SIM_FAULT_EFFECTS`` must cover every
+name in ``KNOWN_POINTS`` — adding a chaos point to the live stack
+without teaching the twin its blast radius fails tests/test_sim.py.
+
+Each effect mirrors the CONTAINMENT the live stack promises, not just
+the failure: a ``decode_dispatch`` fault is a crash-only engine reset
+(actives fail back to clients, pending survive), a ``tier_swap`` fault
+degrades every warm path to a cold prefill (exact outputs, lost speed),
+``route_proxy`` ends in a real ``Router.eject`` and a failover hop. If
+a scenario with the full matrix still meets its SLO, the promise holds
+at fleet scale; when it doesn't, the report says which fault broke it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One scripted fault: ``kind`` at virtual time ``t`` against
+    ``target`` (a replica url, or None for fleet-scoped faults)."""
+
+    t: float
+    kind: str
+    target: "str | None" = None
+    params: "dict | None" = None
+
+    def param(self, key: str, default):
+        return (self.params or {}).get(key, default)
+
+
+# -- per-replica chaos-point effects --------------------------------------
+# One entry per k3stpu.chaos.KNOWN_POINTS name (superset asserted by
+# tests). Signature: effect(fleet, replica, now, ev) -> None.
+
+def _stall(dur_s: float):
+    def effect(fleet, r, now, ev):
+        r.stall(now, ev.param("dur_s", dur_s))
+    return effect
+
+
+def _dispatch_reset(fleet, r, now, ev):
+    # Crash-only engine reset: actives fail (clients retry), pending
+    # survive, pools reconcile against the live set.
+    fleet.requeue_failed(r.fail_active(now), now)
+
+
+def _page_fault(fleet, r, now, ev):
+    r.page_fault_once = True
+
+
+def _cold_caches(fleet, r, now, ev):
+    r.drop_warm_state()
+
+
+def _abort_stream(fleet, r, now, ev):
+    # sse_write: the client vanished mid-stream; the engine aborts that
+    # one request and frees its slot. Counted "aborted", not lost — no
+    # client is waiting for the answer.
+    for rid in sorted(r._active):
+        req = fleet.requests[rid]
+        r._release(req)
+        req.state = "aborted"
+        fleet.on_abort(req, now)
+        break
+
+
+def _double_boot(fleet, r, now, ev):
+    fleet.double_next_boot = True
+
+
+def _crash(fleet, r, now, ev):
+    fleet.crash_replica(r.url, now)
+
+
+def _proxy_fault(fleet, r, now, ev):
+    r.proxy_fault_once = True
+
+
+def _skip_actuation(fleet, r, now, ev):
+    fleet.skip_next_actuation = True
+
+
+def _corrupt(fleet, r, now, ev):
+    r.corrupt_next = True
+
+
+def _canary_blind(fleet, r, now, ev):
+    fleet.canary_blind += 1
+
+
+def _park_fault(fleet, r, now, ev):
+    r.park_fault_once = True
+
+
+def _gate_open(fleet, r, now, ev):
+    r.gate_open_once = True
+
+
+# -- fleet-scoped faults ---------------------------------------------------
+
+def _replica_crash(fleet, r, now, ev):
+    fleet.crash_replica(r.url, now)
+
+
+def _wedged_telemetry(fleet, r, now, ev):
+    # Scrapes of this replica return ok=False for the window — the
+    # replica itself keeps serving. The autoscaler's scrape-coverage
+    # veto must hold scale-down while coverage is partial.
+    r.wedged_until = max(r.wedged_until, now + ev.param("dur_s", 30.0))
+
+
+def _scrape_gap(fleet, r, now, ev):
+    fleet.scrape_gap(now, frac=ev.param("frac", 0.3),
+                     dur_s=ev.param("dur_s", 20.0))
+
+
+def _correlated_drain(fleet, r, now, ev):
+    fleet.correlated_drain(now, k=ev.param("k", 2),
+                           dur_s=ev.param("dur_s", 30.0))
+
+
+def _ring_churn(fleet, r, now, ev):
+    fleet.ring_churn(now, k=ev.param("k", 1),
+                     dur_s=ev.param("dur_s", 15.0))
+
+
+SIM_FAULT_EFFECTS = {
+    # chaos KNOWN_POINTS — serving tier
+    "engine_loop": _stall(2.0),
+    "decode_dispatch": _dispatch_reset,
+    "page_alloc": _page_fault,
+    "spec_verify": _stall(0.2),
+    "tier_swap": _cold_caches,
+    "sse_write": _abort_stream,
+    "kv_transfer": _cold_caches,
+    "gen_corrupt": _corrupt,
+    "preempt_park": _park_fault,
+    "admission_predict": _gate_open,
+    # chaos KNOWN_POINTS — training/checkpoint tier (a serving replica
+    # co-hosted with a training job stalls while the host thrashes)
+    "ckpt_save": _stall(1.0),
+    "ckpt_restore": _stall(1.0),
+    "train_step": _stall(1.0),
+    "rdv_connect": _double_boot,
+    "rank_loss": _crash,
+    "coordinator_loss": _crash,
+    # chaos KNOWN_POINTS — fleet tier
+    "route_proxy": _proxy_fault,
+    "scale_actuate": _skip_actuation,
+    "canary_probe": _canary_blind,
+    # fleet-scale faults with no single-process chaos point
+    "replica_crash": _replica_crash,
+    "wedged_telemetry": _wedged_telemetry,
+    "scrape_gap": _scrape_gap,
+    "correlated_drain": _correlated_drain,
+    "ring_churn": _ring_churn,
+}
+
+# Faults that act on the fleet even when their nominal target replica
+# has already been scaled away or crashed.
+_FLEET_SCOPED = {"scrape_gap", "correlated_drain", "ring_churn",
+                 "scale_actuate", "canary_probe", "rdv_connect"}
+
+
+def apply_fault(fleet, ev: FaultEvent, now: float) -> bool:
+    """Fire one scripted fault. Returns True if it had a target to act
+    on (a missing target for replica-scoped faults is a no-op — the
+    replica already left the fleet)."""
+    effect = SIM_FAULT_EFFECTS[ev.kind]
+    replica = fleet.replicas.get(ev.target) if ev.target else None
+    if replica is None:
+        replica = fleet.any_replica()
+    if replica is None and ev.kind not in _FLEET_SCOPED:
+        return False
+    effect(fleet, replica, now, ev)
+    return True
+
+
+def full_matrix_schedule(rng: random.Random, urls: "list[str]",
+                         t0: float, t1: float,
+                         kinds: "list[str] | None" = None,
+                         ) -> "list[FaultEvent]":
+    """One of EVERY fault kind, spread across [t0, t1) at rng-drawn
+    times against rng-drawn targets — the full-matrix soak the
+    acceptance scenario replays. Deterministic per rng state."""
+    if kinds is None:
+        kinds = sorted(SIM_FAULT_EFFECTS)
+    events = []
+    for kind in kinds:
+        t = t0 + rng.random() * max(0.0, t1 - t0)
+        target = rng.choice(urls) if urls else None
+        events.append(FaultEvent(t=round(t, 6), kind=kind,
+                                 target=target))
+    events.sort(key=lambda e: (e.t, e.kind))
+    return events

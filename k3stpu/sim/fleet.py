@@ -1,0 +1,491 @@
+"""The fleet under simulation: REAL Router + REAL DecisionPolicy + REAL
+SLO engine wired to simulated replicas and clients on one virtual clock.
+
+What is real here, by identity (tests/test_sim.py asserts these are the
+same objects the serving fleet runs, not copies):
+
+- ``k3stpu.router.router.Router`` — placement, session pins, failover
+  precedence, eject/readmit, drain marks, bounded in-flight admission.
+  The sim calls ``route()``/``acquire()``/``commit_route()`` exactly as
+  the HTTP proxy loop does, and the whole run executes under a stdout
+  capture because the router narrates membership changes to stdout.
+- ``k3stpu.autoscaler.controller.DecisionPolicy`` — every scale
+  decision, including cool-downs and the scrape-coverage veto, against
+  ``FleetSignals`` built from REAL exposition text each simulated
+  replica renders.
+- ``k3stpu.obs.slo.SloEngine`` + ``qos_specs()`` — the burn-rate math
+  in the report is the production engine fed simulated histograms.
+
+The client model mirrors loadgen's retry discipline (same constants):
+bounded 503 retries with exponential backoff, Retry-After honored. A
+request is LOST only when its retry budget exhausts — the number the
+acceptance scenario requires to be zero.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import math
+import random
+
+from k3stpu.autoscaler.controller import DecisionPolicy
+from k3stpu.autoscaler.signals import FleetSignals, ReplicaSample
+from k3stpu.obs.hist import LATENCY_BUCKETS_S, Histogram
+from k3stpu.obs.slo import SloEngine, qos_specs
+from k3stpu.router.router import FleetUnavailable, Router
+from k3stpu.sim import faults as faults_mod
+from k3stpu.sim.clock import EventQueue, VirtualClock
+from k3stpu.sim.replica import SimReplica, SimRequest, real_policy
+
+# Client retry discipline — the loadgen constants (serve/loadgen.py),
+# restated here because the sim's client IS the loadgen model.
+MAX_RETRIES_503 = 8
+BACKOFF_BASE_S = 0.05
+BACKOFF_CAP_S = 2.0
+
+# Oscillation yardstick: the SHIPPED cool-down windows. Judged against
+# the defaults, not the scenario's configured windows — otherwise a
+# cooldowns-disabled run would grade itself against zero-length windows
+# and hide exactly the flapping it exists to demonstrate.
+_D = DecisionPolicy()
+DEFAULT_UP_WINDOW_S = _D.scale_up_cooldown_s
+DEFAULT_DOWN_WINDOW_S = _D.scale_down_cooldown_s
+del _D
+
+# Pin-stampede yardstick: a counterexample when one replica holds more
+# than 3x the mean pin load with a nontrivial pin population.
+STAMPEDE_SKEW = 3.0
+STAMPEDE_MIN_PINS = 50
+
+
+class FleetSim:
+    """One scenario run: a pure function of (scenario, seed, trace)."""
+
+    def __init__(self, scenario, seed: int,
+                 trace: "list[dict]", costs,
+                 fault_events: "list | None" = None):
+        self.scenario = scenario
+        self.seed = int(seed)
+        self.costs = costs
+        self.trace = trace
+        self.fault_events = list(fault_events or [])
+        self.clock = VirtualClock()
+        self.events = EventQueue(self.clock)
+        # The fleet's own stream, independent of the trace generator's:
+        # dispatch jitter must not shift when the trace is replayed from
+        # a file instead of generated.
+        self.rng = random.Random(self.seed ^ 0x5DEECE66D)
+        self.replica_kwargs = dict(scenario.replica_kwargs)
+        urls = [f"http://sim-{i:05d}" for i in range(scenario.replicas_start)]
+        self.members: "list[str]" = list(urls)
+        self.next_idx = scenario.replicas_start
+        self.replicas: "dict[str, SimReplica]" = {}
+        self.requests: "dict[int, SimRequest]" = {}
+        self.router = Router(list(urls), allow_empty=True,
+                             **scenario.router_kwargs)
+        self.policy = DecisionPolicy(**scenario.policy_kwargs)
+        for u in urls:
+            self.replicas[u] = SimReplica(self, u, **self.replica_kwargs)
+        self.slo_specs = qos_specs()
+        self.slo_engine = SloEngine(self.slo_specs)
+        self.h_client_ttft = {
+            cls: Histogram("k3stpu_request_ttft_seconds",
+                           f"Simulated client TTFT ({cls}).",
+                           bounds=LATENCY_BUCKETS_S)
+            for cls in ("interactive", "batch")}
+        self._AdmissionRejected = real_policy()["AdmissionRejected"]
+        self.counters = {
+            "total": len(trace), "completed": 0, "lost": 0,
+            "aborted": 0, "corrupted": 0, "retries": 0,
+            "admission_rejected": 0, "bounced": 0, "crashes": 0,
+            "reboots": 0, "actuations_skipped": 0,
+            "fleet_unavailable": 0,
+        }
+        self.canary_blind = 0
+        self.double_next_boot = False
+        self.skip_next_actuation = False
+        self.booting = 0
+        self._drain: "dict | None" = None
+        self.scale_log: "list[dict]" = []
+        self.decision_log: "list[tuple]" = []
+        self.fault_log: "list[dict]" = []
+        self.stampedes: "list[dict]" = []
+        self.router_log_lines = 0
+        self.t_stop = float(scenario.duration_s) + float(scenario.tail_s)
+
+    # -- client model ------------------------------------------------------
+
+    @staticmethod
+    def _route_body(req: SimRequest) -> dict:
+        """The routing-relevant slice of a generate body: the shared
+        prefix head (what prefix_key hashes) plus session/priority."""
+        head = [req.prefix_id] * max(1, min(req.prefix_len, 16))
+        body: dict = {"prompt_tokens": [head], "priority": req.priority}
+        if req.session is not None:
+            body["session"] = req.session
+        return body
+
+    def _dispatch(self, now: float, req: SimRequest) -> None:
+        req.attempts += 1
+        batch = req.priority == "batch"
+        try:
+            candidates, reason, session = self.router.route(
+                self._route_body(req), b"")
+        except FleetUnavailable:
+            self.counters["fleet_unavailable"] += 1
+            self._client_retry(req, now, retry_after=None)
+            return
+        for url in candidates:
+            r = self.replicas.get(url)
+            if r is None or not r.alive:
+                # Connect failure: the proxy's reactive ejection.
+                self.router.eject(url, "sim: connect failed")
+                continue
+            if r.proxy_fault_once:
+                r.proxy_fault_once = False
+                self.router.eject(url, "sim: proxy fault")
+                continue
+            if not self.router.acquire(url, batch=batch):
+                continue  # at in-flight cap: failover walk continues
+            try:
+                r.enqueue(req, now)
+            except self._AdmissionRejected as e:
+                # An HTTP 503 with Retry-After goes back to the CLIENT
+                # (a served response, not a connect failure) — no
+                # failover; the client backs off and re-dispatches.
+                self.router.release(url)
+                self.counters["admission_rejected"] += 1
+                self._client_retry(req, now,
+                                   retry_after=e.retry_after_s)
+                return
+            req.acquired_url = url
+            self.router.commit_route(session, url)
+            return
+        self._client_retry(req, now, retry_after=None)
+
+    def _client_retry(self, req: SimRequest, now: float,
+                      retry_after: "float | None") -> None:
+        if req.attempts > MAX_RETRIES_503:
+            req.state = "lost"
+            self.counters["lost"] += 1
+            return
+        req.state = "retrying"
+        delay = min(BACKOFF_BASE_S * (2.0 ** (req.attempts - 1)),
+                    BACKOFF_CAP_S)
+        if retry_after is not None:
+            delay = max(delay, retry_after)
+        delay *= 0.5 + self.rng.random()  # loadgen's jitter window
+        self.counters["retries"] += 1
+        self.events.schedule(now + delay, self._dispatch, req)
+
+    def _release_req(self, req: SimRequest) -> None:
+        if req.acquired_url is not None:
+            self.router.release(req.acquired_url)
+            req.acquired_url = None
+
+    # -- replica callbacks -------------------------------------------------
+
+    def on_first_token(self, req: SimRequest, now: float) -> None:
+        cls = "batch" if req.priority == "batch" else "interactive"
+        self.h_client_ttft[cls].observe(max(0.0, now - req.t_arrival))
+
+    def on_complete(self, req: SimRequest, now: float) -> None:
+        self._release_req(req)
+        self.counters["completed"] += 1
+        if req.corrupted:
+            self.counters["corrupted"] += 1
+
+    def on_bounce(self, req: SimRequest, now: float) -> None:
+        self._release_req(req)
+        self.counters["bounced"] += 1
+        self._client_retry(req, now, retry_after=None)
+
+    def on_abort(self, req: SimRequest, now: float) -> None:
+        self._release_req(req)
+        self.counters["aborted"] += 1
+
+    def requeue_failed(self, failed: "list[SimRequest]",
+                       now: float) -> None:
+        for req in failed:
+            self._release_req(req)
+            self._client_retry(req, now, retry_after=None)
+
+    # -- fault surface -----------------------------------------------------
+
+    def any_replica(self) -> "SimReplica | None":
+        for u in self.members:
+            r = self.replicas.get(u)
+            if r is not None and r.alive:
+                return r
+        return None
+
+    def crash_replica(self, url: str, now: float) -> None:
+        r = self.replicas.get(url)
+        if r is None or not r.alive:
+            return
+        failed = r.crash(now)
+        self.counters["crashes"] += 1
+        self.router.eject(url, "sim: replica crashed")
+        boot = float(self.scenario.boot_delay_s)
+        if self.double_next_boot:
+            boot *= 2.0  # rdv_connect fault: first reconnect times out
+            self.double_next_boot = False
+        self.events.schedule(now + boot, self._reboot, url)
+        self.requeue_failed(failed, now)
+
+    def _reboot(self, now: float, url: str) -> None:
+        if url not in self.members:
+            self.replicas.pop(url, None)  # scaled away while down
+            return
+        self.replicas[url] = SimReplica(self, url, **self.replica_kwargs)
+        self.router.readmit(url)
+        self.counters["reboots"] += 1
+
+    def scrape_gap(self, now: float, frac: float, dur_s: float) -> None:
+        """Partial scrape coverage: a fraction of the fleet's /metrics
+        endpoints time out for a window (scrape path only — replicas
+        keep serving). The coverage veto must hold scale-down."""
+        pool = sorted(self.members)
+        k = max(1, int(math.ceil(frac * len(pool))))
+        for u in self.rng.sample(pool, min(k, len(pool))):
+            r = self.replicas.get(u)
+            if r is not None:
+                r.wedged_until = max(r.wedged_until, now + dur_s)
+
+    def correlated_drain(self, now: float, k: int, dur_s: float) -> None:
+        pool = [u for u in self.members
+                if self.replicas.get(u) is not None]
+        picks = self.rng.sample(sorted(pool), min(k, len(pool)))
+        for u in picks:
+            self.router.set_replica_drain(u, True)
+        self.events.schedule(now + dur_s, self._undrain, tuple(picks))
+
+    def _undrain(self, now: float, urls: tuple) -> None:
+        for u in urls:
+            d = self._drain
+            if d is not None and d["victim"] == u:
+                continue  # the autoscaler owns this drain mark now
+            self.router.set_replica_drain(u, False)
+
+    def ring_churn(self, now: float, k: int, dur_s: float) -> None:
+        """Membership flap: k replicas leave the ring (pins DROPPED —
+        the stampede source) and rejoin after ``dur_s``. The replicas
+        themselves keep serving what they hold."""
+        k = min(k, len(self.members) - 1)
+        if k <= 0:
+            return
+        removed = self.rng.sample(sorted(self.members), k)
+        self.members = [u for u in self.members if u not in removed]
+        self.router.set_membership(list(self.members))
+        self.events.schedule(now + dur_s, self._rejoin, tuple(removed))
+
+    def _rejoin(self, now: float, urls: tuple) -> None:
+        for u in urls:
+            if u in self.replicas and u not in self.members:
+                self.members.append(u)
+        self.router.set_membership(list(self.members))
+
+    def _fault(self, now: float, ev) -> None:
+        applied = faults_mod.apply_fault(self, ev, now)
+        self.fault_log.append({"t": round(now, 6), "kind": ev.kind,
+                               "target": ev.target, "applied": applied})
+
+    # -- the autoscaler loop -----------------------------------------------
+
+    def _collect(self, now: float) -> FleetSignals:
+        samples = []
+        for u in self.members:
+            r = self.replicas.get(u)
+            samples.append(r.sample(now) if r is not None
+                           else ReplicaSample(u, ok=False))
+        return FleetSignals(samples)
+
+    def _autoscale(self, now: float) -> None:
+        if now >= self.t_stop:
+            return
+        self.events.schedule(now + self.scenario.autoscale_period_s,
+                             self._autoscale)
+        if self._drain is not None:
+            return  # one actuation at a time: drain still in flight
+        fleet = self._collect(now)
+        current = len(self.members) + self.booting
+        desired, reasons = self.policy.decide(fleet, current, now)
+        self.decision_log.append((round(now, 6), current, desired,
+                                  list(reasons)))
+        if desired == current:
+            return
+        if self.skip_next_actuation:
+            # scale_actuate chaos: the actuator call failed. No
+            # note_scaled — failed actuations must not start cool-downs.
+            self.skip_next_actuation = False
+            self.counters["actuations_skipped"] += 1
+            return
+        if desired > current:
+            self._scale_up(now, current, desired, reasons)
+        else:
+            self._scale_down(now, current, reasons)
+
+    def _scale_up(self, now: float, current: int, desired: int,
+                  reasons: "list[str]") -> None:
+        for _ in range(desired - current):
+            url = f"http://sim-{self.next_idx:05d}"
+            self.next_idx += 1
+            self.booting += 1
+            self.events.schedule(now + self.scenario.boot_delay_s,
+                                 self._join, url)
+        self.policy.note_scaled("up", now)
+        self.scale_log.append({"t": round(now, 6), "dir": "up",
+                               "from": current, "to": desired,
+                               "reasons": list(reasons)})
+
+    def _join(self, now: float, url: str) -> None:
+        self.booting -= 1
+        self.replicas[url] = SimReplica(self, url, **self.replica_kwargs)
+        self.members.append(url)
+        self.router.set_membership(list(self.members))
+
+    def _scale_down(self, now: float, current: int,
+                    reasons: "list[str]") -> None:
+        # Victim pick mirrors Controller._pick_victim: fewest pinned
+        # sessions, ties broken by LAST in membership order.
+        pins = self.router.state()["pins"]
+        pin_counts: "dict[str, int]" = {}
+        for _s, u in pins.items():
+            pin_counts[u] = pin_counts.get(u, 0) + 1
+        best = None
+        for i, u in enumerate(self.members):
+            key = (pin_counts.get(u, 0), -i)
+            if best is None or key < best[0]:
+                best = (key, u)
+        if best is None:
+            return
+        victim = best[1]
+        self.router.set_replica_drain(victim, True)
+        self._drain = {"victim": victim, "from": current,
+                       "deadline": now + self.scenario.drain_deadline_s,
+                       "reasons": list(reasons)}
+        self.events.schedule(now + 1.0, self._drain_poll)
+
+    def _drain_poll(self, now: float) -> None:
+        d = self._drain
+        victim = d["victim"]
+        r = self.replicas.get(victim)
+        if (r is not None and r.alive and r.in_flight() > 0
+                and now < d["deadline"]):
+            self.events.schedule(now + 1.0, self._drain_poll)
+            return
+        # Retire: park the pinned chains (drop_pin — the next turn
+        # re-places by prefix), shrink membership, fail any stragglers
+        # back to their clients (deadline-expiry case only).
+        leftovers: "list[SimRequest]" = []
+        if r is not None and r.alive and r.in_flight() > 0:
+            leftovers = r.crash(now)
+        for s in self.router.pinned_sessions(victim):
+            self.router.drop_pin(s)
+        if victim in self.members:
+            self.members.remove(victim)
+        self.router.set_membership(list(self.members))
+        self.replicas.pop(victim, None)
+        self.requeue_failed(leftovers, now)
+        self.policy.note_scaled("down", now)
+        self.scale_log.append({"t": round(now, 6), "dir": "down",
+                               "from": d["from"],
+                               "to": len(self.members) + self.booting,
+                               "reasons": d["reasons"]})
+        self._drain = None
+
+    # -- SLO reporting -----------------------------------------------------
+
+    def _report_tick(self, now: float) -> None:
+        if now > self.t_stop:
+            return
+        for spec in self.slo_specs:
+            cls = "batch" if spec.name.endswith("batch") \
+                else "interactive"
+            h = self.h_client_ttft[cls]
+            cum, _sum, _count = h.snapshot()
+            gt = spec.good_total({"bounds": list(h.bounds),
+                                  "cumulative": cum})
+            if gt is not None:
+                self.slo_engine.ingest_counts(spec.name, gt[0], gt[1],
+                                              now)
+        self._stampede_check(now)
+        self.events.schedule(now + self.scenario.report_period_s,
+                             self._report_tick)
+
+    def _stampede_check(self, now: float) -> None:
+        """Flag a replica piling up a disproportionate share of the
+        fleet's session pins. Two gates, both required: the victim must
+        hold a meaningful ABSOLUTE pile-up (>= STAMPEDE_MIN_PINS — a
+        17x skew of single-digit counts is noise, not a stampede) and a
+        relative one (> STAMPEDE_SKEW x the fleet mean). One entry per
+        victim, kept at its worst tick — a sustained pile-up is one
+        finding, not one per report period."""
+        pins = self.router.state()["pins"]
+        if len(pins) < STAMPEDE_MIN_PINS or not self.members:
+            return
+        counts: "dict[str, int]" = {}
+        for _s, u in pins.items():
+            counts[u] = counts.get(u, 0) + 1
+        peak_url = max(sorted(counts), key=lambda u: counts[u])
+        peak = counts[peak_url]
+        mean = len(pins) / max(1, len(self.members))
+        if peak >= STAMPEDE_MIN_PINS and peak > STAMPEDE_SKEW * mean:
+            rec = {"t": round(now, 6), "replica": peak_url,
+                   "max_pins": peak, "mean_pins": round(mean, 3),
+                   "total_pins": len(pins)}
+            for i, old in enumerate(self.stampedes):
+                if old["replica"] == peak_url:
+                    if peak > old["max_pins"]:
+                        self.stampedes[i] = rec
+                    return
+            self.stampedes.append(rec)
+
+    # -- run ---------------------------------------------------------------
+
+    def run(self) -> None:
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            for i, rec in enumerate(self.trace):
+                req = SimRequest(i, rec)
+                self.requests[req.rid] = req
+                self.events.schedule(req.t_arrival, self._dispatch, req)
+            for ev in self.fault_events:
+                self.events.schedule(ev.t, self._fault, ev)
+            self.events.schedule(self.scenario.autoscale_period_s,
+                                 self._autoscale)
+            self.events.schedule(self.scenario.report_period_s,
+                                 self._report_tick)
+            self.events.run_all(self.t_stop + 3600.0)
+        self.router_log_lines = sum(1 for _ in
+                                    buf.getvalue().splitlines())
+
+    # -- post-run analysis -------------------------------------------------
+
+    def oscillations(self) -> "list[dict]":
+        """Opposite-direction actuation pairs inside the SHIPPED
+        cool-down windows — the flapping signature the adversarial
+        sweep hunts and the cross-direction cool-down forbids.
+
+        Bounds repairs are excluded: the policy deliberately bypasses
+        cool-downs to pull the fleet back inside [min, max] (e.g. a
+        ``rdv_connect`` double-boot overshooting max_replicas), and a
+        repair right after a legitimate actuation is the controller
+        working, not flapping."""
+        bounds = ("below min_replicas", "above max_replicas")
+        out = []
+        for a, b in zip(self.scale_log, self.scale_log[1:]):
+            if a["dir"] == b["dir"]:
+                continue
+            if any(r in bounds for r in b["reasons"]):
+                continue
+            window = (DEFAULT_UP_WINDOW_S if b["dir"] == "up"
+                      else DEFAULT_DOWN_WINDOW_S)
+            gap = b["t"] - a["t"]
+            if gap < window:
+                out.append({"t_first": a["t"], "t_second": b["t"],
+                            "gap_s": round(gap, 6),
+                            "flip": f"{a['dir']}->{b['dir']}",
+                            "window_s": window})
+        return out

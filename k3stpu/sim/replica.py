@@ -1,0 +1,376 @@
+"""The simulated replica: queue/pages/prefill-backlog state priced by a
+calibrated :class:`~k3stpu.sim.calibrate.CostModel`, admitting requests
+through the REAL scheduler policy code.
+
+Identity, not reimplementation (the acceptance bar in ISSUE 19):
+
+- the QoS admission walk is ``SchedulerMixin._admission_walk`` itself,
+  bound onto this class at first construction — ``SimReplica`` is the
+  duck-typed engine view it expects (``qos``, ``chunk_prefill``,
+  ``_pending`` of ``.priority``-bearing requests);
+- the predictive admission gate calls the real
+  ``k3stpu.obs.slo.predict_ttft`` and the real
+  ``k3stpu.obs.slo.admission_retry_after``, and rejects with the real
+  ``AdmissionRejected`` exception;
+- the signal surface is REAL exposition text: queue/page gauges plus
+  two live :class:`k3stpu.obs.hist.Histogram` families rendered to the
+  same families the serving tier exports, then parsed back through the
+  real ``autoscaler.signals.parse_replica_metrics`` — the autoscaler in
+  the sim scales on byte-for-byte the signal shapes it scrapes in
+  production.
+
+The priced physics underneath is deliberately simple and serialized:
+one prefill engine (a high-watermark ``_prefill_free_at``), ``slots``
+concurrent decodes at constant TPOT, page accounting at admission, and
+warm-path discounts for session chains and shared prefixes.
+"""
+
+from __future__ import annotations
+
+import math
+
+from k3stpu.autoscaler.signals import ReplicaSample, parse_replica_metrics
+from k3stpu.obs.hist import LATENCY_BUCKETS_S, Histogram
+from k3stpu.obs.slo import admission_retry_after, predict_ttft
+
+
+class SimRequest:
+    """One logical request's lifetime across retries and replicas.
+    ``priority`` is the attribute the real admission walk reads."""
+
+    __slots__ = (
+        "rid", "t_arrival", "priority", "prompt_tokens", "max_new_tokens",
+        "session", "prefix_id", "prefix_len", "attempts", "state",
+        "replica", "t_replica_enqueue", "t_first_token", "t_done",
+        "corrupted", "retries_503", "acquired_url",
+    )
+
+    def __init__(self, rid: int, rec: dict):
+        self.rid = rid
+        self.t_arrival = float(rec["t"])
+        self.priority = rec.get("priority") or "interactive"
+        self.prompt_tokens = int(rec["prompt_tokens"])
+        self.max_new_tokens = max(1, int(rec.get("max_new_tokens") or 1))
+        self.session = rec.get("session")
+        self.prefix_id = int(rec.get("prefix_id", 0))
+        self.prefix_len = int(rec.get("prefix_len", 0))
+        self.attempts = 0
+        self.retries_503 = 0
+        self.state = "new"  # new/queued/active/done/lost/aborted
+        self.replica: "SimReplica | None" = None
+        self.t_replica_enqueue = 0.0
+        self.t_first_token: "float | None" = None
+        self.t_done: "float | None" = None
+        self.corrupted = False
+        self.acquired_url: "str | None" = None  # router slot held
+
+
+def _bind_real_policy() -> dict:
+    """Import the real scheduler lazily (it pulls the jax-backed serve
+    stack) and hand back the exact objects the sim drives — cached so
+    identity assertions in tests compare the same references."""
+    from k3stpu.serve.scheduler import AdmissionRejected, SchedulerMixin
+    return {"walk": SchedulerMixin._admission_walk,
+            "AdmissionRejected": AdmissionRejected}
+
+
+_POLICY: "dict | None" = None
+
+
+def real_policy() -> dict:
+    global _POLICY
+    if _POLICY is None:
+        _POLICY = _bind_real_policy()
+    return _POLICY
+
+
+class SimReplica:
+    """One replica's state machine. The fleet (fleet.py) owns routing
+    and retries; this class owns admission, pricing, and signals."""
+
+    # Bound to SchedulerMixin._admission_walk (the real function object)
+    # by __init__ via real_policy() — a class attribute so tests can
+    # assert `SimReplica._admission_walk is SchedulerMixin._admission_walk`.
+    _admission_walk = None
+
+    def __init__(self, fleet, url: str, *, slots: int = 8,
+                 page_size: int = 64, pages_total: int = 513,
+                 chunk_prefill: "int | None" = 256, qos: bool = True,
+                 interactive_ttft_slo_s: float = 2.5,
+                 batch_ttft_slo_s: float = 30.0,
+                 bounce_timeout_s: float = 10.0):
+        if SimReplica._admission_walk is None:
+            SimReplica._admission_walk = real_policy()["walk"]
+        self.fleet = fleet
+        self.url = url
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.pages_total = int(pages_total)
+        self.pages_free = int(pages_total)
+        self.chunk_prefill = chunk_prefill
+        self.qos = bool(qos)
+        self.interactive_ttft_slo_s = interactive_ttft_slo_s
+        self.batch_ttft_slo_s = batch_ttft_slo_s
+        self.bounce_timeout_s = bounce_timeout_s
+        self.alive = True
+        self._pending: "list[SimRequest]" = []   # the real walk reads this
+        self._active: "set[int]" = set()
+        self._pages_held: "dict[int, int]" = {}
+        self._prefill_free_at = 0.0
+        self.busy_until = 0.0          # stall faults push this forward
+        self.wedged_until = 0.0        # telemetry wedge: ok=False scrapes
+        # One-shot fault latches (armed by faults.py effects).
+        self.page_fault_once = False
+        self.proxy_fault_once = False
+        self.gate_open_once = False
+        self.park_fault_once = False
+        self.corrupt_next = False
+        # Warm state: shared-prefix cache + parked session chains.
+        self._prefix_cache: "dict[int, float]" = {}
+        self._session_tokens: "dict[str, int]" = {}
+        # REAL histogram families, rendered into REAL exposition text.
+        self.h_wait = Histogram(
+            "k3stpu_request_queue_wait_seconds",
+            "Simulated queue wait.", bounds=LATENCY_BUCKETS_S)
+        self.h_ttft = Histogram(
+            "k3stpu_request_ttft_seconds",
+            "Simulated replica-local TTFT.", bounds=LATENCY_BUCKETS_S)
+        self.stats = {"admitted": 0, "admission_rejected": 0,
+                      "preempt_fallbacks": 0, "predict_fallbacks": 0,
+                      "bounced": 0}
+
+    # -- admission ---------------------------------------------------------
+
+    def _interactive_pending(self) -> "list[SimRequest]":
+        return [r for r in self._pending if r.priority != "batch"]
+
+    def _class_slo_s(self, priority: str) -> float:
+        return (self.batch_ttft_slo_s if priority == "batch"
+                else self.interactive_ttft_slo_s)
+
+    def _qos_gate(self, req: SimRequest) -> None:
+        """The predictive gate, via the real estimator + retry math.
+        Mirrors scheduler._qos_admission_gate's fail-open discipline:
+        the chaos point ``admission_predict`` downs the estimator and
+        the gate admits (FIFO degradation, never blanket rejection)."""
+        if not self.qos:
+            return
+        if self.gate_open_once:
+            self.gate_open_once = False
+            self.stats["predict_fallbacks"] += 1
+            return
+        if self.park_fault_once:
+            # preempt_park chaos: the slot-reclaim leg is down, so the
+            # admission that would have preempted rejects honestly
+            # (503 + Retry-After) — the real preempt_fallbacks path.
+            self.park_fault_once = False
+            self.stats["preempt_fallbacks"] += 1
+            self._reject(req, retry_s=1.0)
+        p50 = self.h_ttft.quantile(0.5)
+        if p50 is None:
+            return
+        pend = (self._interactive_pending() if req.priority != "batch"
+                else list(self._pending))
+        backlog = sum(r.prompt_tokens for r in pend)
+        chunk = (self.chunk_prefill if self.chunk_prefill is not None
+                 else 4096)
+        predicted = predict_ttft(p50, len(pend), backlog,
+                                 self.slots, chunk)
+        slo = self._class_slo_s(req.priority)
+        if predicted > slo:
+            self.stats["admission_rejected"] += 1
+            self._reject(req, retry_s=admission_retry_after(predicted, slo))
+
+    def _reject(self, req: SimRequest, retry_s: float) -> None:
+        raise real_policy()["AdmissionRejected"](
+            f"predicted TTFT breach for {req.priority} on {self.url}",
+            retry_after_s=retry_s)
+
+    def enqueue(self, req: SimRequest, now: float) -> None:
+        """Admission attempt: may raise the real AdmissionRejected (the
+        sim's 503 + Retry-After). On success the request is pending and
+        a bounce timer guards against starvation (the client deadline
+        the live scheduler enforces with _expire_deadlines)."""
+        self._qos_gate(req)
+        req.state = "queued"
+        req.replica = self
+        req.t_replica_enqueue = now
+        self._pending.append(req)
+        self.fleet.events.schedule(now + self.bounce_timeout_s,
+                                   self._bounce, req)
+        self.try_admit(now)
+
+    def _pages_needed(self, req: SimRequest) -> int:
+        return int(math.ceil((req.prompt_tokens + req.max_new_tokens)
+                             / self.page_size))
+
+    def _warm_plan(self, req: SimRequest) -> "tuple[int, int]":
+        """(cold_prefill_tokens, restored_tokens) for this request on
+        THIS replica — session chain beats shared prefix beats cold."""
+        if req.session is not None \
+                and req.session in self._session_tokens:
+            cached = min(self._session_tokens[req.session],
+                         req.prompt_tokens)
+            return req.prompt_tokens - cached, cached
+        if req.prefix_id in self._prefix_cache:
+            return max(0, req.prompt_tokens - req.prefix_len), 0
+        return req.prompt_tokens, 0
+
+    def try_admit(self, now: float) -> None:
+        """Drain the pending queue through THE real admission walk:
+        class-ordered candidates plus the split chunk budget, admitted
+        while slots/pages/budget allow."""
+        if not self.alive:
+            return
+        walk, budget = self._admission_walk()
+        cost = self.fleet.costs
+        for req in walk:
+            if len(self._active) >= self.slots:
+                break
+            key = "batch" if req.priority == "batch" else "interactive"
+            if budget is not None:
+                if budget[key] <= 0.0:
+                    continue  # class budget spent this tick
+            pages = self._pages_needed(req)
+            if self.page_fault_once:
+                self.page_fault_once = False
+                continue  # allocation fault: rollback, stay pending
+            if pages > self.pages_free:
+                continue  # pool exhausted: wait (pages_free signal)
+            cold, restored = self._warm_plan(req)
+            if budget is not None:
+                budget[key] -= float(cold)
+            self._pending.remove(req)
+            self._active.add(req.rid)
+            self._pages_held[req.rid] = pages
+            self.pages_free -= pages
+            req.state = "active"
+            self.stats["admitted"] += 1
+            self.h_wait.observe(max(0.0, now - req.t_replica_enqueue))
+            start = max(now, self._prefill_free_at, self.busy_until)
+            first_at = (start + cost.prefill_s(cold)
+                        + cost.restore_s(restored))
+            self._prefill_free_at = first_at
+            self.fleet.events.schedule(first_at, self._first_token, req)
+
+    # -- the priced request lifecycle --------------------------------------
+
+    def _first_token(self, now: float, req: SimRequest) -> None:
+        if req.state != "active" or req.replica is not self:
+            return  # crashed / aborted while prefilling
+        req.t_first_token = now
+        self.h_ttft.observe(max(0.0, now - req.t_replica_enqueue))
+        self.fleet.on_first_token(req, now)
+        done_at = now + self.fleet.costs.decode_s(req.max_new_tokens)
+        self.fleet.events.schedule(done_at, self._complete, req)
+
+    def _complete(self, now: float, req: SimRequest) -> None:
+        if req.state != "active" or req.replica is not self:
+            return
+        if now < self.busy_until:
+            # A stall fault landed mid-decode: the remaining tokens
+            # resume when the engine does.
+            self.fleet.events.schedule(self.busy_until, self._complete,
+                                       req)
+            return
+        if self.corrupt_next:
+            self.corrupt_next = False
+            req.corrupted = True
+        self._release(req)
+        req.state = "done"
+        req.t_done = now
+        if req.session is not None:
+            self._session_tokens[req.session] = (req.prompt_tokens
+                                                 + req.max_new_tokens)
+            self._evict(self._session_tokens, cap=128)
+        self._prefix_cache[req.prefix_id] = now
+        self._evict(self._prefix_cache, cap=32)
+        self.fleet.on_complete(req, now)
+        self.try_admit(now)
+
+    @staticmethod
+    def _evict(cache: dict, cap: int) -> None:
+        while len(cache) > cap:
+            del cache[next(iter(cache))]  # insertion-ordered LRU-ish
+
+    def _release(self, req: SimRequest) -> None:
+        self._active.discard(req.rid)
+        self.pages_free += self._pages_held.pop(req.rid, 0)
+
+    def _bounce(self, now: float, req: SimRequest) -> None:
+        """Starvation guard: a request still queued after the bounce
+        window goes back to the client for re-dispatch — the sim analog
+        of the scheduler's deadline expiry + loadgen's retry."""
+        if req.state != "queued" or req.replica is not self:
+            return
+        self._pending.remove(req)
+        req.state = "bounced"
+        self.stats["bounced"] += 1
+        self.fleet.on_bounce(req, now)
+
+    # -- faults ------------------------------------------------------------
+
+    def stall(self, now: float, dur_s: float) -> None:
+        self.busy_until = max(self.busy_until, now + dur_s)
+        self._prefill_free_at = max(self._prefill_free_at,
+                                    self.busy_until)
+
+    def drop_warm_state(self) -> None:
+        """tier_swap / kv_transfer faults: every warm path on this
+        replica degrades to a cold prefill (exact outputs, lost speed —
+        the live containment contract)."""
+        self._prefix_cache.clear()
+        self._session_tokens.clear()
+
+    def fail_active(self, now: float) -> "list[SimRequest]":
+        """decode_dispatch chaos: crash-only reset — active requests
+        fail (clients retry), pending survive, pools reconcile."""
+        failed = []  # pending untouched: the reset preserves the queue
+        for rid in list(self._active):
+            req = self.fleet.requests[rid]
+            self._release(req)
+            req.state = "failed"
+            failed.append(req)
+        self._prefill_free_at = now
+        return failed
+
+    def crash(self, now: float) -> "list[SimRequest]":
+        """Hard exit (rank_loss / replica_crash): everything in flight
+        fails back to its client; all replica state is gone."""
+        self.alive = False
+        failed = self.fail_active(now)
+        for req in list(self._pending):
+            req.state = "failed"
+            failed.append(req)
+        self._pending.clear()
+        self._pages_held.clear()
+        self.pages_free = self.pages_total
+        self.drop_warm_state()
+        return failed
+
+    def in_flight(self) -> int:
+        return len(self._active) + len(self._pending)
+
+    # -- the signal surface ------------------------------------------------
+
+    def metrics_text(self) -> str:
+        """REAL exposition text: the same families the serving tier
+        renders, consumed by the REAL parse_replica_metrics."""
+        iq = len(self._interactive_pending())
+        lines = [
+            f"k3stpu_engine_queue_depth {len(self._pending)}",
+            f"k3stpu_engine_pages_free {self.pages_free}",
+            f"k3stpu_pages_total {self.pages_total}",
+            f'k3stpu_serve_class_queue_depth{{class="interactive"}} {iq}',
+            self.h_wait.render(),
+            self.h_ttft.render(),
+        ]
+        return "\n".join(lines) + "\n"
+
+    def sample(self, now: float):
+        """One autoscaler scrape of this replica, through the real
+        parser. Dead or telemetry-wedged replicas return the same
+        ok=False sample a failed HTTP scrape produces."""
+        if not self.alive or now < self.wedged_until:
+            return ReplicaSample(self.url, ok=False)
+        return parse_replica_metrics(self.url, self.metrics_text())

@@ -1,0 +1,95 @@
+"""Deterministic run reports.
+
+The report is the twin's contract with CI: a pure function of
+(scenario, seed, trace), so the same seed produces a byte-identical
+JSON document twice — no wall-clock timestamps, no unordered dict
+iteration, floats rounded before serialization (repr noise in the 15th
+decimal is not signal). Wall-clock cost lives OUTSIDE the report
+(``bench.py --sim`` records it next to, never inside, the document).
+
+SLO figures go through the REAL ``k3stpu.obs.slo`` machinery: per-class
+attainment via ``SloSpec.good_total`` on the simulated client TTFT
+histograms, burn rates via ``SloEngine.evaluate`` over the snapshots the
+run ingested at every report tick.
+"""
+
+from __future__ import annotations
+
+import json
+
+SCHEMA = "k3stpu-sim-report-v1"
+
+
+def _rounded(obj, ndigits: int = 6):
+    if isinstance(obj, float):
+        return round(obj, ndigits)
+    if isinstance(obj, dict):
+        return {k: _rounded(v, ndigits) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_rounded(v, ndigits) for v in obj]
+    return obj
+
+
+def canonical_json(report: dict) -> str:
+    """The byte-identity serialization: rounded floats, sorted keys,
+    fixed indentation, trailing newline."""
+    return json.dumps(_rounded(report), sort_keys=True, indent=2) + "\n"
+
+
+def build_report(fleet) -> dict:
+    """Assemble the report from a completed FleetSim run."""
+    sc = fleet.scenario
+    latency = {}
+    for cls, h in sorted(fleet.h_client_ttft.items()):
+        cum, _sum, count = h.snapshot()
+        spec = next(s for s in fleet.slo_specs
+                    if s.name == f"ttft-{cls}")
+        gt = spec.good_total({"bounds": list(h.bounds),
+                              "cumulative": cum})
+        latency[cls] = {
+            "count": count,
+            "p50_s": h.quantile(0.5),
+            "p99_s": h.quantile(0.99),
+            "slo_threshold_s": spec.threshold_s,
+            "slo_target": spec.target,
+            "attainment": (gt[0] / gt[1]) if gt and gt[1] else None,
+        }
+    oscillations = fleet.oscillations()
+    state = fleet.router.state()
+    return {
+        "schema": SCHEMA,
+        "scenario": sc.name,
+        "seed": fleet.seed,
+        "config": {
+            "duration_s": sc.duration_s,
+            "replicas_start": sc.replicas_start,
+            "autoscale_period_s": sc.autoscale_period_s,
+            "boot_delay_s": sc.boot_delay_s,
+            "policy": dict(sc.policy_kwargs),
+            "replica": dict(sc.replica_kwargs),
+            "router": dict(sc.router_kwargs),
+        },
+        "calibration": fleet.costs.as_dict(),
+        "requests": dict(fleet.counters),
+        "latency": latency,
+        "slo": fleet.slo_engine.evaluate(fleet.t_stop),
+        "autoscaler": {
+            "actuations": list(fleet.scale_log),
+            "decisions": len(fleet.decision_log),
+            "oscillations": oscillations,
+            "final_replicas": len(fleet.members),
+            "skipped_actuations": fleet.counters["actuations_skipped"],
+        },
+        "faults": {
+            "scheduled": len(fleet.fault_events),
+            "applied": sum(1 for f in fleet.fault_log if f["applied"]),
+            "log": list(fleet.fault_log),
+            "canary_blind": fleet.canary_blind,
+        },
+        "pins": {
+            "total": state["sessions_pinned"],
+            "stampedes": list(fleet.stampedes),
+        },
+        "router_log_lines": fleet.router_log_lines,
+        "events_processed": fleet.events.processed,
+    }

@@ -1,0 +1,227 @@
+"""Named scenarios: the twin's regression corpus.
+
+Each scenario is a full (workload, fleet, policy, fault) configuration.
+The load-bearing ones:
+
+- ``diurnal-1000`` — the acceptance soak: a 1000-replica fleet, 100k
+  requests over a compressed diurnal day, the FULL fault matrix (every
+  chaos point + every fleet-scale fault), shipped policy defaults. Must
+  meet the interactive TTFT SLO with zero lost requests.
+- ``regress-cooldown`` / ``regress-cooldown-off`` — the oscillation
+  regression pair: an identical bursty square-wave workload, shipped
+  cool-downs vs cool-downs disabled. The ``-off`` variant MUST flap
+  (up→down inside the shipped window — the counterexample the
+  adversarial sweep originally surfaced); the shipped variant must not.
+  Both pin the latency bars high so queue-depth signals alone drive the
+  policy — the pair isolates the cool-down mechanism, not the
+  latency-vs-histogram-lifetime interaction.
+- ``burst`` — the adversarial hunting ground: bursty load plus the full
+  fault matrix, swept over seeds by ``python -m k3stpu.sim
+  --adversarial``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+
+from k3stpu.sim import calibrate, faults, traces
+from k3stpu.sim.fleet import FleetSim
+
+
+@dataclasses.dataclass
+class Scenario:
+    name: str
+    duration_s: float
+    profile: "list[tuple[float, float]]"
+    replicas_start: int
+    policy_kwargs: dict
+    replica_kwargs: dict = dataclasses.field(default_factory=dict)
+    router_kwargs: dict = dataclasses.field(default_factory=dict)
+    trace_kwargs: dict = dataclasses.field(default_factory=dict)
+    faults: str = "none"          # "none" | "matrix"
+    autoscale_period_s: float = 5.0
+    report_period_s: float = 30.0
+    boot_delay_s: float = 10.0
+    drain_deadline_s: float = 20.0
+    max_requests: "int | None" = None
+    tail_s: float = 120.0
+    description: str = ""
+
+
+_REPLICA_DEFAULTS = dict(slots=8, page_size=64, pages_total=513,
+                         chunk_prefill=256, qos=True)
+_ROUTER_DEFAULTS = dict(vnodes=32, max_inflight=16,
+                        max_failover_candidates=8)
+
+
+def _smoke() -> Scenario:
+    return Scenario(
+        name="smoke", duration_s=120.0,
+        profile=traces.diurnal_profile(120.0, 2.0, 8.0),
+        replicas_start=3,
+        policy_kwargs=dict(min_replicas=2, max_replicas=8),
+        replica_kwargs=dict(_REPLICA_DEFAULTS),
+        router_kwargs=dict(_ROUTER_DEFAULTS),
+        trace_kwargs=dict(session_frac=0.3),
+        max_requests=500,
+        description="Small clean run: no faults, one diurnal cycle.")
+
+
+def _diurnal() -> Scenario:
+    return Scenario(
+        name="diurnal", duration_s=300.0,
+        profile=traces.diurnal_profile(300.0, 4.0, 24.0),
+        replicas_start=8,
+        policy_kwargs=dict(min_replicas=4, max_replicas=40),
+        replica_kwargs=dict(_REPLICA_DEFAULTS),
+        router_kwargs=dict(_ROUTER_DEFAULTS),
+        trace_kwargs=dict(session_frac=0.3),
+        faults="matrix", max_requests=6000,
+        description="Mid-size diurnal day with the full fault matrix.")
+
+
+def _diurnal_1000() -> Scenario:
+    return Scenario(
+        name="diurnal-1000", duration_s=600.0,
+        profile=traces.diurnal_profile(600.0, 60.0, 260.0),
+        replicas_start=1000,
+        policy_kwargs=dict(min_replicas=200, max_replicas=1000),
+        replica_kwargs=dict(_REPLICA_DEFAULTS),
+        router_kwargs=dict(vnodes=8, max_inflight=16,
+                           max_failover_candidates=8),
+        # Prefix diversity scales with the fleet: 2000 shared prompts at
+        # a flattened Zipf. The default 8-prompt pool would funnel the
+        # entire offered load through 8 prefix-affine replicas of the
+        # 1000 and melt them — a workload-model artifact, not a serving
+        # behavior this scenario is allowed to invent.
+        trace_kwargs=dict(session_frac=0.3, prefix_pool=2000,
+                          zipf_s=0.5),
+        faults="matrix", autoscale_period_s=10.0,
+        max_requests=100_000,
+        description="The acceptance soak: 1000 replicas, 100k requests,"
+                    " full fault matrix, shipped policy defaults.")
+
+
+def _regress_cooldown(off: bool) -> Scenario:
+    policy = dict(min_replicas=1, max_replicas=6,
+                  # Latency bars pinned far out of the way: replica
+                  # histograms are cumulative-lifetime, so one early
+                  # burst's waits would otherwise hold the p50 over the
+                  # idle bar for minutes and veto every scale-down,
+                  # masking the cool-down behavior this pair exists to
+                  # regression-test. Queue depth alone drives here.
+                  queue_wait_high_s=60.0, ttft_high_s=60.0)
+    if off:
+        policy.update(scale_up_cooldown_s=0.0,
+                      scale_down_cooldown_s=0.0)
+    return Scenario(
+        name="regress-cooldown-off" if off else "regress-cooldown",
+        duration_s=360.0,
+        profile=traces.square_wave_profile(360.0, 0.3, 12.0,
+                                           period_s=45.0, burst_s=10.0),
+        replicas_start=2,
+        policy_kwargs=policy,
+        # Classless replicas (no predictive gate) with a long bounce
+        # window: bursts build QUEUE DEPTH instead of 503 storms, so
+        # the pair exercises the cool-down mechanism, nothing else.
+        replica_kwargs=dict(_REPLICA_DEFAULTS, slots=4, qos=False,
+                            bounce_timeout_s=30.0),
+        # High in-flight cap: bursts queue on replicas (visible queue
+        # depth — the scale signal) instead of bouncing off the
+        # router's admission cap into client retry storms.
+        router_kwargs=dict(_ROUTER_DEFAULTS, max_inflight=64),
+        trace_kwargs=dict(interactive_frac=1.0, session_frac=0.0),
+        max_requests=4000,
+        description="Oscillation regression pair: bursty square wave, "
+                    + ("cool-downs DISABLED (must flap)" if off
+                       else "shipped cool-downs (must not flap)"))
+
+
+def _burst() -> Scenario:
+    sc = _regress_cooldown(off=False)
+    return dataclasses.replace(
+        sc, name="burst", duration_s=240.0,
+        profile=traces.square_wave_profile(240.0, 0.3, 40.0,
+                                           period_s=45.0, burst_s=10.0),
+        faults="matrix", max_requests=3000,
+        description="Adversarial hunting ground: bursts + full fault "
+                    "matrix, swept over seeds.")
+
+
+SCENARIOS = {
+    "smoke": _smoke,
+    "diurnal": _diurnal,
+    "diurnal-1000": _diurnal_1000,
+    "regress-cooldown": lambda: _regress_cooldown(off=False),
+    "regress-cooldown-off": lambda: _regress_cooldown(off=True),
+    "burst": _burst,
+}
+
+
+def get_scenario(name: str) -> Scenario:
+    try:
+        return SCENARIOS[name]()
+    except KeyError:
+        raise ValueError(
+            f"unknown scenario {name!r} "
+            f"(have: {', '.join(sorted(SCENARIOS))})") from None
+
+
+def build_run(scenario: Scenario, seed: int, *,
+              trace_path: "str | None" = None,
+              costs=None) -> FleetSim:
+    """Wire one run: trace (generated or replayed), scripted faults,
+    calibrated costs, fleet. Three independent rng streams per seed so
+    replaying a recorded trace doesn't shift fault timings or dispatch
+    jitter."""
+    if trace_path is not None:
+        trace = traces.load_trace(trace_path)
+    else:
+        trace_rng = random.Random(seed)
+        trace = traces.generate(
+            trace_rng, duration_s=scenario.duration_s,
+            profile=scenario.profile,
+            max_requests=scenario.max_requests,
+            **scenario.trace_kwargs)
+    fault_events: "list[faults.FaultEvent]" = []
+    if scenario.faults == "matrix":
+        fault_rng = random.Random(seed ^ 0x00C0FFEE)
+        urls = [f"http://sim-{i:05d}"
+                for i in range(scenario.replicas_start)]
+        fault_events = faults.full_matrix_schedule(
+            fault_rng, urls,
+            0.1 * scenario.duration_s, 0.9 * scenario.duration_s)
+    if costs is None:
+        costs = calibrate.from_artifacts()
+    return FleetSim(scenario, seed, trace, costs,
+                    fault_events=fault_events)
+
+
+def run_scenario(name: str, seed: int = 0, *,
+                 trace_path: "str | None" = None,
+                 replicas: "int | None" = None,
+                 max_requests: "int | None" = None,
+                 disable_cooldowns: bool = False,
+                 costs=None) -> FleetSim:
+    """Build + run one scenario with optional CLI overrides; returns the
+    completed FleetSim (report.build_report turns it into the JSON)."""
+    sc = get_scenario(name)
+    if replicas is not None:
+        bounds = dict(sc.policy_kwargs)
+        bounds["max_replicas"] = max(replicas,
+                                     bounds.get("max_replicas", replicas))
+        bounds["min_replicas"] = min(bounds.get("min_replicas", 1),
+                                     replicas)
+        sc = dataclasses.replace(sc, replicas_start=replicas,
+                                 policy_kwargs=bounds)
+    if max_requests is not None:
+        sc = dataclasses.replace(sc, max_requests=max_requests)
+    if disable_cooldowns:
+        policy = dict(sc.policy_kwargs)
+        policy.update(scale_up_cooldown_s=0.0,
+                      scale_down_cooldown_s=0.0)
+        sc = dataclasses.replace(sc, policy_kwargs=policy)
+    fleet = build_run(sc, seed, trace_path=trace_path, costs=costs)
+    fleet.run()
+    return fleet

@@ -1,0 +1,223 @@
+"""Synthetic (and replayed) request traces for the fleet simulator.
+
+A trace is the twin's workload contract — the same schema
+``loadgen --record-arrivals`` dumps, so captured real traffic replays
+through the simulator unchanged::
+
+    {"schema": "k3stpu-sim-trace-v1",
+     "requests": [{"t": 0.0, "priority": "interactive",
+                   "prompt_tokens": 128, "max_new_tokens": 64,
+                   "session": "s-00042"}, ...]}
+
+Synthetic generation adds two sim-only fields per request, ``prefix_id``
+and ``prefix_len`` (which shared system-prompt head the prompt opens
+with — the span the router prefix-hashes and the replica prefix-caches);
+replayed traces without them get a degenerate per-shape prefix, which is
+faithful to how loadgen traffic actually hashes (identical payload head
+per class).
+
+Generators model the fleet-scale shapes the live mini-fleet tests never
+see: Poisson arrivals against a piecewise-linear rate profile (diurnal
+ramps, square-wave bursts), a priority-class mix, Zipf-weighted shared
+prefixes, and multi-turn sessions whose follow-up turns arrive after the
+previous turn's expected service plus think time. Everything draws from
+one ``random.Random`` in arrival order — same seed, same trace, byte for
+byte.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import random
+
+SCHEMA = "k3stpu-sim-trace-v1"
+
+# Trace-side service-time guess used ONLY to space session turns (a
+# client can't send turn N+1 before turn N answered). Deliberately the
+# fallback cost constants — the trace must not depend on calibration.
+_EST_PREFILL_S_PER_TOKEN = 3.2e-4
+_EST_TPOT_S = 0.02
+
+
+def rate_at(profile: "list[tuple[float, float]]", t: float) -> float:
+    """Linear interpolation over [(t, rps), ...] anchor points (clamped
+    at both ends)."""
+    if t <= profile[0][0]:
+        return profile[0][1]
+    for (t0, r0), (t1, r1) in zip(profile, profile[1:]):
+        if t <= t1:
+            frac = (t - t0) / (t1 - t0) if t1 > t0 else 1.0
+            return r0 + frac * (r1 - r0)
+    return profile[-1][1]
+
+
+def diurnal_profile(duration_s: float, lo_rps: float,
+                    hi_rps: float) -> "list[tuple[float, float]]":
+    """The compressed day: trough -> ramp -> peak plateau -> ramp back
+    to trough. The autoscaler's nominal test signal, scaled to whatever
+    window the scenario simulates."""
+    d = float(duration_s)
+    return [(0.0, lo_rps), (0.25 * d, hi_rps),
+            (0.60 * d, hi_rps), (0.85 * d, lo_rps), (d, lo_rps)]
+
+
+def square_wave_profile(duration_s: float, lo_rps: float, hi_rps: float,
+                        period_s: float,
+                        burst_s: float) -> "list[tuple[float, float]]":
+    """Bursty on/off load: ``burst_s`` of ``hi_rps`` at the top of every
+    ``period_s``, trough in between — the oscillation hunter's signal
+    (a burst ends right after the scale-up it provoked)."""
+    pts: "list[tuple[float, float]]" = []
+    t = 0.0
+    while t < duration_s:
+        pts += [(t, hi_rps), (min(t + burst_s, duration_s), hi_rps),
+                (min(t + burst_s + 0.001, duration_s), lo_rps),
+                (min(t + period_s - 0.001, duration_s), lo_rps)]
+        t += period_s
+    pts.append((duration_s, lo_rps))
+    return pts
+
+
+def _zipf_cum_weights(pool: int, s: float) -> "list[float]":
+    """Cumulative Zipf(s) weights over ``pool`` shared system prompts,
+    precomputed once per trace (rng.choices with cum_weights is O(log n)
+    per draw). ``s`` sets the skew: 1.0 is classic Zipf (a handful of
+    prompts dominate — right for small cache-affinity fleets), lower
+    values flatten the head — at 1000-replica scale even a popular
+    prompt is a small fraction of total traffic, and a pool sized to
+    the fleet with s≈0.5 models that."""
+    total, out = 0.0, []
+    for k in range(pool):
+        total += 1.0 / (k + 1) ** s
+        out.append(total)
+    return out
+
+
+def generate(rng: random.Random, *,
+             duration_s: float,
+             profile: "list[tuple[float, float]]",
+             interactive_frac: float = 0.8,
+             session_frac: float = 0.3,
+             prefix_pool: int = 8,
+             zipf_s: float = 1.0,
+             turn_continue_p: float = 0.5,
+             max_turns: int = 5,
+             think_s: float = 15.0,
+             max_requests: "int | None" = None) -> "list[dict]":
+    """One full trace, sorted by arrival time."""
+    requests: "list[dict]" = []
+    t = 0.0
+    n_sessions = 0
+    cum = _zipf_cum_weights(prefix_pool, zipf_s)
+    pids = range(prefix_pool)
+    while t < duration_s:
+        rate = max(rate_at(profile, t), 1e-6)
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            break
+        interactive = rng.random() < interactive_frac
+        pid = rng.choices(pids, cum_weights=cum)[0]
+        plen = 64 + 32 * (pid % 4)
+        if interactive:
+            body_len = 16 + min(int(rng.expovariate(1.0 / 200.0)), 2048)
+            max_new = 32 + rng.randrange(96)
+        else:
+            body_len = 64 + min(int(rng.expovariate(1.0 / 800.0)), 6144)
+            max_new = 256
+        priority = "interactive" if interactive else "batch"
+        session = None
+        if interactive and rng.random() < session_frac:
+            n_sessions += 1
+            session = f"s-{n_sessions:06d}"
+        req = {"t": round(t, 6), "priority": priority,
+               "prompt_tokens": plen + body_len,
+               "max_new_tokens": max_new,
+               "session": session,
+               "prefix_id": pid, "prefix_len": plen}
+        requests.append(req)
+        if session is not None:
+            # Follow-up turns: each arrives after the previous turn's
+            # expected completion plus think time, prompt grown by the
+            # reply + the user's next message.
+            t_turn, prompt = t, req["prompt_tokens"]
+            for _ in range(max_turns - 1):
+                if rng.random() >= turn_continue_p:
+                    break
+                service = (prompt * _EST_PREFILL_S_PER_TOKEN
+                           + max_new * _EST_TPOT_S)
+                t_turn += service + rng.expovariate(1.0 / think_s)
+                if t_turn >= duration_s:
+                    break
+                prompt += max_new + 16 + rng.randrange(64)
+                requests.append({
+                    "t": round(t_turn, 6), "priority": priority,
+                    "prompt_tokens": prompt,
+                    "max_new_tokens": max_new,
+                    "session": session,
+                    "prefix_id": pid, "prefix_len": plen})
+        if max_requests is not None and len(requests) >= max_requests:
+            break
+    requests.sort(key=lambda r: (r["t"], r.get("session") or ""))
+    if max_requests is not None:
+        requests = requests[:max_requests]
+    return requests
+
+
+def normalize(requests: "list[dict]") -> "list[dict]":
+    """Fill the sim-only fields a replayed (loadgen-recorded) trace
+    lacks: requests sharing a payload shape share a prefix — exactly
+    how identical loadgen payload heads hash on the real ring."""
+    out = []
+    for i, r in enumerate(requests):
+        prompt = int(r.get("prompt_tokens", 0))
+        rec = {"t": float(r["t"]),
+               "priority": r.get("priority") or "interactive",
+               "prompt_tokens": prompt,
+               "max_new_tokens": int(r.get("max_new_tokens", 0)),
+               "session": r.get("session"),
+               "prefix_id": int(r["prefix_id"]) if "prefix_id" in r
+               else prompt % 1009,
+               "prefix_len": int(r["prefix_len"]) if "prefix_len" in r
+               else min(16, prompt)}
+        out.append(rec)
+    out.sort(key=lambda r: (r["t"], r.get("session") or ""))
+    return out
+
+
+def load_trace(path: str) -> "list[dict]":
+    """Read a ``k3stpu-sim-trace-v1`` file (loadgen --record-arrivals
+    output, or a hand-written fixture) into normalized request dicts."""
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or doc.get("schema") != SCHEMA:
+        raise ValueError(
+            f"{path}: not a {SCHEMA} trace "
+            f"(schema={doc.get('schema') if isinstance(doc, dict) else None!r})")
+    reqs = doc.get("requests")
+    if not isinstance(reqs, list):
+        raise ValueError(f"{path}: trace has no requests list")
+    return normalize(reqs)
+
+
+def arrivals_per_s(requests: "list[dict]",
+                   duration_s: float) -> float:
+    if duration_s <= 0.0:
+        return 0.0
+    return len(requests) / duration_s
+
+
+def scale_guess(profile: "list[tuple[float, float]]") -> float:
+    """Peak rate of a profile — used by scenarios to sanity-log offered
+    load against fleet capacity."""
+    return max(r for _, r in profile) if profile else 0.0
+
+
+def estimate_requests(profile: "list[tuple[float, float]]",
+                      duration_s: float) -> int:
+    """Trapezoid integral of the rate profile — the expected request
+    count a scenario will generate (before session follow-ups)."""
+    total = 0.0
+    for (t0, r0), (t1, r1) in zip(profile, profile[1:]):
+        total += 0.5 * (r0 + r1) * max(0.0, min(t1, duration_s) - t0)
+    return int(math.ceil(total))

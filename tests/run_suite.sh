@@ -37,7 +37,10 @@
 #            fault degradation), and the correctness-watchdog suite
 #            (canary known-answer probes + SLO burn-rate math), and
 #            the QoS suite (priority classes, predictive admission,
-#            loss-free preemption bit-exactness) ride
+#            loss-free preemption bit-exactness), and the fleet
+#            digital-twin suite (deterministic simulation identity/
+#            byte-stability + the cool-down oscillation regression
+#            pair) ride
 #            along minus their @slow soak/bench tests (the full suite
 #            runs those).
 set -u
@@ -71,6 +74,7 @@ SMOKE=(
   tests/test_slo.py
   tests/test_canary.py
   tests/test_qos.py
+  tests/test_sim.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -196,18 +196,28 @@ def test_policy_down_requires_every_signal_idle():
     assert p.decide(_pressure(queue=0.1), 3, 0.0)[0] == 2
 
 
-def test_policy_cooldowns_are_per_direction():
+def test_policy_cooldowns_cross_direction_windows():
+    """Each direction keeps its own window LENGTH, but both windows
+    measure from the last actuation in EITHER direction — the sim's
+    adversarial sweep showed per-direction stamps alone permit an
+    up→down flip seconds after a scale-up (burst ends, fleet reads
+    idle, the replica just added is handed straight back)."""
     p = DecisionPolicy(scale_up_cooldown_s=10.0,
                        scale_down_cooldown_s=100.0)
     p.note_scaled("up", t0 := 50.0)
     d, reasons = p.decide(_pressure(queue=50.0), 2, t0 + 5.0)
     assert d == 2 and any("cool-down" in r for r in reasons)
-    # Up cool-down does NOT block a scale-down...
-    assert p.decide(_pressure(queue=0.1), 2, t0 + 5.0)[0] == 1
-    p.note_scaled("down", t0 + 5.0)
-    # ...and the down cool-down holds shrinks but not growth.
-    assert p.decide(_pressure(queue=0.1), 2, t0 + 6.0)[0] == 2
-    assert p.decide(_pressure(queue=50.0), 2, t0 + 20.0)[0] > 2
+    # The up actuation arms the DOWN window too: no immediate give-back.
+    d, reasons = p.decide(_pressure(queue=0.1), 2, t0 + 5.0)
+    assert d == 2 and any("cool-down" in r for r in reasons)
+    # Past the down window (measured from the up actuation): shrink ok.
+    assert p.decide(_pressure(queue=0.1), 2, t0 + 101.0)[0] == 1
+    p.note_scaled("down", t0 + 101.0)
+    # A down actuation arms BOTH windows at their own lengths: growth
+    # waits out the (short) up window, shrink the (long) down window.
+    assert p.decide(_pressure(queue=50.0), 2, t0 + 106.0)[0] == 2
+    assert p.decide(_pressure(queue=0.1), 1 + 1, t0 + 106.0)[0] == 2
+    assert p.decide(_pressure(queue=50.0), 2, t0 + 112.0)[0] > 2
 
 
 def test_policy_bounds_clamp_and_repair():
