@@ -1641,6 +1641,213 @@ def _serve_canary_main() -> int:
                  **skw)
 
 
+def _obs_pipeline_worker() -> int:
+    """Embedded metrics pipeline gate (bounded subprocess, CPU tiny
+    model, loopback HTTP).
+
+    Paired arms over ONE live 2-replica routed fleet: threaded loadgen
+    through the router with the collector OFF, then the identical
+    loadgen with the collector scraping every fleet /metrics endpoint
+    at 1 Hz AND running the full shipped rule set (the chart's qos
+    render — 12 rules, loaded from the golden by the collector's own
+    zero-dep reader) on every round. Best-of-N throughput per arm; the
+    pipeline must cost <= 5% of loadgen throughput — scrapes are reads
+    off the replicas' telemetry locks plus pure-Python rule evals, so
+    the marginal cost is render time, not serving time."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import threading
+    import urllib.request
+    from http.server import ThreadingHTTPServer
+
+    import numpy as np
+
+    from k3stpu.obs.collector import Collector
+    from k3stpu.obs.promql import load_rule_groups
+    from k3stpu.router.router import Router, make_router_app
+    from k3stpu.serve.server import InferenceServer, make_app
+
+    prompt_len, reply = 48, 8
+    n_threads, reqs_per_thread, runs_per_arm = 3, 16, 3
+    scrape_interval_s = 1.0
+
+    rules_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "tests", "golden", "chart", "qos.yaml")
+    with open(rules_path) as f:
+        groups = load_rule_groups(f.read())
+
+    def prompt_for(seed: int) -> "list[int]":
+        rng = np.random.default_rng(seed)
+        return rng.integers(1, 1000, size=(prompt_len,)).tolist()
+
+    servers: list = []
+    httpds: list = []
+    urls: "list[str]" = []
+    try:
+        for name in ("bench-obs-a", "bench-obs-b"):
+            srv = InferenceServer(
+                model_name="transformer-tiny", seq_len=256,
+                batch_window_ms=0.0, continuous_batching=True,
+                decode_block=4, prompt_cache=0, kv_page_size=16,
+                kv_pages=128, shard_devices=None, instance=name)
+            servers.append(srv)
+            httpd = ThreadingHTTPServer(("127.0.0.1", 0), make_app(srv))
+            httpds.append(httpd)
+            threading.Thread(target=httpd.serve_forever,
+                             daemon=True).start()
+            urls.append(f"http://127.0.0.1:{httpd.server_address[1]}")
+        router = Router(urls, health_period_s=5.0,
+                        instance="bench-obs-router")
+        rhttpd = ThreadingHTTPServer(("127.0.0.1", 0),
+                                     make_router_app(router))
+        threading.Thread(target=rhttpd.serve_forever, daemon=True).start()
+        rurl = f"http://127.0.0.1:{rhttpd.server_address[1]}"
+
+        col = Collector(router_url=rurl, groups=groups)
+        n_targets = len(col.discover_targets())
+
+        for srv in servers:
+            srv.generate_tokens([prompt_for(999)], max_new_tokens=reply)
+        col.step(time.time())  # warm the scrape + eval path
+
+        def post(body: dict) -> dict:
+            req = urllib.request.Request(
+                rurl + "/v1/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+                method="POST")
+            with urllib.request.urlopen(req, timeout=60) as r:
+                return json.loads(r.read().decode())
+
+        def loadgen_once(seed_base: int) -> float:
+            """One timed loadgen run; returns organic requests/s."""
+            def go(tid: int):
+                for j in range(reqs_per_thread):
+                    out = post({"prompt_tokens":
+                                [prompt_for(seed_base + tid * 100 + j)],
+                                "max_new_tokens": reply})
+                    assert len(out["tokens"][0]) == reply
+            threads = [threading.Thread(target=go, args=(i,))
+                       for i in range(n_threads)]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return (n_threads * reqs_per_thread) / (time.perf_counter()
+                                                    - t0)
+
+        def arm(with_pipeline: bool, seed_base: int) -> float:
+            stop = threading.Event()
+            scraper = None
+            if with_pipeline:
+                def scrape_loop():
+                    # Fire immediately, then on the interval — a short
+                    # run must still overlap at least one full scrape +
+                    # rule-eval round or the on-arm measures nothing.
+                    while True:
+                        col.step(time.time())
+                        if stop.wait(scrape_interval_s):
+                            return
+                scraper = threading.Thread(target=scrape_loop,
+                                           daemon=True)
+                scraper.start()
+            try:
+                return max(loadgen_once(seed_base + r * 1000)
+                           for r in range(runs_per_arm))
+            finally:
+                stop.set()
+                if scraper is not None:
+                    scraper.join()
+
+        loadgen_once(5_000)  # unmeasured warm pass: caches, threads
+        rps_off = arm(False, 10_000)
+        rps_on = arm(True, 10_000)  # same prompts: paired arms
+        overhead_pct = ((1.0 - rps_on / rps_off) * 100.0
+                        if rps_off else 0.0)
+        rounds = int(col.obs.scrapes.value) // max(1, n_targets)
+    finally:
+        try:
+            rhttpd.shutdown()
+            router.close()
+        except NameError:
+            pass
+        for httpd in httpds:
+            httpd.shutdown()
+        for srv in servers:
+            srv.close()
+
+    doc = {
+        # Headline: loadgen throughput lost to the 1 Hz scrape + rule
+        # pipeline, in percent. The bar is 5%; vs_baseline = value/5 so
+        # <=1.0 means within budget (negative = run-to-run noise
+        # exceeded the true cost).
+        "metric": "obs_pipeline_overhead_pct",
+        "value": round(overhead_pct, 2),
+        "unit": "pct_loadgen_requests_per_s",
+        "vs_baseline": round(overhead_pct / 5.0, 4),
+        "detail": {
+            "budget_pct": 5.0,
+            "overhead_gate_passed": overhead_pct <= 5.0,
+            "requests_per_s_pipeline_off": round(rps_off, 3),
+            "requests_per_s_pipeline_on": round(rps_on, 3),
+            "scrape_interval_s": scrape_interval_s,
+            "scrape_targets": n_targets,
+            "scrape_rounds": rounds,
+            "rules_evaluated": len(col.engine.rules),
+            "series_in_store": col.store.series_count(),
+            "samples_ingested": int(col.obs.samples_ingested.value),
+            "alerts_firing": len(col.engine.firing()),
+            "runs_per_arm": runs_per_arm,
+            "loadgen_threads": n_threads,
+            "requests_per_thread": reqs_per_thread,
+            "replicas": 2,
+            "prompt_tokens": prompt_len,
+        },
+    }
+    print("BENCH_JSON " + json.dumps(doc), flush=True)
+    _emit(doc)
+    return 0
+
+
+def _obs_pipeline_main() -> int:
+    """Bounded-subprocess wrapper for --obs-pipeline (same wedge-proof
+    discipline as the other serve benches)."""
+    signal.signal(signal.SIGTERM, _on_term)
+    signal.signal(signal.SIGINT, _on_term)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS",
+                          "0.5")
+    ok, rc, out, err = _run_with_retry(
+        [sys.executable, os.path.abspath(__file__),
+         "--obs-pipeline-worker"],
+        MEASURE_TIMEOUT_S, retry_on_timeout=False, stage="obs_pipeline")
+    skw = {"metric": "obs_pipeline_overhead_pct",
+           "unit": "pct_loadgen_requests_per_s"}
+    if not ok:
+        why = (f"pipeline bench did not finish within {MEASURE_TIMEOUT_S}s"
+               if rc is None else f"worker exited rc={rc}")
+        return _fail("obs_pipeline", f"{why}; stderr: {err.strip()}",
+                     **skw)
+    for line in reversed(out.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(rec, dict) and "metric" in rec:
+            _emit(rec)
+            return 0
+    return _fail("parse", f"worker emitted no metric line; stdout: {out!r}",
+                 **skw)
+
+
 def _serve_qos_worker() -> int:
     """SLO-aware QoS gate (bounded subprocess, CPU tiny model,
     loopback HTTP).
@@ -3088,6 +3295,10 @@ if __name__ == "__main__":
         sys.exit(_serve_canary_worker())
     if "--serve-canary" in sys.argv[1:]:
         sys.exit(_serve_canary_main())
+    if "--obs-pipeline-worker" in sys.argv[1:]:
+        sys.exit(_obs_pipeline_worker())
+    if "--obs-pipeline" in sys.argv[1:]:
+        sys.exit(_obs_pipeline_main())
     if "--serve-qos-worker" in sys.argv[1:]:
         sys.exit(_serve_qos_worker())
     if "--serve-qos" in sys.argv[1:]:

@@ -40,7 +40,12 @@ from __future__ import annotations
 
 import urllib.request
 
-from k3stpu.obs.hist import hist_p50
+from k3stpu.obs.hist import hist_p50, parse_prometheus_samples
+
+# THE shared exposition reader (obs/hist.py) — identity-pinned by
+# tests/test_tsdb.py so this scrape path can never fork its own
+# line-format handling again.
+parse_samples = parse_prometheus_samples
 
 
 class ReplicaSample:
@@ -86,31 +91,22 @@ class ReplicaSample:
                 "interactive_queue_depth": self.interactive_queue_depth}
 
 
-def _gauge_value(text: str, name: str) -> "float | None":
-    """First un-labeled sample of ``name`` in a v0.0.4 exposition."""
-    for line in text.splitlines():
-        if line.startswith(name + " "):
-            try:
-                return float(line.split()[1])
-            except (IndexError, ValueError):
-                return None
+def _gauge_value(fams: dict, name: str) -> "float | None":
+    """First un-labeled sample of ``name`` in a parsed exposition."""
+    for labels, value in fams.get(name, []):
+        if not labels:
+            return value
     return None
 
 
-def _labeled_gauge_min(text: str, name: str) -> "float | None":
+def _labeled_gauge_min(fams: dict, name: str) -> "float | None":
     """MIN over every labeled sample of ``name`` (``name{...} v``).
     None when the family has no labeled samples — the caller falls back
     to the unlabeled gauge. Min, not sum: on a tensor-parallel replica
     each shard holds its own page pool, and admission stalls on the
     tightest shard, so the fleet's free-page headroom is the worst
     shard's, not the aggregate."""
-    vals = []
-    for line in text.splitlines():
-        if line.startswith(name + "{"):
-            try:
-                vals.append(float(line.split()[1]))
-            except (IndexError, ValueError):
-                continue
+    vals = [value for labels, value in fams.get(name, []) if labels]
     return min(vals) if vals else None
 
 
@@ -120,34 +116,33 @@ def _labeled_gauge_min(text: str, name: str) -> "float | None":
 _hist_p50 = hist_p50
 
 
-def _labeled_gauge_value(text: str, name: str,
+def _labeled_gauge_value(fams: dict, name: str,
                          label: str, value: str) -> "float | None":
     """The sample of ``name`` whose (single) label pair is exactly
     ``label="value"`` — the read side of LabeledGauge.render. None when
     the series is absent (family not armed, or that class idle since
     boot)."""
-    needle = f'{name}{{{label}="{value}"}}'
-    for line in text.splitlines():
-        if line.startswith(needle + " "):
-            try:
-                return float(line.split()[1])
-            except (IndexError, ValueError):
-                return None
+    for labels, v in fams.get(name, []):
+        if labels == {label: value}:
+            return v
     return None
 
 
 def parse_replica_metrics(url: str, text: str) -> ReplicaSample:
-    """Pure exposition-text → sample (the unit-testable half)."""
-    qd = _gauge_value(text, "k3stpu_engine_queue_depth")
+    """Pure exposition-text → sample (the unit-testable half). One pass
+    through the shared exposition reader; the scalar helpers above all
+    consume its output."""
+    fams = parse_samples(text)
+    qd = _gauge_value(fams, "k3stpu_engine_queue_depth")
     # Tensor-parallel replicas expose per-shard pools
     # (k3stpu_serve_tp_pages_free{shard="i"}); the tightest shard is the
     # one that gates admission. Monolithic replicas have no such family
     # and keep the unlabeled engine gauge.
-    pf = _labeled_gauge_min(text, "k3stpu_serve_tp_pages_free")
+    pf = _labeled_gauge_min(fams, "k3stpu_serve_tp_pages_free")
     if pf is None:
-        pf = _gauge_value(text, "k3stpu_engine_pages_free")
-    pt = _gauge_value(text, "k3stpu_pages_total")
-    iq = _labeled_gauge_value(text, "k3stpu_serve_class_queue_depth",
+        pf = _gauge_value(fams, "k3stpu_engine_pages_free")
+    pt = _gauge_value(fams, "k3stpu_pages_total")
+    iq = _labeled_gauge_value(fams, "k3stpu_serve_class_queue_depth",
                               "class", "interactive")
     return ReplicaSample(
         url, ok=True,

@@ -1,0 +1,422 @@
+"""The embedded fleet metrics pipeline: scrape -> TSDB -> rule engine.
+
+``python -m k3stpu.obs.collector`` is the deployable half of
+docs/OBSERVABILITY.md's "Executing the rules": a single pod that
+scrapes every fleet ``/metrics`` endpoint into the bounded store
+(obs/tsdb.py), runs the chart's rendered recording and alert rules
+through the PromQL-subset engine (obs/promql.py), and serves the
+results — so a cluster WITHOUT a Prometheus still gets its alerts
+evaluated, and a cluster WITH one gets a second opinion whose window
+math is bit-identical to the SLO engine's.
+
+Target discovery reuses the autoscaler's path: the router's
+``/debug/router`` endpoint lists the live replica set, and the
+collector re-reads it every scrape round, so replicas the autoscaler
+adds or drains enter/leave the scrape set within one interval. Static
+targets (router, autoscaler, canary, node exporters) ride alongside
+via ``--targets``.
+
+HTTP surface (same zero-dep handler idiom as the canary CLI):
+
+- ``/api/query?query=...&time=...`` — evaluate one subset expression
+  against the store (Prometheus-ish ``resultType: vector`` payload);
+- ``/api/alerts`` — the rule engine's active alerts;
+- ``/metrics`` — self-telemetry (``k3stpu_pipeline_*``) plus the
+  synthetic ``ALERTS{alertname=,alertstate=}`` series;
+- ``/healthz`` — liveness.
+
+Everything that computes takes explicit ``now`` (``Collector.step``),
+so tests, the sim twin's alert replay, and the bench harness drive the
+whole pipeline on a virtual clock and get byte-identical timelines per
+seed; only ``main()``'s loop reads the wall clock.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from k3stpu.obs.hist import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    build_info_gauge,
+    prometheus_text_to_openmetrics,
+)
+from k3stpu.obs.promql import (
+    PromQLError,
+    RuleEngine,
+    evaluate,
+    load_rule_groups,
+    parse_expr,
+)
+from k3stpu.obs.tsdb import TSDB
+
+
+class CollectorObs:
+    """The pipeline's own families — the pipeline must be observable
+    by the very rules it executes. Same construct-and-scan facade
+    discipline as AutoscalerObs (tools/metrics_lint.py reads vars())."""
+
+    def __init__(self, enabled: bool = True,
+                 instance: "str | None" = None):
+        self.enabled = enabled
+        self.scrapes = Counter(
+            "k3stpu_pipeline_scrape_total",
+            "Scrape attempts against fleet /metrics endpoints (every "
+            "target every round, reachable or not).")
+        self.scrape_errors = Counter(
+            "k3stpu_pipeline_scrape_errors_total",
+            "Scrapes that failed (unreachable target or unparsable "
+            "exposition); the target's series are stale-marked so "
+            "alerts stop trusting its last values.")
+        self.scrape_duration = Histogram(
+            "k3stpu_pipeline_scrape_seconds",
+            "Wall time of one full scrape round across every target.",
+            bounds=LATENCY_BUCKETS_S)
+        self.rule_eval_duration = Histogram(
+            "k3stpu_pipeline_rule_eval_seconds",
+            "Wall time of one rule-engine pass (every recording and "
+            "alert rule).", bounds=LATENCY_BUCKETS_S)
+        self.samples_ingested = Counter(
+            "k3stpu_pipeline_samples_ingested_total",
+            "Samples written into the time-series store.")
+        self.targets = Gauge(
+            "k3stpu_pipeline_targets",
+            "Scrape targets in the last round (router-discovered "
+            "replicas plus static endpoints).")
+        self.series = Gauge(
+            "k3stpu_pipeline_series",
+            "Live series in the bounded store.")
+        self.rules = Gauge(
+            "k3stpu_pipeline_rules",
+            "Recording + alerting rules loaded into the engine.")
+        self.alerts_firing = Gauge(
+            "k3stpu_pipeline_alerts_firing",
+            "Alerts currently in the firing state.")
+        self.build_info = build_info_gauge(
+            "collector", instance=instance or socket.gethostname())
+
+    def histograms(self) -> "tuple[Histogram, ...]":
+        return (self.scrape_duration, self.rule_eval_duration)
+
+    def _counters(self):
+        return (self.scrapes, self.scrape_errors, self.samples_ingested)
+
+    def _gauges(self) -> "tuple[Gauge, ...]":
+        return (self.targets, self.series, self.rules,
+                self.alerts_firing)
+
+    def render_prometheus(self) -> str:
+        parts = [h.render() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(c.render() for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n"
+
+    def render_openmetrics(self) -> str:
+        parts = [h.render_openmetrics() for h in self.histograms()]
+        parts.extend(g.render() for g in self._gauges())
+        parts.extend(prometheus_text_to_openmetrics(c.render())
+                     for c in self._counters())
+        parts.append(self.build_info.render())
+        return "\n".join(parts) + "\n# EOF\n"
+
+
+def instance_of(url: str) -> str:
+    """host:port identity for the ``instance`` label, Prometheus
+    style."""
+    parsed = urllib.parse.urlsplit(url if "//" in url
+                                   else "//" + url)
+    return parsed.netloc or url
+
+
+class Collector:
+    """Scrape + store + rules, one object. ``step(now)`` is the whole
+    pipeline tick and the only mutating entry point — the HTTP surface
+    is read-only."""
+
+    def __init__(self, router_url: "str | None" = None,
+                 targets: "list[str] | None" = None,
+                 groups: "list[dict] | None" = None,
+                 store: "TSDB | None" = None,
+                 obs: "CollectorObs | None" = None,
+                 scrape_timeout_s: float = 2.0):
+        self.router_url = router_url.rstrip("/") if router_url else None
+        self.static_targets = [t.rstrip("/") for t in (targets or [])]
+        self.store = store if store is not None else TSDB()
+        self.obs = obs if obs is not None else CollectorObs()
+        self.engine = RuleEngine(groups or [], self.store)
+        self.scrape_timeout_s = scrape_timeout_s
+        self.last_now: "float | None" = None
+        self.obs.rules.set(float(len(self.engine.rules)))
+
+    # -- discovery ---------------------------------------------------------
+
+    def discover_targets(self) -> "list[str]":
+        """Static targets plus the router's live membership (the
+        autoscaler's discovery path: GET /debug/router). The router
+        itself is a target too — its families feed the routing
+        dashboards. Order is deterministic (static first, then
+        replicas as listed) so scrape timelines replay byte-identically."""
+        out = list(self.static_targets)
+        if self.router_url:
+            if self.router_url not in out:
+                out.append(self.router_url)
+            try:
+                req = urllib.request.Request(
+                    self.router_url + "/debug/router")
+                with urllib.request.urlopen(
+                        req, timeout=self.scrape_timeout_s) as resp:
+                    state = json.loads(resp.read().decode())
+                for rep in state.get("replicas", []):
+                    url = str(rep.get("url", "")).rstrip("/")
+                    if url and url not in out:
+                        out.append(url)
+            except (OSError, ValueError):
+                pass  # router down: scrape what we know
+        return out
+
+    # -- the tick ----------------------------------------------------------
+
+    def _fetch(self, target: str) -> "str | None":
+        try:
+            with urllib.request.urlopen(
+                    target + "/metrics",
+                    timeout=self.scrape_timeout_s) as resp:
+                return resp.read().decode("utf-8", "replace")
+        except (OSError, ValueError):
+            return None
+
+    def scrape_once(self, now: float) -> int:
+        """One scrape round; returns samples ingested. A failed target
+        is stale-marked, not dropped — its absence must be visible to
+        the rules, not silently forgiven."""
+        targets = self.discover_targets()
+        self.obs.targets.set(float(len(targets)))
+        total = 0
+        for target in targets:
+            self.obs.scrapes.inc()
+            text = self._fetch(target)
+            if text is None:
+                self.obs.scrape_errors.inc()
+                self.store.mark_target_down(target, now)
+                continue
+            n = self.ingest(target, text, now)
+            total += n
+        return total
+
+    def ingest(self, target: str, text: str, now: float) -> int:
+        """Ingest one exposition for ``target`` (the sim twin feeds
+        rendered text straight in here — no sockets)."""
+        n = self.store.ingest_text(text, now,
+                                   instance=instance_of(target),
+                                   target=target)
+        self.obs.samples_ingested.inc(n)
+        return n
+
+    def eval_rules(self, now: float) -> "list[dict]":
+        alerts = self.engine.evaluate(now)
+        self.obs.alerts_firing.set(
+            float(sum(1 for a in alerts if a["state"] == "firing")))
+        self.obs.series.set(float(self.store.series_count()))
+        return alerts
+
+    def step(self, now: float) -> "list[dict]":
+        """One full pipeline tick: scrape every target, then run every
+        rule. Returns the active alerts after the pass."""
+        t0 = time.perf_counter()
+        self.scrape_once(now)
+        self.obs.scrape_duration.observe(time.perf_counter() - t0)
+        t1 = time.perf_counter()
+        alerts = self.eval_rules(now)
+        self.obs.rule_eval_duration.observe(time.perf_counter() - t1)
+        self.last_now = float(now)
+        return alerts
+
+    # -- read side ---------------------------------------------------------
+
+    def query(self, expr: str, now: "float | None" = None
+              ) -> "list[tuple[dict, float]]":
+        """Evaluate one subset expression at ``now`` (defaults to the
+        last tick's timestamp so queries see exactly what the rules
+        saw). Raises PromQLError on anything outside the subset."""
+        at = now if now is not None else (
+            self.last_now if self.last_now is not None else time.time())
+        return evaluate(parse_expr(expr), self.store, at)
+
+    def render_alerts_series(self) -> str:
+        """The synthetic ALERTS exposition block. Deliberately not a
+        ``k3stpu_``-prefixed family: ``ALERTS{alertname=,alertstate=}``
+        is the Prometheus convention every alert dashboard already
+        queries, and the whole point is drop-in compatibility."""
+        lines = ["# HELP ALERTS Active alert series (synthetic, "
+                 "Prometheus convention).",
+                 "# TYPE ALERTS gauge"]
+        for a in self.engine.alerts():
+            labels = dict(a["labels"])
+            labels["alertname"] = a["name"]
+            labels["alertstate"] = a["state"]
+            pairs = ",".join(f'{k}="{v}"'
+                             for k, v in sorted(labels.items()))
+            lines.append("ALERTS{%s} 1" % pairs)
+        return "\n".join(lines) + "\n"
+
+
+def make_collector_app(collector: Collector):
+    """/api/query + /api/alerts + /metrics + /healthz — the same
+    handler idiom as the canary CLI's surface."""
+    obs = collector.obs
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *args):
+            pass
+
+        def _send(self, code: int, payload: dict) -> None:
+            body = json.dumps(payload).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            parsed = urllib.parse.urlsplit(self.path)
+            if parsed.path in ("/healthz", "/livez"):
+                self._send(200, {
+                    "ok": True,
+                    "targets": int(obs.targets.value),
+                    "series": int(obs.series.value),
+                    "rules": int(obs.rules.value),
+                    "alerts_firing": int(obs.alerts_firing.value)})
+            elif parsed.path == "/api/query":
+                qs = urllib.parse.parse_qs(parsed.query)
+                expr = (qs.get("query") or [""])[0]
+                at = qs.get("time")
+                try:
+                    now = float(at[0]) if at else None
+                    vec = collector.query(expr, now)
+                except PromQLError as e:
+                    self._send(400, {"status": "error",
+                                     "errorType": "bad_data",
+                                     "error": str(e)})
+                    return
+                except ValueError:
+                    self._send(400, {"status": "error",
+                                     "errorType": "bad_data",
+                                     "error": "bad time parameter"})
+                    return
+                ts = now if now is not None else (
+                    collector.last_now or 0.0)
+                self._send(200, {
+                    "status": "success",
+                    "data": {"resultType": "vector",
+                             "result": [{"metric": labels,
+                                         "value": [ts, repr(value)]}
+                                        for labels, value in vec]}})
+            elif parsed.path == "/api/alerts":
+                self._send(200, {"status": "success",
+                                 "data": {"alerts":
+                                          collector.engine.alerts()}})
+            elif parsed.path == "/metrics":
+                body = (obs.render_prometheus()
+                        + collector.render_alerts_series()).encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            else:
+                self._send(404, {"error": f"no route {parsed.path}"})
+
+    return Handler
+
+
+def run_loop(collector: Collector, interval_s: float,
+             stop: "threading.Event") -> None:
+    while not stop.is_set():
+        t0 = time.perf_counter()
+        try:
+            collector.step(time.time())
+        except Exception as e:  # noqa: BLE001 — the loop must live
+            print(f"collector: step failed: {e}", flush=True)
+        elapsed = time.perf_counter() - t0
+        stop.wait(max(0.0, interval_s - elapsed))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="K3S-TPU embedded fleet metrics pipeline "
+                    "(scrape -> TSDB -> rule engine)")
+    ap.add_argument("--router", default=None,
+                    help="router base URL (replica discovery via "
+                         "/debug/router; also scraped itself)")
+    ap.add_argument("--targets", default="",
+                    help="comma-separated static scrape URLs "
+                         "(autoscaler, canary, node exporters)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule files: bare groups "
+                         "documents (the chart's rules ConfigMap "
+                         "mounts one file per data key) or a full "
+                         "rendered manifest; loaded in the given "
+                         "order (recording groups first)")
+    ap.add_argument("--interval-s", type=float, default=1.0,
+                    help="scrape + rule-eval cadence")
+    ap.add_argument("--scrape-timeout-s", type=float, default=2.0)
+    ap.add_argument("--lookback-s", type=float, default=300.0,
+                    help="instant-vector staleness horizon")
+    ap.add_argument("--metrics-port", type=int, default=8092,
+                    help="/api/query + /api/alerts + /metrics port "
+                         "(0 disables)")
+    ap.add_argument("--instance", default=None,
+                    help="identity stamp for k3stpu_build_info")
+    args = ap.parse_args(argv)
+
+    groups = []
+    for path in (args.rules or "").split(","):
+        if path.strip():
+            groups.extend(load_rule_groups(open(path.strip()).read()))
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    collector = Collector(
+        router_url=args.router, targets=targets, groups=groups,
+        store=TSDB(lookback_s=args.lookback_s),
+        obs=CollectorObs(instance=args.instance),
+        scrape_timeout_s=args.scrape_timeout_s)
+
+    httpd = None
+    if args.metrics_port > 0:
+        httpd = ThreadingHTTPServer(("0.0.0.0", args.metrics_port),
+                                    make_collector_app(collector))
+        threading.Thread(target=httpd.serve_forever, daemon=True,
+                         name="collector-api").start()
+
+    import signal as _signal
+
+    stop = threading.Event()
+
+    def _stop(signum, frame):
+        print(f"signal {signum}: stopping collector", flush=True)
+        stop.set()
+
+    _signal.signal(_signal.SIGTERM, _stop)
+    _signal.signal(_signal.SIGINT, _stop)
+    print(f"collector: {len(collector.engine.rules)} rules, scraping "
+          f"every {args.interval_s:g}s", flush=True)
+    run_loop(collector, args.interval_s, stop)
+    if httpd is not None:
+        httpd.shutdown()
+        httpd.server_close()
+    print("collector: bye", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

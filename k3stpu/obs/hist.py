@@ -21,6 +21,7 @@ client/server skew visible (loadgen.py does exactly that).
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from bisect import bisect_left
@@ -444,42 +445,76 @@ def hist_p50(text: str, name: str) -> float:
     return float(q) if q is not None else 0.0
 
 
+# One exposition sample line: name, optional {labels}, one value token.
+# Our renderers never emit trailing timestamps, so the value is the last
+# token (after the exemplar suffix is stripped).
+_SERIES_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prometheus_samples(
+        text: str) -> "dict[str, list[tuple[dict, float]]]":
+    """Exposition text -> name -> [(labels, value)], in line order.
+
+    THE shared exposition reader for every scrape consumer in the repo —
+    the autoscaler's signal parser (autoscaler/signals.py), the canary's
+    SLO ingest path (via ``parse_prometheus_histograms`` below), the
+    node-exporter sweep in tools/tpu_top.py, and the collector's TSDB
+    ingest (obs/tsdb.py) all read exposition through this one function,
+    so exemplar-suffix stripping and label handling can never drift
+    between them. OpenMetrics exemplar tails (`` # {...} v ts``) are
+    dropped before the value parse; unparsable lines are skipped, not
+    fatal (one bad line must not blind a scrape)."""
+    out: "dict[str, list[tuple[dict, float]]]" = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        # Exemplar suffix: no label value here ever contains " # "
+        # (trace ids are hex), so the split is safe.
+        line = line.split(" # ", 1)[0]
+        m = _SERIES_RE.match(line.strip())
+        if not m:
+            continue
+        name, labels_raw, val = m.groups()
+        try:
+            value = float(val)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
 def parse_prometheus_histograms(text: str) -> "dict[str, dict]":
     """Lift histogram triples out of exposition text: name ->
     {"bounds": [...], "cumulative": [...], "sum": float, "count": int}.
     The read side of render(); loadgen uses it to compute server-side
     quantiles from a live /metrics scrape (and the exposition lint test
-    uses it to check triple consistency)."""
+    uses it to check triple consistency). Built on the shared
+    ``parse_prometheus_samples`` reader, so labeled buckets (constant
+    labels next to ``le``) and exemplar suffixes are handled in exactly
+    one place."""
+    fams = parse_prometheus_samples(text)
     out: "dict[str, dict]" = {}
-    for line in text.splitlines():
-        if line.startswith("#") or not line.strip():
+    for name, series in fams.items():
+        if not name.endswith("_bucket"):
             continue
-        # OpenMetrics bucket lines may carry an exemplar suffix
-        # (` # {trace_id="..."} value ts`); drop it or rsplit would
-        # read the exemplar timestamp as the sample value. No label
-        # value here ever contains " # " (trace ids are hex).
-        line = line.split(" # ", 1)[0]
-        try:
-            key, val = line.rsplit(None, 1)
-        except ValueError:
-            continue
-        if "_bucket{le=" in key:
-            name = key[:key.index("_bucket{le=")]
-            # le's value ends at ITS closing quote, not the line's last
-            # one — constant-labeled histograms carry more labels after.
-            start = key.index('le="') + 4
-            le = key[start:key.index('"', start)]
-            h = out.setdefault(name, {"bounds": [], "cumulative": [],
+        base = name[:-len("_bucket")]
+        for labels, value in series:
+            le = labels.get("le")
+            if le is None:
+                continue
+            h = out.setdefault(base, {"bounds": [], "cumulative": [],
                                       "sum": 0.0, "count": 0})
             if le == "+Inf":
-                h["cumulative"].append(int(float(val)))
+                h["cumulative"].append(int(value))
             else:
                 h["bounds"].append(float(le))
-                h["cumulative"].append(int(float(val)))
-            continue
-        base = key.partition("{")[0]  # strip constant labels if present
-        if base.endswith("_sum") and base[:-4] in out:
-            out[base[:-4]]["sum"] = float(val)
-        elif base.endswith("_count") and base[:-6] in out:
-            out[base[:-6]]["count"] = int(float(val))
+                h["cumulative"].append(int(value))
+    for name, series in fams.items():
+        if name.endswith("_sum") and name[:-4] in out:
+            out[name[:-4]]["sum"] = float(series[-1][1])
+        elif name.endswith("_count") and name[:-6] in out:
+            out[name[:-6]]["count"] = int(series[-1][1])
     return out

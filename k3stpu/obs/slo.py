@@ -38,6 +38,7 @@ registered with tools/metrics_lint.py via the LINT_* constants below.
 from __future__ import annotations
 
 from k3stpu.obs.hist import LabeledGauge, _fmt
+from k3stpu.obs.tsdb import anchor_index
 
 # The standard multi-window alert horizons (seconds). Fast pair pages,
 # slow pair tickets; each alert requires BOTH windows of its pair over
@@ -279,17 +280,15 @@ class SloEngine:
         minus the newest snapshot at or before the window start (a
         snapshot exactly at the horizon anchors the full window). All
         snapshots inside the window means the series is younger than
-        the window — difference from its oldest point instead."""
+        the window — difference from its oldest point instead. The
+        anchoring rule is the SHARED one (obs/tsdb.py anchor_index):
+        the collector's PromQL rate()/increase() and this engine's
+        burn-rate math can never disagree about what "the trailing
+        window" means."""
         if len(snaps) < 2:
             return 0, 0
         latest = snaps[-1]
-        start = now - window_s
-        anchor = snaps[0]
-        for s in snaps:
-            if s.t <= start:
-                anchor = s
-            else:
-                break
+        anchor = snaps[anchor_index([s.t for s in snaps], now - window_s)]
         return latest.good - anchor.good, latest.total - anchor.total
 
     def evaluate(self, now: float) -> "dict[str, dict]":

@@ -1,0 +1,259 @@
+"""Bounded in-memory time-series store for the fleet metrics pipeline.
+
+The scrape side of the embedded pipeline (docs/OBSERVABILITY.md
+"Executing the rules"): ``obs/collector.py`` feeds every fleet
+``/metrics`` exposition through the shared reader in ``obs/hist.py``
+into this store, and ``obs/promql.py`` evaluates the chart's recording
+and alert rules against it. Same zero-dep discipline as the rest of the
+observability tier — stdlib only, no client library, no Prometheus.
+
+Design points:
+
+- **Ring buffers.** Every series keeps at most ``max_samples`` points
+  (a deque); a collector scraping a 1000-replica fleet at 1 Hz is
+  bounded at ``series x max_samples`` floats no matter how long it
+  runs. The default (2048) holds > 30 minutes at 1 Hz — enough for
+  every window the shipped rules use except the slow-burn horizons,
+  which the burn-rate engine (obs/slo.py) already tracks with its own
+  pruned snapshots.
+- **Counter deltas unified with slo.py.** ``anchor_index`` is THE
+  window-anchoring rule: the newest sample at or before the window
+  start anchors the delta (a series younger than the window differences
+  from its oldest point). ``SloEngine._delta`` delegates to it, and
+  ``counter_increase`` builds rate()/increase() on top of it with
+  counter-reset correction — so a burn-rate number computed by the SLO
+  engine and one computed by a PromQL ``rate()`` over the same scrapes
+  can never disagree about what "the trailing window" means.
+- **Staleness marking.** A scrape that no longer contains a series the
+  same target exposed before marks that series stale (the Prometheus
+  staleness-marker analogue): instant queries skip it immediately
+  instead of serving its last value for a full lookback window. A
+  replica that vanishes from the router takes its series out of every
+  alert expression within one scrape interval.
+
+Everything takes explicit ``now`` timestamps — the store never reads
+the clock, so tests and the sim twin drive it on a virtual clock and
+get byte-identical results per seed.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from k3stpu.obs.hist import parse_prometheus_samples
+
+# Instant-vector lookback (seconds): how far back the newest sample may
+# be and still count as "current" — Prometheus's 5m default.
+DEFAULT_LOOKBACK_S = 300.0
+
+# Per-series ring capacity: > 30 min of 1 Hz scrapes.
+DEFAULT_MAX_SAMPLES = 2048
+
+
+def anchor_index(times: "list[float]", start: float) -> int:
+    """Index of the newest timestamp at or before ``start`` — the
+    window-anchoring rule shared by ``SloEngine._delta`` and
+    ``counter_increase``: a sample exactly at the horizon anchors the
+    full window; every sample inside the window means the series is
+    younger than the window, so the delta runs from its oldest point
+    (index 0)."""
+    idx = 0
+    for i, t in enumerate(times):
+        if t <= start:
+            idx = i
+        else:
+            break
+    return idx
+
+
+def counter_increase(points: "list[tuple[float, float]]", now: float,
+                     window_s: float) -> "float | None":
+    """Counter increase over the trailing window, reset-aware.
+
+    Anchored by ``anchor_index`` (the slo.py ``_delta`` rule), then
+    summed pairwise so a counter reset (value went DOWN — replica
+    restart) contributes the post-reset absolute value instead of a
+    negative delta, exactly how Prometheus's ``increase()`` corrects
+    resets. No extrapolation to the window edges: at the pipeline's
+    1 Hz scrape cadence the anchor rule is already sub-second exact,
+    and un-extrapolated deltas are what the hand-computed fixtures in
+    tests/test_tsdb.py pin. None when fewer than two points exist (no
+    delta is not zero traffic)."""
+    if len(points) < 2:
+        return None
+    i = anchor_index([t for t, _ in points], now - window_s)
+    inc = 0.0
+    prev = points[i][1]
+    for _, v in points[i + 1:]:
+        inc += v if v < prev else v - prev
+        prev = v
+    return inc
+
+
+class Series:
+    """One (name, labelset) ring: samples plus the staleness mark."""
+
+    __slots__ = ("name", "labels", "samples", "stale_at")
+
+    def __init__(self, name: str, labels: "dict[str, str]",
+                 max_samples: int):
+        self.name = name
+        self.labels = dict(labels)
+        self.samples: "deque[tuple[float, float]]" = \
+            deque(maxlen=max_samples)
+        self.stale_at: "float | None" = None
+
+    def key(self) -> "tuple[str, tuple]":
+        return series_key(self.name, self.labels)
+
+
+def series_key(name: str, labels: "dict[str, str]") -> "tuple[str, tuple]":
+    return name, tuple(sorted(labels.items()))
+
+
+class TSDB:
+    """The bounded store. One lock over the whole map — ingest is a
+    scrape-cadence batch (1 Hz over single-digit targets), queries are
+    rule-eval cadence; neither is a hot path worth sharding locks for.
+    """
+
+    def __init__(self, max_samples: int = DEFAULT_MAX_SAMPLES,
+                 lookback_s: float = DEFAULT_LOOKBACK_S):
+        self.max_samples = int(max_samples)
+        self.lookback_s = float(lookback_s)
+        self._series: "dict[tuple[str, tuple], Series]" = {}
+        # target name -> series keys its last scrape contained, for the
+        # vanished-series staleness walk.
+        self._seen_by_target: "dict[str, set]" = {}
+        self._lock = threading.Lock()
+
+    # -- write side --------------------------------------------------------
+
+    def ingest_sample(self, name: str, labels: "dict[str, str]",
+                      value: float, now: float) -> None:
+        key = series_key(name, labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = Series(name, labels,
+                                               self.max_samples)
+            s.samples.append((float(now), float(value)))
+            s.stale_at = None  # a fresh sample un-marks staleness
+
+    def ingest_text(self, text: str, now: float,
+                    instance: "str | None" = None,
+                    target: "str | None" = None) -> int:
+        """One scrape's exposition into the store; returns the sample
+        count. ``instance`` stamps every series (the scrape-time label
+        Prometheus adds — rules aggregate ``by (instance)``).
+        ``target`` names the scrape endpoint for staleness tracking:
+        series this target exposed last time but not now get their
+        staleness mark, so a vanished series drops out of instant
+        queries at the NEXT eval instead of lingering for a full
+        lookback window."""
+        fams = parse_prometheus_samples(text)
+        n = 0
+        seen: "set[tuple[str, tuple]]" = set()
+        for name, series in fams.items():
+            for labels, value in series:
+                if instance is not None and "instance" not in labels:
+                    labels = dict(labels, instance=instance)
+                self.ingest_sample(name, labels, value, now)
+                seen.add(series_key(name, labels))
+                n += 1
+        if target is not None:
+            with self._lock:
+                for key in self._seen_by_target.get(target, set()) - seen:
+                    s = self._series.get(key)
+                    if s is not None and s.stale_at is None:
+                        s.stale_at = float(now)
+                self._seen_by_target[target] = seen
+        return n
+
+    def mark_stale(self, name: str, labels: "dict[str, str]",
+                   now: float) -> None:
+        """Stale-mark one exact series (the rule engine uses this for
+        ALERTS series whose alert resolved or changed state — they must
+        vanish from instant queries at once, not after a lookback)."""
+        with self._lock:
+            s = self._series.get(series_key(name, labels))
+            if s is not None and s.stale_at is None:
+                s.stale_at = float(now)
+
+    def mark_target_down(self, target: str, now: float) -> None:
+        """A failed scrape stales every series the target owned — an
+        unreachable replica must not keep satisfying alert selectors
+        with its last healthy values."""
+        with self._lock:
+            for key in self._seen_by_target.get(target, set()):
+                s = self._series.get(key)
+                if s is not None and s.stale_at is None:
+                    s.stale_at = float(now)
+            self._seen_by_target[target] = set()
+
+    # -- read side ---------------------------------------------------------
+
+    def _select(self, name: str,
+                matchers: "dict[str, str] | None") -> "list[Series]":
+        with self._lock:
+            out = [s for s in self._series.values() if s.name == name]
+        if matchers:
+            out = [s for s in out
+                   if all(s.labels.get(k) == v
+                          for k, v in matchers.items())]
+        return out
+
+    def instant(self, name: str, matchers: "dict[str, str] | None",
+                now: float) -> "list[tuple[dict, float]]":
+        """Instant vector at ``now``: each matching series' newest
+        sample at or before ``now``, unless it is older than the
+        lookback or the series was stale-marked after it."""
+        out = []
+        for s in self._select(name, matchers):
+            point = None
+            for t, v in reversed(s.samples):
+                if t <= now:
+                    point = (t, v)
+                    break
+            if point is None:
+                continue
+            t, v = point
+            if now - t > self.lookback_s:
+                continue
+            if s.stale_at is not None and t < s.stale_at <= now:
+                continue
+            out.append((dict(s.labels), v))
+        return out
+
+    def window(self, name: str, matchers: "dict[str, str] | None",
+               now: float, window_s: float
+               ) -> "list[tuple[dict, list[tuple[float, float]]]]":
+        """Range vector: each matching series' samples in
+        ``(now - window_s, now]`` PLUS the anchor sample at or before
+        the window start (the ``anchor_index`` convention — rate() and
+        increase() difference from the anchor, same as slo._delta)."""
+        start = now - window_s
+        out = []
+        for s in self._select(name, matchers):
+            pts = [(t, v) for t, v in s.samples if t <= now]
+            if not pts:
+                continue
+            i = anchor_index([t for t, _ in pts], start)
+            pts = pts[i:]
+            if len(pts) < 1:
+                continue
+            out.append((dict(s.labels), pts))
+        return out
+
+    def series_count(self) -> int:
+        with self._lock:
+            return len(self._series)
+
+    def sample_count(self) -> int:
+        with self._lock:
+            return sum(len(s.samples) for s in self._series.values())
+
+    def names(self) -> "list[str]":
+        with self._lock:
+            return sorted({s.name for s in self._series.values()})

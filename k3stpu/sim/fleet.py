@@ -112,6 +112,11 @@ class FleetSim:
         self.fault_log: "list[dict]" = []
         self.stampedes: "list[dict]" = []
         self.router_log_lines = 0
+        # Report-cadence observers (scenarios.AlertReplay feeds the
+        # embedded metrics pipeline here): called with the virtual
+        # ``now`` after each SLO ingest, so whatever they compute is a
+        # pure function of (scenario, seed, trace) like everything else.
+        self.tick_hooks: "list" = []
         self.t_stop = float(scenario.duration_s) + float(scenario.tail_s)
 
     # -- client model ------------------------------------------------------
@@ -411,6 +416,8 @@ class FleetSim:
                 self.slo_engine.ingest_counts(spec.name, gt[0], gt[1],
                                               now)
         self._stampede_check(now)
+        for hook in self.tick_hooks:
+            hook(now)
         self.events.schedule(now + self.scenario.report_period_s,
                              self._report_tick)
 

@@ -92,4 +92,6 @@ def build_report(fleet) -> dict:
         },
         "router_log_lines": fleet.router_log_lines,
         "events_processed": fleet.events.processed,
+        **({"alert_replay": list(fleet.alert_replay.timeline)}
+           if getattr(fleet, "alert_replay", None) is not None else {}),
     }

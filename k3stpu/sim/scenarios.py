@@ -46,6 +46,10 @@ class Scenario:
     drain_deadline_s: float = 20.0
     max_requests: "int | None" = None
     tail_s: float = 120.0
+    # Replay the fleet's rendered expositions through the embedded
+    # metrics pipeline (obs/collector.py) at every report tick and
+    # record the alert timeline — the alert-replay scenario pair.
+    alert_replay: bool = False
     description: str = ""
 
 
@@ -149,6 +153,34 @@ def _burst() -> Scenario:
                     "matrix, swept over seeds.")
 
 
+def _alert_replay(calm: bool) -> Scenario:
+    # A fixed 2-replica fleet (min == max: the autoscaler is not
+    # allowed to rescue it) under a 3-minute overload plateau — long
+    # enough to hold the interactive fast-burn expression true through
+    # its 2m `for:` window. The calm variant is the same fleet, seed,
+    # and duration at trough load throughout: the pair pins "fires on
+    # overload, silent when calm" as a replayable regression.
+    overload = [(0.0, 0.5), (119.9, 0.5), (120.0, 18.0),
+                (300.0, 18.0), (300.1, 0.5), (480.0, 0.5)]
+    calm_profile = [(0.0, 0.5), (480.0, 0.5)]
+    return Scenario(
+        name="alert-replay-calm" if calm else "alert-replay",
+        duration_s=480.0,
+        profile=calm_profile if calm else overload,
+        replicas_start=2,
+        policy_kwargs=dict(min_replicas=2, max_replicas=2),
+        replica_kwargs=dict(_REPLICA_DEFAULTS, slots=4),
+        router_kwargs=dict(_ROUTER_DEFAULTS, max_inflight=64),
+        trace_kwargs=dict(interactive_frac=1.0, session_frac=0.0),
+        max_requests=4000,
+        alert_replay=True,
+        description="Alert replay pair: rendered sim expositions "
+                    "through the embedded metrics pipeline — "
+                    + ("calm trace (must stay silent)" if calm else
+                       "overload window (interactive fast-burn must "
+                       "fire)"))
+
+
 SCENARIOS = {
     "smoke": _smoke,
     "diurnal": _diurnal,
@@ -156,7 +188,67 @@ SCENARIOS = {
     "regress-cooldown": lambda: _regress_cooldown(off=False),
     "regress-cooldown-off": lambda: _regress_cooldown(off=True),
     "burst": _burst,
+    "alert-replay": lambda: _alert_replay(calm=False),
+    "alert-replay-calm": lambda: _alert_replay(calm=True),
 }
+
+
+def chart_rule_groups(qos: bool = True) -> "list[dict]":
+    """The chart's rendered rule groups, via the collector's own
+    zero-dep reader — the sim twin replays the SAME rule files the
+    cluster ships, not a hand-copied approximation."""
+    import os
+
+    from k3stpu.obs.promql import load_rule_groups
+    from k3stpu.utils.helm_lite import render_chart
+
+    chart = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         "..", "..", "deploy", "charts", "k3s-tpu")
+    overrides = {"rules.enabled": "true"}
+    if qos:
+        overrides.update({"inference.enabled": "true",
+                          "inference.qos.enabled": "true"})
+    return load_rule_groups(render_chart(chart, overrides=overrides))
+
+
+class AlertReplay:
+    """Report-tick hook: feeds the sim's rendered expositions (the SLO
+    engine's burn-rate families plus every live replica's serving
+    families) through a real Collector store + rule engine at virtual
+    timestamps, and records the alert timeline. Pure function of the
+    run — same seed, byte-identical timeline."""
+
+    def __init__(self, fleet, groups: "list[dict]"):
+        from k3stpu.obs.collector import Collector
+
+        self.fleet = fleet
+        self.collector = Collector(groups=groups)
+        self.timeline: "list[dict]" = []
+
+    def __call__(self, now: float) -> None:
+        f = self.fleet
+        f.slo_engine.evaluate(now)
+        self.collector.ingest("http://sim-canary:8093",
+                              f.slo_engine.render_prometheus(), now)
+        for url in sorted(f.replicas):
+            rep = f.replicas[url]
+            if rep.alive:
+                self.collector.ingest(url, rep.metrics_text(), now)
+        alerts = self.collector.eval_rules(now)
+        self.timeline.append(
+            {"t": round(now, 6),
+             "alerts": sorted((a["name"], a["state"])
+                              for a in alerts)})
+
+    def states(self, alert: str) -> "list[tuple[float, str]]":
+        """(t, state) transitions of one alert across the run —
+        'absent' ticks elided."""
+        out = []
+        for entry in self.timeline:
+            for name, state in entry["alerts"]:
+                if name == alert:
+                    out.append((entry["t"], state))
+        return out
 
 
 def get_scenario(name: str) -> Scenario:
@@ -194,8 +286,12 @@ def build_run(scenario: Scenario, seed: int, *,
             0.1 * scenario.duration_s, 0.9 * scenario.duration_s)
     if costs is None:
         costs = calibrate.from_artifacts()
-    return FleetSim(scenario, seed, trace, costs,
-                    fault_events=fault_events)
+    fleet = FleetSim(scenario, seed, trace, costs,
+                     fault_events=fault_events)
+    if scenario.alert_replay:
+        fleet.alert_replay = AlertReplay(fleet, chart_rule_groups())
+        fleet.tick_hooks.append(fleet.alert_replay)
+    return fleet
 
 
 def run_scenario(name: str, seed: int = 0, *,

@@ -75,6 +75,7 @@ SMOKE=(
   tests/test_canary.py
   tests/test_qos.py
   tests/test_sim.py
+  tests/test_tsdb.py
 )
 
 # Full-suite-only files: every test file must be EITHER in SMOKE or

@@ -561,13 +561,21 @@ def _golden_case(name):
         "qos.yaml": {"inference.enabled": "true",
                      "inference.qos.enabled": "true",
                      "rules.enabled": "true"},
+        # Embedded metrics pipeline (docs/OBSERVABILITY.md "Executing
+        # the rules"): the collector Deployment/Service with the rules
+        # ConfigMap mounted — the same rule files a real Prometheus
+        # would load, executed by the in-cluster engine.
+        "collector.yaml": {"collector.enabled": "true",
+                           "router.enabled": "true",
+                           "inference.enabled": "true",
+                           "rules.enabled": "true"},
     }[name]
 
 
 GOLDEN_NAMES = ["default.yaml", "core-8way.yaml", "inference.yaml",
                 "train.yaml", "node-obs.yaml", "router.yaml",
                 "autoscaler.yaml", "disagg.yaml", "canary.yaml",
-                "qos.yaml"]
+                "qos.yaml", "collector.yaml"]
 
 
 @pytest.mark.parametrize("name", GOLDEN_NAMES)

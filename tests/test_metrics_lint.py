@@ -36,7 +36,8 @@ def test_scan_actually_finds_families():
 
 _COLLECTORS = ("_families_from_obs", "_families_from_server",
                "_families_from_router", "_families_from_autoscaler",
-               "_families_from_canary", "_families_from_slo")
+               "_families_from_canary", "_families_from_slo",
+               "_families_from_collector")
 
 
 def _check(fams):

@@ -1,0 +1,520 @@
+"""Embedded metrics pipeline (k3stpu/obs/{tsdb,promql,collector}).
+
+Evaluator semantics are pinned with hand-computed fixtures — rate()
+under a counter reset, histogram_quantile() on labeled buckets,
+``and ignoring()`` vector matching, ``for:`` state transitions — so a
+future "optimization" of the window math shows up as a changed number,
+not a silently different alert timeline. The chart contract is the
+acceptance criterion: every rule the chart renders (default AND qos)
+must parse and evaluate in the embedded engine, and a real 2-replica
+routed fleet with silent corruption armed must drive
+K3sTpuCanaryTokenMismatch to firing from scrape data alone.
+"""
+
+import json
+import os
+import sys
+import threading
+import urllib.error
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from k3stpu.obs.promql import (
+    PromQLError,
+    Rule,
+    RuleEngine,
+    evaluate,
+    load_rule_groups,
+    metric_names,
+    parse_duration,
+    parse_expr,
+    yaml_lite_load,
+)
+from k3stpu.obs.tsdb import TSDB, anchor_index, counter_increase
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+CHART = os.path.join(os.path.dirname(__file__), "..", "deploy",
+                     "charts", "k3s-tpu")
+
+
+def _store(samples):
+    """TSDB from [(name, labels, value, t)]."""
+    db = TSDB()
+    for name, labels, value, t in samples:
+        db.ingest_sample(name, labels, value, t)
+    return db
+
+
+def _eval(expr, db, now):
+    return sorted(evaluate(parse_expr(expr), db, now),
+                  key=lambda lv: sorted(lv[0].items()))
+
+
+# --- TSDB -------------------------------------------------------------------
+
+
+def test_instant_respects_lookback_and_staleness():
+    db = _store([("m", {"i": "a"}, 1.0, 0.0),
+                 ("m", {"i": "b"}, 2.0, 290.0)])
+    assert _eval("m", db, 300.0) == [({"i": "a"}, 1.0),
+                                     ({"i": "b"}, 2.0)]
+    # a: 301s old > 300s lookback -> gone; b still inside.
+    assert _eval("m", db, 301.0) == [({"i": "b"}, 2.0)]
+    db.mark_stale("m", {"i": "b"}, 295.0)
+    # stale-marked: b gone at once (a's sample is still in lookback).
+    assert _eval("m", db, 296.0) == [({"i": "a"}, 1.0)]
+    db.ingest_sample("m", {"i": "b"}, 3.0, 297.0)
+    assert _eval("m", db, 298.0) == [({"i": "a"}, 1.0),
+                                     ({"i": "b"}, 3.0)]  # un-staled
+
+
+def test_target_staleness_on_scrape_and_on_target_down():
+    db = TSDB()
+    db.ingest_text("a 1\nb 2\n", 0.0, instance="x", target="t1")
+    assert db.names() == ["a", "b"]
+    # next scrape drops family b -> b stale-marked immediately.
+    db.ingest_text("a 3\n", 10.0, instance="x", target="t1")
+    assert _eval("b", db, 11.0) == []
+    assert _eval("a", db, 11.0) == [({"instance": "x"}, 3.0)]
+    db.mark_target_down("t1", 20.0)
+    assert _eval("a", db, 21.0) == []  # unreachable target: all stale
+
+
+def test_ring_buffer_caps_samples_per_series():
+    db = TSDB(max_samples=4)
+    for i in range(10):
+        db.ingest_sample("m", {}, float(i), float(i))
+    assert db.sample_count() == 4
+    assert _eval("m", db, 10.0) == [({}, 9.0)]
+
+
+def test_anchor_index_is_the_slo_delta_rule():
+    from k3stpu.obs.slo import SloEngine
+    # the unification is an identity, not a lookalike
+    assert SloEngine._delta.__module__ == "k3stpu.obs.slo"
+    assert anchor_index([0.0, 60.0, 120.0], 60.0) == 1  # at horizon
+    assert anchor_index([0.0, 60.0, 120.0], 59.0) == 0
+    assert anchor_index([100.0, 160.0], 50.0) == 0  # young series
+
+
+# --- evaluator semantics (hand-computed fixtures) ---------------------------
+
+
+def _counter_with_reset():
+    # counter climbs to 60, resets (restart), climbs again:
+    # pairwise increase = 60 + 10 + 60 = 130, NOT 70 - 0 = 70.
+    return _store([("c", {"i": "a"}, 0.0, 0.0),
+                   ("c", {"i": "a"}, 60.0, 60.0),
+                   ("c", {"i": "a"}, 10.0, 120.0),
+                   ("c", {"i": "a"}, 70.0, 180.0)])
+
+
+def test_increase_is_reset_corrected():
+    db = _counter_with_reset()
+    assert _eval("increase(c[3m])", db, 180.0) == [({"i": "a"}, 130.0)]
+    # window covering only the post-reset leg: anchor at t=120.
+    assert _eval("increase(c[1m])", db, 180.0) == [({"i": "a"}, 60.0)]
+
+
+def test_rate_is_increase_over_window():
+    db = _counter_with_reset()
+    ((_, v),) = _eval("rate(c[3m])", db, 180.0)
+    assert v == pytest.approx(130.0 / 180.0)
+
+
+def test_counter_increase_needs_two_points():
+    assert counter_increase([(0.0, 5.0)], 60.0, 60.0) is None
+    assert counter_increase([], 60.0, 60.0) is None
+
+
+def test_histogram_quantile_on_labeled_buckets():
+    db = _store([
+        ("h_bucket", {"i": "a", "le": "0.1"}, 5.0, 0.0),
+        ("h_bucket", {"i": "a", "le": "1"}, 9.0, 0.0),
+        ("h_bucket", {"i": "a", "le": "+Inf"}, 10.0, 0.0),
+        ("h_bucket", {"i": "b", "le": "0.1"}, 0.0, 0.0),
+        ("h_bucket", {"i": "b", "le": "1"}, 10.0, 0.0),
+        ("h_bucket", {"i": "b", "le": "+Inf"}, 10.0, 0.0),
+    ])
+    got = dict((lv[0]["i"], lv[1])
+               for lv in _eval("histogram_quantile(0.5, h_bucket)",
+                               db, 0.0))
+    # a: rank 5 lands exactly on the 0.1 bucket's cumulative count.
+    assert got["a"] == pytest.approx(0.1)
+    # b: rank 5 is halfway through (0.1, 1] -> 0.1 + 0.9/2.
+    assert got["b"] == pytest.approx(0.55)
+    # q=0.3 interpolates inside a's first bucket: 3/5 of (0, 0.1].
+    got = dict((lv[0]["i"], lv[1])
+               for lv in _eval("histogram_quantile(0.3, h_bucket)",
+                               db, 0.0))
+    assert got["a"] == pytest.approx(0.06)
+
+
+def test_and_ignoring_matches_on_remaining_labels():
+    db = _store([
+        ("b", {"slo": "x", "window": "5m"}, 20.0, 0.0),
+        ("b", {"slo": "x", "window": "1h"}, 16.0, 0.0),
+        ("b", {"slo": "y", "window": "5m"}, 20.0, 0.0),
+        ("b", {"slo": "y", "window": "1h"}, 2.0, 0.0),
+    ])
+    expr = ('b{window="5m"} > 14.4 '
+            'and ignoring(window) b{window="1h"} > 14.4')
+    # only slo=x clears the bar on BOTH windows; the result keeps the
+    # LEFT side's labels and value (Prometheus `and` semantics).
+    assert _eval(expr, db, 0.0) == [
+        ({"slo": "x", "window": "5m"}, 20.0)]
+
+
+def test_aggregation_and_arithmetic():
+    db = _store([("q", {"i": "a", "c": "int"}, 3.0, 0.0),
+                 ("q", {"i": "b", "c": "int"}, 5.0, 0.0),
+                 ("q", {"i": "a", "c": "bat"}, 7.0, 0.0)])
+    assert _eval("sum by (c) (q)", db, 0.0) == [({"c": "bat"}, 7.0),
+                                                ({"c": "int"}, 8.0)]
+    assert _eval("sum(q) by (c)", db, 0.0) == [({"c": "bat"}, 7.0),
+                                               ({"c": "int"}, 8.0)]
+    assert _eval("max(q)", db, 0.0) == [({}, 7.0)]
+    assert _eval("sum(q) / 3", db, 0.0) == [({}, 5.0)]
+    assert _eval("q * 2 + 1", db, 0.0) == [
+        ({"c": "bat", "i": "a"}, 15.0),
+        ({"c": "int", "i": "a"}, 7.0),
+        ({"c": "int", "i": "b"}, 11.0)]
+
+
+def test_division_by_zero_drops_the_element():
+    db = _store([("good", {"i": "a"}, 5.0, 0.0),
+                 ("tot", {"i": "a"}, 10.0, 0.0),
+                 ("good", {"i": "b"}, 0.0, 0.0),
+                 ("tot", {"i": "b"}, 0.0, 0.0)])
+    # 0/0 is silence (no traffic), not a paging NaN.
+    assert _eval("good / tot", db, 0.0) == [({"i": "a"}, 0.5)]
+
+
+def test_comparison_filters_do_not_booleanize():
+    db = _store([("m", {"i": "a"}, 5.0, 0.0),
+                 ("m", {"i": "b"}, 1.0, 0.0)])
+    assert _eval("m > 2", db, 0.0) == [({"i": "a"}, 5.0)]
+    assert _eval("m <= 1", db, 0.0) == [({"i": "b"}, 1.0)]
+    assert _eval("m == 5", db, 0.0) == [({"i": "a"}, 5.0)]
+
+
+# --- the subset boundary ----------------------------------------------------
+
+
+@pytest.mark.parametrize("expr,tok", [
+    ("a or b", "or"),
+    ("a unless b", "unless"),
+    ("sum without (x) (a)", "without"),
+    ('a{x=~"y"}', "=~"),
+    ('a{x!="y"}', "!="),
+    ("a offset 5m", "offset"),
+    ("rate(a[5m:1m])", "duration"),  # subqueries are out
+    ("a[5m]", "range vector"),       # bare top-level range vector
+    ("avg(a)", "avg"),            # outside the agg subset
+    ("irate(a[1m])", "irate"),    # outside the func subset
+    ("a and on(x) b", "on"),
+    ("1 > 2", ">"),               # scalar-scalar comparison
+])
+def test_out_of_subset_rejected_with_offending_token(expr, tok):
+    with pytest.raises(PromQLError) as ei:
+        parse_expr(expr)
+    assert tok in str(ei.value)
+
+
+def test_metric_names_walks_the_whole_tree():
+    node = parse_expr("sum by (i) (rate(a[5m])) / max(b) + c")
+    assert metric_names(node) == {"a", "b", "c"}
+
+
+def test_parse_duration():
+    assert parse_duration("90s") == 90.0
+    assert parse_duration("2m") == 120.0
+    assert parse_duration("1h") == 3600.0
+    with pytest.raises(PromQLError):
+        parse_duration("5 parsecs")
+
+
+# --- rule engine ------------------------------------------------------------
+
+_RULES_YAML = """\
+groups:
+  - name: test.rules
+    interval: 30s
+    rules:
+      - record: t:m:sum
+        expr: sum(m)
+      - alert: MHigh
+        expr: m > 10
+        for: 1m
+        labels:
+          severity: page
+        annotations:
+          summary: m too high
+"""
+
+
+def _alert_states(engine):
+    return [(a["name"], a["state"]) for a in engine.alerts()]
+
+
+def test_for_duration_pending_firing_resolved():
+    db = TSDB()
+    engine = RuleEngine(yaml_lite_load(_RULES_YAML)["groups"], db)
+    db.ingest_sample("m", {"i": "a"}, 20.0, 0.0)
+    engine.evaluate(0.0)
+    assert _alert_states(engine) == [("MHigh", "pending")]
+    db.ingest_sample("m", {"i": "a"}, 20.0, 30.0)
+    engine.evaluate(30.0)
+    assert _alert_states(engine) == [("MHigh", "pending")]  # 30 < 60
+    db.ingest_sample("m", {"i": "a"}, 20.0, 60.0)
+    engine.evaluate(60.0)
+    assert _alert_states(engine) == [("MHigh", "firing")]
+    (alert,) = engine.firing()
+    assert alert["labels"]["severity"] == "page"
+    assert alert["active_since"] == 0.0
+    # expr goes false -> resolved (gone), ALERTS series stale at once.
+    db.ingest_sample("m", {"i": "a"}, 1.0, 90.0)
+    engine.evaluate(90.0)
+    assert engine.alerts() == []
+    assert db.instant("ALERTS", None, 90.0) == []
+
+
+def test_alerts_series_tracks_state_transitions():
+    db = TSDB()
+    engine = RuleEngine(yaml_lite_load(_RULES_YAML)["groups"], db)
+    db.ingest_sample("m", {"i": "a"}, 20.0, 0.0)
+    engine.evaluate(0.0)
+    ((labels, v),) = db.instant("ALERTS", None, 0.0)
+    assert v == 1.0 and labels["alertstate"] == "pending"
+    db.ingest_sample("m", {"i": "a"}, 20.0, 60.0)
+    engine.evaluate(60.0)
+    # the pending series was stale-marked when the alert promoted:
+    # exactly one ALERTS series visible, and it says firing.
+    ((labels, _),) = db.instant("ALERTS", None, 60.0)
+    assert labels["alertstate"] == "firing"
+    assert labels["alertname"] == "MHigh"
+
+
+def test_recording_rule_feeds_later_rules_in_same_pass():
+    text = _RULES_YAML.replace("expr: m > 10", "expr: t:m:sum > 10")
+    db = TSDB()
+    engine = RuleEngine(yaml_lite_load(text)["groups"], db)
+    db.ingest_sample("m", {"i": "a"}, 7.0, 0.0)
+    db.ingest_sample("m", {"i": "b"}, 7.0, 0.0)
+    engine.evaluate(0.0)
+    assert db.instant("t:m:sum", None, 0.0) == [({}, 14.0)]
+    assert _alert_states(engine) == [("MHigh", "pending")]
+
+
+def test_interval_default_and_rule_parse():
+    (group,) = yaml_lite_load(_RULES_YAML)["groups"]
+    engine = RuleEngine([group], TSDB())
+    ((name, interval, rules),) = engine.groups
+    assert (name, interval) == ("test.rules", 30.0)
+    assert [r.is_alert for r in rules] == [False, True]
+    assert rules[1].for_s == 60.0
+
+
+# --- the shared-parser pin (satellite: one exposition reader) ---------------
+
+
+def test_exposition_parser_is_shared_not_copied():
+    import tpu_top
+
+    from k3stpu.autoscaler import signals
+    from k3stpu.obs.hist import parse_prometheus_samples
+    assert signals.parse_samples is parse_prometheus_samples
+    assert tpu_top.parse_families is parse_prometheus_samples
+    # histogram lifting and the canary/node-exporter primitives ride
+    # the same reader module (no second regex stack anywhere).
+    from k3stpu.obs import hist
+    assert hist.parse_prometheus_histograms.__module__ == hist.__name__
+
+
+# --- the chart contract -----------------------------------------------------
+
+
+def _rendered_groups(qos):
+    yaml = pytest.importorskip("yaml")  # noqa: F841 (render needs it)
+    from k3stpu.utils.helm_lite import render_chart
+    overrides = {"rules.enabled": "true"}
+    if qos:
+        overrides.update({"inference.enabled": "true",
+                          "inference.qos.enabled": "true"})
+    return load_rule_groups(render_chart(CHART, overrides=overrides))
+
+
+@pytest.mark.parametrize("qos", [False, True], ids=["default", "qos"])
+def test_every_shipped_rule_parses_and_evaluates(qos):
+    groups = _rendered_groups(qos)
+    assert groups, "chart rendered no rule groups"
+    rules = [Rule(r) for g in groups for r in g["rules"]]
+    assert len(rules) >= (12 if qos else 10)
+    # and the engine can run the full pass on an empty store: every
+    # expr evaluates (to empty vectors) without touching the reject
+    # paths — the lint gate and the runtime agree on the subset.
+    engine = RuleEngine(groups, TSDB())
+    assert engine.evaluate(0.0) == []
+    names = {r.name for r in engine.rules}
+    assert "K3sTpuCanaryTokenMismatch" in names
+    if qos:
+        assert "K3sTpuInteractiveTtftBudgetFastBurn" in names
+
+
+# --- collector HTTP surface + tpu_top integration ---------------------------
+
+
+def _serve(app):
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), app)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd, f"http://127.0.0.1:{httpd.server_address[1]}"
+
+
+def test_query_api_and_tpu_top_collector_mode():
+    import tpu_top
+
+    from k3stpu.obs.collector import Collector, make_collector_app
+    groups = yaml_lite_load(_RULES_YAML)["groups"]
+    col = Collector(groups=groups)
+    col.ingest("http://fake:1", 'm{instance="a"} 20\n', 0.0)
+    col.eval_rules(0.0)
+    col.ingest("http://fake:1", 'm{instance="a"} 20\n', 60.0)
+    col.eval_rules(60.0)
+    col.last_now = 60.0
+    httpd, base = _serve(make_collector_app(col))
+    try:
+        got = tpu_top.collector_query(base, "sum(m)")
+        assert got == [({}, 20.0)]
+        alerts = tpu_top.collector_alerts(base)
+        assert [(a["name"], a["state"]) for a in alerts] == [
+            ("MHigh", "firing")]
+        # out-of-subset query: 400 with the offending token, not a 500.
+        try:
+            urllib.request.urlopen(
+                base + "/api/query?query=m%20or%20n", timeout=5.0)
+            raise AssertionError("expected HTTP 400")
+        except urllib.error.HTTPError as e:
+            assert e.code == 400
+            doc = json.loads(e.read().decode())
+            assert doc["status"] == "error" and "'or'" in doc["error"]
+        # /metrics self-telemetry + the synthetic ALERTS family.
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=5.0) as r:
+            text = r.read().decode()
+        assert "k3stpu_pipeline_rules 2" in text
+        assert 'ALERTS{' in text and 'alertstate="firing"' in text
+    finally:
+        httpd.shutdown()
+        httpd.server_close()
+
+
+def test_render_table_alert_column_and_footer():
+    import tpu_top
+    rows = [tpu_top.node_row("http://node-a:8478", None)]
+    firing = [{"name": "MHigh", "state": "firing",
+               "labels": {"severity": "page"}}]
+    out = tpu_top.render_table(rows, alerts=firing)
+    assert "ALERTS" in out and "FIRING: MHigh" in out
+    # legacy direct-scrape rendering stays byte-compatible: no column.
+    assert "ALERTS" not in tpu_top.render_table(rows)
+
+
+# --- sim twin alert replay --------------------------------------------------
+
+
+def _replay(name, seed=0):
+    from k3stpu.sim.scenarios import build_run, get_scenario
+    fleet = build_run(get_scenario(name), seed=seed)
+    fleet.run()
+    return fleet.alert_replay.timeline
+
+
+def test_sim_replay_fires_on_overload_and_only_then():
+    pytest.importorskip("yaml")
+    timeline = _replay("alert-replay")
+    states = [s for e in timeline for (n, s) in e["alerts"]
+              if n == "K3sTpuInteractiveTtftBudgetFastBurn"]
+    assert "firing" in states
+    assert states.index("firing") > 0  # for: 2m held it pending first
+    calm = _replay("alert-replay-calm")
+    assert all(not e["alerts"] for e in calm), calm
+
+
+def test_sim_replay_timeline_is_byte_identical_per_seed():
+    pytest.importorskip("yaml")
+    a = json.dumps(_replay("alert-replay", seed=7), sort_keys=True)
+    b = json.dumps(_replay("alert-replay", seed=7), sort_keys=True)
+    assert a == b
+
+
+# --- e2e: corruption observed from scrape data alone ------------------------
+
+
+def test_e2e_canary_mismatch_alert_fires_from_scrapes():
+    """A real 2-replica routed fleet, one replica silently corrupting
+    its output tokens; the collector learns of it ONLY by scraping
+    /metrics over HTTP and must walk K3sTpuCanaryTokenMismatch through
+    pending to firing on logical timestamps."""
+    from test_canary import PROMPTS, _real_fleet
+
+    from k3stpu.canary import Canary, CanaryObs
+    from k3stpu.canary.__main__ import make_canary_app
+    from k3stpu.obs.collector import Collector
+    from k3stpu.obs.slo import SloEngine
+
+    servers, httpds, urls, router, rhttpd, router_url, inj = \
+        _real_fleet()
+    chttpd = None
+    try:
+        can = Canary(router_url, prompts=PROMPTS, max_new_tokens=4,
+                     timeout_s=60.0, obs=CanaryObs(instance="e2e"))
+        chttpd, canary_url = _serve(make_canary_app(can, SloEngine([])))
+        col = Collector(router_url=router_url, targets=[canary_url],
+                        groups=_rendered_groups(qos=False))
+        # discovery is live: router membership, not a static list.
+        targets = col.discover_targets()
+        assert canary_url in targets and all(u in targets for u in urls)
+
+        can.record_golden()
+        col.step(0.0)  # baseline: clean fleet, no alert
+        assert col.engine.alerts() == []
+
+        inj.arm("gen_corrupt", times=10_000)
+        for _ in range(2):  # canary acceptance bar: TWO intervals
+            can.probe_round()
+            if can.obs.fleet_ok.value == 0.0:
+                break
+        assert inj.fired("gen_corrupt") > 0
+        assert can.obs.mismatch.get("replica") >= 1
+
+        # the mismatch series is born at this scrape (LabeledCounter
+        # renders a path only once seen): one window point is no delta
+        # yet — increase() needs two, exactly like Prometheus.
+        col.step(30.0)
+        def _mismatch_states():
+            # one alert instance per mismatching probe path — how many
+            # paths caught the corruption varies with routing, the
+            # state machine must not.
+            return {a["state"] for a in col.engine.alerts()
+                    if a["name"] == "K3sTpuCanaryTokenMismatch"}
+        assert _mismatch_states() == set()
+        can.probe_round()  # corruption persists: counter still rising
+        col.step(60.0)  # second point: increase[10m] > 0 -> pending
+        assert _mismatch_states() == {"pending"}
+        col.step(90.0)
+        col.step(120.0)  # for: 1m elapsed since 60.0
+        firing = [a["name"] for a in col.engine.firing()]
+        assert "K3sTpuCanaryTokenMismatch" in firing
+        # and the verdict is queryable where an operator would look.
+        got = col.query('increase(k3stpu_canary_mismatch_total[10m])')
+        assert any(v > 0 for _, v in got)
+    finally:
+        if chttpd is not None:
+            chttpd.shutdown()
+            chttpd.server_close()
+        for h in [rhttpd] + httpds:
+            h.shutdown()
+            h.server_close()

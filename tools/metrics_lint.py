@@ -237,11 +237,39 @@ def _families_from_slo() -> "list[tuple[str, str, str]]":
     return list(LINT_FAMILIES)
 
 
+def _families_from_collector() -> "list[tuple[str, str, str]]":
+    """The metrics pipeline's own families, from a real CollectorObs —
+    the collector must be observable by the very rules it executes.
+    (The synthetic ALERTS series is deliberately NOT here: it is
+    hand-rendered without the k3stpu_ prefix because
+    ``ALERTS{alertname=,alertstate=}`` is the Prometheus convention
+    dashboards already query.)"""
+    from k3stpu.obs.collector import CollectorObs
+    from k3stpu.obs.hist import (
+        Counter,
+        Gauge,
+        Histogram,
+        InfoGauge,
+        LabeledCounter,
+        LabeledGauge,
+    )
+
+    fams = []
+    for attr in vars(CollectorObs(instance="lint")).values():
+        if isinstance(attr, Histogram):
+            fams.append((attr.name, "histogram", attr.help))
+        elif isinstance(attr, (Counter, LabeledCounter)):
+            fams.append((attr.name, "counter", attr.help))
+        elif isinstance(attr, (Gauge, LabeledGauge, InfoGauge)):
+            fams.append((attr.name, "gauge", attr.help))
+    return fams
+
+
 def _all_families() -> "list[tuple[str, str, str]]":
     return (_families_from_obs() + _families_from_server()
             + _families_from_node_exporter() + _families_from_router()
             + _families_from_autoscaler() + _families_from_canary()
-            + _families_from_slo())
+            + _families_from_slo() + _families_from_collector())
 
 
 def lint() -> "list[str]":
@@ -381,22 +409,22 @@ def lint_openmetrics(text: str) -> "list[str]":
     return problems
 
 
-# Metric tokens in a rule expression: bare family names and the
-# colon-separated recording-rule convention (k3stpu:level:operation).
-RULE_METRIC_RE = re.compile(r"\bk3stpu[a-z0-9_:]*")
-
-
 def _rule_groups_from_chart() -> "list[dict]":
     """Rule groups out of the chart's rendered rules ConfigMap, with
-    both the nodeExporter and rules components forced on — the lint
-    must see the rules even though the chart ships them opt-out."""
+    the nodeExporter, rules, AND QoS components forced on — the lint
+    must see every rule the chart can ship, including the per-class
+    burn-rate alert pair that only renders under inference.qos
+    (a superset of the default render)."""
     import yaml
 
     from k3stpu.utils.helm_lite import render_chart
 
     chart = os.path.join(REPO, "deploy", "charts", "k3s-tpu")
     text = render_chart(chart, overrides={"nodeExporter.enabled": "true",
-                                          "rules.enabled": "true"})
+                                          "rules.enabled": "true",
+                                          "inference.enabled": "true",
+                                          "inference.qos.enabled":
+                                              "true"})
     groups = []
     for doc in yaml.safe_load_all(text):
         if not doc or doc.get("kind") != "ConfigMap":
@@ -410,9 +438,22 @@ def _rule_groups_from_chart() -> "list[dict]":
 
 def lint_rules(fams: "list[tuple[str, str, str]] | None" = None,
                groups: "list[dict] | None" = None) -> "list[str]":
-    """Recording/alerting rules vs the real families: every k3stpu_*
-    metric an expr references must be a linted family (histograms via
-    _bucket/_sum/_count) or another rule's recorded output."""
+    """Recording/alerting rules vs the real families AND the embedded
+    engine: every expr must parse in the PromQL subset the collector
+    executes (obs/promql.py — an out-of-subset expression fails with
+    the offending token, because the shipped collector could not run
+    it), and every series name the parsed AST selects must be a linted
+    family (histograms via _bucket/_sum/_count) or another rule's
+    recorded output. The AST replaces the old regex token scan, so a
+    metric name inside a label VALUE or annotation no longer counts as
+    a reference."""
+    from k3stpu.obs.promql import (
+        PromQLError,
+        metric_names,
+        parse_duration,
+        parse_expr,
+    )
+
     problems = []
     fams = _all_families() if fams is None else fams
     known = set()
@@ -440,7 +481,18 @@ def lint_rules(fams: "list[tuple[str, str, str]] | None" = None,
             if "record" in r and ":" not in r["record"]:
                 problems.append(f"{where}: recording-rule name must use "
                                 f"the level:metric:operation convention")
-            for tok in set(RULE_METRIC_RE.findall(expr)):
+            try:
+                node = parse_expr(expr)
+            except PromQLError as e:
+                problems.append(f"{where}: expr outside the embedded "
+                                f"PromQL subset: {e}")
+                continue
+            if "for" in r:
+                try:
+                    parse_duration(str(r["for"]))
+                except PromQLError as e:
+                    problems.append(f"{where}: bad for duration: {e}")
+            for tok in sorted(metric_names(node)):
                 if tok not in known and tok not in recorded:
                     problems.append(
                         f"{where}: references '{tok}' which is neither "

@@ -18,6 +18,15 @@ instead of killing the sweep — a down exporter is exactly the node you
 want visible. Exit code 0 when every node is healthy, 1 otherwise
 (scriptable: a cron wrapper can page on it).
 
+With ``--collector URL`` the table comes from ONE place instead of N:
+the embedded metrics pipeline (k3stpu/obs/collector.py) already scraped
+the fleet, so tpu-top asks its ``/api/query`` for the same families,
+groups them by the ``instance`` label, and adds an ALERTS column plus a
+firing-alert footer from ``/api/alerts``. Any firing alert forces the
+nonzero exit — the same pager contract as an unhealthy node.
+
+    python tools/tpu_top.py --collector http://tpu-collector:8092
+
 Endpoints that also expose the canary/SLO families (the tpu-canary
 pod's /metrics, k3stpu/canary) get two extra columns: CANARY (the
 `k3stpu_canary_fleet_ok` verdict) and BUDGET (the tightest
@@ -30,37 +39,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import re
 import sys
 import time
 import urllib.error
+import urllib.parse
 import urllib.request
 
-SERIES_RE = re.compile(
-    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{([^}]*)\})?\s+(\S+)$')
-LABEL_RE = re.compile(r'(\w+)="([^"]*)"')
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
+from k3stpu.obs.hist import parse_prometheus_samples  # noqa: E402
 
-def parse_families(text: str) -> "dict[str, list[tuple[dict, float]]]":
-    """Exposition text -> name -> [(labels, value)]. Scalar parse only
-    (gauges/counters); the exporter's families are all scalar series.
-    The histogram read side lives in obs/hist.py — this is its untyped
-    sibling for gauge sweeps."""
-    out: "dict[str, list[tuple[dict, float]]]" = {}
-    for line in text.splitlines():
-        if line.startswith("#") or not line.strip():
-            continue
-        m = SERIES_RE.match(line.strip())
-        if not m:
-            continue
-        name, labels_raw, val = m.groups()
-        try:
-            value = float(val)
-        except ValueError:
-            continue
-        labels = dict(LABEL_RE.findall(labels_raw or ""))
-        out.setdefault(name, []).append((labels, value))
-    return out
+# Exposition text -> name -> [(labels, value)]: THE shared reader in
+# obs/hist.py (identity-pinned by tests/test_tsdb.py) — tpu_top used to
+# carry its own regex sibling, which silently dropped exemplar-suffixed
+# lines the shared one handles.
+parse_families = parse_prometheus_samples
 
 
 def fetch(endpoint: str, timeout: float = 5.0
@@ -129,6 +126,79 @@ def node_row(endpoint: str, fams) -> dict:
     }
 
 
+# Every family node_row() reads — the collector-mode query list. One
+# /api/query per family rebuilds the same per-instance parsed shape the
+# direct-scrape path produces, so BOTH paths feed the identical
+# node_row() and can never render different tables for the same fleet.
+NODE_FAMILIES = (
+    "k3stpu_node_tpu_health_state",
+    "k3stpu_node_chips",
+    "k3stpu_node_chips_expected",
+    "k3stpu_node_drop_files",
+    "k3stpu_node_drop_file_age_seconds",
+    "k3stpu_node_drop_file_stale",
+    "k3stpu_node_chip_hbm_used_bytes",
+    "k3stpu_node_chip_hbm_limit_bytes",
+    "k3stpu_node_chip_duty_cycle_pct",
+    "k3stpu_canary_fleet_ok",
+    "k3stpu_slo_error_budget_remaining_ratio",
+)
+
+
+def collector_query(base: str, expr: str, timeout: float = 5.0
+                    ) -> "list[tuple[dict, float]]":
+    """One /api/query round-trip -> [(labels, value)]."""
+    url = (base.rstrip("/") + "/api/query?query="
+           + urllib.parse.quote(expr))
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        payload = json.loads(r.read().decode())
+    return [(e.get("metric", {}), float(e["value"][1]))
+            for e in payload.get("data", {}).get("result", [])]
+
+
+def collector_alerts(base: str, timeout: float = 5.0) -> "list[dict]":
+    url = base.rstrip("/") + "/api/alerts"
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        payload = json.loads(r.read().decode())
+    return payload.get("data", {}).get("alerts", [])
+
+
+def sweep_collector(base: str, timeout: float = 5.0
+                    ) -> "tuple[list[dict], list[dict]]":
+    """The single-query-path sweep: rebuild each instance's family dict
+    from /api/query results and feed the SAME node_row() the scrape
+    path uses; alerts ride along from /api/alerts. An unreachable
+    collector renders one `unreachable` row for the collector itself —
+    same convention as a dead exporter."""
+    try:
+        by_instance: "dict[str, dict]" = {}
+        for fam in NODE_FAMILIES:
+            for labels, value in collector_query(base, fam, timeout):
+                inst = labels.get("instance", "?")
+                by_instance.setdefault(inst, {}).setdefault(
+                    fam, []).append((labels, value))
+        alerts = collector_alerts(base, timeout)
+    except (urllib.error.URLError, OSError, ValueError):
+        return [node_row(base, None)], []
+    rows = [node_row(inst, fams)
+            for inst, fams in sorted(by_instance.items())]
+    return rows, alerts
+
+
+def _instance_alert_count(row: dict, alerts: "list[dict]") -> int:
+    """Firing alerts whose labels pin this row's instance; alerts with
+    no instance label (fleet-wide: canary verdicts, burn rates) count
+    on every row — everyone's pager rings."""
+    n = 0
+    for a in alerts:
+        if a.get("state") != "firing":
+            continue
+        inst = a.get("labels", {}).get("instance")
+        if inst is None or inst == row["node"]:
+            n += 1
+    return n
+
+
 def _gib(v) -> str:
     return "n/a" if v is None else f"{v / 2**30:.1f}"
 
@@ -137,13 +207,18 @@ def _pct(v) -> str:
     return "n/a" if v is None else f"{int(v)}%"
 
 
-def render_table(rows: "list[dict]") -> str:
+def render_table(rows: "list[dict]",
+                 alerts: "list[dict] | None" = None) -> str:
     """The cluster table: one node line, then one line per chip the
     node's workloads report on (a chip in sysfs with no telemetry is
-    visible as the CHIPS count exceeding the chip lines)."""
+    visible as the CHIPS count exceeding the chip lines). With
+    ``alerts`` (collector mode) an ALERTS column carries each row's
+    firing count and a footer lists the firing alerts by name."""
     hdr = (f"{'NODE':<28} {'HEALTH':<16} {'CHIPS':>5} "
            f"{'HBM GiB':>12} {'UTIL':>5} {'DROPS':>5} {'AGE s':>7} "
            f"{'CANARY':>7} {'BUDGET':>7}")
+    if alerts is not None:
+        hdr += f" {'ALERTS':>7}"
     lines = [hdr, "-" * len(hdr)]
     for r in rows:
         chips = ("n/a" if r["chips"] is None else
@@ -165,13 +240,26 @@ def render_table(rows: "list[dict]") -> str:
             r.get("canary_ok"), "?")
         budget = ("-" if r.get("budget_remaining") is None
                   else f"{r['budget_remaining']:.2f}")
-        lines.append(f"{r['node']:<28} {r['health']:<16} {chips:>5} "
-                     f"{hbm:>12} {util:>5} {drops:>5} {age:>7} "
-                     f"{canary:>7} {budget:>7}")
+        line = (f"{r['node']:<28} {r['health']:<16} {chips:>5} "
+                f"{hbm:>12} {util:>5} {drops:>5} {age:>7} "
+                f"{canary:>7} {budget:>7}")
+        if alerts is not None:
+            n = _instance_alert_count(r, alerts)
+            line += f" {(str(n) + '!' if n else '-'):>7}"
+        lines.append(line)
         for d in r["devices"]:
             lines.append(f"  chip {d['chip']:<4} "
                          f"{_gib(d['used'])}/{_gib(d['limit'])} GiB"
                          f"  util {_pct(d['duty'])}")
+    if alerts is not None:
+        firing = [a for a in alerts if a.get("state") == "firing"]
+        pending = [a for a in alerts if a.get("state") == "pending"]
+        if firing:
+            lines.append("FIRING: " + ", ".join(
+                sorted(a["name"] for a in firing)))
+        if pending:
+            lines.append("pending: " + ", ".join(
+                sorted(a["name"] for a in pending)))
     return "\n".join(lines)
 
 
@@ -195,8 +283,13 @@ def fleet_ok(rows: "list[dict]") -> bool:
 def main(argv: "list[str] | None" = None) -> int:
     ap = argparse.ArgumentParser(
         description="Cluster-wide TPU table from k3stpu node exporters")
-    ap.add_argument("endpoints", nargs="+",
+    ap.add_argument("endpoints", nargs="*",
                     help="node exporter base URLs (http://node:8478)")
+    ap.add_argument("--collector", default=None, metavar="URL",
+                    help="embedded metrics pipeline base URL — build "
+                         "the table from its /api/query instead of "
+                         "scraping exporters directly, with an ALERTS "
+                         "column from /api/alerts")
     ap.add_argument("--watch", type=float, default=0,
                     help="refresh every N seconds (0 = render once)")
     ap.add_argument("--timeout", type=float, default=5.0)
@@ -204,17 +297,26 @@ def main(argv: "list[str] | None" = None) -> int:
                     help="emit the rows as one JSON line instead of "
                          "the table (machine consumers)")
     args = ap.parse_args(argv)
+    if not args.collector and not args.endpoints:
+        ap.error("either endpoints or --collector is required")
 
     while True:
-        rows = sweep(args.endpoints, args.timeout)
-        if args.json:
-            print(json.dumps(rows), flush=True)
+        if args.collector:
+            rows, alerts = sweep_collector(args.collector, args.timeout)
         else:
-            print(render_table(rows), flush=True)
+            rows, alerts = sweep(args.endpoints, args.timeout), None
+        if args.json:
+            payload = (rows if alerts is None
+                       else {"rows": rows, "alerts": alerts})
+            print(json.dumps(payload), flush=True)
+        else:
+            print(render_table(rows, alerts), flush=True)
         if not args.watch:
             break
         time.sleep(args.watch)
-    return 0 if fleet_ok(rows) else 1
+    firing = bool(alerts) and any(a.get("state") == "firing"
+                                  for a in alerts)
+    return 0 if fleet_ok(rows) and not firing else 1
 
 
 if __name__ == "__main__":
